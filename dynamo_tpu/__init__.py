@@ -1,0 +1,27 @@
+"""dynamo-tpu: a TPU-native distributed LLM inference serving framework.
+
+A ground-up re-design of the capabilities of NVIDIA Dynamo (the reference,
+see SURVEY.md) for TPU hardware:
+
+- OpenAI-compatible HTTP frontend with SSE streaming (``dynamo_tpu.frontend``).
+- A distributed runtime with hierarchical addressing
+  (Namespace -> Component -> Endpoint -> Instance), lease-based liveness and
+  a two-plane transport: a broker-style request plane and a direct stream
+  response plane (``dynamo_tpu.runtime``).
+- KV-cache-aware request routing over a global radix index
+  (``dynamo_tpu.router``).
+- A multi-tier KV block manager: HBM (G1) -> host RAM (G2) -> disk (G3)
+  (``dynamo_tpu.blocks``).
+- A first-party JAX engine: continuous batching, paged KV cache, Pallas
+  paged-attention kernels, pjit/GSPMD sharding over TPU meshes
+  (``dynamo_tpu.engine``, ``dynamo_tpu.ops``, ``dynamo_tpu.models``,
+  ``dynamo_tpu.parallel``).
+- Disaggregated prefill/decode with KV migration over ICI/DCN
+  (``dynamo_tpu.engine.disagg``).
+
+Unlike the reference, which orchestrates third-party GPU engines, the engine
+layer here is first-party JAX, so intra-model parallelism (TP/EP/SP) is
+implemented natively.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,39 @@
+"""Distributed runtime core.
+
+Capability parity with the reference's `lib/runtime` crate (see SURVEY.md §1
+L1/L2), re-designed for asyncio + an in-process/TCP transport pair:
+
+- :mod:`dynamo_tpu.runtime.engine` — the streaming ``AsyncEngine`` abstraction
+  and per-request ``Context`` (id / stop / kill lifecycle).
+- :mod:`dynamo_tpu.runtime.discovery` — pluggable key-value discovery store
+  with TTL leases, prefix watch (etcd-equivalent; in-memory and TCP-served).
+- :mod:`dynamo_tpu.runtime.transport` — the request/response data plane
+  (broker-free: direct streams with a two-part codec).
+- :mod:`dynamo_tpu.runtime.component` — hierarchical addressing:
+  Namespace -> Component -> Endpoint -> Instance(lease_id).
+- :mod:`dynamo_tpu.runtime.client` — endpoint clients with instance watching
+  and router modes (round-robin / random / direct / KV).
+"""
+
+from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineError
+from dynamo_tpu.runtime.discovery import (
+    KeyValueStore,
+    Lease,
+    MemoryStore,
+    WatchEvent,
+    WatchEventType,
+)
+from dynamo_tpu.runtime.component import DistributedRuntime, Instance
+
+__all__ = [
+    "AsyncEngine",
+    "Context",
+    "EngineError",
+    "KeyValueStore",
+    "Lease",
+    "MemoryStore",
+    "WatchEvent",
+    "WatchEventType",
+    "DistributedRuntime",
+    "Instance",
+]

@@ -1,0 +1,183 @@
+"""Endpoint client: instance watching, routing modes, failure inhibition.
+
+A client watches the discovery prefix for its endpoint and keeps a live
+instance table. Each request picks an instance by router mode:
+
+- ``round_robin`` / ``random`` — load-agnostic spreading (DP across replicas).
+- ``direct`` — pin to a specific instance id (used by the disagg path and by
+  the KV router, which computes the instance id itself and then goes direct).
+
+Instances that fail a request are *inhibited* for a short window rather than
+removed — discovery owns membership (lease expiry), the client only routes
+around transient errors. Parity: reference `component/client.rs:56-150` and
+PushRouter modes (`egress/push_router.rs:72-85`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import time
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.runtime.component import Endpoint, Instance, instance_prefix
+from dynamo_tpu.runtime.discovery import WatchEventType
+from dynamo_tpu.runtime.engine import Context, EngineError
+from dynamo_tpu.runtime.transport import NoSuchSubjectError
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_INHIBIT_SECONDS = 2.0
+
+
+class NoInstancesError(RuntimeError):
+    pass
+
+
+class Client:
+    def __init__(
+        self,
+        endpoint: Endpoint,
+        *,
+        router_mode: str = "round_robin",
+        inhibit_seconds: float = DEFAULT_INHIBIT_SECONDS,
+        max_attempts: int = 3,
+    ) -> None:
+        if router_mode not in ("round_robin", "random", "direct"):
+            raise ValueError(f"unknown router mode: {router_mode}")
+        self.endpoint = endpoint
+        self.router_mode = router_mode
+        self._instances: dict[int, Instance] = {}
+        self._inhibited: dict[int, float] = {}  # instance_id -> inhibit deadline
+        self._inhibit_seconds = inhibit_seconds
+        self._max_attempts = max_attempts
+        self._rr_counter = 0
+        self._watch_task: asyncio.Task | None = None
+        self._changed: asyncio.Event = asyncio.Event()
+
+    # -- instance table ----------------------------------------------------
+
+    async def start(self) -> "Client":
+        if self._watch_task is None:
+            # Seed synchronously so the first generate() after start() sees
+            # currently-registered instances; the watch (whose initial
+            # snapshot upserts idempotently) then keeps the table live.
+            ep = self.endpoint
+            prefix = instance_prefix(ep.namespace, ep.component, ep.name)
+            for value in (await ep.runtime.store.get_prefix(prefix)).values():
+                inst = Instance.from_bytes(value)
+                self._instances[inst.instance_id] = inst
+            self._watch_task = asyncio.create_task(self._watch_loop())
+        return self
+
+    async def _watch_loop(self) -> None:
+        ep = self.endpoint
+        prefix = instance_prefix(ep.namespace, ep.component, ep.name)
+        try:
+            async for event in ep.runtime.store.watch_prefix(prefix):
+                if event.type is WatchEventType.PUT and event.value is not None:
+                    inst = Instance.from_bytes(event.value)
+                    self._instances[inst.instance_id] = inst
+                elif event.type is WatchEventType.DELETE:
+                    lease_hex = event.key.rsplit(":", 1)[-1]
+                    self._instances.pop(int(lease_hex, 16), None)
+                self._changed.set()
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.exception("instance watch failed for %s", ep.path)
+
+    def instances(self) -> list[Instance]:
+        return list(self._instances.values())
+
+    def instance_ids(self) -> list[int]:
+        return list(self._instances.keys())
+
+    async def wait_for_instances(self, *, count: int = 1, timeout: float = 10.0) -> list[Instance]:
+        await self.start()
+        deadline = time.monotonic() + timeout
+        while len(self._instances) < count:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"{self.endpoint.path}: {len(self._instances)}/{count} instances after {timeout}s"
+                )
+            self._changed.clear()
+            try:
+                await asyncio.wait_for(self._changed.wait(), timeout=remaining)
+            except asyncio.TimeoutError:
+                continue
+        return self.instances()
+
+    # -- selection ---------------------------------------------------------
+
+    def _eligible(self) -> list[Instance]:
+        now = time.monotonic()
+        self._inhibited = {i: t for i, t in self._inhibited.items() if t > now}
+        pool = [inst for iid, inst in self._instances.items() if iid not in self._inhibited]
+        # All inhibited is worse than trying an inhibited one: fall back.
+        return pool or list(self._instances.values())
+
+    def _pick(self, instance_id: int | None) -> Instance:
+        if instance_id is not None:
+            inst = self._instances.get(instance_id)
+            if inst is None:
+                raise NoInstancesError(f"instance {instance_id:x} not found for {self.endpoint.path}")
+            return inst
+        pool = self._eligible()
+        if not pool:
+            raise NoInstancesError(f"no live instances for {self.endpoint.path}")
+        if self.router_mode == "random":
+            return random.choice(pool)
+        self._rr_counter += 1
+        return pool[self._rr_counter % len(pool)]
+
+    def inhibit(self, instance_id: int) -> None:
+        self._inhibited[instance_id] = time.monotonic() + self._inhibit_seconds
+
+    # -- request path ------------------------------------------------------
+
+    async def generate(
+        self,
+        request: Any,
+        context: Context | None = None,
+        *,
+        instance_id: int | None = None,
+    ) -> AsyncIterator[Any]:
+        """Open a response stream on one instance (retrying across replicas).
+
+        Retries only happen before the first response item — once tokens have
+        flowed, a failure surfaces to the caller (no replay of partial
+        streams, same stance as the reference).
+        """
+        context = context or Context()
+        await self.start()
+        transport = self.endpoint.runtime.transport
+        attempts = self._max_attempts if instance_id is None else 1
+        last_error: Exception | None = None
+        for _ in range(attempts):
+            inst = self._pick(instance_id)
+            stream = transport.generate(inst.address, request, context)
+            try:
+                try:
+                    first = await anext(stream)
+                except StopAsyncIteration:
+                    return
+                except (NoSuchSubjectError, ConnectionError, OSError, EngineError) as exc:
+                    logger.warning("instance %x failed pre-stream: %s; inhibiting", inst.instance_id, exc)
+                    self.inhibit(inst.instance_id)
+                    last_error = exc
+                    continue
+                yield first
+                async for item in stream:
+                    yield item
+                return
+            finally:
+                await stream.aclose()
+        raise last_error if last_error is not None else NoInstancesError(self.endpoint.path)
+
+    async def close(self) -> None:
+        if self._watch_task is not None:
+            self._watch_task.cancel()
+            self._watch_task = None

@@ -1,0 +1,75 @@
+"""Two-part wire codec for the stream data plane.
+
+Frames are ``[4-byte big-endian length][msgpack body]``. The body always has a
+control part (``t`` = frame type, plus routing/identity fields) and an
+optional payload part (``p``) — the same split as the reference's
+TwoPartCodec (`lib/runtime/src/pipeline/network/codec/two_part.rs`): control
+headers small and introspectable, payload opaque.
+
+msgpack (not JSON) keeps the per-token hot path cheap; the payload may carry
+raw bytes (e.g. serialized arrays) with no base64 overhead.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from enum import Enum
+from typing import Any
+
+import msgpack
+
+MAX_FRAME_BYTES = 256 * 1024 * 1024  # hard cap; a corrupt length prefix fails fast
+
+
+class FrameType(str, Enum):
+    REQUEST = "req"        # caller -> worker: open a stream {subject, id, p}
+    PROLOGUE = "pro"       # worker -> caller: stream accepted (or error detail)
+    DATA = "dat"           # worker -> caller: one response item
+    ERROR = "err"          # worker -> caller: stream failed; terminal
+    COMPLETE = "end"       # worker -> caller: stream finished; terminal
+    STOP = "stp"           # caller -> worker: stop generating (graceful)
+    KILL = "kil"           # caller -> worker: hard-cancel the stream
+
+
+@dataclass(frozen=True)
+class Frame:
+    type: FrameType
+    fields: dict[str, Any]
+
+    @property
+    def payload(self) -> Any:
+        return self.fields.get("p")
+
+
+def encode_frame(ftype: FrameType, **fields: Any) -> bytes:
+    body = msgpack.packb({"t": ftype.value, **fields}, use_bin_type=True)
+    if len(body) > MAX_FRAME_BYTES:
+        raise ValueError(f"frame too large: {len(body)} bytes")
+    return len(body).to_bytes(4, "big") + body
+
+
+def decode_body(body: bytes) -> Frame:
+    obj = msgpack.unpackb(body, raw=False)
+    t = obj.pop("t")
+    return Frame(type=FrameType(t), fields=obj)
+
+
+async def read_frame(reader: asyncio.StreamReader) -> Frame | None:
+    """Read one frame; None on clean EOF."""
+    try:
+        header = await reader.readexactly(4)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    length = int.from_bytes(header, "big")
+    if length > MAX_FRAME_BYTES:
+        raise ValueError(f"frame length {length} exceeds cap")
+    try:
+        body = await reader.readexactly(length)
+    except (asyncio.IncompleteReadError, ConnectionResetError):
+        return None
+    return decode_body(body)
+
+
+def write_frame(writer: asyncio.StreamWriter, ftype: FrameType, **fields: Any) -> None:
+    writer.write(encode_frame(ftype, **fields))

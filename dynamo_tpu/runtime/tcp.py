@@ -1,0 +1,199 @@
+"""TCP stream transport: the cross-process data plane.
+
+Each worker process runs one asyncio TCP server; every registered subject is
+reachable at ``tcp://host:port/subject``. A caller opens one connection per
+request stream:
+
+    caller -> worker   REQUEST {subject, id, p}
+    worker -> caller   PROLOGUE            (accepted; or carries error detail)
+    worker -> caller   DATA* then COMPLETE | ERROR
+    caller -> worker   STOP | KILL         (any time; graceful / hard cancel)
+
+Connection teardown is equivalent to KILL, so a dead caller can never leak a
+running generation. Parity: reference response plane `tcp/server.rs` +
+control messages `network.rs:49-73`; see transport.py for why this is a
+single-connection design rather than broker+callback.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, AsyncIterator
+from urllib.parse import urlparse
+
+from dynamo_tpu.runtime.codec import Frame, FrameType, read_frame, write_frame
+from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineError
+from dynamo_tpu.runtime.transport import NoSuchSubjectError, Transport
+
+logger = logging.getLogger(__name__)
+
+
+class TcpTransport(Transport):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, *, advertise_host: str | None = None) -> None:
+        self._host = host
+        self._port = port
+        self._advertise_host = advertise_host or host
+        self._engines: dict[str, AsyncEngine[Any, Any]] = {}
+        self._server: asyncio.Server | None = None
+        self._server_lock = asyncio.Lock()
+        self._conn_tasks: set[asyncio.Task] = set()
+
+    # -- worker side -------------------------------------------------------
+
+    async def _ensure_server(self) -> None:
+        async with self._server_lock:
+            if self._server is None:
+                self._server = await asyncio.start_server(self._handle_conn, self._host, self._port)
+                self._port = self._server.sockets[0].getsockname()[1]
+
+    async def register_engine(self, subject: str, engine: AsyncEngine[Any, Any]) -> None:
+        if subject in self._engines:
+            raise ValueError(f"subject already registered: {subject}")
+        await self._ensure_server()
+        self._engines[subject] = engine
+
+    async def unregister_engine(self, subject: str) -> None:
+        self._engines.pop(subject, None)
+
+    def address_of(self, subject: str) -> str:
+        if self._server is None:
+            raise RuntimeError("transport server not started; register an engine first")
+        return f"tcp://{self._advertise_host}:{self._port}/{subject}"
+
+    async def _handle_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        task = asyncio.current_task()
+        assert task is not None
+        self._conn_tasks.add(task)
+        try:
+            await self._serve_stream(reader, writer)
+        except Exception:
+            logger.exception("connection handler failed")
+        finally:
+            self._conn_tasks.discard(task)
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+
+    async def _serve_stream(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        req = await read_frame(reader)
+        if req is None or req.type is not FrameType.REQUEST:
+            return
+        subject = req.fields.get("subject", "")
+        engine = self._engines.get(subject)
+        if engine is None:
+            write_frame(writer, FrameType.PROLOGUE, ok=False, error=f"no such subject: {subject}")
+            await writer.drain()
+            return
+        context = Context(request_id=req.fields.get("id"))
+        write_frame(writer, FrameType.PROLOGUE, ok=True)
+
+        async def watch_control() -> None:
+            # Inbound control frames; EOF (caller vanished) => hard cancel.
+            while True:
+                frame = await read_frame(reader)
+                if frame is None:
+                    context.kill()
+                    return
+                if frame.type is FrameType.STOP:
+                    context.stop_generating()
+                elif frame.type is FrameType.KILL:
+                    context.kill()
+                    return
+
+        control_task = asyncio.create_task(watch_control())
+        stream = engine.generate(req.payload, context)
+        try:
+            async for item in stream:
+                if context.is_killed:
+                    break
+                write_frame(writer, FrameType.DATA, p=item)
+                await writer.drain()
+            if not context.is_killed:
+                write_frame(writer, FrameType.COMPLETE)
+                await writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            context.kill()
+        except Exception as exc:  # engine failure -> ERROR frame
+            logger.exception("engine stream failed (subject=%s)", subject)
+            context.kill()
+            try:
+                write_frame(writer, FrameType.ERROR, error=f"{type(exc).__name__}: {exc}")
+                await writer.drain()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+        finally:
+            control_task.cancel()
+            aclose = getattr(stream, "aclose", None)
+            if aclose is not None:
+                await aclose()
+
+    # -- caller side -------------------------------------------------------
+
+    async def generate(self, address: str, request: Any, context: Context) -> AsyncIterator[Any]:
+        url = urlparse(address)
+        if url.scheme != "tcp":
+            raise ValueError(f"not a tcp address: {address}")
+        subject = url.path.lstrip("/")
+        reader, writer = await asyncio.open_connection(url.hostname, url.port)
+
+        async def forward_cancel() -> None:
+            stop_wait = asyncio.create_task(context.wait_stopped())
+            kill_wait = asyncio.create_task(context.wait_killed())
+            try:
+                await asyncio.wait({stop_wait, kill_wait}, return_when=asyncio.FIRST_COMPLETED)
+                write_frame(writer, FrameType.KILL if context.is_killed else FrameType.STOP)
+                await writer.drain()
+                if not context.is_killed:
+                    await kill_wait
+                    write_frame(writer, FrameType.KILL)
+                    await writer.drain()
+            except (ConnectionResetError, BrokenPipeError, asyncio.CancelledError):
+                pass
+            finally:
+                stop_wait.cancel()
+                kill_wait.cancel()
+
+        cancel_task = asyncio.create_task(forward_cancel())
+        try:
+            write_frame(writer, FrameType.REQUEST, subject=subject, id=context.id, p=request)
+            await writer.drain()
+            prologue = await read_frame(reader)
+            if prologue is None:
+                raise EngineError("connection closed before prologue")
+            if prologue.type is not FrameType.PROLOGUE:
+                raise EngineError(f"expected prologue, got {prologue.type}")
+            if not prologue.fields.get("ok", False):
+                err = prologue.fields.get("error", "rejected")
+                if "no such subject" in err:
+                    raise NoSuchSubjectError(err)
+                raise EngineError(err)
+            while True:
+                frame = await read_frame(reader)
+                if frame is None:
+                    if context.is_killed or context.is_stopped:
+                        return
+                    raise EngineError("connection closed mid-stream")
+                if frame.type is FrameType.DATA:
+                    yield frame.payload
+                elif frame.type is FrameType.COMPLETE:
+                    return
+                elif frame.type is FrameType.ERROR:
+                    raise EngineError(frame.fields.get("error", "remote engine failed"))
+        finally:
+            cancel_task.cancel()
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        for task in list(self._conn_tasks):
+            task.cancel()

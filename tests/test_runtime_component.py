@@ -1,0 +1,158 @@
+"""Tests for the component model + client: registration, watch, routing, failover."""
+
+import asyncio
+from typing import Any, AsyncIterator
+
+import pytest
+
+from dynamo_tpu.runtime.client import NoInstancesError
+from dynamo_tpu.runtime.component import DistributedRuntime, Instance, instance_key
+from dynamo_tpu.runtime.discovery import MemoryStore
+from dynamo_tpu.runtime.engine import AsyncEngine, Context, collect
+from dynamo_tpu.runtime.tcp import TcpTransport
+
+
+class TaggedEngine(AsyncEngine[Any, Any]):
+    def __init__(self, tag: str) -> None:
+        self.tag = tag
+        self.calls = 0
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        self.calls += 1
+        yield {"tag": self.tag, "echo": request}
+
+
+async def test_instance_record_roundtrip():
+    inst = Instance("ns", "comp", "ep", 0xAB, "tcp://1.2.3.4:5/s", {"m": 1})
+    assert Instance.from_bytes(inst.to_bytes()) == inst
+    assert inst.key == "instances/ns/comp/ep:ab"
+    assert inst.subject == "ns.comp.ep-ab"
+    assert instance_key("ns", "comp", "ep", 0xAB) == inst.key
+
+
+async def test_invalid_names_rejected():
+    rt = DistributedRuntime.detached()
+    with pytest.raises(ValueError):
+        rt.namespace("bad/name")
+    with pytest.raises(ValueError):
+        rt.namespace("ok").component("no dots.")
+    await rt.close()
+
+
+async def test_serve_and_call_via_client():
+    rt = DistributedRuntime.detached()
+    ep = rt.namespace("dynamo").component("backend").endpoint("generate")
+    await ep.serve(TaggedEngine("w1"))
+    client = ep.client()
+    await client.wait_for_instances(count=1, timeout=5)
+    items = await collect(client.generate({"x": 1}))
+    assert items == [{"tag": "w1", "echo": {"x": 1}}]
+    await rt.close()
+
+
+async def test_round_robin_spreads_load():
+    # Two worker runtimes sharing one store/transport pair (same process).
+    store = MemoryStore()
+    rt1 = DistributedRuntime(store)
+    rt2 = DistributedRuntime(store, rt1.transport)
+    e1, e2 = TaggedEngine("w1"), TaggedEngine("w2")
+    await rt1.namespace("ns").component("c").endpoint("e").serve(e1)
+    await rt2.namespace("ns").component("c").endpoint("e").serve(e2)
+    client = rt1.namespace("ns").component("c").endpoint("e").client()
+    await client.wait_for_instances(count=2, timeout=5)
+    for _ in range(10):
+        await collect(client.generate({}))
+    assert e1.calls == 5 and e2.calls == 5
+    await rt1.close()
+    await rt2.close()
+
+
+async def test_direct_routing():
+    store = MemoryStore()
+    rt1 = DistributedRuntime(store)
+    rt2 = DistributedRuntime(store, rt1.transport)
+    e1, e2 = TaggedEngine("w1"), TaggedEngine("w2")
+    i1 = await rt1.namespace("ns").component("c").endpoint("e").serve(e1)
+    await rt2.namespace("ns").component("c").endpoint("e").serve(e2)
+    client = rt1.namespace("ns").component("c").endpoint("e").client(router_mode="direct")
+    await client.wait_for_instances(count=2, timeout=5)
+    for _ in range(4):
+        await collect(client.generate({}, instance_id=i1.instance_id))
+    assert e1.calls == 4 and e2.calls == 0
+    await rt1.close()
+    await rt2.close()
+
+
+async def test_lease_expiry_removes_instance_from_client():
+    store = MemoryStore(reap_interval=0.05)
+    rt_worker = DistributedRuntime(store, lease_ttl=0.15)
+    rt_client = DistributedRuntime(store, rt_worker.transport)
+    ep = rt_worker.namespace("ns").component("c").endpoint("e")
+    await ep.serve(TaggedEngine("w"))
+    client = rt_client.namespace("ns").component("c").endpoint("e").client()
+    await client.wait_for_instances(count=1, timeout=5)
+    # Kill the worker's keep-alive: simulate process death.
+    rt_worker._keepalive_task.cancel()
+    await asyncio.sleep(0.6)
+    assert client.instances() == []
+    with pytest.raises(NoInstancesError):
+        await collect(client.generate({}))
+    await rt_worker.close()
+    await rt_client.close()
+
+
+async def test_failover_inhibits_dead_instance_tcp():
+    """A stale discovery record (worker gone, record not yet expired) is routed around."""
+    store = MemoryStore()
+    transport = TcpTransport()
+    rt = DistributedRuntime(store, transport)
+    ep = rt.namespace("ns").component("c").endpoint("e")
+    good = TaggedEngine("good")
+    inst_good = await ep.serve(good)
+    # Forge a second instance record pointing at a dead port.
+    lease = await store.create_lease(10)
+    dead = Instance("ns", "c", "e", lease.id, "tcp://127.0.0.1:1/ns.c.e-dead")
+    await store.put(dead.key, dead.to_bytes(), lease_id=lease.id)
+    client = ep.client(router_mode="random")
+    await client.wait_for_instances(count=2, timeout=5)
+    for _ in range(8):
+        items = await collect(client.generate({}))
+        assert items[0]["tag"] == "good"
+    assert good.calls == 8
+    assert inst_good.instance_id not in client._inhibited
+    await rt.close()
+
+
+async def test_context_kill_propagates_to_children():
+    from dynamo_tpu.runtime.engine import Context
+
+    p = Context()
+    c = p.child()
+    p.kill()
+    assert c.is_killed and c.is_stopped
+    # Children created after the fact inherit the state too.
+    c2 = p.child()
+    assert c2.is_killed
+
+
+async def test_put_if_absent_concurrent_single_winner():
+    store = MemoryStore()
+
+    async def racer(val):
+        return await store.put_if_absent("k", val)
+
+    results = await asyncio.gather(*[racer(f"v{i}".encode()) for i in range(10)])
+    assert sum(results) == 1
+    winner = await store.get("k")
+    assert winner == f"v{results.index(True)}".encode()
+
+
+async def test_first_generate_after_start_sees_existing_instances():
+    rt = DistributedRuntime.detached()
+    ep = rt.namespace("ns").component("c").endpoint("e")
+    await ep.serve(TaggedEngine("w"))
+    client = ep.client()
+    # No wait_for_instances: the synchronous seed in start() must suffice.
+    items = await collect(client.generate({}))
+    assert items[0]["tag"] == "w"
+    await rt.close()

@@ -1,0 +1,101 @@
+"""Tests for the discovery store: put/get/watch, leases, cascade expiry."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.discovery import MemoryStore, WatchEventType
+
+
+async def test_put_get_delete():
+    store = MemoryStore()
+    await store.put("a/b", b"1")
+    assert await store.get("a/b") == b"1"
+    await store.put("a/b", b"2")
+    assert await store.get("a/b") == b"2"
+    assert await store.delete("a/b") is True
+    assert await store.delete("a/b") is False
+    assert await store.get("a/b") is None
+
+
+async def test_get_prefix():
+    store = MemoryStore()
+    await store.put("models/ns/x", b"x")
+    await store.put("models/ns/y", b"y")
+    await store.put("instances/ns/z", b"z")
+    got = await store.get_prefix("models/ns/")
+    assert got == {"models/ns/x": b"x", "models/ns/y": b"y"}
+
+
+async def test_put_if_absent():
+    store = MemoryStore()
+    assert await store.put_if_absent("k", b"first") is True
+    assert await store.put_if_absent("k", b"second") is False
+    assert await store.get("k") == b"first"
+
+
+async def test_watch_snapshot_and_live_events():
+    store = MemoryStore()
+    await store.put("pre/a", b"1")
+    events = []
+
+    async def watcher():
+        async for ev in store.watch_prefix("pre/"):
+            events.append(ev)
+            if len(events) == 3:
+                return
+
+    task = asyncio.create_task(watcher())
+    await asyncio.sleep(0.05)
+    await store.put("pre/b", b"2")
+    await store.put("other/c", b"x")  # outside prefix: not delivered
+    await store.delete("pre/a")
+    await asyncio.wait_for(task, timeout=5)
+    assert [(e.type, e.key) for e in events] == [
+        (WatchEventType.PUT, "pre/a"),
+        (WatchEventType.PUT, "pre/b"),
+        (WatchEventType.DELETE, "pre/a"),
+    ]
+
+
+async def test_lease_expiry_cascades_and_notifies():
+    store = MemoryStore(reap_interval=0.05)
+    lease = await store.create_lease(ttl=0.15)
+    await store.put("instances/w1", b"i", lease_id=lease.id)
+    await store.put("unleased", b"u")
+    deletes = []
+
+    async def watcher():
+        async for ev in store.watch_prefix("instances/", initial=False):
+            if ev.type is WatchEventType.DELETE:
+                deletes.append(ev.key)
+                return
+
+    task = asyncio.create_task(watcher())
+    await asyncio.sleep(0.4)  # no keep-alive -> lease expires
+    await asyncio.wait_for(task, timeout=5)
+    assert deletes == ["instances/w1"]
+    assert await store.get("instances/w1") is None
+    assert await store.get("unleased") == b"u"
+    await store.close()
+
+
+async def test_keep_alive_extends_lease():
+    store = MemoryStore(reap_interval=0.05)
+    lease = await store.create_lease(ttl=0.2)
+    await store.put("k", b"v", lease_id=lease.id)
+    for _ in range(5):
+        await asyncio.sleep(0.1)
+        await lease.keep_alive()
+    assert await store.get("k") == b"v"
+    await lease.revoke()
+    assert await store.get("k") is None
+    with pytest.raises(KeyError):
+        await store.keep_alive(lease.id)
+    await store.close()
+
+
+async def test_put_with_unknown_lease_rejected():
+    store = MemoryStore()
+    with pytest.raises(KeyError):
+        await store.put("k", b"v", lease_id=999)

@@ -1,0 +1,137 @@
+"""Tests for the stream transports (in-memory and TCP): streaming, errors, cancel."""
+
+import asyncio
+from typing import Any, AsyncIterator
+
+import pytest
+
+from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineError, collect
+from dynamo_tpu.runtime.tcp import TcpTransport
+from dynamo_tpu.runtime.transport import InMemoryTransport, NoSuchSubjectError
+
+
+class CountingEngine(AsyncEngine[Any, Any]):
+    """Streams {'i': k} for k < n; honors stop/kill; records how far it got."""
+
+    def __init__(self, delay: float = 0.0) -> None:
+        self.delay = delay
+        self.emitted = 0
+        self.saw_stop = False
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        n = request["n"]
+        for k in range(n):
+            if context.is_stopped:
+                self.saw_stop = True
+                return
+            if self.delay:
+                await asyncio.sleep(self.delay)
+            self.emitted += 1
+            yield {"i": k}
+
+
+class FailingEngine(AsyncEngine[Any, Any]):
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        yield {"i": 0}
+        raise ValueError("engine exploded")
+
+
+async def _transports():
+    mem = InMemoryTransport()
+    tcp = TcpTransport()
+    return [mem, tcp]
+
+
+async def test_stream_roundtrip_both_transports():
+    for transport in await _transports():
+        engine = CountingEngine()
+        await transport.register_engine("ns.comp.ep-1", engine)
+        addr = transport.address_of("ns.comp.ep-1")
+        items = await collect(transport.generate(addr, {"n": 5}, Context()))
+        assert items == [{"i": k} for k in range(5)]
+        await transport.close()
+
+
+async def test_unknown_subject_raises():
+    for transport in await _transports():
+        await transport.register_engine("known", CountingEngine())
+        base = transport.address_of("known")
+        bad = base.replace("known", "missing")
+        with pytest.raises(NoSuchSubjectError):
+            await collect(transport.generate(bad, {"n": 1}, Context()))
+        await transport.close()
+
+
+async def test_engine_error_propagates():
+    for transport in await _transports():
+        await transport.register_engine("f", FailingEngine())
+        addr = transport.address_of("f")
+        items = []
+        with pytest.raises(EngineError):
+            async for item in transport.generate(addr, {}, Context()):
+                items.append(item)
+        assert items == [{"i": 0}]
+        await transport.close()
+
+
+async def test_stop_generating_crosses_transport():
+    for transport in await _transports():
+        engine = CountingEngine(delay=0.02)
+        await transport.register_engine("s", engine)
+        addr = transport.address_of("s")
+        ctx = Context()
+        items = []
+        async for item in transport.generate(addr, {"n": 1000}, ctx):
+            items.append(item)
+            if len(items) == 3:
+                ctx.stop_generating()
+        # Engine must have stopped long before 1000 items.
+        assert 3 <= engine.emitted < 100
+        await transport.close()
+
+
+async def test_caller_abandons_stream_kills_engine():
+    for transport in await _transports():
+        engine = CountingEngine(delay=0.02)
+        await transport.register_engine("a", engine)
+        addr = transport.address_of("a")
+        stream = transport.generate(addr, {"n": 1000}, Context())
+        got = 0
+        async for _ in stream:
+            got += 1
+            if got == 2:
+                break  # abandon: generator close should kill remote
+        await stream.aclose()
+        await asyncio.sleep(0.2)
+        emitted_after = engine.emitted
+        await asyncio.sleep(0.2)
+        assert engine.emitted == emitted_after, "engine kept running after caller left"
+        await transport.close()
+
+
+async def test_binary_payloads_roundtrip():
+    class EchoEngine(AsyncEngine[Any, Any]):
+        async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+            yield request
+
+    for transport in await _transports():
+        await transport.register_engine("b", EchoEngine())
+        addr = transport.address_of("b")
+        payload = {"blob": b"\x00\x01\xff" * 100, "ids": [1, 2, 3], "nested": {"x": 1.5}}
+        items = await collect(transport.generate(addr, payload, Context()))
+        assert items == [payload]
+        await transport.close()
+
+
+async def test_concurrent_streams_tcp():
+    transport = TcpTransport()
+    engine = CountingEngine(delay=0.001)
+    await transport.register_engine("c", engine)
+    addr = transport.address_of("c")
+
+    async def one(n):
+        return await collect(transport.generate(addr, {"n": n}, Context()))
+
+    results = await asyncio.gather(*[one(10) for _ in range(20)])
+    assert all(r == [{"i": k} for k in range(10)] for r in results)
+    await transport.close()

@@ -1,0 +1,101 @@
+"""Unit tests for token block hashing (dynamo_tpu.tokens).
+
+Mirrors the reference test strategy for its tokens crate: chained hash
+determinism, prefix stability, incremental-vs-batch equivalence.
+"""
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.tokens import (
+    DEFAULT_SALT,
+    SaltedPrefix,
+    TokenBlockSequence,
+    compute_block_hashes,
+    hash_token_block,
+)
+
+
+def test_hash_deterministic():
+    h1 = hash_token_block([1, 2, 3, 4], None)
+    h2 = hash_token_block([1, 2, 3, 4], None)
+    assert h1 == h2
+    assert isinstance(h1, int)
+    assert 0 <= h1 < 2**64
+
+
+def test_hash_depends_on_tokens_parent_salt():
+    base = hash_token_block([1, 2, 3, 4], None)
+    assert hash_token_block([1, 2, 3, 5], None) != base
+    assert hash_token_block([1, 2, 3, 4], 7) != base
+    assert hash_token_block([1, 2, 3, 4], None, salt=123) != base
+
+
+def test_chained_hashes_prefix_property():
+    """Shared prefixes produce identical leading block hashes; divergence changes the rest."""
+    a = compute_block_hashes(list(range(64)), 16)
+    b = compute_block_hashes(list(range(48)) + [999] * 16, 16)
+    assert a[:3] == b[:3]
+    assert a[3] != b[3]
+
+
+def test_partial_block_excluded():
+    assert compute_block_hashes(list(range(10)), 16) == []
+    assert len(compute_block_hashes(list(range(16)), 16)) == 1
+    assert len(compute_block_hashes(list(range(31)), 16)) == 1
+    assert len(compute_block_hashes(list(range(32)), 16)) == 2
+
+
+def test_numpy_and_list_inputs_agree():
+    toks = list(range(32))
+    assert compute_block_hashes(toks, 16) == compute_block_hashes(np.array(toks, dtype=np.int32), 16)
+    assert compute_block_hashes(toks, 16) == compute_block_hashes(np.array(toks, dtype=np.int64), 16)
+
+
+def test_incremental_sequence_matches_batch():
+    toks = list(np.random.default_rng(0).integers(0, 32000, size=100))
+    seq = TokenBlockSequence(block_size=16)
+    committed = []
+    for t in toks:
+        blk = seq.append(t)
+        if blk is not None:
+            committed.append(blk.block_hash)
+    assert committed == compute_block_hashes(toks, 16)
+    assert len(seq) == 100
+    assert len(seq.partial_tokens) == 100 % 16
+    np.testing.assert_array_equal(seq.tokens, np.asarray(toks, dtype=np.int32))
+
+
+def test_sequence_extend_and_positions():
+    seq = TokenBlockSequence(list(range(40)), block_size=16)
+    assert [b.position for b in seq.blocks] == [0, 1]
+    assert seq.blocks[0].parent_hash is None
+    assert seq.blocks[1].parent_hash == seq.blocks[0].block_hash
+
+
+def test_sequence_truncate():
+    toks = list(range(100))
+    seq = TokenBlockSequence(toks, block_size=16)
+    seq.truncate(40)
+    assert len(seq) == 40
+    assert seq.block_hashes == compute_block_hashes(toks[:40], 16)
+    with pytest.raises(ValueError):
+        seq.truncate(41)
+
+
+def test_block_size_validation():
+    with pytest.raises(ValueError):
+        compute_block_hashes([1, 2], 0)
+    with pytest.raises(ValueError):
+        TokenBlockSequence(block_size=-1)
+
+
+def test_salted_prefix_model_separation():
+    s1 = SaltedPrefix("meta-llama/Llama-3.2-1B").salt
+    s2 = SaltedPrefix("Qwen/Qwen2-7B").salt
+    assert s1 != s2
+    assert SaltedPrefix("meta-llama/Llama-3.2-1B").salt == s1
+    h1 = compute_block_hashes(list(range(16)), 16, salt=s1)
+    h2 = compute_block_hashes(list(range(16)), 16, salt=s2)
+    assert h1 != h2
+    assert SaltedPrefix("x", base_salt=DEFAULT_SALT).salt != DEFAULT_SALT
