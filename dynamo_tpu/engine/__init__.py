@@ -1,0 +1,29 @@
+"""The first-party JAX serving engine.
+
+Continuous batching over a paged HBM KV cache, with prefix-cache reuse keyed
+by chained block hashes (``dynamo_tpu.tokens``) and native KV stored/removed
+event emission for the KV-aware router.
+
+Structure:
+
+- :mod:`dynamo_tpu.engine.allocator` — HBM page pool: free list, refcounted
+  prefix cache, LRU eviction, KV events (the G1 tier).
+- :mod:`dynamo_tpu.engine.sequence` — per-request runtime state.
+- :mod:`dynamo_tpu.engine.runner` — bucketed jit execution of the model's
+  paged forward + fused sampling; owns the device cache arrays.
+- :mod:`dynamo_tpu.engine.scheduler` — admission / decode batching /
+  preemption policy.
+- :mod:`dynamo_tpu.engine.core` — synchronous engine step loop tying the
+  above together.
+- :mod:`dynamo_tpu.engine.service` — the async AsyncEngine facade served on a
+  runtime endpoint.
+
+The reference delegates all of this to vLLM/SGLang/TRT-LLM (SURVEY.md L4);
+here it is the framework's own execution layer, designed for XLA: static
+bucket shapes, donated cache buffers, one traced layer per model.
+"""
+
+from dynamo_tpu.engine.allocator import PageAllocator
+from dynamo_tpu.engine.core import EngineCore, EngineConfig
+
+__all__ = ["PageAllocator", "EngineCore", "EngineConfig"]

@@ -1,0 +1,348 @@
+"""Engine core: continuous-batching step loop.
+
+Each ``step()`` is either a *prefill* step (admit waiting requests, compute
+their prompts — minus any prefix-cache hit — in one batched forward) or a
+*decode* step (one token for every running sequence). Both phases run the
+same jitted program at different bucket shapes (see runner.py), so there is
+no separate prefill/decode code path on device.
+
+Scheduling policy (matching the behavior of the engines the reference wraps,
+vLLM-v0-style):
+
+- Admission: FIFO from the waiting queue under a prefill token budget and
+  page availability; prefix-cache matches reduce the budget charge.
+- Preemption: on page exhaustion during decode, the most-recently-arrived
+  running sequence is evicted (pages released, tokens kept) and requeued;
+  recomputation re-matches whatever prefix survived in cache.
+- Pages commit to the prefix cache as they fill, emitting KV stored events;
+  eviction emits removed events (allocator.py) — this feeds the KV-aware
+  router's global index natively, replacing the reference's
+  engine->ZMQ->NATS event bridge (SURVEY.md §3 call stack D).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from collections import deque
+from typing import Callable
+
+import numpy as np
+
+from dynamo_tpu.engine.allocator import OutOfPagesError, PageAllocator
+from dynamo_tpu.engine.runner import ModelRunner, StepBatch
+from dynamo_tpu.engine.sequence import SeqStatus, Sequence
+from dynamo_tpu.protocols.common import EngineOutput, FinishReason, PreprocessedRequest
+from dynamo_tpu.protocols.kv import ForwardPassMetrics, KvCacheEvent
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.tokens import DEFAULT_SALT
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    num_pages: int = 512
+    page_size: int = 16
+    max_batch_size: int = 64
+    max_prefill_tokens: int = 2048  # token budget per prefill step (chunked-prefill cap)
+    max_seq_len: int = 4096
+    eos_token_ids: tuple[int, ...] = ()
+    enable_prefix_caching: bool = True
+    salt: int = DEFAULT_SALT
+    worker_id: int = 0
+
+
+class EngineCore:
+    """Synchronous scheduler + executor. The async service layer drives it."""
+
+    def __init__(
+        self,
+        runner: ModelRunner,
+        config: EngineConfig,
+        *,
+        on_kv_event: Callable[[KvCacheEvent], None] | None = None,
+    ) -> None:
+        if runner.num_pages != config.num_pages or runner.page_size != config.page_size:
+            raise ValueError("runner and engine config disagree on cache geometry")
+        self.runner = runner
+        self.config = config
+        self.allocator = PageAllocator(config.num_pages, config.page_size, on_event=on_kv_event)
+        self.waiting: deque[Sequence] = deque()
+        self.running: list[Sequence] = []
+        self._next_seq_id = 0
+        self._eos = set(config.eos_token_ids)
+        self.num_preemptions = 0
+        # Cumulative counters for the metrics plane.
+        self._prompt_tokens_total = 0
+        self._generated_tokens_total = 0
+
+    # -- request intake ----------------------------------------------------
+
+    def add_request(self, request: PreprocessedRequest, context: Context | None = None) -> Sequence:
+        context = context or Context()
+        seq = Sequence.from_request(
+            self._next_seq_id, request, context,
+            page_size=self.config.page_size, salt=self.config.salt,
+        )
+        self._next_seq_id += 1
+        if not request.token_ids:
+            seq.status = SeqStatus.FINISHED
+            seq.finish_reason = FinishReason.ERROR
+            return seq
+        max_prompt = self.config.max_seq_len - 1
+        if len(request.token_ids) > max_prompt:
+            seq.status = SeqStatus.FINISHED
+            seq.finish_reason = FinishReason.LENGTH
+            return seq
+        self.waiting.append(seq)
+        return seq
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    # -- stepping ----------------------------------------------------------
+
+    def step(self) -> list[tuple[Sequence, EngineOutput]]:
+        """Advance the engine by one batched forward; returns per-seq deltas."""
+        cancelled = self._reap_cancelled()
+        prefill = self._schedule_prefill()
+        if prefill:
+            return cancelled + self._run_prefill(prefill)
+        if self.running:
+            return cancelled + self._run_decode()
+        return cancelled
+
+    def _reap_cancelled(self) -> list[tuple[Sequence, EngineOutput]]:
+        out: list[tuple[Sequence, EngineOutput]] = []
+        for q in (self.waiting, self.running):
+            for seq in list(q):
+                if seq.context.is_stopped and seq.status is not SeqStatus.FINISHED:
+                    self._finish(seq, FinishReason.CANCELLED)
+                    out.append(
+                        (
+                            seq,
+                            EngineOutput(
+                                token_ids=[],
+                                finish_reason=FinishReason.CANCELLED,
+                                cumulative_tokens=seq.num_generated,
+                                prompt_tokens=seq.num_prompt,
+                                cached_tokens=seq.num_cached_at_start,
+                            ),
+                        )
+                    )
+        return out
+
+    # -- prefill phase -----------------------------------------------------
+
+    def _schedule_prefill(self) -> list[Sequence]:
+        """Admit waiting sequences under the token budget + page availability.
+
+        A *resumed* (preempted) sequence already carries generated tokens; its
+        "prompt" for this prefill is everything generated so far — the forward
+        recomputes all uncached KV and the sampled token is the legitimate
+        next token of the continuation (no re-emission of old tokens).
+        """
+        batch: list[Sequence] = []
+        budget = self.config.max_prefill_tokens
+        while self.waiting and len(batch) + len(self.running) < self.config.max_batch_size:
+            seq = self.waiting[0]
+            total = len(seq.tokens)  # prompt + any generated-before-preemption
+            matched: list[int] = []
+            if self.config.enable_prefix_caching:
+                matched = self.allocator.match_prefix(seq.block_seq.block_hashes)
+                # Must compute at least the final token's logits.
+                while len(matched) * self.config.page_size > total - 1:
+                    self.allocator.release([matched.pop()])
+            cached_len = len(matched) * self.config.page_size
+            num_new = total - cached_len
+            if batch and num_new > budget:
+                self.allocator.release(matched)
+                break
+            pages_total = -(-total // self.config.page_size)
+            try:
+                new_pages = self.allocator.allocate(pages_total - len(matched))
+            except OutOfPagesError:
+                self.allocator.release(matched)
+                break
+            self.waiting.popleft()
+            seq.pages = matched + new_pages
+            seq.committed_pages = len(matched)
+            seq.num_cached = cached_len
+            if seq.status is not SeqStatus.PREEMPTED:
+                seq.num_cached_at_start = cached_len
+            seq.status = SeqStatus.RUNNING
+            budget -= num_new
+            batch.append(seq)
+            if budget <= 0:
+                break
+        return batch
+
+    def _run_prefill(self, batch: list[Sequence]) -> list[tuple[Sequence, EngineOutput]]:
+        ps = self.config.page_size
+        t = max(len(s.tokens) - s.num_cached for s in batch)
+        n = max(len(s.pages) for s in batch)
+        b = len(batch)
+        tokens = np.zeros((b, t), np.int32)
+        positions = np.zeros((b, t), np.int32)
+        block_tables = np.zeros((b, n), np.int32)
+        slots = np.zeros((b, t), np.int32)
+        last = np.zeros(b, np.int32)
+        for i, s in enumerate(batch):
+            new = s.tokens[s.num_cached :]
+            tokens[i, : len(new)] = new
+            pos = np.arange(s.num_cached, len(s.tokens), dtype=np.int32)
+            positions[i, : len(new)] = pos
+            block_tables[i, : len(s.pages)] = s.pages
+            page_arr = np.asarray(s.pages, dtype=np.int32)
+            slots[i, : len(new)] = page_arr[pos // ps] * ps + pos % ps
+            last[i] = len(new) - 1
+        next_tokens = self.runner.step(self._sampling_batch(batch, tokens, positions, block_tables, slots, last))
+        outputs: list[tuple[Sequence, EngineOutput]] = []
+        for i, s in enumerate(batch):
+            self._prompt_tokens_total += max(0, s.num_prompt - s.num_cached)
+            s.num_cached = len(s.tokens)
+            s.append_token(int(next_tokens[i]))
+            self._generated_tokens_total += 1
+            self._commit_filled_pages(s)
+            outputs.append(self._emit(s, int(next_tokens[i])))
+        self.running.extend(s for s in batch if not s.is_finished)
+        return outputs
+
+    # -- decode phase ------------------------------------------------------
+
+    def _run_decode(self) -> list[tuple[Sequence, EngineOutput]]:
+        ps = self.config.page_size
+        # Ensure every running sequence has a page for its next slot; preempt on OOM.
+        i = 0
+        while i < len(self.running):
+            seq = self.running[i]
+            need = seq.pages_needed(ps, 1)
+            if need:
+                try:
+                    seq.pages.extend(self.allocator.allocate(need))
+                except OutOfPagesError:
+                    victim = self.running[-1]
+                    if victim is seq and len(self.running) == 1:
+                        # Sole sequence can't fit: fail it (context outgrew the cache).
+                        self._finish(seq, FinishReason.ERROR)
+                        return [(seq, self._final_output(seq))]
+                    self._preempt(victim)
+                    continue  # retry same index (list shrank behind us)
+            i += 1
+        # Snapshot: _finish() inside _emit() mutates self.running mid-loop.
+        batch = list(self.running)
+        if not batch:
+            return []
+        b = len(batch)
+        n = max(len(s.pages) for s in batch)
+        tokens = np.zeros((b, 1), np.int32)
+        positions = np.zeros((b, 1), np.int32)
+        block_tables = np.zeros((b, n), np.int32)
+        slots = np.zeros((b, 1), np.int32)
+        last = np.zeros(b, np.int32)
+        for i, s in enumerate(batch):
+            tokens[i, 0] = s.tokens[s.num_cached]
+            positions[i, 0] = s.num_cached
+            block_tables[i, : len(s.pages)] = s.pages
+            slots[i, 0] = s.pages[s.num_cached // ps] * ps + s.num_cached % ps
+        next_tokens = self.runner.step(self._sampling_batch(batch, tokens, positions, block_tables, slots, last))
+        outputs = []
+        for i, s in enumerate(batch):
+            s.num_cached += 1
+            s.append_token(int(next_tokens[i]))
+            self._generated_tokens_total += 1
+            self._commit_filled_pages(s)
+            outputs.append(self._emit(s, int(next_tokens[i])))
+        return outputs
+
+    # -- shared helpers ----------------------------------------------------
+
+    def _sampling_batch(self, batch, tokens, positions, block_tables, slots, last) -> StepBatch:
+        b = len(batch)
+        temp = np.zeros(b, np.float32)
+        top_k = np.zeros(b, np.int32)
+        top_p = np.ones(b, np.float32)
+        seeds = np.zeros(b, np.uint32)
+        steps = np.zeros(b, np.int32)
+        for i, s in enumerate(batch):
+            sp = s.request.sampling
+            temp[i] = sp.temperature
+            top_k[i] = sp.top_k
+            top_p[i] = sp.top_p
+            seeds[i] = np.uint32((sp.seed if sp.seed is not None else s.seq_id * 0x9E3779B9 + 1) & 0xFFFFFFFF)
+            steps[i] = s.num_generated
+        return StepBatch(tokens, positions, block_tables, slots, last, temp, top_k, top_p, seeds, steps)
+
+    def _commit_filled_pages(self, seq: Sequence) -> None:
+        """Publish newly-filled pages to the prefix cache (emits stored events)."""
+        if not self.config.enable_prefix_caching:
+            return
+        full_pages = seq.num_cached // self.config.page_size
+        blocks = seq.block_seq.blocks
+        while seq.committed_pages < full_pages:
+            idx = seq.committed_pages
+            blk = blocks[idx]
+            self.allocator.commit(seq.pages[idx], blk.block_hash, blk.parent_hash, blk.tokens)
+            seq.committed_pages += 1
+
+    def _emit(self, seq: Sequence, token: int) -> tuple[Sequence, EngineOutput]:
+        reason = seq.check_stop(self._eos)
+        if reason is not None:
+            self._finish(seq, reason)
+        out = EngineOutput(
+            token_ids=[token],
+            finish_reason=seq.finish_reason,
+            cumulative_tokens=seq.num_generated,
+            prompt_tokens=seq.num_prompt if seq.finish_reason else None,
+            cached_tokens=seq.num_cached_at_start if seq.finish_reason else None,
+        )
+        return seq, out
+
+    def _final_output(self, seq: Sequence) -> EngineOutput:
+        return EngineOutput(
+            token_ids=[],
+            finish_reason=seq.finish_reason,
+            cumulative_tokens=seq.num_generated,
+            prompt_tokens=seq.num_prompt,
+            cached_tokens=seq.num_cached_at_start,
+        )
+
+    def _preempt(self, seq: Sequence) -> None:
+        logger.info("preempting seq %d (%d pages)", seq.seq_id, len(seq.pages))
+        self.num_preemptions += 1
+        self.allocator.release(seq.pages)
+        seq.pages = []
+        seq.committed_pages = 0
+        seq.num_cached = 0
+        seq.status = SeqStatus.PREEMPTED
+        self.running.remove(seq)
+        self.waiting.appendleft(seq)
+
+    def _finish(self, seq: Sequence, reason: FinishReason) -> None:
+        seq.status = SeqStatus.FINISHED
+        seq.finish_reason = reason
+        if seq.pages:
+            self.allocator.release(seq.pages)
+            seq.pages = []
+        if seq in self.running:
+            self.running.remove(seq)
+        if seq in self.waiting:
+            self.waiting.remove(seq)
+
+    # -- bookkeeping -------------------------------------------------------
+
+    def metrics(self) -> ForwardPassMetrics:
+        st = self.allocator.stats()
+        return ForwardPassMetrics(
+            worker_id=self.config.worker_id,
+            kv_active_blocks=st.active_pages,
+            kv_total_blocks=st.total_pages,
+            num_requests_waiting=len(self.waiting),
+            num_requests_running=len(self.running),
+            request_total_slots=self.config.max_batch_size,
+            cache_hit_rate=st.hit_rate,
+            prompt_tokens_total=self._prompt_tokens_total,
+            generated_tokens_total=self._generated_tokens_total,
+        )

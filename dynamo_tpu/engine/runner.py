@@ -1,0 +1,153 @@
+"""Bucketed jit execution of the paged forward + fused sampling.
+
+XLA traces/compiles once per distinct input shape; the runner keeps shapes
+drawn from a small bucket lattice (batch and prefill-length rounded up to
+powers of two, block-table width in page-count steps) so steady-state serving
+touches a handful of compiled programs. The KV cache buffers are donated each
+step, so cache writes are in-place in HBM; only the sampled token ids
+(i32[B]) come back to the host per step.
+
+The forward + sampling are one fused jitted program: logits never leave the
+device, avoiding a [B, vocab] device->host transfer per token.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models import llama
+from dynamo_tpu.ops.sampling import sample_tokens
+
+logger = logging.getLogger(__name__)
+
+
+def next_pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+@dataclasses.dataclass
+class StepBatch:
+    """Host-side arrays describing one engine step (pre-padding)."""
+
+    tokens: np.ndarray  # i32[B, T]
+    positions: np.ndarray  # i32[B, T]
+    block_tables: np.ndarray  # i32[B, N]
+    slot_mapping: np.ndarray  # i32[B, T]
+    last_token_index: np.ndarray  # i32[B]
+    temperature: np.ndarray  # f32[B]
+    top_k: np.ndarray  # i32[B]
+    top_p: np.ndarray  # f32[B]
+    seeds: np.ndarray  # u32[B]
+    sample_steps: np.ndarray  # i32[B] — rng fold counter (monotonic per request)
+
+    @property
+    def batch_size(self) -> int:
+        return self.tokens.shape[0]
+
+
+class ModelRunner:
+    """Owns device state (params + paged KV cache) and runs engine steps."""
+
+    def __init__(
+        self,
+        cfg: ModelConfig,
+        params: llama.Params,
+        *,
+        num_pages: int,
+        page_size: int,
+        max_batch_size: int = 64,
+        prefill_bucket: int = 64,
+        attn_impl: str | None = None,
+        forward_fn=None,
+        cache_dtype: jnp.dtype | None = None,
+    ) -> None:
+        self.cfg = cfg
+        self.params = params
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.max_batch_size = max_batch_size
+        self.prefill_bucket = prefill_bucket
+        self.attn_impl = attn_impl
+        self._forward = forward_fn or llama.forward
+        self.k_cache, self.v_cache = llama.init_kv_cache(cfg, num_pages, page_size, dtype=cache_dtype)
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def _step(params, k_cache, v_cache, tokens, positions, block_tables, slot_mapping,
+                  last_idx, temperature, top_k, top_p, seeds, sample_steps):
+            logits, k_cache, v_cache = self._forward(
+                params, self.cfg, tokens, positions, k_cache, v_cache,
+                block_tables, slot_mapping, last_idx, attn_impl=self.attn_impl,
+            )
+            keys = jax.vmap(lambda s, c: jax.random.fold_in(jax.random.PRNGKey(s), c))(seeds, sample_steps)
+            next_tokens = sample_tokens(logits, keys, temperature, top_k, top_p)
+            return next_tokens, k_cache, v_cache
+
+        self._step_fn = _step
+
+    # -- bucketing ---------------------------------------------------------
+
+    def _bucket_batch(self, b: int) -> int:
+        return min(next_pow2(b), max(self.max_batch_size, b))
+
+    def _bucket_time(self, t: int) -> int:
+        if t <= 1:
+            return 1
+        return min(next_pow2(t), max(self.prefill_bucket * ((t + self.prefill_bucket - 1) // self.prefill_bucket), t))
+
+    def _bucket_pages(self, n: int) -> int:
+        return max(1, next_pow2(n))
+
+    def _pad(self, batch: StepBatch) -> StepBatch:
+        b, t = batch.tokens.shape
+        bp = self._bucket_batch(b)
+        tp = self._bucket_time(t)
+        np_ = self._bucket_pages(batch.block_tables.shape[1])
+
+        def pad2(a, rows, cols, fill=0):
+            out = np.full((rows, cols), fill, a.dtype)
+            out[: a.shape[0], : a.shape[1]] = a
+            return out
+
+        def pad1(a, rows, fill=0):
+            out = np.full((rows,), fill, a.dtype)
+            out[: a.shape[0]] = a
+            return out
+
+        return StepBatch(
+            tokens=pad2(batch.tokens, bp, tp),
+            positions=pad2(batch.positions, bp, tp),
+            block_tables=pad2(batch.block_tables, bp, np_),
+            slot_mapping=pad2(batch.slot_mapping, bp, tp),
+            last_token_index=pad1(batch.last_token_index, bp),
+            temperature=pad1(batch.temperature, bp),
+            top_k=pad1(batch.top_k, bp),
+            top_p=pad1(batch.top_p, bp, fill=1.0),
+            seeds=pad1(batch.seeds, bp),
+            sample_steps=pad1(batch.sample_steps, bp),
+        )
+
+    # -- execution ---------------------------------------------------------
+
+    def step(self, batch: StepBatch) -> np.ndarray:
+        """Run one forward+sample step; returns sampled token ids i32[B_real]."""
+        b_real = batch.batch_size
+        padded = self._pad(batch)
+        next_tokens, self.k_cache, self.v_cache = self._step_fn(
+            self.params, self.k_cache, self.v_cache,
+            jnp.asarray(padded.tokens), jnp.asarray(padded.positions),
+            jnp.asarray(padded.block_tables), jnp.asarray(padded.slot_mapping),
+            jnp.asarray(padded.last_token_index), jnp.asarray(padded.temperature),
+            jnp.asarray(padded.top_k), jnp.asarray(padded.top_p),
+            jnp.asarray(padded.seeds), jnp.asarray(padded.sample_steps),
+        )
+        return np.asarray(next_tokens)[:b_real]
+
+    def cache_memory_bytes(self) -> int:
+        return int(self.k_cache.nbytes + self.v_cache.nbytes)

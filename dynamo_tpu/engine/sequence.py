@@ -1,0 +1,88 @@
+"""Per-request runtime state inside the engine.
+
+A sequence tracks its tokens (prompt + generated), how many of them have KV
+resident in the paged cache, its page list, and its hash-chained block
+identities (for prefix-cache commit + KV events). Preemption resets the
+cached count to zero while keeping tokens — recomputation then re-matches
+whatever prefix survives in cache.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+
+from dynamo_tpu.protocols.common import FinishReason, PreprocessedRequest
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.tokens import TokenBlockSequence
+
+
+class SeqStatus(Enum):
+    WAITING = "waiting"
+    RUNNING = "running"
+    PREEMPTED = "preempted"
+    FINISHED = "finished"
+
+
+@dataclass
+class Sequence:
+    seq_id: int
+    request: PreprocessedRequest
+    context: Context
+    block_seq: TokenBlockSequence  # hash-chained identity of self.tokens
+    tokens: list[int] = field(default_factory=list)  # prompt + generated
+    num_prompt: int = 0
+    num_cached: int = 0  # tokens whose KV is in the paged cache
+    num_cached_at_start: int = 0  # prefix-cache hits at admission (for usage stats)
+    pages: list[int] = field(default_factory=list)
+    committed_pages: int = 0  # pages already committed to the prefix cache
+    status: SeqStatus = SeqStatus.WAITING
+    finish_reason: FinishReason | None = None
+    arrival_time: float = field(default_factory=time.monotonic)
+    first_token_time: float | None = None
+
+    @classmethod
+    def from_request(cls, seq_id: int, request: PreprocessedRequest, context: Context, *, page_size: int, salt: int) -> "Sequence":
+        block_seq = TokenBlockSequence(request.token_ids, block_size=page_size, salt=salt)
+        return cls(
+            seq_id=seq_id,
+            request=request,
+            context=context,
+            block_seq=block_seq,
+            tokens=list(request.token_ids),
+            num_prompt=len(request.token_ids),
+        )
+
+    @property
+    def num_generated(self) -> int:
+        return len(self.tokens) - self.num_prompt
+
+    @property
+    def is_finished(self) -> bool:
+        return self.status is SeqStatus.FINISHED
+
+    def pages_needed(self, page_size: int, num_tokens_ahead: int = 1) -> int:
+        """Extra pages needed to hold KV for the next ``num_tokens_ahead`` tokens."""
+        target = self.num_cached + num_tokens_ahead
+        need = -(-target // page_size)  # ceil
+        return max(0, need - len(self.pages))
+
+    def append_token(self, token: int) -> None:
+        self.tokens.append(int(token))
+        self.block_seq.append(int(token))
+
+    def check_stop(self, eos_token_ids: set[int]) -> FinishReason | None:
+        """Evaluate token-level stop conditions after a newly appended token."""
+        stop = self.request.stop
+        if self.context.is_stopped:
+            return FinishReason.CANCELLED
+        last = self.tokens[-1]
+        if self.num_generated >= stop.min_tokens:
+            if not stop.ignore_eos and last in eos_token_ids:
+                return FinishReason.STOP
+            if last in stop.stop_token_ids:
+                return FinishReason.STOP
+        if self.num_generated >= stop.max_tokens:
+            return FinishReason.LENGTH
+        return None

@@ -1,0 +1,138 @@
+"""Async engine service: the AsyncEngine facade over the synchronous core.
+
+One background loop owns the EngineCore (single-writer — no locking):
+it drains the intake queue, runs engine steps in a worker thread (so the
+event loop keeps serving streams while XLA executes), and fans step outputs
+out to per-request asyncio queues.
+
+This is the stage that gets served on a runtime Endpoint
+(``runtime.Endpoint.serve``); with KV events and metrics wired to the
+runtime's event plane it is the full equivalent of one reference "worker"
+process (vLLM subprocess + publisher side-cars, SURVEY.md §3 call stacks B/D).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.engine.core import EngineCore
+from dynamo_tpu.engine.sequence import Sequence
+from dynamo_tpu.protocols.common import EngineOutput, PreprocessedRequest
+from dynamo_tpu.protocols.kv import ForwardPassMetrics
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+
+logger = logging.getLogger(__name__)
+
+_SENTINEL = object()
+
+
+class JaxEngineService(AsyncEngine[Any, dict]):
+    """Serves PreprocessedRequest (or its dict form) -> stream of EngineOutput dicts."""
+
+    def __init__(self, core: EngineCore) -> None:
+        self.core = core
+        self._intake: asyncio.Queue = asyncio.Queue()
+        self._streams: dict[int, asyncio.Queue] = {}
+        self._loop_task: asyncio.Task | None = None
+        self._wake = asyncio.Event()
+        self._closed = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> "JaxEngineService":
+        if self._loop_task is None:
+            self._loop_task = asyncio.create_task(self._engine_loop(), name="jax-engine-loop")
+        return self
+
+    async def close(self) -> None:
+        self._closed = True
+        self._wake.set()
+        if self._loop_task is not None:
+            self._loop_task.cancel()
+            try:
+                await self._loop_task
+            except asyncio.CancelledError:
+                pass
+            self._loop_task = None
+
+    # -- engine loop -------------------------------------------------------
+
+    async def _engine_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        while not self._closed:
+            # Drain intake without blocking.
+            admitted = False
+            while True:
+                try:
+                    request, context, out_q = self._intake.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+                seq = self.core.add_request(request, context)
+                self._streams[seq.seq_id] = out_q
+                if seq.is_finished:  # rejected at intake (too long / empty)
+                    out_q.put_nowait(
+                        EngineOutput(token_ids=[], finish_reason=seq.finish_reason, prompt_tokens=seq.num_prompt)
+                    )
+                    out_q.put_nowait(_SENTINEL)
+                    del self._streams[seq.seq_id]
+                admitted = True
+
+            if not self.core.has_work:
+                if not admitted:
+                    self._wake.clear()
+                    await self._wake.wait()
+                continue
+
+            # One engine step off-thread: the event loop stays responsive.
+            try:
+                outputs = await loop.run_in_executor(None, self.core.step)
+            except Exception:
+                logger.exception("engine step failed; failing all in-flight streams")
+                self._fail_all_streams()
+                continue
+            self._route(outputs)
+
+    def _fail_all_streams(self) -> None:
+        from dynamo_tpu.protocols.common import FinishReason
+
+        for q in self._streams.values():
+            q.put_nowait(EngineOutput(token_ids=[], finish_reason=FinishReason.ERROR))
+            q.put_nowait(_SENTINEL)
+        self._streams.clear()
+        # Engine state may be inconsistent after a failed step: drop all work.
+        for seq in list(self.core.running) + list(self.core.waiting):
+            seq.context.kill()
+        self.core.running.clear()
+        self.core.waiting.clear()
+
+    def _route(self, outputs: list[tuple[Sequence, EngineOutput]]) -> None:
+        for seq, out in outputs:
+            q = self._streams.get(seq.seq_id)
+            if q is None:
+                continue
+            q.put_nowait(out)
+            if out.finish_reason is not None:
+                q.put_nowait(_SENTINEL)
+                del self._streams[seq.seq_id]
+
+    # -- AsyncEngine -------------------------------------------------------
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
+        if isinstance(request, dict):
+            request = PreprocessedRequest.from_dict(request)
+        await self.start()
+        out_q: asyncio.Queue = asyncio.Queue()
+        await self._intake.put((request, context, out_q))
+        self._wake.set()
+        while True:
+            item = await out_q.get()
+            if item is _SENTINEL:
+                return
+            yield item.to_dict()
+
+    # -- introspection -----------------------------------------------------
+
+    def metrics(self) -> ForwardPassMetrics:
+        return self.core.metrics()

@@ -1,0 +1,19 @@
+"""First-party JAX model implementations.
+
+The reference wraps external engines per model family; here the model zoo is
+native: dense Llama-family decoders (Llama 3.x, DeepSeek-R1-Distill), with MoE
+(DeepSeek-style expert parallel) and multimodal (vision-encoder prefill)
+variants layered on the same paged-cache forward contract.
+
+The forward contract every model implements (see ``llama.py``):
+
+    forward(params, tokens, positions, k_cache, v_cache, block_tables,
+            slot_mapping, last_token_index) -> (logits, k_cache, v_cache)
+
+so the engine's scheduler/runner is model-agnostic.
+"""
+
+from dynamo_tpu.models.config import ModelConfig, PRESETS
+from dynamo_tpu.models import llama
+
+__all__ = ["ModelConfig", "PRESETS", "llama"]

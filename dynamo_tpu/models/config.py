@@ -1,0 +1,120 @@
+"""Model architecture configs + presets.
+
+``ModelConfig`` is the single architecture description consumed by model
+forwards, weight loaders, the engine's cache sizing, and the planner's memory
+model. Convertible from HF `config.json` (`from_hf`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import pathlib
+from typing import Any
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    name: str
+    vocab_size: int
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    intermediate_size: int
+    rope_theta: float = 500000.0
+    rope_scaling: dict | None = None
+    rms_eps: float = 1e-5
+    max_position: int = 131072
+    tie_embeddings: bool = False
+    dtype: str = "bfloat16"
+    # MoE fields (0 experts = dense).
+    num_experts: int = 0
+    num_experts_per_token: int = 0
+    moe_intermediate_size: int = 0
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+    def kv_bytes_per_token(self) -> int:
+        """Bytes of KV cache per token across all layers (2 = K and V)."""
+        itemsize = 2 if self.dtype == "bfloat16" else 4
+        return 2 * self.num_layers * self.kv_dim * itemsize
+
+    def param_count(self) -> int:
+        embed = self.vocab_size * self.hidden_size
+        attn = self.hidden_size * (self.q_dim + 2 * self.kv_dim) + self.q_dim * self.hidden_size
+        mlp = 3 * self.hidden_size * self.intermediate_size
+        if self.is_moe:
+            mlp = self.num_experts * 3 * self.hidden_size * self.moe_intermediate_size + self.hidden_size * self.num_experts
+        norms = 2 * self.hidden_size
+        head = 0 if self.tie_embeddings else embed
+        return embed + head + self.hidden_size + self.num_layers * (attn + mlp + norms)
+
+    @classmethod
+    def from_hf(cls, config: dict[str, Any] | str | pathlib.Path, *, name: str | None = None) -> "ModelConfig":
+        """Build from an HF ``config.json`` dict or path (Llama/Qwen-style keys)."""
+        if not isinstance(config, dict):
+            config = json.loads(pathlib.Path(config).read_text())
+        hidden = config["hidden_size"]
+        heads = config["num_attention_heads"]
+        return cls(
+            name=name or config.get("_name_or_path", config.get("model_type", "model")),
+            vocab_size=config["vocab_size"],
+            hidden_size=hidden,
+            num_layers=config["num_hidden_layers"],
+            num_heads=heads,
+            num_kv_heads=config.get("num_key_value_heads", heads),
+            head_dim=config.get("head_dim") or hidden // heads,
+            intermediate_size=config["intermediate_size"],
+            rope_theta=config.get("rope_theta", 10000.0),
+            rope_scaling=config.get("rope_scaling"),
+            rms_eps=config.get("rms_norm_eps", 1e-5),
+            max_position=config.get("max_position_embeddings", 8192),
+            tie_embeddings=config.get("tie_word_embeddings", False),
+        )
+
+
+# Presets for the tracked benchmark configs (BASELINE.md) plus tiny test models.
+PRESETS: dict[str, ModelConfig] = {
+    # Small enough for fast CPU unit tests, large enough to exercise GQA + paging.
+    "test-tiny": ModelConfig(
+        name="test-tiny", vocab_size=256, hidden_size=64, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=16, intermediate_size=128,
+        rope_theta=10000.0, max_position=512, tie_embeddings=True, dtype="float32",
+    ),
+    # MoE test model: 4 experts, top-2.
+    "test-tiny-moe": ModelConfig(
+        name="test-tiny-moe", vocab_size=256, hidden_size=64, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=16, intermediate_size=128,
+        rope_theta=10000.0, max_position=512, tie_embeddings=True, dtype="float32",
+        num_experts=4, num_experts_per_token=2, moe_intermediate_size=64,
+    ),
+    "llama-3.2-1b": ModelConfig(
+        name="llama-3.2-1b", vocab_size=128256, hidden_size=2048, num_layers=16,
+        num_heads=32, num_kv_heads=8, head_dim=64, intermediate_size=8192,
+        rope_theta=500000.0, tie_embeddings=True,
+        rope_scaling={"rope_type": "llama3", "factor": 32.0, "low_freq_factor": 1.0,
+                      "high_freq_factor": 4.0, "original_max_position_embeddings": 8192},
+    ),
+    "llama-3-8b": ModelConfig(
+        name="llama-3-8b", vocab_size=128256, hidden_size=4096, num_layers=32,
+        num_heads=32, num_kv_heads=8, head_dim=128, intermediate_size=14336,
+        rope_theta=500000.0, max_position=8192,
+    ),
+    "llama-3-70b": ModelConfig(
+        name="llama-3-70b", vocab_size=128256, hidden_size=8192, num_layers=80,
+        num_heads=64, num_kv_heads=8, head_dim=128, intermediate_size=28672,
+        rope_theta=500000.0, max_position=8192,
+    ),
+}

@@ -1,0 +1,176 @@
+"""Llama-family decoder (Llama 3.x, DeepSeek-R1-Distill-Llama) — pure-functional JAX.
+
+Design (TPU-first, not a torch translation):
+
+- Parameters are a pytree with **layers stacked on a leading axis** and the
+  forward pass is a ``lax.scan`` over layers. One layer gets traced/compiled
+  regardless of depth — compile time is O(1) in ``num_layers`` (matters at
+  70B/80-layer scale) and XLA schedules identical per-layer programs.
+- The KV cache is **paged** ([L, num_pages, page_size, n_kv, head_dim]) and
+  flows through the scan carry; each layer reads its slice and writes back via
+  dynamic index updates, which XLA aliases in place under buffer donation.
+- One forward function serves prefill (T>1) and decode (T=1); queries attend
+  to the paged cache, so chunked prefill and prefix reuse need no extra code
+  path (see ``dynamo_tpu/ops/attention.py``).
+- All matmuls are expressed so GSPMD can shard them from param/cache sharding
+  annotations alone (no explicit collectives here; see ``dynamo_tpu/parallel``).
+
+Replaces the model execution the reference delegates to vLLM/TRT-LLM
+(SURVEY.md §2 parallelism table: TP/PP "engine-internal" — first-party here).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.ops.attention import paged_attention, write_kv
+from dynamo_tpu.ops.norm import rms_norm
+from dynamo_tpu.ops.rope import apply_rope, rope_frequencies
+
+Params = dict
+
+
+def param_dtype(cfg: ModelConfig) -> jnp.dtype:
+    return jnp.dtype(cfg.dtype)
+
+
+def init_params(cfg: ModelConfig, rng: jax.Array | int = 0) -> Params:
+    """Random-init parameters (tests / benchmarks without checkpoint download)."""
+    if isinstance(rng, int):
+        rng = jax.random.PRNGKey(rng)
+    dt = param_dtype(cfg)
+    keys = jax.random.split(rng, 12)
+    d, q, kv, f, l = cfg.hidden_size, cfg.q_dim, cfg.kv_dim, cfg.intermediate_size, cfg.num_layers
+
+    def w(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) * (fan_in**-0.5)).astype(dt)
+
+    layers = {
+        "attn_norm": jnp.ones((l, d), dt),
+        "mlp_norm": jnp.ones((l, d), dt),
+        "wq": w(keys[0], (l, d, q), d),
+        "wk": w(keys[1], (l, d, kv), d),
+        "wv": w(keys[2], (l, d, kv), d),
+        "wo": w(keys[3], (l, q, d), q),
+    }
+    if cfg.is_moe:
+        e, mf = cfg.num_experts, cfg.moe_intermediate_size
+        layers.update(
+            {
+                "router": w(keys[4], (l, d, e), d),
+                "w_gate": w(keys[5], (l, e, d, mf), d),
+                "w_up": w(keys[6], (l, e, d, mf), d),
+                "w_down": w(keys[7], (l, e, mf, d), mf),
+            }
+        )
+    else:
+        layers.update(
+            {
+                "w_gate": w(keys[5], (l, d, f), d),
+                "w_up": w(keys[6], (l, d, f), d),
+                "w_down": w(keys[7], (l, f, d), f),
+            }
+        )
+    params: Params = {
+        "embed": w(keys[8], (cfg.vocab_size, d), d),
+        "norm_f": jnp.ones((d,), dt),
+        "layers": layers,
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = w(keys[9], (d, cfg.vocab_size), d)
+    return params
+
+
+def init_kv_cache(cfg: ModelConfig, num_pages: int, page_size: int, dtype: jnp.dtype | None = None):
+    """Allocate the paged KV cache: two [L, num_pages, page_size, n_kv, hd] arrays."""
+    dt = dtype or param_dtype(cfg)
+    shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim)
+    return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+
+def _mlp_dense(lp: Params, x: jnp.ndarray) -> jnp.ndarray:
+    gate = jax.nn.silu(x @ lp["w_gate"])
+    return (gate * (x @ lp["w_up"])) @ lp["w_down"]
+
+
+def _mlp_moe(lp: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+    """Top-k routed MoE, dense-compute formulation.
+
+    Every token runs every expert and results are mixed by routing weights.
+    Dense einsum keeps shapes static for XLA; for large expert counts the
+    expert-parallel path in ``dynamo_tpu/parallel/moe.py`` (all-to-all over
+    the ``ep`` mesh axis) replaces this with a capacity-based dispatch.
+    """
+    b, t, d = x.shape
+    xt = x.reshape(b * t, d)
+    router_logits = (xt @ lp["router"]).astype(jnp.float32)  # [N, E]
+    topv, topi = jax.lax.top_k(router_logits, cfg.num_experts_per_token)
+    weights = jax.nn.softmax(topv, axis=-1)  # [N, k]
+    mix = jnp.zeros_like(router_logits).at[jnp.arange(xt.shape[0])[:, None], topi].set(weights)  # [N, E]
+    gate = jax.nn.silu(jnp.einsum("nd,edf->nef", xt, lp["w_gate"]))
+    up = jnp.einsum("nd,edf->nef", xt, lp["w_up"])
+    expert_out = jnp.einsum("nef,efd->ned", gate * up, lp["w_down"])  # [N, E, d]
+    out = jnp.einsum("ned,ne->nd", expert_out.astype(jnp.float32), mix)
+    return out.reshape(b, t, d).astype(x.dtype)
+
+
+def forward(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # i32[B, T]
+    positions: jnp.ndarray,  # i32[B, T]
+    k_cache: jnp.ndarray,  # [L, num_pages, page_size, n_kv, hd]
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,  # i32[B, pages_per_seq]
+    slot_mapping: jnp.ndarray,  # i32[B, T]
+    last_token_index: jnp.ndarray,  # i32[B] index in [0,T) of each seq's last real token
+    *,
+    attn_impl: str | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One forward step. Returns (logits f32[B, vocab], k_cache, v_cache).
+
+    Works for prefill (T = padded prompt chunk) and decode (T=1) alike; the
+    engine runner donates the cache buffers so updates happen in place.
+    """
+    b, t = tokens.shape
+    inv_freq = jnp.asarray(rope_frequencies(cfg.head_dim, theta=cfg.rope_theta, scaling=cfg.rope_scaling))
+    x = params["embed"][tokens]  # [B, T, D]
+
+    def layer_step(carry, lp):
+        x, k_full, v_full, li = carry
+        k_cache_l = jax.lax.dynamic_index_in_dim(k_full, li, axis=0, keepdims=False)
+        v_cache_l = jax.lax.dynamic_index_in_dim(v_full, li, axis=0, keepdims=False)
+        h = rms_norm(x, lp["attn_norm"], eps=cfg.rms_eps)
+        q = (h @ lp["wq"]).reshape(b, t, cfg.num_heads, cfg.head_dim)
+        k = (h @ lp["wk"]).reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+        v = (h @ lp["wv"]).reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+        k_cache_l, v_cache_l = write_kv(k_cache_l, v_cache_l, k, v, slot_mapping)
+        attn = paged_attention(q, k_cache_l, v_cache_l, block_tables, positions, impl=attn_impl)
+        x = x + attn.reshape(b, t, cfg.q_dim) @ lp["wo"]
+        h2 = rms_norm(x, lp["mlp_norm"], eps=cfg.rms_eps)
+        mlp = _mlp_moe(lp, h2, cfg) if cfg.is_moe else _mlp_dense(lp, h2)
+        x = x + mlp
+        k_full = jax.lax.dynamic_update_index_in_dim(k_full, k_cache_l, li, axis=0)
+        v_full = jax.lax.dynamic_update_index_in_dim(v_full, v_cache_l, li, axis=0)
+        return (x, k_full, v_full, li + 1), None
+
+    # Scan over layers with the full paged cache in the carry: each step
+    # reads/writes its layer slice via dynamic indexing, which XLA performs
+    # in place when the runner donates the cache buffers. One layer's program
+    # is traced once — compile time is O(1) in depth.
+    (x, k_out, v_out, _), _ = jax.lax.scan(
+        layer_step,
+        (x, k_cache, v_cache, jnp.int32(0)),
+        params["layers"],
+    )
+
+    x = rms_norm(x, params["norm_f"], eps=cfg.rms_eps)
+    last = jnp.take_along_axis(x, last_token_index[:, None, None], axis=1)[:, 0]  # [B, D]
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (last.astype(jnp.float32)) @ head.astype(jnp.float32)  # [B, vocab]
+    return logits, k_out, v_out

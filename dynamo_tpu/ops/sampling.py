@@ -1,0 +1,65 @@
+"""Vectorized token sampling: greedy / temperature / top-k / top-p, batched.
+
+All requests in a decode batch are sampled in one fused device computation —
+per-request parameters arrive as arrays, and greedy requests are expressed as
+``temperature == 0``. Runs entirely on device; only the sampled token ids
+return to the host.
+
+Parity: the reference delegates sampling to the wrapped engine; sampling
+parameter schema follows its `PreprocessedRequest` sampling options
+(`lib/llm/src/protocols/common/mod.rs` SamplingOptions / StopConditions).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.ops.attention import NEG_INF
+
+
+def _mask_top_k(logits: jnp.ndarray, top_k: jnp.ndarray) -> jnp.ndarray:
+    """Keep the top-k logits per row (top_k <= 0 means disabled)."""
+    vocab = logits.shape[-1]
+    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]  # descending
+    k = jnp.where(top_k <= 0, vocab, top_k)
+    k = jnp.clip(k, 1, vocab)
+    # Threshold = k-th largest logit per row.
+    thresh = jnp.take_along_axis(sorted_logits, (k - 1)[:, None], axis=-1)
+    return jnp.where(logits >= thresh, logits, NEG_INF)
+
+
+def _mask_top_p(logits: jnp.ndarray, top_p: jnp.ndarray) -> jnp.ndarray:
+    """Nucleus filtering: keep the smallest set of tokens with cumulative
+    probability >= top_p (top_p >= 1 means disabled)."""
+    sort_idx = jnp.argsort(logits, axis=-1)[:, ::-1]
+    sorted_logits = jnp.take_along_axis(logits, sort_idx, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # Token i is kept if the cumulative mass *before* it is < top_p.
+    keep_sorted = (cum - probs) < top_p[:, None]
+    keep_sorted = keep_sorted.at[:, 0].set(True)  # always keep the argmax
+    masked_sorted = jnp.where(keep_sorted, sorted_logits, NEG_INF)
+    # Unsort back to vocab order.
+    inv_idx = jnp.argsort(sort_idx, axis=-1)
+    return jnp.take_along_axis(masked_sorted, inv_idx, axis=-1)
+
+
+def sample_tokens(
+    logits: jnp.ndarray,  # f32[B, vocab]
+    keys: jax.Array,  # PRNG keys [B] (one per row: per-request seed determinism)
+    temperature: jnp.ndarray,  # f32[B]; 0 => greedy
+    top_k: jnp.ndarray,  # i32[B]; <=0 => disabled
+    top_p: jnp.ndarray,  # f32[B]; >=1 => disabled
+) -> jnp.ndarray:
+    """Sample one token per row; returns i32[B]."""
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    safe_temp = jnp.where(temperature > 0, temperature, 1.0)
+    scaled = logits / safe_temp[:, None]
+    scaled = _mask_top_k(scaled, top_k)
+    scaled = _mask_top_p(scaled, top_p)
+    sampled = jax.vmap(lambda k, row: jax.random.categorical(k, row))(keys, scaled).astype(jnp.int32)
+
+    return jnp.where(temperature > 0, sampled, greedy)

@@ -1,0 +1,8 @@
+"""Wire and inter-stage protocol types.
+
+Mirrors the reference's `lib/llm/src/protocols` split: OpenAI-compatible HTTP
+schemas (:mod:`dynamo_tpu.protocols.openai`), the internal preprocessed
+request / engine output shapes every pipeline stage speaks
+(:mod:`dynamo_tpu.protocols.common`), and the KV event + worker metrics plane
+(:mod:`dynamo_tpu.protocols.kv`).
+"""

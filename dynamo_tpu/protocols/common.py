@@ -1,0 +1,158 @@
+"""Internal inter-stage protocol: preprocessed requests and engine outputs.
+
+Parity: reference `lib/llm/src/protocols/common/*` — `PreprocessedRequest`
+(token_ids + sampling + stop conditions, produced by the preprocessor and
+consumed by router/engine) and `BackendOutput`/`LLMEngineOutput` (token deltas
+flowing back). Everything is a plain dataclass serializable to/from dicts so
+it crosses the stream transport as msgpack/JSON without bespoke codecs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any
+
+
+class FinishReason(str, Enum):
+    STOP = "stop"  # stop condition (eos / stop token / stop string)
+    LENGTH = "length"  # max_tokens or context window reached
+    CANCELLED = "cancelled"  # client stopped/killed the request
+    ERROR = "error"
+
+
+@dataclass
+class SamplingOptions:
+    temperature: float = 0.0  # 0 => greedy
+    top_k: int = 0  # <=0 => disabled
+    top_p: float = 1.0  # >=1 => disabled
+    seed: int | None = None
+    frequency_penalty: float = 0.0
+    presence_penalty: float = 0.0
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "SamplingOptions":
+        return cls(**{k: v for k, v in d.items() if k in {f.name for f in dataclasses.fields(cls)}})
+
+
+@dataclass
+class StopConditions:
+    max_tokens: int = 512
+    stop_token_ids: list[int] = field(default_factory=list)
+    stop_strings: list[str] = field(default_factory=list)
+    ignore_eos: bool = False
+    min_tokens: int = 0
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "StopConditions":
+        return cls(**{k: v for k, v in d.items() if k in {f.name for f in dataclasses.fields(cls)}})
+
+
+@dataclass
+class PreprocessedRequest:
+    """Tokenized request: what the router schedules and the engine executes."""
+
+    token_ids: list[int]
+    sampling: SamplingOptions = field(default_factory=SamplingOptions)
+    stop: StopConditions = field(default_factory=StopConditions)
+    model: str | None = None
+    request_id: str | None = None
+    annotations: dict[str, Any] = field(default_factory=dict)
+    # Multimodal embeddings handle (filled by encode workers; see models/vision).
+    mm_inputs: dict[str, Any] | None = None
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "token_ids": list(self.token_ids),
+            "sampling": self.sampling.to_dict(),
+            "stop": self.stop.to_dict(),
+            "model": self.model,
+            "request_id": self.request_id,
+            "annotations": self.annotations,
+            "mm_inputs": self.mm_inputs,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "PreprocessedRequest":
+        return cls(
+            token_ids=list(d["token_ids"]),
+            sampling=SamplingOptions.from_dict(d.get("sampling", {})),
+            stop=StopConditions.from_dict(d.get("stop", {})),
+            model=d.get("model"),
+            request_id=d.get("request_id"),
+            annotations=d.get("annotations", {}) or {},
+            mm_inputs=d.get("mm_inputs"),
+        )
+
+
+@dataclass
+class BackendOutput:
+    """Detokenized delta leaving the backend (postprocessor) stage."""
+
+    text: str = ""
+    token_ids: list[int] = field(default_factory=list)
+    finish_reason: FinishReason | None = None
+    cumulative_tokens: int = 0
+    prompt_tokens: int | None = None
+    cached_tokens: int | None = None
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "text": self.text,
+            "token_ids": list(self.token_ids),
+            "finish_reason": self.finish_reason.value if self.finish_reason else None,
+            "cumulative_tokens": self.cumulative_tokens,
+            "prompt_tokens": self.prompt_tokens,
+            "cached_tokens": self.cached_tokens,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "BackendOutput":
+        fr = d.get("finish_reason")
+        return cls(
+            text=d.get("text", ""),
+            token_ids=list(d.get("token_ids", [])),
+            finish_reason=FinishReason(fr) if fr else None,
+            cumulative_tokens=d.get("cumulative_tokens", 0),
+            prompt_tokens=d.get("prompt_tokens"),
+            cached_tokens=d.get("cached_tokens"),
+        )
+
+
+@dataclass
+class EngineOutput:
+    """One streamed delta from the engine: newly generated token ids."""
+
+    token_ids: list[int]
+    finish_reason: FinishReason | None = None
+    cumulative_tokens: int = 0
+    # Usage metadata on the final delta.
+    prompt_tokens: int | None = None
+    cached_tokens: int | None = None
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "token_ids": list(self.token_ids),
+            "finish_reason": self.finish_reason.value if self.finish_reason else None,
+            "cumulative_tokens": self.cumulative_tokens,
+            "prompt_tokens": self.prompt_tokens,
+            "cached_tokens": self.cached_tokens,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "EngineOutput":
+        fr = d.get("finish_reason")
+        return cls(
+            token_ids=list(d.get("token_ids", [])),
+            finish_reason=FinishReason(fr) if fr else None,
+            cumulative_tokens=d.get("cumulative_tokens", 0),
+            prompt_tokens=d.get("prompt_tokens"),
+            cached_tokens=d.get("cached_tokens"),
+        )

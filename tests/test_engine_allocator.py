@@ -1,0 +1,106 @@
+"""Unit tests for the HBM page allocator (G1 tier): free list, prefix cache,
+refcounts, LRU eviction, and KV event emission."""
+
+import pytest
+
+from dynamo_tpu.engine.allocator import OutOfPagesError, PageAllocator
+from dynamo_tpu.protocols.kv import KvCacheEvent
+
+
+def collect_events():
+    events: list[KvCacheEvent] = []
+    return events, events.append
+
+
+def test_allocate_release_roundtrip():
+    alloc = PageAllocator(num_pages=8, page_size=4)
+    pages = alloc.allocate(7)
+    assert sorted(pages) == list(range(1, 8))  # page 0 reserved
+    with pytest.raises(OutOfPagesError):
+        alloc.allocate(1)
+    alloc.release(pages)
+    assert alloc.num_free() == 7
+
+
+def test_prefix_cache_hit_and_events():
+    events, cb = collect_events()
+    alloc = PageAllocator(num_pages=8, page_size=4, on_event=cb)
+    [p1] = alloc.allocate(1)
+    alloc.commit(p1, block_hash=111, parent_hash=None, token_ids=(1, 2, 3, 4))
+    assert len(events) == 1 and events[0].stored[0].block_hash == 111
+    alloc.release([p1])  # becomes evictable prefix cache
+
+    matched = alloc.match_prefix([111, 222])
+    assert matched == [p1]  # stops at first miss
+    st = alloc.stats()
+    assert st.hits == 1 and st.misses == 1
+    alloc.release(matched)
+
+
+def test_lru_eviction_emits_removed():
+    events, cb = collect_events()
+    alloc = PageAllocator(num_pages=4, page_size=4, on_event=cb)
+    pages = alloc.allocate(3)
+    for i, p in enumerate(pages):
+        alloc.commit(p, block_hash=100 + i, parent_hash=None)
+    alloc.release(pages)
+    # All 3 cached; allocating 2 must evict the 2 least recently used (100, 101).
+    alloc.allocate(2)
+    removed = [r.block_hash for e in events for r in e.removed]
+    assert removed == [100, 101]
+    # 102 still matchable.
+    assert len(alloc.match_prefix([102])) == 1
+
+
+def test_match_touches_lru_order():
+    alloc = PageAllocator(num_pages=4, page_size=4)
+    pages = alloc.allocate(3)
+    for i, p in enumerate(pages):
+        alloc.commit(p, block_hash=200 + i, parent_hash=None)
+    alloc.release(pages)
+    # Touch 200: it becomes MRU; eviction must take 201 first.
+    m = alloc.match_prefix([200])
+    alloc.release(m)
+    alloc.allocate(1)
+    assert alloc.match_prefix([201]) == []  # evicted
+    assert len(alloc.match_prefix([200])) == 1  # survived
+
+
+def test_duplicate_commit_not_cached_twice():
+    alloc = PageAllocator(num_pages=8, page_size=4)
+    [a, b] = alloc.allocate(2)
+    alloc.commit(a, block_hash=7, parent_hash=None)
+    alloc.commit(b, block_hash=7, parent_hash=None)  # concurrent duplicate
+    alloc.release([a, b])
+    # Only one page holds hash 7; the duplicate went back to the free list.
+    assert alloc.stats().cached_pages == 1
+    assert alloc.stats().free_pages == 6
+
+
+def test_shared_page_refcounting():
+    alloc = PageAllocator(num_pages=8, page_size=4)
+    [p] = alloc.allocate(1)
+    alloc.commit(p, block_hash=5, parent_hash=None)
+    alloc.release([p])
+    m1 = alloc.match_prefix([5])
+    m2 = alloc.match_prefix([5])
+    assert m1 == m2 == [p]
+    alloc.release(m1)
+    # Still referenced by m2: not evictable.
+    assert alloc.stats().cached_pages == 0 and alloc.stats().active_pages == 1
+    alloc.release(m2)
+    assert alloc.stats().cached_pages == 1
+
+
+def test_clear_cache():
+    events, cb = collect_events()
+    alloc = PageAllocator(num_pages=8, page_size=4, on_event=cb)
+    pages = alloc.allocate(3)
+    for i, p in enumerate(pages):
+        alloc.commit(p, block_hash=300 + i, parent_hash=None)
+    alloc.release(pages)
+    n = alloc.clear_cache()
+    assert n == 3
+    assert alloc.num_free() == 7
+    removed = [r.block_hash for e in events for r in e.removed]
+    assert sorted(removed) == [300, 301, 302]
