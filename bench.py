@@ -1,0 +1,103 @@
+"""Benchmark: single-chip serving throughput (output tokens/sec) on the real TPU.
+
+Runs the engine core directly (no HTTP) on Llama-3.2-1B-class weights
+(random-init — no network egress) with a continuous-batching workload:
+BATCH concurrent requests, ISL/OSL scaled from the reference recipe
+(`benchmarks/llm/perf.sh`: ISL 3000 / OSL 150).
+
+Prints exactly one JSON line:
+  {"metric": "output_tokens_per_sec_per_chip", "value": N, "unit": "tok/s", "vs_baseline": R}
+
+``vs_baseline`` is measured/target where the target is the north-star
+proxy scaled to this config: vLLM-H100 class single-chip decode throughput
+on a 1B model. The reference publishes no absolute numbers
+(BASELINE.json.published == {}), so the target constant below is the
+commonly-cited ~8000 tok/s aggregate decode throughput for 1B-class models
+on one accelerator at moderate batch — a deliberately hard bar.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+# Run on the real chip: do NOT force a platform here.
+PRESET = os.environ.get("BENCH_PRESET", "llama-3.2-1b")
+BATCH = int(os.environ.get("BENCH_BATCH", "32"))
+ISL = int(os.environ.get("BENCH_ISL", "512"))
+OSL = int(os.environ.get("BENCH_OSL", "128"))
+TARGET_TOKS = float(os.environ.get("BENCH_TARGET", "8000"))
+
+
+def main() -> None:
+    from dynamo_tpu.engine.core import EngineConfig, EngineCore
+    from dynamo_tpu.engine.runner import ModelRunner
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import PRESETS
+    from dynamo_tpu.protocols.common import PreprocessedRequest, SamplingOptions, StopConditions
+
+    cfg = PRESETS[PRESET]
+    page_size = 16
+    pages_per_seq = (ISL + OSL) // page_size + 2
+    num_pages = BATCH * pages_per_seq + 8
+
+    params = llama.init_params(cfg, 0)
+    runner = ModelRunner(
+        cfg, params, num_pages=num_pages, page_size=page_size,
+        max_batch_size=BATCH, prefill_bucket=max(ISL, 64),
+    )
+    core = EngineCore(
+        runner,
+        EngineConfig(
+            num_pages=num_pages, page_size=page_size, max_batch_size=BATCH,
+            max_prefill_tokens=ISL * 4, max_seq_len=ISL + OSL + 8,
+            enable_prefix_caching=False,  # uniform-random prompts: measure raw decode
+        ),
+    )
+
+    rng = np.random.default_rng(0)
+    for i in range(BATCH):
+        prompt = rng.integers(1, cfg.vocab_size - 1, size=ISL).tolist()
+        core.add_request(
+            PreprocessedRequest(
+                token_ids=prompt,
+                sampling=SamplingOptions(temperature=0.0),
+                stop=StopConditions(max_tokens=OSL, ignore_eos=True),
+            )
+        )
+
+    # Warmup: run prefills + a few decode steps so compile time is excluded.
+    warmup_tokens = 0
+    while core.waiting:
+        warmup_tokens += len(core.step())
+    for _ in range(3):
+        warmup_tokens += len(core.step())
+
+    start = time.perf_counter()
+    generated = 0
+    while core.has_work:
+        outputs = core.step()
+        generated += sum(len(o.token_ids) for _, o in outputs)
+    elapsed = time.perf_counter() - start
+
+    tok_per_sec = generated / elapsed if elapsed > 0 else 0.0
+    print(
+        json.dumps(
+            {
+                "metric": "output_tokens_per_sec_per_chip",
+                "value": round(tok_per_sec, 2),
+                "unit": "tok/s",
+                "vs_baseline": round(tok_per_sec / TARGET_TOKS, 4),
+                "detail": {
+                    "preset": PRESET, "batch": BATCH, "isl": ISL, "osl": OSL,
+                    "decode_tokens": generated, "seconds": round(elapsed, 3),
+                    "backend": __import__("jax").default_backend(),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""Multi-tier KV block management: G1 (HBM) -> G2 (host RAM) -> G3 (disk).
+
+The engine's PageAllocator (dynamo_tpu.engine.allocator) is the G1 tier.
+This package adds the capacity tiers behind it:
+
+- :mod:`dynamo_tpu.blocks.storage` — payload backends: host memory, disk
+  (one file per block), and a Null backend for CI (metadata only).
+- :mod:`dynamo_tpu.blocks.tier` — a capacity-bounded, LRU-evicting pool of
+  completed blocks keyed by sequence hash.
+- :mod:`dynamo_tpu.blocks.manager` — the KvBlockManager: write-through
+  offload of committed G1 pages into G2 (cascading to G3 on G2 eviction),
+  and onboarding — extending a prefill's prefix match by copying blocks
+  back into freshly-allocated HBM pages.
+
+Parity: reference block manager (SURVEY.md §2 rows 27-29) — CacheLevel
+G1/G2/G3 pools (`block_manager.rs:69-82`), OffloadManager (`offload.rs:80`),
+storage backends (`storage.rs:104-433`). TPU mapping: NIXL RDMA is replaced
+by device<->host copies of page slices (`jax.device_get` / donated scatter),
+and G4 (remote) arrives with disaggregation (KV migration over the runtime's
+stream transport).
+"""
+
+from dynamo_tpu.blocks.manager import KvBlockManager, BlockManagerConfig
+from dynamo_tpu.blocks.tier import TierPool
+from dynamo_tpu.blocks.storage import HostStorage, DiskStorage, NullStorage
+
+__all__ = [
+    "KvBlockManager",
+    "BlockManagerConfig",
+    "TierPool",
+    "HostStorage",
+    "DiskStorage",
+    "NullStorage",
+]

@@ -1,0 +1,123 @@
+"""KvBlockManager: ties the capacity tiers to the engine's G1 pages.
+
+Flow (parity: reference OffloadManager `offload.rs:80` + onboarding):
+
+- **Offload (write-through):** when a G1 page fills and commits to the
+  prefix cache, its payload is copied device->host into G2. A G2 eviction
+  cascades the payload into G3 (disk). G1 eviction then never loses data
+  that was worth keeping.
+- **Onboard:** at admission, after the G1 prefix match stops, the manager is
+  asked for the *next* blocks in the chain; hits are copied back into
+  freshly-allocated G1 pages, extending the cached prefix and shrinking
+  prefill compute.
+
+Device access is through two callables injected by the engine runner
+(``read_page(page_id) -> (k, v)``, ``write_page(page_id, k, v)``), keeping
+this module free of JAX so tier logic unit-tests run instantly.
+"""
+
+from __future__ import annotations
+
+import logging
+import pathlib
+from dataclasses import dataclass
+from typing import Callable
+
+import numpy as np
+
+from dynamo_tpu.blocks.storage import DiskStorage, HostStorage, NullStorage, Payload
+from dynamo_tpu.blocks.tier import TierPool
+
+logger = logging.getLogger(__name__)
+
+ReadPage = Callable[[int], Payload]
+WritePage = Callable[[int, np.ndarray, np.ndarray], None]
+
+
+@dataclass
+class BlockManagerConfig:
+    g2_capacity_blocks: int = 1024
+    g3_capacity_blocks: int = 0  # 0 disables the disk tier
+    g3_path: str | pathlib.Path = "/tmp/dynamo_tpu_g3"
+    onboard_limit: int = 64  # max blocks copied back per admission
+    null_storage: bool = False  # CI: capacity logic without payload memory
+
+
+class KvBlockManager:
+    def __init__(self, config: BlockManagerConfig, *, read_page: ReadPage, write_page: WritePage) -> None:
+        self.config = config
+        self._read_page = read_page
+        self._write_page = write_page
+
+        self.g3: TierPool | None = None
+        if config.g3_capacity_blocks > 0:
+            g3_storage = NullStorage() if config.null_storage else DiskStorage(config.g3_path)
+            self.g3 = TierPool("g3", g3_storage, config.g3_capacity_blocks)
+
+        def cascade(block_hash: int, payload: Payload | None) -> None:
+            if self.g3 is not None and payload is not None:
+                self.g3.put(block_hash, payload)
+
+        g2_storage = NullStorage() if config.null_storage else HostStorage()
+        self.g2 = TierPool("g2", g2_storage, config.g2_capacity_blocks, on_evict=cascade)
+        self.offloaded = 0
+        self.onboarded = 0
+
+    # -- offload path ------------------------------------------------------
+
+    def offload(self, block_hash: int, page_id: int) -> None:
+        """Write-through one committed G1 page into G2 (no-op if present)."""
+        if block_hash in self.g2:
+            return
+        if self.g3 is not None and block_hash in self.g3:
+            return
+        payload = self._read_page(page_id)
+        self.g2.put(block_hash, payload)
+        self.offloaded += 1
+
+    # -- onboard path ------------------------------------------------------
+
+    def lookup(self, block_hash: int) -> Payload | None:
+        """G2 first, then G3 (promoting a G3 hit back into G2)."""
+        payload = self.g2.get(block_hash)
+        if payload is not None:
+            return payload
+        if self.g3 is not None:
+            payload = self.g3.get(block_hash)
+            if payload is not None:
+                self.g2.put(block_hash, payload)
+                return payload
+        return None
+
+    def extend_prefix(self, block_hashes: list[int], start: int) -> list[Payload]:
+        """Consecutive payloads for block_hashes[start:], stopping at the
+        first miss (chain order) or the onboard limit."""
+        out: list[Payload] = []
+        for h in block_hashes[start:]:
+            if len(out) >= self.config.onboard_limit:
+                break
+            payload = self.lookup(h)
+            if payload is None:
+                break
+            out.append(payload)
+        return out
+
+    def onboard(self, page_ids: list[int], payloads: list[Payload]) -> None:
+        """Copy payloads host->device into the given (freshly-allocated) pages."""
+        for pid, (k, v) in zip(page_ids, payloads):
+            self._write_page(pid, k, v)
+        self.onboarded += len(payloads)
+
+    # -- admin -------------------------------------------------------------
+
+    def clear(self) -> int:
+        n = self.g2.clear()
+        if self.g3 is not None:
+            n += self.g3.clear()
+        return n
+
+    def stats(self) -> dict:
+        out = {"g2": self.g2.stats().__dict__, "offloaded": self.offloaded, "onboarded": self.onboarded}
+        if self.g3 is not None:
+            out["g3"] = self.g3.stats().__dict__
+        return out

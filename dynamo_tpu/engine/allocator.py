@@ -32,6 +32,7 @@ class OutOfPagesError(RuntimeError):
 class _PageInfo:
     refcount: int = 0
     block_hash: int | None = None  # set once the page's block is complete
+    parent_hash: int | None = None
     is_cache_holder: bool = False  # this page backs the prefix-cache entry for its hash
 
 
@@ -141,21 +142,25 @@ class PageAllocator:
 
     # -- completion / release ---------------------------------------------
 
-    def commit(self, page_id: int, block_hash: int, parent_hash: int | None, token_ids: Sequence[int] = ()) -> None:
+    def commit(self, page_id: int, block_hash: int, parent_hash: int | None, token_ids: Sequence[int] = ()) -> bool:
         """Mark a page's block complete and publish it to the prefix cache.
 
-        If the hash is already cached (another sequence computed the same
-        block concurrently), this page stays un-cached — a duplicate that
-        simply frees on release.
+        Returns True if this page became the cache holder for its hash. If
+        the hash is already cached (another sequence computed the same block
+        concurrently), this page stays un-cached — a duplicate that simply
+        frees on release.
         """
         info = self._pages[page_id]
         if info.block_hash is not None:
-            return  # already committed
+            return False  # already committed
         info.block_hash = block_hash
+        info.parent_hash = parent_hash
         if block_hash not in self._cached:
             self._cached[block_hash] = page_id
             info.is_cache_holder = True
             self._emit(KvCacheEvent(stored=[BlockStored(block_hash, parent_hash, tuple(token_ids))]))
+            return True
+        return False
 
     def release(self, page_ids: Sequence[int]) -> None:
         """Drop one reference from each page; refcount-0 pages become evictable
@@ -172,6 +177,32 @@ class PageAllocator:
                 else:
                     del self._pages[pid]
                     self._free.append(pid)
+
+    def cache_snapshot(self) -> KvCacheEvent:
+        """All currently-known completed blocks, parents before children.
+
+        Used to (re)announce this worker's cache to a fresh event subscriber
+        (router reconnect / late join).
+        """
+        blocks = {
+            info.block_hash: info.parent_hash
+            for info in self._pages.values()
+            if info.block_hash is not None and info.is_cache_holder
+        }
+        stored: list[BlockStored] = []
+        emitted: set[int] = set()
+        pending = dict(blocks)
+        while pending:
+            progress = False
+            for h, parent in list(pending.items()):
+                if parent is None or parent in emitted or parent not in blocks:
+                    stored.append(BlockStored(h, parent))
+                    emitted.add(h)
+                    del pending[h]
+                    progress = True
+            if not progress:  # pragma: no cover - cycles are impossible by construction
+                break
+        return KvCacheEvent(stored=stored)
 
     def clear_cache(self) -> int:
         """Drop all evictable prefix-cache pages (the clear-kv-blocks admin op).

@@ -62,11 +62,13 @@ class EngineCore:
         config: EngineConfig,
         *,
         on_kv_event: Callable[[KvCacheEvent], None] | None = None,
+        block_manager=None,  # dynamo_tpu.blocks.KvBlockManager (G2/G3 tiers)
     ) -> None:
         if runner.num_pages != config.num_pages or runner.page_size != config.page_size:
             raise ValueError("runner and engine config disagree on cache geometry")
         self.runner = runner
         self.config = config
+        self.block_manager = block_manager
         self.allocator = PageAllocator(config.num_pages, config.page_size, on_event=on_kv_event)
         self.waiting: deque[Sequence] = deque()
         self.running: list[Sequence] = []
@@ -150,12 +152,20 @@ class EngineCore:
             seq = self.waiting[0]
             total = len(seq.tokens)  # prompt + any generated-before-preemption
             matched: list[int] = []
+            onboard: list = []  # payloads from G2/G3 to copy into fresh pages
             if self.config.enable_prefix_caching:
-                matched = self.allocator.match_prefix(seq.block_seq.block_hashes)
+                hashes = seq.block_seq.block_hashes
+                matched = self.allocator.match_prefix(hashes)
+                if self.block_manager is not None:
+                    # Extend the G1 match from the capacity tiers (onboarding).
+                    onboard = self.block_manager.extend_prefix(hashes, len(matched))
                 # Must compute at least the final token's logits.
-                while len(matched) * self.config.page_size > total - 1:
-                    self.allocator.release([matched.pop()])
-            cached_len = len(matched) * self.config.page_size
+                while (len(matched) + len(onboard)) * self.config.page_size > total - 1:
+                    if onboard:
+                        onboard.pop()
+                    else:
+                        self.allocator.release([matched.pop()])
+            cached_len = (len(matched) + len(onboard)) * self.config.page_size
             num_new = total - cached_len
             if batch and num_new > budget:
                 self.allocator.release(matched)
@@ -167,8 +177,17 @@ class EngineCore:
                 self.allocator.release(matched)
                 break
             self.waiting.popleft()
+            if onboard:
+                # Copy tier payloads into the first onboarded pages and commit
+                # them: they re-enter the G1 prefix cache and re-announce on
+                # the KV event plane.
+                self.block_manager.onboard(new_pages[: len(onboard)], onboard)
+                blocks = seq.block_seq.blocks
+                for i, pid in enumerate(new_pages[: len(onboard)]):
+                    blk = blocks[len(matched) + i]
+                    self.allocator.commit(pid, blk.block_hash, blk.parent_hash, blk.tokens)
             seq.pages = matched + new_pages
-            seq.committed_pages = len(matched)
+            seq.committed_pages = len(matched) + len(onboard)
             seq.num_cached = cached_len
             if seq.status is not SeqStatus.PREEMPTED:
                 seq.num_cached_at_start = cached_len
@@ -276,7 +295,8 @@ class EngineCore:
         return StepBatch(tokens, positions, block_tables, slots, last, temp, top_k, top_p, seeds, steps)
 
     def _commit_filled_pages(self, seq: Sequence) -> None:
-        """Publish newly-filled pages to the prefix cache (emits stored events)."""
+        """Publish newly-filled pages to the prefix cache (emits stored events)
+        and write them through to the capacity tiers."""
         if not self.config.enable_prefix_caching:
             return
         full_pages = seq.num_cached // self.config.page_size
@@ -284,11 +304,13 @@ class EngineCore:
         while seq.committed_pages < full_pages:
             idx = seq.committed_pages
             blk = blocks[idx]
-            self.allocator.commit(seq.pages[idx], blk.block_hash, blk.parent_hash, blk.tokens)
+            newly_cached = self.allocator.commit(seq.pages[idx], blk.block_hash, blk.parent_hash, blk.tokens)
+            if newly_cached and self.block_manager is not None:
+                self.block_manager.offload(blk.block_hash, seq.pages[idx])
             seq.committed_pages += 1
 
     def _emit(self, seq: Sequence, token: int) -> tuple[Sequence, EngineOutput]:
-        reason = seq.check_stop(self._eos)
+        reason = seq.check_stop(self._eos, self.config.max_seq_len)
         if reason is not None:
             self._finish(seq, reason)
         out = EngineOutput(

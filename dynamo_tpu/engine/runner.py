@@ -67,16 +67,27 @@ class ModelRunner:
         attn_impl: str | None = None,
         forward_fn=None,
         cache_dtype: jnp.dtype | None = None,
+        mesh=None,  # jax.sharding.Mesh for TP/DP execution (see dynamo_tpu.parallel)
     ) -> None:
         self.cfg = cfg
-        self.params = params
         self.num_pages = num_pages
         self.page_size = page_size
         self.max_batch_size = max_batch_size
         self.prefill_bucket = prefill_bucket
         self.attn_impl = attn_impl
+        self.mesh = mesh
         self._forward = forward_fn or llama.forward
         self.k_cache, self.v_cache = llama.init_kv_cache(cfg, num_pages, page_size, dtype=cache_dtype)
+        self._dp = 1
+        if mesh is not None:
+            from dynamo_tpu.parallel.sharding import cache_shardings, shard_params
+
+            params = shard_params(params, mesh)
+            cs = cache_shardings(mesh)
+            self.k_cache = jax.device_put(self.k_cache, cs)
+            self.v_cache = jax.device_put(self.v_cache, cs)
+            self._dp = int(mesh.shape["dp"])
+        self.params = params
 
         @functools.partial(jax.jit, donate_argnums=(1, 2))
         def _step(params, k_cache, v_cache, tokens, positions, block_tables, slot_mapping,
@@ -91,10 +102,36 @@ class ModelRunner:
 
         self._step_fn = _step
 
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def _write_page(k_cache, v_cache, k, v, pid):
+            return (
+                k_cache.at[:, pid].set(k.astype(k_cache.dtype)),
+                v_cache.at[:, pid].set(v.astype(v_cache.dtype)),
+            )
+
+        self._write_page_fn = _write_page
+
+    # -- tier access (block manager offload/onboard) -----------------------
+
+    def read_page(self, page_id: int) -> tuple[np.ndarray, np.ndarray]:
+        """Device->host copy of one page: ([L, ps, kv, hd], [L, ps, kv, hd])."""
+        return (
+            np.asarray(self.k_cache[:, page_id]),
+            np.asarray(self.v_cache[:, page_id]),
+        )
+
+    def write_page(self, page_id: int, k: np.ndarray, v: np.ndarray) -> None:
+        """Host->device copy into one page (in place via buffer donation)."""
+        self.k_cache, self.v_cache = self._write_page_fn(
+            self.k_cache, self.v_cache, jnp.asarray(k), jnp.asarray(v), page_id
+        )
+
     # -- bucketing ---------------------------------------------------------
 
     def _bucket_batch(self, b: int) -> int:
-        return min(next_pow2(b), max(self.max_batch_size, b))
+        bucket = min(next_pow2(b), max(self.max_batch_size, b))
+        # Batch is dp-sharded: round up to a multiple of the dp axis size.
+        return -(-bucket // self._dp) * self._dp
 
     def _bucket_time(self, t: int) -> int:
         if t <= 1:
@@ -139,13 +176,20 @@ class ModelRunner:
         """Run one forward+sample step; returns sampled token ids i32[B_real]."""
         b_real = batch.batch_size
         padded = self._pad(batch)
+        if self.mesh is not None:
+            from dynamo_tpu.parallel.sharding import batch_sharding
+
+            def put(a):
+                return jax.device_put(a, batch_sharding(self.mesh, a.ndim))
+        else:
+            put = jnp.asarray
         next_tokens, self.k_cache, self.v_cache = self._step_fn(
             self.params, self.k_cache, self.v_cache,
-            jnp.asarray(padded.tokens), jnp.asarray(padded.positions),
-            jnp.asarray(padded.block_tables), jnp.asarray(padded.slot_mapping),
-            jnp.asarray(padded.last_token_index), jnp.asarray(padded.temperature),
-            jnp.asarray(padded.top_k), jnp.asarray(padded.top_p),
-            jnp.asarray(padded.seeds), jnp.asarray(padded.sample_steps),
+            put(padded.tokens), put(padded.positions),
+            put(padded.block_tables), put(padded.slot_mapping),
+            put(padded.last_token_index), put(padded.temperature),
+            put(padded.top_k), put(padded.top_p),
+            put(padded.seeds), put(padded.sample_steps),
         )
         return np.asarray(next_tokens)[:b_real]
 

@@ -72,7 +72,7 @@ class Sequence:
         self.tokens.append(int(token))
         self.block_seq.append(int(token))
 
-    def check_stop(self, eos_token_ids: set[int]) -> FinishReason | None:
+    def check_stop(self, eos_token_ids: set[int], max_seq_len: int) -> FinishReason | None:
         """Evaluate token-level stop conditions after a newly appended token."""
         stop = self.request.stop
         if self.context.is_stopped:
@@ -85,4 +85,6 @@ class Sequence:
                 return FinishReason.STOP
         if self.num_generated >= stop.max_tokens:
             return FinishReason.LENGTH
+        if len(self.tokens) >= max_seq_len:
+            return FinishReason.LENGTH  # context window reached
         return None

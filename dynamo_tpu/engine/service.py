@@ -33,6 +33,7 @@ class JaxEngineService(AsyncEngine[Any, dict]):
 
     def __init__(self, core: EngineCore) -> None:
         self.core = core
+        self.aux: list = []  # companion tasks (metrics publisher, ...) closed with us
         self._intake: asyncio.Queue = asyncio.Queue()
         self._streams: dict[int, asyncio.Queue] = {}
         self._loop_task: asyncio.Task | None = None
@@ -49,6 +50,9 @@ class JaxEngineService(AsyncEngine[Any, dict]):
     async def close(self) -> None:
         self._closed = True
         self._wake.set()
+        for a in self.aux:
+            await a.close()
+        self.aux = []
         if self._loop_task is not None:
             self._loop_task.cancel()
             try:

@@ -1,0 +1,19 @@
+"""OpenAI-compatible HTTP frontend.
+
+- :mod:`dynamo_tpu.frontend.openai_format` — chat/completions response +
+  SSE chunk construction and stream aggregation.
+- :mod:`dynamo_tpu.frontend.model_manager` — per-model engine registry and
+  the discovery watcher that builds serving pipelines as workers appear.
+- :mod:`dynamo_tpu.frontend.metrics` — Prometheus request metrics
+  (count/duration/TTFT/ITL/inflight, token histograms).
+- :mod:`dynamo_tpu.frontend.http` — the aiohttp service:
+  /v1/chat/completions, /v1/completions, /v1/models, /health, /live,
+  /metrics, /clear_kv_blocks.
+
+Parity: reference `lib/llm/src/http/service/*` (axum) + ModelManager/
+ModelWatcher (`discovery/watcher.rs`), SURVEY.md §2 rows 17-18.
+"""
+
+from dynamo_tpu.frontend.http import HttpService
+
+__all__ = ["HttpService"]

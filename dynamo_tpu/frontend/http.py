@@ -1,0 +1,217 @@
+"""The OpenAI-compatible aiohttp service.
+
+Routes (parity: reference `http/service/openai.rs`, `health.rs`,
+`metrics.rs`, `clear_kv_blocks.rs`):
+
+- POST /v1/chat/completions — streaming (SSE) and aggregated
+- POST /v1/completions
+- GET  /v1/models
+- GET  /health, /live
+- GET  /metrics — Prometheus text
+- POST /clear_kv_blocks — admin: drop prefix caches on all workers
+
+Client disconnects cancel generation: the per-request Context is killed when
+the response write fails or the request is torn down, and that propagates
+through the pipeline to the engine scheduler.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, AsyncIterator, Awaitable, Callable
+
+from aiohttp import web
+
+from dynamo_tpu.frontend.metrics import FrontendMetrics
+from dynamo_tpu.frontend.model_manager import ModelManager
+from dynamo_tpu.frontend.openai_format import (
+    SSE_DONE,
+    ChatStream,
+    CompletionStream,
+    aggregate_chat,
+    aggregate_completion,
+    sse_encode,
+)
+from dynamo_tpu.protocols.common import BackendOutput
+from dynamo_tpu.runtime.engine import Context
+
+logger = logging.getLogger(__name__)
+
+
+def _error(status: int, message: str, etype: str = "invalid_request_error") -> web.Response:
+    return web.json_response({"error": {"message": message, "type": etype}}, status=status)
+
+
+class HttpService:
+    def __init__(
+        self,
+        manager: ModelManager,
+        *,
+        metrics: FrontendMetrics | None = None,
+        clear_kv_hook: Callable[[], Awaitable[int]] | None = None,
+    ) -> None:
+        self.manager = manager
+        self.metrics = metrics or FrontendMetrics()
+        self.clear_kv_hook = clear_kv_hook
+        self._runner: web.AppRunner | None = None
+        self.app = web.Application()
+        self.app.add_routes(
+            [
+                web.post("/v1/chat/completions", self.chat_completions),
+                web.post("/v1/completions", self.completions),
+                web.get("/v1/models", self.list_models),
+                web.get("/health", self.health),
+                web.get("/live", self.live),
+                web.get("/metrics", self.prometheus),
+                web.post("/clear_kv_blocks", self.clear_kv_blocks),
+            ]
+        )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self, host: str = "0.0.0.0", port: int = 8080) -> int:
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        actual = self._runner.addresses[0][1] if self._runner.addresses else port
+        logger.info("HTTP frontend listening on %s:%d", host, actual)
+        return actual
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    # -- OpenAI endpoints --------------------------------------------------
+
+    async def chat_completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._serve_openai(request, kind="chat")
+
+    async def completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._serve_openai(request, kind="completions")
+
+    async def _serve_openai(self, request: web.Request, *, kind: str) -> web.StreamResponse:
+        try:
+            body = await request.json()
+        except Exception:
+            return _error(400, "invalid JSON body")
+        model = body.get("model")
+        if not isinstance(model, str):
+            return _error(400, "missing 'model'")
+        if kind == "chat" and not isinstance(body.get("messages"), list):
+            return _error(400, "missing 'messages'")
+        if kind == "completions" and "prompt" not in body:
+            return _error(400, "missing 'prompt'")
+        entry = self.manager.get(model)
+        if (
+            entry is None
+            or (kind == "chat" and not entry.card.supports_chat)
+            or (kind == "completions" and not entry.card.supports_completions)
+        ):
+            return _error(404, f"model '{model}' not found", "model_not_found")
+        stream_mode = bool(body.get("stream", False))
+        # OpenAI default: usage only when explicitly requested via stream_options.
+        send_usage = bool((body.get("stream_options") or {}).get("include_usage", False))
+        ctx = Context(request_id=body.get("request_id"))
+
+        with self.metrics.tracker(model, kind) as tracker:
+            try:
+                backend_stream = self._backend_stream(entry.pipeline, body, ctx, tracker)
+                if stream_mode:
+                    return await self._stream_response(request, model, kind, ctx, backend_stream, send_usage)
+                if kind == "chat":
+                    payload = await aggregate_chat(model, backend_stream)
+                else:
+                    payload = await aggregate_completion(model, backend_stream)
+                return web.json_response(payload)
+            except asyncio.CancelledError:
+                ctx.kill()
+                raise
+            except ValueError as exc:  # request-shape errors from the preprocessor
+                tracker.status = "invalid"
+                ctx.kill()
+                return _error(400, str(exc))
+            except Exception:
+                logger.exception("request failed (model=%s)", model)
+                ctx.kill()
+                return _error(500, "internal error", "internal_error")
+
+    async def _backend_stream(self, pipeline, body, ctx: Context, tracker) -> AsyncIterator[BackendOutput]:
+        async for item in pipeline.generate(body, ctx):
+            out = item if isinstance(item, BackendOutput) else BackendOutput.from_dict(item)
+            tracker.on_token()
+            if out.finish_reason is not None:
+                tracker.on_usage(out.prompt_tokens, out.cumulative_tokens, out.cached_tokens)
+            yield out
+
+    async def _stream_response(
+        self, request: web.Request, model: str, kind: str, ctx: Context,
+        backend_stream: AsyncIterator[BackendOutput], send_usage: bool,
+    ) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            }
+        )
+        await resp.prepare(request)
+        fmt = ChatStream(model, send_usage=send_usage) if kind == "chat" else CompletionStream(model, send_usage=send_usage)
+        try:
+            if kind == "chat":
+                await resp.write(sse_encode(fmt.first()))
+            async for out in backend_stream:
+                await resp.write(sse_encode(fmt.delta(out)))
+            await resp.write(SSE_DONE)
+        except (ConnectionResetError, asyncio.CancelledError):
+            logger.info("client disconnected; cancelling %s", ctx.id)
+            ctx.kill()
+            raise
+        except Exception:
+            # Headers are already on the wire: a JSON 500 is impossible. End
+            # the SSE stream with an error event instead of a silent cut.
+            logger.exception("stream failed mid-flight (model=%s)", model)
+            ctx.kill()
+            try:
+                await resp.write(sse_encode({"error": {"message": "internal error", "type": "internal_error"}}))
+                await resp.write(SSE_DONE)
+            except (ConnectionResetError, OSError):
+                pass
+        finally:
+            aclose = getattr(backend_stream, "aclose", None)
+            if aclose:
+                await aclose()
+        await resp.write_eof()
+        return resp
+
+    # -- service endpoints -------------------------------------------------
+
+    async def list_models(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {
+                "object": "list",
+                "data": [
+                    {"id": c.name, "object": "model", "created": 0, "owned_by": "dynamo-tpu"}
+                    for c in self.manager.cards()
+                ],
+            }
+        )
+
+    async def health(self, request: web.Request) -> web.Response:
+        models = self.manager.names()
+        status = "healthy" if models else "no_models"
+        return web.json_response({"status": status, "models": models})
+
+    async def live(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "live"})
+
+    async def prometheus(self, request: web.Request) -> web.Response:
+        return web.Response(body=self.metrics.render(), content_type="text/plain")
+
+    async def clear_kv_blocks(self, request: web.Request) -> web.Response:
+        if self.clear_kv_hook is None:
+            return web.json_response({"cleared": 0, "detail": "no workers wired"}, status=200)
+        cleared = await self.clear_kv_hook()
+        return web.json_response({"cleared": cleared})

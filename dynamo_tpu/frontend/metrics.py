@@ -1,0 +1,93 @@
+"""Frontend Prometheus metrics.
+
+Three levels, mirroring the reference (`http/service/metrics.rs:28-110`):
+per-request counters/durations, streaming quality (TTFT / inter-token
+latency), and size histograms (input/output sequence length). Exposed in
+Prometheus text format at GET /metrics.
+"""
+
+from __future__ import annotations
+
+import time
+
+from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram, generate_latest
+
+_DURATION_BUCKETS = (0.005, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+_TTFT_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.2, 0.3, 0.5, 0.75, 1.0, 2.0, 5.0, 10.0)
+_ITL_BUCKETS = (0.001, 0.005, 0.01, 0.02, 0.04, 0.06, 0.1, 0.2, 0.5, 1.0)
+_LEN_BUCKETS = (16, 64, 256, 1024, 3000, 8192, 32768, 131072)
+
+
+class FrontendMetrics:
+    def __init__(self, registry: CollectorRegistry | None = None) -> None:
+        self.registry = registry or CollectorRegistry()
+        ns = "dynamo_frontend"
+        self.requests = Counter(
+            f"{ns}_requests_total", "Requests received", ["model", "endpoint", "status"], registry=self.registry
+        )
+        self.inflight = Gauge(f"{ns}_inflight_requests", "Requests in flight", ["model"], registry=self.registry)
+        self.duration = Histogram(
+            f"{ns}_request_duration_seconds", "Request duration", ["model"],
+            buckets=_DURATION_BUCKETS, registry=self.registry,
+        )
+        self.ttft = Histogram(
+            f"{ns}_time_to_first_token_seconds", "TTFT", ["model"], buckets=_TTFT_BUCKETS, registry=self.registry
+        )
+        self.itl = Histogram(
+            f"{ns}_inter_token_latency_seconds", "ITL", ["model"], buckets=_ITL_BUCKETS, registry=self.registry
+        )
+        self.input_len = Histogram(
+            f"{ns}_input_sequence_tokens", "Prompt tokens", ["model"], buckets=_LEN_BUCKETS, registry=self.registry
+        )
+        self.output_len = Histogram(
+            f"{ns}_output_sequence_tokens", "Generated tokens", ["model"], buckets=_LEN_BUCKETS, registry=self.registry
+        )
+        self.cached_tokens = Counter(
+            f"{ns}_cached_prompt_tokens_total", "Prompt tokens served from prefix cache", ["model"],
+            registry=self.registry,
+        )
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)
+
+    def tracker(self, model: str, endpoint: str) -> "RequestTracker":
+        return RequestTracker(self, model, endpoint)
+
+
+class RequestTracker:
+    """Per-request context manager: times the request + token stream gaps."""
+
+    def __init__(self, metrics: FrontendMetrics, model: str, endpoint: str) -> None:
+        self.m = metrics
+        self.model = model
+        self.endpoint = endpoint
+        self._start = 0.0
+        self._last_token: float | None = None
+        self.status = "success"
+
+    def __enter__(self) -> "RequestTracker":
+        self._start = time.monotonic()
+        self.m.inflight.labels(self.model).inc()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is not None:
+            self.status = "error"
+        self.m.inflight.labels(self.model).dec()
+        self.m.requests.labels(self.model, self.endpoint, self.status).inc()
+        self.m.duration.labels(self.model).observe(time.monotonic() - self._start)
+
+    def on_token(self) -> None:
+        now = time.monotonic()
+        if self._last_token is None:
+            self.m.ttft.labels(self.model).observe(now - self._start)
+        else:
+            self.m.itl.labels(self.model).observe(now - self._last_token)
+        self._last_token = now
+
+    def on_usage(self, prompt_tokens: int | None, output_tokens: int, cached_tokens: int | None) -> None:
+        if prompt_tokens:
+            self.m.input_len.labels(self.model).observe(prompt_tokens)
+        self.m.output_len.labels(self.model).observe(output_tokens)
+        if cached_tokens:
+            self.m.cached_tokens.labels(self.model).inc(cached_tokens)

@@ -1,0 +1,158 @@
+"""OpenAI response construction: SSE chunks + non-streaming aggregation.
+
+Parity: reference `protocols/openai/chat_completions/delta.rs` (delta
+generator) and `protocols/openai/*/aggregator.rs` (stream -> full response),
+plus the SSE codec (`protocols/codec.rs`).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.protocols.common import BackendOutput, FinishReason
+
+_FINISH_MAP = {
+    FinishReason.STOP: "stop",
+    FinishReason.LENGTH: "length",
+    FinishReason.CANCELLED: "stop",
+    FinishReason.ERROR: "error",
+}
+
+
+def _finish_str(reason: FinishReason | None) -> str | None:
+    return _FINISH_MAP.get(reason) if reason else None
+
+
+def new_request_id(kind: str) -> str:
+    return f"{kind}-{uuid.uuid4().hex}"
+
+
+def _usage(prompt_tokens: int | None, completion_tokens: int, cached_tokens: int | None) -> dict[str, Any]:
+    usage: dict[str, Any] = {
+        "prompt_tokens": prompt_tokens or 0,
+        "completion_tokens": completion_tokens,
+        "total_tokens": (prompt_tokens or 0) + completion_tokens,
+    }
+    if cached_tokens:
+        usage["prompt_tokens_details"] = {"cached_tokens": cached_tokens}
+    return usage
+
+
+class ChatStream:
+    """Builds chat.completion.chunk objects from BackendOutput deltas."""
+
+    def __init__(self, model: str, *, request_id: str | None = None, send_usage: bool = False) -> None:
+        self.id = request_id or new_request_id("chatcmpl")
+        self.model = model
+        self.created = int(time.time())
+        self.send_usage = send_usage
+
+    def _chunk(self, delta: dict[str, Any], finish: str | None = None, usage: dict | None = None) -> dict[str, Any]:
+        out = {
+            "id": self.id,
+            "object": "chat.completion.chunk",
+            "created": self.created,
+            "model": self.model,
+            "choices": [{"index": 0, "delta": delta, "finish_reason": finish}],
+        }
+        if usage is not None:
+            out["usage"] = usage
+        return out
+
+    def first(self) -> dict[str, Any]:
+        return self._chunk({"role": "assistant", "content": ""})
+
+    def delta(self, out: BackendOutput) -> dict[str, Any]:
+        usage = None
+        if out.finish_reason is not None and self.send_usage:
+            usage = _usage(out.prompt_tokens, out.cumulative_tokens, out.cached_tokens)
+        return self._chunk(
+            {"content": out.text} if out.text else {},
+            finish=_finish_str(out.finish_reason),
+            usage=usage,
+        )
+
+
+class CompletionStream:
+    """Builds text_completion chunks from BackendOutput deltas."""
+
+    def __init__(self, model: str, *, request_id: str | None = None, send_usage: bool = False) -> None:
+        self.id = request_id or new_request_id("cmpl")
+        self.model = model
+        self.created = int(time.time())
+        self.send_usage = send_usage
+
+    def delta(self, out: BackendOutput) -> dict[str, Any]:
+        chunk: dict[str, Any] = {
+            "id": self.id,
+            "object": "text_completion",
+            "created": self.created,
+            "model": self.model,
+            "choices": [
+                {"index": 0, "text": out.text, "finish_reason": _finish_str(out.finish_reason), "logprobs": None}
+            ],
+        }
+        if out.finish_reason is not None and self.send_usage:
+            chunk["usage"] = _usage(out.prompt_tokens, out.cumulative_tokens, out.cached_tokens)
+        return chunk
+
+
+async def aggregate_chat(model: str, stream: AsyncIterator[BackendOutput]) -> dict[str, Any]:
+    """Drain a backend stream into a full chat.completion response."""
+    text_parts: list[str] = []
+    finish: FinishReason | None = None
+    prompt_tokens = cached = None
+    completion_tokens = 0
+    async for out in stream:
+        text_parts.append(out.text)
+        completion_tokens = max(completion_tokens, out.cumulative_tokens)
+        if out.finish_reason is not None:
+            finish = out.finish_reason
+            prompt_tokens, cached = out.prompt_tokens, out.cached_tokens
+    return {
+        "id": new_request_id("chatcmpl"),
+        "object": "chat.completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [
+            {
+                "index": 0,
+                "message": {"role": "assistant", "content": "".join(text_parts)},
+                "finish_reason": _finish_str(finish) or "stop",
+            }
+        ],
+        "usage": _usage(prompt_tokens, completion_tokens, cached),
+    }
+
+
+async def aggregate_completion(model: str, stream: AsyncIterator[BackendOutput]) -> dict[str, Any]:
+    text_parts: list[str] = []
+    finish: FinishReason | None = None
+    prompt_tokens = cached = None
+    completion_tokens = 0
+    async for out in stream:
+        text_parts.append(out.text)
+        completion_tokens = max(completion_tokens, out.cumulative_tokens)
+        if out.finish_reason is not None:
+            finish = out.finish_reason
+            prompt_tokens, cached = out.prompt_tokens, out.cached_tokens
+    return {
+        "id": new_request_id("cmpl"),
+        "object": "text_completion",
+        "created": int(time.time()),
+        "model": model,
+        "choices": [
+            {"index": 0, "text": "".join(text_parts), "finish_reason": _finish_str(finish) or "stop", "logprobs": None}
+        ],
+        "usage": _usage(prompt_tokens, completion_tokens, cached),
+    }
+
+
+def sse_encode(obj: dict[str, Any]) -> bytes:
+    return b"data: " + json.dumps(obj, separators=(",", ":")).encode() + b"\n\n"
+
+
+SSE_DONE = b"data: [DONE]\n\n"

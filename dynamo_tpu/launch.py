@@ -1,0 +1,238 @@
+"""Launcher: the `dynamo-run` equivalent (reference SURVEY.md §2 row 37).
+
+Wires the pieces into runnable topologies:
+
+- ``serve_worker``     — build a JAX engine for a model and serve it on a
+  runtime endpoint; publish the ModelDeploymentCard (lease-bound) so
+  frontends discover it.
+- ``serve_frontend``   — ModelManager + ModelWatcher + OpenAI HttpService.
+- ``run_local``        — both in one process over the in-memory runtime
+  (the `dynamo-run in=http out=<engine>` single-node path).
+- CLI: ``python -m dynamo_tpu.launch --model test-tiny --http-port 8080``
+  with ``--store tcp://...`` to join a multi-process deployment.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+from dataclasses import dataclass, field
+from typing import Any
+
+from dynamo_tpu.engine.core import EngineConfig, EngineCore
+from dynamo_tpu.engine.runner import ModelRunner
+from dynamo_tpu.engine.service import JaxEngineService
+from dynamo_tpu.frontend.http import HttpService
+from dynamo_tpu.frontend.metrics import FrontendMetrics
+from dynamo_tpu.frontend.model_manager import ModelManager, ModelWatcher
+from dynamo_tpu.model_card import ModelDeploymentCard
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import PRESETS, ModelConfig
+from dynamo_tpu.protocols.kv import KvCacheEvent
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.tokenizer import load_tokenizer
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class WorkerSpec:
+    """Everything needed to bring up one engine worker."""
+
+    model_config: ModelConfig
+    card: ModelDeploymentCard
+    engine_config: EngineConfig = field(default_factory=EngineConfig)
+    params: Any = None  # model params pytree; random-init if None
+    attn_impl: str | None = None
+    block_manager_config: Any = None  # blocks.BlockManagerConfig enables G2/G3 tiers
+
+    @classmethod
+    def from_preset(cls, preset: str, *, card: ModelDeploymentCard | None = None, **engine_kw: Any) -> "WorkerSpec":
+        mc = PRESETS[preset]
+        tokenizer = "byte"
+        card = card or ModelDeploymentCard(
+            name=preset,
+            tokenizer=tokenizer,
+            context_length=min(mc.max_position, 4096),
+            eos_token_ids=sorted(load_tokenizer(tokenizer).eos_token_ids),
+        )
+        ecfg = EngineConfig(
+            max_seq_len=card.context_length,
+            eos_token_ids=tuple(card.eos_token_ids),
+            page_size=card.kv_page_size,
+            **engine_kw,
+        )
+        return cls(model_config=mc, card=card, engine_config=ecfg)
+
+
+async def build_engine_service(spec: WorkerSpec, *, on_kv_event=None) -> JaxEngineService:
+    def _build() -> ModelRunner:
+        # Device work (param init, cache allocation) can take seconds on a
+        # remote/real chip — keep it off the event loop so lease keep-alives
+        # and health endpoints stay live.
+        params = spec.params if spec.params is not None else llama.init_params(spec.model_config, 0)
+        return ModelRunner(
+            spec.model_config,
+            params,
+            num_pages=spec.engine_config.num_pages,
+            page_size=spec.engine_config.page_size,
+            max_batch_size=spec.engine_config.max_batch_size,
+            attn_impl=spec.attn_impl,
+        )
+
+    runner = await asyncio.get_running_loop().run_in_executor(None, _build)
+    block_manager = None
+    if spec.block_manager_config is not None:
+        from dynamo_tpu.blocks import KvBlockManager
+
+        block_manager = KvBlockManager(
+            spec.block_manager_config, read_page=runner.read_page, write_page=runner.write_page
+        )
+    core = EngineCore(runner, spec.engine_config, on_kv_event=on_kv_event, block_manager=block_manager)
+    return await JaxEngineService(core).start()
+
+
+async def serve_worker(
+    runtime: DistributedRuntime,
+    spec: WorkerSpec,
+    *,
+    lease=None,
+) -> JaxEngineService:
+    """Serve the engine + KV event stream + metrics and publish the model card."""
+    from dynamo_tpu.router.events import KV_EVENTS_ENDPOINT, KvEventBroadcaster
+    from dynamo_tpu.router.metrics import WorkerMetricsPublisher
+
+    broadcaster = KvEventBroadcaster()
+    broadcaster.bind_loop(asyncio.get_running_loop())
+    service = await build_engine_service(spec, on_kv_event=broadcaster.publish)
+    broadcaster.bind_snapshot(service.core.allocator.cache_snapshot)
+    ns, comp, ep = spec.card.endpoint
+    component = runtime.namespace(ns).component(comp)
+    instance = await component.endpoint(ep).serve(service, metadata={"model": spec.card.name}, lease=lease)
+    await component.endpoint(KV_EVENTS_ENDPOINT).serve(broadcaster, metadata={"model": spec.card.name}, lease=lease)
+    service.core.config.worker_id = instance.lease_id  # same object as spec.engine_config
+
+    def snapshot():
+        m = service.metrics()
+        m.worker_id = instance.lease_id
+        return m
+
+    publisher = await WorkerMetricsPublisher(
+        runtime, ns, comp, instance.lease_id, snapshot, interval=0.5, lease=lease
+    ).start()
+    service.aux = [publisher]  # closed with the service by callers that track it
+    card_lease = lease or await runtime.primary_lease()
+    await runtime.store.put(
+        spec.card.instance_key(instance.lease_id), spec.card.to_bytes(), lease_id=card_lease.id
+    )
+    logger.info("worker serving %s as instance %x", spec.card.name, instance.lease_id)
+    return service
+
+
+async def serve_frontend(
+    runtime: DistributedRuntime,
+    *,
+    host: str = "0.0.0.0",
+    port: int = 8080,
+    router_factory=None,
+    clear_kv_hook=None,
+) -> tuple[HttpService, ModelWatcher, int]:
+    manager = ModelManager()
+    watcher = await ModelWatcher(runtime, manager, router_factory=router_factory).start()
+    service = HttpService(manager, metrics=FrontendMetrics(), clear_kv_hook=clear_kv_hook)
+    actual_port = await service.start(host, port)
+    return service, watcher, actual_port
+
+
+async def run_local(
+    preset: str = "test-tiny",
+    *,
+    host: str = "127.0.0.1",
+    port: int = 8080,
+    num_workers: int = 1,
+    router_mode: str = "round_robin",
+    **engine_kw: Any,
+) -> dict[str, Any]:
+    """Single-process serving: N engine workers + frontend on one runtime."""
+    runtime = DistributedRuntime.detached()
+    services = []
+    g2_blocks = engine_kw.pop("g2_blocks", 0)
+    g3_blocks = engine_kw.pop("g3_blocks", 0)
+    for i in range(num_workers):
+        spec = WorkerSpec.from_preset(preset, **engine_kw)
+        spec.card.router_mode = router_mode
+        if g2_blocks or g3_blocks:
+            from dynamo_tpu.blocks import BlockManagerConfig
+
+            spec.block_manager_config = BlockManagerConfig(
+                g2_capacity_blocks=g2_blocks,
+                g3_capacity_blocks=g3_blocks,
+                g3_path=f"/tmp/dynamo_tpu_g3_w{i}",
+            )
+        # Each worker needs its own lease/instance: secondary leases per worker.
+        lease = await runtime.secondary_lease() if num_workers > 1 else None
+        service = await serve_worker(runtime, spec, lease=lease)
+        services.append(service)
+
+    async def clear_all() -> int:
+        return sum(s.core.allocator.clear_cache() for s in services)
+
+    http, watcher, actual_port = await serve_frontend(
+        runtime, host=host, port=port, clear_kv_hook=clear_all
+    )
+    return {
+        "runtime": runtime,
+        "services": services,
+        "http": http,
+        "watcher": watcher,
+        "port": actual_port,
+    }
+
+
+async def _amain(args: argparse.Namespace) -> None:
+    handles = await run_local(
+        args.model,
+        host=args.host,
+        port=args.http_port,
+        num_workers=args.workers,
+        router_mode=args.router_mode,
+        num_pages=args.num_pages,
+        max_batch_size=args.max_batch_size,
+        g2_blocks=args.g2_blocks,
+        g3_blocks=args.g3_blocks,
+    )
+    logger.info("serving %s on port %d", args.model, handles["port"])
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await handles["http"].stop()
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(description="dynamo-tpu launcher")
+    parser.add_argument("--model", default="test-tiny", help="model preset name")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--http-port", type=int, default=8080)
+    parser.add_argument("--workers", type=int, default=1)
+    parser.add_argument("--num-pages", type=int, default=512)
+    parser.add_argument("--max-batch-size", type=int, default=64)
+    parser.add_argument("--router-mode", default="round_robin", choices=["round_robin", "random", "kv"])
+    parser.add_argument("--g2-blocks", type=int, default=0, help="host-RAM KV tier capacity (blocks); 0 disables")
+    parser.add_argument("--g3-blocks", type=int, default=0, help="disk KV tier capacity (blocks); 0 disables")
+    parser.add_argument(
+        "--platform", default=None,
+        help="force a jax platform (e.g. 'cpu'); needed because hardware "
+             "plugins may override the JAX_PLATFORMS env var",
+    )
+    args = parser.parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    asyncio.run(_amain(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+"""Parallelism: device meshes, GSPMD shardings, sequence parallelism.
+
+The reference delegates intra-model parallelism to wrapped engines (NCCL
+TP/PP/EP inside vLLM/SGLang — SURVEY.md §2 parallelism table). Here it is
+first-class and XLA-native: annotate parameter/cache shardings over a named
+mesh and let GSPMD insert the collectives over ICI.
+
+- :mod:`dynamo_tpu.parallel.mesh` — mesh axes (``dp``, ``tp``, ``sp``, ``ep``)
+  and topology helpers.
+- :mod:`dynamo_tpu.parallel.sharding` — sharding rules for model params,
+  paged KV cache, and activations (megatron-style TP: attention heads and
+  MLP hidden sharded on ``tp``; experts on ``ep``; batch on ``dp``).
+- :mod:`dynamo_tpu.parallel.ring` — ring attention over the ``sp`` axis for
+  long-context prefill (shard_map + ppermute), absent from the reference
+  (SURVEY.md §5) but first-class here.
+"""
+
+from dynamo_tpu.parallel.mesh import MeshPlan, make_mesh
+from dynamo_tpu.parallel.sharding import shard_params, cache_shardings, param_shardings
+
+__all__ = ["MeshPlan", "make_mesh", "shard_params", "cache_shardings", "param_shardings"]

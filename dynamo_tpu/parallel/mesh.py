@@ -1,0 +1,66 @@
+"""Device meshes and topology planning.
+
+Axis convention (order matters — outermost first so DCN-crossing axes come
+before ICI axes when multi-slice):
+
+- ``dp`` — data parallel: independent batch shards (requests).
+- ``tp`` — tensor parallel: attention heads / MLP hidden dimension.
+- ``sp`` — sequence parallel: ring-attention shards of the sequence axis.
+- ``ep`` — expert parallel: MoE experts (aliases tp's devices when unused).
+
+``MeshPlan.auto`` picks a plan for a model on N devices: tp capped by the
+model's KV-head count (so the paged cache shards cleanly), remaining devices
+to dp. Explicit plans override for benchmarks and disagg topologies.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("dp", "tp", "sp", "ep")
+
+
+@dataclass(frozen=True)
+class MeshPlan:
+    dp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.tp * self.sp * self.ep
+
+    @classmethod
+    def auto(cls, num_devices: int, *, num_kv_heads: int, num_experts: int = 0) -> "MeshPlan":
+        """Largest tp dividing both device count and KV-head count; rest dp.
+
+        MoE models put the non-dp factor on ``ep`` instead when experts
+        outnumber KV heads (wide-EP regime, e.g. DeepSeek).
+        """
+        tp = 1
+        for cand in range(min(num_devices, num_kv_heads), 0, -1):
+            if num_devices % cand == 0 and num_kv_heads % cand == 0:
+                tp = cand
+                break
+        if num_experts and num_experts >= num_kv_heads and num_devices > 1:
+            ep = 1
+            for cand in range(min(num_devices, num_experts), 0, -1):
+                if num_devices % cand == 0 and num_experts % cand == 0:
+                    ep = cand
+                    break
+            if ep > 1:
+                return cls(dp=num_devices // ep, ep=ep)
+        return cls(dp=num_devices // tp, tp=tp)
+
+
+def make_mesh(plan: MeshPlan, devices: list | None = None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    if len(devices) < plan.num_devices:
+        raise ValueError(f"plan needs {plan.num_devices} devices, have {len(devices)}")
+    arr = np.asarray(devices[: plan.num_devices]).reshape(plan.dp, plan.tp, plan.sp, plan.ep)
+    return Mesh(arr, AXES)

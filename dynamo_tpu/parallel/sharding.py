@@ -1,0 +1,81 @@
+"""GSPMD sharding rules for Llama-family params, paged KV cache, activations.
+
+Megatron-style tensor parallelism expressed purely as shardings — no explicit
+collectives; XLA inserts the all-reduce after ``wo`` / ``w_down`` row-parallel
+matmuls and partitions QKV/gate/up column-parallel:
+
+| tensor              | shape                   | spec                        |
+|---------------------|-------------------------|-----------------------------|
+| embed               | [V, D]                  | (tp, None) — vocab-sharded  |
+| lm_head             | [D, V]                  | (None, tp)                  |
+| wq / wk / wv        | [L, D, H*hd]            | (None, None, tp)            |
+| wo                  | [L, H*hd, D]            | (None, tp, None)            |
+| w_gate / w_up       | [L, D, F]               | (None, None, tp)            |
+| w_down              | [L, F, D]               | (None, tp, None)            |
+| MoE expert weights  | [L, E, D, F]            | (None, ep, None, tp)        |
+| router              | [L, D, E]               | replicated                  |
+| norms               | [L, D] / [D]            | replicated                  |
+| k/v cache           | [L, pages, ps, kv, hd]  | (None, None, None, tp, None)|
+
+KV-head sharding of the cache matches the head sharding of k/v projections,
+so cache writes and paged-attention gathers are collective-free; GQA requires
+``tp <= num_kv_heads`` (MeshPlan.auto enforces this).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def param_shardings(mesh: Mesh, params: dict[str, Any]) -> dict[str, Any]:
+    """A pytree of NamedShardings matching the params pytree."""
+
+    def spec_for(path: tuple[str, ...], leaf) -> P:
+        name = path[-1]
+        if name == "embed":
+            return P("tp", None)
+        if name == "lm_head":
+            return P(None, "tp")
+        if name in ("wq", "wk", "wv"):
+            return P(None, None, "tp")
+        if name == "wo":
+            return P(None, "tp", None)
+        if name in ("w_gate", "w_up"):
+            if leaf.ndim == 4:  # MoE: [L, E, D, F]
+                return P(None, "ep", None, "tp")
+            return P(None, None, "tp")
+        if name == "w_down":
+            if leaf.ndim == 4:  # MoE: [L, E, F, D]
+                return P(None, "ep", "tp", None)
+            return P(None, "tp", None)
+        return P()  # norms, router: replicated
+
+    def walk(tree, path):
+        if isinstance(tree, dict):
+            return {k: walk(v, path + (k,)) for k, v in tree.items()}
+        return NamedSharding(mesh, spec_for(path, tree))
+
+    return walk(params, ())
+
+
+def cache_shardings(mesh: Mesh) -> NamedSharding:
+    """Paged KV cache [L, pages, ps, n_kv, hd]: shard KV heads on tp."""
+    return NamedSharding(mesh, P(None, None, None, "tp", None))
+
+
+def batch_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
+    """Request-batch inputs [B, ...]: shard batch on dp."""
+    return NamedSharding(mesh, P("dp", *([None] * (ndim - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_params(params: dict[str, Any], mesh: Mesh) -> dict[str, Any]:
+    """Place a params pytree onto the mesh with TP/EP shardings."""
+    shardings = param_shardings(mesh, params)
+    return jax.tree.map(jax.device_put, params, shardings)

@@ -1,0 +1,33 @@
+"""KV-cache-aware request routing.
+
+The router keeps a live world-model of every worker's prefix cache (fed by
+the KV event plane) plus load metrics, and schedules each request to the
+worker where prefill cost is lowest:
+
+- :mod:`dynamo_tpu.router.indexer` — global block-hash index per worker
+  (the reference's RadixTree; hash chaining makes an explicit trie
+  unnecessary here — see module docstring).
+- :mod:`dynamo_tpu.router.scheduler` — cost = overlap-weighted new blocks +
+  cache usage + queue depth, softmax-sampled with temperature.
+- :mod:`dynamo_tpu.router.events` — worker-side event broadcast endpoint +
+  router-side subscriber.
+- :mod:`dynamo_tpu.router.metrics` — ForwardPassMetrics publisher/aggregator.
+- :mod:`dynamo_tpu.router.router` — KvRouter + the KvPushRouter engine that
+  plugs into the frontend pipeline.
+- :mod:`dynamo_tpu.router.recorder` — JSONL event record/replay.
+
+Parity: reference `lib/llm/src/kv_router/*` (SURVEY.md §2 rows 22-26).
+"""
+
+from dynamo_tpu.router.indexer import KvIndexer, OverlapScores
+from dynamo_tpu.router.scheduler import KvScheduler, SchedulerConfig
+from dynamo_tpu.router.router import KvRouter, KvPushRouter
+
+__all__ = [
+    "KvIndexer",
+    "OverlapScores",
+    "KvScheduler",
+    "SchedulerConfig",
+    "KvRouter",
+    "KvPushRouter",
+]

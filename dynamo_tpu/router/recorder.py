@@ -1,0 +1,54 @@
+"""KV router event recorder: capture RouterEvents to JSONL and replay them.
+
+Used for offline analysis of routing behavior and for tests that replay a
+captured production event stream against a fresh indexer.
+
+Parity: reference `kv_router/recorder.rs` / `lib/llm/src/recorder.rs:37-287`.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import time
+from typing import Iterator
+
+from dynamo_tpu.protocols.kv import RouterEvent
+
+
+class KvRecorder:
+    def __init__(self, path: str | pathlib.Path) -> None:
+        self.path = pathlib.Path(path)
+        self._fh = None
+        self.count = 0
+
+    def __enter__(self) -> "KvRecorder":
+        self._fh = self.path.open("a")
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+    def record(self, event: RouterEvent) -> None:
+        if self._fh is None:
+            raise RuntimeError("recorder not open (use as context manager)")
+        self._fh.write(json.dumps({"ts": time.time(), **event.to_dict()}) + "\n")
+        self.count += 1
+
+    def flush(self) -> None:
+        if self._fh:
+            self._fh.flush()
+
+
+def replay(path: str | pathlib.Path) -> Iterator[tuple[float, RouterEvent]]:
+    """Yield (timestamp, RouterEvent) from a recorded JSONL file."""
+    with pathlib.Path(path).open() as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            d = json.loads(line)
+            ts = d.pop("ts", 0.0)
+            yield ts, RouterEvent.from_dict(d)

@@ -1,0 +1,96 @@
+"""KvRouter: ties indexer + scheduler + metrics into a routing engine.
+
+``KvPushRouter`` is the pipeline stage the frontend uses in ``kv`` router
+mode: for each PreprocessedRequest it computes the prompt's chained block
+hashes, asks the indexer for per-worker overlaps, scores candidates with the
+scheduler, and opens the stream *direct* to the chosen worker instance.
+
+Parity: reference `kv_router.rs:104-199,220` (KvRouter + KvPushRouter).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.protocols.common import PreprocessedRequest
+from dynamo_tpu.router.events import KV_EVENTS_ENDPOINT, KvEventSubscriber
+from dynamo_tpu.router.indexer import KvIndexer
+from dynamo_tpu.router.metrics import KvMetricsAggregator
+from dynamo_tpu.router.scheduler import KvScheduler, SchedulerConfig
+from dynamo_tpu.runtime.client import Client
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.tokens import compute_block_hashes
+
+logger = logging.getLogger(__name__)
+
+
+class KvRouter:
+    """Scheduling brain: returns the best worker for a token sequence."""
+
+    def __init__(
+        self,
+        indexer: KvIndexer,
+        scheduler: KvScheduler,
+        aggregator: KvMetricsAggregator | None,
+        *,
+        block_size: int,
+        salt: int | None = None,
+    ) -> None:
+        self.indexer = indexer
+        self.scheduler = scheduler
+        self.aggregator = aggregator
+        self.block_size = block_size
+        self.salt = salt
+
+    def schedule(self, token_ids: list[int], worker_ids: list[int]) -> tuple[int, int]:
+        """Returns (worker_id, overlap_blocks) for the given prompt."""
+        kw = {"salt": self.salt} if self.salt is not None else {}
+        hashes = compute_block_hashes(token_ids, self.block_size, **kw)
+        overlaps = self.indexer.find_matches(hashes)
+        metrics = self.aggregator.snapshot() if self.aggregator else {}
+        num_blocks = max(len(hashes), 1)
+        wid = self.scheduler.schedule(num_blocks, overlaps, metrics, worker_ids)
+        return wid, overlaps.scores.get(wid, 0)
+
+
+class KvPushRouter(AsyncEngine[Any, Any]):
+    """Pipeline stage: route each request to its best worker, then go direct."""
+
+    def __init__(self, client: Client, router: KvRouter) -> None:
+        self.client = client
+        self.router = router
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        body = request if isinstance(request, dict) else request.to_dict()
+        token_ids = list(body.get("token_ids", []))
+        await self.client.start()
+        worker_ids = self.client.instance_ids()
+        if not worker_ids:
+            worker_ids = [i.instance_id for i in await self.client.wait_for_instances(count=1)]
+        wid, overlap = self.router.schedule(token_ids, worker_ids)
+        logger.debug("kv-routed %d tokens -> worker %x (overlap %d blocks)", len(token_ids), wid, overlap)
+        async for item in self.client.generate(body, context, instance_id=wid):
+            yield item
+
+
+async def build_kv_router(
+    runtime: DistributedRuntime,
+    *,
+    namespace: str,
+    component: str,
+    endpoint: str = "generate",
+    block_size: int,
+    salt: int | None = None,
+    scheduler_config: SchedulerConfig | None = None,
+) -> tuple[KvPushRouter, KvEventSubscriber, KvMetricsAggregator]:
+    """Assemble the full KV routing stack against a worker component."""
+    indexer = KvIndexer()
+    events_ep = runtime.namespace(namespace).component(component).endpoint(KV_EVENTS_ENDPOINT)
+    subscriber = await KvEventSubscriber(events_ep, indexer).start()
+    aggregator = await KvMetricsAggregator(runtime, namespace, component).start()
+    scheduler = KvScheduler(scheduler_config)
+    router = KvRouter(indexer, scheduler, aggregator, block_size=block_size, salt=salt)
+    client = runtime.namespace(namespace).component(component).endpoint(endpoint).client(router_mode="direct")
+    return KvPushRouter(client, router), subscriber, aggregator

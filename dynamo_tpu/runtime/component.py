@@ -111,6 +111,7 @@ class DistributedRuntime:
         self._lease_ttl = lease_ttl
         self._primary_lease: Lease | None = None
         self._keepalive_task: asyncio.Task | None = None
+        self._secondary_tasks: list[asyncio.Task] = []
         self._served: list[tuple[str, str]] = []  # (subject, key)
         self._closed = False
 
@@ -125,6 +126,13 @@ class DistributedRuntime:
             self._primary_lease = await self.store.create_lease(self._lease_ttl)
             self._keepalive_task = asyncio.create_task(self._keepalive_loop(self._primary_lease))
         return self._primary_lease
+
+    async def secondary_lease(self, ttl: float | None = None) -> Lease:
+        """An extra kept-alive lease: a distinct instance identity within this
+        process (e.g. several engine workers sharing one runtime)."""
+        lease = await self.store.create_lease(ttl if ttl is not None else self._lease_ttl)
+        self._secondary_tasks.append(asyncio.create_task(self._keepalive_loop(lease)))
+        return lease
 
     async def _keepalive_loop(self, lease: Lease) -> None:
         interval = max(lease.ttl / 3.0, 0.05)
@@ -151,6 +159,8 @@ class DistributedRuntime:
         self._closed = True
         if self._keepalive_task is not None:
             self._keepalive_task.cancel()
+        for t in self._secondary_tasks:
+            t.cancel()
         for subject, key in self._served:
             await self.transport.unregister_engine(subject)
             try:

@@ -1,0 +1,193 @@
+"""Preprocessor + backend tests: templates, tokenization, incremental
+detokenization (multi-byte safety), stop-string jail semantics."""
+
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.backend import Backend, StopStringJail
+from dynamo_tpu.preprocessor import OpenAIPreprocessor, PromptFormatter, extract_sampling, extract_stop
+from dynamo_tpu.protocols.common import EngineOutput, FinishReason
+from dynamo_tpu.runtime.engine import AsyncEngine, Context, collect
+from dynamo_tpu.tokenizer import ByteTokenizer, IncrementalDetokenizer
+
+TOK = ByteTokenizer()
+
+
+# -- tokenizer ---------------------------------------------------------------
+
+
+def test_byte_tokenizer_roundtrip():
+    s = "héllo wörld → 漢字"
+    assert TOK.decode(TOK.encode(s)) == s
+    assert TOK.encode("a", add_bos=True)[0] == ByteTokenizer.BOS
+
+
+def test_incremental_detokenizer_multibyte():
+    s = "né漢"
+    ids = TOK.encode(s)
+    detok = IncrementalDetokenizer(TOK)
+    # Push byte-by-byte: partial UTF-8 sequences must be held, never "�".
+    out = ""
+    for t in ids:
+        delta = detok.push([t])
+        assert "�" not in delta
+        out += delta
+    assert out == s
+
+
+def test_incremental_detokenizer_batch():
+    detok = IncrementalDetokenizer(TOK)
+    assert detok.push(TOK.encode("hello ")) == "hello "
+    assert detok.push(TOK.encode("world")) == "world"
+
+
+# -- stop-string jail --------------------------------------------------------
+
+
+def test_jail_no_stops_passthrough():
+    j = StopStringJail([])
+    assert j.push("anything") == "anything"
+
+
+def test_jail_holds_partial_prefix():
+    j = StopStringJail(["STOP"])
+    assert j.push("abcS") == "abc"  # "S" could start "STOP"
+    assert j.push("T") == ""  # "ST" still a prefix
+    assert j.push("xy") == "STxy"  # disambiguated: release jailed text
+    assert j.triggered is None
+
+
+def test_jail_triggers_and_truncates():
+    j = StopStringJail(["<end>"])
+    assert j.push("hello <e") == "hello "
+    assert j.push("nd> tail") == ""
+    assert j.triggered == "<end>"
+    assert j.push("more") == ""  # silent after trigger
+
+
+def test_jail_flush_releases_pending():
+    j = StopStringJail(["ZZZ"])
+    j.push("abZ")
+    assert j.flush() == "Z"
+
+
+# -- backend operator --------------------------------------------------------
+
+
+class FakeEngine(AsyncEngine[Any, dict]):
+    """Replays scripted EngineOutput dicts; records whether it was cancelled."""
+
+    def __init__(self, texts: list[str], finish: str = "length") -> None:
+        self.texts = texts
+        self.finish = finish
+        self.closed_early = False
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
+        try:
+            n = len(self.texts)
+            for i, t in enumerate(self.texts):
+                final = i == n - 1
+                yield EngineOutput(
+                    token_ids=TOK.encode(t),
+                    finish_reason=FinishReason(self.finish) if final else None,
+                    cumulative_tokens=i + 1,
+                    prompt_tokens=3 if final else None,
+                ).to_dict()
+        finally:
+            if not final or self.closed_early:
+                self.closed_early = True
+
+
+async def test_backend_detokenizes_stream():
+    eng = FakeEngine(["Hel", "lo ", "wor", "ld"])
+    backend = Backend(eng, TOK)
+    req = {"token_ids": [1, 2, 3], "sampling": {}, "stop": {}}
+    outs = await collect(backend.generate(req, Context()))
+    assert "".join(o.text for o in outs) == "Hello world"
+    assert outs[-1].finish_reason == FinishReason.LENGTH
+    assert outs[-1].prompt_tokens == 3
+
+
+async def test_backend_stop_string_truncates_and_cancels():
+    eng = FakeEngine(["one two ", "<e", "nd> junk", "never seen"])
+    backend = Backend(eng, TOK)
+    req = {"token_ids": [1], "sampling": {}, "stop": {"stop_strings": ["<end>"]}}
+    outs = await collect(backend.generate(req, Context()))
+    assert "".join(o.text for o in outs) == "one two "
+    assert outs[-1].finish_reason == FinishReason.STOP
+
+
+# -- preprocessor ------------------------------------------------------------
+
+
+def test_prompt_formatter_default_template():
+    f = PromptFormatter()
+    text = f.render([{"role": "user", "content": "hi"}])
+    assert "<|im_start|>user\nhi<|im_end|>" in text
+    assert text.endswith("<|im_start|>assistant\n")
+
+
+def test_prompt_formatter_custom_template():
+    f = PromptFormatter("{{ bos_token }}{% for m in messages %}[{{ m['role'] }}]{{ m['content'] }}{% endfor %}", bos_token="<s>")
+    assert f.render([{"role": "user", "content": "x"}]) == "<s>[user]x"
+
+
+def test_extract_sampling_and_stop():
+    body = {
+        "temperature": 0.7, "top_p": 0.9, "seed": 42, "max_tokens": 99,
+        "stop": ["\n\n"],
+        "nvext": {"top_k": 50, "ignore_eos": True, "min_tokens": 3, "stop_token_ids": [7]},
+    }
+    s = extract_sampling(body)
+    assert (s.temperature, s.top_k, s.top_p, s.seed) == (0.7, 50, 0.9, 42)
+    st = extract_stop(body, default_max_tokens=512)
+    assert st.max_tokens == 99 and st.stop_strings == ["\n\n"]
+    assert st.ignore_eos and st.min_tokens == 3 and st.stop_token_ids == [7]
+
+
+def test_extract_defaults():
+    s = extract_sampling({})
+    assert s.temperature == 1.0 and s.top_p == 1.0 and s.top_k == 0
+    st = extract_stop({}, default_max_tokens=256)
+    assert st.max_tokens == 256 and not st.stop_strings
+
+
+class EchoEngine(AsyncEngine[Any, dict]):
+    def __init__(self):
+        self.last_request = None
+
+    async def generate(self, request, context):
+        self.last_request = request
+        yield request
+
+
+async def test_preprocessor_forward_edge():
+    eng = EchoEngine()
+    pre = OpenAIPreprocessor(eng, TOK, default_max_tokens=64)
+    body = {"messages": [{"role": "user", "content": "hey"}], "temperature": 0, "model": "m1"}
+    [downstream_req] = await collect(pre.generate(body, Context()))
+    assert downstream_req["model"] == "m1"
+    assert downstream_req["stop"]["max_tokens"] == 64
+    text = TOK.decode(downstream_req["token_ids"])
+    assert "hey" in text and "assistant" in text
+
+
+async def test_preprocessor_completions_prompt():
+    eng = EchoEngine()
+    pre = OpenAIPreprocessor(eng, TOK, add_bos=False)
+    [req] = await collect(pre.generate({"prompt": "2+2="}, Context()))
+    assert TOK.decode(req["token_ids"]) == "2+2="
+
+
+async def test_preprocessor_pretokenized_prompt():
+    eng = EchoEngine()
+    pre = OpenAIPreprocessor(eng, TOK)
+    [req] = await collect(pre.generate({"prompt": [5, 6, 7]}, Context()))
+    assert req["token_ids"] == [5, 6, 7]
+
+
+async def test_preprocessor_bad_prompt_type_raises():
+    import pytest
+
+    pre = OpenAIPreprocessor(EchoEngine(), TOK)
+    with pytest.raises(ValueError):
+        await collect(pre.generate({"prompt": ["a", "b"]}, Context()))

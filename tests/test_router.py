@@ -1,0 +1,167 @@
+"""KV router unit tests: indexer overlap walking, scheduler cost/softmax,
+event subscription plumbing, recorder replay."""
+
+import numpy as np
+
+from dynamo_tpu.protocols.kv import BlockRemoved, BlockStored, ForwardPassMetrics, KvCacheEvent, RouterEvent
+from dynamo_tpu.router.indexer import KvIndexer
+from dynamo_tpu.router.recorder import KvRecorder, replay
+from dynamo_tpu.router.scheduler import KvScheduler, SchedulerConfig
+from dynamo_tpu.tokens import compute_block_hashes
+
+
+def stored(wid, *hashes, parents=None):
+    parents = parents or [None] * len(hashes)
+    return RouterEvent(wid, KvCacheEvent(stored=[BlockStored(h, p) for h, p in zip(hashes, parents)]))
+
+
+def removed(wid, *hashes):
+    return RouterEvent(wid, KvCacheEvent(removed=[BlockRemoved(h) for h in hashes]))
+
+
+# -- indexer -----------------------------------------------------------------
+
+
+def test_find_matches_consecutive_prefix():
+    idx = KvIndexer()
+    idx.apply_event(stored(1, 10, 11, 12))
+    idx.apply_event(stored(2, 10, 11))
+    idx.apply_event(stored(3, 99))
+    scores = idx.find_matches([10, 11, 12, 13]).scores
+    assert scores == {1: 3, 2: 2}
+    assert idx.find_matches([99]).scores == {3: 1}
+    assert idx.find_matches([13, 10]).scores == {}  # must match from the start
+
+
+def test_removed_blocks_stop_matching():
+    idx = KvIndexer()
+    idx.apply_event(stored(1, 10, 11))
+    idx.apply_event(removed(1, 11))
+    assert idx.find_matches([10, 11]).scores == {1: 1}
+
+
+def test_remove_worker_and_cleared():
+    idx = KvIndexer()
+    idx.apply_event(stored(1, 10, 11))
+    idx.apply_event(stored(2, 10))
+    idx.remove_worker(1)
+    assert idx.find_matches([10, 11]).scores == {2: 1}
+    idx.apply_event(RouterEvent(2, KvCacheEvent(cleared=True)))
+    assert idx.find_matches([10]).scores == {}
+    assert idx.num_blocks == 0
+
+
+def test_indexer_matches_engine_hashes():
+    # The indexer must agree with the engine's chained block hashing.
+    tokens = list(range(32))
+    hashes = compute_block_hashes(tokens, 8)
+    idx = KvIndexer()
+    parents = [None] + hashes[:-1]
+    idx.apply_event(stored(7, *hashes, parents=parents))
+    assert idx.find_matches(compute_block_hashes(tokens, 8)).scores == {7: 4}
+    # A different continuation shares only the common prefix.
+    other = compute_block_hashes(tokens[:16] + [999] * 16, 8)
+    assert idx.find_matches(other).scores == {7: 2}
+
+
+# -- scheduler ---------------------------------------------------------------
+
+
+def make_metrics(wid, usage=0.0, waiting=0, slots=10):
+    return ForwardPassMetrics(
+        worker_id=wid, kv_active_blocks=int(usage * 100), kv_total_blocks=100,
+        num_requests_waiting=waiting, request_total_slots=slots,
+    )
+
+
+def test_scheduler_prefers_overlap():
+    s = KvScheduler(SchedulerConfig(overlap_weight=1.0, temperature=0.0))
+    from dynamo_tpu.router.indexer import OverlapScores
+
+    overlaps = OverlapScores({1: 8, 2: 0})
+    metrics = {1: make_metrics(1), 2: make_metrics(2)}
+    assert s.schedule(10, overlaps, metrics, [1, 2]) == 1
+
+
+def test_scheduler_load_beats_small_overlap():
+    s = KvScheduler(SchedulerConfig(overlap_weight=1.0, temperature=0.0))
+    from dynamo_tpu.router.indexer import OverlapScores
+
+    # Worker 1 has 1 block overlap but is saturated; worker 2 is idle.
+    overlaps = OverlapScores({1: 1})
+    metrics = {1: make_metrics(1, usage=0.95, waiting=9), 2: make_metrics(2)}
+    assert s.schedule(10, overlaps, metrics, [1, 2]) == 2
+
+
+def test_scheduler_softmax_spreads_ties():
+    s = KvScheduler(SchedulerConfig(temperature=0.5, seed=0))
+    from dynamo_tpu.router.indexer import OverlapScores
+
+    picks = {s.schedule(4, OverlapScores({}), {}, [1, 2, 3]) for _ in range(50)}
+    assert len(picks) > 1  # samples, not always the same worker
+
+
+def test_scheduler_deterministic_tiebreak():
+    s = KvScheduler(SchedulerConfig(temperature=0.0))
+    from dynamo_tpu.router.indexer import OverlapScores
+
+    assert s.schedule(4, OverlapScores({}), {}, [5, 3, 9]) == 3
+
+
+# -- recorder ----------------------------------------------------------------
+
+
+def test_recorder_roundtrip(tmp_path):
+    path = tmp_path / "events.jsonl"
+    ev = stored(1, 10, 11)
+    with KvRecorder(path) as rec:
+        rec.record(ev)
+        rec.record(removed(1, 10))
+    events = list(replay(path))
+    assert len(events) == 2
+    idx = KvIndexer()
+    for _, e in events:
+        idx.apply_event(e)
+    assert idx.find_matches([10, 11]).scores == {}  # 10 removed breaks the chain at the start
+    assert idx.find_matches([11]).scores == {1: 1}  # 11 itself is still held
+    assert idx.worker_block_counts() == {1: 1}
+
+
+# -- snapshot / late join ----------------------------------------------------
+
+
+def test_allocator_snapshot_orders_parents_first():
+    from dynamo_tpu.engine.allocator import PageAllocator
+
+    alloc = PageAllocator(num_pages=8, page_size=4)
+    a, b, c = alloc.allocate(3)
+    alloc.commit(a, 100, None)
+    alloc.commit(b, 200, 100)
+    alloc.commit(c, 300, 200)
+    snap = alloc.cache_snapshot()
+    hashes = [s.block_hash for s in snap.stored]
+    assert hashes.index(100) < hashes.index(200) < hashes.index(300)
+    # Applying the snapshot to a fresh indexer reconstructs the chain.
+    idx = KvIndexer()
+    idx.apply_event(RouterEvent(5, snap))
+    assert idx.find_matches([100, 200, 300]).scores == {5: 3}
+
+
+async def test_broadcaster_snapshot_for_late_subscriber():
+    from dynamo_tpu.protocols.kv import BlockStored
+    from dynamo_tpu.router.events import KvEventBroadcaster
+    from dynamo_tpu.runtime.engine import Context
+
+    snap_event = KvCacheEvent(stored=[BlockStored(42, None)])
+    bc = KvEventBroadcaster(snapshot_fn=lambda: snap_event)
+    bc.publish(KvCacheEvent(stored=[BlockStored(1, None)]))  # before subscribe
+    ctx = Context()
+    stream = bc.generate({}, ctx)
+    first = await stream.__anext__()
+    assert first["snapshot"] is True and first["seq"] == 1
+    assert first["event"]["stored"][0]["block_hash"] == 42
+    bc.publish(KvCacheEvent(stored=[BlockStored(2, None)]))
+    second = await stream.__anext__()
+    assert second["seq"] == 1 and not second.get("snapshot")
+    ctx.stop_generating()
+    await stream.aclose()

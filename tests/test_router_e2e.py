@@ -1,0 +1,105 @@
+"""KV routing end-to-end: two engine workers + frontend in kv router mode.
+
+Exercises the full loop from SURVEY.md §3 call stacks B+D: engine emits KV
+stored events -> broadcaster -> subscriber -> indexer; scheduler routes a
+repeated prompt to the worker that cached it; metrics plane feeds costs.
+"""
+
+import asyncio
+
+import aiohttp
+
+from dynamo_tpu.launch import run_local
+
+
+async def wait_for(cond, timeout=5.0, interval=0.05):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while True:
+        if cond():
+            return True
+        if asyncio.get_event_loop().time() > deadline:
+            return False
+        await asyncio.sleep(interval)
+
+
+async def test_kv_routed_repeat_prompt_hits_cache():
+    handles = await run_local(
+        "test-tiny", port=0, num_workers=2, router_mode="kv",
+        num_pages=64, max_batch_size=8,
+    )
+    base = f"http://127.0.0.1:{handles['port']}"
+    try:
+        # The watcher registered the model with a KvPushRouter pipeline.
+        entry = handles["http"].manager.get("test-tiny")
+        assert entry is not None and entry.aux, "kv router stack should be built"
+
+        # 48-token prompt = 3 full pages of 16.
+        body = {"model": "test-tiny", "prompt": "a" * 48, "max_tokens": 4, "temperature": 0}
+        async with aiohttp.ClientSession() as s:
+            async with s.post(base + "/v1/completions", json=body) as r:
+                assert r.status == 200, await r.text()
+                first = await r.json()
+
+            # KV events must reach the router's indexer.
+            subscriber = entry.aux[0]
+            indexer = subscriber.indexer
+            assert await wait_for(lambda: indexer.num_blocks >= 3), "indexer never saw KV events"
+
+            # Count which worker currently holds blocks: exactly one.
+            counts_before = indexer.worker_block_counts()
+            assert len([w for w, c in counts_before.items() if c >= 3]) == 1
+            (hot_worker,) = [w for w, c in counts_before.items() if c >= 3]
+
+            # Same prompt again: must go to the same worker and hit its cache.
+            async with s.post(base + "/v1/completions", json=body) as r:
+                assert r.status == 200
+                second = await r.json()
+            assert second["choices"][0]["text"] == first["choices"][0]["text"]
+            assert second["usage"]["prompt_tokens_details"]["cached_tokens"] >= 32
+
+            # Cold different prompt: scheduler should spread to the idle worker
+            # (same new-block cost, lower usage there after the cache fills).
+            other = {"model": "test-tiny", "prompt": "z" * 48, "max_tokens": 4, "temperature": 0}
+            async with s.post(base + "/v1/completions", json=other) as r:
+                assert r.status == 200
+            await wait_for(lambda: len(indexer.worker_block_counts()) == 2, timeout=3.0)
+            counts_after = indexer.worker_block_counts()
+            assert sum(counts_after.values()) > counts_before.get(hot_worker, 0)
+    finally:
+        await handles["http"].stop()
+        await handles["watcher"].close()
+        for svc in handles["services"]:
+            await svc.close()
+        await handles["runtime"].close()
+
+
+async def test_worker_death_removes_blocks_from_index():
+    handles = await run_local(
+        "test-tiny", port=0, num_workers=2, router_mode="kv",
+        num_pages=64, max_batch_size=8,
+    )
+    base = f"http://127.0.0.1:{handles['port']}"
+    try:
+        entry = handles["http"].manager.get("test-tiny")
+        subscriber = entry.aux[0]
+        indexer = subscriber.indexer
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "test-tiny", "prompt": "b" * 32, "max_tokens": 2, "temperature": 0}
+            async with s.post(base + "/v1/completions", json=body) as r:
+                assert r.status == 200
+        assert await wait_for(lambda: indexer.num_blocks >= 2)
+        (wid,) = [w for w, c in indexer.worker_block_counts().items() if c > 0]
+
+        # Simulate worker death: delete its instance records (lease revoke).
+        store = handles["runtime"].store
+        for key in list((await store.get_prefix("instances/")).keys()):
+            if key.endswith(f":{wid:x}"):
+                await store.delete(key)
+        assert await wait_for(lambda: indexer.worker_block_counts().get(wid, 0) == 0), \
+            "dead worker's blocks must leave the index"
+    finally:
+        await handles["http"].stop()
+        await handles["watcher"].close()
+        for svc in handles["services"]:
+            await svc.close()
+        await handles["runtime"].close()
