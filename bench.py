@@ -28,6 +28,7 @@ BATCH = int(os.environ.get("BENCH_BATCH", "32"))
 ISL = int(os.environ.get("BENCH_ISL", "512"))
 OSL = int(os.environ.get("BENCH_OSL", "128"))
 TARGET_TOKS = float(os.environ.get("BENCH_TARGET", "8000"))
+DECODE_STEPS = int(os.environ.get("BENCH_DECODE_STEPS", "8"))
 
 
 def main() -> None:
@@ -53,6 +54,7 @@ def main() -> None:
             num_pages=num_pages, page_size=page_size, max_batch_size=BATCH,
             max_prefill_tokens=ISL * 4, max_seq_len=ISL + OSL + 8,
             enable_prefix_caching=False,  # uniform-random prompts: measure raw decode
+            decode_steps=DECODE_STEPS,
         ),
     )
 
@@ -91,6 +93,7 @@ def main() -> None:
                 "vs_baseline": round(tok_per_sec / TARGET_TOKS, 4),
                 "detail": {
                     "preset": PRESET, "batch": BATCH, "isl": ISL, "osl": OSL,
+                    "decode_steps": DECODE_STEPS,
                     "decode_tokens": generated, "seconds": round(elapsed, 3),
                     "backend": __import__("jax").default_backend(),
                 },

@@ -89,13 +89,28 @@ class KvBlockManager:
                 return payload
         return None
 
-    def extend_prefix(self, block_hashes: list[int], start: int) -> list[Payload]:
-        """Consecutive payloads for block_hashes[start:], stopping at the
-        first miss (chain order) or the onboard limit."""
-        out: list[Payload] = []
+    def probe_prefix(self, block_hashes: list[int], start: int) -> int:
+        """How many consecutive blocks from ``start`` the tiers hold.
+
+        Membership-only — no payload I/O. Admission uses this to budget and
+        allocate pages first; payloads are fetched only once pages exist
+        (otherwise each failed admission attempt would re-read from disk).
+        """
+        n = 0
         for h in block_hashes[start:]:
-            if len(out) >= self.config.onboard_limit:
+            if n >= self.config.onboard_limit:
                 break
+            if h in self.g2 or (self.g3 is not None and h in self.g3):
+                n += 1
+            else:
+                break
+        return n
+
+    def fetch_prefix(self, block_hashes: list[int], start: int, count: int) -> list[Payload]:
+        """Read up to ``count`` consecutive payloads; may return fewer if a
+        block was evicted (or its payload lost) since the probe."""
+        out: list[Payload] = []
+        for h in block_hashes[start : start + count]:
             payload = self.lookup(h)
             if payload is None:
                 break

@@ -1,0 +1,39 @@
+"""Disaggregated prefill/decode serving.
+
+The reference's flagship "phase parallelism" (SURVEY.md §3 call stack C):
+prefill and decode run on separate worker fleets so each can be sized and
+sharded for its regime (prefill = compute-bound, decode = memory-bound).
+
+TPU-first design — **remote prefill is remote prefix-cache injection.**
+There is no RDMA-write-into-remote-block-id primitive on TPU; instead of
+emulating one, the prefill worker computes the prompt's full KV pages and
+streams them into the *decode* worker's page allocator as committed,
+hash-identified prefix-cache blocks (`disagg/transfer.py`). The decode
+worker then admits the request through its completely ordinary scheduling
+path: the prefix match hits the injected blocks, and only the sub-page tail
+(< page_size tokens) is computed locally — which also yields the first-token
+logits, so the prefill side never needs to sample or ship logits.
+
+Components:
+
+- :mod:`dynamo_tpu.disagg.queue` — distributed work queue on the discovery
+  store with lease-protected claims (the JetStream `prefill_queue`
+  equivalent; at-least-once, crash-safe reclaim).
+- :mod:`dynamo_tpu.disagg.transfer` — the KV injection endpoint served by
+  decode workers + the sender-side helper (DCN path over the stream
+  transport; same-process meshes short-circuit to device-to-device copies).
+- :mod:`dynamo_tpu.disagg.router` — conditional disagg decision
+  (prefill length threshold, hot-reloaded from the store like the
+  reference's etcd-watched `disagg_router.rs`).
+- :mod:`dynamo_tpu.disagg.prefill_worker` — claims queue tasks, prefills on
+  its local engine, ships pages.
+- :mod:`dynamo_tpu.disagg.operator` — pipeline stage in front of a decode
+  engine: decides, enqueues, awaits injection, falls back to local prefill
+  on timeout.
+"""
+
+from dynamo_tpu.disagg.queue import DistributedQueue
+from dynamo_tpu.disagg.router import DisaggConfig, DisaggRouter
+from dynamo_tpu.disagg.transfer import KvTransferService, send_blocks
+
+__all__ = ["DistributedQueue", "DisaggConfig", "DisaggRouter", "KvTransferService", "send_blocks"]

@@ -1,0 +1,82 @@
+"""Disagg operator: the decode-worker stage that orchestrates remote prefill.
+
+Sits in front of the decode engine service on the ``generate`` endpoint.
+For each request: decide (DisaggRouter), enqueue a prefill task carrying our
+transfer address, await KV injection, then hand the request to the ordinary
+engine path — whose prefix match now hits the injected blocks. On transfer
+timeout the request simply proceeds with local prefill (graceful
+degradation; no wedged requests).
+
+Parity: the decision + callback choreography of
+`examples/llm/components/worker.py:190-229` without the block-id callback —
+injection into the prefix cache replaces RemotePrefillParams entirely.
+"""
+
+from __future__ import annotations
+
+import logging
+import uuid
+from typing import Any, AsyncIterator
+
+import asyncio
+
+from dynamo_tpu.disagg.queue import DistributedQueue
+from dynamo_tpu.disagg.router import DisaggRouter
+from dynamo_tpu.disagg.transfer import KvTransferService
+from dynamo_tpu.engine.service import JaxEngineService
+from dynamo_tpu.protocols.common import PreprocessedRequest
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+
+logger = logging.getLogger(__name__)
+
+
+class DisaggDecodeService(AsyncEngine[Any, dict]):
+    def __init__(
+        self,
+        engine: JaxEngineService,
+        transfer: KvTransferService,
+        queue: DistributedQueue,
+        router: DisaggRouter,
+        transfer_address: str,
+        *,
+        transfer_timeout: float = 30.0,
+    ) -> None:
+        self.engine = engine
+        self.transfer = transfer
+        self.queue = queue
+        self.router = router
+        self.transfer_address = transfer_address
+        self.transfer_timeout = transfer_timeout
+        self.remote_prefills = 0
+        self.local_prefills = 0
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
+        req = PreprocessedRequest.from_dict(request) if isinstance(request, dict) else request
+        prefill_len = len(req.token_ids)
+        # Length screen first: the common short-prompt path must not pay the
+        # queue-depth store scans.
+        go_remote = self.router.wants_remote(prefill_len)
+        if go_remote:
+            go_remote = self.router.prefill_remote(prefill_len, await self.queue.depth())
+        if go_remote:
+            rid = req.request_id or uuid.uuid4().hex
+            done = self.transfer.expect(rid)
+            await self.queue.put(
+                {
+                    "request_id": rid,
+                    "token_ids": list(req.token_ids),
+                    "transfer_address": self.transfer_address,
+                }
+            )
+            try:
+                await asyncio.wait_for(done.wait(), timeout=self.transfer_timeout)
+                self.remote_prefills += 1
+            except asyncio.TimeoutError:
+                logger.warning("remote prefill timed out for %s; prefilling locally", rid)
+                self.local_prefills += 1
+            finally:
+                self.transfer.forget(rid)
+        else:
+            self.local_prefills += 1
+        async for item in self.engine.generate(req, context):
+            yield item

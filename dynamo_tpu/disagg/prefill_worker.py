@@ -1,0 +1,96 @@
+"""Prefill worker: claims queue tasks, prefills, ships KV to decode workers.
+
+The reference's `examples/llm/components/prefill_worker.py` role. The local
+engine runs an ordinary 1-token generation (prefill + first decode step);
+its committed pages are then read out and streamed to the requesting decode
+worker's transfer endpoint. The sampled token is discarded — the decode side
+recomputes the sub-page tail locally and samples there, so the transferred
+artifact is pure KV.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from dynamo_tpu.disagg.queue import DistributedQueue
+from dynamo_tpu.disagg.transfer import collect_prefill_blocks, send_blocks
+from dynamo_tpu.engine.service import JaxEngineService
+from dynamo_tpu.protocols.common import PreprocessedRequest, SamplingOptions, StopConditions
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.tokens import compute_block_hashes
+
+logger = logging.getLogger(__name__)
+
+PREFILL_QUEUE = "prefill"
+
+
+class PrefillWorker:
+    def __init__(
+        self,
+        runtime: DistributedRuntime,
+        service: JaxEngineService,
+        *,
+        queue_name: str = PREFILL_QUEUE,
+    ) -> None:
+        self.runtime = runtime
+        self.service = service
+        self.queue = DistributedQueue(runtime, queue_name)
+        self._task: asyncio.Task | None = None
+        self.completed = 0
+
+    async def start(self) -> "PrefillWorker":
+        if self._task is None:
+            self._task = asyncio.create_task(self._loop(), name="prefill-worker")
+        return self
+
+    async def _loop(self) -> None:
+        while True:
+            try:
+                claimed = await self.queue.claim(timeout=None)
+                if claimed is None:
+                    continue
+                key, task = claimed
+                await self._handle(task)
+                await self.queue.delete(key)
+                self.completed += 1
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("prefill task failed")
+                await asyncio.sleep(0.2)
+
+    async def _handle(self, task: dict) -> None:
+        token_ids = task["token_ids"]
+        request_id = task["request_id"]
+        page_size = self.service.core.config.page_size
+        salt = self.service.core.config.salt
+        # Ordinary 1-token generation: prefill fills + commits the prompt's
+        # full pages into this worker's prefix cache.
+        req = PreprocessedRequest(
+            token_ids=token_ids,
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=1, ignore_eos=True),
+            request_id=request_id,
+        )
+        async for _ in self.service.generate(req, Context()):
+            pass
+        hashes = compute_block_hashes(token_ids, page_size, salt=salt)
+        loop = asyncio.get_running_loop()
+        blocks = await loop.run_in_executor(None, collect_prefill_blocks, self.service.core, hashes)
+        if not blocks:
+            logger.warning("prefill %s produced no transferable blocks", request_id)
+        result = await send_blocks(
+            self.runtime.transport, task["transfer_address"], request_id, blocks
+        )
+        logger.info(
+            "prefill %s: %d tokens -> %d blocks shipped (%s injected)",
+            request_id, len(token_ids), len(blocks), result.get("injected"),
+        )
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        await self.queue.close()

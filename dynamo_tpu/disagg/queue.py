@@ -1,0 +1,118 @@
+"""Distributed work queue over the discovery store (JetStream equivalent).
+
+Tasks are records under ``queue/{name}/task/{seq:020d}``; claims are
+lease-bound records under ``queue/{name}/claim/{seq}``. A worker claims the
+oldest unclaimed task with an atomic ``put_if_absent``; if the worker dies,
+its claim's lease expires, the claim key vanishes, and the task becomes
+claimable again — at-least-once delivery with crash-safe reclaim, the same
+guarantee the reference gets from JetStream acks (`utils/prefill_queue.py`,
+`transports/nats.rs:345`).
+
+Watch-driven: consumers block on the task-prefix watch rather than polling.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from typing import Any
+
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.discovery import WatchEventType
+
+logger = logging.getLogger(__name__)
+
+
+class DistributedQueue:
+    def __init__(self, runtime: DistributedRuntime, name: str) -> None:
+        import uuid
+
+        self.runtime = runtime
+        self.name = name
+        self._seq = 0
+        # Producer-unique suffix: several queue instances may share one
+        # process/lease; keys must never collide (an overwrite loses a task).
+        self._uid = uuid.uuid4().hex[:8]
+        self._wake = asyncio.Event()
+        self._watch_task: asyncio.Task | None = None
+
+    @property
+    def task_prefix(self) -> str:
+        return f"queue/{self.name}/task/"
+
+    def _claim_key(self, task_key: str) -> str:
+        return f"queue/{self.name}/claim/{task_key.rsplit('/', 1)[-1]}"
+
+    # -- producer ----------------------------------------------------------
+
+    async def put(self, item: dict[str, Any], *, lease_bound: bool = False) -> str:
+        """Enqueue a task; returns its key. ``lease_bound`` ties the task's
+        lifetime to this process (use when the result is useless without us)."""
+        lease_id = None
+        if lease_bound:
+            lease_id = (await self.runtime.primary_lease()).id
+        self._seq += 1
+        key = f"{self.task_prefix}{self._seq:012d}-{self._uid}"
+        await self.runtime.store.put(key, json.dumps(item).encode(), lease_id=lease_id)
+        return key
+
+    async def delete(self, task_key: str) -> None:
+        """Ack: remove a completed task (and its claim record)."""
+        await self.runtime.store.delete(task_key)
+        await self.runtime.store.delete(self._claim_key(task_key))
+
+    # -- consumer ----------------------------------------------------------
+
+    async def _ensure_watch(self) -> None:
+        if self._watch_task is None:
+            self._watch_task = asyncio.create_task(self._watch())
+
+    async def _watch(self) -> None:
+        try:
+            async for event in self.runtime.store.watch_prefix(self.task_prefix):
+                if event.type is WatchEventType.PUT:
+                    self._wake.set()
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            logger.exception("queue watch failed: %s", self.name)
+
+    async def claim(self, *, timeout: float | None = None) -> tuple[str, dict[str, Any]] | None:
+        """Claim the oldest available task; blocks until one is available.
+
+        Returns (task_key, item), or None on timeout. The claim is bound to
+        this process's lease: call :meth:`delete` when done, or crash and let
+        the claim expire for another worker to pick it up.
+        """
+        await self._ensure_watch()
+        deadline = asyncio.get_event_loop().time() + timeout if timeout is not None else None
+        lease = await self.runtime.primary_lease()
+        while True:
+            tasks = await self.runtime.store.get_prefix(self.task_prefix)
+            for key in sorted(tasks):
+                if await self.runtime.store.put_if_absent(self._claim_key(key), b"1", lease_id=lease.id):
+                    # Task may have been deleted between scan and claim.
+                    value = await self.runtime.store.get(key)
+                    if value is None:
+                        await self.runtime.store.delete(self._claim_key(key))
+                        continue
+                    return key, json.loads(value)
+            self._wake.clear()
+            remaining = None if deadline is None else deadline - asyncio.get_event_loop().time()
+            if remaining is not None and remaining <= 0:
+                return None
+            try:
+                await asyncio.wait_for(self._wake.wait(), timeout=min(remaining, 1.0) if remaining else 1.0)
+            except asyncio.TimeoutError:
+                pass  # rescan: claims may have expired
+
+    async def depth(self) -> int:
+        tasks = await self.runtime.store.get_prefix(self.task_prefix)
+        claims = await self.runtime.store.get_prefix(f"queue/{self.name}/claim/")
+        return max(0, len(tasks) - len(claims))
+
+    async def close(self) -> None:
+        if self._watch_task is not None:
+            self._watch_task.cancel()
+            self._watch_task = None

@@ -1,0 +1,91 @@
+"""Conditional disaggregation decision + hot-reloaded config.
+
+``DisaggRouter.prefill_remote(prefill_len, queue_depth)`` mirrors the
+reference's decision (`disagg_router.rs:25-38`): prompts longer than
+``max_local_prefill_length`` go to the prefill fleet, unless the prefill
+queue is so deep that waiting would cost more than computing locally
+(``max_prefill_queue_size``). The config lives in the discovery store under
+``config/disagg/{namespace}`` and is watched, so operators (or the planner)
+retune thresholds at runtime without restarts.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from dataclasses import dataclass
+
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.discovery import WatchEventType
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class DisaggConfig:
+    enabled: bool = True
+    max_local_prefill_length: int = 512
+    max_prefill_queue_size: int = 64
+    # Blocks shorter than this aren't worth the transfer overhead.
+    min_remote_prefill_blocks: int = 2
+
+    def to_json(self) -> bytes:
+        return json.dumps(self.__dict__).encode()
+
+    @classmethod
+    def from_json(cls, data: bytes) -> "DisaggConfig":
+        d = json.loads(data)
+        return cls(**{k: d[k] for k in cls().__dict__ if k in d})
+
+
+def config_key(namespace: str) -> str:
+    return f"config/disagg/{namespace}"
+
+
+class DisaggRouter:
+    def __init__(self, config: DisaggConfig | None = None, *, page_size: int = 16) -> None:
+        self.config = config or DisaggConfig()
+        self.page_size = page_size
+        self._watch_task: asyncio.Task | None = None
+
+    def wants_remote(self, prefill_len: int) -> bool:
+        """Cheap length-only screen — callers check this before paying for a
+        queue-depth lookup."""
+        c = self.config
+        if not c.enabled:
+            return False
+        if prefill_len // self.page_size < c.min_remote_prefill_blocks:
+            return False
+        return prefill_len > c.max_local_prefill_length
+
+    def prefill_remote(self, prefill_len: int, queue_depth: int = 0) -> bool:
+        return self.wants_remote(prefill_len) and queue_depth < self.config.max_prefill_queue_size
+
+    # -- dynamic config ----------------------------------------------------
+
+    async def watch(self, runtime: DistributedRuntime, namespace: str) -> "DisaggRouter":
+        key = config_key(namespace)
+        current = await runtime.store.get(key)
+        if current is not None:
+            self.config = DisaggConfig.from_json(current)
+        if self._watch_task is None:
+            self._watch_task = asyncio.create_task(self._watch_loop(runtime, key))
+        return self
+
+    async def _watch_loop(self, runtime: DistributedRuntime, key: str) -> None:
+        try:
+            async for event in runtime.store.watch_prefix(key):
+                if event.type is WatchEventType.PUT and event.value is not None:
+                    try:
+                        self.config = DisaggConfig.from_json(event.value)
+                        logger.info("disagg config updated: %s", self.config)
+                    except Exception:
+                        logger.exception("bad disagg config at %s", key)
+        except asyncio.CancelledError:
+            raise
+
+    async def close(self) -> None:
+        if self._watch_task is not None:
+            self._watch_task.cancel()
+            self._watch_task = None

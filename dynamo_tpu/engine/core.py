@@ -51,6 +51,10 @@ class EngineConfig:
     enable_prefix_caching: bool = True
     salt: int = DEFAULT_SALT
     worker_id: int = 0
+    # Fused decode steps per dispatch. >1 amortizes host<->device round trips
+    # (vital on remote/tunneled chips); trades up to decode_steps-1 wasted
+    # steps per finishing sequence and K-token stream granularity.
+    decode_steps: int = 1
 
 
 class EngineCore:
@@ -152,20 +156,22 @@ class EngineCore:
             seq = self.waiting[0]
             total = len(seq.tokens)  # prompt + any generated-before-preemption
             matched: list[int] = []
-            onboard: list = []  # payloads from G2/G3 to copy into fresh pages
+            onboard_n = 0  # tier blocks to onboard (payloads fetched post-alloc)
+            hashes: list[int] = []
             if self.config.enable_prefix_caching:
                 hashes = seq.block_seq.block_hashes
                 matched = self.allocator.match_prefix(hashes)
                 if self.block_manager is not None:
-                    # Extend the G1 match from the capacity tiers (onboarding).
-                    onboard = self.block_manager.extend_prefix(hashes, len(matched))
+                    # Extend the G1 match from the capacity tiers (membership
+                    # probe only; payload I/O happens after allocation succeeds).
+                    onboard_n = self.block_manager.probe_prefix(hashes, len(matched))
                 # Must compute at least the final token's logits.
-                while (len(matched) + len(onboard)) * self.config.page_size > total - 1:
-                    if onboard:
-                        onboard.pop()
+                while (len(matched) + onboard_n) * self.config.page_size > total - 1:
+                    if onboard_n:
+                        onboard_n -= 1
                     else:
                         self.allocator.release([matched.pop()])
-            cached_len = (len(matched) + len(onboard)) * self.config.page_size
+            cached_len = (len(matched) + onboard_n) * self.config.page_size
             num_new = total - cached_len
             if batch and num_new > budget:
                 self.allocator.release(matched)
@@ -177,17 +183,22 @@ class EngineCore:
                 self.allocator.release(matched)
                 break
             self.waiting.popleft()
-            if onboard:
-                # Copy tier payloads into the first onboarded pages and commit
-                # them: they re-enter the G1 prefix cache and re-announce on
-                # the KV event plane.
-                self.block_manager.onboard(new_pages[: len(onboard)], onboard)
+            if onboard_n:
+                # Pages exist now: fetch tier payloads, copy them in, and
+                # commit — they re-enter the G1 prefix cache and re-announce
+                # on the KV event plane. A fetch shortfall (evicted since the
+                # probe) just means those tokens get recomputed.
+                onboard = self.block_manager.fetch_prefix(hashes, len(matched), onboard_n)
+                if len(onboard) < onboard_n:
+                    onboard_n = len(onboard)
+                    cached_len = (len(matched) + onboard_n) * self.config.page_size
+                self.block_manager.onboard(new_pages[: onboard_n], onboard)
                 blocks = seq.block_seq.blocks
-                for i, pid in enumerate(new_pages[: len(onboard)]):
+                for i, pid in enumerate(new_pages[:onboard_n]):
                     blk = blocks[len(matched) + i]
                     self.allocator.commit(pid, blk.block_hash, blk.parent_hash, blk.tokens)
             seq.pages = matched + new_pages
-            seq.committed_pages = len(matched) + len(onboard)
+            seq.committed_pages = len(matched) + onboard_n
             seq.num_cached = cached_len
             if seq.status is not SeqStatus.PREEMPTED:
                 seq.num_cached_at_start = cached_len
@@ -233,11 +244,12 @@ class EngineCore:
 
     def _run_decode(self) -> list[tuple[Sequence, EngineOutput]]:
         ps = self.config.page_size
-        # Ensure every running sequence has a page for its next slot; preempt on OOM.
+        k = max(1, self.config.decode_steps)
+        # Ensure every running sequence has pages for the whole burst; preempt on OOM.
         i = 0
         while i < len(self.running):
             seq = self.running[i]
-            need = seq.pages_needed(ps, 1)
+            need = seq.pages_needed(ps, k)
             if need:
                 try:
                     seq.pages.extend(self.allocator.allocate(need))
@@ -266,14 +278,23 @@ class EngineCore:
             positions[i, 0] = s.num_cached
             block_tables[i, : len(s.pages)] = s.pages
             slots[i, 0] = s.pages[s.num_cached // ps] * ps + s.num_cached % ps
-        next_tokens = self.runner.step(self._sampling_batch(batch, tokens, positions, block_tables, slots, last))
+        step_batch = self._sampling_batch(batch, tokens, positions, block_tables, slots, last)
+        if k == 1:
+            next_tokens = self.runner.step(step_batch)[:, None]
+        else:
+            next_tokens = self.runner.multi_step(step_batch, k)  # [B, k]
         outputs = []
         for i, s in enumerate(batch):
-            s.num_cached += 1
-            s.append_token(int(next_tokens[i]))
-            self._generated_tokens_total += 1
+            accepted: list[int] = []
+            for tok in next_tokens[i]:
+                s.num_cached += 1
+                s.append_token(int(tok))
+                self._generated_tokens_total += 1
+                accepted.append(int(tok))
+                if s.check_stop(self._eos, self.config.max_seq_len) is not None:
+                    break  # overshoot from the burst is discarded
             self._commit_filled_pages(s)
-            outputs.append(self._emit(s, int(next_tokens[i])))
+            outputs.append(self._emit_many(s, accepted))
         return outputs
 
     # -- shared helpers ----------------------------------------------------
@@ -310,11 +331,14 @@ class EngineCore:
             seq.committed_pages += 1
 
     def _emit(self, seq: Sequence, token: int) -> tuple[Sequence, EngineOutput]:
+        return self._emit_many(seq, [token])
+
+    def _emit_many(self, seq: Sequence, tokens: list[int]) -> tuple[Sequence, EngineOutput]:
         reason = seq.check_stop(self._eos, self.config.max_seq_len)
-        if reason is not None:
+        if reason is not None and not seq.is_finished:
             self._finish(seq, reason)
         out = EngineOutput(
-            token_ids=[token],
+            token_ids=tokens,
             finish_reason=seq.finish_reason,
             cumulative_tokens=seq.num_generated,
             prompt_tokens=seq.num_prompt if seq.finish_reason else None,

@@ -102,11 +102,45 @@ class ModelRunner:
 
         self._step_fn = _step
 
+        @functools.partial(jax.jit, static_argnames=("num_steps",), donate_argnums=(1, 2))
+        def _multi_step(params, k_cache, v_cache, tokens, positions, block_tables,
+                        temperature, top_k, top_p, seeds, sample_steps, *, num_steps):
+            """``num_steps`` fused decode iterations in one dispatch.
+
+            The sampled token of step i is step i+1's input; slot mapping is
+            derived in-graph from positions and block tables (pages must be
+            pre-allocated to cover positions + num_steps). Returns the sampled
+            tokens [num_steps, B] — one host round-trip per burst, not per
+            token, which is what decode throughput on a remote/tunneled chip
+            lives or dies by.
+            """
+            ps = self.page_size
+            zeros = jnp.zeros_like(tokens)
+
+            def body(carry, _):
+                tok, pos, kc, vc, cnt = carry
+                page = jnp.take_along_axis(block_tables, (pos // ps)[:, None], axis=1)[:, 0]
+                slot = page * ps + pos % ps
+                logits, kc, vc = self._forward(
+                    params, self.cfg, tok[:, None], pos[:, None], kc, vc,
+                    block_tables, slot[:, None], zeros, attn_impl=self.attn_impl,
+                )
+                keys = jax.vmap(lambda s, c: jax.random.fold_in(jax.random.PRNGKey(s), c))(seeds, cnt)
+                nxt = sample_tokens(logits, keys, temperature, top_k, top_p)
+                return (nxt, pos + 1, kc, vc, cnt + 1), nxt
+
+            (_, _, k_cache, v_cache, _), toks = jax.lax.scan(
+                body, (tokens, positions, k_cache, v_cache, sample_steps), None, length=num_steps
+            )
+            return toks, k_cache, v_cache
+
+        self._multi_step_fn = _multi_step
+
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def _write_page(k_cache, v_cache, k, v, pid):
             return (
-                k_cache.at[:, pid].set(k.astype(k_cache.dtype)),
-                v_cache.at[:, pid].set(v.astype(v_cache.dtype)),
+                k_cache.at[:, :, pid].set(k.astype(k_cache.dtype)),
+                v_cache.at[:, :, pid].set(v.astype(v_cache.dtype)),
             )
 
         self._write_page_fn = _write_page
@@ -114,10 +148,10 @@ class ModelRunner:
     # -- tier access (block manager offload/onboard) -----------------------
 
     def read_page(self, page_id: int) -> tuple[np.ndarray, np.ndarray]:
-        """Device->host copy of one page: ([L, ps, kv, hd], [L, ps, kv, hd])."""
+        """Device->host copy of one page: ([L, kv, ps, hd], [L, kv, ps, hd])."""
         return (
-            np.asarray(self.k_cache[:, page_id]),
-            np.asarray(self.v_cache[:, page_id]),
+            np.asarray(self.k_cache[:, :, page_id]),
+            np.asarray(self.v_cache[:, :, page_id]),
         )
 
     def write_page(self, page_id: int, k: np.ndarray, v: np.ndarray) -> None:
@@ -192,6 +226,32 @@ class ModelRunner:
             put(padded.seeds), put(padded.sample_steps),
         )
         return np.asarray(next_tokens)[:b_real]
+
+    def multi_step(self, batch: StepBatch, num_steps: int) -> np.ndarray:
+        """Fused decode burst; returns sampled tokens i32[B_real, num_steps].
+
+        ``batch`` must be a decode batch (T == 1) whose block tables cover
+        positions + num_steps.
+        """
+        assert batch.tokens.shape[1] == 1, "multi_step is decode-only"
+        b_real = batch.batch_size
+        padded = self._pad(batch)
+        if self.mesh is not None:
+            from dynamo_tpu.parallel.sharding import batch_sharding
+
+            def put(a):
+                return jax.device_put(a, batch_sharding(self.mesh, a.ndim))
+        else:
+            put = jnp.asarray
+        toks, self.k_cache, self.v_cache = self._multi_step_fn(
+            self.params, self.k_cache, self.v_cache,
+            put(padded.tokens[:, 0]), put(padded.positions[:, 0]),
+            put(padded.block_tables), put(padded.temperature),
+            put(padded.top_k), put(padded.top_p),
+            put(padded.seeds), put(padded.sample_steps),
+            num_steps=num_steps,
+        )
+        return np.asarray(toks).T[:b_real]  # [B, num_steps]
 
     def cache_memory_bytes(self) -> int:
         return int(self.k_cache.nbytes + self.v_cache.nbytes)

@@ -57,10 +57,13 @@ class WorkerSpec:
             context_length=min(mc.max_position, 4096),
             eos_token_ids=sorted(load_tokenizer(tokenizer).eos_token_ids),
         )
+        import os
+
         ecfg = EngineConfig(
             max_seq_len=card.context_length,
             eos_token_ids=tuple(card.eos_token_ids),
             page_size=card.kv_page_size,
+            decode_steps=int(os.environ.get("DYNAMO_DECODE_STEPS", "1")),
             **engine_kw,
         )
         return cls(model_config=mc, card=card, engine_config=ecfg)
@@ -98,8 +101,14 @@ async def serve_worker(
     spec: WorkerSpec,
     *,
     lease=None,
+    disagg=None,  # disagg.DisaggConfig: serve as a disaggregated *decode* worker
 ) -> JaxEngineService:
-    """Serve the engine + KV event stream + metrics and publish the model card."""
+    """Serve the engine + KV event stream + metrics and publish the model card.
+
+    With ``disagg`` set, the worker also serves the KV transfer endpoint and
+    fronts its engine with the disagg operator (remote prefill via the
+    prefill queue; see dynamo_tpu.disagg).
+    """
     from dynamo_tpu.router.events import KV_EVENTS_ENDPOINT, KvEventBroadcaster
     from dynamo_tpu.router.metrics import WorkerMetricsPublisher
 
@@ -109,7 +118,26 @@ async def serve_worker(
     broadcaster.bind_snapshot(service.core.allocator.cache_snapshot)
     ns, comp, ep = spec.card.endpoint
     component = runtime.namespace(ns).component(comp)
-    instance = await component.endpoint(ep).serve(service, metadata={"model": spec.card.name}, lease=lease)
+
+    serve_engine: Any = service
+    if disagg is not None:
+        from dynamo_tpu.disagg.operator import DisaggDecodeService
+        from dynamo_tpu.disagg.prefill_worker import PREFILL_QUEUE
+        from dynamo_tpu.disagg.queue import DistributedQueue
+        from dynamo_tpu.disagg.router import DisaggRouter
+        from dynamo_tpu.disagg.transfer import KV_TRANSFER_ENDPOINT, KvTransferService
+
+        transfer = KvTransferService(service.core)
+        t_inst = await component.endpoint(KV_TRANSFER_ENDPOINT).serve(
+            transfer, metadata={"model": spec.card.name}, lease=lease
+        )
+        disagg_router = await DisaggRouter(disagg, page_size=spec.engine_config.page_size).watch(runtime, ns)
+        serve_engine = DisaggDecodeService(
+            service, transfer, DistributedQueue(runtime, PREFILL_QUEUE), disagg_router, t_inst.address
+        )
+        service.aux.append(disagg_router)
+
+    instance = await component.endpoint(ep).serve(serve_engine, metadata={"model": spec.card.name}, lease=lease)
     await component.endpoint(KV_EVENTS_ENDPOINT).serve(broadcaster, metadata={"model": spec.card.name}, lease=lease)
     service.core.config.worker_id = instance.lease_id  # same object as spec.engine_config
 
@@ -121,12 +149,23 @@ async def serve_worker(
     publisher = await WorkerMetricsPublisher(
         runtime, ns, comp, instance.lease_id, snapshot, interval=0.5, lease=lease
     ).start()
-    service.aux = [publisher]  # closed with the service by callers that track it
+    service.aux.append(publisher)  # closed with the service by callers that track it
     card_lease = lease or await runtime.primary_lease()
     await runtime.store.put(
         spec.card.instance_key(instance.lease_id), spec.card.to_bytes(), lease_id=card_lease.id
     )
     logger.info("worker serving %s as instance %x", spec.card.name, instance.lease_id)
+    return service
+
+
+async def serve_prefill_worker(runtime: DistributedRuntime, spec: WorkerSpec, *, lease=None):
+    """A prefill-fleet worker: engine + queue consumer, no model card."""
+    from dynamo_tpu.disagg.prefill_worker import PrefillWorker
+
+    service = await build_engine_service(spec)
+    worker = await PrefillWorker(runtime, service).start()
+    service.aux.append(worker)
+    logger.info("prefill worker up for %s", spec.card.name)
     return service
 
 
@@ -151,15 +190,19 @@ async def run_local(
     host: str = "127.0.0.1",
     port: int = 8080,
     num_workers: int = 1,
+    num_prefill_workers: int = 0,
     router_mode: str = "round_robin",
+    disagg=None,  # DisaggConfig: enables the disaggregated topology
     **engine_kw: Any,
 ) -> dict[str, Any]:
-    """Single-process serving: N engine workers + frontend on one runtime."""
+    """Single-process serving: N (decode) workers [+ M prefill workers] + frontend."""
     runtime = DistributedRuntime.detached()
     services = []
     g2_blocks = engine_kw.pop("g2_blocks", 0)
     g3_blocks = engine_kw.pop("g3_blocks", 0)
-    for i in range(num_workers):
+    total_workers = num_workers + num_prefill_workers
+
+    def make_spec(i: int) -> WorkerSpec:
         spec = WorkerSpec.from_preset(preset, **engine_kw)
         spec.card.router_mode = router_mode
         if g2_blocks or g3_blocks:
@@ -170,13 +213,25 @@ async def run_local(
                 g3_capacity_blocks=g3_blocks,
                 g3_path=f"/tmp/dynamo_tpu_g3_w{i}",
             )
+        return spec
+
+    for i in range(num_workers):
         # Each worker needs its own lease/instance: secondary leases per worker.
-        lease = await runtime.secondary_lease() if num_workers > 1 else None
-        service = await serve_worker(runtime, spec, lease=lease)
+        lease = await runtime.secondary_lease() if total_workers > 1 else None
+        service = await serve_worker(runtime, make_spec(i), lease=lease, disagg=disagg)
+        services.append(service)
+    for i in range(num_prefill_workers):
+        lease = await runtime.secondary_lease() if total_workers > 1 else None
+        service = await serve_prefill_worker(runtime, make_spec(num_workers + i), lease=lease)
         services.append(service)
 
     async def clear_all() -> int:
-        return sum(s.core.allocator.clear_cache() for s in services)
+        n = 0
+        for s in services:
+            n += s.core.allocator.clear_cache()
+            if s.core.block_manager is not None:
+                n += s.core.block_manager.clear()
+        return n
 
     http, watcher, actual_port = await serve_frontend(
         runtime, host=host, port=port, clear_kv_hook=clear_all
@@ -190,13 +245,65 @@ async def run_local(
     }
 
 
+async def run_role(args: argparse.Namespace) -> None:
+    """Multi-process deployment: one process per role, joined via the TCP
+    store (``--serve-store`` in exactly one process, ``--store`` elsewhere)."""
+    from dynamo_tpu.runtime.store_server import StoreClient, StoreServer
+    from dynamo_tpu.runtime.tcp import TcpTransport
+
+    store_server = None
+    if args.serve_store_port is not None:
+        store_server = await StoreServer(host=args.host, port=args.serve_store_port).start()
+        store = store_server.store
+    else:
+        if not args.store:
+            raise SystemExit("--role requires --store tcp://host:port (or --serve-store-port)")
+        store = StoreClient.from_url(args.store)
+    runtime = DistributedRuntime(store, TcpTransport(host=args.host))
+
+    disagg = None
+    if args.disagg_threshold is not None:
+        from dynamo_tpu.disagg.router import DisaggConfig
+
+        disagg = DisaggConfig(max_local_prefill_length=args.disagg_threshold)
+
+    if args.role == "frontend":
+        _, _, port = await serve_frontend(runtime, host=args.host, port=args.http_port)
+        logger.info("frontend ready on port %d", port)
+    elif args.role == "worker":
+        spec = WorkerSpec.from_preset(args.model, num_pages=args.num_pages, max_batch_size=args.max_batch_size)
+        spec.card.router_mode = args.router_mode
+        await serve_worker(runtime, spec, disagg=disagg)
+        logger.info("worker ready")
+    elif args.role == "prefill":
+        spec = WorkerSpec.from_preset(args.model, num_pages=args.num_pages, max_batch_size=args.max_batch_size)
+        await serve_prefill_worker(runtime, spec)
+        logger.info("prefill worker ready")
+    elif args.role == "store":
+        logger.info("store-only process")
+    else:
+        raise SystemExit(f"unknown role {args.role!r}")
+    print(f"READY role={args.role}", flush=True)
+    await asyncio.Event().wait()
+
+
 async def _amain(args: argparse.Namespace) -> None:
+    if args.role != "local":
+        await run_role(args)
+        return
+    disagg = None
+    if args.disagg_threshold is not None:
+        from dynamo_tpu.disagg.router import DisaggConfig
+
+        disagg = DisaggConfig(max_local_prefill_length=args.disagg_threshold)
     handles = await run_local(
         args.model,
         host=args.host,
         port=args.http_port,
         num_workers=args.workers,
+        num_prefill_workers=args.prefill_workers,
         router_mode=args.router_mode,
+        disagg=disagg,
         num_pages=args.num_pages,
         max_batch_size=args.max_batch_size,
         g2_blocks=args.g2_blocks,
@@ -220,6 +327,17 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--router-mode", default="round_robin", choices=["round_robin", "random", "kv"])
     parser.add_argument("--g2-blocks", type=int, default=0, help="host-RAM KV tier capacity (blocks); 0 disables")
     parser.add_argument("--g3-blocks", type=int, default=0, help="disk KV tier capacity (blocks); 0 disables")
+    parser.add_argument("--prefill-workers", type=int, default=0, help="disaggregated prefill fleet size")
+    parser.add_argument(
+        "--role", default="local", choices=["local", "frontend", "worker", "prefill", "store"],
+        help="multi-process deployments: run one role per process",
+    )
+    parser.add_argument("--store", default=None, help="tcp://host:port of the deployment's store server")
+    parser.add_argument("--serve-store-port", type=int, default=None, help="run the store server in this process")
+    parser.add_argument(
+        "--disagg-threshold", type=int, default=None,
+        help="prompts longer than this prefill remotely (enables disaggregation)",
+    )
     parser.add_argument(
         "--platform", default=None,
         help="force a jax platform (e.g. 'cpu'); needed because hardware "

@@ -1,0 +1,111 @@
+"""Mocker: a simulated engine worker for router/planner testing at scale.
+
+The reference ships a full vLLM-like simulator (`lib/llm/src/mocker/*`,
+SURVEY.md §2 row 35) so KV routing, metrics, and autoscaling logic can be
+exercised without GPUs. Here the real ``EngineCore`` *is* the scheduler —
+the mocker is just a runner with a timing model instead of a TPU: scheduling,
+paging, prefix cache, preemption, KV events and metrics are all the
+production code paths, so what the router/planner sees is exactly what a
+real fleet emits, at simulated speed.
+
+Timing model: prefill costs ``prefill_us_per_token * new_tokens``; a decode
+step costs ``decode_us_base + decode_us_per_seq * batch``. Generated tokens
+are deterministic per (seed, position) so tests can assert streams.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from dynamo_tpu.engine.core import EngineConfig, EngineCore
+from dynamo_tpu.engine.runner import StepBatch
+from dynamo_tpu.engine.service import JaxEngineService
+
+
+class MockRunner:
+    """Drop-in for ModelRunner: no device, simulated latency."""
+
+    def __init__(
+        self,
+        *,
+        num_pages: int,
+        page_size: int,
+        vocab_size: int = 32000,
+        prefill_us_per_token: float = 50.0,
+        decode_us_base: float = 2000.0,
+        decode_us_per_seq: float = 100.0,
+        seed: int = 0,
+        realtime: bool = True,
+    ) -> None:
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.vocab_size = vocab_size
+        self.prefill_us_per_token = prefill_us_per_token
+        self.decode_us_base = decode_us_base
+        self.decode_us_per_seq = decode_us_per_seq
+        self.seed = seed
+        self.realtime = realtime
+        self.simulated_us = 0.0
+        self._layers, self._kv, self._hd = 1, 1, 8  # page payload shape stub
+
+    def _sleep_us(self, us: float) -> None:
+        self.simulated_us += us
+        if self.realtime and us > 0:
+            time.sleep(us / 1e6)
+
+    def _tokens_for(self, positions: np.ndarray, row_tokens: np.ndarray) -> np.ndarray:
+        # Deterministic pseudo-generation: next token = f(seed, pos, last token).
+        return ((row_tokens.astype(np.int64) * 1103515245 + positions + self.seed) % (self.vocab_size - 2) + 1).astype(
+            np.int32
+        )
+
+    def step(self, batch: StepBatch) -> np.ndarray:
+        b, t = batch.tokens.shape
+        if t > 1:  # prefill
+            new_tokens = int((batch.last_token_index + 1).sum())
+            self._sleep_us(self.prefill_us_per_token * new_tokens)
+        else:
+            self._sleep_us(self.decode_us_base + self.decode_us_per_seq * b)
+        last_tok = batch.tokens[np.arange(b), batch.last_token_index]
+        last_pos = batch.positions[np.arange(b), batch.last_token_index]
+        return self._tokens_for(last_pos, last_tok)
+
+    def multi_step(self, batch: StepBatch, num_steps: int) -> np.ndarray:
+        b = batch.tokens.shape[0]
+        out = np.zeros((b, num_steps), np.int32)
+        tok = batch.tokens[:, 0]
+        pos = batch.positions[:, 0]
+        for i in range(num_steps):
+            self._sleep_us(self.decode_us_base + self.decode_us_per_seq * b)
+            tok = self._tokens_for(pos, tok)
+            out[:, i] = tok
+            pos = pos + 1
+        return out
+
+    # Tier hooks: payload-free stubs (pair with NullStorage tiers).
+    def read_page(self, page_id: int):
+        shape = (self._layers, self._kv, self.page_size, self._hd)
+        return np.zeros(shape, np.float32), np.zeros(shape, np.float32)
+
+    def write_page(self, page_id: int, k, v) -> None:
+        pass
+
+    def cache_memory_bytes(self) -> int:
+        return 0
+
+
+def build_mock_core(
+    config: EngineConfig | None = None,
+    *,
+    on_kv_event=None,
+    **runner_kw,
+) -> EngineCore:
+    config = config or EngineConfig(num_pages=1024, page_size=16, max_batch_size=256, max_seq_len=32768)
+    runner = MockRunner(num_pages=config.num_pages, page_size=config.page_size, **runner_kw)
+    return EngineCore(runner, config, on_kv_event=on_kv_event)
+
+
+async def build_mock_service(config: EngineConfig | None = None, **runner_kw) -> JaxEngineService:
+    return await JaxEngineService(build_mock_core(config, **runner_kw)).start()

@@ -85,9 +85,13 @@ def init_params(cfg: ModelConfig, rng: jax.Array | int = 0) -> Params:
 
 
 def init_kv_cache(cfg: ModelConfig, num_pages: int, page_size: int, dtype: jnp.dtype | None = None):
-    """Allocate the paged KV cache: two [L, num_pages, page_size, n_kv, hd] arrays."""
+    """Allocate the paged KV cache: two [L, n_kv, num_pages, page_size, hd] arrays.
+
+    KV-head-major per layer — the native layout of the TPU Pallas
+    paged-attention kernel, so decode reads need no transposition.
+    """
     dt = dtype or param_dtype(cfg)
-    shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim)
+    shape = (cfg.num_layers, cfg.num_kv_heads, num_pages, page_size, cfg.head_dim)
     return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
 
 
@@ -122,7 +126,7 @@ def forward(
     cfg: ModelConfig,
     tokens: jnp.ndarray,  # i32[B, T]
     positions: jnp.ndarray,  # i32[B, T]
-    k_cache: jnp.ndarray,  # [L, num_pages, page_size, n_kv, hd]
+    k_cache: jnp.ndarray,  # [L, n_kv, num_pages, page_size, hd]
     v_cache: jnp.ndarray,
     block_tables: jnp.ndarray,  # i32[B, pages_per_seq]
     slot_mapping: jnp.ndarray,  # i32[B, T]

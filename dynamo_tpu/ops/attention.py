@@ -6,11 +6,13 @@ queries always attend to the *paged* cache (which may hold tokens computed by
 an earlier chunk, an earlier turn, or a different worker after KV migration)
 rather than to an in-flight contiguous K/V tensor.
 
-Layout (per layer): ``k_cache, v_cache: [num_pages, page_size, n_kv, head_dim]``.
-A sequence's pages are listed in its row of ``block_tables: i32[B, pages_per_seq]``;
-absolute token position ``p`` lives at page ``block_tables[b, p // page_size]``,
-offset ``p % page_size``. Page 0 is a reserved null page: padding writes land
-there and it is never allocated to a sequence.
+Layout (per layer): ``k_cache, v_cache: [n_kv, num_pages, page_size, head_dim]``
+— KV-head major, matching the TPU Pallas paged-attention kernel's native
+layout so the hot decode path needs no transposes. A sequence's pages are
+listed in its row of ``block_tables: i32[B, pages_per_seq]``; absolute token
+position ``p`` lives at page ``block_tables[b, p // page_size]``, offset
+``p % page_size``. Page 0 is a reserved null page: padding writes land there
+and it is never allocated to a sequence.
 
 Two implementations:
 
@@ -36,16 +38,16 @@ NEG_INF = -1e30  # large-but-finite: avoids NaN from (-inf) - (-inf) in masked s
 
 
 def gather_pages(cache: jnp.ndarray, block_tables: jnp.ndarray) -> jnp.ndarray:
-    """Gather per-sequence K or V: [pages, ps, kv, hd] x [B, N] -> [B, N*ps, kv, hd]."""
+    """Gather per-sequence K or V: [kv, pages, ps, hd] x [B, N] -> [B, N*ps, kv, hd]."""
     b, n = block_tables.shape
-    gathered = cache[block_tables.reshape(-1)]  # [B*N, ps, kv, hd]
-    ps, kv, hd = cache.shape[1], cache.shape[2], cache.shape[3]
-    return gathered.reshape(b, n * ps, kv, hd)
+    kv, _, ps, hd = cache.shape
+    gathered = cache[:, block_tables.reshape(-1)]  # [kv, B*N, ps, hd]
+    return gathered.reshape(kv, b, n * ps, hd).transpose(1, 2, 0, 3)
 
 
 def paged_attention_reference(
     q: jnp.ndarray,  # [B, T, n_heads, head_dim]
-    k_cache: jnp.ndarray,  # [num_pages, page_size, n_kv, head_dim]
+    k_cache: jnp.ndarray,  # [n_kv, num_pages, page_size, head_dim]
     v_cache: jnp.ndarray,
     block_tables: jnp.ndarray,  # i32[B, pages_per_seq]
     positions: jnp.ndarray,  # i32[B, T] absolute position of each query token
@@ -59,7 +61,8 @@ def paged_attention_reference(
     produce garbage that callers discard (their logits are never gathered).
     """
     b, t, n_heads, head_dim = q.shape
-    n_kv = k_cache.shape[2]
+    n_kv = k_cache.shape[0]
+    group = n_heads // n_kv
     if scale is None:
         scale = head_dim**-0.5
 
@@ -67,23 +70,21 @@ def paged_attention_reference(
     v = gather_pages(v_cache, block_tables)
     s = k.shape[1]
 
-    if n_heads != n_kv:
-        group = n_heads // n_kv
-        k = jnp.repeat(k, group, axis=2)
-        v = jnp.repeat(v, group, axis=2)
-
-    qf = q.astype(jnp.float32) * scale
-    logits = jnp.einsum("bthd,bshd->bhts", qf, k.astype(jnp.float32))
+    # GQA-native: fold query heads as [kv, group] and contract against the
+    # un-repeated KV — no G-times materialization, f32 only as the einsum
+    # accumulation type (no f32 copies of the gathered cache).
+    qg = (q * scale).astype(q.dtype).reshape(b, t, n_kv, group, head_dim)
+    logits = jnp.einsum("btkgd,bskd->bkgts", qg, k, preferred_element_type=jnp.float32)
     key_pos = jnp.arange(s, dtype=jnp.int32)
     mask = key_pos[None, None, :] <= positions[:, :, None]  # [B, T, S]
-    logits = jnp.where(mask[:, None, :, :], logits, NEG_INF)
+    logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
     weights = jax.nn.softmax(logits, axis=-1)
-    out = jnp.einsum("bhts,bshd->bthd", weights, v.astype(jnp.float32))
-    return out.astype(q.dtype)
+    out = jnp.einsum("bkgts,bskd->btkgd", weights.astype(v.dtype), v, preferred_element_type=jnp.float32)
+    return out.reshape(b, t, n_heads, head_dim).astype(q.dtype)
 
 
 def write_kv(
-    k_cache: jnp.ndarray,  # [num_pages, page_size, n_kv, head_dim]
+    k_cache: jnp.ndarray,  # [n_kv, num_pages, page_size, head_dim]
     v_cache: jnp.ndarray,
     new_k: jnp.ndarray,  # [B, T, n_kv, head_dim]
     new_v: jnp.ndarray,
@@ -94,11 +95,13 @@ def write_kv(
     Under jit with donated cache buffers this lowers to an in-place scatter.
     Padding tokens carry slot 0 (the null page) — harmless overlapping writes.
     """
-    num_pages, page_size, n_kv, head_dim = k_cache.shape
-    flat_shape = (num_pages * page_size, n_kv, head_dim)
+    n_kv, num_pages, page_size, head_dim = k_cache.shape
+    flat_shape = (n_kv, num_pages * page_size, head_dim)
     slots = slot_mapping.reshape(-1)
-    kf = k_cache.reshape(flat_shape).at[slots].set(new_k.reshape(-1, n_kv, head_dim).astype(k_cache.dtype))
-    vf = v_cache.reshape(flat_shape).at[slots].set(new_v.reshape(-1, n_kv, head_dim).astype(v_cache.dtype))
+    nk = new_k.reshape(-1, n_kv, head_dim).transpose(1, 0, 2).astype(k_cache.dtype)  # [kv, B*T, hd]
+    nv = new_v.reshape(-1, n_kv, head_dim).transpose(1, 0, 2).astype(v_cache.dtype)
+    kf = k_cache.reshape(flat_shape).at[:, slots].set(nk)
+    vf = v_cache.reshape(flat_shape).at[:, slots].set(nv)
     return kf.reshape(k_cache.shape), vf.reshape(v_cache.shape)
 
 

@@ -1,0 +1,73 @@
+"""Decode-phase paged attention via the TPU Pallas kernel.
+
+Wraps ``jax.experimental.pallas.ops.tpu.paged_attention`` — a public JAX op
+that streams KV pages HBM->VMEM per (sequence, kv-head) with double
+buffering and online softmax, never materializing the gathered K/V the
+reference formulation builds. This is the HBM-bandwidth-bound hot loop of
+serving; the cache layout ([n_kv, pages, page_size, head_dim] per layer) is
+chosen engine-wide to be this kernel's native layout.
+
+Kernel contract (decode, T == 1):
+    q:            [B, n_heads, head_dim]   (pre-scaled here)
+    k/v_pages:    [n_kv, total_pages, page_size, head_dim]
+    lengths:      i32[B]  context length per sequence
+    page_indices: i32[B, pages_per_seq]
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.cache
+def _kernel():
+    from jax.experimental.pallas.ops.tpu.paged_attention import paged_attention
+
+    return paged_attention
+
+
+def decode_attention_supported(q: jnp.ndarray, k_cache: jnp.ndarray) -> bool:
+    """TPU backend, even grouping, and lane-aligned head_dim (the kernel's
+    block shapes need head_dim % 128 == 0; smaller head dims take the XLA
+    gather path until the small-head-dim kernel lands)."""
+    if jax.default_backend() != "tpu":
+        return False
+    n_heads, head_dim = q.shape[2], q.shape[3]
+    n_kv = k_cache.shape[0]
+    return n_heads % n_kv == 0 and head_dim % 128 == 0
+
+
+def _pick_pages_per_block(pages_per_seq: int) -> int:
+    # Largest power-of-two divisor of pages_per_seq, capped at 8: keeps the
+    # per-step VMEM footprint bounded while amortizing DMA issue overhead.
+    for cand in (8, 4, 2, 1):
+        if pages_per_seq % cand == 0:
+            return cand
+    return 1
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,  # [B, 1, n_heads, head_dim]
+    k_cache: jnp.ndarray,  # [n_kv, pages, page_size, head_dim]
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,  # i32[B, pages_per_seq]
+    positions: jnp.ndarray,  # i32[B, 1] — decode token's absolute position
+    *,
+    scale: float,
+) -> jnp.ndarray:
+    b, t, n_heads, head_dim = q.shape
+    assert t == 1, "pallas decode path is T == 1 only"
+    lengths = positions[:, 0] + 1  # context includes the token being decoded
+    q3 = (q[:, 0].astype(jnp.float32) * scale).astype(q.dtype)
+    out = _kernel()(
+        q3,
+        k_cache,
+        v_cache,
+        lengths,
+        block_tables,
+        pages_per_compute_block=_pick_pages_per_block(block_tables.shape[1]),
+    )  # [B, n_heads, head_dim]
+    return out[:, None].astype(q.dtype)

@@ -1,0 +1,27 @@
+"""Planner: the autoscaler for worker fleets.
+
+Observes the metrics plane, predicts near-future load, and resizes the
+prefill/decode fleets through a connector. Mirrors the reference planner
+(`components/planner`, SURVEY.md §2 row 42): load-based and SLA-based
+policies, pluggable load predictors, pre-profiled performance
+interpolation, and local/k8s connectors.
+
+- :mod:`dynamo_tpu.planner.predictor` — constant / moving-average / linear-
+  trend load predictors.
+- :mod:`dynamo_tpu.planner.core` — pure decision logic (testable without a
+  cluster): rates from the metrics plane -> target replica counts.
+- :mod:`dynamo_tpu.planner.connector` — applies targets: in-process worker
+  fleets (tests, single node) or subprocess fleets via the launch CLI.
+"""
+
+from dynamo_tpu.planner.core import Planner, PlannerConfig, WorkerProfile
+from dynamo_tpu.planner.predictor import ConstantPredictor, LinearTrendPredictor, MovingAveragePredictor
+
+__all__ = [
+    "Planner",
+    "PlannerConfig",
+    "WorkerProfile",
+    "ConstantPredictor",
+    "MovingAveragePredictor",
+    "LinearTrendPredictor",
+]

@@ -1,0 +1,156 @@
+"""Planner decision logic: metrics -> target fleet sizes.
+
+Pure and synchronous (the loop/connector wrap it), mirroring the reference's
+`planner_core.py:162-285` structure: observe rates from cumulative worker
+counters, predict next-interval load, divide by per-worker capacity from a
+(profiled) WorkerProfile, correct by observed saturation, clamp to budget,
+and apply hysteresis so the fleet doesn't flap.
+
+SLA mode uses the profile's latency surfaces: pick the smallest fleet whose
+interpolated TTFT/ITL meet the targets at the predicted load — the same
+shape as the reference's pre-deployment profiling + interpolation
+(`perf_interpolation.py`, `profile_sla.py`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from dataclasses import dataclass, field
+from typing import Mapping
+
+from dynamo_tpu.planner.predictor import LinearTrendPredictor
+from dynamo_tpu.protocols.kv import ForwardPassMetrics
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class WorkerProfile:
+    """Per-worker capacity, from the profiler sweep (dynamo_tpu.profiler).
+
+    Latency surfaces are piecewise-linear: points of (load_fraction, seconds).
+    """
+
+    prefill_tokens_per_sec: float = 20000.0
+    decode_tokens_per_sec: float = 2000.0
+    max_concurrent: int = 64
+    ttft_curve: list[tuple[float, float]] = field(default_factory=lambda: [(0.0, 0.05), (1.0, 0.5)])
+    itl_curve: list[tuple[float, float]] = field(default_factory=lambda: [(0.0, 0.01), (1.0, 0.1)])
+
+    @staticmethod
+    def _interp(curve: list[tuple[float, float]], x: float) -> float:
+        if not curve:
+            return 0.0
+        pts = sorted(curve)
+        if x <= pts[0][0]:
+            return pts[0][1]
+        for (x0, y0), (x1, y1) in zip(pts, pts[1:]):
+            if x <= x1:
+                return y0 + (y1 - y0) * (x - x0) / max(x1 - x0, 1e-9)
+        return pts[-1][1]
+
+    def ttft_at(self, load_fraction: float) -> float:
+        return self._interp(self.ttft_curve, load_fraction)
+
+    def itl_at(self, load_fraction: float) -> float:
+        return self._interp(self.itl_curve, load_fraction)
+
+
+@dataclass
+class PlannerConfig:
+    mode: str = "load"  # "load" | "sla"
+    min_workers: int = 1
+    max_workers: int = 8
+    min_prefill_workers: int = 0
+    max_prefill_workers: int = 8
+    target_utilization: float = 0.7  # load mode: keep fleets at this fraction
+    ttft_slo_seconds: float = 0.5  # sla mode
+    itl_slo_seconds: float = 0.05
+    scale_down_headroom: float = 0.3  # hysteresis: only shrink below (target - headroom)
+    interval_seconds: float = 10.0
+
+
+@dataclass
+class PlanDecision:
+    decode_workers: int
+    prefill_workers: int
+    predicted_prefill_tps: float
+    predicted_decode_tps: float
+
+
+class Planner:
+    def __init__(self, config: PlannerConfig, profile: WorkerProfile) -> None:
+        self.config = config
+        self.profile = profile
+        self._prefill_pred = LinearTrendPredictor()
+        self._decode_pred = LinearTrendPredictor()
+        self._last_counters: dict[int, tuple[int, int]] = {}
+        self._last_decision: PlanDecision | None = None
+
+    # -- observation -------------------------------------------------------
+
+    def observe(self, metrics: Mapping[int, ForwardPassMetrics], dt_seconds: float) -> tuple[float, float]:
+        """Feed one scrape; returns (prefill_tps, decode_tps) this interval."""
+        prefill_tokens = decode_tokens = 0
+        for wid, m in metrics.items():
+            last = self._last_counters.get(wid, (0, 0))
+            prefill_tokens += max(0, m.prompt_tokens_total - last[0])
+            decode_tokens += max(0, m.generated_tokens_total - last[1])
+            self._last_counters[wid] = (m.prompt_tokens_total, m.generated_tokens_total)
+        # Drop counters of departed workers.
+        for wid in list(self._last_counters):
+            if wid not in metrics:
+                del self._last_counters[wid]
+        dt = max(dt_seconds, 1e-6)
+        prefill_tps, decode_tps = prefill_tokens / dt, decode_tokens / dt
+        self._prefill_pred.observe(prefill_tps)
+        self._decode_pred.observe(decode_tps)
+        return prefill_tps, decode_tps
+
+    # -- decision ----------------------------------------------------------
+
+    def decide(self, *, disaggregated: bool = True) -> PlanDecision:
+        c, p = self.config, self.profile
+        prefill_tps = self._prefill_pred.predict()
+        decode_tps = self._decode_pred.predict()
+
+        if c.mode == "sla":
+            decode = self._smallest_meeting_slo(decode_tps, p.decode_tokens_per_sec, p.itl_at, c.itl_slo_seconds, c.max_workers)
+            prefill = self._smallest_meeting_slo(prefill_tps, p.prefill_tokens_per_sec, p.ttft_at, c.ttft_slo_seconds, c.max_prefill_workers)
+        else:
+            decode = -(-decode_tps // max(p.decode_tokens_per_sec * c.target_utilization, 1e-6))
+            prefill = -(-prefill_tps // max(p.prefill_tokens_per_sec * c.target_utilization, 1e-6))
+
+        decode = int(min(max(decode, c.min_workers), c.max_workers))
+        prefill = int(min(max(prefill, c.min_prefill_workers), c.max_prefill_workers)) if disaggregated else 0
+
+        # Hysteresis: only scale down when clearly over-provisioned.
+        if self._last_decision is not None:
+            prev = self._last_decision
+            if decode < prev.decode_workers:
+                needed = decode_tps / max(p.decode_tokens_per_sec, 1e-6)
+                if needed > (prev.decode_workers - 1) * (c.target_utilization - c.scale_down_headroom):
+                    decode = prev.decode_workers
+            if prefill < prev.prefill_workers:
+                needed = prefill_tps / max(p.prefill_tokens_per_sec, 1e-6)
+                if needed > (prev.prefill_workers - 1) * (c.target_utilization - c.scale_down_headroom):
+                    prefill = prev.prefill_workers
+
+        decision = PlanDecision(decode, prefill, prefill_tps, decode_tps)
+        self._last_decision = decision
+        return decision
+
+    @staticmethod
+    def _smallest_meeting_slo(load_tps, per_worker_tps, latency_at, slo, max_workers) -> int:
+        for n in range(1, max_workers + 1):
+            frac = load_tps / max(n * per_worker_tps, 1e-6)
+            if frac <= 1.0 and latency_at(frac) <= slo:
+                return n
+        return max_workers
+
+
+@dataclasses.dataclass
+class PlannerLoopStats:
+    iterations: int = 0
+    scale_events: int = 0

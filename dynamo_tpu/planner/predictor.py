@@ -1,0 +1,62 @@
+"""Load predictors: estimate the next interval's request/token rates.
+
+Parity: reference `utils/load_predictor.py:62-106` (Constant / ARIMA /
+Prophet). The heavy statistical models are replaced by a linear-trend fit —
+on the minute-scale horizons autoscalers act on, trend extrapolation
+captures what matters (ramps) without the dependency weight.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+
+class ConstantPredictor:
+    """Predicts the last observation."""
+
+    def __init__(self) -> None:
+        self._last = 0.0
+
+    def observe(self, value: float) -> None:
+        self._last = value
+
+    def predict(self) -> float:
+        return self._last
+
+
+class MovingAveragePredictor:
+    def __init__(self, window: int = 8) -> None:
+        self._values: deque[float] = deque(maxlen=window)
+
+    def observe(self, value: float) -> None:
+        self._values.append(value)
+
+    def predict(self) -> float:
+        return sum(self._values) / len(self._values) if self._values else 0.0
+
+
+class LinearTrendPredictor:
+    """Least-squares linear fit over the window, extrapolated one step.
+
+    Never predicts negative load; falls back to the mean with < 3 samples.
+    """
+
+    def __init__(self, window: int = 12) -> None:
+        self._values: deque[float] = deque(maxlen=window)
+
+    def observe(self, value: float) -> None:
+        self._values.append(value)
+
+    def predict(self) -> float:
+        n = len(self._values)
+        if n == 0:
+            return 0.0
+        if n < 3:
+            return sum(self._values) / n
+        xs = range(n)
+        mean_x = (n - 1) / 2.0
+        mean_y = sum(self._values) / n
+        cov = sum((x - mean_x) * (y - mean_y) for x, y in zip(xs, self._values))
+        var = sum((x - mean_x) ** 2 for x in xs)
+        slope = cov / var if var else 0.0
+        return max(0.0, mean_y + slope * (n - mean_x))

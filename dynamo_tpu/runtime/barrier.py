@@ -1,0 +1,73 @@
+"""Leader/worker rendezvous barrier over the discovery store.
+
+Multi-host engine bring-up (one mesh spanning hosts) needs a rendezvous:
+the leader publishes bootstrap data (mesh coordinates, jax distributed
+initialization address), N workers read it and check in, and everyone
+proceeds once the roster is full. Lease-bound check-ins make the barrier
+crash-safe: a worker dying during rendezvous releases its slot.
+
+Parity: reference `lib/runtime/src/utils/leader_worker_barrier.rs:137,230`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Any
+
+from dynamo_tpu.runtime.component import DistributedRuntime
+
+
+class BarrierTimeout(TimeoutError):
+    pass
+
+
+def _data_key(name: str) -> str:
+    return f"barrier/{name}/data"
+
+
+def _worker_prefix(name: str) -> str:
+    return f"barrier/{name}/workers/"
+
+
+async def leader_barrier(
+    runtime: DistributedRuntime,
+    name: str,
+    data: Any,
+    *,
+    num_workers: int,
+    timeout: float = 60.0,
+) -> None:
+    """Publish ``data`` and wait until ``num_workers`` workers checked in."""
+    lease = await runtime.primary_lease()
+    await runtime.store.put(_data_key(name), json.dumps(data).encode(), lease_id=lease.id)
+    deadline = asyncio.get_event_loop().time() + timeout
+    prefix = _worker_prefix(name)
+    while True:
+        present = await runtime.store.get_prefix(prefix)
+        if len(present) >= num_workers:
+            return
+        if asyncio.get_event_loop().time() > deadline:
+            raise BarrierTimeout(f"barrier {name}: {len(present)}/{num_workers} workers after {timeout}s")
+        await asyncio.sleep(0.05)
+
+
+async def worker_barrier(
+    runtime: DistributedRuntime,
+    name: str,
+    worker_id: str,
+    *,
+    timeout: float = 60.0,
+) -> Any:
+    """Wait for the leader's data, check in, and return the data."""
+    deadline = asyncio.get_event_loop().time() + timeout
+    while True:
+        raw = await runtime.store.get(_data_key(name))
+        if raw is not None:
+            break
+        if asyncio.get_event_loop().time() > deadline:
+            raise BarrierTimeout(f"barrier {name}: no leader data after {timeout}s")
+        await asyncio.sleep(0.05)
+    lease = await runtime.primary_lease()
+    await runtime.store.put(_worker_prefix(name) + worker_id, b"1", lease_id=lease.id)
+    return json.loads(raw)

@@ -1,0 +1,250 @@
+"""The discovery store served over TCP — this deployment's etcd.
+
+One process (typically the frontend) runs ``StoreServer`` around a
+MemoryStore; every other process connects with ``StoreClient``, which
+implements the same ``KeyValueStore`` interface — nothing above the store
+can tell local from remote. Leases live server-side, so a client process
+dying (keep-alives stop) expires its keys exactly like etcd.
+
+Protocol: length-prefixed msgpack frames (runtime.codec). RPCs are
+request/response on a single multiplexed connection (correlation ids);
+watches each hold a dedicated streaming connection.
+
+Parity: reference `transports/etcd.rs` (we speak to our own server instead
+of etcd; an etcd-backed KeyValueStore can be slotted in unchanged when
+available).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.runtime.codec import Frame, FrameType, read_frame, write_frame
+from dynamo_tpu.runtime.discovery import (
+    DEFAULT_LEASE_TTL,
+    KeyValueStore,
+    Lease,
+    MemoryStore,
+    WatchEvent,
+    WatchEventType,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class StoreServer:
+    def __init__(self, store: KeyValueStore | None = None, *, host: str = "0.0.0.0", port: int = 0) -> None:
+        self.store = store if store is not None else MemoryStore()
+        self._host = host
+        self._port = port
+        self._server: asyncio.Server | None = None
+        self._conn_tasks: set[asyncio.Task] = set()
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    async def start(self) -> "StoreServer":
+        if self._server is None:
+            self._server = await asyncio.start_server(self._handle, self._host, self._port)
+            self._port = self._server.sockets[0].getsockname()[1]
+            logger.info("store server on %s:%d", self._host, self._port)
+        return self
+
+    async def _handle(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        task = asyncio.current_task()
+        if task:
+            self._conn_tasks.add(task)
+        watch_task: asyncio.Task | None = None
+        try:
+            while True:
+                frame = await read_frame(reader)
+                if frame is None:
+                    return
+                op = frame.fields.get("op")
+                rid = frame.fields.get("rid")
+                if op == "watch":
+                    # Connection becomes a one-way event stream.
+                    watch_task = asyncio.create_task(
+                        self._stream_watch(writer, frame.fields["prefix"], frame.fields.get("initial", True))
+                    )
+                    continue
+                try:
+                    result = await self._execute(op, frame.fields)
+                    write_frame(writer, FrameType.DATA, rid=rid, p=result)
+                except KeyError as exc:
+                    write_frame(writer, FrameType.ERROR, rid=rid, error=str(exc), kind="key")
+                except Exception as exc:
+                    logger.exception("store op %s failed", op)
+                    write_frame(writer, FrameType.ERROR, rid=rid, error=str(exc), kind="internal")
+                await writer.drain()
+        except (ConnectionResetError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            if watch_task is not None:
+                watch_task.cancel()
+            writer.close()
+            if task:
+                self._conn_tasks.discard(task)
+
+    async def _stream_watch(self, writer: asyncio.StreamWriter, prefix: str, initial: bool) -> None:
+        try:
+            async for event in self.store.watch_prefix(prefix, initial=initial):
+                write_frame(
+                    writer, FrameType.DATA,
+                    p={"type": event.type.value, "key": event.key, "value": event.value},
+                )
+                await writer.drain()
+        except (ConnectionResetError, asyncio.CancelledError):
+            pass
+        except Exception:
+            logger.exception("watch stream failed for %s", prefix)
+
+    async def _execute(self, op: str, f: dict[str, Any]) -> Any:
+        s = self.store
+        if op == "put":
+            await s.put(f["key"], f["value"], lease_id=f.get("lease_id"))
+            return True
+        if op == "put_if_absent":
+            return await s.put_if_absent(f["key"], f["value"], lease_id=f.get("lease_id"))
+        if op == "get":
+            return await s.get(f["key"])
+        if op == "get_prefix":
+            return await s.get_prefix(f["prefix"])
+        if op == "delete":
+            return await s.delete(f["key"])
+        if op == "create_lease":
+            lease = await s.create_lease(f.get("ttl", DEFAULT_LEASE_TTL))
+            return {"id": lease.id, "ttl": lease.ttl}
+        if op == "keep_alive":
+            await s.keep_alive(f["lease_id"])
+            return True
+        if op == "revoke_lease":
+            await s.revoke_lease(f["lease_id"])
+            return True
+        raise ValueError(f"unknown op {op!r}")
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        for t in list(self._conn_tasks):
+            t.cancel()
+
+
+class StoreClient(KeyValueStore):
+    """KeyValueStore speaking the wire protocol. One shared RPC connection
+    (correlated by request id), one dedicated connection per watch."""
+
+    def __init__(self, host: str, port: int) -> None:
+        self._host = host
+        self._port = port
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._pending: dict[int, asyncio.Future] = {}
+        self._rid = itertools.count(1)
+        self._reader_task: asyncio.Task | None = None
+        self._lock = asyncio.Lock()
+        self._watch_writers: list[asyncio.StreamWriter] = []
+
+    @classmethod
+    def from_url(cls, url: str) -> "StoreClient":
+        """tcp://host:port"""
+        rest = url.split("://", 1)[-1]
+        host, port = rest.rsplit(":", 1)
+        return cls(host, int(port))
+
+    async def _ensure(self) -> None:
+        if self._writer is not None and not self._writer.is_closing():
+            return
+        self._reader, self._writer = await asyncio.open_connection(self._host, self._port)
+        self._reader_task = asyncio.create_task(self._read_loop(self._reader))
+
+    async def _read_loop(self, reader: asyncio.StreamReader) -> None:
+        try:
+            while True:
+                frame = await read_frame(reader)
+                if frame is None:
+                    break
+                fut = self._pending.pop(frame.fields.get("rid"), None)
+                if fut is None or fut.done():
+                    continue
+                if frame.type is FrameType.ERROR:
+                    kind = frame.fields.get("kind")
+                    exc: Exception = KeyError(frame.fields.get("error")) if kind == "key" else RuntimeError(
+                        frame.fields.get("error")
+                    )
+                    fut.set_exception(exc)
+                else:
+                    fut.set_result(frame.payload)
+        finally:
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionError("store connection lost"))
+            self._pending.clear()
+
+    async def _call(self, op: str, **fields: Any) -> Any:
+        async with self._lock:
+            await self._ensure()
+            rid = next(self._rid)
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._pending[rid] = fut
+            write_frame(self._writer, FrameType.REQUEST, op=op, rid=rid, **fields)
+            await self._writer.drain()
+        return await fut
+
+    # -- KeyValueStore API -------------------------------------------------
+
+    async def put(self, key: str, value: bytes, lease_id: int | None = None) -> None:
+        await self._call("put", key=key, value=value, lease_id=lease_id)
+
+    async def put_if_absent(self, key: str, value: bytes, lease_id: int | None = None) -> bool:
+        return await self._call("put_if_absent", key=key, value=value, lease_id=lease_id)
+
+    async def get(self, key: str) -> bytes | None:
+        return await self._call("get", key=key)
+
+    async def get_prefix(self, prefix: str) -> dict[str, bytes]:
+        return await self._call("get_prefix", prefix=prefix)
+
+    async def delete(self, key: str) -> bool:
+        return await self._call("delete", key=key)
+
+    async def create_lease(self, ttl: float = DEFAULT_LEASE_TTL) -> Lease:
+        d = await self._call("create_lease", ttl=ttl)
+        return Lease(id=d["id"], ttl=d["ttl"], store=self)
+
+    async def keep_alive(self, lease_id: int) -> None:
+        await self._call("keep_alive", lease_id=lease_id)
+
+    async def revoke_lease(self, lease_id: int) -> None:
+        await self._call("revoke_lease", lease_id=lease_id)
+
+    async def watch_prefix(self, prefix: str, initial: bool = True) -> AsyncIterator[WatchEvent]:
+        reader, writer = await asyncio.open_connection(self._host, self._port)
+        self._watch_writers.append(writer)
+        try:
+            write_frame(writer, FrameType.REQUEST, op="watch", prefix=prefix, initial=initial)
+            await writer.drain()
+            while True:
+                frame = await read_frame(reader)
+                if frame is None:
+                    raise ConnectionError("watch stream closed")
+                p = frame.payload
+                yield WatchEvent(WatchEventType(p["type"]), p["key"], p.get("value"))
+        finally:
+            self._watch_writers.remove(writer)
+            writer.close()
+
+    async def close(self) -> None:
+        if self._reader_task is not None:
+            self._reader_task.cancel()
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+        for w in list(self._watch_writers):
+            w.close()

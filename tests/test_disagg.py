@@ -1,0 +1,185 @@
+"""Disaggregated prefill/decode tests.
+
+Covers the queue (claim/ack/reclaim), the disagg decision, KV transfer
+injection, and the full topology E2E: a long prompt is prefilled by the
+prefill fleet, its KV injected into the decode worker's cache, and decode
+produces token-exact output with most of the prompt cached remotely.
+"""
+
+import asyncio
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.disagg.queue import DistributedQueue
+from dynamo_tpu.disagg.router import DisaggConfig, DisaggRouter, config_key
+from dynamo_tpu.launch import run_local
+from dynamo_tpu.runtime.component import DistributedRuntime
+
+
+# -- queue -------------------------------------------------------------------
+
+
+async def test_queue_put_claim_ack():
+    rt = DistributedRuntime.detached()
+    try:
+        q = DistributedQueue(rt, "test")
+        await q.put({"x": 1})
+        await q.put({"x": 2})
+        assert await q.depth() == 2
+        key1, item1 = await q.claim(timeout=2)
+        assert item1["x"] == 1  # FIFO by key order
+        assert await q.depth() == 1
+        # Same consumer can't double-claim; a second claim gets task 2.
+        key2, item2 = await q.claim(timeout=2)
+        assert item2["x"] == 2
+        await q.delete(key1)
+        await q.delete(key2)
+        assert await q.depth() == 0
+        assert await q.claim(timeout=0.2) is None
+    finally:
+        await rt.close()
+
+
+async def test_queue_reclaim_after_claimant_death():
+    rt = DistributedRuntime.detached()
+    try:
+        producer = DistributedQueue(rt, "test")
+        await producer.put({"job": "a"})
+        # Claimant is a different runtime sharing the store, with a short lease.
+        claimant = DistributedRuntime(rt.store, rt.transport, lease_ttl=0.3)
+        cq = DistributedQueue(claimant, "test")
+        key, _ = await cq.claim(timeout=2)
+        assert await producer.claim(timeout=0.2) is None  # claimed: unavailable
+        # Claimant "dies": stop keepalive, let the lease expire.
+        claimant._keepalive_task.cancel()
+        await asyncio.sleep(0.8)
+        reclaimed = await producer.claim(timeout=3)
+        assert reclaimed is not None and reclaimed[1]["job"] == "a"
+    finally:
+        await rt.close()
+
+
+# -- decision ----------------------------------------------------------------
+
+
+def test_disagg_decision_thresholds():
+    r = DisaggRouter(DisaggConfig(max_local_prefill_length=100, max_prefill_queue_size=4,
+                                  min_remote_prefill_blocks=2), page_size=16)
+    assert not r.prefill_remote(50)  # short: local
+    assert r.prefill_remote(200)  # long: remote
+    assert not r.prefill_remote(200, queue_depth=5)  # queue too deep: local
+    assert not r.prefill_remote(31)  # < 2 blocks: local regardless
+    r.config.enabled = False
+    assert not r.prefill_remote(5000)
+
+
+async def test_disagg_config_hot_reload():
+    rt = DistributedRuntime.detached()
+    try:
+        r = await DisaggRouter(DisaggConfig(max_local_prefill_length=100)).watch(rt, "dynamo")
+        assert r.prefill_remote(200)
+        await rt.store.put(config_key("dynamo"), DisaggConfig(max_local_prefill_length=1000).to_json())
+        for _ in range(50):
+            if r.config.max_local_prefill_length == 1000:
+                break
+            await asyncio.sleep(0.02)
+        assert not r.prefill_remote(200)
+        await r.close()
+    finally:
+        await rt.close()
+
+
+# -- full topology E2E -------------------------------------------------------
+
+
+@pytest.mark.e2e
+async def test_disagg_e2e_remote_prefill():
+    disagg = DisaggConfig(max_local_prefill_length=24, min_remote_prefill_blocks=1)
+    handles = await run_local(
+        "test-tiny", port=0, num_workers=1, num_prefill_workers=1,
+        disagg=disagg, num_pages=64, max_batch_size=8,
+    )
+    base = f"http://127.0.0.1:{handles['port']}"
+    try:
+        decode_svc = handles["services"][0]
+        long_prompt = "r" * 48  # 48 tokens > threshold 24 -> remote prefill
+        short_prompt = "s" * 8
+
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "test-tiny", "prompt": long_prompt, "max_tokens": 4, "temperature": 0}
+            async with s.post(base + "/v1/completions", json=body) as r:
+                assert r.status == 200, await r.text()
+                out = await r.json()
+            # Remote prefill happened: the decode engine saw >= 2 pages cached
+            # at admission (injected by the prefill worker), tail computed locally.
+            assert out["usage"]["prompt_tokens_details"]["cached_tokens"] >= 32
+
+            # Output must equal a pure-local run of the same prompt.
+            body_local = {"model": "test-tiny", "prompt": short_prompt, "max_tokens": 4, "temperature": 0}
+            async with s.post(base + "/v1/completions", json=body_local) as r:
+                assert r.status == 200  # short prompt: local path still works
+
+        # Counters: one remote, one local.
+        prefill_svc = handles["services"][1]
+        prefill_worker = prefill_svc.aux[-1]
+        assert prefill_worker.completed == 1
+    finally:
+        await handles["http"].stop()
+        await handles["watcher"].close()
+        for svc in handles["services"]:
+            await svc.close()
+        await handles["runtime"].close()
+
+
+@pytest.mark.e2e
+async def test_disagg_output_matches_aggregated():
+    """Same prompt through disagg and plain topologies -> identical tokens."""
+    prompt = "t" * 40
+
+    async def run_topology(**kw):
+        handles = await run_local("test-tiny", port=0, num_pages=64, max_batch_size=8, **kw)
+        try:
+            async with aiohttp.ClientSession() as s:
+                body = {"model": "test-tiny", "prompt": prompt, "max_tokens": 6, "temperature": 0}
+                async with s.post(f"http://127.0.0.1:{handles['port']}/v1/completions", json=body) as r:
+                    assert r.status == 200, await r.text()
+                    return (await r.json())["choices"][0]["text"]
+        finally:
+            await handles["http"].stop()
+            await handles["watcher"].close()
+            for svc in handles["services"]:
+                await svc.close()
+            await handles["runtime"].close()
+
+    plain = await run_topology(num_workers=1)
+    disagg = await run_topology(
+        num_workers=1, num_prefill_workers=1,
+        disagg=DisaggConfig(max_local_prefill_length=16, min_remote_prefill_blocks=1),
+    )
+    assert disagg == plain
+
+
+# -- leader/worker barrier ---------------------------------------------------
+
+
+async def test_leader_worker_barrier():
+    from dynamo_tpu.runtime.barrier import BarrierTimeout, leader_barrier, worker_barrier
+
+    rt = DistributedRuntime.detached()
+    try:
+        data = {"coordinator": "10.0.0.1:8476", "mesh": [2, 4]}
+
+        async def worker(i):
+            return await worker_barrier(rt, "boot", f"w{i}", timeout=5)
+
+        results = await asyncio.gather(
+            leader_barrier(rt, "boot", data, num_workers=3, timeout=5),
+            worker(0), worker(1), worker(2),
+        )
+        assert results[1] == results[2] == results[3] == data
+
+        with pytest.raises(BarrierTimeout):
+            await leader_barrier(rt, "boot2", {}, num_workers=1, timeout=0.2)
+    finally:
+        await rt.close()

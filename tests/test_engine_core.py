@@ -216,3 +216,47 @@ def test_reject_too_long_prompt():
     core = make_core()
     seq = core.add_request(greedy_request(list(range(200)), max_tokens=2))
     assert seq.is_finished and seq.finish_reason == FinishReason.LENGTH
+
+
+def make_core_multi(decode_steps, num_pages=64, max_batch=8, **cfg_kw):
+    config = EngineConfig(
+        num_pages=num_pages, page_size=PAGE, max_batch_size=max_batch,
+        max_prefill_tokens=256, max_seq_len=128, decode_steps=decode_steps, **cfg_kw,
+    )
+    runner = ModelRunner(
+        CFG, PARAMS, num_pages=num_pages, page_size=PAGE,
+        max_batch_size=max_batch, prefill_bucket=16, attn_impl="reference",
+    )
+    return EngineCore(runner, config)
+
+
+def test_multi_step_decode_matches_single_step():
+    # Fused 4-step decode bursts must be token-identical to per-step decode.
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7]]
+    core = make_core_multi(decode_steps=4)
+    for p in prompts:
+        core.add_request(greedy_request(p, max_tokens=10))
+    outputs = run_to_completion(core)
+    for i, p in enumerate(prompts):
+        assert outputs[i] == greedy_reference(p, 10), f"seq {i}"
+
+
+def test_multi_step_decode_stop_token_discards_overshoot():
+    prompt = [5, 6, 7]
+    ref = greedy_reference(prompt, 8)
+    stop_at = ref[2]
+    core = make_core_multi(decode_steps=4)
+    core.add_request(greedy_request(prompt, max_tokens=8, stop_token_ids=[stop_at]))
+    outputs = run_to_completion(core)
+    assert outputs[0] == ref[: ref.index(stop_at) + 1]
+    assert outputs["finish"][0] == FinishReason.STOP
+
+
+def test_multi_step_decode_odd_max_tokens():
+    # max_tokens not a multiple of the burst size.
+    prompt = [2, 4, 6]
+    core = make_core_multi(decode_steps=4)
+    core.add_request(greedy_request(prompt, max_tokens=6))
+    outputs = run_to_completion(core)
+    assert outputs[0] == greedy_reference(prompt, 6)
+    assert outputs["finish"][0] == FinishReason.LENGTH
