@@ -67,13 +67,33 @@ class KvBlockManager:
 
     def offload(self, block_hash: int, page_id: int) -> None:
         """Write-through one committed G1 page into G2 (no-op if present)."""
-        if block_hash in self.g2:
+        self.offload_batch([(block_hash, page_id)])
+
+    def offload_batch(self, items: list[tuple[int, int]], *, read_pages=None) -> None:
+        """Write-through many (block_hash, page_id) pairs at once.
+
+        With ``read_pages`` (``list[page_id] -> list[Payload]``) the device
+        reads collapse into one batched gather + one device->host transfer;
+        otherwise falls back to per-page reads.
+        """
+        todo: list[tuple[int, int]] = []
+        seen: set[int] = set()
+        for block_hash, page_id in items:
+            if block_hash in seen or block_hash in self.g2:
+                continue
+            if self.g3 is not None and block_hash in self.g3:
+                continue
+            seen.add(block_hash)
+            todo.append((block_hash, page_id))
+        if not todo:
             return
-        if self.g3 is not None and block_hash in self.g3:
-            return
-        payload = self._read_page(page_id)
-        self.g2.put(block_hash, payload)
-        self.offloaded += 1
+        if read_pages is not None:
+            payloads = read_pages([p for _, p in todo])
+        else:
+            payloads = [self._read_page(p) for _, p in todo]
+        for (block_hash, _), payload in zip(todo, payloads):
+            self.g2.put(block_hash, payload)
+            self.offloaded += 1
 
     # -- onboard path ------------------------------------------------------
 

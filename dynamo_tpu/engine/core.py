@@ -82,6 +82,13 @@ class EngineCore:
         # Cumulative counters for the metrics plane.
         self._prompt_tokens_total = 0
         self._generated_tokens_total = 0
+        # Tier write-through is collected per step and flushed as one batched
+        # device->host read. The async service sets ``defer_offloads`` and
+        # flushes after routing outputs, so token delivery never waits on
+        # offload copies; direct drivers (tests, bench) flush at end of step.
+        self.pending_offloads: list[tuple[int, int]] = []  # (block_hash, page_id)
+        self.defer_offloads = False
+        self._head_stall_steps = 0
 
     # -- request intake ----------------------------------------------------
 
@@ -101,6 +108,18 @@ class EngineCore:
             seq.status = SeqStatus.FINISHED
             seq.finish_reason = FinishReason.LENGTH
             return seq
+        # A prompt needing more pages than the pool holds can never be
+        # scheduled; admitting it would wedge the FIFO head forever.
+        usable_pages = self.config.num_pages - 1  # page 0 is the reserved null page
+        pages_needed = -(-len(request.token_ids) // self.config.page_size)
+        if pages_needed > usable_pages:
+            logger.warning(
+                "rejecting request: prompt needs %d pages, pool holds %d",
+                pages_needed, usable_pages,
+            )
+            seq.status = SeqStatus.FINISHED
+            seq.finish_reason = FinishReason.ERROR
+            return seq
         self.waiting.append(seq)
         return seq
 
@@ -112,13 +131,20 @@ class EngineCore:
 
     def step(self) -> list[tuple[Sequence, EngineOutput]]:
         """Advance the engine by one batched forward; returns per-seq deltas."""
+        # Pending offloads must be read before allocate() can evict their
+        # pages (deferred-mode safety; no-op when the service already flushed).
+        self.flush_offloads()
         cancelled = self._reap_cancelled()
         prefill = self._schedule_prefill()
         if prefill:
-            return cancelled + self._run_prefill(prefill)
-        if self.running:
-            return cancelled + self._run_decode()
-        return cancelled
+            out = cancelled + self._run_prefill(prefill)
+        elif self.running:
+            out = cancelled + self._run_decode()
+        else:
+            out = cancelled
+        if not self.defer_offloads:
+            self.flush_offloads()
+        return out
 
     def _reap_cancelled(self) -> list[tuple[Sequence, EngineOutput]]:
         out: list[tuple[Sequence, EngineOutput]] = []
@@ -181,6 +207,15 @@ class EngineCore:
                 new_pages = self.allocator.allocate(pages_total - len(matched))
             except OutOfPagesError:
                 self.allocator.release(matched)
+                if not batch and not self.running:
+                    self._head_stall_steps += 1
+                    if self._head_stall_steps % 100 == 1:
+                        logger.warning(
+                            "head-of-queue seq %d cannot allocate %d pages "
+                            "(free %d) with nothing running; stalled %d steps",
+                            seq.seq_id, pages_total - len(matched),
+                            self.allocator.num_free(), self._head_stall_steps,
+                        )
                 break
             self.waiting.popleft()
             if onboard_n:
@@ -228,7 +263,14 @@ class EngineCore:
             page_arr = np.asarray(s.pages, dtype=np.int32)
             slots[i, : len(new)] = page_arr[pos // ps] * ps + pos % ps
             last[i] = len(new) - 1
-        next_tokens = self.runner.step(self._sampling_batch(batch, tokens, positions, block_tables, slots, last))
+        try:
+            next_tokens = self.runner.step(self._sampling_batch(batch, tokens, positions, block_tables, slots, last))
+        except Exception:
+            # Batch seqs were popped from waiting but are not yet in running:
+            # without cleanup here their pages would leak forever.
+            for s in batch:
+                self._finish(s, FinishReason.ERROR)
+            raise
         outputs: list[tuple[Sequence, EngineOutput]] = []
         for i, s in enumerate(batch):
             self._prompt_tokens_total += max(0, s.num_prompt - s.num_cached)
@@ -279,10 +321,15 @@ class EngineCore:
             block_tables[i, : len(s.pages)] = s.pages
             slots[i, 0] = s.pages[s.num_cached // ps] * ps + s.num_cached % ps
         step_batch = self._sampling_batch(batch, tokens, positions, block_tables, slots, last)
-        if k == 1:
-            next_tokens = self.runner.step(step_batch)[:, None]
-        else:
-            next_tokens = self.runner.multi_step(step_batch, k)  # [B, k]
+        try:
+            if k == 1:
+                next_tokens = self.runner.step(step_batch)[:, None]
+            else:
+                next_tokens = self.runner.multi_step(step_batch, k)  # [B, k]
+        except Exception:
+            for s in batch:
+                self._finish(s, FinishReason.ERROR)
+            raise
         outputs = []
         for i, s in enumerate(batch):
             accepted: list[int] = []
@@ -327,8 +374,31 @@ class EngineCore:
             blk = blocks[idx]
             newly_cached = self.allocator.commit(seq.pages[idx], blk.block_hash, blk.parent_hash, blk.tokens)
             if newly_cached and self.block_manager is not None:
-                self.block_manager.offload(blk.block_hash, seq.pages[idx])
+                # Deferred: the device->host read happens in flush_offloads(),
+                # batched, after the step's outputs have been routed.
+                self.pending_offloads.append((blk.block_hash, seq.pages[idx]))
             seq.committed_pages += 1
+
+    def flush_offloads(self) -> None:
+        """Write-through pending committed pages to the capacity tiers.
+
+        Called by the service between engine steps (same single-writer
+        thread ordering, so committed pages are still live); uses the
+        runner's batched multi-page gather when available.
+        """
+        if self.block_manager is None or not self.pending_offloads:
+            self.pending_offloads = []
+            return
+        items, self.pending_offloads = self.pending_offloads, []
+        self.block_manager.offload_batch(items, read_pages=getattr(self.runner, "read_pages", None))
+
+    def abort_all(self, reason: FinishReason = FinishReason.ERROR) -> None:
+        """Finish every in-flight sequence (releasing its pages) — used when
+        a step failure leaves device state suspect."""
+        for seq in list(self.running) + list(self.waiting):
+            seq.context.kill()
+            self._finish(seq, reason)
+        self.pending_offloads = []
 
     def _emit(self, seq: Sequence, token: int) -> tuple[Sequence, EngineOutput]:
         return self._emit_many(seq, [token])

@@ -145,6 +145,12 @@ class ModelRunner:
 
         self._write_page_fn = _write_page
 
+        @jax.jit
+        def _gather_pages(k_cache, v_cache, pids):
+            return k_cache[:, :, pids], v_cache[:, :, pids]
+
+        self._gather_pages_fn = _gather_pages
+
     # -- tier access (block manager offload/onboard) -----------------------
 
     def read_page(self, page_id: int) -> tuple[np.ndarray, np.ndarray]:
@@ -153,6 +159,21 @@ class ModelRunner:
             np.asarray(self.k_cache[:, :, page_id]),
             np.asarray(self.v_cache[:, :, page_id]),
         )
+
+    def read_pages(self, page_ids: list[int]) -> list[tuple[np.ndarray, np.ndarray]]:
+        """Batched device->host copy: one gather + one transfer for N pages.
+
+        Page ids are padded to a power-of-two bucket so the jitted gather
+        compiles for a handful of shapes only.
+        """
+        if not page_ids:
+            return []
+        n = len(page_ids)
+        padded = np.zeros(next_pow2(n), np.int32)
+        padded[:n] = page_ids
+        k, v = self._gather_pages_fn(self.k_cache, self.v_cache, jnp.asarray(padded))
+        k_host, v_host = np.asarray(k), np.asarray(v)
+        return [(k_host[:, :, i], v_host[:, :, i]) for i in range(n)]
 
     def write_page(self, page_id: int, k: np.ndarray, v: np.ndarray) -> None:
         """Host->device copy into one page (in place via buffer donation)."""

@@ -33,6 +33,7 @@ class JaxEngineService(AsyncEngine[Any, dict]):
 
     def __init__(self, core: EngineCore) -> None:
         self.core = core
+        core.defer_offloads = True  # we flush after routing outputs (below)
         self.aux: list = []  # companion tasks (metrics publisher, ...) closed with us
         self._intake: asyncio.Queue = asyncio.Queue()
         self._streams: dict[int, asyncio.Queue] = {}
@@ -97,6 +98,13 @@ class JaxEngineService(AsyncEngine[Any, dict]):
                 self._fail_all_streams()
                 continue
             self._route(outputs)
+            # Tier write-through happens after outputs are routed, so token
+            # delivery latency never waits on device->host offload copies.
+            if self.core.pending_offloads:
+                try:
+                    await loop.run_in_executor(None, self.core.flush_offloads)
+                except Exception:
+                    logger.exception("tier offload flush failed (non-fatal)")
 
     def _fail_all_streams(self) -> None:
         from dynamo_tpu.protocols.common import FinishReason
@@ -105,11 +113,9 @@ class JaxEngineService(AsyncEngine[Any, dict]):
             q.put_nowait(EngineOutput(token_ids=[], finish_reason=FinishReason.ERROR))
             q.put_nowait(_SENTINEL)
         self._streams.clear()
-        # Engine state may be inconsistent after a failed step: drop all work.
-        for seq in list(self.core.running) + list(self.core.waiting):
-            seq.context.kill()
-        self.core.running.clear()
-        self.core.waiting.clear()
+        # Engine state may be inconsistent after a failed step: drop all work,
+        # releasing every sequence's pages back to the allocator.
+        self.core.abort_all()
 
     def _route(self, outputs: list[tuple[Sequence, EngineOutput]]) -> None:
         for seq, out in outputs:
@@ -130,11 +136,20 @@ class JaxEngineService(AsyncEngine[Any, dict]):
         out_q: asyncio.Queue = asyncio.Queue()
         await self._intake.put((request, context, out_q))
         self._wake.set()
-        while True:
-            item = await out_q.get()
-            if item is _SENTINEL:
-                return
-            yield item.to_dict()
+        finished = False
+        try:
+            while True:
+                item = await out_q.get()
+                if item is _SENTINEL:
+                    finished = True
+                    return
+                yield item.to_dict()
+        finally:
+            if not finished:
+                # Consumer walked away (generator closed / task cancelled):
+                # stop the sequence so it doesn't decode to max_tokens.
+                context.stop_generating()
+                self._wake.set()
 
     # -- introspection -----------------------------------------------------
 
