@@ -139,25 +139,25 @@ class ModelRunner:
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def _write_page(k_cache, v_cache, k, v, pid):
             return (
-                k_cache.at[:, :, pid].set(k.astype(k_cache.dtype)),
-                v_cache.at[:, :, pid].set(v.astype(v_cache.dtype)),
+                k_cache.at[:, pid].set(k.astype(k_cache.dtype)),
+                v_cache.at[:, pid].set(v.astype(v_cache.dtype)),
             )
 
         self._write_page_fn = _write_page
 
         @jax.jit
         def _gather_pages(k_cache, v_cache, pids):
-            return k_cache[:, :, pids], v_cache[:, :, pids]
+            return k_cache[:, pids], v_cache[:, pids]
 
         self._gather_pages_fn = _gather_pages
 
     # -- tier access (block manager offload/onboard) -----------------------
 
     def read_page(self, page_id: int) -> tuple[np.ndarray, np.ndarray]:
-        """Device->host copy of one page: ([L, kv, ps, hd], [L, kv, ps, hd])."""
+        """Device->host copy of one page: ([L, ps, kv, hd], [L, ps, kv, hd])."""
         return (
-            np.asarray(self.k_cache[:, :, page_id]),
-            np.asarray(self.v_cache[:, :, page_id]),
+            np.asarray(self.k_cache[:, page_id]),
+            np.asarray(self.v_cache[:, page_id]),
         )
 
     def read_pages(self, page_ids: list[int]) -> list[tuple[np.ndarray, np.ndarray]]:
@@ -173,7 +173,7 @@ class ModelRunner:
         padded[:n] = page_ids
         k, v = self._gather_pages_fn(self.k_cache, self.v_cache, jnp.asarray(padded))
         k_host, v_host = np.asarray(k), np.asarray(v)
-        return [(k_host[:, :, i], v_host[:, :, i]) for i in range(n)]
+        return [(k_host[:, i], v_host[:, i]) for i in range(n)]
 
     def write_page(self, page_id: int, k: np.ndarray, v: np.ndarray) -> None:
         """Host->device copy into one page (in place via buffer donation)."""

@@ -6,9 +6,10 @@ Design (TPU-first, not a torch translation):
   forward pass is a ``lax.scan`` over layers. One layer gets traced/compiled
   regardless of depth — compile time is O(1) in ``num_layers`` (matters at
   70B/80-layer scale) and XLA schedules identical per-layer programs.
-- The KV cache is **paged** ([L, num_pages, page_size, n_kv, head_dim]) and
-  flows through the scan carry; each layer reads its slice and writes back via
-  dynamic index updates, which XLA aliases in place under buffer donation.
+- The KV cache is **paged** ([L, num_pages, page_size, n_kv, head_dim],
+  page-major — see ``ops/attention.py``) and flows through the scan carry;
+  each layer reads its slice and writes back via dynamic index updates,
+  which XLA aliases in place under buffer donation.
 - One forward function serves prefill (T>1) and decode (T=1); queries attend
   to the paged cache, so chunked prefill and prefix reuse need no extra code
   path (see ``dynamo_tpu/ops/attention.py``).
@@ -85,13 +86,19 @@ def init_params(cfg: ModelConfig, rng: jax.Array | int = 0) -> Params:
 
 
 def init_kv_cache(cfg: ModelConfig, num_pages: int, page_size: int, dtype: jnp.dtype | None = None):
-    """Allocate the paged KV cache: two [L, n_kv, num_pages, page_size, hd] arrays.
+    """Allocate the paged KV cache: two [L, num_pages, page_size, n_kv * hd] arrays.
 
-    KV-head-major per layer — the native layout of the TPU Pallas
-    paged-attention kernel, so decode reads need no transposition.
+    Page-major per layer with KV heads flattened into the trailing (lane)
+    dimension — one page is a single contiguous ``ps x W`` slab covering all
+    KV heads, the native layout of the Pallas decode kernel (one big DMA per
+    page). Keeping W = n_kv * head_dim as the physical trailing dim makes the
+    array's TPU tiling padding-free even at head_dim 64, and means the
+    kernel, the write scatter, and the gather all address the cache without
+    relayout copies. Ops that need per-head structure reshape *gathered*
+    slices (fresh intermediates XLA can fuse), never the cache itself.
     """
     dt = dtype or param_dtype(cfg)
-    shape = (cfg.num_layers, cfg.num_kv_heads, num_pages, page_size, cfg.head_dim)
+    shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads * cfg.head_dim)
     return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
 
 
@@ -126,7 +133,7 @@ def forward(
     cfg: ModelConfig,
     tokens: jnp.ndarray,  # i32[B, T]
     positions: jnp.ndarray,  # i32[B, T]
-    k_cache: jnp.ndarray,  # [L, n_kv, num_pages, page_size, hd]
+    k_cache: jnp.ndarray,  # [L, num_pages, page_size, n_kv * hd]
     v_cache: jnp.ndarray,
     block_tables: jnp.ndarray,  # i32[B, pages_per_seq]
     slot_mapping: jnp.ndarray,  # i32[B, T]
@@ -140,41 +147,50 @@ def forward(
     engine runner donates the cache buffers so updates happen in place.
     """
     b, t = tokens.shape
+    nl, npages, ps = k_cache.shape[0], k_cache.shape[1], k_cache.shape[2]
     inv_freq = jnp.asarray(rope_frequencies(cfg.head_dim, theta=cfg.rope_theta, scaling=cfg.rope_scaling))
     x = params["embed"][tokens]  # [B, T, D]
 
+    # The stacked cache is kept flat ([L*pages, ps, W]) and every layer
+    # addresses its region with offset indices (page' = li*pages + page).
+    # This keeps cache writes a single in-place scatter on the donated carry
+    # and cache reads a gather — slicing the layer out of the carry
+    # (dynamic_index/update_in_dim) would copy the full multi-MB layer cache
+    # twice per layer per step, which measures ~7 ms/step at 1B scale.
+    kf0 = k_cache.reshape(nl * npages, ps, k_cache.shape[3])
+    vf0 = v_cache.reshape(nl * npages, ps, v_cache.shape[3])
+
     def layer_step(carry, lp):
         x, k_full, v_full, li = carry
-        k_cache_l = jax.lax.dynamic_index_in_dim(k_full, li, axis=0, keepdims=False)
-        v_cache_l = jax.lax.dynamic_index_in_dim(v_full, li, axis=0, keepdims=False)
         h = rms_norm(x, lp["attn_norm"], eps=cfg.rms_eps)
         q = (h @ lp["wq"]).reshape(b, t, cfg.num_heads, cfg.head_dim)
         k = (h @ lp["wk"]).reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
         v = (h @ lp["wv"]).reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
-        k_cache_l, v_cache_l = write_kv(k_cache_l, v_cache_l, k, v, slot_mapping)
-        attn = paged_attention(q, k_cache_l, v_cache_l, block_tables, positions, impl=attn_impl)
+        k_full, v_full = write_kv(k_full, v_full, k, v, slot_mapping + li * (npages * ps))
+        tables_l = block_tables + li * npages
+        attn = paged_attention(q, k_full, v_full, tables_l, positions, impl=attn_impl)
         x = x + attn.reshape(b, t, cfg.q_dim) @ lp["wo"]
         h2 = rms_norm(x, lp["mlp_norm"], eps=cfg.rms_eps)
         mlp = _mlp_moe(lp, h2, cfg) if cfg.is_moe else _mlp_dense(lp, h2)
         x = x + mlp
-        k_full = jax.lax.dynamic_update_index_in_dim(k_full, k_cache_l, li, axis=0)
-        v_full = jax.lax.dynamic_update_index_in_dim(v_full, v_cache_l, li, axis=0)
         return (x, k_full, v_full, li + 1), None
 
-    # Scan over layers with the full paged cache in the carry: each step
-    # reads/writes its layer slice via dynamic indexing, which XLA performs
-    # in place when the runner donates the cache buffers. One layer's program
-    # is traced once — compile time is O(1) in depth.
+    # Scan over layers: one layer's program is traced once — compile time is
+    # O(1) in depth (matters at 70B/80-layer scale).
     (x, k_out, v_out, _), _ = jax.lax.scan(
         layer_step,
-        (x, k_cache, v_cache, jnp.int32(0)),
+        (x, kf0, vf0, jnp.int32(0)),
         params["layers"],
     )
+    k_out = k_out.reshape(k_cache.shape)
+    v_out = v_out.reshape(v_cache.shape)
 
     x = rms_norm(x, params["norm_f"], eps=cfg.rms_eps)
     last = jnp.take_along_axis(x, last_token_index[:, None, None], axis=1)[:, 0]  # [B, D]
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = (last.astype(jnp.float32)) @ head.astype(jnp.float32)  # [B, vocab]
+    # bf16 operands, f32 accumulate: no f32 materialization of the (huge)
+    # embedding matrix per step.
+    logits = jnp.matmul(last, head, preferred_element_type=jnp.float32)  # [B, vocab]
     return logits, k_out, v_out

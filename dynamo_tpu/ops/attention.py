@@ -6,9 +6,14 @@ queries always attend to the *paged* cache (which may hold tokens computed by
 an earlier chunk, an earlier turn, or a different worker after KV migration)
 rather than to an in-flight contiguous K/V tensor.
 
-Layout (per layer): ``k_cache, v_cache: [n_kv, num_pages, page_size, head_dim]``
-— KV-head major, matching the TPU Pallas paged-attention kernel's native
-layout so the hot decode path needs no transposes. A sequence's pages are
+Layout (per layer): ``k_cache, v_cache: [num_pages, page_size, W]`` with
+``W = n_kv * head_dim`` — **page-major, heads flattened into lanes**: one
+page is one contiguous ``page_size x W`` slab covering every KV head. This
+is the native layout of the Pallas decode kernel (``pallas_paged.py``): a
+single large DMA per page (all heads at once) instead of one small DMA per
+(head, page), a 128-lane-aligned padding-free TPU tiling even for head_dim
+64, and no relayout copies anywhere on the hot path (per-head views are
+reshapes of gathered intermediates only). A sequence's pages are
 listed in its row of ``block_tables: i32[B, pages_per_seq]``; absolute token
 position ``p`` lives at page ``block_tables[b, p // page_size]``, offset
 ``p % page_size``. Page 0 is a reserved null page: padding writes land there
@@ -37,17 +42,21 @@ import jax.numpy as jnp
 NEG_INF = -1e30  # large-but-finite: avoids NaN from (-inf) - (-inf) in masked softmax
 
 
-def gather_pages(cache: jnp.ndarray, block_tables: jnp.ndarray) -> jnp.ndarray:
-    """Gather per-sequence K or V: [kv, pages, ps, hd] x [B, N] -> [B, N*ps, kv, hd]."""
+def gather_pages(cache: jnp.ndarray, block_tables: jnp.ndarray, n_kv: int) -> jnp.ndarray:
+    """Gather per-sequence K or V: [pages, ps, W] x [B, N] -> [B, N*ps, kv, hd].
+
+    The per-head split is a reshape of the *gathered* intermediate (layout
+    chosen by XLA, fusable) — never of the cache itself.
+    """
     b, n = block_tables.shape
-    kv, _, ps, hd = cache.shape
-    gathered = cache[:, block_tables.reshape(-1)]  # [kv, B*N, ps, hd]
-    return gathered.reshape(kv, b, n * ps, hd).transpose(1, 2, 0, 3)
+    _, ps, w = cache.shape
+    gathered = cache[block_tables.reshape(-1)]  # [B*N, ps, W]
+    return gathered.reshape(b, n * ps, n_kv, w // n_kv)
 
 
 def paged_attention_reference(
     q: jnp.ndarray,  # [B, T, n_heads, head_dim]
-    k_cache: jnp.ndarray,  # [n_kv, num_pages, page_size, head_dim]
+    k_cache: jnp.ndarray,  # [num_pages, page_size, n_kv * head_dim]
     v_cache: jnp.ndarray,
     block_tables: jnp.ndarray,  # i32[B, pages_per_seq]
     positions: jnp.ndarray,  # i32[B, T] absolute position of each query token
@@ -61,13 +70,13 @@ def paged_attention_reference(
     produce garbage that callers discard (their logits are never gathered).
     """
     b, t, n_heads, head_dim = q.shape
-    n_kv = k_cache.shape[0]
+    n_kv = k_cache.shape[2] // head_dim
     group = n_heads // n_kv
     if scale is None:
         scale = head_dim**-0.5
 
-    k = gather_pages(k_cache, block_tables)  # [B, S, n_kv, hd]
-    v = gather_pages(v_cache, block_tables)
+    k = gather_pages(k_cache, block_tables, n_kv)  # [B, S, n_kv, hd]
+    v = gather_pages(v_cache, block_tables, n_kv)
     s = k.shape[1]
 
     # GQA-native: fold query heads as [kv, group] and contract against the
@@ -84,7 +93,7 @@ def paged_attention_reference(
 
 
 def write_kv(
-    k_cache: jnp.ndarray,  # [n_kv, num_pages, page_size, head_dim]
+    k_cache: jnp.ndarray,  # [num_pages, page_size, n_kv * head_dim]
     v_cache: jnp.ndarray,
     new_k: jnp.ndarray,  # [B, T, n_kv, head_dim]
     new_v: jnp.ndarray,
@@ -94,14 +103,17 @@ def write_kv(
 
     Under jit with donated cache buffers this lowers to an in-place scatter.
     Padding tokens carry slot 0 (the null page) — harmless overlapping writes.
+    Page-major layout makes this a plain row scatter: flat token slot indexes
+    the leading [pages * ps] axis directly; the head flatten touches only the
+    small new_k/new_v activations.
     """
-    n_kv, num_pages, page_size, head_dim = k_cache.shape
-    flat_shape = (n_kv, num_pages * page_size, head_dim)
+    num_pages, page_size, w = k_cache.shape
+    flat_shape = (num_pages * page_size, w)
     slots = slot_mapping.reshape(-1)
-    nk = new_k.reshape(-1, n_kv, head_dim).transpose(1, 0, 2).astype(k_cache.dtype)  # [kv, B*T, hd]
-    nv = new_v.reshape(-1, n_kv, head_dim).transpose(1, 0, 2).astype(v_cache.dtype)
-    kf = k_cache.reshape(flat_shape).at[:, slots].set(nk)
-    vf = v_cache.reshape(flat_shape).at[:, slots].set(nv)
+    nk = new_k.reshape(-1, w).astype(k_cache.dtype)  # [B*T, W]
+    nv = new_v.reshape(-1, w).astype(v_cache.dtype)
+    kf = k_cache.reshape(flat_shape).at[slots].set(nk)
+    vf = v_cache.reshape(flat_shape).at[slots].set(nv)
     return kf.reshape(k_cache.shape), vf.reshape(v_cache.shape)
 
 
