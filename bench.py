@@ -28,7 +28,7 @@ BATCH = int(os.environ.get("BENCH_BATCH", "32"))
 ISL = int(os.environ.get("BENCH_ISL", "512"))
 OSL = int(os.environ.get("BENCH_OSL", "128"))
 TARGET_TOKS = float(os.environ.get("BENCH_TARGET", "8000"))
-DECODE_STEPS = int(os.environ.get("BENCH_DECODE_STEPS", "8"))
+DECODE_STEPS = int(os.environ.get("BENCH_DECODE_STEPS", "16"))
 
 
 def main() -> None:

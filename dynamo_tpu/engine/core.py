@@ -89,6 +89,9 @@ class EngineCore:
         self.pending_offloads: list[tuple[int, int]] = []  # (block_hash, page_id)
         self.defer_offloads = False
         self._head_stall_steps = 0
+        # Pipelined decode: the burst in flight on device, not yet consumed.
+        # (batch snapshot, DeviceTokens handle, burst length)
+        self._inflight: tuple[list[Sequence], object, int] | None = None
 
     # -- request intake ----------------------------------------------------
 
@@ -125,7 +128,7 @@ class EngineCore:
 
     @property
     def has_work(self) -> bool:
-        return bool(self.waiting or self.running)
+        return bool(self.waiting or self.running or self._inflight is not None)
 
     # -- stepping ----------------------------------------------------------
 
@@ -135,13 +138,20 @@ class EngineCore:
         # pages (deferred-mode safety; no-op when the service already flushed).
         self.flush_offloads()
         cancelled = self._reap_cancelled()
+        if self._inflight is not None and (cancelled or self.waiting):
+            # Composition is about to change (new admissions / cancellations):
+            # drain the pipeline before scheduling anything else.
+            out = cancelled + self._drain_inflight()
+            if not self.defer_offloads:
+                self.flush_offloads()
+            return out
         prefill = self._schedule_prefill()
         if prefill:
             out = cancelled + self._run_prefill(prefill)
         elif self.running:
             out = cancelled + self._run_decode()
         else:
-            out = cancelled
+            out = cancelled + self._drain_inflight()
         if not self.defer_offloads:
             self.flush_offloads()
         return out
@@ -285,29 +295,46 @@ class EngineCore:
     # -- decode phase ------------------------------------------------------
 
     def _run_decode(self) -> list[tuple[Sequence, EngineOutput]]:
-        ps = self.config.page_size
         k = max(1, self.config.decode_steps)
-        # Ensure every running sequence has pages for the whole burst; preempt on OOM.
+        if (
+            k > 1
+            and hasattr(self.runner, "multi_step_async")
+            and getattr(self.runner, "mesh", None) is None
+        ):
+            return self._run_decode_pipelined(k)
+        return self._run_decode_sync(k)
+
+    def _ensure_burst_pages(self, horizon: int, *, fail_sole: bool = True) -> Sequence | None:
+        """Give every running sequence pages covering the next ``horizon``
+        tokens; preempt on exhaustion. If the sole remaining sequence cannot
+        fit it is returned — finished with ERROR when ``fail_sole``, left
+        untouched otherwise (the pipelined path must first commit the burst
+        already in flight, which may contain the sequence's legitimate
+        finish)."""
         i = 0
         while i < len(self.running):
             seq = self.running[i]
-            need = seq.pages_needed(ps, k)
+            need = seq.pages_needed(self.config.page_size, horizon)
             if need:
                 try:
                     seq.pages.extend(self.allocator.allocate(need))
                 except OutOfPagesError:
                     victim = self.running[-1]
                     if victim is seq and len(self.running) == 1:
-                        # Sole sequence can't fit: fail it (context outgrew the cache).
-                        self._finish(seq, FinishReason.ERROR)
-                        return [(seq, self._final_output(seq))]
+                        # Sole sequence can't fit: context outgrew the cache.
+                        if fail_sole:
+                            self._finish(seq, FinishReason.ERROR)
+                        return seq
                     self._preempt(victim)
                     continue  # retry same index (list shrank behind us)
             i += 1
-        # Snapshot: _finish() inside _emit() mutates self.running mid-loop.
-        batch = list(self.running)
-        if not batch:
-            return []
+        return None
+
+    def _decode_step_batch(self, batch: list[Sequence], offset: int = 0) -> StepBatch:
+        """Host arrays for a decode burst starting ``offset`` tokens ahead of
+        each sequence's committed state (offset > 0 = chained burst whose
+        input tokens live on device; the host token column is a placeholder)."""
+        ps = self.config.page_size
         b = len(batch)
         n = max(len(s.pages) for s in batch)
         tokens = np.zeros((b, 1), np.int32)
@@ -316,22 +343,27 @@ class EngineCore:
         slots = np.zeros((b, 1), np.int32)
         last = np.zeros(b, np.int32)
         for i, s in enumerate(batch):
-            tokens[i, 0] = s.tokens[s.num_cached]
-            positions[i, 0] = s.num_cached
+            pos = s.num_cached + offset
+            if offset == 0:
+                tokens[i, 0] = s.tokens[s.num_cached]
+            positions[i, 0] = pos
             block_tables[i, : len(s.pages)] = s.pages
-            slots[i, 0] = s.pages[s.num_cached // ps] * ps + s.num_cached % ps
-        step_batch = self._sampling_batch(batch, tokens, positions, block_tables, slots, last)
-        try:
-            if k == 1:
-                next_tokens = self.runner.step(step_batch)[:, None]
-            else:
-                next_tokens = self.runner.multi_step(step_batch, k)  # [B, k]
-        except Exception:
-            for s in batch:
-                self._finish(s, FinishReason.ERROR)
-            raise
+            slots[i, 0] = s.pages[pos // ps] * ps + pos % ps
+        sb = self._sampling_batch(batch, tokens, positions, block_tables, slots, last)
+        if offset:
+            sb.sample_steps += offset  # rng fold-counter continuity across bursts
+        return sb
+
+    def _process_burst_tokens(self, batch: list[Sequence], next_tokens) -> list[tuple[Sequence, EngineOutput]]:
+        """Apply a burst's sampled tokens to the batch's sequences.
+
+        Sequences that left RUNNING while the burst was in flight (cancelled,
+        preempted) are skipped — their sampled tokens are discarded, exactly
+        like post-stop overshoot within a burst."""
         outputs = []
         for i, s in enumerate(batch):
+            if s.status is not SeqStatus.RUNNING:
+                continue
             accepted: list[int] = []
             for tok in next_tokens[i]:
                 s.num_cached += 1
@@ -343,6 +375,103 @@ class EngineCore:
             self._commit_filled_pages(s)
             outputs.append(self._emit_many(s, accepted))
         return outputs
+
+    def _run_decode_sync(self, k: int) -> list[tuple[Sequence, EngineOutput]]:
+        failed = self._ensure_burst_pages(k)
+        if failed is not None:
+            return [(failed, self._final_output(failed))]
+        # Snapshot: _finish() inside _emit() mutates self.running mid-loop.
+        batch = list(self.running)
+        if not batch:
+            return []
+        step_batch = self._decode_step_batch(batch)
+        try:
+            if k == 1:
+                next_tokens = self.runner.step(step_batch)[:, None]
+            else:
+                next_tokens = self.runner.multi_step(step_batch, k)  # [B, k]
+        except Exception:
+            for s in batch:
+                self._finish(s, FinishReason.ERROR)
+            raise
+        return self._process_burst_tokens(batch, next_tokens)
+
+    def _run_decode_pipelined(self, k: int) -> list[tuple[Sequence, EngineOutput]]:
+        """One-burst-deep pipelined decode.
+
+        Burst N+1 is dispatched (with its input tokens chained device-side
+        from burst N's output) *before* burst N's tokens are fetched, so the
+        blocking host round-trip overlaps the next burst's compute. Stop
+        conditions are evaluated one burst late; the page slack and discarded
+        overshoot this costs is the same trade ``decode_steps`` already makes.
+        Any composition change (admission, cancellation, preemption, finish)
+        drains the pipeline first — stale in-flight writes land only in
+        uncommitted or reallocated-after-completion pages, so the prefix
+        cache is never corrupted (device programs execute in dispatch order).
+        """
+        if self._inflight is None:
+            failed = self._ensure_burst_pages(k)
+            if failed is not None:
+                return [(failed, self._final_output(failed))]
+            if not self.running:
+                return []
+            batch = list(self.running)
+            self.runner.reset_chain()
+            try:
+                dev = self.runner.multi_step_async(self._decode_step_batch(batch), k)
+            except Exception:
+                for s in batch:
+                    self._finish(s, FinishReason.ERROR)
+                raise
+            self._inflight = (batch, dev, k)
+            return []  # pipeline fill: outputs arrive next step
+
+        batch, dev, kprev = self._inflight
+        extra: list[tuple[Sequence, EngineOutput]] = []
+        same = len(batch) == len(self.running) and all(
+            a is b for a, b in zip(batch, self.running)
+        )
+        dispatched = False
+        if same:
+            # Don't fail the sole sequence yet: the burst in flight may hold
+            # its legitimate finish (EOS/length) — commit that first below.
+            failed = self._ensure_burst_pages(kprev + k, fail_sole=False)
+            # _ensure_burst_pages may have preempted or failed someone: re-check.
+            same = failed is None and len(batch) == len(self.running) and all(
+                a is b for a, b in zip(batch, self.running)
+            )
+            if same and self.runner.can_chain(len(batch)):
+                try:
+                    dev2 = self.runner.multi_step_async(
+                        self._decode_step_batch(batch, offset=kprev), k, chain=True
+                    )
+                except Exception:
+                    for s in batch:
+                        self._finish(s, FinishReason.ERROR)
+                    raise
+                self._inflight = (batch, dev2, k)
+                dispatched = True
+        if not dispatched:
+            self._inflight = None
+            self.runner.reset_chain()
+        out = extra + self._process_burst_tokens(batch, dev.fetch())
+        # A sole sequence that couldn't extend and wasn't finished by the
+        # burst has truly outgrown the cache — fail it now (sync behavior).
+        if not dispatched and self.running:
+            failed2 = self._ensure_burst_pages(1)
+            if failed2 is not None:
+                out.append((failed2, self._final_output(failed2)))
+        return out
+
+    def _drain_inflight(self) -> list[tuple[Sequence, EngineOutput]]:
+        """Consume the in-flight burst without dispatching another."""
+        if self._inflight is None:
+            return []
+        batch, dev, _k = self._inflight
+        self._inflight = None
+        if hasattr(self.runner, "reset_chain"):
+            self.runner.reset_chain()
+        return self._process_burst_tokens(batch, dev.fetch())
 
     # -- shared helpers ----------------------------------------------------
 
@@ -395,6 +524,9 @@ class EngineCore:
     def abort_all(self, reason: FinishReason = FinishReason.ERROR) -> None:
         """Finish every in-flight sequence (releasing its pages) — used when
         a step failure leaves device state suspect."""
+        self._inflight = None
+        if hasattr(self.runner, "reset_chain"):
+            self.runner.reset_chain()
         for seq in list(self.running) + list(self.waiting):
             seq.context.kill()
             self._finish(seq, reason)

@@ -44,6 +44,7 @@ class WorkerSpec:
     card: ModelDeploymentCard
     engine_config: EngineConfig = field(default_factory=EngineConfig)
     params: Any = None  # model params pytree; random-init if None
+    model_dir: str | None = None  # HF-style checkpoint dir: real weights + tokenizer
     attn_impl: str | None = None
     block_manager_config: Any = None  # blocks.BlockManagerConfig enables G2/G3 tiers
 
@@ -57,16 +58,48 @@ class WorkerSpec:
             context_length=min(mc.max_position, 4096),
             eos_token_ids=sorted(load_tokenizer(tokenizer).eos_token_ids),
         )
+        return cls(model_config=mc, card=card, engine_config=cls._engine_cfg(card, engine_kw))
+
+    @classmethod
+    def from_model_dir(cls, model_dir: str, *, name: str | None = None, **engine_kw: Any) -> "WorkerSpec":
+        """Serve a real HF-style checkpoint directory (config.json +
+        safetensors + tokenizer.json). Weights load at engine build time,
+        directly onto the device/mesh.
+
+        Parity: reference `lib/llm/src/local_model.rs:29-140` (local model
+        resolution into a served card + engine)."""
+        import pathlib
+
+        p = pathlib.Path(model_dir)
+        mc = ModelConfig.from_hf(p / "config.json", name=name or p.name)
+        card = ModelDeploymentCard.from_model_dir(name or p.name, p)
+        return cls(
+            model_config=mc, card=card,
+            engine_config=cls._engine_cfg(card, engine_kw), model_dir=str(p),
+        )
+
+    @staticmethod
+    def _engine_cfg(card: ModelDeploymentCard, engine_kw: dict) -> EngineConfig:
         import os
 
-        ecfg = EngineConfig(
+        return EngineConfig(
             max_seq_len=card.context_length,
             eos_token_ids=tuple(card.eos_token_ids),
             page_size=card.kv_page_size,
             decode_steps=int(os.environ.get("DYNAMO_DECODE_STEPS", "1")),
             **engine_kw,
         )
-        return cls(model_config=mc, card=card, engine_config=ecfg)
+
+
+def make_worker_spec(model: str, **engine_kw: Any) -> WorkerSpec:
+    """Resolve ``model``: a preset name, or a path to an HF checkpoint dir."""
+    import os
+
+    if model in PRESETS:
+        return WorkerSpec.from_preset(model, **engine_kw)
+    if os.path.isdir(model):
+        return WorkerSpec.from_model_dir(model, **engine_kw)
+    raise ValueError(f"unknown model {model!r}: not a preset ({', '.join(PRESETS)}) or a directory")
 
 
 async def build_engine_service(spec: WorkerSpec, *, on_kv_event=None) -> JaxEngineService:
@@ -74,7 +107,14 @@ async def build_engine_service(spec: WorkerSpec, *, on_kv_event=None) -> JaxEngi
         # Device work (param init, cache allocation) can take seconds on a
         # remote/real chip — keep it off the event loop so lease keep-alives
         # and health endpoints stay live.
-        params = spec.params if spec.params is not None else llama.init_params(spec.model_config, 0)
+        if spec.params is not None:
+            params = spec.params
+        elif spec.model_dir is not None:
+            from dynamo_tpu.models.loader import load_params
+
+            params = load_params(spec.model_dir, spec.model_config)
+        else:
+            params = llama.init_params(spec.model_config, 0)
         return ModelRunner(
             spec.model_config,
             params,
@@ -203,7 +243,7 @@ async def run_local(
     total_workers = num_workers + num_prefill_workers
 
     def make_spec(i: int) -> WorkerSpec:
-        spec = WorkerSpec.from_preset(preset, **engine_kw)
+        spec = make_worker_spec(preset, **engine_kw)
         spec.card.router_mode = router_mode
         if g2_blocks or g3_blocks:
             from dynamo_tpu.blocks import BlockManagerConfig
@@ -271,12 +311,12 @@ async def run_role(args: argparse.Namespace) -> None:
         _, _, port = await serve_frontend(runtime, host=args.host, port=args.http_port)
         logger.info("frontend ready on port %d", port)
     elif args.role == "worker":
-        spec = WorkerSpec.from_preset(args.model, num_pages=args.num_pages, max_batch_size=args.max_batch_size)
+        spec = make_worker_spec(args.model, num_pages=args.num_pages, max_batch_size=args.max_batch_size)
         spec.card.router_mode = args.router_mode
         await serve_worker(runtime, spec, disagg=disagg)
         logger.info("worker ready")
     elif args.role == "prefill":
-        spec = WorkerSpec.from_preset(args.model, num_pages=args.num_pages, max_batch_size=args.max_batch_size)
+        spec = make_worker_spec(args.model, num_pages=args.num_pages, max_batch_size=args.max_batch_size)
         await serve_prefill_worker(runtime, spec)
         logger.info("prefill worker ready")
     elif args.role == "store":
@@ -318,7 +358,7 @@ async def _amain(args: argparse.Namespace) -> None:
 
 def main(argv: list[str] | None = None) -> None:
     parser = argparse.ArgumentParser(description="dynamo-tpu launcher")
-    parser.add_argument("--model", default="test-tiny", help="model preset name")
+    parser.add_argument("--model", default="test-tiny", help="model preset name or HF checkpoint directory")
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--http-port", type=int, default=8080)
     parser.add_argument("--workers", type=int, default=1)

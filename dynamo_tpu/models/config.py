@@ -82,6 +82,10 @@ class ModelConfig:
             rms_eps=config.get("rms_norm_eps", 1e-5),
             max_position=config.get("max_position_embeddings", 8192),
             tie_embeddings=config.get("tie_word_embeddings", False),
+            num_experts=(n_experts := config.get("num_experts", config.get("num_local_experts", config.get("n_routed_experts", 0))) or 0),
+            num_experts_per_token=config.get("num_experts_per_tok", 0) or 0,
+            # Mixtral stores the expert width in intermediate_size itself.
+            moe_intermediate_size=(config.get("moe_intermediate_size", 0) or 0) or (config["intermediate_size"] if n_experts else 0),
         )
 
 

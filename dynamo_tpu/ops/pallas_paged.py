@@ -10,8 +10,8 @@ contexts is tens of MB of extra HBM traffic per layer per decode step).
 
 Design (fresh, built around the engine's page-major cache layout):
 
-- Cache layout is ``[num_pages, page_size, n_kv, head_dim]`` per layer
-  (``ops/attention.py``): one page is a single contiguous
+- Cache layout is the engine's flat ``[num_pages, page_size, n_kv * head_dim]``
+  per layer (``ops/attention.py``): one page is a single contiguous
   ``page_size * n_kv * head_dim`` slab covering **all KV heads**, so each
   page needs exactly one DMA descriptor (~16 KB for Llama-3.2-1B) instead
   of one small copy per (head, page). DMA-descriptor issue rate, not
@@ -182,16 +182,22 @@ def _decode_kernel(
 
 def decode_supported(q: jnp.ndarray, k_cache: jnp.ndarray) -> bool:
     """Shapes this kernel handles on hardware: even GQA grouping and a
-    128-lane-aligned page slab width (n_kv * head_dim)."""
+    128-lane-aligned page slab width (n_kv * head_dim).
+
+    ``k_cache`` is the engine's flat page-major layout ``[P, page_size, W]``
+    with ``W = n_kv * head_dim`` (``models/llama.py:init_kv_cache``)."""
     n_heads, head_dim = q.shape[-2], q.shape[-1]
-    n_kv = k_cache.shape[2]
-    return n_heads % n_kv == 0 and (n_kv * head_dim) % LANES == 0
+    width = k_cache.shape[2]
+    if width % head_dim != 0:
+        return False
+    n_kv = width // head_dim
+    return n_heads % n_kv == 0 and width % LANES == 0
 
 
 @functools.partial(jax.jit, static_argnames=("scale", "interpret"))
 def paged_decode_attention(
     q: jnp.ndarray,  # [B, 1, n_heads, head_dim]
-    k_cache: jnp.ndarray,  # [P, page_size, n_kv, head_dim] (page-major)
+    k_cache: jnp.ndarray,  # [P, page_size, n_kv * head_dim] (page-major, flat)
     v_cache: jnp.ndarray,
     block_tables: jnp.ndarray,  # i32[B, pages_per_seq]
     positions: jnp.ndarray,  # i32[B, 1] absolute position of the decode token
@@ -199,19 +205,20 @@ def paged_decode_attention(
     scale: float,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """Decode-phase (T == 1) paged attention; returns [B, 1, n_heads, hd]."""
+    """Decode-phase (T == 1) paged attention; returns [B, 1, n_heads, hd].
+
+    Cache layout matches the engine exactly ([P, ps, W] flat slabs), so the
+    layer-stacked cache can be passed as-is with per-layer offset tables."""
     b, t, n_heads, head_dim = q.shape
     assert t == 1, "decode kernel is T == 1 only"
-    num_pages, page_size, n_kv, _ = k_cache.shape
+    num_pages, page_size, width = k_cache.shape
+    n_kv = width // head_dim
     group = n_heads // n_kv
-    width = n_kv * head_dim
     pages_per_seq = block_tables.shape[1]
     ppb = _pages_per_block(pages_per_seq)
     bk = ppb * page_size
 
-    # Free metadata reshapes: page slab with heads flattened into lanes.
-    kf = k_cache.reshape(num_pages, page_size, width)
-    vf = v_cache.reshape(num_pages, page_size, width)
+    kf, vf = k_cache, v_cache
 
     lengths = positions[:, 0] + 1  # history + the token being decoded
 
@@ -269,8 +276,8 @@ def paged_decode_attention(
 
 
 def paged_attention_pallas(
-    q: jnp.ndarray,
-    k_cache: jnp.ndarray,
+    q: jnp.ndarray,  # [B, T, n_heads, head_dim]
+    k_cache: jnp.ndarray,  # [P, page_size, n_kv * head_dim] (flat page-major)
     v_cache: jnp.ndarray,
     block_tables: jnp.ndarray,
     positions: jnp.ndarray,

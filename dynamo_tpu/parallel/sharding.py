@@ -15,7 +15,7 @@ matmuls and partitions QKV/gate/up column-parallel:
 | MoE expert weights  | [L, E, D, F]            | (None, ep, None, tp)        |
 | router              | [L, D, E]               | replicated                  |
 | norms               | [L, D] / [D]            | replicated                  |
-| k/v cache           | [L, pages, ps, kv, hd]  | (None, None, None, tp, None)|
+| k/v cache           | [L, pages, ps, kv*hd]   | (None, None, None, tp)      |
 
 KV-head sharding of the cache matches the head sharding of k/v projections,
 so cache writes and paged-attention gathers are collective-free; GQA requires
@@ -62,8 +62,10 @@ def param_shardings(mesh: Mesh, params: dict[str, Any]) -> dict[str, Any]:
 
 
 def cache_shardings(mesh: Mesh) -> NamedSharding:
-    """Paged KV cache [L, pages, ps, n_kv, hd]: shard KV heads on tp."""
-    return NamedSharding(mesh, P(None, None, None, "tp", None))
+    """Paged KV cache [L, pages, ps, n_kv*hd]: shard the head-major flattened
+    KV-head dim on tp (head h occupies [h*hd, (h+1)*hd), so a tp-split is a
+    contiguous block of whole heads, matching the k/v projection sharding)."""
+    return NamedSharding(mesh, P(None, None, None, "tp"))
 
 
 def batch_sharding(mesh: Mesh, ndim: int) -> NamedSharding:

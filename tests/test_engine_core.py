@@ -39,8 +39,8 @@ def make_core(num_pages=64, max_batch=8, on_kv_event=None, **cfg_kw):
     return EngineCore(runner, config, on_kv_event=on_kv_event)
 
 
-def run_to_completion(core, max_steps=200):
-    outputs = {}
+def run_to_completion(core, max_steps=200, outputs=None):
+    outputs = outputs if outputs is not None else {}
     for _ in range(max_steps):
         if not core.has_work:
             break
@@ -260,3 +260,39 @@ def test_multi_step_decode_odd_max_tokens():
     outputs = run_to_completion(core)
     assert outputs[0] == greedy_reference(prompt, 6)
     assert outputs["finish"][0] == FinishReason.LENGTH
+
+
+def test_pipelined_decode_midstream_admission():
+    # A request admitted while a chained burst is in flight must drain the
+    # pipeline cleanly; both sequences still match the greedy reference.
+    core = make_core_multi(decode_steps=4)
+    p1, p2 = [1, 2, 3, 4, 5], [9, 8, 7]
+    core.add_request(greedy_request(p1, max_tokens=12))
+    # Fill the pipeline (prefill step + first dispatched burst + one chained).
+    outputs = {}
+    for _ in range(3):
+        for seq, out in core.step():
+            outputs.setdefault(seq.seq_id, []).extend(out.token_ids)
+    assert core._inflight is not None
+    core.add_request(greedy_request(p2, max_tokens=12))
+    outputs = run_to_completion(core, outputs=outputs)
+    assert outputs[0] == greedy_reference(p1, 12)
+    assert outputs[1] == greedy_reference(p2, 12)
+
+
+def test_pipelined_decode_cancellation_inflight():
+    core = make_core_multi(decode_steps=4)
+    ctx1, ctx2 = Context(), Context()
+    core.add_request(greedy_request([1, 2, 3], max_tokens=40), ctx1)
+    core.add_request(greedy_request([4, 5, 6], max_tokens=40), ctx2)
+    outputs = {}
+    for _ in range(3):
+        for seq, out in core.step():
+            outputs.setdefault(seq.seq_id, []).extend(out.token_ids)
+    assert core._inflight is not None
+    ctx1.stop_generating()
+    outputs = run_to_completion(core, outputs=outputs)
+    assert outputs["finish"][0] == FinishReason.CANCELLED
+    # The surviving sequence still completes correctly.
+    assert outputs[1] == greedy_reference([4, 5, 6], 40)
+    assert core._inflight is None
