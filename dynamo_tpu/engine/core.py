@@ -427,7 +427,6 @@ class EngineCore:
             return []  # pipeline fill: outputs arrive next step
 
         batch, dev, kprev = self._inflight
-        extra: list[tuple[Sequence, EngineOutput]] = []
         same = len(batch) == len(self.running) and all(
             a is b for a, b in zip(batch, self.running)
         )
@@ -454,7 +453,7 @@ class EngineCore:
         if not dispatched:
             self._inflight = None
             self.runner.reset_chain()
-        out = extra + self._process_burst_tokens(batch, dev.fetch())
+        out = self._process_burst_tokens(batch, dev.fetch())
         # A sole sequence that couldn't extend and wasn't finished by the
         # burst has truly outgrown the cache — fail it now (sync behavior).
         if not dispatched and self.running:

@@ -129,12 +129,12 @@ class ModelRunner:
             self._dp = int(mesh.shape["dp"])
         self.params = params
 
-        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        @functools.partial(jax.jit, static_argnames=("impl",), donate_argnums=(1, 2))
         def _step(params, k_cache, v_cache, tokens, positions, block_tables, slot_mapping,
-                  last_idx, temperature, top_k, top_p, seeds, sample_steps):
+                  last_idx, temperature, top_k, top_p, seeds, sample_steps, *, impl):
             logits, k_cache, v_cache = self._forward(
                 params, self.cfg, tokens, positions, k_cache, v_cache,
-                block_tables, slot_mapping, last_idx, attn_impl=self.attn_impl,
+                block_tables, slot_mapping, last_idx, attn_impl=impl, mesh=self.mesh,
             )
             keys = jax.vmap(lambda s, c: jax.random.fold_in(jax.random.PRNGKey(s), c))(seeds, sample_steps)
             next_tokens = sample_tokens(logits, keys, temperature, top_k, top_p)
@@ -145,7 +145,7 @@ class ModelRunner:
         @functools.partial(jax.jit, static_argnames=("b", "t", "n"), donate_argnums=(1, 2))
         def _step_packed(params, k_cache, v_cache, packed, *, b, t, n):
             args = _unpack(packed, b, t, n)
-            return _step(params, k_cache, v_cache, *args)
+            return _step(params, k_cache, v_cache, *args, impl=self.attn_impl)
 
         self._step_packed_fn = _step_packed
 
@@ -300,6 +300,25 @@ class ModelRunner:
 
     # -- execution ---------------------------------------------------------
 
+    def _select_impl(self, padded: StepBatch) -> str | None:
+        """Pick the attention path for a (mesh-sharded) step.
+
+        Whole-prompt prefills on a mesh with an ``sp`` axis run sequence-
+        parallel ring attention: every sequence's context starts at position
+        0 inside this chunk, so attending only the in-flight K/V is exact.
+        Chunk-continuations and decode use the paged path (they must read
+        the cache)."""
+        t = padded.tokens.shape[1]
+        if (
+            self.mesh is not None
+            and int(self.mesh.shape.get("sp", 1)) > 1
+            and t > 1
+            and t % int(self.mesh.shape["sp"]) == 0
+            and bool((padded.positions[:, 0] == 0).all())
+        ):
+            return "ring"
+        return self.attn_impl
+
     def step(self, batch: StepBatch) -> np.ndarray:
         """Run one forward+sample step; returns sampled token ids i32[B_real]."""
         b_real = batch.batch_size
@@ -317,6 +336,7 @@ class ModelRunner:
                 put(padded.last_token_index), put(padded.temperature),
                 put(padded.top_k), put(padded.top_p),
                 put(padded.seeds), put(padded.sample_steps),
+                impl=self._select_impl(padded),
             )
         else:
             b, t = padded.tokens.shape

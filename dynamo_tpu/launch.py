@@ -47,6 +47,9 @@ class WorkerSpec:
     model_dir: str | None = None  # HF-style checkpoint dir: real weights + tokenizer
     attn_impl: str | None = None
     block_manager_config: Any = None  # blocks.BlockManagerConfig enables G2/G3 tiers
+    # GSPMD execution: a parallel.mesh.MeshPlan, or "auto" to derive one from
+    # the device count and model shape (tp <= kv heads, ep for wide MoE).
+    mesh_plan: Any = None
 
     @classmethod
     def from_preset(cls, preset: str, *, card: ModelDeploymentCard | None = None, **engine_kw: Any) -> "WorkerSpec":
@@ -91,6 +94,21 @@ class WorkerSpec:
         )
 
 
+def _parse_mesh(spec: str | None):
+    """'auto' | 'dp=2,tp=4' | None -> mesh_plan value for WorkerSpec."""
+    if spec is None or spec == "":
+        return None
+    if spec == "auto":
+        return "auto"
+    from dynamo_tpu.parallel.mesh import MeshPlan
+
+    kw = {}
+    for part in spec.split(","):
+        k, _, v = part.partition("=")
+        kw[k.strip()] = int(v)
+    return MeshPlan(**kw)
+
+
 def make_worker_spec(model: str, **engine_kw: Any) -> WorkerSpec:
     """Resolve ``model``: a preset name, or a path to an HF checkpoint dir."""
     import os
@@ -107,12 +125,28 @@ async def build_engine_service(spec: WorkerSpec, *, on_kv_event=None) -> JaxEngi
         # Device work (param init, cache allocation) can take seconds on a
         # remote/real chip — keep it off the event loop so lease keep-alives
         # and health endpoints stay live.
+        mesh = None
+        if spec.mesh_plan is not None:
+            import jax
+
+            from dynamo_tpu.parallel.mesh import MeshPlan, make_mesh
+
+            plan = spec.mesh_plan
+            if plan == "auto":
+                plan = MeshPlan.auto(
+                    len(jax.devices()),
+                    num_kv_heads=spec.model_config.num_kv_heads,
+                    num_experts=spec.model_config.num_experts,
+                )
+            mesh = make_mesh(plan)
         if spec.params is not None:
             params = spec.params
         elif spec.model_dir is not None:
             from dynamo_tpu.models.loader import load_params
 
-            params = load_params(spec.model_dir, spec.model_config)
+            # Direct-to-mesh: each device shard reads its own checkpoint
+            # slice; the runner then skips re-placement of placed params.
+            params = load_params(spec.model_dir, spec.model_config, mesh=mesh)
         else:
             params = llama.init_params(spec.model_config, 0)
         return ModelRunner(
@@ -122,6 +156,7 @@ async def build_engine_service(spec: WorkerSpec, *, on_kv_event=None) -> JaxEngi
             page_size=spec.engine_config.page_size,
             max_batch_size=spec.engine_config.max_batch_size,
             attn_impl=spec.attn_impl,
+            mesh=mesh,
         )
 
     runner = await asyncio.get_running_loop().run_in_executor(None, _build)
@@ -240,11 +275,13 @@ async def run_local(
     services = []
     g2_blocks = engine_kw.pop("g2_blocks", 0)
     g3_blocks = engine_kw.pop("g3_blocks", 0)
+    mesh_plan = engine_kw.pop("mesh", None)
     total_workers = num_workers + num_prefill_workers
 
     def make_spec(i: int) -> WorkerSpec:
         spec = make_worker_spec(preset, **engine_kw)
         spec.card.router_mode = router_mode
+        spec.mesh_plan = mesh_plan
         if g2_blocks or g3_blocks:
             from dynamo_tpu.blocks import BlockManagerConfig
 
@@ -301,6 +338,19 @@ async def run_role(args: argparse.Namespace) -> None:
         store = StoreClient.from_url(args.store)
     runtime = DistributedRuntime(store, TcpTransport(host=args.host))
 
+    if args.num_nodes > 1:
+        # Multi-host worker: rendezvous through the store, then initialize
+        # the global device runtime so the mesh below spans every node.
+        from dynamo_tpu.parallel.multihost import MultiNodeConfig, bringup
+
+        await bringup(
+            MultiNodeConfig(
+                num_nodes=args.num_nodes, node_rank=args.node_rank,
+                leader_addr=args.leader_addr,
+            ),
+            runtime,
+        )
+
     disagg = None
     if args.disagg_threshold is not None:
         from dynamo_tpu.disagg.router import DisaggConfig
@@ -313,10 +363,12 @@ async def run_role(args: argparse.Namespace) -> None:
     elif args.role == "worker":
         spec = make_worker_spec(args.model, num_pages=args.num_pages, max_batch_size=args.max_batch_size)
         spec.card.router_mode = args.router_mode
+        spec.mesh_plan = _parse_mesh(args.mesh)
         await serve_worker(runtime, spec, disagg=disagg)
         logger.info("worker ready")
     elif args.role == "prefill":
         spec = make_worker_spec(args.model, num_pages=args.num_pages, max_batch_size=args.max_batch_size)
+        spec.mesh_plan = _parse_mesh(args.mesh)
         await serve_prefill_worker(runtime, spec)
         logger.info("prefill worker ready")
     elif args.role == "store":
@@ -344,6 +396,7 @@ async def _amain(args: argparse.Namespace) -> None:
         num_prefill_workers=args.prefill_workers,
         router_mode=args.router_mode,
         disagg=disagg,
+        mesh=_parse_mesh(args.mesh),
         num_pages=args.num_pages,
         max_batch_size=args.max_batch_size,
         g2_blocks=args.g2_blocks,
@@ -377,6 +430,16 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument(
         "--disagg-threshold", type=int, default=None,
         help="prompts longer than this prefill remotely (enables disaggregation)",
+    )
+    parser.add_argument(
+        "--mesh", default=None,
+        help="GSPMD mesh: 'auto' or 'dp=2,tp=4,sp=1,ep=1' (default: single device)",
+    )
+    parser.add_argument("--num-nodes", type=int, default=1, help="hosts forming one worker's mesh")
+    parser.add_argument("--node-rank", type=int, default=0)
+    parser.add_argument(
+        "--leader-addr", default=None,
+        help="host:port of the rank-0 jax coordinator (default: rendezvous via the store)",
     )
     parser.add_argument(
         "--platform", default=None,

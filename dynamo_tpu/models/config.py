@@ -33,6 +33,16 @@ class ModelConfig:
     num_experts: int = 0
     num_experts_per_token: int = 0
     moe_intermediate_size: int = 0
+    # Per-expert buffer headroom for the dispatched (expert-parallel) MoE
+    # path; <= 0 means no-drop capacity (exact, memory-heavier).
+    moe_capacity_factor: float = 1.25
+    # Always-on shared expert alongside the routed ones (Qwen2-MoE /
+    # DeepSeek): total hidden width of the shared FFN; 0 disables.
+    shared_expert_size: int = 0
+    # Qwen2-MoE gates the shared expert with sigmoid(x @ g); DeepSeek doesn't.
+    shared_expert_gated: bool = False
+    # Biases on q/k/v projections (Qwen2 family).
+    attention_bias: bool = False
 
     @property
     def q_dim(self) -> int:
@@ -86,6 +96,11 @@ class ModelConfig:
             num_experts_per_token=config.get("num_experts_per_tok", 0) or 0,
             # Mixtral stores the expert width in intermediate_size itself.
             moe_intermediate_size=(config.get("moe_intermediate_size", 0) or 0) or (config["intermediate_size"] if n_experts else 0),
+            # Qwen2-MoE names the width directly; DeepSeek counts experts.
+            shared_expert_size=(config.get("shared_expert_intermediate_size", 0) or 0)
+            or (config.get("n_shared_experts", 0) or 0) * (config.get("moe_intermediate_size", 0) or 0),
+            shared_expert_gated=config.get("model_type") == "qwen2_moe",
+            attention_bias=bool(config.get("attention_bias", config.get("model_type") in ("qwen2", "qwen2_moe"))),
         )
 
 
@@ -120,5 +135,24 @@ PRESETS: dict[str, ModelConfig] = {
         name="llama-3-70b", vocab_size=128256, hidden_size=8192, num_layers=80,
         num_heads=64, num_kv_heads=8, head_dim=128, intermediate_size=28672,
         rope_theta=500000.0, max_position=8192,
+    ),
+    # DeepSeek-R1-Distill-Llama-8B: Llama-3.1-8B architecture (BASELINE
+    # tracked config #2); distilled weights load via the standard Llama map.
+    "deepseek-r1-distill-8b": ModelConfig(
+        name="deepseek-r1-distill-8b", vocab_size=128256, hidden_size=4096,
+        num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
+        intermediate_size=14336, rope_theta=500000.0, max_position=131072,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+                      "high_freq_factor": 4.0, "original_max_position_embeddings": 8192},
+    ),
+    # DeepSeek-V3-shaped wide-EP config (BASELINE tracked config #4):
+    # 256 routed experts / top-8, GQA attention stand-in for MLA (MLA-specific
+    # latent projections are tracked separately; expert-parallel serving is
+    # what this preset exercises — see dynamo_tpu/parallel/moe.py).
+    "deepseek-v3-ep": ModelConfig(
+        name="deepseek-v3-ep", vocab_size=129280, hidden_size=7168,
+        num_layers=61, num_heads=128, num_kv_heads=128, head_dim=64,
+        intermediate_size=18432, rope_theta=10000.0, max_position=163840,
+        num_experts=256, num_experts_per_token=8, moe_intermediate_size=2048,
     ),
 }

@@ -57,6 +57,14 @@ def init_params(cfg: ModelConfig, rng: jax.Array | int = 0) -> Params:
         "wv": w(keys[2], (l, d, kv), d),
         "wo": w(keys[3], (l, q, d), q),
     }
+    if cfg.attention_bias:
+        layers.update(
+            {
+                "bq": jnp.zeros((l, q), dt),
+                "bk": jnp.zeros((l, kv), dt),
+                "bv": jnp.zeros((l, kv), dt),
+            }
+        )
     if cfg.is_moe:
         e, mf = cfg.num_experts, cfg.moe_intermediate_size
         layers.update(
@@ -67,6 +75,17 @@ def init_params(cfg: ModelConfig, rng: jax.Array | int = 0) -> Params:
                 "w_down": w(keys[7], (l, e, mf, d), mf),
             }
         )
+        if cfg.shared_expert_size:
+            fs = cfg.shared_expert_size
+            layers.update(
+                {
+                    "w_shared_gate": w(keys[10], (l, d, fs), d),
+                    "w_shared_up": w(keys[11], (l, d, fs), d),
+                    "w_shared_down": w(keys[9], (l, fs, d), fs),
+                }
+            )
+            if cfg.shared_expert_gated:
+                layers["shared_gate"] = w(keys[8], (l, d, 1), d)
     else:
         layers.update(
             {
@@ -107,14 +126,40 @@ def _mlp_dense(lp: Params, x: jnp.ndarray) -> jnp.ndarray:
     return (gate * (x @ lp["w_up"])) @ lp["w_down"]
 
 
-def _mlp_moe(lp: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
-    """Top-k routed MoE, dense-compute formulation.
+def _mlp_moe(lp: Params, x: jnp.ndarray, cfg: ModelConfig, mesh=None) -> jnp.ndarray:
+    """Top-k routed MoE (``dynamo_tpu/parallel/moe.py``).
 
-    Every token runs every expert and results are mixed by routing weights.
-    Dense einsum keeps shapes static for XLA; for large expert counts the
-    expert-parallel path in ``dynamo_tpu/parallel/moe.py`` (all-to-all over
-    the ``ep`` mesh axis) replaces this with a capacity-based dispatch.
-    """
+    Without an ``ep`` mesh axis: dropless ragged-matmul dispatch — exact,
+    batch-composition-independent (deterministic greedy). With experts
+    sharded over ``ep``: capacity-bounded scatter dispatch, where GSPMD turns
+    the buffer movement into all-to-all over the expert axis."""
+    from dynamo_tpu.parallel.moe import moe_mlp, moe_mlp_dropless
+
+    b, t, d = x.shape
+    xt = x.reshape(b * t, d)
+    ep = int(mesh.shape.get("ep", 1)) if mesh is not None else 1
+    if ep <= 1:
+        out = moe_mlp_dropless(lp, xt, num_experts_per_token=cfg.num_experts_per_token)
+    else:
+        cf = cfg.moe_capacity_factor
+        out = moe_mlp(
+            lp, xt,
+            num_experts_per_token=cfg.num_experts_per_token,
+            capacity_factor=cf,
+            capacity=(b * t * cfg.num_experts_per_token) if cf <= 0 else None,
+        )
+    if cfg.shared_expert_size:
+        shared = (jax.nn.silu(xt @ lp["w_shared_gate"]) * (xt @ lp["w_shared_up"])) @ lp["w_shared_down"]
+        if cfg.shared_expert_gated:
+            shared = shared * jax.nn.sigmoid((xt @ lp["shared_gate"]).astype(jnp.float32)).astype(shared.dtype)
+        out = out + shared
+    return out.reshape(b, t, d)
+
+
+def _mlp_moe_dense(lp: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+    """Dense-compute MoE reference: every token through every expert, mixed
+    by routing weights. O(N*E) FLOPs — kept as the golden model for tests of
+    the dispatched path, never used for serving."""
     b, t, d = x.shape
     xt = x.reshape(b * t, d)
     router_logits = (xt @ lp["router"]).astype(jnp.float32)  # [N, E]
@@ -140,11 +185,18 @@ def forward(
     last_token_index: jnp.ndarray,  # i32[B] index in [0,T) of each seq's last real token
     *,
     attn_impl: str | None = None,
+    mesh=None,  # required when attn_impl == "ring"
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One forward step. Returns (logits f32[B, vocab], k_cache, v_cache).
 
     Works for prefill (T = padded prompt chunk) and decode (T=1) alike; the
     engine runner donates the cache buffers so updates happen in place.
+
+    ``attn_impl="ring"`` runs sequence-parallel ring attention over the
+    mesh's ``sp`` axis (``parallel/ring.py``) for whole-prompt prefills —
+    valid only when every sequence's full context is inside this chunk
+    (positions start at 0, no cached prefix); K/V still write through to the
+    paged cache so decode continues on the paged path.
     """
     b, t = tokens.shape
     nl, npages, ps = k_cache.shape[0], k_cache.shape[1], k_cache.shape[2]
@@ -160,20 +212,35 @@ def forward(
     kf0 = k_cache.reshape(nl * npages, ps, k_cache.shape[3])
     vf0 = v_cache.reshape(nl * npages, ps, v_cache.shape[3])
 
+    ring = attn_impl == "ring"
+    if ring:
+        # Padding tokens (slot 0) must not act as attendable keys in the ring
+        # path (the paged path excludes them structurally via the null page).
+        # A far-future sentinel position hides them from every real query.
+        ring_pos = jnp.where(slot_mapping == 0, jnp.int32(2**30), positions)
+
     def layer_step(carry, lp):
         x, k_full, v_full, li = carry
         h = rms_norm(x, lp["attn_norm"], eps=cfg.rms_eps)
-        q = (h @ lp["wq"]).reshape(b, t, cfg.num_heads, cfg.head_dim)
-        k = (h @ lp["wk"]).reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
-        v = (h @ lp["wv"]).reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+        qp, kp, vp = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]
+        if cfg.attention_bias:
+            qp, kp, vp = qp + lp["bq"], kp + lp["bk"], vp + lp["bv"]
+        q = qp.reshape(b, t, cfg.num_heads, cfg.head_dim)
+        k = kp.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+        v = vp.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
         k_full, v_full = write_kv(k_full, v_full, k, v, slot_mapping + li * (npages * ps))
-        tables_l = block_tables + li * npages
-        attn = paged_attention(q, k_full, v_full, tables_l, positions, impl=attn_impl)
+        if ring:
+            from dynamo_tpu.parallel.ring import ring_attention
+
+            attn = ring_attention(q, k, v, ring_pos, mesh, scale=cfg.head_dim**-0.5)
+        else:
+            tables_l = block_tables + li * npages
+            attn = paged_attention(q, k_full, v_full, tables_l, positions, impl=attn_impl)
         x = x + attn.reshape(b, t, cfg.q_dim) @ lp["wo"]
         h2 = rms_norm(x, lp["mlp_norm"], eps=cfg.rms_eps)
-        mlp = _mlp_moe(lp, h2, cfg) if cfg.is_moe else _mlp_dense(lp, h2)
+        mlp = _mlp_moe(lp, h2, cfg, mesh) if cfg.is_moe else _mlp_dense(lp, h2)
         x = x + mlp
         return (x, k_full, v_full, li + 1), None
 

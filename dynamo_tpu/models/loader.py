@@ -102,6 +102,13 @@ _LAYER_MAP: dict[str, tuple[tuple[str, ...], bool]] = {
     "w_down": (("mlp.down_proj.weight",), True),
 }
 
+# Qwen2-family attention biases.
+_BIAS_MAP: dict[str, tuple[tuple[str, ...], bool]] = {
+    "bq": (("self_attn.q_proj.bias",), False),
+    "bk": (("self_attn.k_proj.bias",), False),
+    "bv": (("self_attn.v_proj.bias",), False),
+}
+
 # MoE per-layer sources. Router: [E, D] in HF -> [D, E]. Experts are stored
 # one tensor per expert; the loader stacks them on an expert axis.
 _MOE_ROUTER = ("mlp.gate.weight", "block_sparse_moe.gate.weight")
@@ -110,6 +117,15 @@ _MOE_EXPERT_MAP: dict[str, tuple[tuple[str, ...], bool]] = {
     "w_up": (("mlp.experts.{e}.up_proj.weight", "block_sparse_moe.experts.{e}.w3.weight"), True),
     "w_down": (("mlp.experts.{e}.down_proj.weight", "block_sparse_moe.experts.{e}.w2.weight"), True),
 }
+
+# Always-on shared expert: Qwen2-MoE (`mlp.shared_expert.*` + sigmoid gate) /
+# DeepSeek (`mlp.shared_experts.*`, ungated).
+_SHARED_EXPERT_MAP: dict[str, tuple[tuple[str, ...], bool]] = {
+    "w_shared_gate": (("mlp.shared_expert.gate_proj.weight", "mlp.shared_experts.gate_proj.weight"), True),
+    "w_shared_up": (("mlp.shared_expert.up_proj.weight", "mlp.shared_experts.up_proj.weight"), True),
+    "w_shared_down": (("mlp.shared_expert.down_proj.weight", "mlp.shared_experts.down_proj.weight"), True),
+}
+_SHARED_GATE = ("mlp.shared_expert_gate.weight",)
 
 
 def _find(index: CheckpointIndex, candidates: tuple[str, ...], li: int, e: int | None = None) -> str:
@@ -194,6 +210,9 @@ def _leaf_specs(index: CheckpointIndex, cfg: ModelConfig, dtype: np.dtype) -> di
     layers: dict[str, Any] = {
         name: simple(suffixes, t) for name, (suffixes, t) in _LAYER_MAP.items() if name not in ("w_gate", "w_up", "w_down")
     }
+    if cfg.attention_bias:
+        for name, (suffixes, t) in _BIAS_MAP.items():
+            layers[name] = simple(suffixes, t)
     moe = cfg.is_moe and any(
         f"model.layers.0.{c}" in index for c in _MOE_ROUTER
     )
@@ -210,6 +229,11 @@ def _leaf_specs(index: CheckpointIndex, cfg: ModelConfig, dtype: np.dtype) -> di
                 dtype,
                 expert_axis=True,
             )
+        if cfg.shared_expert_size:
+            for name, (suffixes, t) in _SHARED_EXPERT_MAP.items():
+                layers[name] = simple(suffixes, t)
+            if cfg.shared_expert_gated:
+                layers["shared_gate"] = simple(_SHARED_GATE, True)
     else:
         for name in ("w_gate", "w_up", "w_down"):
             layers[name] = simple(_LAYER_MAP[name][0], True)
@@ -246,12 +270,33 @@ def _leaf_specs(index: CheckpointIndex, cfg: ModelConfig, dtype: np.dtype) -> di
     return params
 
 
+def _consumed_names(specs: dict, num_layers: int) -> set[str]:
+    """Every checkpoint tensor the spec tree will read."""
+    names: set[str] = set()
+
+    def walk(tree):
+        for leaf in jax.tree.leaves(tree, is_leaf=lambda x: hasattr(x, "shape")):
+            if isinstance(leaf, _LazyLeaf):
+                for li in range(num_layers):
+                    names.update(n for n, _t in leaf.per_layer(li))
+            else:
+                names.add(leaf.name)
+
+    walk(specs)
+    return names
+
+
+# Buffers some exporters serialize that carry no weights.
+_IGNORABLE = ("rotary_emb.inv_freq", "masked_bias", ".attn.bias")
+
+
 def load_params(
     model_dir: str | pathlib.Path,
     cfg: ModelConfig,
     *,
     mesh: jax.sharding.Mesh | None = None,
     dtype: Any | None = None,
+    strict: bool = True,
 ) -> Params:
     """Load a params pytree from an HF-style safetensors checkpoint.
 
@@ -259,6 +304,10 @@ def load_params(
     device shard is read from the checkpoint independently (lazy slices), so
     host memory stays O(largest shard). Without a mesh, leaves land on the
     default device.
+
+    ``strict`` (default) fails on checkpoint tensors the mapping would
+    silently drop — a model whose weights are partially ignored *looks* like
+    a working deployment while generating garbage.
     """
     target_dtype = np.dtype(jnp.dtype(dtype or cfg.dtype).name) if str(dtype or cfg.dtype) != "bfloat16" else jnp.bfloat16
     import ml_dtypes
@@ -266,6 +315,18 @@ def load_params(
     np_dtype = ml_dtypes.bfloat16 if target_dtype == jnp.bfloat16 else np.dtype(target_dtype)
     index = CheckpointIndex(model_dir)
     specs = _leaf_specs(index, cfg, np_dtype)
+    if strict:
+        consumed = _consumed_names(specs, cfg.num_layers)
+        leftover = [
+            n for n in index.keys()
+            if n not in consumed and not any(n.endswith(sfx) for sfx in _IGNORABLE)
+        ]
+        if leftover:
+            raise ValueError(
+                f"checkpoint has {len(leftover)} tensors the {cfg.name!r} mapping would "
+                f"silently drop (first few: {leftover[:6]}); the architecture config and "
+                f"checkpoint disagree — pass strict=False only if this is intentional"
+            )
 
     # _LazyLeaf/_TopLeaf are unregistered types: jax.tree.map sees them as leaves.
     if mesh is None:
@@ -336,13 +397,19 @@ def save_params(
     }
     if cfg.rope_scaling:
         hf_cfg["rope_scaling"] = cfg.rope_scaling
+    hf_cfg["attention_bias"] = cfg.attention_bias
     if cfg.is_moe:
         hf_cfg.update(
-            model_type="qwen2_moe",
+            model_type="qwen2_moe" if cfg.shared_expert_gated or not cfg.shared_expert_size else "deepseek_v2",
             num_experts=cfg.num_experts,
             num_experts_per_tok=cfg.num_experts_per_token,
             moe_intermediate_size=cfg.moe_intermediate_size,
         )
+        if cfg.shared_expert_size:
+            if cfg.shared_expert_gated:
+                hf_cfg["shared_expert_intermediate_size"] = cfg.shared_expert_size
+            else:
+                hf_cfg["n_shared_experts"] = cfg.shared_expert_size // cfg.moe_intermediate_size
     (p / "config.json").write_text(json.dumps(hf_cfg, indent=2))
 
     tensors: dict[str, np.ndarray] = {}
@@ -362,11 +429,20 @@ def save_params(
             if cfg.is_moe and leaf in _MOE_EXPERT_MAP:
                 continue
             put(base + suffixes[0], lp[leaf][li], transpose)
+        if cfg.attention_bias:
+            for leaf, (suffixes, transpose) in _BIAS_MAP.items():
+                put(base + suffixes[0], lp[leaf][li], transpose)
         if cfg.is_moe:
             put(base + _MOE_ROUTER[0], lp["router"][li], True)
             for leaf, (suffixes, transpose) in _MOE_EXPERT_MAP.items():
                 for e in range(cfg.num_experts):
                     put(base + suffixes[0].format(e=e), lp[leaf][li, e], transpose)
+            if cfg.shared_expert_size:
+                src = 0 if cfg.shared_expert_gated else 1
+                for leaf, (suffixes, transpose) in _SHARED_EXPERT_MAP.items():
+                    put(base + suffixes[src], lp[leaf][li], transpose)
+                if cfg.shared_expert_gated:
+                    put(base + _SHARED_GATE[0], lp["shared_gate"][li], True)
 
     from safetensors.numpy import save_file
 

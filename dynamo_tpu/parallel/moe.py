@@ -1,0 +1,128 @@
+"""Expert-parallel MoE dispatch: capacity-bounded scatter/combine.
+
+TPU-native routed-MoE execution, replacing the dense every-token-through-
+every-expert formulation (``models/llama._mlp_moe`` dense path) with the
+standard capacity-based dispatch used by TPU MoE stacks (GShard/Switch
+lineage), expressed so GSPMD turns the data movement into all-to-all
+collectives over the ``ep`` mesh axis:
+
+- Router top-k picks (expert, weight) per token; every (token, choice) pair
+  gets a *position* inside its expert's fixed-capacity buffer via a one-hot
+  cumsum (O(N*k*E), no vocabulary-scale sorts, static shapes throughout).
+- Tokens are **scattered** into ``[E, C, D]`` expert buffers (O(N*k*D) data
+  movement — never the O(N*E*C*D) dispatch-einsum of the original GShard
+  formulation, which is quadratic in tokens at prefill widths).
+- Expert FFNs run as batched matmuls ``[E, C, D] @ [E, D, F]`` — one MXU
+  contraction over all local experts. With ``w_gate/w_up/w_down`` sharded
+  ``P(None, ep, None, tp)`` (see ``parallel/sharding.py``), GSPMD shards the
+  expert axis and inserts the token all-to-all at the scatter/gather
+  boundaries; ICI carries exactly the dispatched tokens.
+- Combine gathers each choice's output row and mixes by routing weight.
+
+Over-capacity tokens are dropped (zero contribution from that choice,
+Switch-style, earlier tokens win); serving engines size ``capacity_factor``
+so drops are measure-zero, and tests use a no-drop capacity to prove
+bit-parity with the dense formulation.
+
+Parity: the reference delegates wide-EP MoE serving to SGLang's DeepEP path
+(`examples/sglang/`, SURVEY.md §2 parallelism table row EP); this module is
+the first-party TPU equivalent of that capability.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def moe_mlp_dropless(
+    lp: dict,
+    x: jnp.ndarray,  # [N, D] flattened tokens
+    *,
+    num_experts_per_token: int,
+) -> jnp.ndarray:
+    """Dropless routed MoE via ``lax.ragged_dot`` (TPU grouped matmul).
+
+    Token copies are stable-sorted by expert id (an O(N*k) argsort — token
+    count, never vocabulary), expert FFNs run as ragged grouped matmuls with
+    per-expert group sizes, and results unsort back. No capacity, no drops:
+    output is exact and independent of batch composition — the default
+    serving path whenever the expert axis is not sharded (parity with the
+    dropless DeepEP-style dispatch the reference gets from SGLang).
+    """
+    n, d = x.shape
+    e = lp["router"].shape[-1]
+    k = num_experts_per_token
+
+    router_logits = (x @ lp["router"]).astype(jnp.float32)  # [N, E]
+    topv, topi = jax.lax.top_k(router_logits, k)
+    weights = jax.nn.softmax(topv, axis=-1)  # [N, k]
+
+    flat_e = topi.reshape(-1)  # [N*k]
+    order = jnp.argsort(flat_e, stable=True)
+    xk = jnp.repeat(x, k, axis=0)[order]  # [N*k, D] grouped by expert
+    group_sizes = jnp.bincount(flat_e, length=e).astype(jnp.int32)
+
+    gate = jax.nn.silu(jax.lax.ragged_dot(xk, lp["w_gate"], group_sizes))
+    up = jax.lax.ragged_dot(xk, lp["w_up"], group_sizes)
+    down = jax.lax.ragged_dot(gate * up, lp["w_down"], group_sizes)  # [N*k, D]
+
+    rows = jnp.zeros_like(down).at[order].set(down)  # unsort
+    out = (rows.astype(jnp.float32) * weights.reshape(-1)[:, None]).reshape(n, k, d).sum(axis=1)
+    return out.astype(x.dtype)
+
+
+def expert_capacity(num_tokens: int, num_experts: int, k: int, capacity_factor: float) -> int:
+    """Per-expert buffer size: ceil(N*k/E * f), clamped to [k, N*k] and
+    rounded up to a multiple of 8 (TPU sublane alignment)."""
+    c = int(num_tokens * k * capacity_factor / num_experts + 0.999)
+    c = max(k, min(c, num_tokens * k))
+    return -(-c // 8) * 8
+
+
+def moe_mlp(
+    lp: dict,
+    x: jnp.ndarray,  # [N, D] flattened tokens
+    *,
+    num_experts_per_token: int,
+    capacity_factor: float = 1.25,
+    capacity: int | None = None,
+) -> jnp.ndarray:
+    """Routed MoE FFN over flattened tokens; returns [N, D].
+
+    ``lp`` holds ``router [D, E]``, ``w_gate/w_up [E, D, F]``, ``w_down
+    [E, F, D]`` (one layer's slice of the stacked params).
+    """
+    n, d = x.shape
+    e = lp["router"].shape[-1]
+    k = num_experts_per_token
+    c = capacity if capacity is not None else expert_capacity(n, e, k, capacity_factor)
+
+    router_logits = (x @ lp["router"]).astype(jnp.float32)  # [N, E]
+    topv, topi = jax.lax.top_k(router_logits, k)  # [N, k]; E is small — cheap
+    weights = jax.nn.softmax(topv, axis=-1)  # [N, k]
+
+    # Buffer position of each (token, choice) within its expert: rank among
+    # all earlier assignments to the same expert (token-major priority).
+    flat_e = topi.reshape(-1)  # [N*k]
+    oh = jax.nn.one_hot(flat_e, e, dtype=jnp.int32)  # [N*k, E]
+    pos = (jnp.cumsum(oh, axis=0) * oh).sum(-1) - 1  # [N*k]
+    keep = pos < c
+    slot = jnp.where(keep, pos, c)  # dropped choices land in a spill row
+
+    # Scatter tokens into expert buffers (+1 spill row, sliced off).
+    xk = jnp.repeat(x, k, axis=0)  # [N*k, D] — choice j of token t at t*k+j
+    buf = jnp.zeros((e, c + 1, d), x.dtype).at[flat_e, slot].set(xk)
+    expert_in = buf[:, :c]  # [E, C, D]
+
+    # Batched expert FFN: one contraction over all experts; GSPMD shards the
+    # leading axis on ep from the weight shardings.
+    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, lp["w_gate"]))
+    up = jnp.einsum("ecd,edf->ecf", expert_in, lp["w_up"])
+    expert_out = jnp.einsum("ecf,efd->ecd", gate * up, lp["w_down"])  # [E, C, D]
+
+    # Combine: gather each choice's row, weight, and sum over the k choices.
+    rows = expert_out[flat_e, jnp.minimum(slot, c - 1)]  # [N*k, D]
+    w = (weights.reshape(-1) * keep.astype(weights.dtype))[:, None]
+    out = (rows.astype(jnp.float32) * w).reshape(n, k, d).sum(axis=1)
+    return out.astype(x.dtype)

@@ -1,0 +1,125 @@
+"""Multi-host mesh bring-up: ``jax.distributed`` coordinated by the runtime.
+
+One serving worker can span multiple hosts (a TPU pod slice): every host
+runs the same process, `jax.distributed.initialize` stitches their local
+chips into one global device set, and a single GSPMD mesh (dp/tp/sp/ep —
+``parallel/mesh.py``) spans all of them. Bring-up needs a rendezvous — the
+leader picks the coordinator address, followers must learn it and start
+together — which runs through the discovery store via the lease-bound
+leader/worker barrier (``runtime/barrier.py``), so a host dying during
+bring-up releases its slot instead of wedging the fleet.
+
+The same flags the reference threads through its engines are accepted here
+(`--num-nodes/--node-rank/--leader-addr`): reference
+`lib/llm/src/engines.rs:43` (``MultiNodeConfig``), `flags.rs:82-100`,
+`lib/runtime/src/utils/leader_worker_barrier.rs:137`.
+
+Usage (each host)::
+
+    cfg = MultiNodeConfig(num_nodes=2, node_rank=rank)
+    await bringup(cfg, runtime)      # rendezvous + jax.distributed.initialize
+    mesh = make_mesh(plan)           # jax.devices() is now the global set
+
+CPU-mesh variant for tests: works identically with
+``JAX_PLATFORMS=cpu`` + ``--xla_force_host_platform_device_count=K`` in each
+process — the 2-process test in ``tests/test_multihost.py`` serves a sharded
+model this way without TPU hardware.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import socket
+
+logger = logging.getLogger(__name__)
+
+BARRIER_NAME = "jax-multihost-bringup"
+
+
+@dataclasses.dataclass
+class MultiNodeConfig:
+    """Topology of one logical worker spanning several hosts."""
+
+    num_nodes: int = 1
+    node_rank: int = 0
+    # host:port of the rank-0 jax coordinator. Leader: picked automatically
+    # if unset. Followers: learned through the barrier if unset.
+    leader_addr: str | None = None
+
+    @property
+    def is_leader(self) -> bool:
+        return self.node_rank == 0
+
+    @property
+    def is_multi_node(self) -> bool:
+        return self.num_nodes > 1
+
+
+def _pick_coordinator_addr(port: int = 0) -> str:
+    """A host:port the other nodes can reach; an OS-assigned free port."""
+    host = socket.gethostbyname(socket.gethostname())
+    with socket.socket() as s:
+        s.bind(("", port))
+        port = s.getsockname()[1]
+    return f"{host}:{port}"
+
+
+async def bringup(
+    cfg: MultiNodeConfig,
+    runtime=None,
+    *,
+    timeout: float = 120.0,
+    _initialize=None,  # test seam: replaces jax.distributed.initialize
+) -> str | None:
+    """Rendezvous (if needed) and initialize the global device runtime.
+
+    Returns the coordinator address in use (None for single-node). After this
+    returns, ``jax.devices()`` on every node is the same global list and any
+    mesh built from it spans the hosts.
+    """
+    if not cfg.is_multi_node:
+        return None
+    import jax
+
+    initialize = _initialize or jax.distributed.initialize
+
+    if cfg.is_leader:
+        addr = cfg.leader_addr or _pick_coordinator_addr()
+        if runtime is not None:
+            # Publish the coordinator address and wait for every follower's
+            # check-in (they check in *before* their own initialize, so the
+            # leader reaches its blocking initialize only once all ranks are
+            # about to connect — linear control flow, lease-bound slots).
+            from dynamo_tpu.runtime.barrier import leader_barrier
+
+            await leader_barrier(
+                runtime, BARRIER_NAME, {"leader_addr": addr, "num_nodes": cfg.num_nodes},
+                num_workers=cfg.num_nodes - 1, timeout=timeout,
+            )
+        elif cfg.leader_addr is None:
+            raise ValueError("leader needs --leader-addr or a runtime store for rendezvous")
+    else:
+        addr = cfg.leader_addr
+        if addr is None:
+            if runtime is None:
+                raise ValueError("follower needs --leader-addr or a runtime store for rendezvous")
+            from dynamo_tpu.runtime.barrier import worker_barrier
+
+            data = await worker_barrier(runtime, BARRIER_NAME, f"rank-{cfg.node_rank}", timeout=timeout)
+            addr = data["leader_addr"]
+            if data["num_nodes"] != cfg.num_nodes:
+                raise ValueError(
+                    f"rank {cfg.node_rank}: leader expects {data['num_nodes']} nodes, "
+                    f"this process was launched with {cfg.num_nodes}"
+                )
+
+    logger.info("jax.distributed.initialize(%s, num_processes=%d, process_id=%d)",
+                addr, cfg.num_nodes, cfg.node_rank)
+    # Blocks until every rank has connected to the coordinator.
+    initialize(
+        coordinator_address=addr,
+        num_processes=cfg.num_nodes,
+        process_id=cfg.node_rank,
+    )
+    return addr

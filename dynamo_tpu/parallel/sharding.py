@@ -51,7 +51,13 @@ def param_shardings(mesh: Mesh, params: dict[str, Any]) -> dict[str, Any]:
             if leaf.ndim == 4:  # MoE: [L, E, F, D]
                 return P(None, "ep", "tp", None)
             return P(None, "tp", None)
-        return P()  # norms, router: replicated
+        if name in ("bq", "bk", "bv"):  # qkv biases follow the head split
+            return P(None, "tp")
+        if name in ("w_shared_gate", "w_shared_up"):
+            return P(None, None, "tp")
+        if name == "w_shared_down":
+            return P(None, "tp", None)
+        return P()  # norms, router, shared_gate: replicated
 
     def walk(tree, path):
         if isinstance(tree, dict):

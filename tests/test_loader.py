@@ -161,3 +161,36 @@ async def test_serve_model_dir_end_to_end(tmp_path):
         for svc in handles["services"]:
             await svc.close()
         await handles["runtime"].close()
+
+
+def test_roundtrip_qwen2_moe_shared_expert_and_bias(tmp_path):
+    """Qwen2-MoE layout: routed experts + gated shared expert + qkv biases."""
+    cfg = dataclasses.replace(
+        PRESETS["test-tiny-moe"],
+        shared_expert_size=32, shared_expert_gated=True, attention_bias=True,
+    )
+    params = llama.init_params(cfg, 5)
+    assert "w_shared_gate" in params["layers"] and "bq" in params["layers"]
+    save_params(tmp_path, cfg, params)
+    cfg2, loaded = load_model(tmp_path, dtype=cfg.dtype)
+    assert cfg2.shared_expert_size == 32 and cfg2.shared_expert_gated and cfg2.attention_bias
+    _assert_trees_equal(params, loaded)
+
+
+def test_strict_load_rejects_dropped_tensors(tmp_path):
+    """A checkpoint with tensors the mapping would ignore must fail loudly."""
+    import dataclasses as dc
+
+    cfg = dataclasses.replace(
+        PRESETS["test-tiny-moe"],
+        shared_expert_size=32, shared_expert_gated=True,
+    )
+    params = llama.init_params(cfg, 6)
+    save_params(tmp_path, cfg, params)
+    # Load with a config that doesn't know about the shared expert: its
+    # tensors would be silently dropped -> strict mode must raise.
+    bad_cfg = dc.replace(cfg, shared_expert_size=0, shared_expert_gated=False)
+    with pytest.raises(ValueError, match="silently drop"):
+        load_params(tmp_path, bad_cfg)
+    # Explicit opt-out still works.
+    load_params(tmp_path, bad_cfg, strict=False)

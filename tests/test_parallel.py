@@ -77,3 +77,56 @@ def test_graft_entry_single_chip_compiles(monkeypatch):
     jitted = jax.jit(fn)
     out = jitted(*args)
     assert np.isfinite(np.asarray(out)).all()
+
+
+@pytest.mark.tpu_8
+def test_sp_ring_prefill_matches_single_device():
+    """Prefill runs sequence-parallel ring attention (sp axis) and must be
+    token-identical to single-device paged prefill; decode then continues on
+    the paged path against the ring-written cache."""
+    mesh = make_mesh(MeshPlan(dp=2, tp=2, sp=2), jax.devices())
+    runner = ModelRunner(
+        CFG, PARAMS, num_pages=64, page_size=PAGE, max_batch_size=8,
+        prefill_bucket=16, attn_impl="reference", mesh=mesh,
+    )
+    core = EngineCore(
+        runner,
+        EngineConfig(num_pages=64, page_size=PAGE, max_batch_size=8, max_seq_len=128,
+                     enable_prefix_caching=False),
+    )
+    prompts = [list(range(1, 17)), [9, 8, 7, 6, 5, 4, 3, 2, 1, 2, 3, 4]]
+    for p in prompts:
+        core.add_request(greedy_request(p, max_tokens=6))
+    outputs = run_to_completion(core)
+    for i, p in enumerate(prompts):
+        assert outputs[i] == greedy_reference(p, 6), f"seq {i}"
+
+
+def test_select_impl_ring_conditions():
+    mesh = make_mesh(MeshPlan(dp=2, tp=2, sp=2), jax.devices())
+    runner = ModelRunner(
+        CFG, PARAMS, num_pages=64, page_size=PAGE, max_batch_size=8,
+        prefill_bucket=16, attn_impl="reference", mesh=mesh,
+    )
+    import numpy as np
+    from dynamo_tpu.engine.runner import StepBatch
+
+    def batch(t, pos0):
+        b = 2
+        return StepBatch(
+            tokens=np.zeros((b, t), np.int32),
+            positions=np.tile(np.arange(pos0, pos0 + t, dtype=np.int32), (b, 1)),
+            block_tables=np.zeros((b, 4), np.int32),
+            slot_mapping=np.zeros((b, t), np.int32),
+            last_token_index=np.zeros(b, np.int32),
+            temperature=np.zeros(b, np.float32),
+            top_k=np.zeros(b, np.int32),
+            top_p=np.ones(b, np.float32),
+            seeds=np.zeros(b, np.uint32),
+            sample_steps=np.zeros(b, np.int32),
+        )
+
+    assert runner._select_impl(batch(16, 0)) == "ring"      # whole-prompt prefill
+    assert runner._select_impl(batch(1, 5)) == "reference"  # decode
+    assert runner._select_impl(batch(16, 8)) == "reference" # chunk continuation
+    assert runner._select_impl(batch(15, 0)) == "reference" # not sp-divisible
