@@ -1,0 +1,164 @@
+"""Device-path KV transfer: cache pages move device->device, never via host.
+
+The TCP transfer service (``disagg/transfer.py``) is the DCN fallback: pages
+bounce device -> host -> msgpack -> host -> device. When the prefill and
+decode engines live in the same process group (one host's chips, or one
+slice), the pages can instead move as device arrays: one batched gather on
+the source cache, a ``jax.device_put`` onto the destination's devices (XLA
+routes it over ICI when source and destination differ; it never touches
+Python), and one batched in-place scatter into the destination cache.
+
+Every transfer records bytes and wall time; ``stats()`` exposes cumulative
+GB/s — KV-transfer bandwidth is a tracked north-star metric (BASELINE.md).
+
+Parity: the reference's NIXL RDMA put into remote block ids
+(`lib/llm/src/block_manager/block/transfer/nixl.rs:86`) — here the RDMA role
+is played by ICI DMA under ``device_put``, and the registry plays the
+rendezvous role of NIXL metadata exchange (SURVEY.md §7 hard part (b)).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+
+from dynamo_tpu.engine.runner import ModelRunner, next_pow2
+
+import numpy as np
+import jax.numpy as jnp
+
+
+def cache_compatible(a: ModelRunner, b: ModelRunner) -> bool:
+    """Whether two runners' caches share page geometry (layers, page size,
+    KV width) and dtype — the precondition for a raw device-path page copy."""
+    ka, kb = a.k_cache, b.k_cache
+    return (ka.shape[0], ka.shape[2], ka.shape[3], ka.dtype) == (
+        kb.shape[0], kb.shape[2], kb.shape[3], kb.dtype
+    )
+
+
+@dataclasses.dataclass
+class TransferStats:
+    transfers: int = 0
+    pages: int = 0
+    bytes: int = 0
+    seconds: float = 0.0
+
+    @property
+    def gbytes_per_sec(self) -> float:
+        return (self.bytes / 1e9) / self.seconds if self.seconds > 0 else 0.0
+
+
+class DeviceKvTransfer:
+    """Moves KV pages between two runners' caches on the device path."""
+
+    def __init__(self) -> None:
+        self.stats = TransferStats()
+
+    def transfer(
+        self,
+        src: ModelRunner,
+        src_pages: list[int],
+        dst: ModelRunner,
+        dst_pages: list[int],
+    ) -> TransferStats:
+        """Copy ``src_pages`` of src's cache into ``dst_pages`` of dst's.
+
+        One gather -> one device_put -> one scatter, regardless of page
+        count. Cache geometry (layers, page size, width) must match; the
+        destination pages must already be allocated by dst's allocator.
+        """
+        assert len(src_pages) == len(dst_pages)
+        if not src_pages:
+            return self.stats
+        n = len(src_pages)
+        padded_n = next_pow2(n)
+        src_ids = np.zeros(padded_n, np.int32)
+        src_ids[:n] = src_pages
+        # Padded slots scatter into the reserved null page 0, so the whole
+        # padded buffer stays on device (no slice-and-restack host bounce).
+        dst_ids = np.zeros(padded_n, np.int32)
+        dst_ids[:n] = dst_pages
+        # Both runners' caches are touched (src gathered, dst donated into),
+        # each racing its own engine's in-flight steps — hold both io_locks,
+        # in a stable order so opposed concurrent transfers can't deadlock.
+        lock_a, lock_b = (
+            (src.io_lock, dst.io_lock) if id(src) <= id(dst) else (dst.io_lock, src.io_lock)
+        )
+        with lock_a, lock_b:
+            # Resharding device_put: each shard of the gathered pages lands
+            # on the device that owns the matching shard of dst's cache (the
+            # cache spec never shards the page axis, so it applies to
+            # [L, N, ps, W] too). Single-device runners degenerate to a
+            # plain placement.
+            dst_sharding = dst.k_cache.sharding
+
+            if padded_n not in src._devxfer_warm or padded_n not in dst._devxfer_warm:
+                # Untimed warm-up into the null page: compiles the gather/
+                # scatter kernels for this shape so the timed run below
+                # measures the copy, not XLA compilation (bandwidth is a
+                # tracked metric).
+                kg, vg = src._gather_pages_fn(src.k_cache, src.v_cache, jnp.asarray(src_ids))
+                dst.write_pages([0] * padded_n, jax.device_put(kg, dst_sharding), jax.device_put(vg, dst_sharding))
+                jax.block_until_ready(dst.k_cache)
+                src._devxfer_warm.add(padded_n)
+                dst._devxfer_warm.add(padded_n)
+
+            t0 = time.perf_counter()
+            k_gath, v_gath = src._gather_pages_fn(src.k_cache, src.v_cache, jnp.asarray(src_ids))
+            # Device->device: XLA moves the buffers over ICI (or aliases them
+            # when src and dst share devices); the host never sees the bytes.
+            k_dst = jax.device_put(k_gath, dst_sharding)
+            v_dst = jax.device_put(v_gath, dst_sharding)
+            dst.write_pages(list(dst_ids), k_dst, v_dst)
+            jax.block_until_ready(dst.k_cache)
+            dt = time.perf_counter() - t0
+
+        # bytes per page = L * ps * W * itemsize, for K and V.
+        page_bytes = src.k_cache.shape[0] * src.k_cache.shape[2] * src.k_cache.shape[3] * src.k_cache.itemsize
+        moved = 2 * n * page_bytes
+        self.stats.transfers += 1
+        self.stats.pages += n
+        self.stats.bytes += moved
+        self.stats.seconds += dt
+        return self.stats
+
+
+class DeviceTransferRegistry:
+    """In-process rendezvous: decode workers publish their transfer service
+    under their (globally unique) transfer address, so a co-located prefill
+    worker can take the device path instead of TCP.
+
+    The registry is the process-local analogue of NIXL's metadata exchange:
+    presence in the registry *is* reachability over the device path.
+    """
+
+    def __init__(self) -> None:
+        self._services: dict[str, object] = {}  # transfer address -> KvTransferService
+
+    def register(self, transfer_address: str, service) -> "RegistryHandle":
+        self._services[transfer_address] = service
+        return RegistryHandle(self, transfer_address)
+
+    def unregister(self, transfer_address: str) -> None:
+        self._services.pop(transfer_address, None)
+
+    def lookup(self, transfer_address: str):
+        return self._services.get(transfer_address)
+
+
+class RegistryHandle:
+    """Aux-closeable registration (unregisters with the owning service)."""
+
+    def __init__(self, registry: DeviceTransferRegistry, address: str) -> None:
+        self._registry = registry
+        self._address = address
+
+    async def close(self) -> None:
+        self._registry.unregister(self._address)
+
+
+# One registry per process (run_local topologies share it automatically).
+REGISTRY = DeviceTransferRegistry()

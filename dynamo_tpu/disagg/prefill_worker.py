@@ -77,6 +77,28 @@ class PrefillWorker:
         async for _ in self.service.generate(req, Context()):
             pass
         hashes = compute_block_hashes(token_ids, page_size, salt=salt)
+
+        # Co-located decode worker with matching cache geometry: move the
+        # pages over the device path (gather -> device_put -> scatter; ICI
+        # when chips differ). The TCP stream below is the cross-host (DCN)
+        # fallback, also taken if the device path fails.
+        from dynamo_tpu.disagg.device_transfer import REGISTRY, cache_compatible
+
+        peer = REGISTRY.lookup(task["transfer_address"])
+        if peer is not None and cache_compatible(self.service.core.runner, peer.core.runner):
+            try:
+                injected = await peer.inject_from(self.service.core, hashes, request_id)
+            except Exception:
+                logger.exception(
+                    "prefill %s: device-path transfer failed, falling back to TCP", request_id
+                )
+            else:
+                logger.info(
+                    "prefill %s: %d tokens -> %d blocks via device path (%s)",
+                    request_id, len(token_ids), injected, peer.stats(),
+                )
+                return
+
         loop = asyncio.get_running_loop()
         blocks = await loop.run_in_executor(None, collect_prefill_blocks, self.service.core, hashes)
         if not blocks:

@@ -60,12 +60,122 @@ def unpack_payload(msg: dict) -> tuple[np.ndarray, np.ndarray]:
 
 
 class KvTransferService(AsyncEngine[Any, dict]):
-    """Served by decode workers: ingests KV blocks into the local cache."""
+    """Served by decode workers: ingests KV blocks into the local cache.
+
+    Two ingestion paths share this service: the TCP stream below (DCN
+    fallback, host-bounced) and :meth:`inject_from` (device path — pages
+    move src-device -> dst-device through ``disagg/device_transfer.py``
+    without touching the host). Both record bytes/seconds; ``stats()``
+    reports cumulative bandwidth, a tracked metric (BASELINE.md).
+    """
 
     def __init__(self, core: EngineCore) -> None:
         self.core = core
         self._completions: dict[str, asyncio.Event] = {}
         self.blocks_received = 0
+        self.bytes_received = 0
+        self.transfer_seconds = 0.0
+        self.device_path_blocks = 0
+
+    def stats(self) -> dict:
+        gbps = (self.bytes_received / 1e9) / self.transfer_seconds if self.transfer_seconds else 0.0
+        return {
+            "blocks": self.blocks_received,
+            "device_path_blocks": self.device_path_blocks,
+            "bytes": self.bytes_received,
+            "seconds": round(self.transfer_seconds, 6),
+            "gbytes_per_sec": round(gbps, 6),
+        }
+
+    # -- staging (shared by the TCP and device ingestion paths) ------------
+
+    def _stage_chain(self, items) -> tuple[list[int], list[tuple[int, int, Any]]]:
+        """Pin already-present blocks; allocate a destination page per miss.
+
+        ``items``: (block_hash, payload) in chain order; stops at pool
+        exhaustion. Returns ``(pinned_hits, staged)`` with staged =
+        ``[(dst_pid, block_hash, payload), ...]``. Hits are *pinned*
+        (refcount++) so the allocations here can't evict them mid-chain —
+        the caller must release them. Staged pages are uncommitted
+        (refcount 1): finish with :meth:`_commit_staged` or roll back with
+        :meth:`_release_staged`.
+        """
+        alloc = self.core.allocator
+        pinned: list[int] = []
+        staged: list[tuple[int, int, Any]] = []
+        for h, payload in items:
+            hit = alloc.acquire_cached(h)  # already have it (races are benign)
+            if hit is not None:
+                pinned.append(hit)
+                continue
+            try:
+                [pid] = alloc.allocate(1)
+            except OutOfPagesError:
+                logger.warning("kv injection out of pages after %d blocks", len(staged))
+                break
+            staged.append((pid, h, payload))
+        return pinned, staged
+
+    def _commit_staged(self, entries) -> None:
+        """``entries``: (dst_pid, hash, parent_hash, tokens) — publish each
+        written page to the prefix cache and drop the staging refcount."""
+        alloc = self.core.allocator
+        for pid, h, parent, tokens in entries:
+            alloc.commit(pid, h, parent, tokens)
+            alloc.release([pid])  # refcount 0: lives as prefix cache
+            self.blocks_received += 1
+
+    def _release_staged(self, staged) -> None:
+        # Uncommitted pages: release returns them to the free list instead
+        # of stranding them at refcount 1 forever.
+        self.core.allocator.release([pid for pid, _h, _p in staged])
+
+    async def inject_from(self, src_core: EngineCore, block_hashes: list[int], request_id: str = "") -> int:
+        """Device-path injection: pull the hash chain's pages straight from a
+        co-located engine's cache over the device interconnect.
+
+        Returns the number of chain blocks now present at the destination
+        (already-cached hits + freshly transferred). On a transfer failure
+        the staged destination pages are released and the error propagates —
+        the caller falls back to the TCP path.
+        """
+        from dynamo_tpu.disagg.device_transfer import DeviceKvTransfer
+
+        src_alloc = src_core.allocator
+        src_pages = src_alloc.match_prefix(block_hashes)  # acquires refcounts
+        pinned: list[int] = []
+        staged: list[tuple[int, int, Any]] = []  # payload = source page id
+        try:
+            pinned, staged = self._stage_chain(
+                (block_hashes[i], src_pid) for i, src_pid in enumerate(src_pages)
+            )
+            if staged:
+                xfer = DeviceKvTransfer()
+                loop = asyncio.get_running_loop()
+                try:
+                    await loop.run_in_executor(
+                        None, xfer.transfer,
+                        src_core.runner, [src_pid for _pid, _h, src_pid in staged],
+                        self.core.runner, [pid for pid, _h, _s in staged],
+                    )
+                except Exception:
+                    self._release_staged(staged)
+                    staged = []
+                    raise
+                self._commit_staged(
+                    (pid, h, src_alloc.page_parent_hash(src_pid), ())
+                    for pid, h, src_pid in staged
+                )
+                self.transfer_seconds += xfer.stats.seconds
+                self.bytes_received += xfer.stats.bytes
+                self.device_path_blocks += len(staged)
+        finally:
+            self.core.allocator.release(pinned)
+            src_alloc.release(src_pages)
+        ev = self._completions.get(request_id)
+        if ev is not None:
+            ev.set()
+        return len(pinned) + len(staged)
 
     def expect(self, request_id: str) -> asyncio.Event:
         """Register interest in a transfer's completion (disagg operator)."""
@@ -78,34 +188,47 @@ class KvTransferService(AsyncEngine[Any, dict]):
     async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
         """Request: {"request_id": str, "blocks": [packed blocks...]}.
 
-        Responds with one summary item. Injection is atomic-enough per block:
-        allocate page -> write payload -> commit hash; a mid-transfer failure
-        leaves a shorter (still valid, chain-consistent) cached prefix.
+        Responds with one summary item. The whole chain is staged (allocate +
+        unpack) then written as one batched scatter and committed; a failure
+        anywhere releases the staged pages, so the cache keeps only
+        previously-present blocks — still a valid, chain-consistent prefix.
         """
+        import time
+
         request_id = request.get("request_id", "")
         blocks = request.get("blocks", [])
         injected = 0
-        allocator = self.core.allocator
-        runner = self.core.runner
-        for blk in blocks:
-            if blk["hash"] in allocator._cached:  # already have it (races are benign)
-                injected += 1
-                continue
-            try:
-                [pid] = allocator.allocate(1)
-            except OutOfPagesError:
-                logger.warning("kv injection out of pages after %d blocks", injected)
-                break
-            k, v = unpack_payload(blk)
-            await asyncio.get_running_loop().run_in_executor(None, runner.write_page, pid, k, v)
-            allocator.commit(pid, blk["hash"], blk.get("parent"), tuple(blk.get("tokens", ())))
-            allocator.release([pid])  # refcount 0: lives as prefix cache
-            injected += 1
-            self.blocks_received += 1
+        t0 = time.perf_counter()
+        pinned: list[int] = []
+        staged: list[tuple[int, int, Any]] = []  # payload = packed block dict
+        try:
+            pinned, staged = self._stage_chain((blk["hash"], blk) for blk in blocks)
+            injected += len(pinned)
+            if staged:
+                payloads = [unpack_payload(blk) for _pid, _h, blk in staged]
+                # One stacked transfer + one scatter for the whole chain,
+                # instead of a dispatch round-trip per page.
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self.core.runner.write_pages,
+                    [pid for pid, _h, _b in staged],
+                    [k for k, _ in payloads], [v for _, v in payloads],
+                )
+                self._commit_staged(
+                    (pid, h, blk.get("parent"), tuple(blk.get("tokens", ())))
+                    for pid, h, blk in staged
+                )
+                injected += len(staged)
+                self.bytes_received += sum(k.nbytes + v.nbytes for k, v in payloads)
+                self.transfer_seconds += time.perf_counter() - t0
+        except Exception:
+            self._release_staged(staged)
+            logger.exception("kv injection failed; dropped %d staged blocks", len(staged))
+        finally:
+            self.core.allocator.release(pinned)
         ev = self._completions.get(request_id)
         if ev is not None:
             ev.set()
-        yield {"request_id": request_id, "injected": injected, "total": len(blocks)}
+        yield {"request_id": request_id, "injected": injected, "total": len(blocks), "stats": self.stats()}
 
 
 async def send_blocks(
@@ -133,12 +256,10 @@ def collect_prefill_blocks(core: EngineCore, block_hashes: list[int]) -> list[di
     allocator = core.allocator
     pages = allocator.match_prefix(block_hashes)
     try:
-        out = []
-        for i, pid in enumerate(pages):
-            k, v = core.runner.read_page(pid)
-            # Parent/token metadata from the allocator's page records.
-            info = allocator._pages[pid]
-            out.append(pack_block(block_hashes[i], info.parent_hash, [], k, v))
-        return out
+        payloads = core.runner.read_pages(pages)  # one gather + one transfer
+        return [
+            pack_block(block_hashes[i], allocator.page_parent_hash(pid), [], k, v)
+            for i, (pid, (k, v)) in enumerate(zip(pages, payloads))
+        ]
     finally:
         allocator.release(pages)

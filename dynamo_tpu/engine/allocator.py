@@ -178,6 +178,22 @@ class PageAllocator:
                     del self._pages[pid]
                     self._free.append(pid)
 
+    def page_parent_hash(self, page_id: int) -> int | None:
+        """Parent hash recorded for a committed page (transfer metadata)."""
+        return self._pages[page_id].parent_hash
+
+    def acquire_cached(self, block_hash: int) -> int | None:
+        """Pin the cached page backing this hash (refcount++), if present.
+
+        Deliberately the only hit-check: pinning means the page can't be
+        evicted by a later :meth:`allocate` — required when checking hits
+        while also allocating in the same pass (KV transfer injection)."""
+        pid = self._cached.get(block_hash)
+        if pid is None:
+            return None
+        self.acquire(pid)
+        return pid
+
     def cache_snapshot(self) -> KvCacheEvent:
         """All currently-known completed blocks, parents before children.
 

@@ -16,6 +16,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import logging
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -30,6 +31,24 @@ logger = logging.getLogger(__name__)
 
 def next_pow2(n: int) -> int:
     return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+def _locked(fn):
+    """Serialize cache-touching entry points on the runner's ``io_lock``.
+
+    The KV cache buffers are *donated* to every jitted step/write: a second
+    thread dispatching against ``self.k_cache`` while a step is in flight
+    would either double-donate (JAX "array deleted" crash) or lose one
+    thread's reassignment. The engine loop is single-writer, but KV transfer
+    services and tier offload run on other executor threads — this mutex is
+    what makes their access safe."""
+
+    @functools.wraps(fn)
+    def wrapper(self, *args, **kwargs):
+        with self.io_lock:
+            return fn(self, *args, **kwargs)
+
+    return wrapper
 
 
 def _pack(padded: "StepBatch") -> np.ndarray:
@@ -117,6 +136,13 @@ class ModelRunner:
         self.attn_impl = attn_impl
         self.mesh = mesh
         self._forward = forward_fn or llama.forward
+        # Serializes every cache-donating/reading entry point (see _locked):
+        # RLock so a locked method may call another (e.g. device transfer).
+        self.io_lock = threading.RLock()
+        # Padded page-counts whose gather/scatter kernels are compiled for
+        # this runner (device-transfer warm-up bookkeeping — keyed on the
+        # runner object itself, so id() reuse after GC can't skip a warm-up).
+        self._devxfer_warm: set[int] = set()
         self.k_cache, self.v_cache = llama.init_kv_cache(cfg, num_pages, page_size, dtype=cache_dtype)
         self._dp = 1
         if mesh is not None:
@@ -224,8 +250,19 @@ class ModelRunner:
 
         self._gather_pages_fn = _gather_pages
 
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def _scatter_pages(k_cache, v_cache, ks, vs, pids):
+            # ks/vs: [L, N, ps, W]; one in-place scatter along the page axis.
+            return (
+                k_cache.at[:, pids].set(ks.astype(k_cache.dtype)),
+                v_cache.at[:, pids].set(vs.astype(v_cache.dtype)),
+            )
+
+        self._scatter_pages_fn = _scatter_pages
+
     # -- tier access (block manager offload/onboard) -----------------------
 
+    @_locked
     def read_page(self, page_id: int) -> tuple[np.ndarray, np.ndarray]:
         """Device->host copy of one page: ([L, ps, kv, hd], [L, ps, kv, hd])."""
         return (
@@ -233,6 +270,7 @@ class ModelRunner:
             np.asarray(self.v_cache[:, page_id]),
         )
 
+    @_locked
     def read_pages(self, page_ids: list[int]) -> list[tuple[np.ndarray, np.ndarray]]:
         """Batched device->host copy: one gather + one transfer for N pages.
 
@@ -248,10 +286,37 @@ class ModelRunner:
         k_host, v_host = np.asarray(k), np.asarray(v)
         return [(k_host[:, i], v_host[:, i]) for i in range(n)]
 
+    @_locked
     def write_page(self, page_id: int, k: np.ndarray, v: np.ndarray) -> None:
         """Host->device copy into one page (in place via buffer donation)."""
         self.k_cache, self.v_cache = self._write_page_fn(
             self.k_cache, self.v_cache, jnp.asarray(k), jnp.asarray(v), page_id
+        )
+
+    @_locked
+    def write_pages(self, page_ids: list[int], ks, vs) -> None:
+        """Batched host->device write: one transfer + one in-place scatter for
+        N pages (the per-page path costs a full dispatch round-trip each).
+
+        ``ks``/``vs``: per-page arrays [L, ps, W] (stacked on axis 1 here) or
+        pre-stacked [L, N, ps, W] device/host arrays.
+        """
+        if not page_ids:
+            return
+        n = len(page_ids)
+        k_stack = np.stack(ks, axis=1) if isinstance(ks, (list, tuple)) else ks
+        v_stack = np.stack(vs, axis=1) if isinstance(vs, (list, tuple)) else vs
+        padded_n = next_pow2(n)
+        pids = np.zeros(padded_n, np.int32)
+        pids[:n] = page_ids
+        if padded_n != n:
+            pad = ((0, 0), (0, padded_n - n)) + ((0, 0),) * (k_stack.ndim - 2)
+            k_stack = np.pad(np.asarray(k_stack), pad)
+            v_stack = np.pad(np.asarray(v_stack), pad)
+            pids[n:] = 0  # padding writes land in the reserved null page
+        self.k_cache, self.v_cache = self._scatter_pages_fn(
+            self.k_cache, self.v_cache, jnp.asarray(k_stack), jnp.asarray(v_stack),
+            jnp.asarray(pids),
         )
 
     # -- bucketing ---------------------------------------------------------
@@ -319,6 +384,7 @@ class ModelRunner:
             return "ring"
         return self.attn_impl
 
+    @_locked
     def step(self, batch: StepBatch) -> np.ndarray:
         """Run one forward+sample step; returns sampled token ids i32[B_real]."""
         b_real = batch.batch_size
@@ -346,6 +412,7 @@ class ModelRunner:
             )
         return np.asarray(next_tokens)[:b_real]
 
+    @_locked
     def multi_step(self, batch: StepBatch, num_steps: int) -> np.ndarray:
         """Fused decode burst; returns sampled tokens i32[B_real, num_steps].
 
@@ -377,6 +444,7 @@ class ModelRunner:
             )
         return np.asarray(toks).T[:b_real]  # [B, num_steps]
 
+    @_locked
     def multi_step_async(self, batch: StepBatch, num_steps: int, *, chain: bool = False) -> "DeviceTokens":
         """Dispatch a decode burst WITHOUT blocking on its result.
 

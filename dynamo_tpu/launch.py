@@ -206,6 +206,11 @@ async def serve_worker(
         t_inst = await component.endpoint(KV_TRANSFER_ENDPOINT).serve(
             transfer, metadata={"model": spec.card.name}, lease=lease
         )
+        # Device-path short-circuit for co-located prefill workers (ICI
+        # instead of the TCP host-bounce) — see disagg/device_transfer.py.
+        from dynamo_tpu.disagg.device_transfer import REGISTRY
+
+        service.aux.append(REGISTRY.register(t_inst.address, transfer))
         disagg_router = await DisaggRouter(disagg, page_size=spec.engine_config.page_size).watch(runtime, ns)
         serve_engine = DisaggDecodeService(
             service, transfer, DistributedQueue(runtime, PREFILL_QUEUE), disagg_router, t_inst.address
