@@ -93,6 +93,16 @@ class Backend(Operator):
         jail = StopStringJail(request.stop.stop_strings)
         async for item in stream:
             out = EngineOutput.from_dict(item) if isinstance(item, dict) else item
+            if out.embedding is not None:  # embeddings: nothing to detokenize
+                yield BackendOutput(
+                    finish_reason=out.finish_reason,
+                    prompt_tokens=out.prompt_tokens,
+                    cached_tokens=out.cached_tokens,
+                    embedding=out.embedding,
+                )
+                if out.finish_reason is not None:  # one output per batch input
+                    return
+                continue
             text = detok.push(out.token_ids) if out.token_ids else ""
             released = jail.push(text)
             if jail.triggered is not None:

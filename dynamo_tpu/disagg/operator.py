@@ -52,6 +52,11 @@ class DisaggDecodeService(AsyncEngine[Any, dict]):
 
     async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
         req = PreprocessedRequest.from_dict(request) if isinstance(request, dict) else request
+        if req.annotations.get("embed"):
+            # Embeddings build no KV: remote prefill would be pure waste.
+            async for item in self.engine.generate(req, context):
+                yield item
+            return
         prefill_len = len(req.token_ids)
         # Length screen first: the common short-prompt path must not pay the
         # queue-depth store scans.

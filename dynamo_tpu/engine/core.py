@@ -296,12 +296,24 @@ class EngineCore:
 
     def _run_decode(self) -> list[tuple[Sequence, EngineOutput]]:
         k = max(1, self.config.decode_steps)
+        # Penalized sampling needs fresh host-side token history per burst;
+        # a chained (pipelined) burst would dispatch with history missing the
+        # burst still in flight, undercounting repetitions. Those batches
+        # take the sync path (the in-burst scan still self-counts).
+        penalized = any(
+            s.request.sampling.frequency_penalty or s.request.sampling.presence_penalty
+            for s in self.running
+        )
         if (
             k > 1
+            and not penalized
             and hasattr(self.runner, "multi_step_async")
             and getattr(self.runner, "mesh", None) is None
         ):
             return self._run_decode_pipelined(k)
+        if penalized and self._inflight is not None:
+            # A penalized request just joined mid-pipeline: drain first.
+            return self._drain_inflight()
         return self._run_decode_sync(k)
 
     def _ensure_burst_pages(self, horizon: int, *, fail_sole: bool = True) -> Sequence | None:
@@ -481,6 +493,8 @@ class EngineCore:
         top_p = np.ones(b, np.float32)
         seeds = np.zeros(b, np.uint32)
         steps = np.zeros(b, np.int32)
+        freq = np.zeros(b, np.float32)
+        pres = np.zeros(b, np.float32)
         for i, s in enumerate(batch):
             sp = s.request.sampling
             temp[i] = sp.temperature
@@ -488,7 +502,22 @@ class EngineCore:
             top_p[i] = sp.top_p
             seeds[i] = np.uint32((sp.seed if sp.seed is not None else s.seq_id * 0x9E3779B9 + 1) & 0xFFFFFFFF)
             steps[i] = s.num_generated
-        return StepBatch(tokens, positions, block_tables, slots, last, temp, top_k, top_p, seeds, steps)
+            freq[i] = sp.frequency_penalty
+            pres[i] = sp.presence_penalty
+        # Generated-token history feeds the sampler's repetition penalties.
+        # Only shipped when some request actually set a penalty: H collapses
+        # to 1 otherwise, keeping the packed step input small. Width covers
+        # this dispatch's own fused decode burst (the scan appends in-graph).
+        if freq.any() or pres.any():
+            h = max(int(steps.max()) + self.config.decode_steps, 1)
+            history = np.full((b, h), -1, np.int32)
+            for i, s in enumerate(batch):
+                gen = s.tokens[s.num_prompt:]
+                history[i, : len(gen)] = gen
+        else:
+            history = np.full((b, 1), -1, np.int32)
+        return StepBatch(tokens, positions, block_tables, slots, last, temp, top_k, top_p,
+                         seeds, steps, freq, pres, history)
 
     def _commit_filled_pages(self, seq: Sequence) -> None:
         """Publish newly-filled pages to the prefix cache (emits stored events)

@@ -68,13 +68,16 @@ def _pack(padded: "StepBatch") -> np.ndarray:
             padded.top_p.view(np.int32),
             padded.seeds.view(np.int32),
             padded.sample_steps,
+            padded.freq_pen.view(np.int32),
+            padded.pres_pen.view(np.int32),
+            padded.history.ravel(),
         ]
     )
 
 
-def _unpack(packed: jnp.ndarray, b: int, t: int, n: int):
+def _unpack(packed: jnp.ndarray, b: int, t: int, n: int, h: int):
     """In-graph inverse of :func:`_pack` (static offsets, free slices)."""
-    sizes = [b * t, b * t, b * n, b * t, b, b, b, b, b, b]
+    sizes = [b * t, b * t, b * n, b * t, b, b, b, b, b, b, b, b, b * h]
     offs = np.concatenate([[0], np.cumsum(sizes)])
     part = [packed[offs[i] : offs[i + 1]] for i in range(len(sizes))]
     return (
@@ -88,6 +91,9 @@ def _unpack(packed: jnp.ndarray, b: int, t: int, n: int):
         jax.lax.bitcast_convert_type(part[7], jnp.float32),
         jax.lax.bitcast_convert_type(part[8], jnp.uint32),
         part[9],
+        jax.lax.bitcast_convert_type(part[10], jnp.float32),
+        jax.lax.bitcast_convert_type(part[11], jnp.float32),
+        part[12].reshape(b, h),
     )
 
 
@@ -105,6 +111,9 @@ class StepBatch:
     top_p: np.ndarray  # f32[B]
     seeds: np.ndarray  # u32[B]
     sample_steps: np.ndarray  # i32[B] — rng fold counter (monotonic per request)
+    freq_pen: np.ndarray  # f32[B] — OpenAI frequency_penalty
+    pres_pen: np.ndarray  # f32[B] — OpenAI presence_penalty
+    history: np.ndarray  # i32[B, H] generated tokens so far, pad -1 (H=1 when no penalties)
 
     @property
     def batch_size(self) -> int:
@@ -157,27 +166,32 @@ class ModelRunner:
 
         @functools.partial(jax.jit, static_argnames=("impl",), donate_argnums=(1, 2))
         def _step(params, k_cache, v_cache, tokens, positions, block_tables, slot_mapping,
-                  last_idx, temperature, top_k, top_p, seeds, sample_steps, *, impl):
+                  last_idx, temperature, top_k, top_p, seeds, sample_steps,
+                  freq_pen, pres_pen, history, *, impl):
             logits, k_cache, v_cache = self._forward(
                 params, self.cfg, tokens, positions, k_cache, v_cache,
                 block_tables, slot_mapping, last_idx, attn_impl=impl, mesh=self.mesh,
             )
             keys = jax.vmap(lambda s, c: jax.random.fold_in(jax.random.PRNGKey(s), c))(seeds, sample_steps)
-            next_tokens = sample_tokens(logits, keys, temperature, top_k, top_p)
+            next_tokens = sample_tokens(
+                logits, keys, temperature, top_k, top_p,
+                history=history, frequency_penalty=freq_pen, presence_penalty=pres_pen,
+            )
             return next_tokens, k_cache, v_cache
 
         self._step_fn = _step
 
-        @functools.partial(jax.jit, static_argnames=("b", "t", "n"), donate_argnums=(1, 2))
-        def _step_packed(params, k_cache, v_cache, packed, *, b, t, n):
-            args = _unpack(packed, b, t, n)
+        @functools.partial(jax.jit, static_argnames=("b", "t", "n", "h"), donate_argnums=(1, 2))
+        def _step_packed(params, k_cache, v_cache, packed, *, b, t, n, h):
+            args = _unpack(packed, b, t, n, h)
             return _step(params, k_cache, v_cache, *args, impl=self.attn_impl)
 
         self._step_packed_fn = _step_packed
 
         @functools.partial(jax.jit, static_argnames=("num_steps",), donate_argnums=(1, 2))
         def _multi_step(params, k_cache, v_cache, tokens, positions, block_tables,
-                        temperature, top_k, top_p, seeds, sample_steps, *, num_steps):
+                        temperature, top_k, top_p, seeds, sample_steps,
+                        freq_pen, pres_pen, history, *, num_steps):
             """``num_steps`` fused decode iterations in one dispatch.
 
             The sampled token of step i is step i+1's input; slot mapping is
@@ -189,9 +203,10 @@ class ModelRunner:
             """
             ps = self.page_size
             zeros = jnp.zeros_like(tokens)
+            h_width = history.shape[1]
 
             def body(carry, _):
-                tok, pos, kc, vc, cnt = carry
+                tok, pos, kc, vc, cnt, hist = carry
                 page = jnp.take_along_axis(block_tables, (pos // ps)[:, None], axis=1)[:, 0]
                 slot = page * ps + pos % ps
                 logits, kc, vc = self._forward(
@@ -199,37 +214,47 @@ class ModelRunner:
                     block_tables, slot[:, None], zeros, attn_impl=self.attn_impl,
                 )
                 keys = jax.vmap(lambda s, c: jax.random.fold_in(jax.random.PRNGKey(s), c))(seeds, cnt)
-                nxt = sample_tokens(logits, keys, temperature, top_k, top_p)
-                return (nxt, pos + 1, kc, vc, cnt + 1), nxt
+                nxt = sample_tokens(
+                    logits, keys, temperature, top_k, top_p,
+                    history=hist, frequency_penalty=freq_pen, presence_penalty=pres_pen,
+                )
+                # The burst's own samples count toward later steps' penalties.
+                write = jnp.minimum(cnt, h_width - 1)
+                hist = jax.vmap(lambda hrow, w, t: hrow.at[w].set(t))(hist, write, nxt)
+                return (nxt, pos + 1, kc, vc, cnt + 1, hist), nxt
 
-            (_, _, k_cache, v_cache, _), toks = jax.lax.scan(
-                body, (tokens, positions, k_cache, v_cache, sample_steps), None, length=num_steps
+            (_, _, k_cache, v_cache, _, _), toks = jax.lax.scan(
+                body, (tokens, positions, k_cache, v_cache, sample_steps, history), None, length=num_steps
             )
             return toks, k_cache, v_cache
 
         self._multi_step_fn = _multi_step
 
-        @functools.partial(jax.jit, static_argnames=("b", "t", "n", "num_steps"), donate_argnums=(1, 2))
-        def _multi_step_packed(params, k_cache, v_cache, packed, *, b, t, n, num_steps):
+        @functools.partial(jax.jit, static_argnames=("b", "t", "n", "h", "num_steps"), donate_argnums=(1, 2))
+        def _multi_step_packed(params, k_cache, v_cache, packed, *, b, t, n, h, num_steps):
             (tokens, positions, block_tables, _slot, _last,
-             temperature, top_k, top_p, seeds, sample_steps) = _unpack(packed, b, t, n)
+             temperature, top_k, top_p, seeds, sample_steps,
+             freq_pen, pres_pen, history) = _unpack(packed, b, t, n, h)
             return _multi_step(
                 params, k_cache, v_cache, tokens[:, 0], positions[:, 0], block_tables,
-                temperature, top_k, top_p, seeds, sample_steps, num_steps=num_steps,
+                temperature, top_k, top_p, seeds, sample_steps,
+                freq_pen, pres_pen, history, num_steps=num_steps,
             )
 
         self._multi_step_packed_fn = _multi_step_packed
 
-        @functools.partial(jax.jit, static_argnames=("b", "t", "n", "num_steps"), donate_argnums=(1, 2))
-        def _multi_step_chained(params, k_cache, v_cache, packed, chain_tokens, *, b, t, n, num_steps):
+        @functools.partial(jax.jit, static_argnames=("b", "t", "n", "h", "num_steps"), donate_argnums=(1, 2))
+        def _multi_step_chained(params, k_cache, v_cache, packed, chain_tokens, *, b, t, n, h, num_steps):
             """Chained decode burst: input tokens come from the previous
             burst's device-resident output instead of the host (the host
             never blocks on them — see multi_step_async)."""
             (_tok, positions, block_tables, _slot, _last,
-             temperature, top_k, top_p, seeds, sample_steps) = _unpack(packed, b, t, n)
+             temperature, top_k, top_p, seeds, sample_steps,
+             freq_pen, pres_pen, history) = _unpack(packed, b, t, n, h)
             return _multi_step(
                 params, k_cache, v_cache, chain_tokens, positions[:, 0], block_tables,
-                temperature, top_k, top_p, seeds, sample_steps, num_steps=num_steps,
+                temperature, top_k, top_p, seeds, sample_steps,
+                freq_pen, pres_pen, history, num_steps=num_steps,
             )
 
         self._multi_step_chained_fn = _multi_step_chained
@@ -259,6 +284,12 @@ class ModelRunner:
             )
 
         self._scatter_pages_fn = _scatter_pages
+
+        @jax.jit
+        def _embed(params, tokens, mask):
+            return llama.encode(params, self.cfg, tokens, mask)
+
+        self._embed_fn = _embed
 
     # -- tier access (block manager offload/onboard) -----------------------
 
@@ -339,6 +370,7 @@ class ModelRunner:
         bp = self._bucket_batch(b)
         tp = self._bucket_time(t)
         np_ = self._bucket_pages(batch.block_tables.shape[1])
+        hp = next_pow2(batch.history.shape[1])  # 1 when no penalties in batch
 
         def pad2(a, rows, cols, fill=0):
             out = np.full((rows, cols), fill, a.dtype)
@@ -361,6 +393,9 @@ class ModelRunner:
             top_p=pad1(batch.top_p, bp, fill=1.0),
             seeds=pad1(batch.seeds, bp),
             sample_steps=pad1(batch.sample_steps, bp),
+            freq_pen=pad1(batch.freq_pen, bp),
+            pres_pen=pad1(batch.pres_pen, bp),
+            history=pad2(batch.history, bp, hp, fill=-1),
         )
 
     # -- execution ---------------------------------------------------------
@@ -402,13 +437,14 @@ class ModelRunner:
                 put(padded.last_token_index), put(padded.temperature),
                 put(padded.top_k), put(padded.top_p),
                 put(padded.seeds), put(padded.sample_steps),
+                put(padded.freq_pen), put(padded.pres_pen), put(padded.history),
                 impl=self._select_impl(padded),
             )
         else:
             b, t = padded.tokens.shape
             next_tokens, self.k_cache, self.v_cache = self._step_packed_fn(
                 self.params, self.k_cache, self.v_cache, jnp.asarray(_pack(padded)),
-                b=b, t=t, n=padded.block_tables.shape[1],
+                b=b, t=t, n=padded.block_tables.shape[1], h=padded.history.shape[1],
             )
         return np.asarray(next_tokens)[:b_real]
 
@@ -434,13 +470,15 @@ class ModelRunner:
                 put(padded.block_tables), put(padded.temperature),
                 put(padded.top_k), put(padded.top_p),
                 put(padded.seeds), put(padded.sample_steps),
+                put(padded.freq_pen), put(padded.pres_pen), put(padded.history),
                 num_steps=num_steps,
             )
         else:
             b, t = padded.tokens.shape
             toks, self.k_cache, self.v_cache = self._multi_step_packed_fn(
                 self.params, self.k_cache, self.v_cache, jnp.asarray(_pack(padded)),
-                b=b, t=t, n=padded.block_tables.shape[1], num_steps=num_steps,
+                b=b, t=t, n=padded.block_tables.shape[1], h=padded.history.shape[1],
+                num_steps=num_steps,
             )
         return np.asarray(toks).T[:b_real]  # [B, num_steps]
 
@@ -461,6 +499,7 @@ class ModelRunner:
         padded = self._pad(batch)
         b, t = padded.tokens.shape
         n = padded.block_tables.shape[1]
+        h = padded.history.shape[1]
         packed = jnp.asarray(_pack(padded))
         if chain:
             assert self._chain_tokens is not None and self._chain_tokens.shape[0] == b, (
@@ -468,12 +507,12 @@ class ModelRunner:
             )
             toks, self.k_cache, self.v_cache = self._multi_step_chained_fn(
                 self.params, self.k_cache, self.v_cache, packed, self._chain_tokens,
-                b=b, t=t, n=n, num_steps=num_steps,
+                b=b, t=t, n=n, h=h, num_steps=num_steps,
             )
         else:
             toks, self.k_cache, self.v_cache = self._multi_step_packed_fn(
                 self.params, self.k_cache, self.v_cache, packed,
-                b=b, t=t, n=n, num_steps=num_steps,
+                b=b, t=t, n=n, h=h, num_steps=num_steps,
             )
         self._chain_tokens = toks[num_steps - 1]
         try:  # start the device->host DMA early; overlaps the next burst
@@ -481,6 +520,26 @@ class ModelRunner:
         except Exception:
             pass
         return DeviceTokens(toks, b_real)
+
+    def embed(self, token_lists: list[list[int]]) -> np.ndarray:
+        """Sentence embeddings for N token sequences; returns f32[N, D].
+
+        Runs the cache-free encoder (`models/llama.encode`) — params are
+        read-only and nothing is donated, so this deliberately does NOT take
+        ``io_lock``: embedding traffic must not stall the decode loop.
+        """
+        if not token_lists:
+            return np.zeros((0, self.cfg.hidden_size), np.float32)
+        n = len(token_lists)
+        t = max(1, max(len(ts) for ts in token_lists))
+        bp, tp = next_pow2(n), self._bucket_time(t)
+        tokens = np.zeros((bp, tp), np.int32)
+        mask = np.zeros((bp, tp), bool)
+        for i, ts in enumerate(token_lists):
+            tokens[i, : len(ts)] = ts
+            mask[i, : len(ts)] = True
+        out = self._embed_fn(self.params, jnp.asarray(tokens), jnp.asarray(mask))
+        return np.asarray(out)[:n]
 
     def can_chain(self, batch_size: int) -> bool:
         """True if a chained burst for this real batch size would line up with

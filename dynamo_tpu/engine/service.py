@@ -132,6 +132,25 @@ class JaxEngineService(AsyncEngine[Any, dict]):
     async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
         if isinstance(request, dict):
             request = PreprocessedRequest.from_dict(request)
+        if request.annotations.get("embed"):
+            # Embedding requests bypass the scheduler: the cache-free encoder
+            # shares nothing with the paged decode state (runner.embed). The
+            # request's whole input batch runs as ONE device dispatch; one
+            # output per input streams back, the last carrying the finish.
+            from dynamo_tpu.protocols.common import FinishReason
+
+            inputs = request.annotations.get("embed_inputs") or [list(request.token_ids)]
+            vecs = await asyncio.get_running_loop().run_in_executor(
+                None, self.core.runner.embed, [list(ids) for ids in inputs]
+            )
+            for i, ids in enumerate(inputs):
+                last = i == len(inputs) - 1
+                yield EngineOutput(
+                    token_ids=[], finish_reason=FinishReason.STOP if last else None,
+                    prompt_tokens=len(ids), cached_tokens=0,
+                    embedding=[float(x) for x in vecs[i]],
+                ).to_dict()
+            return
         await self.start()
         out_q: asyncio.Queue = asyncio.Queue()
         await self._intake.put((request, context, out_q))

@@ -60,6 +60,7 @@ class HttpService:
             [
                 web.post("/v1/chat/completions", self.chat_completions),
                 web.post("/v1/completions", self.completions),
+                web.post("/v1/embeddings", self.embeddings),
                 web.get("/v1/models", self.list_models),
                 web.get("/health", self.health),
                 web.get("/live", self.live),
@@ -92,6 +93,72 @@ class HttpService:
     async def completions(self, request: web.Request) -> web.StreamResponse:
         return await self._serve_openai(request, kind="completions")
 
+    async def embeddings(self, request: web.Request) -> web.Response:
+        """OpenAI /v1/embeddings: input = str | [str] | [int] | [[int]].
+
+        Parity: `lib/llm/src/http/service/openai.rs:580`. Each input runs
+        through the same preprocessor -> router -> worker pipeline as chat
+        (annotated ``embed``); the worker answers with one vector.
+        """
+        try:
+            body = await request.json()
+        except Exception:
+            return _error(400, "invalid JSON body")
+        model = body.get("model")
+        if not isinstance(model, str):
+            return _error(400, "missing 'model'")
+        entry = self.manager.get(model)
+        if entry is None:
+            return _error(404, f"model '{model}' not found", "model_not_found")
+        raw = body.get("input")
+        if isinstance(raw, str):
+            inputs: list = [raw]
+        elif isinstance(raw, list) and raw and all(isinstance(t, int) for t in raw):
+            inputs = [raw]  # single pre-tokenized input
+        elif isinstance(raw, list) and raw:
+            inputs = raw
+        else:
+            return _error(400, "missing or empty 'input'")
+
+        async def run_batch() -> tuple[list[list[float]], int]:
+            # One pipeline request carries the whole input batch: the worker
+            # encodes all rows in a single device dispatch (runner.embed).
+            req_body = {"model": model, "prompt": inputs[0], "embed": True,
+                        "embed_batch": inputs[1:]}
+            vecs: list[list[float]] = []
+            tokens = 0
+            async for out in entry.pipeline.generate(req_body, Context()):
+                out = out if isinstance(out, BackendOutput) else BackendOutput.from_dict(out)
+                if out.embedding is not None:
+                    vecs.append(out.embedding)
+                    tokens += out.prompt_tokens or 0
+                if out.finish_reason is not None:
+                    break
+            if len(vecs) != len(inputs):
+                raise RuntimeError(f"worker returned {len(vecs)}/{len(inputs)} embeddings")
+            return vecs, tokens
+
+        with self.metrics.tracker(model, "embeddings") as tracker:
+            try:
+                vecs, total = await run_batch()
+            except ValueError as exc:
+                tracker.status = "invalid"
+                return _error(400, str(exc))
+            except Exception:
+                logger.exception("embeddings failed (model=%s)", model)
+                return _error(500, "internal error", "internal_error")
+        return web.json_response(
+            {
+                "object": "list",
+                "model": model,
+                "data": [
+                    {"object": "embedding", "index": i, "embedding": vec}
+                    for i, vec in enumerate(vecs)
+                ],
+                "usage": {"prompt_tokens": total, "total_tokens": total},
+            }
+        )
+
     async def _serve_openai(self, request: web.Request, *, kind: str) -> web.StreamResponse:
         try:
             body = await request.json()
@@ -120,9 +187,14 @@ class HttpService:
             try:
                 backend_stream = self._backend_stream(entry.pipeline, body, ctx, tracker)
                 if stream_mode:
-                    return await self._stream_response(request, model, kind, ctx, backend_stream, send_usage)
+                    return await self._stream_response(
+                        request, model, kind, ctx, backend_stream, send_usage,
+                        parse_tools=kind == "chat" and bool(body.get("tools")),
+                    )
                 if kind == "chat":
-                    payload = await aggregate_chat(model, backend_stream)
+                    payload = await aggregate_chat(
+                        model, backend_stream, parse_tools=bool(body.get("tools"))
+                    )
                 else:
                     payload = await aggregate_completion(model, backend_stream)
                 return web.json_response(payload)
@@ -149,6 +221,7 @@ class HttpService:
     async def _stream_response(
         self, request: web.Request, model: str, kind: str, ctx: Context,
         backend_stream: AsyncIterator[BackendOutput], send_usage: bool,
+        *, parse_tools: bool = False,
     ) -> web.StreamResponse:
         resp = web.StreamResponse(
             headers={
@@ -159,11 +232,33 @@ class HttpService:
         )
         await resp.prepare(request)
         fmt = ChatStream(model, send_usage=send_usage) if kind == "chat" else CompletionStream(model, send_usage=send_usage)
+        jail = None
+        if parse_tools:
+            from dynamo_tpu.frontend.tool_calls import ToolCallStreamJail
+
+            jail = ToolCallStreamJail()
         try:
             if kind == "chat":
                 await resp.write(sse_encode(fmt.first()))
             async for out in backend_stream:
-                await resp.write(sse_encode(fmt.delta(out)))
+                if jail is None:
+                    await resp.write(sse_encode(fmt.delta(out)))
+                    continue
+                # Tools declared: hold back potential tool-call markup; on
+                # the final delta decide between text and tool_calls finish.
+                safe = jail.push(out.text) if out.text else ""
+                if out.finish_reason is None:
+                    if safe:
+                        await resp.write(sse_encode(fmt.text_chunk(safe)))
+                    continue
+                trailing, calls = jail.finish()
+                if calls:
+                    if safe:
+                        await resp.write(sse_encode(fmt.text_chunk(safe)))
+                    await resp.write(sse_encode(fmt.tool_calls_final(calls, out)))
+                else:
+                    out.text = safe + trailing
+                    await resp.write(sse_encode(fmt.delta(out)))
             await resp.write(SSE_DONE)
         except (ConnectionResetError, asyncio.CancelledError):
             logger.info("client disconnected; cancelling %s", ctx.id)

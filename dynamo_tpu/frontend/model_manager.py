@@ -114,6 +114,7 @@ async def build_pipeline(
         tokenizer,
         chat_template=card.chat_template,
         default_max_tokens=max(1, min(card.context_length // 2, 4096)),
+        max_embed_tokens=max(1, min(card.context_length, 2048)),
     )
     return pre, client, aux
 

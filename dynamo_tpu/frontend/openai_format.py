@@ -75,6 +75,21 @@ class ChatStream:
             usage=usage,
         )
 
+    def text_chunk(self, text: str) -> dict[str, Any]:
+        return self._chunk({"content": text})
+
+    def tool_calls_final(self, calls: list[dict[str, Any]], out: BackendOutput) -> dict[str, Any]:
+        """Terminal chunk carrying the parsed tool calls (streaming shape:
+        each call gets a list index) with finish_reason "tool_calls"."""
+        usage = None
+        if self.send_usage:
+            usage = _usage(out.prompt_tokens, out.cumulative_tokens, out.cached_tokens)
+        deltas = [
+            {"index": i, "id": c["id"], "type": c["type"], "function": c["function"]}
+            for i, c in enumerate(calls)
+        ]
+        return self._chunk({"tool_calls": deltas}, finish="tool_calls", usage=usage)
+
 
 class CompletionStream:
     """Builds text_completion chunks from BackendOutput deltas."""
@@ -100,8 +115,14 @@ class CompletionStream:
         return chunk
 
 
-async def aggregate_chat(model: str, stream: AsyncIterator[BackendOutput]) -> dict[str, Any]:
-    """Drain a backend stream into a full chat.completion response."""
+async def aggregate_chat(
+    model: str, stream: AsyncIterator[BackendOutput], *, parse_tools: bool = False
+) -> dict[str, Any]:
+    """Drain a backend stream into a full chat.completion response.
+
+    ``parse_tools`` (set when the request declared ``tools``) lifts emitted
+    tool-call blocks into ``message.tool_calls`` / ``finish_reason:
+    "tool_calls"`` (see `frontend/tool_calls.py`)."""
     text_parts: list[str] = []
     finish: FinishReason | None = None
     prompt_tokens = cached = None
@@ -112,6 +133,16 @@ async def aggregate_chat(model: str, stream: AsyncIterator[BackendOutput]) -> di
         if out.finish_reason is not None:
             finish = out.finish_reason
             prompt_tokens, cached = out.prompt_tokens, out.cached_tokens
+    text = "".join(text_parts)
+    message: dict[str, Any] = {"role": "assistant", "content": text}
+    finish_str = _finish_str(finish) or "stop"
+    if parse_tools:
+        from dynamo_tpu.frontend.tool_calls import parse_tool_calls
+
+        content, calls = parse_tool_calls(text)
+        if calls:
+            message = {"role": "assistant", "content": content or None, "tool_calls": calls}
+            finish_str = "tool_calls"
     return {
         "id": new_request_id("chatcmpl"),
         "object": "chat.completion",
@@ -120,8 +151,8 @@ async def aggregate_chat(model: str, stream: AsyncIterator[BackendOutput]) -> di
         "choices": [
             {
                 "index": 0,
-                "message": {"role": "assistant", "content": "".join(text_parts)},
-                "finish_reason": _finish_str(finish) or "stop",
+                "message": message,
+                "finish_reason": finish_str,
             }
         ],
         "usage": _usage(prompt_tokens, completion_tokens, cached),

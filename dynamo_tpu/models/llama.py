@@ -261,3 +261,56 @@ def forward(
     # embedding matrix per step.
     logits = jnp.matmul(last, head, preferred_element_type=jnp.float32)  # [B, vocab]
     return logits, k_out, v_out
+
+
+def encode(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # i32[B, T]
+    mask: jnp.ndarray,  # bool[B, T] — True on real tokens
+) -> jnp.ndarray:
+    """Sentence-embedding forward: hidden states, mean-pooled, L2-normalized.
+
+    Runs the same stacked-layer scan as :func:`forward` but with plain
+    in-batch causal attention — no paged cache, nothing donated, so it can
+    run concurrently with serving steps. Returns f32[B, D].
+
+    Parity: the reference's /v1/embeddings route + EmbeddingEngine adapter
+    (`lib/llm/src/http/service/openai.rs:580`, `engines.rs:321`); pooling
+    follows the common decoder-LLM embedding recipe (masked mean of the
+    final hidden states).
+    """
+    b, t = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
+    inv_freq = jnp.asarray(rope_frequencies(cfg.head_dim, theta=cfg.rope_theta, scaling=cfg.rope_scaling))
+    x = params["embed"][tokens]  # [B, T, D]
+
+    causal = jnp.tril(jnp.ones((t, t), bool))
+    attendable = causal[None, :, :] & mask[:, None, :]  # [B, Tq, Tk]
+    bias = jnp.where(attendable, 0.0, -jnp.inf).astype(jnp.float32)[:, None, :, :]
+    groups = cfg.num_heads // cfg.num_kv_heads
+    scale = cfg.head_dim**-0.5
+
+    def layer_step(x, lp):
+        h = rms_norm(x, lp["attn_norm"], eps=cfg.rms_eps)
+        qp, kp, vp = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]
+        if cfg.attention_bias:
+            qp, kp, vp = qp + lp["bq"], kp + lp["bk"], vp + lp["bv"]
+        q = apply_rope(qp.reshape(b, t, cfg.num_heads, cfg.head_dim), positions, inv_freq)
+        k = apply_rope(kp.reshape(b, t, cfg.num_kv_heads, cfg.head_dim), positions, inv_freq)
+        v = vp.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+        q = q.reshape(b, t, cfg.num_kv_heads, groups, cfg.head_dim)
+        scores = jnp.einsum("bqhgd,bkhd->bhgqk", q, k).astype(jnp.float32) * scale
+        scores = scores + bias[:, :, None, :, :]
+        probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+        attn = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v).reshape(b, t, cfg.q_dim)
+        x = x + attn @ lp["wo"]
+        h2 = rms_norm(x, lp["mlp_norm"], eps=cfg.rms_eps)
+        mlp = _mlp_moe(lp, h2, cfg) if cfg.is_moe else _mlp_dense(lp, h2)
+        return x + mlp, None
+
+    x, _ = jax.lax.scan(layer_step, x, params["layers"])
+    x = rms_norm(x, params["norm_f"], eps=cfg.rms_eps).astype(jnp.float32)
+    m = mask[:, :, None].astype(jnp.float32)
+    pooled = (x * m).sum(1) / jnp.maximum(m.sum(1), 1.0)
+    return pooled / jnp.maximum(jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9)

@@ -43,13 +43,43 @@ def sample_tokens(
     temperature: jnp.ndarray,  # f32[B]; 0 => greedy
     top_k: jnp.ndarray,  # i32[B]; <=0 => disabled
     top_p: jnp.ndarray,  # f32[B]; >=1 => disabled
+    history: jnp.ndarray | None = None,  # i32[B, H] generated-so-far (pad -1)
+    frequency_penalty: jnp.ndarray | None = None,  # f32[B]
+    presence_penalty: jnp.ndarray | None = None,  # f32[B]
 ) -> jnp.ndarray:
-    """Sample one token per row; returns i32[B]."""
+    """Sample one token per row; returns i32[B].
+
+    Frequency/presence penalties follow the OpenAI semantics over *generated*
+    tokens (``logit -= freq * count + pres * (count > 0)``), computed inside
+    the candidate window: counting 256 candidates against the history costs
+    B*256*H comparisons — noise next to the forward pass — where a full
+    [B, vocab] count tensor would not fit the per-step budget. A penalized
+    greedy row takes the penalized window argmax instead of the exact
+    full-row argmax (the true winner is in the window unless penalties
+    demote all 256 candidates at once).
+    """
     logits = logits.astype(jnp.float32)
     cand = min(CANDIDATES, logits.shape[-1])
     top_logits, top_idx = jax.lax.approx_max_k(logits, cand)  # [B, cand], descending
 
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # exact, sort-free
+
+    penalized = history is not None and frequency_penalty is not None and presence_penalty is not None
+    if penalized:
+        # counts[b, c] = occurrences of candidate c in row b's history.
+        counts = (history[:, None, :] == top_idx[:, :, None]).sum(-1).astype(jnp.float32)
+        top_logits = top_logits - (
+            frequency_penalty[:, None] * counts
+            + presence_penalty[:, None] * (counts > 0)
+        )
+        # Penalties break the window's descending order, which the top-k rank
+        # mask and top-p cumulative mass below depend on. Re-sort within the
+        # window (256-wide: trivial next to the forward pass).
+        order = jnp.argsort(-top_logits, axis=-1)
+        top_logits = jnp.take_along_axis(top_logits, order, axis=-1)
+        top_idx = jnp.take_along_axis(top_idx, order, axis=-1)
+        has_pen = (frequency_penalty != 0) | (presence_penalty != 0)
+        greedy = jnp.where(has_pen, top_idx[:, 0].astype(jnp.int32), greedy)
 
     safe_temp = jnp.where(temperature > 0, temperature, 1.0)
     scaled = top_logits / safe_temp[:, None]

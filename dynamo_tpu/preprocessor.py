@@ -98,18 +98,26 @@ class OpenAIPreprocessor(Operator):
         chat_template: str | None = None,
         default_max_tokens: int = 512,
         add_bos: bool = True,
+        max_embed_tokens: int = 2048,
     ) -> None:
         super().__init__(downstream)
         self.tokenizer = tokenizer
         self.formatter = PromptFormatter(chat_template)
         self.default_max_tokens = default_max_tokens
         self.add_bos = add_bos
+        self.max_embed_tokens = max_embed_tokens
 
     def preprocess(self, body: dict[str, Any]) -> PreprocessedRequest:
         prompt: str | None
         token_ids: list[int] | None = None
         if "messages" in body:
-            prompt = self.formatter.render(body["messages"], add_generation_prompt=True)
+            extra = {}
+            if body.get("tools"):
+                # Tool schemas render through the model's chat template (HF
+                # templates accept a `tools` kwarg); responses are parsed by
+                # frontend/tool_calls.py.
+                extra["tools"] = body["tools"]
+            prompt = self.formatter.render(body["messages"], add_generation_prompt=True, **extra)
         else:
             raw = body.get("prompt", "")
             if isinstance(raw, str):
@@ -135,6 +143,31 @@ class OpenAIPreprocessor(Operator):
             req.annotations["formatted_prompt"] = prompt
         if "token_ids" in annotations:
             req.annotations["token_ids"] = list(token_ids)
+        if body.get("embed"):
+            # /v1/embeddings: the engine runs the encoder, not generation.
+            # All inputs of the request ride as one annotated batch so the
+            # worker encodes them in a single device dispatch; lengths are
+            # capped because the encoder materializes O(T^2) attention
+            # (unlike the paged generation path).
+            inputs = [token_ids]
+            for item in body.get("embed_batch") or []:
+                if isinstance(item, list) and all(isinstance(t, int) for t in item):
+                    inputs.append(list(item))
+                elif isinstance(item, str):
+                    inputs.append(self.tokenizer.encode(item, add_bos=self.add_bos))
+                else:
+                    raise ValueError("embedding inputs must be strings or token-id arrays")
+            for ids in inputs:
+                if not ids:
+                    raise ValueError("embedding input must not be empty")
+                if len(ids) > self.max_embed_tokens:
+                    raise ValueError(
+                        f"embedding input of {len(ids)} tokens exceeds the "
+                        f"{self.max_embed_tokens}-token limit"
+                    )
+            req.annotations["embed"] = True
+            req.annotations["embed_inputs"] = inputs
+            req.stop.max_tokens = 1
         return req
 
     async def transform_request(self, request: Any, context: Context) -> dict:

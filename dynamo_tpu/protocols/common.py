@@ -102,6 +102,7 @@ class BackendOutput:
     cumulative_tokens: int = 0
     prompt_tokens: int | None = None
     cached_tokens: int | None = None
+    embedding: list[float] | None = None  # /v1/embeddings result (no tokens stream)
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -111,6 +112,7 @@ class BackendOutput:
             "cumulative_tokens": self.cumulative_tokens,
             "prompt_tokens": self.prompt_tokens,
             "cached_tokens": self.cached_tokens,
+            "embedding": self.embedding,
         }
 
     @classmethod
@@ -123,6 +125,7 @@ class BackendOutput:
             cumulative_tokens=d.get("cumulative_tokens", 0),
             prompt_tokens=d.get("prompt_tokens"),
             cached_tokens=d.get("cached_tokens"),
+            embedding=d.get("embedding"),
         )
 
 
@@ -136,6 +139,7 @@ class EngineOutput:
     # Usage metadata on the final delta.
     prompt_tokens: int | None = None
     cached_tokens: int | None = None
+    embedding: list[float] | None = None  # /v1/embeddings result (no tokens stream)
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -144,6 +148,7 @@ class EngineOutput:
             "cumulative_tokens": self.cumulative_tokens,
             "prompt_tokens": self.prompt_tokens,
             "cached_tokens": self.cached_tokens,
+            "embedding": self.embedding,
         }
 
     @classmethod
@@ -155,4 +160,5 @@ class EngineOutput:
             cumulative_tokens=d.get("cumulative_tokens", 0),
             prompt_tokens=d.get("prompt_tokens"),
             cached_tokens=d.get("cached_tokens"),
+            embedding=d.get("embedding"),
         )

@@ -160,3 +160,78 @@ async def test_concurrent_requests_share_engine():
             assert await one("p0") == results[0]
     finally:
         await stop_stack(handles)
+
+
+async def test_embeddings_endpoint():
+    """/v1/embeddings serves normalized vectors through the full pipeline
+    (preprocess -> route -> worker encoder) for string and batch inputs."""
+    import math
+
+    handles, base = await start_stack()
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "test-tiny", "input": ["hello world", "different text"]}
+            async with s.post(base + "/v1/embeddings", json=body) as r:
+                assert r.status == 200, await r.text()
+                out = await r.json()
+            assert out["object"] == "list" and len(out["data"]) == 2
+            v0 = out["data"][0]["embedding"]
+            v1 = out["data"][1]["embedding"]
+            assert len(v0) == len(v1) > 0
+            assert abs(math.fsum(x * x for x in v0) - 1.0) < 1e-3  # L2-normalized
+            assert v0 != v1
+            assert out["usage"]["prompt_tokens"] > 0
+
+            # Same input -> identical embedding (deterministic encoder).
+            async with s.post(base + "/v1/embeddings", json={"model": "test-tiny", "input": "hello world"}) as r:
+                again = (await r.json())["data"][0]["embedding"]
+            assert again == v0
+
+            # Error paths.
+            async with s.post(base + "/v1/embeddings", json={"model": "nope", "input": "x"}) as r:
+                assert r.status == 404
+            async with s.post(base + "/v1/embeddings", json={"model": "test-tiny"}) as r:
+                assert r.status == 400
+    finally:
+        await stop_stack(handles)
+
+
+async def test_embeddings_rejects_bad_inputs():
+    handles, base = await start_stack()
+    try:
+        async with aiohttp.ClientSession() as s:
+            # Empty token-id input -> 400 (would otherwise produce NaN vectors).
+            async with s.post(base + "/v1/embeddings", json={"model": "test-tiny", "input": [[]]}) as r:
+                assert r.status == 400, await r.text()
+            # Over-long input -> 400 (the encoder materializes O(T^2) attention).
+            async with s.post(base + "/v1/embeddings",
+                              json={"model": "test-tiny", "input": list(range(1, 90000))}) as r:
+                assert r.status == 400
+    finally:
+        await stop_stack(handles)
+
+
+def test_streaming_tool_calls_format():
+    """stream=true with tools: tool-call markup is jailed and delivered as a
+    tool_calls delta with finish_reason tool_calls (formatter-level check of
+    the exact path _stream_response walks)."""
+    from dynamo_tpu.frontend.openai_format import ChatStream
+    from dynamo_tpu.frontend.tool_calls import ToolCallStreamJail
+    from dynamo_tpu.protocols.common import BackendOutput, FinishReason
+
+    jail = ToolCallStreamJail()
+    fmt = ChatStream("m")
+    chunks = []
+    for piece, fin in [("<tool_call>", None), ('{"name":"f","arguments":{}}', None),
+                       ("</tool_call>", FinishReason.STOP)]:
+        safe = jail.push(piece)
+        if fin is None:
+            if safe:
+                chunks.append(fmt.text_chunk(safe))
+        else:
+            trailing, calls = jail.finish()
+            assert calls
+            chunks.append(fmt.tool_calls_final(calls, BackendOutput(finish_reason=fin)))
+    last = chunks[-1]["choices"][0]
+    assert last["finish_reason"] == "tool_calls"
+    assert last["delta"]["tool_calls"][0]["function"]["name"] == "f"

@@ -124,6 +124,9 @@ def test_select_impl_ring_conditions():
             top_p=np.ones(b, np.float32),
             seeds=np.zeros(b, np.uint32),
             sample_steps=np.zeros(b, np.int32),
+            freq_pen=np.zeros(b, np.float32),
+            pres_pen=np.zeros(b, np.float32),
+            history=np.full((b, 1), -1, np.int32),
         )
 
     assert runner._select_impl(batch(16, 0)) == "ring"      # whole-prompt prefill

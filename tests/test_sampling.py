@@ -1,0 +1,146 @@
+"""Sampler unit tests: penalties, candidate-window behavior, determinism.
+
+Parity: OpenAI frequency/presence penalty semantics the reference accepts in
+its request schema (`lib/llm/src/protocols/openai/*`) and hands to engines.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.ops.sampling import sample_tokens
+
+
+def _keys(b, seed=0):
+    return jax.vmap(jax.random.PRNGKey)(np.arange(seed, seed + b, dtype=np.uint32))
+
+
+def test_greedy_is_exact_argmax():
+    logits = jnp.asarray(np.random.default_rng(0).standard_normal((4, 1000)), jnp.float32)
+    toks = sample_tokens(logits, _keys(4), jnp.zeros(4), jnp.zeros(4, jnp.int32), jnp.ones(4))
+    np.testing.assert_array_equal(np.asarray(toks), np.argmax(np.asarray(logits), -1))
+
+
+def test_frequency_penalty_demotes_repeated_token():
+    """A token that dominates the logits but already appeared H times loses
+    to the runner-up once freq_penalty * H exceeds the logit gap."""
+    b, v = 2, 512
+    logits = np.zeros((b, v), np.float32)
+    logits[:, 7] = 5.0  # dominant
+    logits[:, 3] = 4.5  # runner-up
+    history = np.full((b, 8), -1, np.int32)
+    history[0, :4] = 7  # row 0: token 7 already emitted 4 times
+    # row 1: clean history
+    freq = np.asarray([0.5, 0.5], np.float32)  # 0.5 * 4 = 2.0 > gap 0.5
+    pres = np.zeros(b, np.float32)
+    toks = sample_tokens(
+        jnp.asarray(logits), _keys(b), jnp.zeros(b), jnp.zeros(b, jnp.int32), jnp.ones(b),
+        history=jnp.asarray(history), frequency_penalty=jnp.asarray(freq),
+        presence_penalty=jnp.asarray(pres),
+    )
+    assert int(toks[0]) == 3  # demoted
+    assert int(toks[1]) == 7  # untouched
+
+
+def test_presence_penalty_is_count_independent():
+    """Presence penalty applies once regardless of occurrence count."""
+    b, v = 2, 512
+    logits = np.zeros((b, v), np.float32)
+    logits[:, 7] = 5.0
+    logits[:, 3] = 4.8
+    history = np.full((b, 8), -1, np.int32)
+    history[0, 0] = 7   # once
+    history[1, :6] = 7  # six times
+    pres = np.full(b, 0.3, np.float32)  # 0.3 > gap 0.2: demoted either way
+    freq = np.zeros(b, np.float32)
+    toks = sample_tokens(
+        jnp.asarray(logits), _keys(b), jnp.zeros(b), jnp.zeros(b, jnp.int32), jnp.ones(b),
+        history=jnp.asarray(history), frequency_penalty=jnp.asarray(freq),
+        presence_penalty=jnp.asarray(pres),
+    )
+    assert int(toks[0]) == 3 and int(toks[1]) == 3
+
+
+def test_zero_penalties_match_unpenalized_path():
+    rng = np.random.default_rng(1)
+    b, v = 4, 2048
+    logits = jnp.asarray(rng.standard_normal((b, v)), jnp.float32)
+    history = jnp.asarray(rng.integers(0, v, (b, 16)), jnp.int32)
+    kw = dict(temperature=jnp.full(b, 0.8), top_k=jnp.full(b, 40, jnp.int32), top_p=jnp.full(b, 0.95))
+    base = sample_tokens(logits, _keys(b, 9), kw["temperature"], kw["top_k"], kw["top_p"])
+    pen = sample_tokens(
+        logits, _keys(b, 9), kw["temperature"], kw["top_k"], kw["top_p"],
+        history=history, frequency_penalty=jnp.zeros(b), presence_penalty=jnp.zeros(b),
+    )
+    np.testing.assert_array_equal(np.asarray(base), np.asarray(pen))
+
+
+def test_engine_applies_penalties_end_to_end():
+    """A strong frequency penalty must change what the engine generates vs
+    the same seeded request without it (the API contract: the parameter is
+    applied, not silently dropped)."""
+    from dynamo_tpu.engine.core import EngineConfig, EngineCore
+    from dynamo_tpu.engine.runner import ModelRunner
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import PRESETS
+    from dynamo_tpu.protocols.common import PreprocessedRequest, SamplingOptions, StopConditions
+
+    cfg = PRESETS["test-tiny"]
+    params = llama.init_params(cfg, 0)
+
+    def run(freq_pen):
+        runner = ModelRunner(cfg, params, num_pages=64, page_size=4, max_batch_size=4)
+        core = EngineCore(runner, EngineConfig(num_pages=64, page_size=4, max_batch_size=4,
+                                               decode_steps=4))
+        req = PreprocessedRequest(
+            token_ids=[5, 6, 7, 8, 9, 10, 11, 12],
+            sampling=SamplingOptions(temperature=0.0, frequency_penalty=freq_pen),
+            stop=StopConditions(max_tokens=24, ignore_eos=True),
+        )
+        seq = core.add_request(req)
+        while not seq.is_finished:
+            core.step()
+        return seq.tokens[seq.num_prompt:]
+
+    plain = run(0.0)
+    penalized = run(2.0)
+    assert len(plain) == len(penalized) == 24
+    # Greedy tiny-model output loops hard; the penalty must break the loop.
+    assert plain != penalized
+    top_plain = max(plain.count(t) for t in set(plain))
+    top_pen = max(penalized.count(t) for t in set(penalized))
+    assert top_pen < top_plain, (top_plain, top_pen)
+
+
+def test_penalty_respects_topk_ordering():
+    """Regression: penalties must re-sort the candidate window, or top_k=1
+    keeps sampling the demoted pre-penalty winner."""
+    b, v = 1, 512
+    logits = np.zeros((b, v), np.float32)
+    logits[:, 7] = 5.0
+    logits[:, 3] = 4.5
+    history = np.full((b, 8), -1, np.int32)
+    history[0, :4] = 7
+    toks = sample_tokens(
+        jnp.asarray(logits), _keys(b), jnp.ones(b), jnp.full(b, 1, jnp.int32), jnp.ones(b),
+        history=jnp.asarray(history), frequency_penalty=jnp.full(b, 1.0),
+        presence_penalty=jnp.zeros(b),
+    )
+    assert int(toks[0]) == 3  # top_k=1 must pick the *post-penalty* max
+
+
+def test_penalty_respects_topp_mass():
+    """With top_p ~0, only the post-penalty argmax may be sampled."""
+    b, v = 1, 512
+    logits = np.zeros((b, v), np.float32)
+    logits[:, 7] = 8.0
+    logits[:, 3] = 7.0
+    history = np.full((b, 4), -1, np.int32)
+    history[0, :2] = 7
+    toks = sample_tokens(
+        jnp.asarray(logits), _keys(b), jnp.ones(b), jnp.zeros(b, jnp.int32),
+        jnp.full(b, 0.01),
+        history=jnp.asarray(history), frequency_penalty=jnp.full(b, 2.0),
+        presence_penalty=jnp.zeros(b),
+    )
+    assert int(toks[0]) == 3

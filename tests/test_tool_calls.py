@@ -1,0 +1,123 @@
+"""Tool-call parsing unit tests + aggregation integration.
+
+Parity: reference `preprocessor/tools/*` response parsing lifted to
+OpenAI `message.tool_calls` shape."""
+
+import asyncio
+import json
+
+from dynamo_tpu.frontend.tool_calls import parse_tool_calls
+
+
+def test_hermes_style_single_call():
+    text = 'Let me check.\n<tool_call>\n{"name": "get_weather", "arguments": {"city": "Paris"}}\n</tool_call>'
+    content, calls = parse_tool_calls(text)
+    assert content == "Let me check."
+    assert len(calls) == 1
+    c = calls[0]
+    assert c["type"] == "function" and c["function"]["name"] == "get_weather"
+    assert json.loads(c["function"]["arguments"]) == {"city": "Paris"}
+    assert c["id"].startswith("call_")
+
+
+def test_hermes_style_multiple_calls():
+    text = (
+        '<tool_call>{"name": "a", "arguments": {}}</tool_call>'
+        '<tool_call>{"name": "b", "arguments": {"x": 1}}</tool_call>'
+    )
+    content, calls = parse_tool_calls(text)
+    assert content == ""
+    assert [c["function"]["name"] for c in calls] == ["a", "b"]
+
+
+def test_llama3_bare_json_call():
+    text = '{"name": "search", "parameters": {"query": "tpu"}}'
+    content, calls = parse_tool_calls(text)
+    assert content == ""
+    assert calls[0]["function"]["name"] == "search"
+    assert json.loads(calls[0]["function"]["arguments"]) == {"query": "tpu"}
+
+
+def test_plain_text_untouched():
+    for text in ("just a normal answer", '{"not_a_call": true}', "<tool_call>broken json</tool_call>"):
+        content, calls = parse_tool_calls(text)
+        assert calls == []
+        assert content == text
+
+
+def test_aggregate_chat_lifts_tool_calls():
+    from dynamo_tpu.frontend.openai_format import aggregate_chat
+    from dynamo_tpu.protocols.common import BackendOutput, FinishReason
+
+    async def stream():
+        yield BackendOutput(text='<tool_call>{"name": "f", "arguments": {"k": 2}}')
+        yield BackendOutput(text="</tool_call>", finish_reason=FinishReason.STOP,
+                            cumulative_tokens=12, prompt_tokens=5)
+
+    async def run(parse):
+        return await aggregate_chat("m", stream(), parse_tools=parse)
+
+    out = asyncio.run(run(True))
+    choice = out["choices"][0]
+    assert choice["finish_reason"] == "tool_calls"
+    assert choice["message"]["tool_calls"][0]["function"]["name"] == "f"
+    assert choice["message"]["content"] is None
+
+    out2 = asyncio.run(run(False))  # no tools declared: text passes through
+    assert out2["choices"][0]["finish_reason"] == "stop"
+    assert "tool_call" in out2["choices"][0]["message"]["content"]
+
+
+def test_template_receives_tools():
+    from dynamo_tpu.preprocessor import PromptFormatter
+
+    tmpl = (
+        "{% for m in messages %}{{ m.content }}{% endfor %}"
+        "{% if tools %}TOOLS:{{ tools | length }}{% endif %}"
+    )
+    f = PromptFormatter(tmpl)
+    out = f.render([{"role": "user", "content": "hi"}], tools=[{"type": "function"}])
+    assert out.endswith("TOOLS:1")
+
+
+def test_stream_jail_releases_plain_text():
+    from dynamo_tpu.frontend.tool_calls import ToolCallStreamJail
+
+    j = ToolCallStreamJail()
+    got = "".join(j.push(c) for c in ["Hello ", "wor", "ld!"])
+    trailing, calls = j.finish()
+    assert got + trailing == "Hello world!"
+    assert calls == []
+
+
+def test_stream_jail_holds_marker_and_parses():
+    from dynamo_tpu.frontend.tool_calls import ToolCallStreamJail
+
+    j = ToolCallStreamJail()
+    pieces = ["Sure. <tool", '_call>{"name": "f", ', '"arguments": {}}</tool_call>']
+    got = "".join(j.push(p) for p in pieces)
+    assert "tool_call" not in got  # markup never leaked
+    assert got.startswith("Sure.")
+    trailing, calls = j.finish()
+    assert calls and calls[0]["function"]["name"] == "f"
+
+
+def test_stream_jail_bare_json_buffered():
+    from dynamo_tpu.frontend.tool_calls import ToolCallStreamJail
+
+    j = ToolCallStreamJail()
+    assert j.push('{"name": "g", ') == ""
+    assert j.push('"parameters": {"a": 1}}') == ""
+    trailing, calls = j.finish()
+    assert calls[0]["function"]["name"] == "g"
+    assert trailing == ""
+
+
+def test_stream_jail_false_positive_flushes_as_text():
+    from dynamo_tpu.frontend.tool_calls import ToolCallStreamJail
+
+    j = ToolCallStreamJail()
+    out = j.push("answer is <tool_call>not json")
+    trailing, calls = j.finish()
+    assert calls == []
+    assert out + trailing == "answer is <tool_call>not json"
