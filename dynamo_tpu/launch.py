@@ -50,6 +50,9 @@ class WorkerSpec:
     # GSPMD execution: a parallel.mesh.MeshPlan, or "auto" to derive one from
     # the device count and model shape (tp <= kv heads, ep for wide MoE).
     mesh_plan: Any = None
+    # Timing-model engine instead of JAX (planner/router fleets in CI and the
+    # planner's local connector; parity: reference mocker, SURVEY.md row 35).
+    mock: bool = False
 
     @classmethod
     def from_preset(cls, preset: str, *, card: ModelDeploymentCard | None = None, **engine_kw: Any) -> "WorkerSpec":
@@ -121,6 +124,11 @@ def make_worker_spec(model: str, **engine_kw: Any) -> WorkerSpec:
 
 
 async def build_engine_service(spec: WorkerSpec, *, on_kv_event=None) -> JaxEngineService:
+    if spec.mock:
+        from dynamo_tpu.mocker import build_mock_core
+
+        return await JaxEngineService(build_mock_core(spec.engine_config, on_kv_event=on_kv_event)).start()
+
     def _build() -> ModelRunner:
         # Device work (param init, cache allocation) can take seconds on a
         # remote/real chip — keep it off the event loop so lease keep-alives
@@ -281,12 +289,14 @@ async def run_local(
     g2_blocks = engine_kw.pop("g2_blocks", 0)
     g3_blocks = engine_kw.pop("g3_blocks", 0)
     mesh_plan = engine_kw.pop("mesh", None)
+    mock = engine_kw.pop("mock", False)
     total_workers = num_workers + num_prefill_workers
 
     def make_spec(i: int) -> WorkerSpec:
         spec = make_worker_spec(preset, **engine_kw)
         spec.card.router_mode = router_mode
         spec.mesh_plan = mesh_plan
+        spec.mock = mock
         if g2_blocks or g3_blocks:
             from dynamo_tpu.blocks import BlockManagerConfig
 
@@ -369,11 +379,13 @@ async def run_role(args: argparse.Namespace) -> None:
         spec = make_worker_spec(args.model, num_pages=args.num_pages, max_batch_size=args.max_batch_size)
         spec.card.router_mode = args.router_mode
         spec.mesh_plan = _parse_mesh(args.mesh)
+        spec.mock = args.mock
         await serve_worker(runtime, spec, disagg=disagg)
         logger.info("worker ready")
     elif args.role == "prefill":
         spec = make_worker_spec(args.model, num_pages=args.num_pages, max_batch_size=args.max_batch_size)
         spec.mesh_plan = _parse_mesh(args.mesh)
+        spec.mock = args.mock
         await serve_prefill_worker(runtime, spec)
         logger.info("prefill worker ready")
     elif args.role == "store":
@@ -406,6 +418,7 @@ async def _amain(args: argparse.Namespace) -> None:
         max_batch_size=args.max_batch_size,
         g2_blocks=args.g2_blocks,
         g3_blocks=args.g3_blocks,
+        mock=args.mock,
     )
     logger.info("serving %s on port %d", args.model, handles["port"])
     try:
@@ -431,6 +444,7 @@ def main(argv: list[str] | None = None) -> None:
         help="multi-process deployments: run one role per process",
     )
     parser.add_argument("--store", default=None, help="tcp://host:port of the deployment's store server")
+    parser.add_argument("--mock", action="store_true", help="timing-model engine instead of JAX (fleet tests, planner)")
     parser.add_argument("--serve-store-port", type=int, default=None, help="run the store server in this process")
     parser.add_argument(
         "--disagg-threshold", type=int, default=None,

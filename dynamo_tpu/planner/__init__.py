@@ -14,6 +14,7 @@ interpolation, and local/k8s connectors.
   fleets (tests, single node) or subprocess fleets via the launch CLI.
 """
 
+from dynamo_tpu.planner.connector import LocalProcessConnector, PlannerLoop
 from dynamo_tpu.planner.core import Planner, PlannerConfig, WorkerProfile
 from dynamo_tpu.planner.predictor import ConstantPredictor, LinearTrendPredictor, MovingAveragePredictor
 
@@ -21,6 +22,8 @@ __all__ = [
     "Planner",
     "PlannerConfig",
     "WorkerProfile",
+    "LocalProcessConnector",
+    "PlannerLoop",
     "ConstantPredictor",
     "MovingAveragePredictor",
     "LinearTrendPredictor",

@@ -1,0 +1,230 @@
+"""Planner actuation: decisions -> live fleet changes.
+
+``LocalProcessConnector`` manages worker OS processes the way the
+reference's circus-based local connector does
+(`components/planner/.../local_connector.py:105-197`, `circusd.py`): each
+decode/prefill worker is a ``python -m dynamo_tpu.launch --role ...``
+subprocess joined to the deployment's store. Scaling up spawns processes;
+scaling down terminates the youngest (lease expiry then removes the
+instance from discovery, the router index drops its blocks — the same
+teardown path as a crash, exercised by the failure tests).
+
+``PlannerLoop`` closes the control loop: scrape the metrics plane ->
+observe/predict/decide (`planner/core.py`) -> apply via a connector.
+Parity: reference `planner_core.py:285` run loop + `planner_sla.py`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import subprocess
+import sys
+import threading
+import time
+from typing import Protocol
+
+from dynamo_tpu.planner.core import PlanDecision, Planner
+from dynamo_tpu.router.metrics import KvMetricsAggregator
+
+logger = logging.getLogger(__name__)
+
+
+class Connector(Protocol):
+    async def apply(self, decision: PlanDecision) -> None: ...
+    async def close(self) -> None: ...
+
+
+class LocalProcessConnector:
+    """Scales decode/prefill fleets as launch.py subprocesses."""
+
+    def __init__(
+        self,
+        *,
+        model: str,
+        store_url: str,
+        host: str = "127.0.0.1",
+        mock: bool = False,
+        extra_args: list[str] | None = None,
+        spawn_timeout: float = 60.0,
+    ) -> None:
+        self.model = model
+        self.store_url = store_url
+        self.host = host
+        self.mock = mock
+        self.extra_args = list(extra_args or [])
+        self.spawn_timeout = spawn_timeout
+        self._decode: list[subprocess.Popen] = []
+        self._prefill: list[subprocess.Popen] = []
+        self.scale_events = 0
+
+    # -- process management ------------------------------------------------
+
+    def _spawn(self, role: str) -> subprocess.Popen:
+        import os
+
+        import dynamo_tpu
+
+        cmd = [
+            sys.executable, "-m", "dynamo_tpu.launch",
+            "--role", role, "--model", self.model,
+            "--store", self.store_url, "--host", self.host,
+        ]
+        if self.mock:
+            cmd.append("--mock")
+        cmd += self.extra_args
+        # The child must resolve this package regardless of the planner's
+        # cwd (the launch CLI may be run from anywhere).
+        env = dict(os.environ)
+        pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(dynamo_tpu.__file__)))
+        env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+        logger.info("spawned %s worker pid=%d", role, proc.pid)
+        return proc
+
+    async def _wait_ready(self, proc: subprocess.Popen) -> None:
+        """Wait (bounded) for the worker's READY line, then keep its pipe
+        drained for life — an undrained 64KB pipe would eventually block the
+        worker's own log writes and wedge it mid-serve."""
+
+        def read() -> None:
+            while True:
+                line = proc.stdout.readline() if proc.stdout else ""
+                if not line:  # EOF: the child exited before READY
+                    raise RuntimeError(f"worker pid={proc.pid} exited rc={proc.poll()} before READY")
+                if line.startswith("READY"):
+                    return
+
+        try:
+            await asyncio.wait_for(
+                asyncio.get_running_loop().run_in_executor(None, read), self.spawn_timeout
+            )
+        except (asyncio.TimeoutError, TimeoutError):
+            # Killing the child EOFs the pipe, unblocking the reader thread.
+            proc.kill()
+            raise TimeoutError(f"worker pid={proc.pid} not ready in {self.spawn_timeout}s") from None
+        threading.Thread(target=self._drain, args=(proc,), daemon=True).start()
+
+    @staticmethod
+    def _drain(proc: subprocess.Popen) -> None:
+        try:
+            while proc.stdout and proc.stdout.readline():
+                pass
+        except Exception:  # pragma: no cover - interpreter teardown
+            pass
+
+    @staticmethod
+    def _reap(fleet: list[subprocess.Popen]) -> None:
+        fleet[:] = [p for p in fleet if p.poll() is None]
+
+    async def _scale(self, fleet: list[subprocess.Popen], target: int, role: str) -> None:
+        self._reap(fleet)
+        if len(fleet) < target:
+            # Spawn the whole deficit, then wait for readiness concurrently:
+            # cold starts (JAX init) overlap instead of serializing while the
+            # load spike that triggered the scale-up goes unserved.
+            procs = [self._spawn(role) for _ in range(target - len(fleet))]
+            results = await asyncio.gather(
+                *(self._wait_ready(p) for p in procs), return_exceptions=True
+            )
+            failures: list[BaseException] = []
+            for p, r in zip(procs, results):
+                if isinstance(r, BaseException):
+                    logger.error("%s worker pid=%d failed to start: %s", role, p.pid, r)
+                    if p.poll() is None:
+                        p.kill()
+                    failures.append(r)
+                else:
+                    fleet.append(p)
+                    self.scale_events += 1
+            if failures:
+                raise failures[0]
+        while len(fleet) > target:
+            proc = fleet.pop()  # youngest first (coldest cache)
+            logger.info("stopping %s worker pid=%d", role, proc.pid)
+            proc.terminate()
+            self.scale_events += 1
+        for p in list(fleet):
+            if p.poll() is not None:
+                logger.warning("%s worker pid=%d died (rc=%s)", role, p.pid, p.returncode)
+
+    # -- Connector ---------------------------------------------------------
+
+    async def apply(self, decision: PlanDecision) -> None:
+        await self._scale(self._decode, decision.decode_workers, "worker")
+        await self._scale(self._prefill, decision.prefill_workers, "prefill")
+
+    def live_counts(self) -> tuple[int, int]:
+        self._reap(self._decode)
+        self._reap(self._prefill)
+        return len(self._decode), len(self._prefill)
+
+    async def close(self) -> None:
+        procs = self._decode + self._prefill
+        self._decode, self._prefill = [], []
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+
+        def wait_all() -> None:  # blocking waits stay off the event loop
+            for p in procs:
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait(timeout=5)
+
+        await asyncio.get_running_loop().run_in_executor(None, wait_all)
+
+
+class PlannerLoop:
+    """Periodic scrape -> decide -> actuate loop."""
+
+    def __init__(
+        self,
+        planner: Planner,
+        aggregator: KvMetricsAggregator,
+        connector: Connector,
+        *,
+        disaggregated: bool = False,
+    ) -> None:
+        self.planner = planner
+        self.aggregator = aggregator
+        self.connector = connector
+        self.disaggregated = disaggregated
+        self.iterations = 0
+        self._task: asyncio.Task | None = None
+        self._last_tick = time.monotonic()
+
+    async def tick(self) -> PlanDecision:
+        """One control iteration (the run loop calls this; tests drive it)."""
+        now = time.monotonic()
+        dt, self._last_tick = now - self._last_tick, now
+        self.planner.observe(self.aggregator.snapshot(), dt or self.planner.config.interval_seconds)
+        decision = self.planner.decide(disaggregated=self.disaggregated)
+        await self.connector.apply(decision)
+        self.iterations += 1
+        return decision
+
+    async def start(self) -> "PlannerLoop":
+        if self._task is None:
+            self._task = asyncio.create_task(self._run(), name="planner-loop")
+        return self
+
+    async def _run(self) -> None:
+        while True:
+            await asyncio.sleep(self.planner.config.interval_seconds)
+            try:
+                await self.tick()
+            except Exception:
+                logger.exception("planner iteration failed")
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        await self.connector.close()

@@ -56,6 +56,23 @@ class WorkerProfile:
     def itl_at(self, load_fraction: float) -> float:
         return self._interp(self.itl_curve, load_fraction)
 
+    def to_json(self) -> str:
+        import json
+
+        return json.dumps(dataclasses.asdict(self), indent=2)
+
+    @classmethod
+    def from_json(cls, text: str) -> "WorkerProfile":
+        import json
+
+        d = json.loads(text)
+        # Absent curves keep the dataclass defaults (an empty curve would
+        # interpolate to 0.0 latency and blind the SLA mode).
+        for key in ("ttft_curve", "itl_curve"):
+            if key in d:
+                d[key] = [tuple(p) for p in d[key]]
+        return cls(**{k: v for k, v in d.items() if k in {f.name for f in dataclasses.fields(cls)}})
+
 
 @dataclass
 class PlannerConfig:

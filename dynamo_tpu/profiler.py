@@ -1,0 +1,155 @@
+"""Pre-deployment profiling sweep: one worker -> WorkerProfile JSON.
+
+Drives an in-process engine at increasing concurrency, measuring prefill and
+decode throughput plus TTFT/ITL percentiles per level; the resulting
+`planner.core.WorkerProfile` (capacities + piecewise latency surfaces) is
+what the planner's SLA mode interpolates at runtime.
+
+Parity: reference `benchmarks/profiler/profile_sla.py` (pre-deployment TP
+sweep feeding `perf_interpolation.py`); here the sweep runs the first-party
+engine directly — real JAX on the chip, or the timing-model mocker for
+CI/planner tests.
+
+CLI: ``python -m dynamo_tpu.profiler --model test-tiny --mock --out p.json``
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import dataclasses
+import json
+import logging
+import time
+
+import numpy as np
+
+from dynamo_tpu.planner.core import WorkerProfile
+from dynamo_tpu.protocols.common import PreprocessedRequest, SamplingOptions, StopConditions
+from dynamo_tpu.runtime.engine import Context
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class LevelResult:
+    concurrency: int
+    prefill_tps: float
+    decode_tps: float
+    ttft_p50: float
+    itl_p50: float
+
+
+async def _run_level(service, *, concurrency: int, isl: int, osl: int, seed: int) -> LevelResult:
+    rng = np.random.default_rng(seed)
+
+    async def one(i: int) -> tuple[float, list[float]]:
+        # Distinct prompts: no prefix-cache hits between requests.
+        token_ids = [int(t) for t in rng.integers(5, 250, isl)]
+        req = PreprocessedRequest(
+            token_ids=token_ids,
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=osl, ignore_eos=True),
+            request_id=f"profile-{concurrency}-{i}",
+        )
+        t0 = time.monotonic()
+        first = None
+        gaps: list[float] = []
+        prev = None
+        async for out in service.generate(req, Context()):
+            now = time.monotonic()
+            if first is None and (out.get("token_ids") or out.get("finish_reason")):
+                first = now - t0
+            if prev is not None:
+                gaps.append(now - prev)
+            prev = now
+        return first or 0.0, gaps
+
+    t0 = time.monotonic()
+    results = await asyncio.gather(*(one(i) for i in range(concurrency)))
+    wall = max(time.monotonic() - t0, 1e-6)
+    ttfts = [r[0] for r in results]
+    gaps = [g for r in results for g in r[1]]
+    prefill_tokens = concurrency * isl
+    decode_tokens = concurrency * osl
+    # Prefill phase ends (approximately) at the last first-token time.
+    prefill_wall = max(max(ttfts), 1e-6)
+    return LevelResult(
+        concurrency=concurrency,
+        prefill_tps=prefill_tokens / prefill_wall,
+        decode_tps=decode_tokens / wall,
+        ttft_p50=float(np.median(ttfts)),
+        itl_p50=float(np.median(gaps)) if gaps else 0.0,
+    )
+
+
+async def profile_service(
+    service,
+    *,
+    levels: list[int] | None = None,
+    isl: int = 128,
+    osl: int = 32,
+) -> tuple[WorkerProfile, list[LevelResult]]:
+    """Sweep one engine service; returns (profile, per-level results)."""
+    levels = levels or [1, 2, 4, 8]
+    out: list[LevelResult] = []
+    for i, c in enumerate(levels):
+        res = await _run_level(service, concurrency=c, isl=isl, osl=osl, seed=i)
+        logger.info(
+            "level c=%d: prefill %.0f tok/s, decode %.0f tok/s, ttft p50 %.3fs, itl p50 %.4fs",
+            c, res.prefill_tps, res.decode_tps, res.ttft_p50, res.itl_p50,
+        )
+        out.append(res)
+    max_c = max(levels)
+    profile = WorkerProfile(
+        prefill_tokens_per_sec=max(r.prefill_tps for r in out),
+        decode_tokens_per_sec=max(r.decode_tps for r in out),
+        max_concurrent=max_c,
+        ttft_curve=[(r.concurrency / max_c, r.ttft_p50) for r in out],
+        itl_curve=[(r.concurrency / max_c, r.itl_p50) for r in out],
+    )
+    return profile, out
+
+
+async def _amain(args: argparse.Namespace) -> None:
+    from dynamo_tpu.launch import build_engine_service, make_worker_spec
+
+    spec = make_worker_spec(args.model, num_pages=args.num_pages, max_batch_size=args.max_batch_size)
+    spec.mock = args.mock
+    service = await build_engine_service(spec)
+    try:
+        profile, results = await profile_service(
+            service,
+            levels=[int(x) for x in args.levels.split(",")],
+            isl=args.isl,
+            osl=args.osl,
+        )
+    finally:
+        await service.close()
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(profile.to_json())
+        logger.info("wrote %s", args.out)
+    print(json.dumps({
+        "profile": json.loads(profile.to_json()),
+        "levels": [dataclasses.asdict(r) for r in results],
+    }))
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(description="dynamo-tpu worker profiler")
+    p.add_argument("--model", default="test-tiny")
+    p.add_argument("--mock", action="store_true", help="profile the timing-model mocker")
+    p.add_argument("--levels", default="1,2,4,8", help="concurrency sweep levels")
+    p.add_argument("--isl", type=int, default=128)
+    p.add_argument("--osl", type=int, default=32)
+    p.add_argument("--num-pages", type=int, default=512)
+    p.add_argument("--max-batch-size", type=int, default=64)
+    p.add_argument("--out", default=None, help="write WorkerProfile JSON here")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    asyncio.run(_amain(args))
+
+
+if __name__ == "__main__":
+    main()

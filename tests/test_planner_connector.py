@@ -1,0 +1,115 @@
+"""Planner actuation: decisions drive a real fleet of worker processes.
+
+The integration test mirrors the reference's planner-vs-circus setup
+(`local_connector.py` against mocker fleets): a store server + metrics
+aggregator in-process, mock-engine workers as real OS processes, and the
+planner loop scaling the fleet as measured load ramps up and down.
+"""
+
+import asyncio
+import socket
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.planner.connector import LocalProcessConnector, PlannerLoop
+from dynamo_tpu.planner.core import Planner, PlannerConfig, WorkerProfile
+from dynamo_tpu.protocols.common import PreprocessedRequest, SamplingOptions, StopConditions
+from dynamo_tpu.router.metrics import KvMetricsAggregator
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.store_server import StoreServer
+from dynamo_tpu.runtime.tcp import TcpTransport
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def test_worker_profile_json_roundtrip(tmp_path):
+    p = WorkerProfile(prefill_tokens_per_sec=123.0, decode_tokens_per_sec=45.0,
+                      max_concurrent=16, ttft_curve=[(0.0, 0.1), (1.0, 0.4)],
+                      itl_curve=[(0.0, 0.01), (1.0, 0.02)])
+    q = WorkerProfile.from_json(p.to_json())
+    assert q == p
+    assert q.ttft_at(0.5) == pytest.approx(0.25)
+
+
+async def test_profiler_sweep_on_mocker():
+    """profile_service produces monotone curves and sane capacities."""
+    from dynamo_tpu.mocker import build_mock_service
+    from dynamo_tpu.profiler import profile_service
+
+    service = await build_mock_service()
+    try:
+        profile, levels = await profile_service(service, levels=[1, 4], isl=64, osl=16)
+    finally:
+        await service.close()
+    assert len(levels) == 2
+    assert profile.decode_tokens_per_sec > 0
+    assert profile.prefill_tokens_per_sec > 0
+    assert profile.max_concurrent == 4
+    assert [x for x, _ in profile.ttft_curve] == [0.25, 1.0]
+
+
+@pytest.mark.slow
+@pytest.mark.e2e
+async def test_planner_scales_live_fleet():
+    """Load ramp on a mock-engine fleet: the planner loop spawns real worker
+    processes on load and shrinks the fleet when load drains."""
+    port = _free_port()
+    server = await StoreServer(host="127.0.0.1", port=port).start()
+    runtime = DistributedRuntime(server.store, TcpTransport(host="127.0.0.1"))
+    aggregator = await KvMetricsAggregator(runtime, "dynamo", "backend").start()
+    connector = LocalProcessConnector(
+        model="test-tiny", store_url=f"tcp://127.0.0.1:{port}", mock=True,
+        spawn_timeout=120.0,
+    )
+    planner = Planner(
+        PlannerConfig(min_workers=1, max_workers=3, target_utilization=0.7),
+        # Capacity far below the mocker's real throughput: measured load
+        # forces a scale-up decision deterministically.
+        WorkerProfile(prefill_tokens_per_sec=100000.0, decode_tokens_per_sec=60.0),
+    )
+    loop = PlannerLoop(planner, aggregator, connector)
+    try:
+        # Idle tick: fleet comes up at min_workers.
+        await loop.tick()
+        assert connector.live_counts() == (1, 0)
+
+        # Drive real load through the fleet's endpoint.
+        client = runtime.namespace("dynamo").component("backend").endpoint("generate").client()
+        rng = np.random.default_rng(0)
+
+        async def one(i: int) -> None:
+            req = PreprocessedRequest(
+                token_ids=[int(t) for t in rng.integers(5, 250, 64)],
+                sampling=SamplingOptions(temperature=0.0),
+                stop=StopConditions(max_tokens=120, ignore_eos=True),
+                request_id=f"load-{i}",
+            )
+            async for _ in client.generate(req.to_dict(), Context()):
+                pass
+
+        await asyncio.gather(*(one(i) for i in range(8)))
+        await asyncio.sleep(1.5)  # let the workers publish their counters
+
+        decision = await loop.tick()
+        assert decision.decode_workers > 1, decision
+        assert connector.live_counts()[0] == decision.decode_workers
+
+        # Load drains: fleet shrinks back to min_workers within a few ticks.
+        for _ in range(6):
+            await asyncio.sleep(0.5)
+            decision = await loop.tick()
+            if connector.live_counts() == (1, 0):
+                break
+        assert connector.live_counts() == (1, 0)
+        assert connector.scale_events >= 2  # at least one up + one down
+    finally:
+        await loop.close()
+        await aggregator.close()
+        await runtime.close()
+        await server.close()
