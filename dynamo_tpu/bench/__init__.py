@@ -1,0 +1,16 @@
+"""Benchmark harness: synthetic workloads + concurrency sweeps + pareto.
+
+Parity with the reference benchmark stack (`benchmarks/llm/perf.sh`
+concurrency sweep, `plot_pareto.py`, `data_generator/synthesizer.py`
+prefix-structured workloads) rebuilt as a first-party harness that drives
+the OpenAI HTTP surface of any topology this framework can serve.
+
+- :mod:`dynamo_tpu.bench.synthesizer` — prefix-tree workload generator.
+- :mod:`dynamo_tpu.bench.harness` — closed-loop sweep, TTFT/ITL percentiles.
+- ``python -m dynamo_tpu.bench`` — one command, N topologies, pareto JSON.
+"""
+
+from dynamo_tpu.bench.harness import LevelStats, sweep_http
+from dynamo_tpu.bench.synthesizer import SyntheticConfig, WorkloadRequest, synthesize
+
+__all__ = ["LevelStats", "sweep_http", "SyntheticConfig", "WorkloadRequest", "synthesize"]

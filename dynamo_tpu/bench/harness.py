@@ -1,0 +1,168 @@
+"""Closed-loop concurrency sweep over the OpenAI HTTP surface.
+
+Each level keeps exactly C requests in flight (closed loop, like the
+reference's genai-perf runs at concurrency 1..256, `perf.sh:18-29`),
+streaming so TTFT and inter-token latency are measured per token. The
+output rows are the pareto data the reference plots: throughput vs
+TTFT/ITL percentiles per concurrency.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import time
+
+import aiohttp
+import numpy as np
+
+from dynamo_tpu.bench.synthesizer import WorkloadRequest
+
+
+@dataclasses.dataclass
+class RequestResult:
+    ttft: float
+    gaps: list[float]
+    output_tokens: int
+    ok: bool
+
+
+@dataclasses.dataclass
+class LevelStats:
+    concurrency: int
+    requests: int
+    errors: int
+    wall_seconds: float
+    output_tokens: int
+    output_tok_per_sec: float
+    ttft_p50: float
+    ttft_p90: float
+    ttft_p99: float
+    itl_p50: float
+    itl_p90: float
+    itl_p99: float
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def _pct(xs: list[float], q: float) -> float:
+    return float(np.percentile(xs, q)) if xs else 0.0
+
+
+async def _one_request(
+    session: aiohttp.ClientSession, base: str, model: str, req: WorkloadRequest
+) -> RequestResult:
+    import json as _json
+
+    body = {
+        "model": model,
+        "prompt": req.token_ids,
+        "max_tokens": req.max_tokens,
+        "temperature": 0,
+        "stream": True,
+        # Authoritative token count: one SSE chunk may carry a multi-token
+        # decode burst (decode_steps > 1), so counting chunks undercounts.
+        "stream_options": {"include_usage": True},
+    }
+    t0 = time.monotonic()
+    ttft = 0.0
+    gaps: list[float] = []
+    chunks = 0
+    usage_tokens = None
+    prev = None
+    try:
+        async with session.post(f"{base}/v1/completions", json=body) as resp:
+            if resp.status != 200:
+                return RequestResult(0.0, [], 0, ok=False)
+            async for line in resp.content:
+                if not line.startswith(b"data:"):
+                    continue
+                payload = line[5:].strip()
+                if payload == b"[DONE]":
+                    continue
+                now = time.monotonic()
+                try:
+                    obj = _json.loads(payload)
+                except Exception:
+                    continue
+                usage = obj.get("usage")
+                if usage and usage.get("completion_tokens"):
+                    usage_tokens = usage["completion_tokens"]
+                if prev is None:
+                    ttft = now - t0
+                else:
+                    gaps.append(now - prev)
+                prev = now
+                chunks += 1
+    except Exception:
+        return RequestResult(0.0, [], 0, ok=False)
+    tokens = usage_tokens if usage_tokens is not None else chunks
+    if chunks > 1 and tokens > chunks:
+        # Burst streaming: each chunk gap spans ~tokens/chunks tokens —
+        # normalize so ITL stays per-token across decode_steps configs.
+        gaps = [g * chunks / tokens for g in gaps]
+    return RequestResult(ttft, gaps, tokens, ok=True)
+
+
+async def run_level(
+    base: str, model: str, workload: list[WorkloadRequest], *, concurrency: int
+) -> LevelStats:
+    """Closed loop: C workers drain the workload queue."""
+    queue: asyncio.Queue[WorkloadRequest] = asyncio.Queue()
+    for r in workload:
+        queue.put_nowait(r)
+    results: list[RequestResult] = []
+
+    async with aiohttp.ClientSession(timeout=aiohttp.ClientTimeout(total=600)) as session:
+
+        async def worker() -> None:
+            while True:
+                try:
+                    r = queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    return
+                results.append(await _one_request(session, base, model, r))
+
+        t0 = time.monotonic()
+        await asyncio.gather(*(worker() for _ in range(concurrency)))
+        wall = time.monotonic() - t0
+
+    good = [r for r in results if r.ok]
+    gaps = [g for r in good for g in r.gaps]
+    tokens = sum(r.output_tokens for r in good)
+    return LevelStats(
+        concurrency=concurrency,
+        requests=len(results),
+        errors=len(results) - len(good),
+        wall_seconds=round(wall, 3),
+        output_tokens=tokens,
+        output_tok_per_sec=round(tokens / wall, 2) if wall > 0 else 0.0,
+        ttft_p50=round(_pct([r.ttft for r in good], 50), 4),
+        ttft_p90=round(_pct([r.ttft for r in good], 90), 4),
+        ttft_p99=round(_pct([r.ttft for r in good], 99), 4),
+        itl_p50=round(_pct(gaps, 50), 5),
+        itl_p90=round(_pct(gaps, 90), 5),
+        itl_p99=round(_pct(gaps, 99), 5),
+    )
+
+
+async def sweep_http(
+    base: str, model: str, workloads, *, levels: list[int]
+) -> list[LevelStats]:
+    """One pareto sweep across concurrency levels.
+
+    ``workloads``: one list of WorkloadRequest per level (fresh prompts per
+    level — replaying identical prompts against a warm server would measure
+    prefix-cache lookups, not prefill), or a single list replayed at every
+    level when cross-level caching is knowingly acceptable (mock engines,
+    caching disabled).
+    """
+    if workloads and isinstance(workloads[0], WorkloadRequest):
+        workloads = [workloads] * len(levels)
+    if len(workloads) != len(levels):
+        raise ValueError(f"need one workload per level: {len(workloads)} != {len(levels)}")
+    out = []
+    for c, w in zip(levels, workloads):
+        out.append(await run_level(base, model, w, concurrency=c))
+    return out

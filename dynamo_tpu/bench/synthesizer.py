@@ -1,0 +1,60 @@
+"""Synthetic workload generator with controlled prefix structure.
+
+Real serving traffic shares prompt prefixes (system prompts, few-shot
+preambles, multi-turn history). The generator builds a two-level prefix
+tree — one corpus-wide shared prefix, G group prefixes under it, and a
+unique per-request suffix — so KV-router hit rates and prefix-cache
+behavior can be exercised and measured, not just raw decode.
+
+Parity: reference `benchmarks/data_generator/synthesizer.py:34-303`
+(prefix-tree synthesis from traces) — here parameterized directly instead
+of fitted, which is what its own tests do too.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class SyntheticConfig:
+    num_requests: int = 64
+    shared_prefix_len: int = 64  # corpus-wide (system prompt)
+    num_groups: int = 4  # second-level prefixes (few-shot variants)
+    group_prefix_len: int = 64
+    unique_len: int = 64  # per-request tail
+    osl_mean: int = 64
+    osl_cv: float = 0.3  # coefficient of variation of output lengths
+    vocab: int = 250  # keep ids small: works with every test tokenizer
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class WorkloadRequest:
+    token_ids: list[int]
+    max_tokens: int
+    group: int
+
+
+def synthesize(cfg: SyntheticConfig) -> list[WorkloadRequest]:
+    rng = np.random.default_rng(cfg.seed)
+    shared = rng.integers(5, cfg.vocab, cfg.shared_prefix_len).tolist()
+    groups = [rng.integers(5, cfg.vocab, cfg.group_prefix_len).tolist() for _ in range(max(cfg.num_groups, 1))]
+    out: list[WorkloadRequest] = []
+    for i in range(cfg.num_requests):
+        g = int(rng.integers(0, len(groups)))
+        unique = rng.integers(5, cfg.vocab, cfg.unique_len).tolist()
+        sigma = max(cfg.osl_mean * cfg.osl_cv, 1e-6)
+        osl = int(np.clip(rng.normal(cfg.osl_mean, sigma), 1, cfg.osl_mean * 4))
+        out.append(WorkloadRequest(token_ids=shared + groups[g] + unique, max_tokens=osl, group=g))
+    rng.shuffle(out)  # interleave groups like real arrival order
+    return out
+
+
+def sharing_ratio(cfg: SyntheticConfig) -> float:
+    """Fraction of prompt tokens that are shared with at least one other
+    request (the theoretical ceiling for prefix-cache hit rate)."""
+    total = cfg.shared_prefix_len + cfg.group_prefix_len + cfg.unique_len
+    return (cfg.shared_prefix_len + cfg.group_prefix_len) / max(total, 1)

@@ -32,8 +32,12 @@ import jax.numpy as jnp
 
 def cache_compatible(a: ModelRunner, b: ModelRunner) -> bool:
     """Whether two runners' caches share page geometry (layers, page size,
-    KV width) and dtype — the precondition for a raw device-path page copy."""
-    ka, kb = a.k_cache, b.k_cache
+    KV width) and dtype — the precondition for a raw device-path page copy.
+    Runners without a device cache (the mocker) are never compatible; they
+    take the host/TCP path."""
+    ka, kb = getattr(a, "k_cache", None), getattr(b, "k_cache", None)
+    if ka is None or kb is None:
+        return False
     return (ka.shape[0], ka.shape[2], ka.shape[3], ka.dtype) == (
         kb.shape[0], kb.shape[2], kb.shape[3], kb.dtype
     )

@@ -92,6 +92,12 @@ class MockRunner:
     def write_page(self, page_id: int, k, v) -> None:
         pass
 
+    def read_pages(self, page_ids):
+        return [self.read_page(p) for p in page_ids]
+
+    def write_pages(self, page_ids, ks, vs) -> None:
+        pass
+
     def cache_memory_bytes(self) -> int:
         return 0
 

@@ -1,0 +1,59 @@
+"""Benchmark harness tests: synthesizer structure + sweep over a live stack."""
+
+import asyncio
+
+from dynamo_tpu.bench import SyntheticConfig, synthesize, sweep_http
+from dynamo_tpu.bench.synthesizer import sharing_ratio
+
+
+def test_synthesizer_prefix_structure():
+    cfg = SyntheticConfig(num_requests=32, shared_prefix_len=16, num_groups=3,
+                          group_prefix_len=8, unique_len=4, osl_mean=20, seed=7)
+    reqs = synthesize(cfg)
+    assert len(reqs) == 32
+    shared = reqs[0].token_ids[:16]
+    groups = {}
+    for r in reqs:
+        assert r.token_ids[:16] == shared  # corpus-wide prefix
+        assert len(r.token_ids) == 16 + 8 + 4
+        groups.setdefault(r.group, r.token_ids[16:24])
+        assert r.token_ids[16:24] == groups[r.group]  # group prefix stable
+        assert 1 <= r.max_tokens <= 80
+    assert len(groups) == 3
+    # Different groups have different prefixes (overwhelmingly likely).
+    assert len({tuple(g) for g in groups.values()}) == 3
+    assert abs(sharing_ratio(cfg) - 24 / 28) < 1e-9
+
+
+def test_synthesizer_deterministic():
+    a = synthesize(SyntheticConfig(seed=3))
+    b = synthesize(SyntheticConfig(seed=3))
+    assert [r.token_ids for r in a] == [r.token_ids for r in b]
+    assert [r.token_ids for r in a] != [r.token_ids for r in synthesize(SyntheticConfig(seed=4))]
+
+
+async def test_sweep_over_live_stack():
+    """Closed-loop sweep against a real served stack (mock engine): pareto
+    rows come back populated and error-free."""
+    from dynamo_tpu.launch import run_local
+
+    handles = await run_local("test-tiny", port=0, mock=True, num_pages=512, max_batch_size=16)
+    base = f"http://127.0.0.1:{handles['port']}"
+    try:
+        workload = synthesize(SyntheticConfig(num_requests=8, shared_prefix_len=16,
+                                              group_prefix_len=8, unique_len=8, osl_mean=12))
+        stats = await sweep_http(base, "test-tiny", workload, levels=[1, 4])
+        assert [s.concurrency for s in stats] == [1, 4]
+        for s in stats:
+            assert s.errors == 0
+            assert s.requests == 8
+            assert s.output_tokens > 0
+            assert s.output_tok_per_sec > 0
+            assert s.ttft_p50 > 0
+            assert s.ttft_p99 >= s.ttft_p50
+    finally:
+        await handles["http"].stop()
+        await handles["watcher"].close()
+        for svc in handles["services"]:
+            await svc.close()
+        await handles["runtime"].close()
