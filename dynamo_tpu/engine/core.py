@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import threading
 from collections import deque
 from typing import Callable
 
@@ -88,6 +89,10 @@ class EngineCore:
         # offload copies; direct drivers (tests, bench) flush at end of step.
         self.pending_offloads: list[tuple[int, int]] = []  # (block_hash, page_id)
         self.defer_offloads = False
+        # Serializes step()/flush_offloads() (executor thread) against
+        # abort_all() (event-loop thread, on service shutdown/failure): the
+        # scheduler queues and page lists have no other cross-thread guard.
+        self.step_lock = threading.RLock()
         self._head_stall_steps = 0
         # Pipelined decode: the burst in flight on device, not yet consumed.
         # (batch snapshot, DeviceTokens handle, burst length)
@@ -134,6 +139,10 @@ class EngineCore:
 
     def step(self) -> list[tuple[Sequence, EngineOutput]]:
         """Advance the engine by one batched forward; returns per-seq deltas."""
+        with self.step_lock:
+            return self._step_locked()
+
+    def _step_locked(self) -> list[tuple[Sequence, EngineOutput]]:
         # Pending offloads must be read before allocate() can evict their
         # pages (deferred-mode safety; no-op when the service already flushed).
         self.flush_offloads()
@@ -543,15 +552,21 @@ class EngineCore:
         thread ordering, so committed pages are still live); uses the
         runner's batched multi-page gather when available.
         """
-        if self.block_manager is None or not self.pending_offloads:
-            self.pending_offloads = []
-            return
-        items, self.pending_offloads = self.pending_offloads, []
-        self.block_manager.offload_batch(items, read_pages=getattr(self.runner, "read_pages", None))
+        with self.step_lock:
+            if self.block_manager is None or not self.pending_offloads:
+                self.pending_offloads = []
+                return
+            items, self.pending_offloads = self.pending_offloads, []
+            self.block_manager.offload_batch(items, read_pages=getattr(self.runner, "read_pages", None))
 
     def abort_all(self, reason: FinishReason = FinishReason.ERROR) -> None:
         """Finish every in-flight sequence (releasing its pages) — used when
-        a step failure leaves device state suspect."""
+        a step failure leaves device state suspect. Blocks until any step
+        running in another thread completes (step_lock)."""
+        with self.step_lock:
+            self._abort_all_locked(reason)
+
+    def _abort_all_locked(self, reason: FinishReason) -> None:
         self._inflight = None
         if hasattr(self.runner, "reset_chain"):
             self.runner.reset_chain()

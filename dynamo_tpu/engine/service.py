@@ -44,6 +44,8 @@ class JaxEngineService(AsyncEngine[Any, dict]):
     # -- lifecycle ---------------------------------------------------------
 
     async def start(self) -> "JaxEngineService":
+        if self._closed:
+            raise RuntimeError("engine service is closed")
         if self._loop_task is None:
             self._loop_task = asyncio.create_task(self._engine_loop(), name="jax-engine-loop")
         return self
@@ -61,6 +63,28 @@ class JaxEngineService(AsyncEngine[Any, dict]):
             except asyncio.CancelledError:
                 pass
             self._loop_task = None
+        # Cancelling the loop task does NOT stop a core.step() already
+        # running in the executor thread — abort_all takes the core's
+        # step_lock, so running it in the executor waits that step out
+        # before touching the engine state it is mutating.
+        await asyncio.get_running_loop().run_in_executor(None, self.core.abort_all)
+        # In-flight streams would otherwise wait forever for a sentinel the
+        # dead loop can never send (their consumers hang on shutdown/crash).
+        self._drain_intake_failed()
+        if self._streams:
+            self._notify_streams_failed()
+
+    def _drain_intake_failed(self) -> None:
+        """Fail requests queued but never admitted by the (now dead) loop."""
+        from dynamo_tpu.protocols.common import FinishReason
+
+        while True:
+            try:
+                _req, _ctx, out_q = self._intake.get_nowait()
+            except asyncio.QueueEmpty:
+                return
+            out_q.put_nowait(EngineOutput(token_ids=[], finish_reason=FinishReason.ERROR))
+            out_q.put_nowait(_SENTINEL)
 
     # -- engine loop -------------------------------------------------------
 
@@ -91,6 +115,8 @@ class JaxEngineService(AsyncEngine[Any, dict]):
                 continue
 
             # One engine step off-thread: the event loop stays responsive.
+            # (If this task is cancelled mid-step, the executor thread keeps
+            # running — close() serializes against it via core.step_lock.)
             try:
                 outputs = await loop.run_in_executor(None, self.core.step)
             except Exception:
@@ -106,13 +132,16 @@ class JaxEngineService(AsyncEngine[Any, dict]):
                 except Exception:
                     logger.exception("tier offload flush failed (non-fatal)")
 
-    def _fail_all_streams(self) -> None:
+    def _notify_streams_failed(self) -> None:
         from dynamo_tpu.protocols.common import FinishReason
 
         for q in self._streams.values():
             q.put_nowait(EngineOutput(token_ids=[], finish_reason=FinishReason.ERROR))
             q.put_nowait(_SENTINEL)
         self._streams.clear()
+
+    def _fail_all_streams(self) -> None:
+        self._notify_streams_failed()
         # Engine state may be inconsistent after a failed step: drop all work,
         # releasing every sequence's pages back to the allocator.
         self.core.abort_all()
@@ -132,6 +161,10 @@ class JaxEngineService(AsyncEngine[Any, dict]):
     async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
         if isinstance(request, dict):
             request = PreprocessedRequest.from_dict(request)
+        if self._closed:
+            # A dead engine must refuse loudly (the stream error feeds the
+            # client's inhibit list), not queue into a loop that never runs.
+            raise RuntimeError("engine service is closed")
         if request.annotations.get("embed"):
             # Embedding requests bypass the scheduler: the cache-free encoder
             # shares nothing with the paged decode state (runner.embed). The
@@ -155,6 +188,14 @@ class JaxEngineService(AsyncEngine[Any, dict]):
         out_q: asyncio.Queue = asyncio.Queue()
         await self._intake.put((request, context, out_q))
         self._wake.set()
+        if self._closed:
+            # close() may have run between the check above and the put: its
+            # intake drain might have missed this entry, so unblock the
+            # consumer directly (duplicate ERROR items are harmless).
+            from dynamo_tpu.protocols.common import FinishReason
+
+            out_q.put_nowait(EngineOutput(token_ids=[], finish_reason=FinishReason.ERROR))
+            out_q.put_nowait(_SENTINEL)
         finished = False
         try:
             while True:

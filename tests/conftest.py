@@ -45,3 +45,15 @@ def cpu_devices():
     devices = jax.devices()
     assert len(devices) >= 8, f"expected 8 virtual devices, got {len(devices)}"
     return devices
+
+
+async def wait_for(cond, timeout=5.0, interval=0.05):
+    """Poll ``cond()`` until truthy or timeout; returns whether it held."""
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while True:
+        if cond():
+            return True
+        if loop.time() > deadline:
+            return False
+        await asyncio.sleep(interval)

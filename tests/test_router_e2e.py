@@ -9,17 +9,8 @@ import asyncio
 
 import aiohttp
 
+from conftest import wait_for
 from dynamo_tpu.launch import run_local
-
-
-async def wait_for(cond, timeout=5.0, interval=0.05):
-    deadline = asyncio.get_event_loop().time() + timeout
-    while True:
-        if cond():
-            return True
-        if asyncio.get_event_loop().time() > deadline:
-            return False
-        await asyncio.sleep(interval)
 
 
 async def test_kv_routed_repeat_prompt_hits_cache():

@@ -1,0 +1,111 @@
+"""Control-plane soak: a 16-worker mock fleet under sustained load with churn.
+
+Parity: reference `lib/runtime/tests/soak.rs` + mocker-fleet exercises
+(SURVEY.md §4). The KV router's world model is the system under test: with
+workers dying and joining mid-load, the indexer must (a) drop dead workers'
+blocks, (b) admit new workers, and (c) converge to exactly the blocks each
+live worker's allocator actually caches.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.bench.harness import run_level
+from dynamo_tpu.bench.synthesizer import SyntheticConfig, synthesize
+from conftest import wait_for
+from dynamo_tpu.launch import make_worker_spec, run_local, serve_worker
+
+
+async def _kill_worker(handles, service) -> int:
+    """Simulate a crash: revoke the worker's instance records, stop the engine."""
+    wid = service.core.config.worker_id
+    store = handles["runtime"].store
+    for key in list((await store.get_prefix("instances/")).keys()):
+        if key.endswith(f":{wid:x}"):
+            await store.delete(key)
+    await service.close()
+    handles["services"].remove(service)
+    return wid
+
+
+@pytest.mark.slow
+@pytest.mark.e2e
+async def test_soak_16_worker_fleet_with_churn():
+    handles = await run_local(
+        "test-tiny", port=0, num_workers=16, router_mode="kv", mock=True,
+        num_pages=512, max_batch_size=64,
+    )
+    base = f"http://127.0.0.1:{handles['port']}"
+    try:
+        entry = handles["http"].manager.get("test-tiny")
+        indexer = entry.aux[0].indexer
+
+        workload = synthesize(SyntheticConfig(
+            num_requests=150, shared_prefix_len=32, num_groups=8,
+            group_prefix_len=32, unique_len=128, osl_mean=32, seed=11,
+        ))
+
+        async def churn() -> tuple[list[int], list[int]]:
+            await asyncio.sleep(0.5)  # mid-load
+            killed = []
+            for victim in list(handles["services"][:3]):
+                killed.append(await _kill_worker(handles, victim))
+            # Elastic join: two fresh workers enter the live fleet.
+            joined = []
+            for _ in range(2):
+                spec = make_worker_spec("test-tiny", num_pages=512, max_batch_size=64)
+                spec.card.router_mode = "kv"
+                spec.mock = True
+                lease = await handles["runtime"].secondary_lease()
+                svc = await serve_worker(handles["runtime"], spec, lease=lease)
+                handles["services"].append(svc)
+                joined.append(svc.core.config.worker_id)
+            return killed, joined
+
+        load_task = asyncio.create_task(
+            run_level(base, "test-tiny", workload, concurrency=24)
+        )
+        churn_task = asyncio.create_task(churn())
+        stats = await load_task
+        killed, joined = await churn_task
+
+        # The fleet absorbed the churn: the vast majority of requests served.
+        assert stats.requests == 150
+        assert stats.errors <= 30, stats  # in-flight on 3 killed workers
+        assert stats.output_tokens > 0
+
+        # Event-rate soak: the indexer processed a meaningful block volume.
+        assert indexer.num_blocks > 100, indexer.num_blocks
+
+        # Dead workers fully evicted from the router's world model.
+        assert await wait_for(
+            lambda: all(indexer.worker_block_counts().get(w, 0) == 0 for w in killed)
+        ), (killed, indexer.worker_block_counts())
+
+        # Consistency: every live worker's index entry equals exactly what
+        # its allocator holds (snapshot-on-subscribe covers late joiners).
+        def consistent() -> bool:
+            counts = indexer.worker_block_counts()
+            for svc in handles["services"]:
+                wid = svc.core.config.worker_id
+                have = len(svc.core.allocator.cache_snapshot().stored)
+                if counts.get(wid, 0) != have:
+                    return False
+            return True
+
+        assert await wait_for(consistent, timeout=15.0), (
+            indexer.worker_block_counts(),
+            {s.core.config.worker_id: len(s.core.allocator.cache_snapshot().stored)
+             for s in handles["services"]},
+        )
+        # 13 survivors + 2 joiners are all known to the router.
+        assert len(handles["services"]) == 15
+        live_ids = {s.core.config.worker_id for s in handles["services"]}
+        assert set(joined) <= live_ids
+    finally:
+        await handles["http"].stop()
+        await handles["watcher"].close()
+        for svc in list(handles["services"]):
+            await svc.close()
+        await handles["runtime"].close()
