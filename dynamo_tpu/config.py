@@ -1,0 +1,125 @@
+"""Layered configuration: defaults -> TOML file -> environment.
+
+The reference builds RuntimeConfig/WorkerConfig with figment
+(`lib/runtime/src/config.rs:26-143`): dataclass defaults, overlaid by a
+TOML file, overlaid by ``DYN_<SECTION>_<FIELD>`` environment variables —
+highest layer wins. This is the same cascade for this framework's settings;
+the launch CLI seeds its argparse defaults from it, so precedence ends up
+CLI > env > TOML > defaults.
+
+Env naming: section ``runtime`` field ``http_port`` -> ``DYN_RUNTIME_HTTP_PORT``.
+The TOML file is taken from ``DYN_CONFIG`` (path) unless given explicitly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import tomllib
+from typing import Any, TypeVar
+
+logger = logging.getLogger(__name__)
+
+T = TypeVar("T")
+
+
+_TRUTHY = ("1", "true", "yes", "on")
+
+
+def env_flag(env: dict[str, str], key: str, default: bool = False) -> bool:
+    """Parse a boolean env toggle (the one definition of 'truthy')."""
+    raw = env.get(key)
+    return default if raw is None else raw.strip().lower() in _TRUTHY
+
+
+def _coerce(value: str, target_type: Any) -> Any:
+    """Parse an env string into the field's annotated type."""
+    if target_type is bool or target_type == "bool":
+        return value.strip().lower() in _TRUTHY
+    if target_type is int or target_type == "int":
+        return int(value)
+    if target_type is float or target_type == "float":
+        return float(value)
+    return value
+
+
+def _field_types(cls) -> dict[str, Any]:
+    out = {}
+    for f in dataclasses.fields(cls):
+        t = f.type
+        if isinstance(t, str):  # from __future__ annotations
+            t = {"int": int, "float": float, "bool": bool, "str": str}.get(
+                t.replace(" | None", ""), str
+            )
+        out[f.name] = t
+    return out
+
+
+def load_config(
+    defaults: T,
+    *,
+    section: str,
+    toml_path: str | os.PathLike | None = None,
+    env: dict[str, str] | None = None,
+    env_prefix: str = "DYN",
+) -> T:
+    """Overlay ``defaults`` (a dataclass instance) with the ``[section]``
+    table of a TOML file and then with ``{env_prefix}_{SECTION}_{FIELD}``
+    environment variables. Unknown TOML keys warn and are ignored."""
+    env = os.environ if env is None else env
+    cls = type(defaults)
+    values = dataclasses.asdict(defaults)
+    types = _field_types(cls)
+
+    path = toml_path or env.get(f"{env_prefix}_CONFIG")
+    if path:
+        with open(path, "rb") as f:
+            doc = tomllib.load(f)
+        table = doc.get(section, {})
+        for k, v in table.items():
+            if k in values:
+                values[k] = v
+            else:
+                logger.warning("config file %s: unknown key [%s] %s", path, section, k)
+
+    for name, t in types.items():
+        env_key = f"{env_prefix}_{section.upper()}_{name.upper()}"
+        if env_key in env:
+            try:
+                values[name] = _coerce(env[env_key], t)
+            except (ValueError, TypeError) as exc:
+                raise ValueError(f"bad value for {env_key}: {env[env_key]!r}") from exc
+
+    return cls(**values)
+
+
+@dataclasses.dataclass
+class RuntimeSettings:
+    """Deployment-level settings (the reference's RuntimeConfig role)."""
+
+    host: str = "127.0.0.1"
+    http_port: int = 8080
+    store: str = ""  # tcp://host:port; empty = in-process
+    log_level: str = "INFO"
+    log_jsonl: bool = False  # DYN_RUNTIME_LOG_JSONL=1 -> JSON-lines logs
+
+
+@dataclasses.dataclass
+class WorkerSettings:
+    """Per-worker engine settings (the reference's WorkerConfig role)."""
+
+    model: str = "test-tiny"
+    num_pages: int = 512
+    max_batch_size: int = 64
+    router_mode: str = "round_robin"
+    mesh: str = ""  # '' | 'auto' | 'dp=2,tp=4,...'
+    decode_steps: int = 1
+
+
+def load_runtime_settings(**kw) -> RuntimeSettings:
+    return load_config(RuntimeSettings(), section="runtime", **kw)
+
+
+def load_worker_settings(**kw) -> WorkerSettings:
+    return load_config(WorkerSettings(), section="worker", **kw)

@@ -92,7 +92,10 @@ class WorkerSpec:
             max_seq_len=card.context_length,
             eos_token_ids=tuple(card.eos_token_ids),
             page_size=card.kv_page_size,
-            decode_steps=int(os.environ.get("DYNAMO_DECODE_STEPS", "1")),
+            decode_steps=int(
+                os.environ.get("DYNAMO_DECODE_STEPS")
+                or os.environ.get("DYN_WORKER_DECODE_STEPS", "1")
+            ),
             **engine_kw,
         )
 
@@ -388,6 +391,21 @@ async def run_role(args: argparse.Namespace) -> None:
         spec.mock = args.mock
         await serve_prefill_worker(runtime, spec)
         logger.info("prefill worker ready")
+    elif args.role == "router":
+        from dynamo_tpu.model_card import MODEL_PREFIX, ModelDeploymentCard
+        from dynamo_tpu.router.service import serve_router
+
+        # Router-only hosts need no checkpoint: take the block size from a
+        # card already published in the store (fall back to the default).
+        block_size = 16
+        for value in (await runtime.store.get_prefix(f"{MODEL_PREFIX}/")).values():
+            try:
+                block_size = ModelDeploymentCard.from_bytes(value).kv_page_size
+                break
+            except Exception:
+                continue
+        await serve_router(runtime, namespace="dynamo", component="backend", block_size=block_size)
+        logger.info("router service ready")
     elif args.role == "store":
         logger.info("store-only process")
     else:
@@ -428,22 +446,32 @@ async def _amain(args: argparse.Namespace) -> None:
 
 
 def main(argv: list[str] | None = None) -> None:
+    # Layered defaults (reference figment cascade, `config.rs:26-143`):
+    # dataclass defaults <- TOML (DYN_CONFIG) <- DYN_RUNTIME_*/DYN_WORKER_*
+    # env <- CLI flags (highest).
+    from dynamo_tpu.config import load_runtime_settings, load_worker_settings
+
+    rs = load_runtime_settings()
+    ws = load_worker_settings()
+    if ws.router_mode not in ("round_robin", "random", "kv"):
+        # Env/TOML-seeded defaults bypass argparse choices validation.
+        raise SystemExit(f"invalid router_mode from config: {ws.router_mode!r}")
     parser = argparse.ArgumentParser(description="dynamo-tpu launcher")
-    parser.add_argument("--model", default="test-tiny", help="model preset name or HF checkpoint directory")
-    parser.add_argument("--host", default="127.0.0.1")
-    parser.add_argument("--http-port", type=int, default=8080)
+    parser.add_argument("--model", default=ws.model, help="model preset name or HF checkpoint directory")
+    parser.add_argument("--host", default=rs.host)
+    parser.add_argument("--http-port", type=int, default=rs.http_port)
     parser.add_argument("--workers", type=int, default=1)
-    parser.add_argument("--num-pages", type=int, default=512)
-    parser.add_argument("--max-batch-size", type=int, default=64)
-    parser.add_argument("--router-mode", default="round_robin", choices=["round_robin", "random", "kv"])
+    parser.add_argument("--num-pages", type=int, default=ws.num_pages)
+    parser.add_argument("--max-batch-size", type=int, default=ws.max_batch_size)
+    parser.add_argument("--router-mode", default=ws.router_mode, choices=["round_robin", "random", "kv"])
     parser.add_argument("--g2-blocks", type=int, default=0, help="host-RAM KV tier capacity (blocks); 0 disables")
     parser.add_argument("--g3-blocks", type=int, default=0, help="disk KV tier capacity (blocks); 0 disables")
     parser.add_argument("--prefill-workers", type=int, default=0, help="disaggregated prefill fleet size")
     parser.add_argument(
-        "--role", default="local", choices=["local", "frontend", "worker", "prefill", "store"],
+        "--role", default="local", choices=["local", "frontend", "worker", "prefill", "router", "store"],
         help="multi-process deployments: run one role per process",
     )
-    parser.add_argument("--store", default=None, help="tcp://host:port of the deployment's store server")
+    parser.add_argument("--store", default=rs.store or None, help="tcp://host:port of the deployment's store server")
     parser.add_argument("--mock", action="store_true", help="timing-model engine instead of JAX (fleet tests, planner)")
     parser.add_argument("--serve-store-port", type=int, default=None, help="run the store server in this process")
     parser.add_argument(
@@ -451,8 +479,12 @@ def main(argv: list[str] | None = None) -> None:
         help="prompts longer than this prefill remotely (enables disaggregation)",
     )
     parser.add_argument(
-        "--mesh", default=None,
+        "--mesh", default=ws.mesh or None,
         help="GSPMD mesh: 'auto' or 'dp=2,tp=4,sp=1,ep=1' (default: single device)",
+    )
+    parser.add_argument(
+        "--decode-steps", type=int, default=ws.decode_steps,
+        help="fused decode steps per device dispatch",
     )
     parser.add_argument("--num-nodes", type=int, default=1, help="hosts forming one worker's mesh")
     parser.add_argument("--node-rank", type=int, default=0)
@@ -470,7 +502,18 @@ def main(argv: list[str] | None = None) -> None:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
-    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    from dynamo_tpu.runtime.logging import setup_logging
+
+    # Cascade-resolved logging settings; reference-named env toggles
+    # (DYN_LOGGING_JSONL etc.) still apply when the cascade left defaults.
+    setup_logging(
+        jsonl=rs.log_jsonl or None,
+        level=None if rs.log_level == "INFO" else rs.log_level,
+    )
+    if args.decode_steps != 1:
+        import os
+
+        os.environ["DYN_WORKER_DECODE_STEPS"] = str(args.decode_steps)
     asyncio.run(_amain(args))
 
 

@@ -1,0 +1,110 @@
+"""llmctl: inspect and edit model registrations in the deployment store.
+
+Parity: reference `launch/llmctl` (`launch/llmctl/src/main.rs`) — list the
+models frontends currently discover, statically add a registration (for an
+endpoint served by something other than this framework's workers, or ahead
+of its workers), and remove registrations.
+
+Usage:
+    python -m dynamo_tpu.llmctl --store tcp://HOST:PORT list
+    python -m dynamo_tpu.llmctl --store ... add --name m --endpoint ns.comp.ep
+    python -m dynamo_tpu.llmctl --store ... remove --name m
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from dynamo_tpu.model_card import MODEL_PREFIX, ModelDeploymentCard
+
+
+async def cmd_list(store, args) -> int:
+    records = await store.get_prefix(f"{MODEL_PREFIX}/")
+    by_name: dict[str, list[tuple[str, ModelDeploymentCard]]] = {}
+    for key, value in sorted(records.items()):
+        try:
+            card = ModelDeploymentCard.from_bytes(value)
+        except Exception:
+            print(f"?? unparseable card at {key}", file=sys.stderr)
+            continue
+        by_name.setdefault(card.name, []).append((key, card))
+    if args.json:
+        print(json.dumps({
+            name: [json.loads(c.to_bytes()) for _k, c in entries]
+            for name, entries in by_name.items()
+        }))
+        return 0
+    if not by_name:
+        print("(no models registered)")
+        return 0
+    print(f"{'MODEL':<28} {'INSTANCES':>9} {'ENDPOINT':<28} {'ROUTER':<12} {'CTX':>6}")
+    for name, entries in sorted(by_name.items()):
+        card = entries[0][1]
+        ep = ".".join(card.endpoint)
+        print(f"{name:<28} {len(entries):>9} {ep:<28} {card.router_mode:<12} {card.context_length:>6}")
+    return 0
+
+
+async def cmd_add(store, args) -> int:
+    ns, comp, ep = args.endpoint.split(".", 2)
+    card = ModelDeploymentCard(
+        name=args.name,
+        tokenizer=args.tokenizer,
+        context_length=args.context_length,
+        router_mode=args.router_mode,
+        endpoint=(ns, comp, ep),
+        model_type=args.model_type,
+    )
+    # Static registration: lease id 0, no lease binding — lives until removed.
+    await store.put(card.instance_key(0), card.to_bytes())
+    print(f"registered {args.name} -> {args.endpoint}")
+    return 0
+
+
+async def cmd_remove(store, args) -> int:
+    records = await store.get_prefix(f"{MODEL_PREFIX}/{args.name}/")
+    if not records:
+        print(f"no registrations for {args.name!r}", file=sys.stderr)
+        return 1
+    for key in records:
+        await store.delete(key)
+    print(f"removed {len(records)} registration(s) of {args.name}")
+    return 0
+
+
+async def _amain(args: argparse.Namespace) -> int:
+    from dynamo_tpu.runtime.store_server import StoreClient
+
+    store = StoreClient.from_url(args.store)
+    try:
+        return await {"list": cmd_list, "add": cmd_add, "remove": cmd_remove}[args.cmd](store, args)
+    finally:
+        close = getattr(store, "close", None)
+        if close:
+            await close()
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(description="model-registration control")
+    p.add_argument("--store", required=True, help="tcp://host:port of the deployment store")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    lst = sub.add_parser("list", help="list registered models")
+    lst.add_argument("--json", action="store_true")
+    add = sub.add_parser("add", help="statically register a model")
+    add.add_argument("--name", required=True)
+    add.add_argument("--endpoint", required=True, help="namespace.component.endpoint")
+    add.add_argument("--tokenizer", default="byte")
+    add.add_argument("--context-length", type=int, default=4096)
+    add.add_argument("--router-mode", default="round_robin")
+    add.add_argument("--model-type", default="chat+completions")
+    rem = sub.add_parser("remove", help="remove a model's registrations")
+    rem.add_argument("--name", required=True)
+    args = p.parse_args(argv)
+    raise SystemExit(asyncio.run(_amain(args)))
+
+
+if __name__ == "__main__":
+    main()

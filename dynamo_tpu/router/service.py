@@ -1,0 +1,73 @@
+"""Standalone KV-router service: `schedule(token_ids) -> worker_id` as an
+endpoint of its own.
+
+Parity: reference `components/router` binary
+(`components/router/src/main.rs:38-97`) — a router other ingresses (or
+external gateways) can query for placement without going through this
+framework's HTTP frontend. It watches the same worker component the
+embedded router does, so its world model is identical.
+
+Served as ``--role router`` by the launch CLI; request shape
+``{"token_ids": [...]}`` -> one response ``{"worker_id": int,
+"overlap_blocks": int}``.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.router.router import build_kv_router
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+
+logger = logging.getLogger(__name__)
+
+ROUTER_ENDPOINT = "route"
+
+
+class RouterService(AsyncEngine[Any, dict]):
+    """Serves placement decisions (no proxying of the actual request)."""
+
+    def __init__(self, push_router, subscriber, aggregator) -> None:
+        self._push = push_router
+        self._aux = [subscriber, aggregator]
+        self.decisions = 0
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
+        token_ids = list(request.get("token_ids", []))
+        client = self._push.client
+        await client.start()
+        worker_ids = client.instance_ids()
+        if not worker_ids:
+            yield {"error": "no workers available"}
+            return
+        wid, overlap = self._push.router.schedule(token_ids, worker_ids)
+        self.decisions += 1
+        yield {"worker_id": wid, "overlap_blocks": overlap}
+
+    async def close(self) -> None:
+        for a in self._aux:
+            await a.close()
+        self._aux = []
+
+
+async def serve_router(
+    runtime: DistributedRuntime,
+    *,
+    namespace: str = "dynamo",
+    component: str = "backend",
+    block_size: int = 16,
+    lease=None,
+) -> RouterService:
+    """Bring up the router stack and serve it on
+    ``{namespace}/router/{ROUTER_ENDPOINT}``."""
+    push, subscriber, aggregator = await build_kv_router(
+        runtime, namespace=namespace, component=component, block_size=block_size
+    )
+    service = RouterService(push, subscriber, aggregator)
+    await runtime.namespace(namespace).component("router").endpoint(ROUTER_ENDPOINT).serve(
+        service, metadata={"component": component}, lease=lease
+    )
+    logger.info("router service up for %s/%s (block_size=%d)", namespace, component, block_size)
+    return service

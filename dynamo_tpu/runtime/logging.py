@@ -1,0 +1,99 @@
+"""Logging setup: human-readable or JSONL, driven by env toggles.
+
+Parity: the reference's tracing-subscriber init (`lib/runtime/src/
+logging.rs:100-268`) with its env switches (`config.rs:163-176`):
+
+- ``DYN_LOGGING_JSONL=1``      -> one JSON object per line (ts, level,
+  logger, message, plus any ``extra={...}`` fields flattened in).
+- ``DYN_LOG_LEVEL=DEBUG``      -> root level (default INFO).
+- ``DYN_LOG_USE_LOCAL_TZ=1``   -> local-time timestamps (default UTC).
+- ``DYN_SDK_DISABLE_ANSI_LOGGING=1`` -> no color in the text format.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import logging
+import os
+import sys
+
+_RESERVED = set(logging.LogRecord("", 0, "", 0, "", (), None).__dict__) | {
+    "message", "asctime", "taskName"
+}
+
+_LEVEL_COLOR = {"DEBUG": "\x1b[36m", "INFO": "\x1b[32m", "WARNING": "\x1b[33m",
+                "ERROR": "\x1b[31m", "CRITICAL": "\x1b[35m"}
+_RESET = "\x1b[0m"
+
+
+class JsonlFormatter(logging.Formatter):
+    """One JSON object per line; record ``extra`` fields are flattened in
+    (the span-field capture role of the reference's JSONL mode)."""
+
+    def __init__(self, *, local_tz: bool = False) -> None:
+        super().__init__()
+        self._tz = None if local_tz else datetime.timezone.utc
+
+    def format(self, record: logging.LogRecord) -> str:
+        ts = datetime.datetime.fromtimestamp(record.created, tz=self._tz)
+        doc = {
+            "time": ts.isoformat(),
+            "level": record.levelname,
+            "target": record.name,
+            "message": record.getMessage(),
+        }
+        for k, v in record.__dict__.items():
+            if k not in _RESERVED and not k.startswith("_"):
+                doc[k] = v
+        if record.exc_info:
+            doc["exception"] = self.formatException(record.exc_info)
+        return json.dumps(doc, default=str)
+
+
+class TextFormatter(logging.Formatter):
+    def __init__(self, *, ansi: bool = True, local_tz: bool = False) -> None:
+        super().__init__("%(asctime)s %(levelname)s %(name)s %(message)s")
+        self._ansi = ansi
+        self._tz = None if local_tz else datetime.timezone.utc
+
+    def formatTime(self, record, datefmt=None):  # noqa: N802 (stdlib API)
+        return datetime.datetime.fromtimestamp(record.created, tz=self._tz).isoformat(timespec="milliseconds")
+
+    def format(self, record: logging.LogRecord) -> str:
+        out = super().format(record)
+        if self._ansi and record.levelname in _LEVEL_COLOR:
+            out = f"{_LEVEL_COLOR[record.levelname]}{out}{_RESET}"
+        return out
+
+
+def setup_logging(
+    *,
+    jsonl: bool | None = None,
+    level: str | None = None,
+    env: dict[str, str] | None = None,
+    stream=None,
+) -> logging.Handler:
+    """Install the root handler; returns it (tests inspect).
+
+    Explicit ``jsonl``/``level`` (e.g. from the RuntimeSettings cascade) win;
+    otherwise the reference-named env toggles apply."""
+    from dynamo_tpu.config import env_flag
+
+    env = os.environ if env is None else env
+    if jsonl is None:
+        jsonl = env_flag(env, "DYN_LOGGING_JSONL")
+    local_tz = env_flag(env, "DYN_LOG_USE_LOCAL_TZ")
+    no_ansi = env_flag(env, "DYN_SDK_DISABLE_ANSI_LOGGING")
+    level = (level or env.get("DYN_LOG_LEVEL", "INFO")).upper()
+
+    handler = logging.StreamHandler(stream or sys.stderr)
+    if jsonl:
+        handler.setFormatter(JsonlFormatter(local_tz=local_tz))
+    else:
+        ansi = not no_ansi and getattr(handler.stream, "isatty", lambda: False)()
+        handler.setFormatter(TextFormatter(ansi=ansi, local_tz=local_tz))
+    root = logging.getLogger()
+    root.handlers[:] = [handler]
+    root.setLevel(getattr(logging, level, logging.INFO))
+    return handler
