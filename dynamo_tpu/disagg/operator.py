@@ -52,8 +52,9 @@ class DisaggDecodeService(AsyncEngine[Any, dict]):
 
     async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
         req = PreprocessedRequest.from_dict(request) if isinstance(request, dict) else request
-        if req.annotations.get("embed"):
-            # Embeddings build no KV: remote prefill would be pure waste.
+        if req.annotations.get("embed") or req.mm_inputs:
+            # Embeddings build no KV; multimodal prompts carry image
+            # embeddings the prefill queue task does not — both stay local.
             async for item in self.engine.generate(req, context):
                 yield item
             return

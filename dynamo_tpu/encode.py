@@ -1,0 +1,121 @@
+"""Encode worker: images -> vision-tower embeddings, served on the runtime.
+
+The multimodal split (reference `examples/multimodal/components/
+encode_worker.py:61-179`): a dedicated worker owns the vision tower; the
+frontend's preprocessor sends it the request's images and receives the
+projected embeddings, which then ride the preprocessed request to the
+prefill engine (`llama.forward(mm_embeds=...)` substitutes them at the
+image placeholder tokens).
+
+Request: ``{"images_b64": [<base64 image bytes>, ...]}``
+Response: ``{"embeds_b64": ..., "shape": [n, patches, D], "dtype": ...,
+"patches_per_image": [...]}``
+"""
+
+from __future__ import annotations
+
+import base64
+import logging
+from typing import Any, AsyncIterator
+
+import numpy as np
+
+from dynamo_tpu.models.vision import (
+    TEST_TINY_VISION,
+    VisionConfig,
+    encode_image,
+    init_vision_params,
+    preprocess_image,
+)
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+
+logger = logging.getLogger(__name__)
+
+ENCODE_COMPONENT = "encode"
+ENCODE_ENDPOINT = "encode"
+
+# Vision towers paired with the LLM presets that accept their output width.
+VISION_PRESETS: dict[str, VisionConfig] = {
+    "test-tiny-vl": TEST_TINY_VISION,
+}
+
+
+class EncodeService(AsyncEngine[Any, dict]):
+    """Serves the vision tower; one request = one batched image encode."""
+
+    def __init__(self, cfg: VisionConfig, params=None) -> None:
+        import functools
+
+        import jax
+
+        self.cfg = cfg
+        self.params = params if params is not None else init_vision_params(cfg, 0)
+        self._encode = jax.jit(functools.partial(encode_image, self.params, cfg))
+        self.images_encoded = 0
+
+    def _encode_batch(self, images: list[bytes]) -> np.ndarray:
+        pixels = np.stack([preprocess_image(b, self.cfg) for b in images])
+        # Pow2 batch bucketing: without it every new image count compiles a
+        # fresh tower program (the runner's bucket lattice, applied here).
+        n = len(images)
+        bucket = 1 if n <= 1 else 1 << (n - 1).bit_length()
+        if bucket != n:
+            pixels = np.concatenate([pixels, np.zeros((bucket - n, *pixels.shape[1:]), pixels.dtype)])
+        return np.asarray(self._encode(pixels), np.float32)[:n]
+
+    async def close(self) -> None:  # lifecycle parity with engine services
+        pass
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
+        import asyncio
+
+        raw = [base64.b64decode(s) for s in request.get("images_b64", [])]
+        if not raw:
+            yield {"error": "no images"}
+            return
+        embeds = await asyncio.get_running_loop().run_in_executor(None, self._encode_batch, raw)
+        self.images_encoded += len(raw)
+        yield {
+            "embeds_b64": base64.b64encode(np.ascontiguousarray(embeds).tobytes()).decode(),
+            "shape": list(embeds.shape),
+            "dtype": "float32",
+            "patches_per_image": [self.cfg.num_patches] * len(raw),
+        }
+
+
+async def serve_encode_worker(
+    runtime: DistributedRuntime,
+    cfg: VisionConfig,
+    *,
+    params=None,
+    namespace: str = "dynamo",
+    lease=None,
+) -> EncodeService:
+    service = EncodeService(cfg, params)
+    await runtime.namespace(namespace).component(ENCODE_COMPONENT).endpoint(ENCODE_ENDPOINT).serve(
+        service, metadata={"patches": cfg.num_patches}, lease=lease
+    )
+    logger.info("encode worker up (%d patches -> %d dim)", cfg.num_patches, cfg.out_dim)
+    return service
+
+
+def make_encoder(runtime: DistributedRuntime, namespace: str = "dynamo"):
+    """Frontend-side encoder callable: images (bytes) -> (embeds, patch counts).
+
+    Returns an async fn the preprocessor calls; it routes to any live encode
+    worker instance."""
+    client = runtime.namespace(namespace).component(ENCODE_COMPONENT).endpoint(ENCODE_ENDPOINT).client()
+
+    async def encode(images: list[bytes]) -> tuple[np.ndarray, list[int]]:
+        req = {"images_b64": [base64.b64encode(b).decode() for b in images]}
+        async for resp in client.generate(req, Context()):
+            if "error" in resp:
+                raise ValueError(f"encode worker: {resp['error']}")
+            arr = np.frombuffer(
+                base64.b64decode(resp["embeds_b64"]), dtype=np.dtype(resp["dtype"])
+            ).reshape(resp["shape"])
+            return arr, list(resp["patches_per_image"])
+        raise RuntimeError("encode worker returned no response")
+
+    return encode

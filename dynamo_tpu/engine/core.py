@@ -102,9 +102,15 @@ class EngineCore:
 
     def add_request(self, request: PreprocessedRequest, context: Context | None = None) -> Sequence:
         context = context or Context()
+        # Image content is part of the prefix-cache identity: two prompts
+        # with identical placeholder tokens but different images must not
+        # reuse each other's KV. The router folds the same value (tokens.py).
+        from dynamo_tpu.tokens import mm_salt_fold
+
+        salt = self.config.salt ^ mm_salt_fold(request.mm_inputs)
         seq = Sequence.from_request(
             self._next_seq_id, request, context,
-            page_size=self.config.page_size, salt=self.config.salt,
+            page_size=self.config.page_size, salt=salt,
         )
         self._next_seq_id += 1
         if not request.token_ids:
@@ -116,6 +122,14 @@ class EngineCore:
             seq.status = SeqStatus.FINISHED
             seq.finish_reason = FinishReason.LENGTH
             return seq
+        if request.mm_inputs:
+            try:
+                seq.mm_embeds = self._decode_mm_inputs(request)
+            except ValueError as exc:
+                logger.warning("rejecting multimodal request: %s", exc)
+                seq.status = SeqStatus.FINISHED
+                seq.finish_reason = FinishReason.ERROR
+                return seq
         # A prompt needing more pages than the pool holds can never be
         # scheduled; admitting it would wedge the FIFO head forever.
         usable_pages = self.config.num_pages - 1  # page 0 is the reserved null page
@@ -130,6 +144,31 @@ class EngineCore:
             return seq
         self.waiting.append(seq)
         return seq
+
+    def _decode_mm_inputs(self, request: PreprocessedRequest):
+        """mm_inputs wire format -> [total_image_tokens, D] embeddings.
+
+        The placeholder count in the prompt must match the embedding rows:
+        a mismatch would silently shift every image's content."""
+        import base64
+
+        mi = request.mm_inputs
+        try:
+            arr = np.frombuffer(
+                base64.b64decode(mi["embeds_b64"]), dtype=np.dtype(mi.get("dtype", "float32"))
+            ).reshape(mi["shape"])
+            arr = arr.reshape(-1, arr.shape[-1])
+        except Exception as exc:  # malformed wire payloads must not escape
+            raise ValueError(f"malformed mm_inputs: {exc}") from exc
+        img_id = getattr(self.runner.cfg, "image_token_id", None) if hasattr(self.runner, "cfg") else None
+        if img_id is None:
+            raise ValueError("model has no image placeholder token")
+        n_placeholders = sum(1 for t in request.token_ids if t == img_id)
+        if n_placeholders != arr.shape[0]:
+            raise ValueError(
+                f"{n_placeholders} image placeholders vs {arr.shape[0]} embedding rows"
+            )
+        return arr
 
     @property
     def has_work(self) -> bool:
@@ -282,8 +321,25 @@ class EngineCore:
             page_arr = np.asarray(s.pages, dtype=np.int32)
             slots[i, : len(new)] = page_arr[pos // ps] * ps + pos % ps
             last[i] = len(new) - 1
+        sb = self._sampling_batch(batch, tokens, positions, block_tables, slots, last)
+        if any(s.mm_embeds is not None for s in batch):
+            d = next(s.mm_embeds.shape[1] for s in batch if s.mm_embeds is not None)
+            m = max(s.mm_embeds.shape[0] for s in batch if s.mm_embeds is not None)
+            img_id = self.runner.cfg.image_token_id
+            mm = np.zeros((b, m, d), np.float32)
+            off = np.full(b, -1, np.int32)  # -1: text row, no substitution
+            counts = np.zeros(b, np.int32)
+            for i, s in enumerate(batch):
+                if s.mm_embeds is not None:
+                    mm[i, : s.mm_embeds.shape[0]] = s.mm_embeds
+                    counts[i] = s.mm_embeds.shape[0]
+                    # Placeholders already covered by cached/previous chunks.
+                    off[i] = int(np.count_nonzero(
+                        np.asarray(s.tokens[: s.num_cached], np.int32) == img_id
+                    ))
+            sb.mm_embeds, sb.mm_slot_offset, sb.mm_counts = mm, off, counts
         try:
-            next_tokens = self.runner.step(self._sampling_batch(batch, tokens, positions, block_tables, slots, last))
+            next_tokens = self.runner.step(sb)
         except Exception:
             # Batch seqs were popped from waiting but are not yet in running:
             # without cleanup here their pages would leak forever.

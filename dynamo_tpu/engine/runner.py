@@ -114,6 +114,10 @@ class StepBatch:
     freq_pen: np.ndarray  # f32[B] — OpenAI frequency_penalty
     pres_pen: np.ndarray  # f32[B] — OpenAI presence_penalty
     history: np.ndarray  # i32[B, H] generated tokens so far, pad -1 (H=1 when no penalties)
+    # Multimodal prefill only (None on text batches / decode):
+    mm_embeds: np.ndarray | None = None  # f32[B, M, D] image embeddings
+    mm_slot_offset: np.ndarray | None = None  # i32[B] placeholders already cached; -1 = text row
+    mm_counts: np.ndarray | None = None  # i32[B] embedding rows provided per row
 
     @property
     def batch_size(self) -> int:
@@ -167,10 +171,17 @@ class ModelRunner:
         @functools.partial(jax.jit, static_argnames=("impl",), donate_argnums=(1, 2))
         def _step(params, k_cache, v_cache, tokens, positions, block_tables, slot_mapping,
                   last_idx, temperature, top_k, top_p, seeds, sample_steps,
-                  freq_pen, pres_pen, history, *, impl):
+                  freq_pen, pres_pen, history,
+                  mm_embeds=None, mm_slot_offset=None, mm_counts=None, *, impl):
+            # mm_* None on text batches; jit specializes once per presence
+            # pattern, so the text program carries no multimodal cost.
+            mm_kw = {}
+            if mm_embeds is not None:
+                mm_kw = dict(mm_embeds=mm_embeds, mm_slot_offset=mm_slot_offset, mm_counts=mm_counts)
             logits, k_cache, v_cache = self._forward(
                 params, self.cfg, tokens, positions, k_cache, v_cache,
                 block_tables, slot_mapping, last_idx, attn_impl=impl, mesh=self.mesh,
+                **mm_kw,
             )
             keys = jax.vmap(lambda s, c: jax.random.fold_in(jax.random.PRNGKey(s), c))(seeds, sample_steps)
             next_tokens = sample_tokens(
@@ -371,6 +382,11 @@ class ModelRunner:
         tp = self._bucket_time(t)
         np_ = self._bucket_pages(batch.block_tables.shape[1])
         hp = next_pow2(batch.history.shape[1])  # 1 when no penalties in batch
+        mm = None
+        if batch.mm_embeds is not None:
+            mp = next_pow2(batch.mm_embeds.shape[1])
+            mm = np.zeros((bp, mp, batch.mm_embeds.shape[2]), batch.mm_embeds.dtype)
+            mm[: batch.mm_embeds.shape[0], : batch.mm_embeds.shape[1]] = batch.mm_embeds
 
         def pad2(a, rows, cols, fill=0):
             out = np.full((rows, cols), fill, a.dtype)
@@ -396,6 +412,9 @@ class ModelRunner:
             freq_pen=pad1(batch.freq_pen, bp),
             pres_pen=pad1(batch.pres_pen, bp),
             history=pad2(batch.history, bp, hp, fill=-1),
+            mm_embeds=mm,
+            mm_slot_offset=None if batch.mm_slot_offset is None else pad1(batch.mm_slot_offset, bp, fill=-1),
+            mm_counts=None if batch.mm_counts is None else pad1(batch.mm_counts, bp),
         )
 
     # -- execution ---------------------------------------------------------
@@ -424,6 +443,26 @@ class ModelRunner:
         """Run one forward+sample step; returns sampled token ids i32[B_real]."""
         b_real = batch.batch_size
         padded = self._pad(batch)
+        if padded.mm_embeds is not None:
+            if self.mesh is not None:
+                from dynamo_tpu.parallel.sharding import batch_sharding
+
+                def put(a):
+                    return jax.device_put(a, batch_sharding(self.mesh, a.ndim))
+            else:
+                put = jnp.asarray
+            next_tokens, self.k_cache, self.v_cache = self._step_fn(
+                self.params, self.k_cache, self.v_cache,
+                put(padded.tokens), put(padded.positions),
+                put(padded.block_tables), put(padded.slot_mapping),
+                put(padded.last_token_index), put(padded.temperature),
+                put(padded.top_k), put(padded.top_p),
+                put(padded.seeds), put(padded.sample_steps),
+                put(padded.freq_pen), put(padded.pres_pen), put(padded.history),
+                put(padded.mm_embeds), put(padded.mm_slot_offset), put(padded.mm_counts),
+                impl=self._select_impl(padded) if self.mesh is not None else self.attn_impl,
+            )
+            return np.asarray(next_tokens)[:b_real]
         if self.mesh is not None:
             from dynamo_tpu.parallel.sharding import batch_sharding
 

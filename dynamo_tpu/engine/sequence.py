@@ -39,6 +39,9 @@ class Sequence:
     committed_pages: int = 0  # pages already committed to the prefix cache
     status: SeqStatus = SeqStatus.WAITING
     finish_reason: FinishReason | None = None
+    # Image embeddings [total_image_tokens, D] substituted at placeholder
+    # positions during prefill (multimodal; survives preemption/recompute).
+    mm_embeds: "object | None" = None
     arrival_time: float = field(default_factory=time.monotonic)
     first_token_time: float | None = None
 

@@ -98,7 +98,16 @@ class JaxEngineService(AsyncEngine[Any, dict]):
                     request, context, out_q = self._intake.get_nowait()
                 except asyncio.QueueEmpty:
                     break
-                seq = self.core.add_request(request, context)
+                try:
+                    seq = self.core.add_request(request, context)
+                except Exception:
+                    logger.exception("add_request failed; failing that request only")
+                    from dynamo_tpu.protocols.common import FinishReason
+
+                    out_q.put_nowait(EngineOutput(token_ids=[], finish_reason=FinishReason.ERROR))
+                    out_q.put_nowait(_SENTINEL)
+                    admitted = True
+                    continue
                 self._streams[seq.seq_id] = out_q
                 if seq.is_finished:  # rejected at intake (too long / empty)
                     out_q.put_nowait(

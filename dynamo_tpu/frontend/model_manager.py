@@ -109,12 +109,22 @@ async def build_pipeline(
         client = runtime.namespace(ns).component(comp).endpoint(ep).client(router_mode=mode)
         engine = ClientEngine(client)
     backend = Backend(engine, tokenizer)
+    encoder = None
+    image_token_id = card.extra.get("image_token_id")
+    if image_token_id is not None:
+        from dynamo_tpu.encode import make_encoder
+
+        # Vision-language model: route this model's images through the
+        # encode-worker fleet (reference encode_worker handoff).
+        encoder = make_encoder(runtime, ns)
     pre = OpenAIPreprocessor(
         backend,
         tokenizer,
         chat_template=card.chat_template,
         default_max_tokens=max(1, min(card.context_length // 2, 4096)),
         max_embed_tokens=max(1, min(card.context_length, 2048)),
+        encoder=encoder,
+        image_token_id=image_token_id,
     )
     return pre, client, aux
 

@@ -64,6 +64,8 @@ class WorkerSpec:
             context_length=min(mc.max_position, 4096),
             eos_token_ids=sorted(load_tokenizer(tokenizer).eos_token_ids),
         )
+        if mc.image_token_id is not None:
+            card.extra.setdefault("image_token_id", mc.image_token_id)
         return cls(model_config=mc, card=card, engine_config=cls._engine_cfg(card, engine_kw))
 
     @classmethod
@@ -319,13 +321,21 @@ async def run_local(
         lease = await runtime.secondary_lease() if total_workers > 1 else None
         service = await serve_prefill_worker(runtime, make_spec(num_workers + i), lease=lease)
         services.append(service)
+    # Vision-language presets get an in-process encode worker automatically.
+    from dynamo_tpu.encode import VISION_PRESETS, serve_encode_worker
+
+    if preset in VISION_PRESETS:
+        services.append(await serve_encode_worker(runtime, VISION_PRESETS[preset]))
 
     async def clear_all() -> int:
         n = 0
         for s in services:
-            n += s.core.allocator.clear_cache()
-            if s.core.block_manager is not None:
-                n += s.core.block_manager.clear()
+            core = getattr(s, "core", None)  # encode workers hold no KV
+            if core is None:
+                continue
+            n += core.allocator.clear_cache()
+            if core.block_manager is not None:
+                n += core.block_manager.clear()
         return n
 
     http, watcher, actual_port = await serve_frontend(
@@ -391,6 +401,13 @@ async def run_role(args: argparse.Namespace) -> None:
         spec.mock = args.mock
         await serve_prefill_worker(runtime, spec)
         logger.info("prefill worker ready")
+    elif args.role == "encode":
+        from dynamo_tpu.encode import VISION_PRESETS, serve_encode_worker
+
+        if args.model not in VISION_PRESETS:
+            raise SystemExit(f"no vision tower for model {args.model!r}")
+        await serve_encode_worker(runtime, VISION_PRESETS[args.model])
+        logger.info("encode worker ready")
     elif args.role == "router":
         from dynamo_tpu.model_card import MODEL_PREFIX, ModelDeploymentCard
         from dynamo_tpu.router.service import serve_router
@@ -468,7 +485,7 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--g3-blocks", type=int, default=0, help="disk KV tier capacity (blocks); 0 disables")
     parser.add_argument("--prefill-workers", type=int, default=0, help="disaggregated prefill fleet size")
     parser.add_argument(
-        "--role", default="local", choices=["local", "frontend", "worker", "prefill", "router", "store"],
+        "--role", default="local", choices=["local", "frontend", "worker", "prefill", "encode", "router", "store"],
         help="multi-process deployments: run one role per process",
     )
     parser.add_argument("--store", default=rs.store or None, help="tcp://host:port of the deployment's store server")

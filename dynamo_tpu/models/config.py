@@ -43,6 +43,9 @@ class ModelConfig:
     shared_expert_gated: bool = False
     # Biases on q/k/v projections (Qwen2 family).
     attention_bias: bool = False
+    # Multimodal: the placeholder token id image embeddings substitute for
+    # (None = text-only model); vision tower geometry lives in VisionConfig.
+    image_token_id: int | None = None
 
     @property
     def q_dim(self) -> int:
@@ -111,6 +114,13 @@ PRESETS: dict[str, ModelConfig] = {
         name="test-tiny", vocab_size=256, hidden_size=64, num_layers=2,
         num_heads=4, num_kv_heads=2, head_dim=16, intermediate_size=128,
         rope_theta=10000.0, max_position=512, tie_embeddings=True, dtype="float32",
+    ),
+    # Vision-language test model: test-tiny plus an image placeholder token.
+    "test-tiny-vl": ModelConfig(
+        name="test-tiny-vl", vocab_size=256, hidden_size=64, num_layers=2,
+        num_heads=4, num_kv_heads=2, head_dim=16, intermediate_size=128,
+        rope_theta=10000.0, max_position=512, tie_embeddings=True, dtype="float32",
+        image_token_id=255,
     ),
     # MoE test model: 4 experts, top-2.
     "test-tiny-moe": ModelConfig(

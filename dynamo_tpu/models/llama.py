@@ -186,6 +186,9 @@ def forward(
     *,
     attn_impl: str | None = None,
     mesh=None,  # required when attn_impl == "ring"
+    mm_embeds: jnp.ndarray | None = None,  # [B, M, D] image embeddings (vision tower)
+    mm_slot_offset: jnp.ndarray | None = None,  # i32[B] placeholders already cached; -1 = text row
+    mm_counts: jnp.ndarray | None = None,  # i32[B] embedding rows provided per row
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One forward step. Returns (logits f32[B, vocab], k_cache, v_cache).
 
@@ -197,11 +200,33 @@ def forward(
     valid only when every sequence's full context is inside this chunk
     (positions start at 0, no cached prefix); K/V still write through to the
     paged cache so decode continues on the paged path.
+
+    ``mm_embeds`` substitutes the k-th image placeholder token
+    (``cfg.image_token_id``) of row b with ``mm_embeds[b, k + offset]`` —
+    ``mm_slot_offset`` counts placeholders in already-cached chunks, so
+    chunked prefill and prefix-cache resumption stay exact (the multimodal
+    prefill handoff, reference `examples/multimodal/`).
     """
     b, t = tokens.shape
     nl, npages, ps = k_cache.shape[0], k_cache.shape[1], k_cache.shape[2]
     inv_freq = jnp.asarray(rope_frequencies(cfg.head_dim, theta=cfg.rope_theta, scaling=cfg.rope_scaling))
     x = params["embed"][tokens]  # [B, T, D]
+    if mm_embeds is not None and cfg.image_token_id is not None:
+        is_img = tokens == jnp.int32(cfg.image_token_id)  # [B, T]
+        slot = jnp.cumsum(is_img.astype(jnp.int32), axis=1) - 1
+        if mm_slot_offset is not None:
+            slot = slot + jnp.maximum(mm_slot_offset, 0)[:, None]
+            # Rows without images (offset -1) keep plain token embeddings —
+            # a text prompt containing the placeholder id must not change
+            # meaning based on which batch it shares a prefill with.
+            is_img = is_img & (mm_slot_offset >= 0)[:, None]
+        if mm_counts is not None:
+            # Placeholders beyond the provided rows (e.g. *sampled* image
+            # tokens recomputed after preemption) stay token embeddings.
+            is_img = is_img & (slot < mm_counts[:, None])
+        slot = jnp.clip(slot, 0, mm_embeds.shape[1] - 1)
+        gathered = jnp.take_along_axis(mm_embeds.astype(x.dtype), slot[..., None], axis=1)
+        x = jnp.where(is_img[..., None], gathered, x)
 
     # The stacked cache is kept flat ([L*pages, ps, W]) and every layer
     # addresses its region with offset indices (page' = li*pages + page).

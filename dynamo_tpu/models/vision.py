@@ -1,0 +1,142 @@
+"""Vision tower: ViT image encoder + projector to the LLM's hidden space.
+
+Multimodal serving splits into an *encode* stage (this module, run by
+encode workers) and the LLM prefill that consumes the resulting embeddings
+in place of image placeholder tokens (`llama.forward(mm_embeds=...)`).
+
+Parity: reference multimodal examples
+(`examples/multimodal/components/encode_worker.py:61-179`) where a separate
+worker runs the HF vision tower and hands embeddings to prefill over NIXL;
+here the tower is first-party JAX (patchify -> pre-LN ViT -> 2-layer MLP
+projector, the LLaVA recipe) and embeddings ride the runtime's transfer
+plane.
+
+TPU notes: patchify is one conv-as-matmul reshape (MXU-friendly), attention
+is dense over a few hundred patch tokens, everything static-shaped; one
+image = one [num_patches, llm_hidden] bf16/f32 block.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = dict
+
+
+@dataclasses.dataclass(frozen=True)
+class VisionConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    hidden_size: int = 256
+    num_layers: int = 4
+    num_heads: int = 4
+    mlp_ratio: int = 4
+    out_dim: int = 2048  # the LLM's hidden size
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def patch_dim(self) -> int:
+        return 3 * self.patch_size * self.patch_size
+
+
+# A tiny tower matching the test-tiny-vl preset (out_dim = 64).
+TEST_TINY_VISION = VisionConfig(
+    image_size=32, patch_size=8, hidden_size=32, num_layers=2, num_heads=2, out_dim=64
+)
+
+
+def init_vision_params(cfg: VisionConfig, rng: jax.Array | int = 0) -> Params:
+    if isinstance(rng, int):
+        rng = jax.random.PRNGKey(rng)
+    ks = jax.random.split(rng, 8)
+
+    def w(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) * (fan_in**-0.5))
+
+    d, p = cfg.hidden_size, cfg.patch_dim
+    mlp = cfg.hidden_size * cfg.mlp_ratio
+    layer_keys = jax.random.split(ks[7], cfg.num_layers)
+
+    def layer(key):
+        lk = jax.random.split(key, 6)
+        return {
+            "ln1": jnp.ones(d), "ln2": jnp.ones(d),
+            "wqkv": w(lk[0], (d, 3 * d), d), "wo": w(lk[1], (d, d), d),
+            "w1": w(lk[2], (d, mlp), d), "w2": w(lk[3], (mlp, d), mlp),
+        }
+
+    layers = jax.tree.map(lambda *xs: jnp.stack(xs), *[layer(k) for k in layer_keys])
+    return {
+        "patch_embed": w(ks[0], (p, d), p),
+        "pos_embed": w(ks[1], (cfg.num_patches, d), d) * 0.02,
+        "ln_f": jnp.ones(d),
+        # LLaVA-style 2-layer MLP projector into the LLM hidden space.
+        "proj1": w(ks[2], (d, cfg.out_dim), d),
+        "proj2": w(ks[3], (cfg.out_dim, cfg.out_dim), cfg.out_dim),
+        "layers": layers,
+    }
+
+
+def _ln(x: jnp.ndarray, g: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * g
+
+
+def encode_image(params: Params, cfg: VisionConfig, pixels: jnp.ndarray) -> jnp.ndarray:
+    """[B, H, W, 3] float in [-1, 1] -> [B, num_patches, out_dim]."""
+    b = pixels.shape[0]
+    g = cfg.image_size // cfg.patch_size
+    # Patchify as one reshape + matmul (a conv with stride == kernel).
+    x = pixels.reshape(b, g, cfg.patch_size, g, cfg.patch_size, 3)
+    x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, g * g, cfg.patch_dim)
+    x = x @ params["patch_embed"] + params["pos_embed"]
+
+    h = cfg.num_heads
+    hd = cfg.hidden_size // h
+    scale = hd**-0.5
+
+    def layer_step(x, lp):
+        y = _ln(x, lp["ln1"])
+        qkv = (y @ lp["wqkv"]).reshape(b, -1, 3, h, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        att = jax.nn.softmax(jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale, axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(b, -1, cfg.hidden_size)
+        x = x + o @ lp["wo"]
+        y = _ln(x, lp["ln2"])
+        x = x + jax.nn.gelu(y @ lp["w1"]) @ lp["w2"]
+        return x, None
+
+    x, _ = jax.lax.scan(layer_step, x, params["layers"])
+    x = _ln(x, params["ln_f"])
+    x = jax.nn.gelu(x @ params["proj1"]) @ params["proj2"]
+    return x
+
+
+def preprocess_image(data: bytes, cfg: VisionConfig) -> np.ndarray:
+    """Decode + resize + normalize one image -> [H, W, 3] float32 in [-1, 1]."""
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(data)).convert("RGB").resize(
+        (cfg.image_size, cfg.image_size), Image.BILINEAR
+    )
+    arr = np.asarray(img, np.float32) / 127.5 - 1.0
+    return arr
+
+
+def decode_data_url(url: str) -> bytes:
+    """``data:image/...;base64,...`` -> raw image bytes (no network egress)."""
+    import base64
+
+    if not url.startswith("data:"):
+        raise ValueError("only data: image URLs are supported (no network egress)")
+    _, _, payload = url.partition(",")
+    return base64.b64decode(payload)

@@ -99,6 +99,8 @@ class OpenAIPreprocessor(Operator):
         default_max_tokens: int = 512,
         add_bos: bool = True,
         max_embed_tokens: int = 2048,
+        encoder=None,  # async (images: list[bytes]) -> (embeds, patches_per_image)
+        image_token_id: int | None = None,
     ) -> None:
         super().__init__(downstream)
         self.tokenizer = tokenizer
@@ -106,8 +108,38 @@ class OpenAIPreprocessor(Operator):
         self.default_max_tokens = default_max_tokens
         self.add_bos = add_bos
         self.max_embed_tokens = max_embed_tokens
+        self.encoder = encoder
+        self.image_token_id = image_token_id
 
-    def preprocess(self, body: dict[str, Any]) -> PreprocessedRequest:
+    IMAGE_SENTINEL = "<|dyn_image|>"
+
+    def _extract_images(self, body: dict[str, Any]) -> tuple[dict[str, Any], list[bytes]]:
+        """Pull data-URL images out of chat content parts; each becomes a
+        sentinel in the flattened text that tokenization replaces with
+        image placeholder tokens. Returns (copied body, images in order)."""
+        from dynamo_tpu.models.vision import decode_data_url
+
+        images: list[bytes] = []
+        if not isinstance(body.get("messages"), list):
+            return body, images
+        out = dict(body)
+        messages = []
+        for msg in body["messages"]:
+            content = msg.get("content")
+            if isinstance(content, list):
+                parts = []
+                for part in content:
+                    if isinstance(part, dict) and part.get("type") == "image_url":
+                        images.append(decode_data_url(part["image_url"]["url"]))
+                        parts.append(self.IMAGE_SENTINEL)
+                    elif isinstance(part, dict) and part.get("type") == "text":
+                        parts.append(part.get("text", ""))
+                msg = {**msg, "content": "".join(parts)}
+            messages.append(msg)
+        out["messages"] = messages
+        return out, images
+
+    def preprocess(self, body: dict[str, Any], *, image_patches: list[int] | None = None) -> PreprocessedRequest:
         prompt: str | None
         token_ids: list[int] | None = None
         if "messages" in body:
@@ -130,7 +162,20 @@ class OpenAIPreprocessor(Operator):
             else:
                 raise ValueError("unsupported 'prompt' type: expected string, token-id array, or single-element string array")
         if token_ids is None:
-            token_ids = self.tokenizer.encode(prompt, add_bos=self.add_bos)
+            if image_patches and prompt is not None:
+                segments = prompt.split(self.IMAGE_SENTINEL)
+                if len(segments) != len(image_patches) + 1:
+                    raise ValueError(
+                        f"{len(segments) - 1} image sentinels in the rendered prompt "
+                        f"vs {len(image_patches)} images (does the chat template drop content?)"
+                    )
+                token_ids = self.tokenizer.encode(segments[0], add_bos=self.add_bos)
+                for n_patches, seg in zip(image_patches, segments[1:]):
+                    token_ids += [self.image_token_id] * n_patches
+                    if seg:
+                        token_ids += self.tokenizer.encode(seg, add_bos=False)
+            else:
+                token_ids = self.tokenizer.encode(prompt, add_bos=self.add_bos)
         req = PreprocessedRequest(
             token_ids=token_ids,
             sampling=extract_sampling(body),
@@ -173,6 +218,24 @@ class OpenAIPreprocessor(Operator):
     async def transform_request(self, request: Any, context: Context) -> dict:
         if not isinstance(request, dict):
             raise TypeError(f"preprocessor expects an OpenAI body dict, got {type(request)}")
+        if self.encoder is not None and self.image_token_id is not None:
+            body, images = self._extract_images(request)
+            if images:
+                import base64
+
+                import numpy as np
+
+                embeds, patches = await self.encoder(images)
+                req = self.preprocess(body, image_patches=patches)
+                req.mm_inputs = {
+                    "embeds_b64": base64.b64encode(
+                        np.ascontiguousarray(embeds, np.float32).tobytes()
+                    ).decode(),
+                    "shape": list(embeds.shape),
+                    "dtype": "float32",
+                }
+                return req.to_dict()
+            request = body
         return self.preprocess(request).to_dict()
 
     def transform_stream(self, stream: AsyncIterator[Any], request: Any, context: Context) -> AsyncIterator[Any]:

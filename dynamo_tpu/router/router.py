@@ -44,10 +44,15 @@ class KvRouter:
         self.block_size = block_size
         self.salt = salt
 
-    def schedule(self, token_ids: list[int], worker_ids: list[int]) -> tuple[int, int]:
-        """Returns (worker_id, overlap_blocks) for the given prompt."""
-        kw = {"salt": self.salt} if self.salt is not None else {}
-        hashes = compute_block_hashes(token_ids, self.block_size, **kw)
+    def schedule(self, token_ids: list[int], worker_ids: list[int], *, salt_fold: int = 0) -> tuple[int, int]:
+        """Returns (worker_id, overlap_blocks) for the given prompt.
+
+        ``salt_fold``: multimodal content hash (tokens.mm_salt_fold) so the
+        lookup hashes match what the serving engine published."""
+        from dynamo_tpu.tokens import DEFAULT_SALT
+
+        base = self.salt if self.salt is not None else DEFAULT_SALT
+        hashes = compute_block_hashes(token_ids, self.block_size, salt=base ^ salt_fold)
         overlaps = self.indexer.find_matches(hashes)
         metrics = self.aggregator.snapshot() if self.aggregator else {}
         num_blocks = max(len(hashes), 1)
@@ -69,7 +74,11 @@ class KvPushRouter(AsyncEngine[Any, Any]):
         worker_ids = self.client.instance_ids()
         if not worker_ids:
             worker_ids = [i.instance_id for i in await self.client.wait_for_instances(count=1)]
-        wid, overlap = self.router.schedule(token_ids, worker_ids)
+        from dynamo_tpu.tokens import mm_salt_fold
+
+        wid, overlap = self.router.schedule(
+            token_ids, worker_ids, salt_fold=mm_salt_fold(body.get("mm_inputs"))
+        )
         logger.debug("kv-routed %d tokens -> worker %x (overlap %d blocks)", len(token_ids), wid, overlap)
         async for item in self.client.generate(body, context, instance_id=wid):
             yield item

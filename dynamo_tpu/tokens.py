@@ -26,6 +26,20 @@ import xxhash
 # framework's cache-identity scheme (mirrors the reference's hash salt).
 DEFAULT_SALT: int = 0xD1A2_0001
 
+
+def mm_salt_fold(mm_inputs) -> int:
+    """Content hash folded into block-hash salts for multimodal requests.
+
+    Identical prompts with different images must have different prefix-cache
+    identities; the engine AND the KV router must fold the same value or the
+    router's overlap lookups never match the worker's published hashes."""
+    if not mm_inputs or not isinstance(mm_inputs, dict):
+        return 0
+    import hashlib
+
+    payload = str(mm_inputs.get("embeds_b64") or "").encode()
+    return int.from_bytes(hashlib.blake2b(payload, digest_size=8).digest(), "big")
+
 _U64 = np.dtype("<u8")
 _I32 = np.dtype("<i4")
 

@@ -1,0 +1,264 @@
+"""Multimodal tests: vision tower, embedding injection, encode->prefill e2e.
+
+Parity: reference `examples/multimodal/` (encode worker -> embeddings ->
+prefill handoff), rebuilt first-party (SURVEY.md §2 row 51).
+"""
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.core import EngineConfig, EngineCore
+from dynamo_tpu.engine.runner import ModelRunner
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import PRESETS
+from dynamo_tpu.protocols.common import PreprocessedRequest, SamplingOptions, StopConditions
+
+CFG = PRESETS["test-tiny-vl"]
+IMG = CFG.image_token_id
+
+
+def _run(core, token_ids, mm_inputs=None, max_tokens=6):
+    req = PreprocessedRequest(
+        token_ids=token_ids,
+        sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+        mm_inputs=mm_inputs,
+    )
+    seq = core.add_request(req)
+    while not seq.is_finished:
+        core.step()
+    return seq
+
+
+def _mm_payload(embeds: np.ndarray) -> dict:
+    import base64
+
+    return {
+        "embeds_b64": base64.b64encode(np.ascontiguousarray(embeds, np.float32).tobytes()).decode(),
+        "shape": list(embeds.shape),
+        "dtype": "float32",
+    }
+
+
+def _core(params, **kw):
+    runner = ModelRunner(CFG, params, num_pages=64, page_size=4, max_batch_size=4)
+    return EngineCore(runner, EngineConfig(num_pages=64, page_size=4, max_batch_size=4,
+                                           enable_prefix_caching=False, **kw))
+
+
+def test_injection_equals_token_embedding():
+    """Placeholders fed the embedding rows of token 7 must generate exactly
+    what the prompt with literal token 7s generates (the substitution is the
+    whole mechanism; greedy decode makes it observable token-exactly)."""
+    params = llama.init_params(CFG, 0)
+    embed_row_7 = np.asarray(params["embed"][7], np.float32)
+
+    prompt_img = [5, 6, IMG, IMG, 9, 10, 11, 12]
+    prompt_tok = [5, 6, 7, 7, 9, 10, 11, 12]
+    mm = np.stack([embed_row_7, embed_row_7])  # one row per placeholder
+
+    seq_a = _run(_core(params), prompt_img, mm_inputs=_mm_payload(mm))
+    seq_b = _run(_core(params), prompt_tok)
+    assert seq_a.finish_reason is not None and seq_a.finish_reason.value == "length"
+    assert seq_a.tokens[len(prompt_img):] == seq_b.tokens[len(prompt_tok):]
+
+
+def test_injection_embeddings_matter():
+    """Different image embeddings -> different greedy output."""
+    rng = np.random.default_rng(3)
+    params = llama.init_params(CFG, 0)
+    prompt = [5, 6, IMG, IMG, 9, 10, 11, 12]
+    mm = rng.standard_normal((2, CFG.hidden_size)).astype(np.float32)
+    mm2 = rng.standard_normal((2, CFG.hidden_size)).astype(np.float32) * 3
+    a = _run(_core(params), prompt, mm_inputs=_mm_payload(mm))
+    b = _run(_core(params), prompt, mm_inputs=_mm_payload(mm2))
+    assert a.tokens[len(prompt):] != b.tokens[len(prompt):]
+
+
+def test_forward_offset_resumed_chunk_equals_whole():
+    """The mm slot offset: prefilling the tail of a prompt whose earlier
+    chunk (with 2 placeholders) is already cached must inject rows 2,3 —
+    logits must equal the single-pass whole-prompt prefill."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    params = llama.init_params(CFG, 0)
+    prompt = np.array([5, 6, IMG, IMG, 9, 10, 11, 12, 20, 21, 22, 23, 24, IMG, IMG, 25], np.int32)
+    mm = jnp.asarray(rng.standard_normal((1, 4, CFG.hidden_size)).astype(np.float32))
+    ps, pages = 4, [1, 2, 3, 4]
+    tables = np.asarray([pages], np.int32)
+
+    def run(tokens, positions, k, v, offset, counts):
+        slots = np.asarray([[pages[p // ps] * ps + p % ps for p in positions[0]]], np.int32)
+        return llama.forward(
+            params, CFG, jnp.asarray(tokens), jnp.asarray(positions), k, v,
+            jnp.asarray(tables), jnp.asarray(slots),
+            jnp.asarray([tokens.shape[1] - 1], np.int32),
+            mm_embeds=mm, mm_slot_offset=jnp.asarray([offset], np.int32),
+            mm_counts=jnp.asarray([counts], np.int32),
+        )
+
+    k0, v0 = llama.init_kv_cache(CFG, 8, ps)
+    logits_whole, _, _ = run(prompt[None, :], np.arange(16, dtype=np.int32)[None, :], k0, v0, 0, 4)
+
+    k1, v1 = llama.init_kv_cache(CFG, 8, ps)
+    _, k1, v1 = run(prompt[None, :8], np.arange(8, dtype=np.int32)[None, :], k1, v1, 0, 4)
+    # Resume at position 8 with 2 placeholders already cached: offset=2.
+    logits_tail, _, _ = run(prompt[None, 8:], np.arange(8, 16, dtype=np.int32)[None, :], k1, v1, 2, 4)
+    np.testing.assert_allclose(np.asarray(logits_tail), np.asarray(logits_whole), rtol=2e-4, atol=2e-4)
+
+
+def test_text_row_with_placeholder_id_unaffected_by_mm_batchmate():
+    """A text prompt that *contains* the placeholder id, prefilled in the
+    same batch as a real multimodal request, must keep its normal token
+    embeddings (no zero-row substitution leaking across batch rows)."""
+    params = llama.init_params(CFG, 0)
+    text_prompt = [5, IMG, 6, 7]  # pre-tokenized prompt using the raw id
+
+    solo = _run(_core(params), text_prompt)
+
+    core = _core(params)
+    mm = np.random.default_rng(1).standard_normal((2, CFG.hidden_size)).astype(np.float32)
+    req_mm = PreprocessedRequest(
+        token_ids=[8, IMG, IMG, 9],
+        sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=6, ignore_eos=True),
+        mm_inputs=_mm_payload(mm),
+    )
+    req_text = PreprocessedRequest(
+        token_ids=list(text_prompt),
+        sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=6, ignore_eos=True),
+    )
+    seq_mm = core.add_request(req_mm)
+    seq_text = core.add_request(req_text)
+    while not (seq_mm.is_finished and seq_text.is_finished):
+        core.step()
+    assert seq_text.tokens[len(text_prompt):] == solo.tokens[len(text_prompt):]
+
+
+def test_malformed_mm_inputs_fail_only_that_request():
+    params = llama.init_params(CFG, 0)
+    core = _core(params)
+    bad = _run(core, [5, IMG, 6], mm_inputs={"embeds_b64": "AA=="}, max_tokens=2)  # no shape
+    assert bad.finish_reason is not None and bad.finish_reason.value == "error"
+    good = _run(core, [5, 6, 7, 8], max_tokens=2)  # engine still serves
+    assert good.finish_reason is not None and good.finish_reason.value == "length"
+
+
+def test_router_salt_fold_matches_engine():
+    """The KV router must look up multimodal requests with the same folded
+    salt the engine publishes, or image-affine routing never matches."""
+    from dynamo_tpu.tokens import DEFAULT_SALT, compute_block_hashes, mm_salt_fold
+
+    mm = np.ones((2, CFG.hidden_size), np.float32)
+    payload = _mm_payload(mm)
+    fold = mm_salt_fold(payload)
+    assert fold != 0
+    assert mm_salt_fold(None) == 0 and mm_salt_fold({}) == 0
+    toks = [5, IMG, IMG, 6, 7, 8, 9, 10]
+    engine_side = compute_block_hashes(toks, 4, salt=DEFAULT_SALT ^ fold)
+    router_side = compute_block_hashes(toks, 4, salt=DEFAULT_SALT ^ mm_salt_fold(payload))
+    assert engine_side == router_side
+    assert engine_side != compute_block_hashes(toks, 4, salt=DEFAULT_SALT)
+
+
+def test_mismatched_placeholder_count_rejected():
+    params = llama.init_params(CFG, 0)
+    core = _core(params)
+    mm = np.zeros((3, CFG.hidden_size), np.float32)  # 3 rows, 2 placeholders
+    seq = _run(core, [5, IMG, IMG, 9], mm_inputs=_mm_payload(mm), max_tokens=2)
+    assert seq.finish_reason is not None and seq.finish_reason.value == "error"
+
+
+def test_vision_tower_shapes_and_determinism():
+    import jax.numpy as jnp
+
+    from dynamo_tpu.models.vision import TEST_TINY_VISION, encode_image, init_vision_params
+
+    vp = init_vision_params(TEST_TINY_VISION, 0)
+    pixels = np.random.default_rng(0).uniform(-1, 1, (2, 32, 32, 3)).astype(np.float32)
+    out = encode_image(vp, TEST_TINY_VISION, jnp.asarray(pixels))
+    assert out.shape == (2, TEST_TINY_VISION.num_patches, TEST_TINY_VISION.out_dim)
+    out2 = encode_image(vp, TEST_TINY_VISION, jnp.asarray(pixels))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+    # Different images -> different embeddings.
+    assert not np.allclose(np.asarray(out)[0], np.asarray(out)[1])
+
+
+def test_image_preprocess_and_data_url():
+    import base64
+    import io
+
+    from PIL import Image
+
+    from dynamo_tpu.models.vision import TEST_TINY_VISION, decode_data_url, preprocess_image
+
+    img = Image.new("RGB", (64, 48), (255, 0, 0))
+    buf = io.BytesIO()
+    img.save(buf, format="PNG")
+    url = "data:image/png;base64," + base64.b64encode(buf.getvalue()).decode()
+    arr = preprocess_image(decode_data_url(url), TEST_TINY_VISION)
+    assert arr.shape == (32, 32, 3)
+    assert arr.max() <= 1.0 and arr.min() >= -1.0
+    assert arr[0, 0, 0] > 0.9  # red channel saturated
+
+    with pytest.raises(ValueError):
+        decode_data_url("https://example.com/cat.png")
+
+
+async def test_multimodal_chat_e2e():
+    """Full loop over HTTP: chat with a data-URL image -> encode worker ->
+    embeddings -> placeholder-spliced prompt -> injected prefill -> tokens.
+    Different images must produce different outputs (the pixels matter)."""
+    import base64
+    import io
+
+    import aiohttp
+    from PIL import Image
+
+    from dynamo_tpu.launch import run_local
+
+    def data_url(color):
+        img = Image.new("RGB", (32, 32), color)
+        buf = io.BytesIO()
+        img.save(buf, format="PNG")
+        return "data:image/png;base64," + base64.b64encode(buf.getvalue()).decode()
+
+    handles = await run_local("test-tiny-vl", port=0, num_pages=128, max_batch_size=4)
+    base = f"http://127.0.0.1:{handles['port']}"
+    try:
+        async def ask(color):
+            body = {
+                "model": "test-tiny-vl",
+                "messages": [{"role": "user", "content": [
+                    {"type": "text", "text": "what is this? "},
+                    {"type": "image_url", "image_url": {"url": data_url(color)}},
+                ]}],
+                "max_tokens": 6, "temperature": 0,
+            }
+            async with aiohttp.ClientSession() as s:
+                async with s.post(base + "/v1/chat/completions", json=body) as r:
+                    assert r.status == 200, await r.text()
+                    out = await r.json()
+            return out
+
+        red = await ask((255, 0, 0))
+        red2 = await ask((255, 0, 0))
+        blue = await ask((0, 0, 255))
+        # Prompt accounting includes the image placeholder tokens.
+        from dynamo_tpu.models.vision import TEST_TINY_VISION
+        assert red["usage"]["prompt_tokens"] > TEST_TINY_VISION.num_patches
+        assert red["choices"][0]["message"]["content"] == red2["choices"][0]["message"]["content"]
+        assert red["choices"][0]["message"]["content"] != blue["choices"][0]["message"]["content"]
+
+        # The encode worker actually served the images.
+        from dynamo_tpu.encode import EncodeService
+        enc = next(s for s in handles["services"] if isinstance(s, EncodeService))
+        assert enc.images_encoded == 3
+    finally:
+        await handles["http"].stop()
+        await handles["watcher"].close()
+        for svc in handles["services"]:
+            await svc.close()
+        await handles["runtime"].close()
