@@ -361,6 +361,14 @@ class EngineCore:
 
     def _run_decode(self) -> list[tuple[Sequence, EngineOutput]]:
         k = max(1, self.config.decode_steps)
+        if self.running:
+            # Don't burst past the farthest finish line: the overshoot is
+            # discarded compute (at decode_steps=64 and 10 tokens remaining,
+            # 84% of the burst). Pow2 keeps k on the compiled bucket lattice.
+            from dynamo_tpu.engine.runner import next_pow2
+
+            rem = max(s.remaining_tokens(self.config.max_seq_len) for s in self.running)
+            k = max(1, min(k, next_pow2(rem)))
         # Penalized sampling needs fresh host-side token history per burst;
         # a chained (pipelined) burst would dispatch with history missing the
         # burst still in flight, undercounting repetitions. Those batches
@@ -369,16 +377,19 @@ class EngineCore:
             s.request.sampling.frequency_penalty or s.request.sampling.presence_penalty
             for s in self.running
         )
-        if (
+        use_pipelined = (
             k > 1
             and not penalized
             and hasattr(self.runner, "multi_step_async")
             and getattr(self.runner, "mesh", None) is None
-        ):
-            return self._run_decode_pipelined(k)
-        if penalized and self._inflight is not None:
-            # A penalized request just joined mid-pipeline: drain first.
+        )
+        if not use_pipelined and self._inflight is not None:
+            # Entering the sync path (penalties joined, or k collapsed near
+            # the finish line) with a burst still in flight: commit it first
+            # or its positions would be recomputed over live device writes.
             return self._drain_inflight()
+        if use_pipelined:
+            return self._run_decode_pipelined(k)
         return self._run_decode_sync(k)
 
     def _ensure_burst_pages(self, horizon: int, *, fail_sole: bool = True) -> Sequence | None:
@@ -391,7 +402,13 @@ class EngineCore:
         i = 0
         while i < len(self.running):
             seq = self.running[i]
-            need = seq.pages_needed(self.config.page_size, horizon)
+            # A sequence never decodes past max_tokens (or the context
+            # window): demanding pages beyond that caused end-of-run
+            # preemption storms when the burst horizon overshot the finish.
+            # (Safe because overshoot KV writes land in the null page — see
+            # the pos_limit mask in the runner's fused burst.)
+            remaining = seq.remaining_tokens(self.config.max_seq_len)
+            need = seq.pages_needed(self.config.page_size, min(horizon, remaining))
             if need:
                 try:
                     seq.pages.extend(self.allocator.allocate(need))
@@ -507,6 +524,12 @@ class EngineCore:
         same = len(batch) == len(self.running) and all(
             a is b for a, b in zip(batch, self.running)
         )
+        if same:
+            # Someone finishes inside the burst already in flight: the
+            # composition is about to change, so a chained dispatch would be
+            # pure waste — and its pages (capped at each sequence's remaining
+            # tokens) cannot cover positions past the finish line.
+            same = all(s.remaining_tokens(self.config.max_seq_len) > kprev for s in batch)
         dispatched = False
         if same:
             # Don't fail the sole sequence yet: the burst in flight may hold
@@ -560,6 +583,7 @@ class EngineCore:
         steps = np.zeros(b, np.int32)
         freq = np.zeros(b, np.float32)
         pres = np.zeros(b, np.float32)
+        limits = np.zeros(b, np.int32)
         for i, s in enumerate(batch):
             sp = s.request.sampling
             temp[i] = sp.temperature
@@ -569,6 +593,7 @@ class EngineCore:
             steps[i] = s.num_generated
             freq[i] = sp.frequency_penalty
             pres[i] = sp.presence_penalty
+            limits[i] = s.position_limit(self.config.max_seq_len)
         # Generated-token history feeds the sampler's repetition penalties.
         # Only shipped when some request actually set a penalty: H collapses
         # to 1 otherwise, keeping the packed step input small. Width covers
@@ -582,7 +607,7 @@ class EngineCore:
         else:
             history = np.full((b, 1), -1, np.int32)
         return StepBatch(tokens, positions, block_tables, slots, last, temp, top_k, top_p,
-                         seeds, steps, freq, pres, history)
+                         seeds, steps, freq, pres, limits, history)
 
     def _commit_filled_pages(self, seq: Sequence) -> None:
         """Publish newly-filled pages to the prefix cache (emits stored events)

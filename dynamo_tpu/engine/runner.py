@@ -70,6 +70,7 @@ def _pack(padded: "StepBatch") -> np.ndarray:
             padded.sample_steps,
             padded.freq_pen.view(np.int32),
             padded.pres_pen.view(np.int32),
+            padded.pos_limit,
             padded.history.ravel(),
         ]
     )
@@ -77,7 +78,7 @@ def _pack(padded: "StepBatch") -> np.ndarray:
 
 def _unpack(packed: jnp.ndarray, b: int, t: int, n: int, h: int):
     """In-graph inverse of :func:`_pack` (static offsets, free slices)."""
-    sizes = [b * t, b * t, b * n, b * t, b, b, b, b, b, b, b, b, b * h]
+    sizes = [b * t, b * t, b * n, b * t, b, b, b, b, b, b, b, b, b, b * h]
     offs = np.concatenate([[0], np.cumsum(sizes)])
     part = [packed[offs[i] : offs[i + 1]] for i in range(len(sizes))]
     return (
@@ -93,7 +94,8 @@ def _unpack(packed: jnp.ndarray, b: int, t: int, n: int, h: int):
         part[9],
         jax.lax.bitcast_convert_type(part[10], jnp.float32),
         jax.lax.bitcast_convert_type(part[11], jnp.float32),
-        part[12].reshape(b, h),
+        part[12],
+        part[13].reshape(b, h),
     )
 
 
@@ -113,6 +115,7 @@ class StepBatch:
     sample_steps: np.ndarray  # i32[B] — rng fold counter (monotonic per request)
     freq_pen: np.ndarray  # f32[B] — OpenAI frequency_penalty
     pres_pen: np.ndarray  # f32[B] — OpenAI presence_penalty
+    pos_limit: np.ndarray  # i32[B] first absolute position KV must never be written at
     history: np.ndarray  # i32[B, H] generated tokens so far, pad -1 (H=1 when no penalties)
     # Multimodal prefill only (None on text batches / decode):
     mm_embeds: np.ndarray | None = None  # f32[B, M, D] image embeddings
@@ -171,8 +174,9 @@ class ModelRunner:
         @functools.partial(jax.jit, static_argnames=("impl",), donate_argnums=(1, 2))
         def _step(params, k_cache, v_cache, tokens, positions, block_tables, slot_mapping,
                   last_idx, temperature, top_k, top_p, seeds, sample_steps,
-                  freq_pen, pres_pen, history,
+                  freq_pen, pres_pen, pos_limit, history,
                   mm_embeds=None, mm_slot_offset=None, mm_counts=None, *, impl):
+            del pos_limit  # single/prefill steps never write past the finish line
             # mm_* None on text batches; jit specializes once per presence
             # pattern, so the text program carries no multimodal cost.
             mm_kw = {}
@@ -202,7 +206,7 @@ class ModelRunner:
         @functools.partial(jax.jit, static_argnames=("num_steps",), donate_argnums=(1, 2))
         def _multi_step(params, k_cache, v_cache, tokens, positions, block_tables,
                         temperature, top_k, top_p, seeds, sample_steps,
-                        freq_pen, pres_pen, history, *, num_steps):
+                        freq_pen, pres_pen, pos_limit, history, *, num_steps):
             """``num_steps`` fused decode iterations in one dispatch.
 
             The sampled token of step i is step i+1's input; slot mapping is
@@ -220,6 +224,11 @@ class ModelRunner:
                 tok, pos, kc, vc, cnt, hist = carry
                 page = jnp.take_along_axis(block_tables, (pos // ps)[:, None], axis=1)[:, 0]
                 slot = page * ps + pos % ps
+                # Burst overshoot (host discards those tokens) must never
+                # touch live pages: past each row's finish line the write
+                # lands in the reserved null page 0. This is what makes
+                # page allocation capped at remaining-tokens safe.
+                slot = jnp.where(pos < pos_limit, slot, 0)
                 logits, kc, vc = self._forward(
                     params, self.cfg, tok[:, None], pos[:, None], kc, vc,
                     block_tables, slot[:, None], zeros, attn_impl=self.attn_impl,
@@ -245,11 +254,11 @@ class ModelRunner:
         def _multi_step_packed(params, k_cache, v_cache, packed, *, b, t, n, h, num_steps):
             (tokens, positions, block_tables, _slot, _last,
              temperature, top_k, top_p, seeds, sample_steps,
-             freq_pen, pres_pen, history) = _unpack(packed, b, t, n, h)
+             freq_pen, pres_pen, pos_limit, history) = _unpack(packed, b, t, n, h)
             return _multi_step(
                 params, k_cache, v_cache, tokens[:, 0], positions[:, 0], block_tables,
                 temperature, top_k, top_p, seeds, sample_steps,
-                freq_pen, pres_pen, history, num_steps=num_steps,
+                freq_pen, pres_pen, pos_limit, history, num_steps=num_steps,
             )
 
         self._multi_step_packed_fn = _multi_step_packed
@@ -261,11 +270,11 @@ class ModelRunner:
             never blocks on them — see multi_step_async)."""
             (_tok, positions, block_tables, _slot, _last,
              temperature, top_k, top_p, seeds, sample_steps,
-             freq_pen, pres_pen, history) = _unpack(packed, b, t, n, h)
+             freq_pen, pres_pen, pos_limit, history) = _unpack(packed, b, t, n, h)
             return _multi_step(
                 params, k_cache, v_cache, chain_tokens, positions[:, 0], block_tables,
                 temperature, top_k, top_p, seeds, sample_steps,
-                freq_pen, pres_pen, history, num_steps=num_steps,
+                freq_pen, pres_pen, pos_limit, history, num_steps=num_steps,
             )
 
         self._multi_step_chained_fn = _multi_step_chained
@@ -411,6 +420,7 @@ class ModelRunner:
             sample_steps=pad1(batch.sample_steps, bp),
             freq_pen=pad1(batch.freq_pen, bp),
             pres_pen=pad1(batch.pres_pen, bp),
+            pos_limit=pad1(batch.pos_limit, bp),  # pad rows: limit 0 -> null page
             history=pad2(batch.history, bp, hp, fill=-1),
             mm_embeds=mm,
             mm_slot_offset=None if batch.mm_slot_offset is None else pad1(batch.mm_slot_offset, bp, fill=-1),
@@ -458,7 +468,8 @@ class ModelRunner:
                 put(padded.last_token_index), put(padded.temperature),
                 put(padded.top_k), put(padded.top_p),
                 put(padded.seeds), put(padded.sample_steps),
-                put(padded.freq_pen), put(padded.pres_pen), put(padded.history),
+                put(padded.freq_pen), put(padded.pres_pen),
+                put(padded.pos_limit), put(padded.history),
                 put(padded.mm_embeds), put(padded.mm_slot_offset), put(padded.mm_counts),
                 impl=self._select_impl(padded) if self.mesh is not None else self.attn_impl,
             )
@@ -476,7 +487,8 @@ class ModelRunner:
                 put(padded.last_token_index), put(padded.temperature),
                 put(padded.top_k), put(padded.top_p),
                 put(padded.seeds), put(padded.sample_steps),
-                put(padded.freq_pen), put(padded.pres_pen), put(padded.history),
+                put(padded.freq_pen), put(padded.pres_pen),
+                put(padded.pos_limit), put(padded.history),
                 impl=self._select_impl(padded),
             )
         else:
@@ -509,7 +521,8 @@ class ModelRunner:
                 put(padded.block_tables), put(padded.temperature),
                 put(padded.top_k), put(padded.top_p),
                 put(padded.seeds), put(padded.sample_steps),
-                put(padded.freq_pen), put(padded.pres_pen), put(padded.history),
+                put(padded.freq_pen), put(padded.pres_pen),
+                put(padded.pos_limit), put(padded.history),
                 num_steps=num_steps,
             )
         else:

@@ -75,6 +75,22 @@ class Sequence:
         self.tokens.append(int(token))
         self.block_seq.append(int(token))
 
+    def remaining_tokens(self, max_seq_len: int) -> int:
+        """Tokens this sequence may still legitimately generate (the finish
+        line check_stop enforces): bounded by max_tokens and the context
+        window, never below 1 for a live sequence."""
+        return max(
+            1,
+            min(
+                self.request.stop.max_tokens - self.num_generated,
+                max_seq_len - len(self.tokens),
+            ),
+        )
+
+    def position_limit(self, max_seq_len: int) -> int:
+        """First absolute position this sequence must never write KV at."""
+        return min(self.num_prompt + self.request.stop.max_tokens, max_seq_len)
+
     def check_stop(self, eos_token_ids: set[int], max_seq_len: int) -> FinishReason | None:
         """Evaluate token-level stop conditions after a newly appended token."""
         stop = self.request.stop

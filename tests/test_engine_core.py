@@ -296,3 +296,26 @@ def test_pipelined_decode_cancellation_inflight():
     # The surviving sequence still completes correctly.
     assert outputs[1] == greedy_reference([4, 5, 6], 40)
     assert core._inflight is None
+
+
+def test_burst_overshoot_cannot_corrupt_live_pages():
+    """Heterogeneous finish lines inside one fused burst: a sequence whose
+    max_tokens ends mid-burst must not let the burst's overshoot KV writes
+    land in live pages (they are masked to the null page). Everyone stays
+    token-exact vs the step-by-step greedy reference, including a follow-up
+    request that reuses the short sequence's cached prefix."""
+    core = make_core_multi(decode_steps=8)
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7], [11, 12, 13, 14]]
+    budgets = [3, 17, 9]  # finish lines at different points within/across bursts
+    for p, mt in zip(prompts, budgets):
+        core.add_request(greedy_request(p, max_tokens=mt))
+    outputs = run_to_completion(core)
+    for i, (p, mt) in enumerate(zip(prompts, budgets)):
+        assert outputs[i] == greedy_reference(p, mt), f"seq {i}"
+
+    # The short sequence's pages are prefix cache now; a request extending
+    # its prompt must see uncorrupted KV (token-exact again).
+    ext = prompts[0] + outputs[0][:2]
+    core.add_request(greedy_request(ext, max_tokens=6))
+    out2 = run_to_completion(core)
+    assert out2[3] == greedy_reference(ext, 6)

@@ -126,6 +126,7 @@ def test_select_impl_ring_conditions():
             sample_steps=np.zeros(b, np.int32),
             freq_pen=np.zeros(b, np.float32),
             pres_pen=np.zeros(b, np.float32),
+            pos_limit=np.full(b, 10**9, np.int32),
             history=np.full((b, 1), -1, np.int32),
         )
 
