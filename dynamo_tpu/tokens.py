@@ -22,6 +22,11 @@ from typing import Iterable, Sequence
 import numpy as np
 import xxhash
 
+try:  # native chained-hash kernel (build: `make -C native`); pure-Python fallback below
+    from dynamo_tpu import _dyncore
+except ImportError:  # pragma: no cover - image without the built extension
+    _dyncore = None
+
 # Salt mixed into every block hash so sequence hashes are namespaced to this
 # framework's cache-identity scheme (mirrors the reference's hash salt).
 DEFAULT_SALT: int = 0xD1A2_0001
@@ -45,6 +50,8 @@ _I32 = np.dtype("<i4")
 
 
 def _hash_bytes(data: bytes, seed: int) -> int:
+    if _dyncore is not None:
+        return _dyncore.hash_bytes(data, seed)
     return xxhash.xxh3_64_intdigest(data, seed=seed)
 
 
@@ -78,6 +85,13 @@ def compute_block_hashes(
         raise ValueError(f"block_size must be positive, got {block_size}")
     arr = np.asarray(tokens, dtype=np.uint32)
     n_full = len(arr) // block_size
+    if _dyncore is not None:
+        # Native chained-hash loop (native/dyncore.cpp): one C call for the
+        # whole prompt instead of per-block Python bytes assembly — this
+        # runs for every request on both the router and the engine. The C
+        # side drops the partial tail itself; pass the buffer, not a copy
+        # (u4 and <i4 bytes are identical on little-endian hosts).
+        return _dyncore.block_hashes(memoryview(np.ascontiguousarray(arr)), block_size, salt)
     hashes: list[int] = []
     parent: int | None = None
     for i in range(n_full):

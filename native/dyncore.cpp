@@ -1,0 +1,126 @@
+// Native hot-path kernels for the dynamo-tpu runtime: chained block hashing.
+//
+// Role parity: the reference keeps its per-request hash/identity hot paths in
+// native code (lib/tokens is Rust; block_copy.cu is CUDA). Here the chained
+// xxh3 sequence-hash loop — run for every block of every request on both the
+// router and the engine — is one C call over the whole token array instead
+// of a Python loop with per-block bytes assembly.
+//
+// Hash contract (must match dynamo_tpu/tokens.py exactly):
+//   root block:  xxh3_64(tokens_le4, seed=salt)
+//   child block: xxh3_64(parent_hash_le8 || tokens_le4, seed=salt)
+//
+// XXH3 comes from the xxhash single-header library already shipped in this
+// image (vendored by pyarrow); XXH_INLINE_ALL keeps us dependency-free.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#define XXH_INLINE_ALL
+#include "xxhash.h"
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+// One block's payload buffer: 8-byte parent + tokens. Reused across blocks.
+PyObject *block_hashes(PyObject *, PyObject *args, PyObject *kwargs) {
+    static const char *kwlist[] = {"tokens", "block_size", "salt", "parent", nullptr};
+    Py_buffer buf;
+    Py_ssize_t block_size;
+    unsigned long long salt;
+    PyObject *parent_obj = Py_None;
+    if (!PyArg_ParseTupleAndKeywords(
+            args, kwargs, "y*nK|O", const_cast<char **>(kwlist),
+            &buf, &block_size, &salt, &parent_obj)) {
+        return nullptr;
+    }
+    if (block_size <= 0) {
+        PyBuffer_Release(&buf);
+        PyErr_SetString(PyExc_ValueError, "block_size must be positive");
+        return nullptr;
+    }
+    if (buf.len % 4 != 0) {
+        PyBuffer_Release(&buf);
+        PyErr_SetString(PyExc_ValueError, "tokens buffer must be little-endian int32");
+        return nullptr;
+    }
+    const Py_ssize_t n_tokens = buf.len / 4;
+    const Py_ssize_t n_blocks = n_tokens / block_size;
+    const Py_ssize_t block_bytes = block_size * 4;
+    const uint8_t *tok = static_cast<const uint8_t *>(buf.buf);
+
+    bool has_parent = parent_obj != Py_None;
+    uint64_t parent = 0;
+    if (has_parent) {
+        parent = PyLong_AsUnsignedLongLong(parent_obj);
+        if (PyErr_Occurred()) {
+            PyBuffer_Release(&buf);
+            return nullptr;
+        }
+    }
+
+    std::vector<uint64_t> out(static_cast<size_t>(n_blocks));
+    {
+        // Pure C loop: release the GIL for long prompts.
+        std::vector<uint8_t> payload(8 + static_cast<size_t>(block_bytes));
+        Py_BEGIN_ALLOW_THREADS
+        for (Py_ssize_t i = 0; i < n_blocks; i++) {
+            const uint8_t *block = tok + i * block_bytes;
+            uint64_t h;
+            if (!has_parent && i == 0) {
+                h = XXH3_64bits_withSeed(block, block_bytes, salt);
+            } else {
+                std::memcpy(payload.data(), &parent, 8);  // little-endian hosts
+                std::memcpy(payload.data() + 8, block, block_bytes);
+                h = XXH3_64bits_withSeed(payload.data(), payload.size(), salt);
+            }
+            out[static_cast<size_t>(i)] = h;
+            parent = h;
+            has_parent = true;
+        }
+        Py_END_ALLOW_THREADS
+    }
+    PyBuffer_Release(&buf);
+
+    PyObject *list = PyList_New(n_blocks);
+    if (!list) return nullptr;
+    for (Py_ssize_t i = 0; i < n_blocks; i++) {
+        PyObject *v = PyLong_FromUnsignedLongLong(out[static_cast<size_t>(i)]);
+        if (!v) {
+            Py_DECREF(list);
+            return nullptr;
+        }
+        PyList_SET_ITEM(list, i, v);
+    }
+    return list;
+}
+
+PyObject *hash_bytes(PyObject *, PyObject *args) {
+    Py_buffer buf;
+    unsigned long long seed;
+    if (!PyArg_ParseTuple(args, "y*K", &buf, &seed)) return nullptr;
+    uint64_t h = XXH3_64bits_withSeed(buf.buf, static_cast<size_t>(buf.len), seed);
+    PyBuffer_Release(&buf);
+    return PyLong_FromUnsignedLongLong(h);
+}
+
+PyMethodDef methods[] = {
+    {"block_hashes", reinterpret_cast<PyCFunction>(block_hashes),
+     METH_VARARGS | METH_KEYWORDS,
+     "Chained xxh3 sequence hashes for every complete block of a le-i32 token buffer."},
+    {"hash_bytes", hash_bytes, METH_VARARGS, "xxh3_64 of a buffer with a seed."},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_dyncore",
+    "Native runtime kernels (chained block hashing).", -1, methods,
+    nullptr, nullptr, nullptr, nullptr,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__dyncore(void) { return PyModule_Create(&moduledef); }
