@@ -79,8 +79,21 @@ class WorkerSpec:
         import pathlib
 
         p = pathlib.Path(model_dir)
-        mc = ModelConfig.from_hf(p / "config.json", name=name or p.name)
-        card = ModelDeploymentCard.from_model_dir(name or p.name, p)
+        if p.is_file() and p.suffix == ".gguf":
+            from dynamo_tpu.models.gguf import GGUFReader, config_from_gguf
+
+            # One reader serves both config and card: parsing the header
+            # eagerly decodes the full embedded vocab, which is 100k+ strings
+            # for a real model — don't do it twice.
+            reader = GGUFReader(p)
+            try:
+                mc = config_from_gguf(reader, name=name or p.stem)
+                card = ModelDeploymentCard.from_gguf(name or p.stem, p, reader=reader)
+            finally:
+                reader.close()
+        else:
+            mc = ModelConfig.from_hf(p / "config.json", name=name or p.name)
+            card = ModelDeploymentCard.from_model_dir(name or p.name, p)
         return cls(
             model_config=mc, card=card,
             engine_config=cls._engine_cfg(card, engine_kw), model_dir=str(p),
@@ -123,9 +136,11 @@ def make_worker_spec(model: str, **engine_kw: Any) -> WorkerSpec:
 
     if model in PRESETS:
         return WorkerSpec.from_preset(model, **engine_kw)
-    if os.path.isdir(model):
+    if os.path.isdir(model) or (model.endswith(".gguf") and os.path.isfile(model)):
         return WorkerSpec.from_model_dir(model, **engine_kw)
-    raise ValueError(f"unknown model {model!r}: not a preset ({', '.join(PRESETS)}) or a directory")
+    raise ValueError(
+        f"unknown model {model!r}: not a preset ({', '.join(PRESETS)}), a checkpoint directory, or a .gguf file"
+    )
 
 
 async def build_engine_service(spec: WorkerSpec, *, on_kv_event=None) -> JaxEngineService:
@@ -154,6 +169,10 @@ async def build_engine_service(spec: WorkerSpec, *, on_kv_event=None) -> JaxEngi
             mesh = make_mesh(plan)
         if spec.params is not None:
             params = spec.params
+        elif spec.model_dir is not None and spec.model_dir.endswith(".gguf"):
+            from dynamo_tpu.models.gguf import load_gguf_params
+
+            params = load_gguf_params(spec.model_dir, spec.model_config, mesh=mesh)
         elif spec.model_dir is not None:
             from dynamo_tpu.models.loader import load_params
 

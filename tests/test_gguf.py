@@ -1,0 +1,281 @@
+"""GGUF support: binary round-trip, dequant correctness, config/tokenizer
+extraction, params loading, and WorkerSpec resolution of a .gguf path.
+
+The writer emits spec-conformant GGUF v3 (magic, typed metadata, reversed
+ggml dims, aligned data section), so reading back through the parser proves
+both directions against the format llama.cpp tools produce.
+"""
+
+import dataclasses
+import struct
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import PRESETS
+from dynamo_tpu.models.gguf import (
+    GGML_F16,
+    GGML_Q4_0,
+    GGML_Q8_0,
+    GGUFReader,
+    config_from_gguf,
+    load_gguf_params,
+    save_params_gguf,
+    tokenizer_from_gguf,
+    write_gguf,
+)
+
+
+def test_metadata_roundtrip(tmp_path):
+    path = tmp_path / "m.gguf"
+    md = {
+        "general.architecture": "llama",
+        "llama.block_count": 2,
+        "llama.rope.freq_base": 10000.0,
+        "flag": True,
+        "tokenizer.ggml.tokens": ["a", "b", "c"],
+        "tokenizer.ggml.scores": [0.0, -1.0, -2.0],
+        "ids": [3, 1, 2],
+    }
+    write_gguf(path, md, {"t": np.arange(64, dtype=np.float32).reshape(8, 8)})
+    r = GGUFReader(path)
+    assert r.version == 3
+    assert r.metadata["general.architecture"] == "llama"
+    assert r.metadata["llama.block_count"] == 2
+    assert r.metadata["flag"] is True
+    assert r.metadata["tokenizer.ggml.tokens"] == ["a", "b", "c"]
+    assert r.metadata["ids"] == [3, 1, 2]
+    np.testing.assert_allclose(r.metadata["tokenizer.ggml.scores"], [0.0, -1.0, -2.0])
+    r.close()
+
+
+def test_tensor_dtypes_roundtrip(tmp_path):
+    import ml_dtypes
+
+    path = tmp_path / "t.gguf"
+    rng = np.random.default_rng(0)
+    f32 = rng.standard_normal((4, 32)).astype(np.float32)
+    f16 = rng.standard_normal((64,)).astype(np.float16)
+    bf16 = rng.standard_normal((2, 3, 32)).astype(ml_dtypes.bfloat16)
+    write_gguf(path, {"general.architecture": "llama"}, {"f32": f32, "f16": f16, "bf16": bf16})
+    r = GGUFReader(path)
+    np.testing.assert_array_equal(r.read("f32"), f32)
+    np.testing.assert_array_equal(r.read("f16"), f16)
+    np.testing.assert_array_equal(np.asarray(r.read("bf16"), np.float32), np.asarray(bf16, np.float32))
+    # shapes come back in numpy orientation despite reversed on-disk dims
+    assert r.tensors["bf16"].shape == (2, 3, 32)
+    r.close()
+
+
+def test_q8_0_quant_roundtrip(tmp_path):
+    path = tmp_path / "q.gguf"
+    rng = np.random.default_rng(1)
+    w = rng.standard_normal((16, 64)).astype(np.float32)
+    write_gguf(path, {"general.architecture": "llama"}, {"w": w}, quant=GGML_Q8_0)
+    r = GGUFReader(path)
+    got = r.read("w")
+    # int8 block quant: max error bounded by half a quant step per block
+    err = np.abs(got - w)
+    step = np.abs(w).reshape(-1, 32).max(axis=1) / 127.0
+    assert (err.reshape(-1, 32) <= step[:, None] * 0.51 + 1e-6).all()
+    r.close()
+
+
+def test_q4_0_dequant_against_formula(tmp_path):
+    # Hand-build one Q4_0 block: d=0.5, qs nibbles 0..15 twice
+    d = np.float16(0.5)
+    qs = bytes((i | (i << 4)) for i in range(16))  # low nibble i (elem i), high nibble i (elem i+16)
+    raw = struct.pack("<e", d) + qs
+    from dynamo_tpu.models.gguf import _dequant
+
+    got = _dequant(raw, GGML_Q4_0, (32,))
+    expect = np.concatenate([np.arange(16), np.arange(16)]).astype(np.float32)
+    expect = (expect - 8.0) * 0.5
+    np.testing.assert_allclose(got, expect)
+
+
+def test_unblockable_quant_falls_back(tmp_path):
+    path = tmp_path / "fb.gguf"
+    v = np.arange(7, dtype=np.float32)  # 7 % 32 != 0 -> cannot block-quantize
+    write_gguf(path, {"general.architecture": "llama"}, {"v": v}, quant=GGML_Q8_0)
+    r = GGUFReader(path)
+    assert r.tensors["v"].ggml_type == GGML_F16
+    np.testing.assert_allclose(r.read("v"), v)
+    r.close()
+
+
+def _tok_metadata(kind="gpt2"):
+    if kind == "gpt2":
+        # Byte-level BPE over a tiny vocab: enough to encode "hello hello"
+        vocab = ["h", "e", "l", "o", "Ġ", "he", "ll", "hell", "hello", "Ġhello"]
+        merges = ["h e", "l l", "he ll", "hell o", "Ġ hello"]
+        return {
+            "tokenizer.ggml.model": "gpt2",
+            "tokenizer.ggml.tokens": vocab,
+            "tokenizer.ggml.merges": merges,
+            "tokenizer.ggml.bos_token_id": 8,
+            "tokenizer.ggml.eos_token_id": 8,
+        }
+    # unigram ("llama"-style) with metaspace pieces
+    tokens = ["<unk>", "<s>", "</s>", "▁hello", "▁world", "▁", "h", "w", "o"]
+    scores = [0.0, 0.0, 0.0, -1.0, -1.5, -2.0, -3.0, -3.0, -3.0]
+    return {
+        "tokenizer.ggml.model": "llama",
+        "tokenizer.ggml.tokens": tokens,
+        "tokenizer.ggml.scores": scores,
+        "tokenizer.ggml.unknown_token_id": 0,
+        "tokenizer.ggml.bos_token_id": 1,
+        "tokenizer.ggml.eos_token_id": 2,
+    }
+
+
+def test_embedded_bpe_tokenizer(tmp_path):
+    path = tmp_path / "tok.gguf"
+    write_gguf(path, {"general.architecture": "llama", **_tok_metadata("gpt2")}, {})
+    r = GGUFReader(path)
+    tok = tokenizer_from_gguf(r)
+    ids = tok.encode("hello hello")
+    assert tok.decode(ids) == "hello hello"
+    assert 8 in tok.eos_token_ids
+    r.close()
+
+
+def test_embedded_unigram_tokenizer(tmp_path):
+    path = tmp_path / "tok-uni.gguf"
+    write_gguf(path, {"general.architecture": "llama", **_tok_metadata("llama")}, {})
+    r = GGUFReader(path)
+    tok = tokenizer_from_gguf(r)
+    ids = tok.encode("hello world")
+    assert ids == [3, 4]  # ▁hello ▁world win on score
+    assert tok.decode(ids) == "hello world"
+    r.close()
+
+
+def test_control_tokens_skipped_on_decode(tmp_path):
+    path = tmp_path / "tok-ctl.gguf"
+    md = _tok_metadata("llama")
+    # mark <s>/</s> as CONTROL (=3); rest NORMAL (=1)
+    md["tokenizer.ggml.token_type"] = [2, 3, 3, 1, 1, 1, 1, 1, 1]
+    write_gguf(path, {"general.architecture": "llama", **md}, {})
+    r = GGUFReader(path)
+    tok = tokenizer_from_gguf(r)
+    r.close()
+    assert tok.decode([3, 4, 2]) == "hello world"  # trailing </s> skipped
+    assert "</s>" in tok.decode([3, 4, 2], skip_special_tokens=False)
+
+
+def test_reader_closes_on_bad_file(tmp_path):
+    bad = tmp_path / "bad.gguf"
+    bad.write_bytes(b"NOPE" + b"\x00" * 64)
+    with pytest.raises(ValueError, match="not a GGUF file"):
+        GGUFReader(bad)
+
+
+def test_rope_scaling_mapping(tmp_path):
+    path = tmp_path / "rs.gguf"
+    write_gguf(path, {
+        "general.architecture": "llama",
+        "llama.embedding_length": 64,
+        "llama.block_count": 1,
+        "llama.attention.head_count": 4,
+        "llama.vocab_size": 16,
+        "llama.rope.scaling.type": "llama3",
+        "llama.rope.scaling.factor": 8.0,
+        "llama.rope.scaling.original_context_length": 8192,
+    }, {})
+    r = GGUFReader(path)
+    cfg = config_from_gguf(r)
+    r.close()
+    assert cfg.rope_scaling == {
+        "rope_type": "llama3", "factor": 8.0,
+        "original_max_position_embeddings": 8192,
+        "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+    }
+
+
+def test_moe_shared_expert_roundtrip(tmp_path):
+    cfg = dataclasses.replace(
+        PRESETS["test-tiny-moe"], shared_expert_size=32, shared_expert_gated=True,
+    )
+    params = llama.init_params(cfg, 21)
+    path = tmp_path / "moe.gguf"
+    save_params_gguf(path, cfg, params)
+    r = GGUFReader(path)
+    cfg2 = config_from_gguf(r, name=cfg.name)
+    assert cfg2.num_experts == cfg.num_experts
+    assert cfg2.num_experts_per_token == cfg.num_experts_per_token
+    assert cfg2.shared_expert_size == cfg.shared_expert_size
+    assert cfg2.shared_expert_gated
+    loaded = load_gguf_params(r, cfg2, dtype="float32")
+    r.close()
+
+    import jax
+
+    flat_a = jax.tree.leaves(jax.tree.map(np.asarray, params))
+    flat_b = jax.tree.leaves(jax.tree.map(np.asarray, loaded))
+    assert len(flat_a) == len(flat_b)
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_allclose(np.asarray(a, np.float32), np.asarray(b, np.float32), atol=1e-6)
+
+
+def test_config_and_params_roundtrip(tmp_path):
+    cfg = dataclasses.replace(PRESETS["test-tiny"], tie_embeddings=False)
+    params = llama.init_params(cfg, 11)
+    path = tmp_path / "model.gguf"
+    save_params_gguf(path, cfg, params)
+    r = GGUFReader(path)
+    cfg2 = config_from_gguf(r, name=cfg.name)
+    assert cfg2.hidden_size == cfg.hidden_size
+    assert cfg2.num_layers == cfg.num_layers
+    assert cfg2.num_kv_heads == cfg.num_kv_heads
+    assert cfg2.head_dim == cfg.head_dim
+    assert cfg2.intermediate_size == cfg.intermediate_size
+    assert not cfg2.tie_embeddings  # output.weight present
+    loaded = load_gguf_params(r, cfg2, dtype="float32")
+    r.close()
+
+    import jax
+
+    host = jax.tree.map(np.asarray, params)
+    flat_a = jax.tree.leaves(host)
+    flat_b = jax.tree.leaves(jax.tree.map(np.asarray, loaded))
+    assert len(flat_a) == len(flat_b)
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_allclose(np.asarray(a, np.float32), np.asarray(b, np.float32), atol=1e-6)
+
+
+def test_quantized_load_close(tmp_path):
+    """Q8_0-stored weights come back within block-quant tolerance everywhere."""
+    import jax
+
+    cfg = PRESETS["test-tiny"]
+    params = llama.init_params(cfg, 12)
+    path = tmp_path / "model-q8.gguf"
+    save_params_gguf(path, cfg, params, quant=GGML_Q8_0)
+    r = GGUFReader(path)
+    loaded = load_gguf_params(r, config_from_gguf(r, name=cfg.name), dtype="float32")
+    r.close()
+
+    for a, b in zip(jax.tree.leaves(jax.tree.map(np.asarray, params)),
+                    jax.tree.leaves(jax.tree.map(np.asarray, loaded))):
+        a32, b32 = np.asarray(a, np.float32), np.asarray(b, np.float32)
+        scale = max(np.abs(a32).max(), 1e-6)
+        assert np.abs(a32 - b32).max() <= scale / 100.0  # int8 blocks: <1% of range
+
+
+def test_worker_spec_from_gguf(tmp_path):
+    from dynamo_tpu.launch import WorkerSpec
+
+    cfg = PRESETS["test-tiny"]
+    params = llama.init_params(cfg, 13)
+    path = tmp_path / "served.gguf"
+    save_params_gguf(path, cfg, params, tokenizer_metadata=_tok_metadata("gpt2"))
+    spec = WorkerSpec.from_model_dir(str(path), name="tiny-gguf")
+    assert spec.model_config.hidden_size == cfg.hidden_size
+    assert spec.card.name == "tiny-gguf"
+    assert spec.card.tokenizer.endswith(".gguf")
+    from dynamo_tpu.tokenizer import load_tokenizer
+
+    tok = load_tokenizer(spec.card.tokenizer)
+    assert tok.decode(tok.encode("hello hello")) == "hello hello"
