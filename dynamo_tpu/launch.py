@@ -80,17 +80,14 @@ class WorkerSpec:
 
         p = pathlib.Path(model_dir)
         if p.is_file() and p.suffix == ".gguf":
-            from dynamo_tpu.models.gguf import GGUFReader, config_from_gguf
+            from dynamo_tpu.models.gguf import config_from_gguf, shared_reader
 
-            # One reader serves both config and card: parsing the header
-            # eagerly decodes the full embedded vocab, which is 100k+ strings
-            # for a real model — don't do it twice.
-            reader = GGUFReader(p)
-            try:
-                mc = config_from_gguf(reader, name=name or p.stem)
-                card = ModelDeploymentCard.from_gguf(name or p.stem, p, reader=reader)
-            finally:
-                reader.close()
+            # The shared reader serves config, card, tokenizer, and weights:
+            # parsing the header eagerly decodes the full embedded vocab
+            # (100k+ strings for a real model) — do it once per process.
+            reader = shared_reader(p)
+            mc = config_from_gguf(reader, name=name or p.stem)
+            card = ModelDeploymentCard.from_gguf(name or p.stem, p, reader=reader)
         else:
             mc = ModelConfig.from_hf(p / "config.json", name=name or p.name)
             card = ModelDeploymentCard.from_model_dir(name or p.name, p)
@@ -170,9 +167,9 @@ async def build_engine_service(spec: WorkerSpec, *, on_kv_event=None) -> JaxEngi
         if spec.params is not None:
             params = spec.params
         elif spec.model_dir is not None and spec.model_dir.endswith(".gguf"):
-            from dynamo_tpu.models.gguf import load_gguf_params
+            from dynamo_tpu.models.gguf import load_gguf_params, shared_reader
 
-            params = load_gguf_params(spec.model_dir, spec.model_config, mesh=mesh)
+            params = load_gguf_params(shared_reader(spec.model_dir), spec.model_config, mesh=mesh)
         elif spec.model_dir is not None:
             from dynamo_tpu.models.loader import load_params
 

@@ -22,6 +22,7 @@ built from GGUF), ``local_model.rs`` (GGUF vs HF repo resolution).
 from __future__ import annotations
 
 import mmap
+import os
 import pathlib
 import struct
 from typing import Any, BinaryIO
@@ -169,7 +170,9 @@ class GGUFReader:
         """Dequantize tensor ``name`` to float32 (or its native float dtype)."""
         info = self.tensors[name]
         start = self._data_start + info.offset
-        raw = self._mm[start : start + info.nbytes]
+        # memoryview slice: zero-copy window into the mapping (a plain mmap
+        # slice would copy the whole tensor into a bytes object first).
+        raw = memoryview(self._mm)[start : start + info.nbytes]
         return _dequant(raw, info.ggml_type, info.shape)
 
     def close(self) -> None:
@@ -177,15 +180,42 @@ class GGUFReader:
         self._file.close()
 
 
-def _dequant(raw: bytes, ggml_type: int, shape: tuple[int, ...]) -> np.ndarray:
+_READER_CACHE: dict[str, tuple[float, GGUFReader]] = {}
+
+
+def shared_reader(path: str | pathlib.Path) -> GGUFReader:
+    """Process-wide cached reader, keyed by resolved path + mtime.
+
+    Parsing a GGUF header eagerly decodes the embedded vocab (100k+ strings
+    for a real model); the serve path touches the same file for config, card,
+    tokenizer, and weights — one parse serves all. Borrowers must NOT close
+    the returned reader; the cache owns it (an mmap held open for the life of
+    the process, same cost as serving the weights from it).
+    """
+    key = str(pathlib.Path(path).resolve())
+    mtime = os.path.getmtime(key)
+    hit = _READER_CACHE.get(key)
+    if hit is not None and hit[0] == mtime:
+        return hit[1]
+    # A stale entry is dropped, not closed: an in-flight borrower (e.g. a
+    # weight load racing a file replacement) keeps a live mapping; the old
+    # reader's fd/mmap are released when the last borrower lets go (GC).
+    reader = GGUFReader(key)
+    _READER_CACHE[key] = (mtime, reader)
+    return reader
+
+
+def _dequant(raw: bytes | memoryview, ggml_type: int, shape: tuple[int, ...]) -> np.ndarray:
+    # The .copy() detaches from the mmap (no Python-bytes intermediate, one
+    # owned allocation): returned arrays must outlive reader.close().
     if ggml_type == GGML_F32:
-        return np.frombuffer(raw, dtype="<f4").reshape(shape)
+        return np.frombuffer(raw, dtype="<f4").reshape(shape).copy()
     if ggml_type == GGML_F16:
-        return np.frombuffer(raw, dtype="<f2").reshape(shape)
+        return np.frombuffer(raw, dtype="<f2").reshape(shape).copy()
     if ggml_type == GGML_BF16:
         import ml_dtypes
 
-        return np.frombuffer(raw, dtype=ml_dtypes.bfloat16).reshape(shape)
+        return np.frombuffer(raw, dtype=ml_dtypes.bfloat16).reshape(shape).copy()
     n = int(np.prod(shape))
     nb = n // _BLOCK
     if ggml_type == GGML_Q8_0:
@@ -210,6 +240,22 @@ def _dequant(raw: bytes, ggml_type: int, shape: tuple[int, ...]) -> np.ndarray:
 # ---------------------------------------------------------------------------
 # Writer
 # ---------------------------------------------------------------------------
+
+
+def _quantize_q4_0(arr: np.ndarray) -> bytes:
+    flat = np.ascontiguousarray(arr, dtype=np.float32).reshape(-1, _BLOCK)
+    # llama.cpp convention: d = value-at-max-abs / -8, so q=0 hits the
+    # negative extreme exactly; round the scale to its stored f16 width first.
+    idx = np.abs(flat).argmax(axis=1)
+    vmax = flat[np.arange(flat.shape[0]), idx]
+    d = (vmax / -8.0).astype("<f2").astype(np.float32)
+    inv = np.where(d != 0, 1.0 / np.where(d == 0, 1, d), 0.0)
+    q = np.clip(np.rint(flat * inv[:, None]) + 8, 0, 15).astype(np.uint8)
+    lo, hi = q[:, :16], q[:, 16:]
+    rec = np.empty(flat.shape[0], dtype=np.dtype([("d", "<f2"), ("qs", "u1", (_BLOCK // 2,))]))
+    rec["d"] = d.astype("<f2")
+    rec["qs"] = lo | (hi << 4)
+    return rec.tobytes()
 
 
 def _quantize_q8_0(arr: np.ndarray) -> bytes:
@@ -247,16 +293,18 @@ def _write_value(f: BinaryIO, v: Any) -> None:
         f.write(struct.pack("<I", T_ARR))
         if not v:
             f.write(struct.pack("<IQ", T_I32, 0))
-        elif isinstance(v[0], str):
+        elif all(isinstance(e, str) for e in v):
             f.write(struct.pack("<IQ", T_STR, len(v)))
             for s in v:
                 _write_string(f, s)
-        elif isinstance(v[0], float):
+        elif any(isinstance(e, float) for e in v):  # mixed int/float -> f32
             f.write(struct.pack("<IQ", T_F32, len(v)))
             f.write(np.asarray(v, dtype="<f4").tobytes())
-        else:
+        elif all(isinstance(e, (int, bool)) for e in v):
             f.write(struct.pack("<IQ", T_I32, len(v)))
             f.write(np.asarray(v, dtype="<i4").tobytes())
+        else:
+            raise TypeError(f"cannot serialize mixed-type metadata array: {v[:4]!r}...")
     else:
         raise TypeError(f"cannot serialize metadata value of type {type(v)}")
 
@@ -274,6 +322,12 @@ def write_gguf(
     in their native width (f32/f16/bf16)."""
     import ml_dtypes
 
+    # A caller round-tripping reader.metadata would otherwise duplicate the
+    # alignment key with a conflicting value — the reader's last-wins parse
+    # would then compute a data offset the writer never used.
+    metadata = dict(metadata)
+    align = int(metadata.pop("general.alignment", align))
+
     def ttype(name: str, arr: np.ndarray) -> int:
         if isinstance(quant, int):
             q = quant
@@ -282,8 +336,10 @@ def write_gguf(
         else:
             q = -1
         if q >= 0:
+            if q == GGML_Q4_1:
+                raise ValueError("writer supports Q8_0/Q4_0 quantization; Q4_1 is read-only")
             n = int(np.prod(arr.shape))
-            if q in (GGML_Q8_0, GGML_Q4_0, GGML_Q4_1) and n % _BLOCK:
+            if q in (GGML_Q8_0, GGML_Q4_0) and n % _BLOCK:
                 q = GGML_F16  # not blockable; fall back
             return q
         if arr.dtype == np.float16:
@@ -301,7 +357,9 @@ def write_gguf(
             return np.ascontiguousarray(arr.astype(ml_dtypes.bfloat16)).tobytes()
         if t == GGML_Q8_0:
             return _quantize_q8_0(arr)
-        raise ValueError(f"writer does not support ggml type {t}")
+        if t == GGML_Q4_0:
+            return _quantize_q4_0(arr)
+        raise ValueError(f"writer does not support ggml type {t} (readable-only format)")
 
     blobs: list[tuple[str, np.ndarray, int, bytes]] = []
     for name, arr in tensors.items():
@@ -345,13 +403,16 @@ def config_from_gguf(reader: GGUFReader, *, name: str | None = None) -> ModelCon
         raise ValueError("GGUF file missing required `general.architecture` metadata")
 
     def get(key: str, default: Any = None) -> Any:
-        return md.get(f"{arch}.{key}", default)
+        value = md.get(f"{arch}.{key}", default)
+        # Some exports store per-layer lists for scalar-shaped keys
+        # (head_count, feed_forward_length, ...); take the first layer.
+        if isinstance(value, list) and value:
+            return value[0]
+        return value
 
     heads = int(get("attention.head_count", 1))
     hidden = int(get("embedding_length", 0))
     kv_heads = get("attention.head_count_kv", heads)
-    if isinstance(kv_heads, list):  # per-layer lists appear in some exports
-        kv_heads = kv_heads[0]
     vocab = get("vocab_size")
     if vocab is None:
         toks = md.get("tokenizer.ggml.tokens")
@@ -510,7 +571,7 @@ def load_gguf_params(
     import jax.numpy as jnp
     import ml_dtypes
 
-    reader = source if isinstance(source, GGUFReader) else GGUFReader(source)
+    reader = source if isinstance(source, GGUFReader) else shared_reader(source)
     want = str(dtype or cfg.dtype)
     np_dtype = ml_dtypes.bfloat16 if want == "bfloat16" else np.dtype(jnp.dtype(want).name)
 
@@ -587,6 +648,15 @@ def save_params_gguf(
         f"{arch}.context_length": cfg.max_position,
         f"{arch}.vocab_size": cfg.vocab_size,
     }
+    if cfg.rope_scaling:
+        sc = cfg.rope_scaling
+        md[f"{arch}.rope.scaling.type"] = str(sc.get("rope_type", sc.get("type", "linear")))
+        md[f"{arch}.rope.scaling.factor"] = float(sc.get("factor", 1.0))
+        if "original_max_position_embeddings" in sc:
+            md[f"{arch}.rope.scaling.original_context_length"] = int(sc["original_max_position_embeddings"])
+        for key in ("low_freq_factor", "high_freq_factor"):
+            if key in sc:
+                md[f"{arch}.rope.scaling.{key}"] = float(sc[key])
     if cfg.is_moe:
         md[f"{arch}.expert_count"] = cfg.num_experts
         md[f"{arch}.expert_used_count"] = cfg.num_experts_per_token

@@ -24,7 +24,10 @@ def rope_frequencies(
     "original_max_position_embeddings": n}``.
     """
     inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
-    if scaling and scaling.get("rope_type", scaling.get("type")) == "llama3":
+    rope_type = (scaling or {}).get("rope_type", (scaling or {}).get("type"))
+    if rope_type in (None, "none", "default"):
+        pass
+    elif rope_type == "llama3":
         factor = float(scaling["factor"])
         lo = float(scaling["low_freq_factor"])
         hi = float(scaling["high_freq_factor"])
@@ -35,6 +38,31 @@ def rope_frequencies(
         smooth = np.clip(smooth, 0.0, 1.0)
         scaled = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
         inv_freq = np.where(wavelen > orig / lo, inv_freq / factor, scaled)
+    elif rope_type == "linear":
+        inv_freq = inv_freq / float(scaling["factor"])
+    elif rope_type == "yarn":
+        # NTK-by-parts interpolation (YaRN): dims whose wavelength fits the
+        # original context keep extrapolated freqs, long-wavelength dims get
+        # fully interpolated, a smooth ramp in between (beta_fast/beta_slow).
+        factor = float(scaling["factor"])
+        orig = float(scaling.get("original_max_position_embeddings", 4096))
+        beta_fast = float(scaling.get("beta_fast", 32.0))
+        beta_slow = float(scaling.get("beta_slow", 1.0))
+        dims = np.arange(0, head_dim, 2, dtype=np.float64)
+
+        def corr_dim(num_rot: float) -> float:
+            return (head_dim * np.log(orig / (num_rot * 2.0 * np.pi))) / (2.0 * np.log(theta))
+
+        low = max(np.floor(corr_dim(beta_fast)), 0.0)
+        high = min(np.ceil(corr_dim(beta_slow)), head_dim - 1.0)
+        ramp = np.clip((dims / 2.0 - low) / max(high - low, 1e-3), 0.0, 1.0)
+        extrapolation = 1.0 - ramp  # 1 where we keep original freqs
+        inv_freq = inv_freq / factor * ramp + inv_freq * extrapolation
+    else:
+        raise ValueError(
+            f"unsupported rope scaling type {rope_type!r} (supported: llama3, linear, yarn) — "
+            f"serving with unscaled frequencies would silently corrupt long-context output"
+        )
     return inv_freq.astype(np.float32)
 
 

@@ -1,0 +1,221 @@
+"""Service-graph SDK: ``@service`` / ``@endpoint`` / ``@api`` / ``depends()``.
+
+Declares inference graphs as plain Python classes whose dependency edges are
+class attributes. The decorators only attach metadata — a decorated class
+stays an ordinary class, instantiable and unit-testable without any runtime.
+``sdk.graph.load_graph`` walks the edges into a topologically-ordered Graph,
+and ``sdk.serving`` binds each service onto the DistributedRuntime (one
+process per service, or all-in-process for tests/dev).
+
+Example::
+
+    @service(namespace="inference", resources={"tpu": 1})
+    class Worker:
+        @endpoint()
+        async def generate(self, request, context):
+            yield {"text": "..."}
+
+    @service(namespace="inference")
+    class Frontend:
+        worker = depends(Worker)
+
+        @api(path="/generate")
+        async def generate(self, body):
+            return [r async for r in self.worker.generate(body)]
+
+Parity: reference `deploy/sdk/src/dynamo/sdk/__init__.py` decorators
+(`core/decorators/endpoint.py:99-112`, `lib/decorators.py:68-95`) and its
+`depends()` service-graph DSL. TPU-first difference: services bind to the
+first-party runtime's component model (`runtime/component.py`) rather than a
+circus/NATS deployment, and resource requests are expressed in TPU chips.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import inspect
+from typing import Any, AsyncIterator, Callable
+
+__all__ = [
+    "api",
+    "depends",
+    "endpoint",
+    "service",
+    "ApiSpec",
+    "Dependency",
+    "EndpointSpec",
+    "ServiceClient",
+    "ServiceSpec",
+]
+
+_SERVICE_ATTR = "__dynamo_service__"
+_ENDPOINT_ATTR = "__dynamo_endpoint__"
+_API_ATTR = "__dynamo_api__"
+
+
+@dataclasses.dataclass(frozen=True)
+class EndpointSpec:
+    name: str
+    method: str  # attribute name on the class
+
+
+@dataclasses.dataclass(frozen=True)
+class ApiSpec:
+    path: str
+    http_method: str
+    method: str  # attribute name on the class
+
+
+@dataclasses.dataclass
+class ServiceSpec:
+    name: str
+    namespace: str
+    component: str
+    cls: type
+    resources: dict[str, Any]
+    replicas: int
+    endpoints: list[EndpointSpec]
+    apis: list[ApiSpec]
+    dependencies: dict[str, "Dependency"]
+
+    @property
+    def ref(self) -> str:
+        return f"{self.namespace}/{self.component}"
+
+
+def service(
+    cls: type | None = None,
+    *,
+    name: str | None = None,
+    namespace: str = "dynamo",
+    resources: dict[str, Any] | None = None,
+    replicas: int = 1,
+) -> Any:
+    """Class decorator: register endpoints/apis/dependencies as a service."""
+
+    def wrap(target: type) -> type:
+        endpoints: list[EndpointSpec] = []
+        apis: list[ApiSpec] = []
+        for attr, member in inspect.getmembers(target, callable):
+            ep = getattr(member, _ENDPOINT_ATTR, None)
+            if ep is not None:
+                endpoints.append(EndpointSpec(name=ep or attr, method=attr))
+            ap = getattr(member, _API_ATTR, None)
+            if ap is not None:
+                apis.append(ApiSpec(path=ap[0], http_method=ap[1], method=attr))
+        deps = {
+            attr: value
+            for attr, value in vars(target).items()
+            if isinstance(value, Dependency)
+        }
+        spec = ServiceSpec(
+            name=name or target.__name__,
+            namespace=namespace,
+            component=(name or target.__name__).lower(),
+            cls=target,
+            resources=dict(resources or {}),
+            replicas=replicas,
+            endpoints=sorted(endpoints, key=lambda e: e.name),
+            apis=sorted(apis, key=lambda a: a.path),
+            dependencies=deps,
+        )
+        setattr(target, _SERVICE_ATTR, spec)
+        return target
+
+    return wrap(cls) if cls is not None else wrap
+
+
+def endpoint(fn: Callable | None = None, *, name: str | None = None) -> Any:
+    """Mark a method as a runtime endpoint (async generator or coroutine)."""
+
+    def wrap(target: Callable) -> Callable:
+        setattr(target, _ENDPOINT_ATTR, name or "")
+        return target
+
+    return wrap(fn) if fn is not None else wrap
+
+
+def api(fn: Callable | None = None, *, path: str | None = None, method: str = "POST") -> Any:
+    """Mark a method as an HTTP route (served when the service runs)."""
+
+    def wrap(target: Callable) -> Callable:
+        setattr(target, _API_ATTR, (path or f"/{target.__name__}", method.upper()))
+        return target
+
+    return wrap(fn) if fn is not None else wrap
+
+
+def spec_of(cls: type) -> ServiceSpec:
+    spec = getattr(cls, _SERVICE_ATTR, None)
+    if spec is None:
+        raise TypeError(f"{cls.__name__} is not a @service-decorated class")
+    return spec
+
+
+class Dependency:
+    """A ``depends(OtherService)`` edge.
+
+    As a descriptor it resolves to the :class:`ServiceClient` installed by the
+    serving layer (``instance.__dict__[attr]``); accessing it on an unbound
+    instance raises, which keeps "forgot to serve the dependency" an explicit
+    error instead of a hang.
+    """
+
+    def __init__(self, target: type, *, router_mode: str = "round_robin") -> None:
+        self.target = target
+        self.router_mode = router_mode
+        self._attr: str | None = None
+
+    @property
+    def spec(self) -> ServiceSpec:
+        return spec_of(self.target)
+
+    def __set_name__(self, owner: type, attr: str) -> None:
+        self._attr = attr
+
+    def __get__(self, obj: Any, objtype: type | None = None) -> Any:
+        if obj is None:
+            return self
+        try:
+            return obj.__dict__[self._attr]
+        except KeyError:
+            raise RuntimeError(
+                f"dependency {objtype.__name__}.{self._attr} is not bound — "
+                f"serve the graph (sdk.serving) or inject a client for tests"
+            ) from None
+
+
+def depends(target: type, *, router_mode: str = "round_robin") -> Dependency:
+    return Dependency(target, router_mode=router_mode)
+
+
+class ServiceClient:
+    """What a bound ``depends()`` resolves to: one call per target endpoint.
+
+    ``client.generate(req)`` opens a response stream on a live replica of the
+    target service (routing + retries from ``runtime/client.py``).
+    """
+
+    def __init__(self, clients: dict[str, Any]) -> None:
+        self._clients = clients
+
+    def __getattr__(self, name: str) -> Callable[..., AsyncIterator[Any]]:
+        try:
+            client = self._clients[name]
+        except KeyError:
+            raise AttributeError(
+                f"target service has no endpoint {name!r} (has: {sorted(self._clients)})"
+            ) from None
+
+        def call(request: Any, context: Any | None = None, **kw: Any) -> AsyncIterator[Any]:
+            return client.generate(request, context, **kw)
+
+        return call
+
+    def endpoint_client(self, name: str) -> Any:
+        """The underlying runtime Client (instance table, direct routing)."""
+        return self._clients[name]
+
+    async def close(self) -> None:
+        for client in self._clients.values():
+            await client.close()

@@ -1,0 +1,73 @@
+"""The ``dynamo`` CLI: inspect and serve service graphs.
+
+- ``python -m dynamo_tpu.sdk graph graphs.agg:Frontend`` — print topology.
+- ``python -m dynamo_tpu.sdk serve graphs.agg:Frontend -f config.yaml`` —
+  one process per service replica, coordinated via a store server.
+- ``python -m dynamo_tpu.sdk config -f config.yaml`` — show the merged
+  per-service config after the file+env cascade.
+
+Parity: reference `deploy/sdk` `dynamo serve` CLI (`cli/serving.py:49-288`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import signal
+import sys
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(prog="python -m dynamo_tpu.sdk")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_graph = sub.add_parser("graph", help="print a graph's topology")
+    p_graph.add_argument("ref")
+
+    p_serve = sub.add_parser("serve", help="serve a graph, one process per replica")
+    p_serve.add_argument("ref")
+    p_serve.add_argument("-f", "--config", default=None)
+    p_serve.add_argument("--store-port", type=int, default=7411)
+    p_serve.add_argument("--host", default="127.0.0.1")
+
+    p_cfg = sub.add_parser("config", help="print the merged service config")
+    p_cfg.add_argument("-f", "--config", default=None)
+
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    from dynamo_tpu.sdk.graph import load_graph
+    from dynamo_tpu.sdk.serving import ServeFleet, load_service_config
+
+    if args.cmd == "graph":
+        print(load_graph(args.ref).describe())
+    elif args.cmd == "config":
+        print(json.dumps(load_service_config(args.config), indent=2))
+    elif args.cmd == "serve":
+        graph = load_graph(args.ref)
+        config = load_service_config(args.config)
+
+        async def run() -> None:
+            fleet = await ServeFleet(
+                args.ref, config_path=args.config, store_port=args.store_port, host=args.host
+            ).start(graph, config)
+            stop = asyncio.Event()
+            loop = asyncio.get_running_loop()
+            for sig in (signal.SIGINT, signal.SIGTERM):
+                loop.add_signal_handler(sig, stop.set)
+            print(f"FLEET UP services={[s.name for s in graph.services]}", flush=True)
+            try:
+                await stop.wait()
+            finally:
+                await fleet.close()
+
+        asyncio.run(run())
+    else:  # pragma: no cover
+        parser.print_help()
+        sys.exit(2)
+
+
+if __name__ == "__main__":
+    main()

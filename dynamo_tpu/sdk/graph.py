@@ -1,0 +1,93 @@
+"""Graph discovery: ``module:Service`` ref -> topologically ordered services.
+
+``load_graph("examples.agg:Frontend")`` imports the module, takes the named
+service class, and walks its ``depends()`` edges transitively. The resulting
+order is leaves-first so the serving layer brings dependencies up before
+their dependents (a frontend never starts with a dead backend edge).
+
+Parity: reference `deploy/sdk/.../cli/serving.py` graph resolution (the
+``graphs/agg.py`` + ``dynamo serve graphs.agg:Frontend`` flow).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+from typing import Iterable
+
+from dynamo_tpu.sdk import Dependency, ServiceSpec, spec_of
+
+
+@dataclasses.dataclass
+class Graph:
+    entry: ServiceSpec
+    services: list[ServiceSpec]  # leaves-first; entry is last
+
+    def __iter__(self) -> Iterable[ServiceSpec]:
+        return iter(self.services)
+
+    def get(self, name: str) -> ServiceSpec:
+        for s in self.services:
+            if s.name == name:
+                return s
+        raise KeyError(f"graph has no service {name!r} (has: {[s.name for s in self.services]})")
+
+    def edges(self) -> list[tuple[str, str]]:
+        """(dependent, dependency) service-name pairs."""
+        out = []
+        for s in self.services:
+            for dep in s.dependencies.values():
+                out.append((s.name, dep.spec.name))
+        return out
+
+    def describe(self) -> str:
+        lines = []
+        for s in self.services:
+            deps = ", ".join(d.spec.name for d in s.dependencies.values()) or "-"
+            eps = ", ".join(e.name for e in s.endpoints) or "-"
+            apis = ", ".join(f"{a.http_method} {a.path}" for a in s.apis) or "-"
+            lines.append(
+                f"{s.name} (ns={s.namespace}, replicas={s.replicas}, "
+                f"resources={s.resources or '-'}) endpoints=[{eps}] apis=[{apis}] deps=[{deps}]"
+            )
+        return "\n".join(lines)
+
+
+def build_graph(entry_cls: type) -> Graph:
+    """Walk ``depends()`` edges from ``entry_cls``; cycle-safe; leaves first."""
+    entry = spec_of(entry_cls)
+    order: list[ServiceSpec] = []
+    seen: set[type] = set()
+    visiting: set[type] = set()
+
+    def visit(cls: type, chain: tuple[str, ...]) -> None:
+        if cls in seen:
+            return
+        if cls in visiting:
+            raise ValueError(f"dependency cycle: {' -> '.join(chain + (cls.__name__,))}")
+        visiting.add(cls)
+        spec = spec_of(cls)
+        for dep in spec.dependencies.values():
+            visit(dep.target, chain + (cls.__name__,))
+        visiting.discard(cls)
+        seen.add(cls)
+        order.append(spec)
+
+    visit(entry_cls, ())
+    return Graph(entry=entry, services=order)
+
+
+def load_graph(ref: str) -> Graph:
+    """Resolve a ``module.path:ServiceName`` reference to a Graph."""
+    module_name, _, attr = ref.partition(":")
+    if not attr:
+        raise ValueError(f"graph ref must be 'module:Service', got {ref!r}")
+    module = importlib.import_module(module_name)
+    try:
+        entry_cls = getattr(module, attr)
+    except AttributeError:
+        raise AttributeError(f"module {module_name!r} has no service {attr!r}") from None
+    return build_graph(entry_cls)
+
+
+_DEPENDENCY = Dependency  # re-export for isinstance checks in serving

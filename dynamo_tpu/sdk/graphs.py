@@ -1,0 +1,107 @@
+"""Ready-made LLM service graphs for the SDK (`dynamo serve` targets).
+
+``Frontend`` -> ``Processor`` -> ``Worker`` is the aggregated topology of the
+reference's `examples/llm/graphs/agg.py`: HTTP ingress, tokenize/detokenize,
+first-party JAX engine. Serve it with::
+
+    python -m dynamo_tpu.sdk serve dynamo_tpu.sdk.graphs:Frontend -f cfg.yaml
+
+where cfg.yaml can set per-service keys, e.g.::
+
+    Worker: {model: test-tiny, num_pages: 64}
+    Frontend: {http_port: 8000}
+
+Every service also works in-process via ``sdk.serving.serve_graph`` (the
+tests drive the full chain that way on the in-memory runtime).
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.sdk import api, depends, endpoint, service
+
+
+@service(namespace="inference", resources={"tpu": 1})
+class Worker:
+    """First-party JAX engine behind a ``generate`` endpoint.
+
+    Config: ``model`` (preset name, checkpoint dir, or .gguf), ``mock``
+    (timing-model engine instead of the JAX engine), plus engine knobs
+    (``num_pages``, ``max_batch_size``).
+    """
+
+    def __init__(self, model: str = "test-tiny", mock: bool = False, **engine_kw: Any) -> None:
+        self.model = model
+        self.mock = mock
+        self.engine_kw = engine_kw
+        self.service: Any = None
+
+    async def async_init(self) -> None:
+        from dynamo_tpu.launch import build_engine_service, make_worker_spec
+
+        spec = make_worker_spec(self.model, **self.engine_kw)
+        if self.mock:
+            from dynamo_tpu.mocker import build_mock_service
+
+            self.service = await build_mock_service(spec.engine_config)
+        else:
+            self.service = await build_engine_service(spec)
+        self.card = spec.card
+
+    @endpoint()
+    async def generate(self, request: Any, context: Any) -> AsyncIterator[Any]:
+        from dynamo_tpu.protocols.common import PreprocessedRequest
+
+        if isinstance(request, dict):
+            request = PreprocessedRequest.from_dict(request)
+        async for out in self.service.generate(request, context):
+            yield out
+
+    async def async_shutdown(self) -> None:
+        if self.service is not None:
+            await self.service.close()
+
+
+@service(namespace="inference")
+class Processor:
+    """Tokenize prompts in, detokenize token streams out."""
+
+    def __init__(self, model: str = "test-tiny", tokenizer: str = "byte") -> None:
+        from dynamo_tpu.tokenizer import load_tokenizer
+
+        self.tokenizer = load_tokenizer(tokenizer)
+
+    worker = depends(Worker)
+
+    @endpoint()
+    async def generate(self, request: dict, context: Any) -> AsyncIterator[dict]:
+        from dynamo_tpu.protocols.common import PreprocessedRequest, SamplingOptions, StopConditions
+        from dynamo_tpu.tokenizer import IncrementalDetokenizer
+
+        prompt = request.get("prompt", "")
+        pre = PreprocessedRequest(
+            token_ids=self.tokenizer.encode(prompt, add_bos=True),
+            sampling=SamplingOptions(temperature=float(request.get("temperature", 0.0))),
+            stop=StopConditions(max_tokens=int(request.get("max_tokens", 16))),
+        )
+        detok = IncrementalDetokenizer(self.tokenizer)
+        async for out in self.worker.generate(pre.to_dict(), context):
+            token_ids = out.get("token_ids", []) if isinstance(out, dict) else []
+            text = detok.push(token_ids) if token_ids else ""
+            item = {"text": text}
+            if isinstance(out, dict) and out.get("finish_reason"):
+                item["finish_reason"] = out["finish_reason"]
+            yield item
+
+
+@service(namespace="inference")
+class Frontend:
+    """HTTP ingress: ``POST /generate`` -> SSE stream of text deltas."""
+
+    processor = depends(Processor)
+
+    @api(path="/generate")
+    async def generate(self, body: dict) -> AsyncIterator[dict]:
+        async for item in self.processor.generate(body):
+            yield item

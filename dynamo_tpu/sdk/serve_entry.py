@@ -1,0 +1,59 @@
+"""Per-process entrypoint for fleet serving: run ONE service of a graph.
+
+``python -m dynamo_tpu.sdk.serve_entry graphs.agg:Frontend --service Worker
+--store tcp://127.0.0.1:7001 [-f config.yaml]``
+
+Connects to the deployment's store server, binds the named service's
+endpoints onto a TCP transport, and serves until signalled. The reference's
+``serve_dynamo.py`` plays this role under circus (`cli/serving.py`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.store_server import StoreClient
+from dynamo_tpu.runtime.tcp import TcpTransport
+from dynamo_tpu.sdk.graph import load_graph
+from dynamo_tpu.sdk.serving import _section_for, load_service_config, serve_service
+
+logger = logging.getLogger(__name__)
+
+
+async def amain(args: argparse.Namespace) -> None:
+    graph = load_graph(args.graph)
+    spec = graph.get(args.service)
+    config = load_service_config(args.config)
+    store = StoreClient.from_url(args.store)
+    runtime = DistributedRuntime(store, TcpTransport(host=args.host))
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    handle = await serve_service(runtime, spec, _section_for(config, spec))
+    print(f"SERVING {spec.name} instances={len(handle.instances)}", flush=True)
+    try:
+        await stop.wait()
+    finally:
+        await handle.close()
+        await runtime.close()
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(prog="python -m dynamo_tpu.sdk.serve_entry")
+    parser.add_argument("graph", help="module:Service graph reference")
+    parser.add_argument("--service", required=True, help="which service of the graph to run")
+    parser.add_argument("--store", required=True, help="tcp://host:port of the store server")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("-f", "--config", default=None, help="YAML/TOML/JSON service config")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(amain(args))
+
+
+if __name__ == "__main__":
+    main()

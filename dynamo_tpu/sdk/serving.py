@@ -1,0 +1,391 @@
+"""Serve a service graph: bind services to the runtime, wire dependencies.
+
+Two modes share all the binding code:
+
+- ``serve_graph`` — every service in one process over one runtime
+  (``DistributedRuntime.detached()`` by default). Dev loop + tests.
+- ``serve_fleet`` — one OS process per service replica (subprocesses running
+  ``python -m dynamo_tpu.sdk.serve_entry``), coordinated through a TCP store
+  server; replica crash → respawn. Deployment shape of the reference's
+  ``dynamo serve`` (circus watchers, `cli/serving.py:49-288`), with process
+  supervision instead of circus and the shared store instead of NATS/etcd.
+
+Per-service config cascades YAML/TOML file -> ``DYN_SVC_<SERVICE>_<FIELD>``
+env -> constructor; ``replicas`` and ``resources`` keys override the
+decorator (reference `lib/config.py` cascade).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import json
+import logging
+import os
+import pathlib
+import sys
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.sdk import ServiceClient, ServiceSpec
+from dynamo_tpu.sdk.graph import Graph
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Config cascade
+# ---------------------------------------------------------------------------
+
+
+def load_service_config(path: str | pathlib.Path | None, *, env: dict[str, str] | None = None) -> dict[str, dict[str, Any]]:
+    """service name -> merged config section (file then env overrides)."""
+    env = os.environ if env is None else env
+    sections: dict[str, dict[str, Any]] = {}
+    if path is not None:
+        p = pathlib.Path(path)
+        text = p.read_text()
+        if p.suffix in (".yaml", ".yml"):
+            import yaml
+
+            data = yaml.safe_load(text) or {}
+        elif p.suffix == ".toml":
+            import tomllib
+
+            data = tomllib.loads(text)
+        else:
+            data = json.loads(text)
+        if not isinstance(data, dict):
+            raise ValueError(f"service config {p} must be a mapping of service name -> section")
+        sections = {str(k): dict(v or {}) for k, v in data.items()}
+    # DYN_SVC_WORKER_REPLICAS=2 -> sections["Worker"]["replicas"] = 2
+    for key, raw in env.items():
+        if not key.startswith("DYN_SVC_"):
+            continue
+        rest = key[len("DYN_SVC_") :]
+        svc, _, field = rest.partition("_")
+        if not field:
+            continue
+        try:
+            value: Any = json.loads(raw)
+        except (json.JSONDecodeError, ValueError):
+            value = raw
+        bucket = None
+        for name in sections:
+            if name.upper() == svc:
+                bucket = sections[name]
+                break
+        if bucket is None:
+            bucket = sections.setdefault(svc.capitalize() if svc.capitalize() else svc, {})
+        bucket[field.lower()] = value
+    return sections
+
+
+def _section_for(config: dict[str, dict[str, Any]], spec: ServiceSpec) -> dict[str, Any]:
+    for key in (spec.name, spec.name.upper(), spec.component):
+        if key in config:
+            return dict(config[key])
+    return {}
+
+
+# ---------------------------------------------------------------------------
+# Binding a service object to the runtime
+# ---------------------------------------------------------------------------
+
+
+class _MethodEngine(AsyncEngine[Any, Any]):
+    """Adapts a bound service method into the AsyncEngine contract.
+
+    Async generators stream; plain coroutines become one-item streams. The
+    method may accept (request) or (request, context).
+    """
+
+    def __init__(self, fn: Any) -> None:
+        self.fn = fn
+        params = [
+            p for p in inspect.signature(fn).parameters.values()
+            if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+        ]
+        self._wants_context = len(params) >= 2
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        args = (request, context) if self._wants_context else (request,)
+        if inspect.isasyncgenfunction(self.fn):
+            async for item in self.fn(*args):
+                if context.is_stopped or context.is_killed:
+                    break
+                yield item
+        else:
+            yield await self.fn(*args)
+
+
+async def bind_dependencies(runtime: DistributedRuntime, spec: ServiceSpec, obj: Any) -> list[ServiceClient]:
+    """Install a started ServiceClient for every ``depends()`` attribute."""
+    bound: list[ServiceClient] = []
+    for attr, dep in spec.dependencies.items():
+        target = dep.spec
+        clients = {}
+        for ep in target.endpoints:
+            endpoint = (
+                runtime.namespace(target.namespace).component(target.component).endpoint(ep.name)
+            )
+            clients[ep.name] = await endpoint.client(router_mode=dep.router_mode).start()
+        sc = ServiceClient(clients)
+        obj.__dict__[attr] = sc
+        bound.append(sc)
+    return bound
+
+
+def _construct(spec: ServiceSpec, section: dict[str, Any]) -> Any:
+    """Instantiate the service class; pass the config section if accepted."""
+    kwargs = {k: v for k, v in section.items() if k not in ("replicas", "resources", "http_port")}
+    if spec.cls.__init__ is object.__init__:
+        params: dict[str, Any] = {}
+        takes_kw = False
+    else:
+        params = inspect.signature(spec.cls.__init__).parameters
+        takes_kw = any(p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values())
+    accepted = {
+        k: v for k, v in kwargs.items() if takes_kw or k in params
+    }
+    dropped = sorted(set(kwargs) - set(accepted))
+    if dropped:
+        logger.warning("service %s: config keys %s not accepted by __init__", spec.name, dropped)
+    obj = spec.cls(**accepted)
+    obj.__dict__.setdefault("config", dict(section))
+    return obj
+
+
+class ServiceHandle:
+    def __init__(self, spec: ServiceSpec, obj: Any, runtime: DistributedRuntime) -> None:
+        self.spec = spec
+        self.obj = obj
+        self.runtime = runtime
+        self.instances: list[Any] = []
+        self.clients: list[ServiceClient] = []
+        self.http_site: Any = None
+        self.http_port: int | None = None
+
+    async def close(self) -> None:
+        if self.http_site is not None:
+            await self.http_site.cleanup()
+        for c in self.clients:
+            await c.close()
+        shutdown = getattr(self.obj, "async_shutdown", None)
+        if shutdown is not None:
+            await shutdown()
+
+
+async def serve_service(
+    runtime: DistributedRuntime,
+    spec: ServiceSpec,
+    section: dict[str, Any] | None = None,
+    *,
+    http_port: int | None = None,
+) -> ServiceHandle:
+    """Construct + bind + publish one service on ``runtime``."""
+    section = dict(section or {})
+    obj = _construct(spec, section)
+    handle = ServiceHandle(spec, obj, runtime)
+    handle.clients = await bind_dependencies(runtime, spec, obj)
+    init = getattr(obj, "async_init", None)
+    if init is not None:
+        await init()
+    lease = await runtime.primary_lease()
+    for ep in spec.endpoints:
+        endpoint = runtime.namespace(spec.namespace).component(spec.component).endpoint(ep.name)
+        engine = _MethodEngine(getattr(obj, ep.method))
+        handle.instances.append(await endpoint.serve(engine, lease=lease))
+    if spec.apis:
+        port = http_port if http_port is not None else int(section.get("http_port", 0))
+        if port >= 0:
+            handle.http_site, handle.http_port = await _serve_apis(spec, obj, port)
+    return handle
+
+
+async def _serve_apis(spec: ServiceSpec, obj: Any, port: int):
+    """Mount ``@api`` methods on an aiohttp app (dict -> JSON, async gen -> SSE)."""
+    from aiohttp import web
+
+    app = web.Application()
+
+    def make_handler(api_spec):
+        method = getattr(obj, api_spec.method)
+
+        async def handler(request: web.Request) -> web.StreamResponse:
+            if request.method in ("POST", "PUT", "PATCH"):
+                try:
+                    body = await request.json()
+                except json.JSONDecodeError:
+                    return web.json_response({"error": "invalid JSON body"}, status=400)
+            else:
+                body = dict(request.query)
+            result = method(body)
+            if inspect.isasyncgen(result):
+                resp = web.StreamResponse(
+                    headers={"Content-Type": "text/event-stream", "Cache-Control": "no-cache"}
+                )
+                # Failures before the first item become a clean 500; once
+                # streaming has started the only honest signal is an error
+                # event + connection close (headers are already gone).
+                try:
+                    first = await anext(result, None)
+                except Exception as exc:
+                    logger.exception("api %s failed", api_spec.path)
+                    return web.json_response({"error": str(exc)}, status=500)
+                await resp.prepare(request)
+                try:
+                    if first is not None:
+                        data = first if isinstance(first, str) else json.dumps(first)
+                        await resp.write(f"data: {data}\n\n".encode())
+                    async for item in result:
+                        data = item if isinstance(item, str) else json.dumps(item)
+                        await resp.write(f"data: {data}\n\n".encode())
+                    await resp.write(b"data: [DONE]\n\n")
+                except Exception as exc:
+                    logger.exception("api %s failed mid-stream", api_spec.path)
+                    await resp.write(f"data: {json.dumps({'error': str(exc)})}\n\n".encode())
+                await resp.write_eof()
+                return resp
+            try:
+                value = await result
+            except Exception as exc:  # service bug -> 500, not a dead connection
+                logger.exception("api %s failed", api_spec.path)
+                return web.json_response({"error": str(exc)}, status=500)
+            if isinstance(value, web.StreamResponse):
+                return value
+            return web.json_response(value)
+
+        return handler
+
+    for api_spec in spec.apis:
+        app.router.add_route(api_spec.http_method, api_spec.path, make_handler(api_spec))
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", port)
+    await site.start()
+    actual = runner.addresses[0][1] if runner.addresses else port
+    logger.info("service %s api on http://127.0.0.1:%s", spec.name, actual)
+    return runner, actual
+
+
+# ---------------------------------------------------------------------------
+# In-process graph serving (dev / tests)
+# ---------------------------------------------------------------------------
+
+
+class GraphHandles:
+    def __init__(self, runtime: DistributedRuntime, handles: list[ServiceHandle], own_runtime: bool) -> None:
+        self.runtime = runtime
+        self.handles = handles
+        self._own_runtime = own_runtime
+
+    def get(self, name: str) -> ServiceHandle:
+        for h in self.handles:
+            if h.spec.name == name:
+                return h
+        raise KeyError(name)
+
+    async def close(self) -> None:
+        for h in reversed(self.handles):  # dependents first
+            await h.close()
+        if self._own_runtime:
+            await self.runtime.close()
+
+
+async def serve_graph(
+    graph: Graph,
+    *,
+    runtime: DistributedRuntime | None = None,
+    config: dict[str, dict[str, Any]] | None = None,
+) -> GraphHandles:
+    own = runtime is None
+    runtime = runtime or DistributedRuntime.detached()
+    config = config or {}
+    handles: list[ServiceHandle] = []
+    try:
+        for spec in graph.services:  # leaves first
+            handles.append(await serve_service(runtime, spec, _section_for(config, spec)))
+    except BaseException:
+        for h in reversed(handles):
+            await h.close()
+        if own:
+            await runtime.close()
+        raise
+    return GraphHandles(runtime, handles, own)
+
+
+# ---------------------------------------------------------------------------
+# Multi-process fleet serving (deployment)
+# ---------------------------------------------------------------------------
+
+
+class ServeFleet:
+    """One subprocess per service replica + the coordinating store server."""
+
+    def __init__(self, ref: str, *, config_path: str | None, store_port: int, host: str = "127.0.0.1") -> None:
+        self.ref = ref
+        self.config_path = config_path
+        self.store_port = store_port
+        self.host = host
+        self.procs: list[tuple[str, Any]] = []
+        self.store_server: Any = None
+        self._respawn_task: asyncio.Task | None = None
+        self._closing = False
+
+    async def start(self, graph: Graph, config: dict[str, dict[str, Any]]) -> "ServeFleet":
+        from dynamo_tpu.runtime.store_server import StoreServer
+
+        self.store_server = await StoreServer(host=self.host, port=self.store_port).start()
+        for spec in graph.services:
+            replicas = int(_section_for(config, spec).get("replicas", spec.replicas))
+            for i in range(replicas):
+                self.procs.append((spec.name, self._spawn(spec.name, i)))
+        self._respawn_task = asyncio.create_task(self._supervise())
+        return self
+
+    def _spawn(self, service: str, index: int):
+        import subprocess
+
+        cmd = [
+            sys.executable, "-m", "dynamo_tpu.sdk.serve_entry",
+            self.ref, "--service", service,
+            "--store", f"tcp://{self.host}:{self.store_port}",
+        ]
+        if self.config_path:
+            cmd += ["-f", self.config_path]
+        env = dict(os.environ)
+        env.setdefault("DYN_SDK_REPLICA", str(index))
+        logger.info("spawning %s[%d]: %s", service, index, " ".join(cmd))
+        return subprocess.Popen(cmd, env=env)
+
+    async def _supervise(self) -> None:
+        """Respawn dead replicas (the circus-watcher role)."""
+        backoff = 1.0
+        while not self._closing:
+            await asyncio.sleep(backoff)
+            for i, (name, proc) in enumerate(self.procs):
+                if proc.poll() is not None and not self._closing:
+                    logger.warning("service %s exited rc=%s; respawning", name, proc.returncode)
+                    self.procs[i] = (name, self._spawn(name, i))
+
+    async def close(self) -> None:
+        self._closing = True
+        if self._respawn_task is not None:
+            self._respawn_task.cancel()
+        for _name, proc in self.procs:
+            if proc.poll() is None:
+                proc.terminate()
+        loop = asyncio.get_running_loop()
+
+        def wait_all() -> None:
+            for _name, proc in self.procs:
+                try:
+                    proc.wait(timeout=10)
+                except Exception:
+                    proc.kill()
+
+        await loop.run_in_executor(None, wait_all)
+        if self.store_server is not None:
+            await self.store_server.close()

@@ -97,13 +97,9 @@ def load_tokenizer(spec: str | pathlib.Path) -> BaseTokenizer:
     if p.is_dir():
         p = p / "tokenizer.json"
     if p.suffix == ".gguf" and p.exists():
-        from dynamo_tpu.models.gguf import GGUFReader, tokenizer_from_gguf
+        from dynamo_tpu.models.gguf import shared_reader, tokenizer_from_gguf
 
-        reader = GGUFReader(p)
-        try:
-            return tokenizer_from_gguf(reader)
-        finally:
-            reader.close()
+        return tokenizer_from_gguf(shared_reader(p))
     if p.exists():
         return HfTokenizer.from_file(p)
     raise FileNotFoundError(f"no tokenizer at {spec}")

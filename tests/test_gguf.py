@@ -95,6 +95,56 @@ def test_q4_0_dequant_against_formula(tmp_path):
     np.testing.assert_allclose(got, expect)
 
 
+def test_q4_0_writer_roundtrip(tmp_path):
+    path = tmp_path / "q4.gguf"
+    rng = np.random.default_rng(3)
+    w = rng.standard_normal((8, 64)).astype(np.float32)
+    write_gguf(path, {"general.architecture": "llama"}, {"w": w}, quant=GGML_Q4_0)
+    r = GGUFReader(path)
+    got = r.read("w")
+    r.close()
+    # 4-bit blocks: quants land within half a step except at the positive
+    # extreme, where the asymmetric [-8, 7] range costs up to one full step
+    step = np.abs(w).reshape(-1, 32).max(axis=1) / 8.0
+    assert (np.abs(got - w).reshape(-1, 32) <= step[:, None] * 1.01 + 1e-6).all()
+
+
+def test_alignment_key_not_duplicated(tmp_path):
+    path = tmp_path / "al.gguf"
+    v = np.arange(32, dtype=np.float32)
+    write_gguf(path, {"general.architecture": "llama", "general.alignment": 64}, {"v": v})
+    r = GGUFReader(path)
+    assert r.metadata["general.alignment"] == 64
+    np.testing.assert_array_equal(r.read("v"), v)  # data laid out at 64 too
+    r.close()
+
+
+def test_mixed_int_float_array_promotes(tmp_path):
+    path = tmp_path / "mix.gguf"
+    write_gguf(path, {"general.architecture": "llama", "scores": [0, -1.25, -2.5]}, {})
+    r = GGUFReader(path)
+    np.testing.assert_allclose(r.metadata["scores"], [0.0, -1.25, -2.5])
+    r.close()
+
+
+def test_rope_scaling_linear_and_yarn():
+    from dynamo_tpu.ops.rope import rope_frequencies
+
+    base = rope_frequencies(64, theta=10000.0)
+    lin = rope_frequencies(64, theta=10000.0, scaling={"rope_type": "linear", "factor": 4.0})
+    np.testing.assert_allclose(lin, base / 4.0, rtol=1e-6)
+    yarn = rope_frequencies(
+        64, theta=10000.0,
+        scaling={"rope_type": "yarn", "factor": 4.0, "original_max_position_embeddings": 4096},
+    )
+    # high-frequency dims extrapolate (unchanged), low-frequency interpolate
+    np.testing.assert_allclose(yarn[0], base[0], rtol=1e-6)
+    np.testing.assert_allclose(yarn[-1], base[-1] / 4.0, rtol=1e-6)
+    assert ((yarn <= base + 1e-9) & (yarn >= base / 4.0 - 1e-9)).all()
+    with pytest.raises(ValueError, match="unsupported rope scaling"):
+        rope_frequencies(64, scaling={"rope_type": "longrope", "factor": 2.0})
+
+
 def test_unblockable_quant_falls_back(tmp_path):
     path = tmp_path / "fb.gguf"
     v = np.arange(7, dtype=np.float32)  # 7 % 32 != 0 -> cannot block-quantize
@@ -192,6 +242,19 @@ def test_rope_scaling_mapping(tmp_path):
         "original_max_position_embeddings": 8192,
         "low_freq_factor": 1.0, "high_freq_factor": 4.0,
     }
+
+
+def test_rope_scaling_survives_export_roundtrip(tmp_path):
+    scaling = {"rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+               "high_freq_factor": 4.0, "original_max_position_embeddings": 8192}
+    cfg = dataclasses.replace(PRESETS["test-tiny"], rope_scaling=scaling)
+    params = llama.init_params(cfg, 17)
+    path = tmp_path / "scaled.gguf"
+    save_params_gguf(path, cfg, params)
+    r = GGUFReader(path)
+    cfg2 = config_from_gguf(r, name=cfg.name)
+    r.close()
+    assert cfg2.rope_scaling == scaling
 
 
 def test_moe_shared_expert_roundtrip(tmp_path):

@@ -1,0 +1,217 @@
+"""SDK service-graph DSL: decorators, topology, in-process serving, config
+cascade, the packaged LLM graph, and the multi-process fleet path.
+
+Parity model: reference SDK unit tests cover decorator metadata and config
+cascade; here the serving path is additionally driven end-to-end on the
+in-memory runtime and as real subprocesses over the TCP store/transport.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from dynamo_tpu.sdk import ServiceClient, api, depends, endpoint, service, spec_of
+from dynamo_tpu.sdk.graph import build_graph, load_graph
+from dynamo_tpu.sdk.serving import load_service_config, serve_graph
+
+
+@service(namespace="t", resources={"tpu": 2}, replicas=3)
+class Echo:
+    @endpoint()
+    async def generate(self, request, context):
+        for ch in str(request.get("text", "")):
+            yield {"ch": ch}
+
+    @endpoint(name="ping")
+    async def do_ping(self, request):
+        return {"pong": True}
+
+
+@service(namespace="t")
+class Gateway:
+    echo = depends(Echo)
+
+    @api(path="/echo")
+    async def echo_api(self, body):
+        out = ""
+        async for item in self.echo.generate(body):
+            out += item["ch"]
+        return {"text": out}
+
+
+def test_decorator_metadata():
+    spec = spec_of(Echo)
+    assert spec.name == "Echo" and spec.namespace == "t"
+    assert spec.resources == {"tpu": 2} and spec.replicas == 3
+    assert [e.name for e in spec.endpoints] == ["generate", "ping"]
+    spec_g = spec_of(Gateway)
+    assert list(spec_g.dependencies) == ["echo"]
+    assert [(a.http_method, a.path) for a in spec_g.apis] == [("POST", "/echo")]
+
+
+def test_graph_topology_leaves_first():
+    g = build_graph(Gateway)
+    assert [s.name for s in g.services] == ["Echo", "Gateway"]
+    assert g.edges() == [("Gateway", "Echo")]
+    assert "Gateway" in g.describe()
+
+
+def test_graph_cycle_detected():
+    @service
+    class A:
+        pass
+
+    @service
+    class B:
+        a = depends(A)
+
+    # create a cycle after definition
+    spec_of(A).dependencies["b"] = depends(B)
+    with pytest.raises(ValueError, match="cycle"):
+        build_graph(B)
+
+
+def test_load_graph_ref():
+    g = load_graph("dynamo_tpu.sdk.graphs:Frontend")
+    assert [s.name for s in g.services] == ["Worker", "Processor", "Frontend"]
+
+
+def test_unbound_dependency_raises():
+    gw = Gateway()
+    with pytest.raises(RuntimeError, match="not bound"):
+        gw.echo  # noqa: B018
+
+
+def test_config_cascade(tmp_path):
+    cfg = tmp_path / "svc.yaml"
+    cfg.write_text(
+        textwrap.dedent(
+            """
+            Worker:
+              model: test-tiny
+              replicas: 2
+            Frontend:
+              http_port: 8123
+            """
+        )
+    )
+    merged = load_service_config(cfg, env={"DYN_SVC_WORKER_MODEL": '"llama-3.2-1b"', "DYN_SVC_WORKER_NUM_PAGES": "64"})
+    assert merged["Worker"]["model"] == "llama-3.2-1b"  # env beats file
+    assert merged["Worker"]["num_pages"] == 64
+    assert merged["Worker"]["replicas"] == 2
+    assert merged["Frontend"]["http_port"] == 8123
+
+
+async def test_serve_graph_in_process():
+    handles = await serve_graph(build_graph(Gateway))
+    try:
+        gw = handles.get("Gateway").obj
+        assert isinstance(gw.echo, ServiceClient)
+        out = ""
+        async for item in gw.echo.generate({"text": "hi!"}):
+            out += item["ch"]
+        assert out == "hi!"
+        # single-response endpoint becomes a one-item stream
+        items = [i async for i in gw.echo.ping({})]
+        assert items == [{"pong": True}]
+        # the @api surface is live over real HTTP
+        port = handles.get("Gateway").http_port
+        assert port
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            async with session.post(f"http://127.0.0.1:{port}/echo", json={"text": "abc"}) as resp:
+                assert resp.status == 200
+                assert await resp.json() == {"text": "abc"}
+    finally:
+        await handles.close()
+
+
+async def test_llm_graph_end_to_end_mock():
+    g = load_graph("dynamo_tpu.sdk.graphs:Frontend")
+    config = {"Worker": {"mock": True, "model": "test-tiny"}}
+    handles = await serve_graph(g, config=config)
+    try:
+        port = handles.get("Frontend").http_port
+        import aiohttp
+
+        async with aiohttp.ClientSession() as session:
+            async with session.post(
+                f"http://127.0.0.1:{port}/generate",
+                json={"prompt": "hello", "max_tokens": 4},
+            ) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"].startswith("text/event-stream")
+                body = await resp.text()
+        events = [json.loads(line[6:]) for line in body.splitlines() if line.startswith("data: ") and line != "data: [DONE]"]
+        assert events, body
+        assert events[-1].get("finish_reason")
+    finally:
+        await handles.close()
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+async def test_serve_fleet_subprocesses(tmp_path):
+    """serve_entry subprocess + store server + TCP transport, called from a
+    separate client process-side runtime."""
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.store_server import StoreClient, StoreServer
+    from dynamo_tpu.runtime.tcp import TcpTransport
+
+    server = await StoreServer(host="127.0.0.1", port=0).start()
+    store_port = server.port
+    cfg = tmp_path / "svc.yaml"
+    cfg.write_text("Worker:\n  mock: true\n  model: test-tiny\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(filter(None, [env.get("PYTHONPATH"), os.getcwd()]))
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "dynamo_tpu.sdk.serve_entry",
+            "dynamo_tpu.sdk.graphs:Frontend", "--service", "Worker",
+            "--store", f"tcp://127.0.0.1:{store_port}", "-f", str(cfg),
+        ],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        runtime = DistributedRuntime(
+            StoreClient.from_url(f"tcp://127.0.0.1:{store_port}"), TcpTransport(host="127.0.0.1")
+        )
+        client = await (
+            runtime.namespace("inference").component("worker").endpoint("generate").client().start()
+        )
+        # wait for the instance record to land
+        for _ in range(100):
+            if client.instance_ids():
+                break
+            await asyncio.sleep(0.2)
+            assert proc.poll() is None, proc.stdout.read()
+        assert client.instance_ids()
+        req = {
+            "token_ids": [1, 2, 3],
+            "sampling_options": {},
+            "stop_conditions": {"max_tokens": 3},
+        }
+        outs = [o async for o in client.generate(req)]
+        assert outs and any(o.get("token_ids") for o in outs)
+        await client.close()
+        await runtime.close()
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        await server.close()
