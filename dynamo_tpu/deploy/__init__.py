@@ -1,0 +1,15 @@
+"""Deployment plane: api-store, operator-style reconciler, manifest renderer,
+fleet-wide metrics service.
+
+The reference splits this across a Go kubebuilder operator
+(`deploy/cloud/operator`), a Python REST api-store (`deploy/cloud/api-store`),
+and a Grafana/Prometheus metrics stack (`deploy/metrics`). Here the same
+control loop — declarative GraphDeployment objects, a watch-driven
+reconciler, rendered per-service workloads — runs over this framework's own
+KeyValueStore and process supervision, with the k8s YAML renderer producing
+the manifests a cluster deployment would apply.
+"""
+
+from dynamo_tpu.deploy.objects import DeploymentPhase, GraphDeployment
+
+__all__ = ["DeploymentPhase", "GraphDeployment"]

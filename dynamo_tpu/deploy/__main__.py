@@ -1,0 +1,145 @@
+"""Deployment-plane CLI.
+
+- ``python -m dynamo_tpu.deploy api-store --port 8088`` — REST deployment
+  store (in-memory store, or ``--store tcp://...`` to join a cluster store).
+- ``python -m dynamo_tpu.deploy operator --store tcp://...`` — reconciler
+  with the local process backend.
+- ``python -m dynamo_tpu.deploy controller --port 8088`` — api-store +
+  operator sharing one in-process store: the single-host control plane.
+- ``python -m dynamo_tpu.deploy metrics --store tcp://...`` — fleet
+  Prometheus exporter.
+- ``python -m dynamo_tpu.deploy manifests mod:Svc --name d1 [-f cfg]`` —
+  print the k8s bundle; ``--crd`` prints the CRD.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+
+
+async def _wait_for_signal() -> None:
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+
+
+def _store_from(args: argparse.Namespace):
+    from dynamo_tpu.runtime.discovery import MemoryStore
+    from dynamo_tpu.runtime.store_server import StoreClient
+
+    if getattr(args, "store", None):
+        return StoreClient.from_url(args.store)
+    return MemoryStore()
+
+
+def main(argv: list[str] | None = None) -> None:
+    parser = argparse.ArgumentParser(prog="python -m dynamo_tpu.deploy")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_api = sub.add_parser("api-store")
+    p_api.add_argument("--host", default="127.0.0.1")
+    p_api.add_argument("--port", type=int, default=8088)
+    p_api.add_argument("--store", default=None, help="tcp://host:port cluster store (default in-memory)")
+
+    p_op = sub.add_parser("operator")
+    p_op.add_argument("--store", required=True, help="tcp://host:port store with deployment objects")
+    p_op.add_argument("--resync-seconds", type=float, default=30.0)
+
+    p_ctl = sub.add_parser("controller", help="api-store + operator in one process")
+    p_ctl.add_argument("--host", default="127.0.0.1")
+    p_ctl.add_argument("--port", type=int, default=8088)
+    p_ctl.add_argument("--resync-seconds", type=float, default=30.0)
+
+    p_met = sub.add_parser("metrics")
+    p_met.add_argument("--store", required=True)
+    p_met.add_argument("--host", default="127.0.0.1")
+    p_met.add_argument("--port", type=int, default=9090)
+    p_met.add_argument("--namespace", default="dynamo")
+    p_met.add_argument("--component", default="backend")
+
+    p_man = sub.add_parser("manifests")
+    p_man.add_argument("graph", nargs="?", help="module:Service ref")
+    p_man.add_argument("--name", default="dynamo")
+    p_man.add_argument("-f", "--config", default=None)
+    p_man.add_argument("--image", default=None)
+    p_man.add_argument("--crd", action="store_true", help="print the CRD instead")
+
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    if args.cmd == "manifests":
+        from dynamo_tpu.deploy.manifests import DEFAULT_IMAGE, render_bundle, render_crd
+        from dynamo_tpu.deploy.objects import GraphDeployment
+        from dynamo_tpu.sdk.graph import load_graph
+        from dynamo_tpu.sdk.serving import load_service_config
+
+        if args.crd:
+            print(render_crd())
+            return
+        if not args.graph:
+            raise SystemExit("manifests requires a module:Service graph ref (or --crd)")
+        dep = GraphDeployment(
+            name=args.name, graph=args.graph, config=load_service_config(args.config)
+        )
+        print(render_bundle(dep, load_graph(args.graph), image=args.image or DEFAULT_IMAGE))
+        return
+
+    async def run() -> None:
+        closers = []
+        if args.cmd == "api-store":
+            from dynamo_tpu.deploy.api_store import ApiStore
+
+            svc = await ApiStore(_store_from(args), host=args.host, port=args.port).start()
+            closers.append(svc)
+            print(f"API-STORE http://{args.host}:{svc.port}", flush=True)
+        elif args.cmd == "operator":
+            from dynamo_tpu.deploy.operator import Operator, ProcessBackend
+
+            op = await Operator(
+                _store_from(args), ProcessBackend(), resync_seconds=args.resync_seconds
+            ).start()
+            closers.append(op)
+            print("OPERATOR UP", flush=True)
+        elif args.cmd == "controller":
+            from dynamo_tpu.deploy.api_store import ApiStore
+            from dynamo_tpu.deploy.operator import Operator, ProcessBackend
+            from dynamo_tpu.runtime.discovery import MemoryStore
+
+            store = MemoryStore()
+            svc = await ApiStore(store, host=args.host, port=args.port).start()
+            op = await Operator(
+                store, ProcessBackend(), resync_seconds=args.resync_seconds
+            ).start()
+            closers += [op, svc]
+            print(f"CONTROLLER http://{args.host}:{svc.port}", flush=True)
+        elif args.cmd == "metrics":
+            from dynamo_tpu.deploy.metrics_service import MetricsService
+            from dynamo_tpu.runtime.component import DistributedRuntime
+            from dynamo_tpu.runtime.transport import InMemoryTransport
+
+            runtime = DistributedRuntime(_store_from(args), InMemoryTransport())
+            svc = await MetricsService(
+                runtime,
+                namespace=args.namespace,
+                component=args.component,
+                host=args.host,
+                port=args.port,
+            ).start()
+            closers.append(svc)
+            print(f"METRICS http://{args.host}:{svc.port}/metrics", flush=True)
+        try:
+            await _wait_for_signal()
+        finally:
+            for c in closers:
+                await c.close()
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,154 @@
+"""api-store: REST CRUD for graph deployments, backed by the KeyValueStore.
+
+Routes (all JSON):
+
+- ``POST   /api/v1/deployments``        — create (409 on duplicate)
+- ``GET    /api/v1/deployments``        — list (optional ``?label=k=v``)
+- ``GET    /api/v1/deployments/{name}`` — fetch one
+- ``PUT    /api/v1/deployments/{name}`` — update spec (bumps generation)
+- ``DELETE /api/v1/deployments/{name}`` — mark deleting (operator finalizes)
+- ``GET    /healthz``
+
+Writing to the same store the operator watches makes the API the single
+source of truth: a POST here is immediately visible to the reconciler as a
+watch event — the kubectl→apiserver→controller loop in one hop.
+
+Parity: reference `deploy/cloud/api-store` (REST store for packaged
+graphs/deployments).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+from aiohttp import web
+
+from dynamo_tpu.deploy.objects import STORE_PREFIX, DeploymentPhase, GraphDeployment
+from dynamo_tpu.runtime.discovery import KeyValueStore
+
+logger = logging.getLogger(__name__)
+
+
+class ApiStore:
+    def __init__(self, store: KeyValueStore, *, host: str = "127.0.0.1", port: int = 0) -> None:
+        self.store = store
+        self.host = host
+        self.port = port
+        self._runner: web.AppRunner | None = None
+
+    # -- handlers ----------------------------------------------------------
+
+    async def create(self, request: web.Request) -> web.Response:
+        body = await self._json(request)
+        if body is None or "name" not in body or "graph" not in body:
+            return web.json_response({"error": "body must have name + graph"}, status=400)
+        dep = GraphDeployment(
+            name=str(body["name"]),
+            graph=str(body["graph"]),
+            config=dict(body.get("config", {})),
+            labels={str(k): str(v) for k, v in dict(body.get("labels", {})).items()},
+        )
+        if await self.store.get(dep.key) is not None:
+            return web.json_response({"error": f"deployment {dep.name!r} exists"}, status=409)
+        await self.store.put(dep.key, dep.to_bytes())
+        logger.info("created deployment %s -> %s", dep.name, dep.graph)
+        return web.json_response(self._view(dep), status=201)
+
+    async def list_all(self, request: web.Request) -> web.Response:
+        label = request.query.get("label")
+        want: tuple[str, str] | None = None
+        if label:
+            k, _, v = label.partition("=")
+            want = (k, v)
+        items = []
+        for value in (await self.store.get_prefix(STORE_PREFIX)).values():
+            dep = GraphDeployment.from_bytes(value)
+            if want and dep.labels.get(want[0]) != want[1]:
+                continue
+            items.append(self._view(dep))
+        return web.json_response({"items": sorted(items, key=lambda d: d["name"])})
+
+    async def get_one(self, request: web.Request) -> web.Response:
+        dep = await self._load(request.match_info["name"])
+        if dep is None:
+            return web.json_response({"error": "not found"}, status=404)
+        return web.json_response(self._view(dep))
+
+    async def update(self, request: web.Request) -> web.Response:
+        body = await self._json(request)
+        if body is None:
+            return web.json_response({"error": "invalid JSON body"}, status=400)
+        dep = await self._load(request.match_info["name"])
+        if dep is None:
+            return web.json_response({"error": "not found"}, status=404)
+        changed = False
+        if "graph" in body and body["graph"] != dep.graph:
+            dep.graph = str(body["graph"])
+            changed = True
+        if "config" in body and body["config"] != dep.config:
+            dep.config = dict(body["config"])
+            changed = True
+        if "labels" in body:
+            dep.labels = {str(k): str(v) for k, v in dict(body["labels"]).items()}
+        if changed:
+            dep.generation += 1
+            dep.phase = DeploymentPhase.PENDING.value
+        await self.store.put(dep.key, dep.to_bytes())
+        return web.json_response(self._view(dep))
+
+    async def delete(self, request: web.Request) -> web.Response:
+        dep = await self._load(request.match_info["name"])
+        if dep is None:
+            return web.json_response({"error": "not found"}, status=404)
+        # Two-phase delete: the operator tears the fleet down, then removes
+        # the record (the finalizer pattern).
+        dep.phase = DeploymentPhase.DELETING.value
+        await self.store.put(dep.key, dep.to_bytes())
+        return web.json_response({"status": "deleting"}, status=202)
+
+    async def healthz(self, _request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    # -- helpers -----------------------------------------------------------
+
+    @staticmethod
+    async def _json(request: web.Request) -> dict[str, Any] | None:
+        try:
+            body = await request.json()
+        except Exception:
+            return None
+        return body if isinstance(body, dict) else None
+
+    async def _load(self, name: str) -> GraphDeployment | None:
+        raw = await self.store.get(STORE_PREFIX + name)
+        return GraphDeployment.from_bytes(raw) if raw is not None else None
+
+    @staticmethod
+    def _view(dep: GraphDeployment) -> dict[str, Any]:
+        import dataclasses
+
+        return dataclasses.asdict(dep)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> "ApiStore":
+        app = web.Application()
+        app.router.add_post("/api/v1/deployments", self.create)
+        app.router.add_get("/api/v1/deployments", self.list_all)
+        app.router.add_get("/api/v1/deployments/{name}", self.get_one)
+        app.router.add_put("/api/v1/deployments/{name}", self.update)
+        app.router.add_delete("/api/v1/deployments/{name}", self.delete)
+        app.router.add_get("/healthz", self.healthz)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        if self._runner.addresses:
+            self.port = self._runner.addresses[0][1]
+        logger.info("api-store on http://%s:%d", self.host, self.port)
+        return self
+
+    async def close(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()

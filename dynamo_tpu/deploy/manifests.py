@@ -1,0 +1,201 @@
+"""Render k8s manifests for a graph deployment (the operator's k8s half).
+
+Pure functions: GraphDeployment + Graph -> YAML documents. The layout
+mirrors what the reference operator's controllers materialize from a
+DynamoGraphDeployment (per-service Deployments + Services + a ConfigMap,
+`dynamographdeployment_controller.go`), adapted to TPU scheduling:
+``resources: {tpu: N}`` becomes a ``google.com/tpu`` limit plus the
+TPU-topology node selectors.
+
+``python -m dynamo_tpu.deploy manifests graphs.agg:Frontend -f cfg.yaml``
+prints the full bundle; apply with any cluster tooling.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+import yaml
+
+from dynamo_tpu.deploy.objects import GraphDeployment
+from dynamo_tpu.sdk.graph import Graph
+from dynamo_tpu.sdk.serving import _section_for
+
+DEFAULT_IMAGE = "dynamo-tpu:latest"
+STORE_PORT = 7411
+
+
+def render_crd() -> str:
+    """The GraphDeployment custom-resource definition."""
+    crd = {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": "graphdeployments.dynamo.tpu"},
+        "spec": {
+            "group": "dynamo.tpu",
+            "names": {
+                "kind": "GraphDeployment",
+                "plural": "graphdeployments",
+                "singular": "graphdeployment",
+                "shortNames": ["gdep"],
+            },
+            "scope": "Namespaced",
+            "versions": [
+                {
+                    "name": "v1alpha1",
+                    "served": True,
+                    "storage": True,
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "type": "object",
+                            "properties": {
+                                "spec": {
+                                    "type": "object",
+                                    "required": ["graph"],
+                                    "properties": {
+                                        "graph": {"type": "string"},
+                                        "config": {
+                                            "type": "object",
+                                            "x-kubernetes-preserve-unknown-fields": True,
+                                        },
+                                    },
+                                },
+                                "status": {
+                                    "type": "object",
+                                    "x-kubernetes-preserve-unknown-fields": True,
+                                },
+                            },
+                        }
+                    },
+                    "subresources": {"status": {}},
+                }
+            ],
+        },
+    }
+    return yaml.safe_dump(crd, sort_keys=False)
+
+
+def _store_manifests(dep: GraphDeployment, image: str) -> list[dict[str, Any]]:
+    name = f"{dep.name}-store"
+    labels = {"app": name, "dynamo.tpu/deployment": dep.name}
+    return [
+        {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": name, "labels": labels},
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": labels},
+                "template": {
+                    "metadata": {"labels": labels},
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "store",
+                                "image": image,
+                                "command": [
+                                    "python", "-m", "dynamo_tpu.launch",
+                                    "--role", "store",
+                                    "--serve-store-port", str(STORE_PORT),
+                                    "--host", "0.0.0.0",
+                                ],
+                                "ports": [{"containerPort": STORE_PORT}],
+                            }
+                        ]
+                    },
+                },
+            },
+        },
+        {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": name, "labels": labels},
+            "spec": {
+                "selector": labels,
+                "ports": [{"port": STORE_PORT, "targetPort": STORE_PORT}],
+            },
+        },
+    ]
+
+
+def render_deployment(
+    dep: GraphDeployment,
+    graph: Graph,
+    *,
+    image: str = DEFAULT_IMAGE,
+) -> list[dict[str, Any]]:
+    """ConfigMap + store + one Deployment/Service per graph service."""
+    cm_name = f"{dep.name}-config"
+    store_addr = f"tcp://{dep.name}-store:{STORE_PORT}"
+    out: list[dict[str, Any]] = [
+        {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": cm_name, "labels": {"dynamo.tpu/deployment": dep.name}},
+            "data": {"services.json": json.dumps(dep.config, indent=2, sort_keys=True)},
+        },
+        *_store_manifests(dep, image),
+    ]
+    for spec in graph.services:
+        section = _section_for(dep.config, spec)
+        replicas = int(section.get("replicas", spec.replicas))
+        svc_name = f"{dep.name}-{spec.component}"
+        labels = {
+            "app": svc_name,
+            "dynamo.tpu/deployment": dep.name,
+            "dynamo.tpu/service": spec.name,
+        }
+        container: dict[str, Any] = {
+            "name": spec.component,
+            "image": image,
+            "command": [
+                "python", "-m", "dynamo_tpu.sdk.serve_entry",
+                dep.graph, "--service", spec.name,
+                "--store", store_addr,
+                "-f", "/etc/dynamo/services.json",
+            ],
+            "volumeMounts": [{"name": "config", "mountPath": "/etc/dynamo"}],
+        }
+        pod: dict[str, Any] = {
+            "containers": [container],
+            "volumes": [{"name": "config", "configMap": {"name": cm_name}}],
+        }
+        tpus = int(spec.resources.get("tpu", 0))
+        if tpus:
+            container["resources"] = {"limits": {"google.com/tpu": tpus}}
+            pod["nodeSelector"] = {"cloud.google.com/gke-tpu-accelerator": "tpu-v5e"}
+        http_port = int(section.get("http_port", 0))
+        if http_port:
+            container["ports"] = [{"containerPort": http_port}]
+        out.append(
+            {
+                "apiVersion": "apps/v1",
+                "kind": "Deployment",
+                "metadata": {"name": svc_name, "labels": labels},
+                "spec": {
+                    "replicas": replicas,
+                    "selector": {"matchLabels": labels},
+                    "template": {"metadata": {"labels": labels}, "spec": pod},
+                },
+            }
+        )
+        if http_port:
+            out.append(
+                {
+                    "apiVersion": "v1",
+                    "kind": "Service",
+                    "metadata": {"name": svc_name, "labels": labels},
+                    "spec": {
+                        "selector": labels,
+                        "ports": [{"port": http_port, "targetPort": http_port}],
+                    },
+                }
+            )
+    return out
+
+
+def render_bundle(dep: GraphDeployment, graph: Graph, *, image: str = DEFAULT_IMAGE) -> str:
+    """Multi-document YAML: everything `kubectl apply -f -` needs."""
+    docs = render_deployment(dep, graph, image=image)
+    return "---\n".join(yaml.safe_dump(d, sort_keys=False) for d in docs)

@@ -1,0 +1,100 @@
+"""Standalone fleet metrics exporter: worker KV plane -> Prometheus.
+
+A deployment-wide ``/metrics`` endpoint that any Prometheus can scrape
+without touching the serving path: it watches the same store-backed metrics
+plane the KV router reads (`router/metrics.py`) and re-exposes every
+worker's load snapshot as labelled gauges/counters.
+
+Run: ``python -m dynamo_tpu.deploy metrics --store tcp://host:7411 --port 9090``
+Dashboards: ``deploy/grafana-dashboard.json`` charts these series plus the
+frontend's request metrics (`frontend/metrics.py`).
+
+Parity: reference `components/metrics` binary (standalone aggregation
+service feeding the Grafana stack, SURVEY §2 row 41).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from aiohttp import web
+
+from dynamo_tpu.router.metrics import KvMetricsAggregator
+from dynamo_tpu.runtime.component import DistributedRuntime
+
+logger = logging.getLogger(__name__)
+
+_GAUGES = (
+    ("kv_active_blocks", "KV blocks in use"),
+    ("kv_total_blocks", "KV blocks total"),
+    ("num_requests_waiting", "Requests queued"),
+    ("num_requests_running", "Requests running"),
+    ("request_total_slots", "Max batch slots"),
+    ("cache_hit_rate", "Prefix cache hit rate"),
+)
+_COUNTERS = (
+    ("prompt_tokens_total", "Prompt tokens processed"),
+    ("generated_tokens_total", "Tokens generated"),
+)
+
+
+class MetricsService:
+    def __init__(
+        self,
+        runtime: DistributedRuntime,
+        *,
+        namespace: str = "dynamo",
+        component: str = "backend",
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ) -> None:
+        self.runtime = runtime
+        self.aggregator = KvMetricsAggregator(runtime, namespace, component)
+        self.host = host
+        self.port = port
+        self._runner: web.AppRunner | None = None
+
+    def render(self) -> str:
+        """Prometheus text format, one labelled series per worker."""
+        snapshot = self.aggregator.snapshot()
+        lines: list[str] = []
+        ns = "dynamo_worker"
+        for field, help_text in _GAUGES + _COUNTERS:
+            kind = "counter" if field.endswith("_total") and field not in ("kv_total_blocks",) else "gauge"
+            lines.append(f"# HELP {ns}_{field} {help_text}")
+            lines.append(f"# TYPE {ns}_{field} {kind}")
+            for wid, m in sorted(snapshot.items()):
+                lines.append(f'{ns}_{field}{{worker_id="{wid:x}"}} {getattr(m, field)}')
+        lines.append(f"# HELP {ns}_cache_usage KV utilization 0..1")
+        lines.append(f"# TYPE {ns}_cache_usage gauge")
+        for wid, m in sorted(snapshot.items()):
+            lines.append(f'{ns}_cache_usage{{worker_id="{wid:x}"}} {m.cache_usage:.6f}')
+        lines.append(f"# HELP {ns}_up Workers publishing metrics")
+        lines.append(f"# TYPE {ns}_up gauge")
+        lines.append(f"{ns}_up {len(snapshot)}")
+        return "\n".join(lines) + "\n"
+
+    async def _metrics(self, _request: web.Request) -> web.Response:
+        return web.Response(text=self.render(), content_type="text/plain")
+
+    async def _healthz(self, _request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok", "workers": len(self.aggregator.snapshot())})
+
+    async def start(self) -> "MetricsService":
+        await self.aggregator.start()
+        app = web.Application()
+        app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/healthz", self._healthz)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        if self._runner.addresses:
+            self.port = self._runner.addresses[0][1]
+        logger.info("metrics service on http://%s:%d/metrics", self.host, self.port)
+        return self
+
+    async def close(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+        await self.aggregator.close()

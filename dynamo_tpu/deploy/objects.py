@@ -1,0 +1,64 @@
+"""Declarative deployment objects (the CRD shapes).
+
+``GraphDeployment`` is the DynamoGraphDeployment equivalent: a named desire
+for "this service graph, with these per-service overrides, running". The
+api-store persists them; the operator reconciles them; the manifest renderer
+turns them into k8s YAML.
+
+Parity: reference `deploy/cloud/operator/api/v1alpha1/dynamocomponent_types.go:42-104`
+(CRD spec/status split), api-store deployment records.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import time
+from typing import Any
+
+
+class DeploymentPhase(str, enum.Enum):
+    PENDING = "pending"
+    RUNNING = "running"
+    FAILED = "failed"
+    DELETING = "deleting"
+
+
+STORE_PREFIX = "deployments/"
+
+
+@dataclasses.dataclass
+class GraphDeployment:
+    """Spec + status of one deployed service graph."""
+
+    name: str
+    graph: str  # module:Service ref
+    config: dict[str, dict[str, Any]] = dataclasses.field(default_factory=dict)
+    # spec
+    labels: dict[str, str] = dataclasses.field(default_factory=dict)
+    created_at: float = 0.0
+    generation: int = 1
+    # status (written by the operator)
+    phase: str = DeploymentPhase.PENDING.value
+    message: str = ""
+    observed_generation: int = 0
+    services_ready: dict[str, int] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        if not self.created_at:
+            self.created_at = time.time()
+
+    @property
+    def key(self) -> str:
+        return STORE_PREFIX + self.name
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(dataclasses.asdict(self)).encode()
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "GraphDeployment":
+        return cls(**json.loads(data))
+
+    def spec_equals(self, other: "GraphDeployment") -> bool:
+        return (self.graph, self.config) == (other.graph, other.config)

@@ -1,0 +1,200 @@
+"""Operator: watch GraphDeployment objects, reconcile fleets to match.
+
+The kubebuilder-controller shape (reference
+`deploy/cloud/operator/internal/controller/dynamographdeployment_controller.go`)
+on this framework's primitives: a store-prefix watch delivers spec changes,
+`reconcile()` diffs desired vs actual and actuates through a pluggable
+:class:`WorkloadBackend`, then writes status back to the object. Status
+writes echo through the watch; the generation/observed_generation pair makes
+reconciliation idempotent, so the echo converges instead of looping.
+
+Backends:
+
+- :class:`ProcessBackend` — each deployment becomes a supervised
+  ``sdk.serving.ServeFleet`` (one process per service replica). The
+  single-host "cluster".
+- k8s — render manifests with `deploy/manifests.py` and apply them with any
+  cluster tooling; the reconciler logic is identical, only the backend
+  differs (this image has no cluster to drive).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Protocol
+
+from dynamo_tpu.deploy.objects import STORE_PREFIX, DeploymentPhase, GraphDeployment
+from dynamo_tpu.runtime.discovery import KeyValueStore, WatchEventType
+
+logger = logging.getLogger(__name__)
+
+
+class WorkloadBackend(Protocol):
+    async def apply(self, dep: GraphDeployment) -> dict[str, int]:
+        """Bring the deployment's workloads to spec; return service->replicas."""
+        ...
+
+    async def delete(self, name: str) -> None: ...
+
+    async def close(self) -> None: ...
+
+
+class ProcessBackend:
+    """One supervised ServeFleet per deployment (the local-cluster backend)."""
+
+    def __init__(self, *, host: str = "127.0.0.1", base_store_port: int = 0) -> None:
+        self.host = host
+        self.base_store_port = base_store_port
+        self.fleets: dict[str, Any] = {}
+        self._cfg_files: dict[str, str] = {}
+
+    async def apply(self, dep: GraphDeployment) -> dict[str, int]:
+        from dynamo_tpu.sdk.graph import load_graph
+        from dynamo_tpu.sdk.serving import ServeFleet, _section_for
+
+        existing = self.fleets.pop(dep.name, None)
+        if existing is not None:  # spec change: replace wholesale
+            await existing.close()
+            self._drop_cfg(dep.name)
+        graph = load_graph(dep.graph)
+        import json
+        import tempfile
+
+        # ServeFleet subprocesses read config from a file; materialize the
+        # deployment's config dict for them.
+        cfg_file = None
+        if dep.config:
+            cfg_file = tempfile.NamedTemporaryFile(
+                "w", suffix=".json", prefix=f"dep-{dep.name}-", delete=False
+            )
+            json.dump(dep.config, cfg_file)
+            cfg_file.close()
+            self._cfg_files[dep.name] = cfg_file.name
+        fleet = ServeFleet(
+            dep.graph,
+            config_path=cfg_file.name if cfg_file else None,
+            store_port=self.base_store_port,
+            host=self.host,
+        )
+        await fleet.start(graph, dep.config)
+        self.fleets[dep.name] = fleet
+        counts: dict[str, int] = {}
+        for spec in graph.services:
+            counts[spec.name] = int(_section_for(dep.config, spec).get("replicas", spec.replicas))
+        return counts
+
+    def _drop_cfg(self, name: str) -> None:
+        import os
+
+        path = self._cfg_files.pop(name, None)
+        if path is not None:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    async def delete(self, name: str) -> None:
+        fleet = self.fleets.pop(name, None)
+        if fleet is not None:
+            await fleet.close()
+        self._drop_cfg(name)
+
+    async def close(self) -> None:
+        for name in list(self.fleets):
+            await self.delete(name)
+
+
+class Operator:
+    def __init__(
+        self,
+        store: KeyValueStore,
+        backend: WorkloadBackend,
+        *,
+        resync_seconds: float = 30.0,
+    ) -> None:
+        self.store = store
+        self.backend = backend
+        self.resync_seconds = resync_seconds
+        self._task: asyncio.Task | None = None
+        self._resync_task: asyncio.Task | None = None
+        self.reconciled = asyncio.Event()  # pulses after each reconcile (tests)
+
+    # -- control loop ------------------------------------------------------
+
+    async def start(self) -> "Operator":
+        await self.resync()
+        self._task = asyncio.create_task(self._watch_loop())
+        self._resync_task = asyncio.create_task(self._resync_loop())
+        return self
+
+    async def _watch_loop(self) -> None:
+        try:
+            async for event in self.store.watch_prefix(STORE_PREFIX):
+                if event.type is WatchEventType.PUT and event.value is not None:
+                    dep = GraphDeployment.from_bytes(event.value)
+                    await self.reconcile(dep)
+                # DELETE events need no action: deletion goes through the
+                # DELETING phase first, where the backend is torn down.
+        except asyncio.CancelledError:
+            pass
+        except Exception:
+            logger.exception("operator watch loop died")
+
+    async def _resync_loop(self) -> None:
+        try:
+            while True:
+                await asyncio.sleep(self.resync_seconds)
+                await self.resync()
+        except asyncio.CancelledError:
+            pass
+
+    async def resync(self) -> None:
+        """Level-triggered pass over every object (missed-event safety net,
+        and the retry path for failed deployments)."""
+        for value in (await self.store.get_prefix(STORE_PREFIX)).values():
+            await self.reconcile(GraphDeployment.from_bytes(value), force=True)
+
+    # -- reconciliation ----------------------------------------------------
+
+    async def reconcile(self, dep: GraphDeployment, *, force: bool = False) -> None:
+        try:
+            if dep.phase == DeploymentPhase.DELETING.value:
+                await self.backend.delete(dep.name)
+                await self.store.delete(dep.key)
+                logger.info("deployment %s finalized", dep.name)
+                self.reconciled.set()
+                return
+            if dep.observed_generation == dep.generation and dep.phase == DeploymentPhase.RUNNING.value:
+                self.reconciled.set()
+                return  # status echo or already-converged resync
+            if (
+                dep.observed_generation == dep.generation
+                and dep.phase == DeploymentPhase.FAILED.value
+                and not force
+            ):
+                # Don't hot-loop a failing spec off our own status write;
+                # failed objects retry on the level-triggered resync.
+                self.reconciled.set()
+                return
+            counts = await self.backend.apply(dep)
+            dep.phase = DeploymentPhase.RUNNING.value
+            dep.message = ""
+            dep.services_ready = counts
+        except Exception as exc:
+            logger.exception("reconcile %s failed", dep.name)
+            dep.phase = DeploymentPhase.FAILED.value
+            dep.message = f"{type(exc).__name__}: {exc}"
+        dep.observed_generation = dep.generation
+        await self.store.put(dep.key, dep.to_bytes())
+        self.reconciled.set()
+
+    async def close(self) -> None:
+        for task in (self._task, self._resync_task):
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+        await self.backend.close()

@@ -435,6 +435,12 @@ def config_from_gguf(reader: GGUFReader, *, name: str | None = None) -> ModelCon
             "low_freq_factor": float(get("rope.scaling.low_freq_factor", 1.0)),
             "high_freq_factor": float(get("rope.scaling.high_freq_factor", 4.0)),
         }
+        # YaRN extras (attn_factor is llama.cpp's key; betas are ours).
+        if get("rope.scaling.attn_factor") is not None:
+            rope_scaling["attention_factor"] = float(get("rope.scaling.attn_factor"))
+        for beta in ("beta_fast", "beta_slow"):
+            if get(f"rope.scaling.{beta}") is not None:
+                rope_scaling[beta] = float(get(f"rope.scaling.{beta}"))
     shared_ffn = int(get("expert_shared_feed_forward_length", 0))
     if shared_ffn == 0 and "blk.0.ffn_gate_shexp.weight" in reader.tensors:
         shared_ffn = reader.tensors["blk.0.ffn_gate_shexp.weight"].shape[0]
@@ -654,9 +660,11 @@ def save_params_gguf(
         md[f"{arch}.rope.scaling.factor"] = float(sc.get("factor", 1.0))
         if "original_max_position_embeddings" in sc:
             md[f"{arch}.rope.scaling.original_context_length"] = int(sc["original_max_position_embeddings"])
-        for key in ("low_freq_factor", "high_freq_factor"):
+        for key in ("low_freq_factor", "high_freq_factor", "beta_fast", "beta_slow"):
             if key in sc:
                 md[f"{arch}.rope.scaling.{key}"] = float(sc[key])
+        if "attention_factor" in sc:
+            md[f"{arch}.rope.scaling.attn_factor"] = float(sc["attention_factor"])
     if cfg.is_moe:
         md[f"{arch}.expert_count"] = cfg.num_experts
         md[f"{arch}.expert_used_count"] = cfg.num_experts_per_token

@@ -29,7 +29,7 @@ import numpy as np
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.ops.attention import paged_attention, write_kv
 from dynamo_tpu.ops.norm import rms_norm
-from dynamo_tpu.ops.rope import apply_rope, rope_frequencies
+from dynamo_tpu.ops.rope import apply_rope, rope_attention_factor, rope_frequencies
 
 Params = dict
 
@@ -210,6 +210,7 @@ def forward(
     b, t = tokens.shape
     nl, npages, ps = k_cache.shape[0], k_cache.shape[1], k_cache.shape[2]
     inv_freq = jnp.asarray(rope_frequencies(cfg.head_dim, theta=cfg.rope_theta, scaling=cfg.rope_scaling))
+    attn_mscale = rope_attention_factor(cfg.rope_scaling) ** 2
     x = params["embed"][tokens]  # [B, T, D]
     if mm_embeds is not None and cfg.image_token_id is not None:
         is_img = tokens == jnp.int32(cfg.image_token_id)  # [B, T]
@@ -255,6 +256,8 @@ def forward(
         v = vp.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
         q = apply_rope(q, positions, inv_freq)
         k = apply_rope(k, positions, inv_freq)
+        if attn_mscale != 1.0:  # YaRN temperature: logits scale by mscale^2
+            q = q * jnp.asarray(attn_mscale, q.dtype)
         k_full, v_full = write_kv(k_full, v_full, k, v, slot_mapping + li * (npages * ps))
         if ring:
             from dynamo_tpu.parallel.ring import ring_attention
@@ -308,6 +311,7 @@ def encode(
     b, t = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
     inv_freq = jnp.asarray(rope_frequencies(cfg.head_dim, theta=cfg.rope_theta, scaling=cfg.rope_scaling))
+    attn_mscale = rope_attention_factor(cfg.rope_scaling) ** 2
     x = params["embed"][tokens]  # [B, T, D]
 
     causal = jnp.tril(jnp.ones((t, t), bool))
@@ -323,6 +327,8 @@ def encode(
             qp, kp, vp = qp + lp["bq"], kp + lp["bk"], vp + lp["bv"]
         q = apply_rope(qp.reshape(b, t, cfg.num_heads, cfg.head_dim), positions, inv_freq)
         k = apply_rope(kp.reshape(b, t, cfg.num_kv_heads, cfg.head_dim), positions, inv_freq)
+        if attn_mscale != 1.0:  # YaRN temperature: logits scale by mscale^2
+            q = q * jnp.asarray(attn_mscale, q.dtype)
         v = vp.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
         q = q.reshape(b, t, cfg.num_kv_heads, groups, cfg.head_dim)
         scores = jnp.einsum("bqhgd,bkhd->bhgqk", q, k).astype(jnp.float32) * scale

@@ -66,6 +66,24 @@ def rope_frequencies(
     return inv_freq.astype(np.float32)
 
 
+def rope_attention_factor(scaling: dict | None) -> float:
+    """YaRN attention-temperature scaling (mscale).
+
+    YaRN scales the rotated q/k embeddings by ``0.1*ln(s) + 1`` (the paper's
+    ``sqrt(1/t)``), so attention logits grow by its square; HF exposes an
+    explicit ``attention_factor`` override. Models apply the square to q once
+    — equivalent to scaling both rotated tensors, one multiply cheaper.
+    Non-yarn scaling types don't temperature-correct (factor 1.0).
+    """
+    if not scaling or scaling.get("rope_type", scaling.get("type")) != "yarn":
+        return 1.0
+    explicit = scaling.get("attention_factor")
+    if explicit is not None:
+        return float(explicit)
+    factor = float(scaling.get("factor", 1.0))
+    return 0.1 * float(np.log(factor)) + 1.0 if factor > 1.0 else 1.0
+
+
 def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, inv_freq: jnp.ndarray) -> jnp.ndarray:
     """Rotate ``x`` [..., T, n_heads, head_dim] at absolute ``positions`` [..., T]."""
     angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., T, hd/2]

@@ -1,0 +1,159 @@
+"""Typed pipeline graph: compose Operators, split segments across the network.
+
+The reference models a request path as Source/Sink nodes linked by typed
+edges, with ``ServiceFrontend``/``ServiceBackend`` at the ends and
+``SegmentSource``/``SegmentSink`` where one logical pipeline is cut into
+network-separated halves (`lib/runtime/src/pipeline/nodes*.rs`,
+`pipeline.rs:43-120`). In this framework a node is an
+:class:`~dynamo_tpu.runtime.engine.AsyncEngine` and an edge is an async
+response stream, so the graph machinery reduces to three pieces:
+
+- :class:`Pipeline` — an ordered list of operator factories; ``build(backend)``
+  folds them right-to-left into one engine (the frontend), ``split(at)``
+  cuts the list into two pipelines deployable in different processes.
+- :class:`SegmentSink` — the head-side stand-in for the cut edge: an engine
+  whose downstream is attached later (a runtime Client, usually).
+- :func:`serve_segment` — the tail side: builds the remaining pipeline onto
+  the real backend and publishes it as an endpoint (the SegmentSource role).
+
+Per-request :class:`Context` flows through every operator (stop/kill
+propagate down the chain; see ``Operator.generate``), which is the
+reference's per-request context (`pipeline/context.rs`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, AsyncIterator, Callable
+
+from dynamo_tpu.runtime.engine import AsyncEngine, Context, Operator
+
+# An operator factory: downstream engine -> engine. Operator subclasses are
+# factories already (their __init__ takes the downstream engine).
+OperatorFactory = Callable[[AsyncEngine[Any, Any]], AsyncEngine[Any, Any]]
+
+
+class PipelineError(RuntimeError):
+    pass
+
+
+class SegmentSink(AsyncEngine[Any, Any]):
+    """The cut edge's head side: forwards to an engine attached at runtime.
+
+    ``attach`` is once-only (reference ``EdgeAlreadySet``); generating before
+    attachment fails loudly rather than hanging — a segment whose remote half
+    never came up must surface, not queue.
+    """
+
+    def __init__(self) -> None:
+        self._engine: AsyncEngine[Any, Any] | None = None
+
+    def attach(self, engine: AsyncEngine[Any, Any]) -> None:
+        if self._engine is not None:
+            raise PipelineError("segment edge already attached")
+        self._engine = engine
+
+    @property
+    def attached(self) -> bool:
+        return self._engine is not None
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        if self._engine is None:
+            raise PipelineError("segment edge not attached (remote half not connected)")
+        async for item in self._engine.generate(request, context):
+            yield item
+
+
+class _ClientEngine(AsyncEngine[Any, Any]):
+    """Adapts a runtime Client (watch + routing) to the engine interface."""
+
+    def __init__(self, client: Any, **call_kw: Any) -> None:
+        self.client = client
+        self.call_kw = call_kw
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        async for item in self.client.generate(request, context, **self.call_kw):
+            yield item
+
+
+class Pipeline:
+    """An ordered operator chain, frontend-most first.
+
+    ``Pipeline([A, B]).build(backend)`` produces ``A(B(backend))``: requests
+    enter A, responses stream back out of A.
+    """
+
+    def __init__(self, operators: list[OperatorFactory] | None = None) -> None:
+        self.operators: list[OperatorFactory] = list(operators or [])
+
+    def link(self, factory: OperatorFactory) -> "Pipeline":
+        """Append the next (deeper) stage; returns self for chaining."""
+        self.operators.append(factory)
+        return self
+
+    def build(self, backend: AsyncEngine[Any, Any]) -> AsyncEngine[Any, Any]:
+        engine = backend
+        for factory in reversed(self.operators):
+            engine = factory(engine)
+            if not isinstance(engine, AsyncEngine):
+                raise PipelineError(f"operator factory {factory!r} did not produce an AsyncEngine")
+        return engine
+
+    def split(self, at: int) -> tuple["Pipeline", "Pipeline", SegmentSink]:
+        """Cut into (head, tail) at operator index ``at``.
+
+        The returned :class:`SegmentSink` is the head's backend:
+        ``head.build(sink)``. Deploy the tail remotely with
+        :func:`serve_segment`, then ``sink.attach(segment_client(...))``.
+        """
+        if not 0 <= at <= len(self.operators):
+            raise PipelineError(f"split point {at} outside [0, {len(self.operators)}]")
+        return Pipeline(self.operators[:at]), Pipeline(self.operators[at:]), SegmentSink()
+
+
+async def serve_segment(
+    endpoint: Any,
+    pipeline: Pipeline,
+    backend: AsyncEngine[Any, Any],
+    *,
+    lease: Any | None = None,
+    metadata: dict[str, Any] | None = None,
+) -> Any:
+    """SegmentSource: publish the tail half as a network endpoint."""
+    return await endpoint.serve(pipeline.build(backend), lease=lease, metadata=metadata)
+
+
+def segment_client(client: Any, **call_kw: Any) -> AsyncEngine[Any, Any]:
+    """Engine view of a started runtime Client, for ``SegmentSink.attach``."""
+    return _ClientEngine(client, **call_kw)
+
+
+class FnOperator(Operator[Any, Any]):
+    """Operator from two plain functions (request map, item map) — the
+    lightweight way to drop a transform into a pipeline."""
+
+    def __init__(
+        self,
+        downstream: AsyncEngine[Any, Any],
+        *,
+        on_request: Callable[[Any], Any] | None = None,
+        on_item: Callable[[Any], Any] | None = None,
+    ) -> None:
+        super().__init__(downstream)
+        self._on_request = on_request
+        self._on_item = on_item
+
+    @classmethod
+    def factory(
+        cls,
+        *,
+        on_request: Callable[[Any], Any] | None = None,
+        on_item: Callable[[Any], Any] | None = None,
+    ) -> OperatorFactory:
+        return lambda downstream: cls(downstream, on_request=on_request, on_item=on_item)
+
+    async def transform_request(self, request: Any, context: Context) -> Any:
+        return self._on_request(request) if self._on_request else request
+
+    async def transform_stream(self, stream, request, context):
+        async for item in stream:
+            yield self._on_item(item) if self._on_item else item

@@ -15,7 +15,7 @@ import dataclasses
 import importlib
 from typing import Iterable
 
-from dynamo_tpu.sdk import Dependency, ServiceSpec, spec_of
+from dynamo_tpu.sdk import ServiceSpec, spec_of
 
 
 @dataclasses.dataclass
@@ -88,6 +88,3 @@ def load_graph(ref: str) -> Graph:
     except AttributeError:
         raise AttributeError(f"module {module_name!r} has no service {attr!r}") from None
     return build_graph(entry_cls)
-
-
-_DEPENDENCY = Dependency  # re-export for isinstance checks in serving

@@ -67,9 +67,15 @@ class Worker:
 class Processor:
     """Tokenize prompts in, detokenize token streams out."""
 
-    def __init__(self, model: str = "test-tiny", tokenizer: str = "byte") -> None:
+    def __init__(self, model: str = "test-tiny", tokenizer: str | None = None) -> None:
+        import os
+
         from dynamo_tpu.tokenizer import load_tokenizer
 
+        # Mirror the Worker's `model` key: a checkpoint dir / .gguf brings its
+        # own tokenizer; presets fall back to the hermetic byte tokenizer.
+        if tokenizer is None:
+            tokenizer = model if os.path.exists(model) else "byte"
         self.tokenizer = load_tokenizer(tokenizer)
 
     worker = depends(Worker)

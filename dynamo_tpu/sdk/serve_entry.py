@@ -34,7 +34,7 @@ async def amain(args: argparse.Namespace) -> None:
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
         loop.add_signal_handler(sig, stop.set)
-    handle = await serve_service(runtime, spec, _section_for(config, spec))
+    handle = await serve_service(runtime, spec, _section_for(config, spec), http_host=args.host)
     print(f"SERVING {spec.name} instances={len(handle.instances)}", flush=True)
     try:
         await stop.wait()

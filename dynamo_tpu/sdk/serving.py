@@ -24,6 +24,7 @@ import logging
 import os
 import pathlib
 import sys
+import time
 from typing import Any, AsyncIterator
 
 from dynamo_tpu.runtime.component import DistributedRuntime
@@ -59,26 +60,36 @@ def load_service_config(path: str | pathlib.Path | None, *, env: dict[str, str] 
         if not isinstance(data, dict):
             raise ValueError(f"service config {p} must be a mapping of service name -> section")
         sections = {str(k): dict(v or {}) for k, v in data.items()}
-    # DYN_SVC_WORKER_REPLICAS=2 -> sections["Worker"]["replicas"] = 2
+    # DYN_SVC_WORKER_REPLICAS=2 -> sections["Worker"]["replicas"] = 2. The
+    # service-name token is matched case-insensitively against existing
+    # sections at every underscore split (so DYN_SVC_KV_ROUTER_REPLICAS can
+    # target a KvRouter section); otherwise the first token becomes a new
+    # UPPERCASE section, which _section_for matches via spec.name.upper().
     for key, raw in env.items():
         if not key.startswith("DYN_SVC_"):
             continue
         rest = key[len("DYN_SVC_") :]
-        svc, _, field = rest.partition("_")
-        if not field:
+        parts = rest.split("_")
+        if len(parts) < 2:
             continue
         try:
             value: Any = json.loads(raw)
         except (json.JSONDecodeError, ValueError):
             value = raw
+        by_upper = {name.upper().replace("_", ""): name for name in sections}
         bucket = None
-        for name in sections:
-            if name.upper() == svc:
-                bucket = sections[name]
+        field = ""
+        for split in range(len(parts) - 1, 0, -1):
+            candidate = "".join(parts[:split])
+            if candidate in by_upper:
+                bucket = sections[by_upper[candidate]]
+                field = "_".join(parts[split:]).lower()
                 break
         if bucket is None:
-            bucket = sections.setdefault(svc.capitalize() if svc.capitalize() else svc, {})
-        bucket[field.lower()] = value
+            bucket = sections.setdefault(parts[0], {})
+            field = "_".join(parts[1:]).lower()
+        if field:
+            bucket[field] = value
     return sections
 
 
@@ -183,8 +194,14 @@ async def serve_service(
     section: dict[str, Any] | None = None,
     *,
     http_port: int | None = None,
+    http_host: str = "127.0.0.1",
 ) -> ServiceHandle:
-    """Construct + bind + publish one service on ``runtime``."""
+    """Construct + bind + publish one service on ``runtime``.
+
+    A configured ``http_port`` is offset by this process's replica index
+    (``DYN_SDK_REPLICA``), so ``replicas: 2`` with ``http_port: 8000`` binds
+    :8000 and :8001 instead of crash-looping on EADDRINUSE.
+    """
     section = dict(section or {})
     obj = _construct(spec, section)
     handle = ServiceHandle(spec, obj, runtime)
@@ -199,12 +216,14 @@ async def serve_service(
         handle.instances.append(await endpoint.serve(engine, lease=lease))
     if spec.apis:
         port = http_port if http_port is not None else int(section.get("http_port", 0))
+        if port > 0:
+            port += int(os.environ.get("DYN_SDK_REPLICA", "0") or 0)
         if port >= 0:
-            handle.http_site, handle.http_port = await _serve_apis(spec, obj, port)
+            handle.http_site, handle.http_port = await _serve_apis(spec, obj, port, host=http_host)
     return handle
 
 
-async def _serve_apis(spec: ServiceSpec, obj: Any, port: int):
+async def _serve_apis(spec: ServiceSpec, obj: Any, port: int, *, host: str = "127.0.0.1"):
     """Mount ``@api`` methods on an aiohttp app (dict -> JSON, async gen -> SSE)."""
     from aiohttp import web
 
@@ -243,9 +262,15 @@ async def _serve_apis(spec: ServiceSpec, obj: Any, port: int):
                         data = item if isinstance(item, str) else json.dumps(item)
                         await resp.write(f"data: {data}\n\n".encode())
                     await resp.write(b"data: [DONE]\n\n")
+                except (ConnectionResetError, ConnectionError):
+                    logger.debug("api %s: client disconnected mid-stream", api_spec.path)
+                    return resp
                 except Exception as exc:
                     logger.exception("api %s failed mid-stream", api_spec.path)
-                    await resp.write(f"data: {json.dumps({'error': str(exc)})}\n\n".encode())
+                    try:
+                        await resp.write(f"data: {json.dumps({'error': str(exc)})}\n\n".encode())
+                    except (ConnectionResetError, ConnectionError):
+                        return resp
                 await resp.write_eof()
                 return resp
             try:
@@ -263,10 +288,10 @@ async def _serve_apis(spec: ServiceSpec, obj: Any, port: int):
         app.router.add_route(api_spec.http_method, api_spec.path, make_handler(api_spec))
     runner = web.AppRunner(app)
     await runner.setup()
-    site = web.TCPSite(runner, "127.0.0.1", port)
+    site = web.TCPSite(runner, host, port)
     await site.start()
     actual = runner.addresses[0][1] if runner.addresses else port
-    logger.info("service %s api on http://127.0.0.1:%s", spec.name, actual)
+    logger.info("service %s api on http://%s:%s", spec.name, host, actual)
     return runner, actual
 
 
@@ -338,53 +363,73 @@ class ServeFleet:
         from dynamo_tpu.runtime.store_server import StoreServer
 
         self.store_server = await StoreServer(host=self.host, port=self.store_port).start()
+        self.store_port = self.store_server.port  # resolve an ephemeral request (port=0)
         for spec in graph.services:
             replicas = int(_section_for(config, spec).get("replicas", spec.replicas))
             for i in range(replicas):
-                self.procs.append((spec.name, self._spawn(spec.name, i)))
+                self.procs.append([spec.name, i, self._spawn(spec.name, i), time.monotonic(), 1.0])
         self._respawn_task = asyncio.create_task(self._supervise())
         return self
 
-    def _spawn(self, service: str, index: int):
+    def _spawn(self, service: str, replica: int):
         import subprocess
 
         cmd = [
             sys.executable, "-m", "dynamo_tpu.sdk.serve_entry",
             self.ref, "--service", service,
             "--store", f"tcp://{self.host}:{self.store_port}",
+            "--host", self.host,
         ]
         if self.config_path:
             cmd += ["-f", self.config_path]
         env = dict(os.environ)
-        env.setdefault("DYN_SDK_REPLICA", str(index))
-        logger.info("spawning %s[%d]: %s", service, index, " ".join(cmd))
+        env["DYN_SDK_REPLICA"] = str(replica)  # replica N of *this service*
+        logger.info("spawning %s[%d]: %s", service, replica, " ".join(cmd))
         return subprocess.Popen(cmd, env=env)
 
     async def _supervise(self) -> None:
-        """Respawn dead replicas (the circus-watcher role)."""
-        backoff = 1.0
+        """Respawn dead replicas (the circus-watcher role).
+
+        Per-replica exponential backoff: a replica that dies right after
+        spawning (bad config, port conflict) is retried at 1s, 2s, ... 30s
+        instead of fork-bombing at 1 Hz; a long-lived replica that crashes
+        resets to the fast path.
+        """
         while not self._closing:
-            await asyncio.sleep(backoff)
-            for i, (name, proc) in enumerate(self.procs):
-                if proc.poll() is not None and not self._closing:
-                    logger.warning("service %s exited rc=%s; respawning", name, proc.returncode)
-                    self.procs[i] = (name, self._spawn(name, i))
+            await asyncio.sleep(0.5)
+            now = time.monotonic()
+            for entry in self.procs:
+                name, replica, proc, spawned_at, backoff = entry
+                if proc.poll() is None or self._closing:
+                    continue
+                lived = now - spawned_at
+                if lived >= 10.0:
+                    backoff = 1.0  # it served for a while: crash, not a config bug
+                if now - spawned_at < backoff:
+                    continue  # still in this replica's backoff window
+                logger.warning(
+                    "service %s[%d] exited rc=%s after %.1fs; respawning (backoff %.0fs)",
+                    name, replica, proc.returncode, lived, backoff,
+                )
+                entry[2] = self._spawn(name, replica)
+                entry[3] = time.monotonic()
+                entry[4] = min(backoff * 2.0, 30.0)
 
     async def close(self) -> None:
         self._closing = True
         if self._respawn_task is not None:
             self._respawn_task.cancel()
-        for _name, proc in self.procs:
-            if proc.poll() is None:
-                proc.terminate()
+        for entry in self.procs:
+            if entry[2].poll() is None:
+                entry[2].terminate()
         loop = asyncio.get_running_loop()
 
         def wait_all() -> None:
-            for _name, proc in self.procs:
+            for entry in self.procs:
                 try:
-                    proc.wait(timeout=10)
+                    entry[2].wait(timeout=10)
                 except Exception:
-                    proc.kill()
+                    entry[2].kill()
 
         await loop.run_in_executor(None, wait_all)
         if self.store_server is not None:

@@ -144,6 +144,14 @@ def test_rope_scaling_linear_and_yarn():
     with pytest.raises(ValueError, match="unsupported rope scaling"):
         rope_frequencies(64, scaling={"rope_type": "longrope", "factor": 2.0})
 
+    from dynamo_tpu.ops.rope import rope_attention_factor
+
+    assert rope_attention_factor(None) == 1.0
+    assert rope_attention_factor({"rope_type": "llama3", "factor": 8.0}) == 1.0
+    yf = rope_attention_factor({"rope_type": "yarn", "factor": 4.0})
+    np.testing.assert_allclose(yf, 0.1 * np.log(4.0) + 1.0)
+    assert rope_attention_factor({"rope_type": "yarn", "factor": 4.0, "attention_factor": 1.5}) == 1.5
+
 
 def test_unblockable_quant_falls_back(tmp_path):
     path = tmp_path / "fb.gguf"
@@ -242,6 +250,20 @@ def test_rope_scaling_mapping(tmp_path):
         "original_max_position_embeddings": 8192,
         "low_freq_factor": 1.0, "high_freq_factor": 4.0,
     }
+
+
+def test_yarn_scaling_survives_export_roundtrip(tmp_path):
+    scaling = {"rope_type": "yarn", "factor": 4.0, "low_freq_factor": 1.0,
+               "high_freq_factor": 4.0, "original_max_position_embeddings": 8192,
+               "attention_factor": 1.5, "beta_fast": 24.0, "beta_slow": 2.0}
+    cfg = dataclasses.replace(PRESETS["test-tiny"], rope_scaling=scaling)
+    params = llama.init_params(cfg, 18)
+    path = tmp_path / "yarn.gguf"
+    save_params_gguf(path, cfg, params)
+    r = GGUFReader(path)
+    cfg2 = config_from_gguf(r, name=cfg.name)
+    r.close()
+    assert cfg2.rope_scaling == scaling
 
 
 def test_rope_scaling_survives_export_roundtrip(tmp_path):

@@ -1,0 +1,109 @@
+"""Pipeline graph: operator composition, segment split across the runtime,
+context propagation through a cut edge.
+
+The split test is the reference's SegmentSource/SegmentSink scenario
+(`pipeline/nodes/sinks/segment.rs`): one logical pipeline, head in the
+"frontend process", tail served as an endpoint, identical behavior to the
+unsplit build.
+"""
+
+import asyncio
+from typing import Any, AsyncIterator
+
+import pytest
+
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.engine import AsyncEngine, Context, collect
+from dynamo_tpu.runtime.pipeline import (
+    FnOperator,
+    Pipeline,
+    PipelineError,
+    SegmentSink,
+    segment_client,
+    serve_segment,
+)
+
+
+class EchoBackend(AsyncEngine[Any, Any]):
+    """Streams each item of the request list, observing the context."""
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        for item in request["items"]:
+            if context.is_stopped or context.is_killed:
+                return
+            await asyncio.sleep(0)
+            yield {"value": item}
+
+
+def double_req(req):
+    return {"items": [x * 2 for x in req["items"]]}
+
+
+def add_tag(item):
+    return {**item, "tag": True}
+
+
+async def test_build_composes_in_order():
+    pipe = Pipeline().link(FnOperator.factory(on_request=double_req)).link(
+        FnOperator.factory(on_item=add_tag)
+    )
+    engine = pipe.build(EchoBackend())
+    out = await collect(engine.generate({"items": [1, 2, 3]}, Context()))
+    assert out == [{"value": 2, "tag": True}, {"value": 4, "tag": True}, {"value": 6, "tag": True}]
+
+
+async def test_split_equivalence_over_network():
+    pipe = Pipeline(
+        [FnOperator.factory(on_request=double_req), FnOperator.factory(on_item=add_tag)]
+    )
+    whole = pipe.build(EchoBackend())
+    expect = await collect(whole.generate({"items": [5, 7]}, Context()))
+
+    head, tail, sink = pipe.split(1)
+    runtime = DistributedRuntime.detached()
+    try:
+        ep = runtime.namespace("t").component("seg").endpoint("run")
+        await serve_segment(ep, tail, EchoBackend())
+        client = await ep.client().start()
+        sink.attach(segment_client(client))
+        front = head.build(sink)
+        got = await collect(front.generate({"items": [5, 7]}, Context()))
+        assert got == expect
+        await client.close()
+    finally:
+        await runtime.close()
+
+
+async def test_sink_unattached_fails_loudly():
+    _head, _tail, sink = Pipeline([FnOperator.factory()]).split(1)
+    with pytest.raises(PipelineError, match="not attached"):
+        await collect(sink.generate({}, Context()))
+    sink.attach(EchoBackend())
+    with pytest.raises(PipelineError, match="already attached"):
+        sink.attach(EchoBackend())
+
+
+async def test_split_bounds_checked():
+    with pytest.raises(PipelineError, match="split point"):
+        Pipeline([FnOperator.factory()]).split(5)
+
+
+async def test_stop_propagates_through_segment():
+    runtime = DistributedRuntime.detached()
+    try:
+        ep = runtime.namespace("t").component("seg2").endpoint("run")
+        await serve_segment(ep, Pipeline(), EchoBackend())
+        client = await ep.client().start()
+        sink = SegmentSink()
+        sink.attach(segment_client(client))
+        ctx = Context()
+        stream = sink.generate({"items": list(range(1000))}, ctx)
+        got = []
+        async for item in stream:
+            got.append(item)
+            if len(got) == 3:
+                ctx.stop_generating()
+        assert 3 <= len(got) < 1000
+        await client.close()
+    finally:
+        await runtime.close()

@@ -106,6 +106,13 @@ def test_config_cascade(tmp_path):
     assert merged["Worker"]["num_pages"] == 64
     assert merged["Worker"]["replicas"] == 2
     assert merged["Frontend"]["http_port"] == 8123
+    # CamelCase / underscored service names still match at underscore splits
+    merged2 = load_service_config(None, env={"DYN_SVC_KV_ROUTER_REPLICAS": "3"})
+    assert merged2["KV"]["router_replicas"] == 3  # no section: first-token bucket
+    cfg2 = tmp_path / "svc2.yaml"
+    cfg2.write_text("KvRouter: {}\n")
+    merged3 = load_service_config(cfg2, env={"DYN_SVC_KV_ROUTER_REPLICAS": "3"})
+    assert merged3["KvRouter"]["replicas"] == 3
 
 
 async def test_serve_graph_in_process():
@@ -154,14 +161,6 @@ async def test_llm_graph_end_to_end_mock():
         assert events[-1].get("finish_reason")
     finally:
         await handles.close()
-
-
-def _free_port() -> int:
-    import socket
-
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
 
 
 async def test_serve_fleet_subprocesses(tmp_path):
