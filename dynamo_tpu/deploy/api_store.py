@@ -82,6 +82,9 @@ class ApiStore:
         dep = await self._load(request.match_info["name"])
         if dep is None:
             return web.json_response({"error": "not found"}, status=404)
+        if dep.phase == DeploymentPhase.DELETING.value:
+            # A PUT must not cancel/resurrect an acknowledged deletion.
+            return web.json_response({"error": "deployment is being deleted"}, status=409)
         changed = False
         if "graph" in body and body["graph"] != dep.graph:
             dep.graph = str(body["graph"])
@@ -94,6 +97,10 @@ class ApiStore:
         if changed:
             dep.generation += 1
             dep.phase = DeploymentPhase.PENDING.value
+        # Best-effort existence re-check: if the operator finalized a delete
+        # between our load and now, don't resurrect the record.
+        if await self.store.get(dep.key) is None:
+            return web.json_response({"error": "not found"}, status=404)
         await self.store.put(dep.key, dep.to_bytes())
         return web.json_response(self._view(dep))
 

@@ -153,6 +153,7 @@ def render_deployment(
                 "python", "-m", "dynamo_tpu.sdk.serve_entry",
                 dep.graph, "--service", spec.name,
                 "--store", store_addr,
+                "--host", "0.0.0.0",  # cross-pod: bind + advertise non-loopback
                 "-f", "/etc/dynamo/services.json",
             ],
             "volumeMounts": [{"name": "config", "mountPath": "/etc/dynamo"}],

@@ -84,6 +84,11 @@ class ProcessBackend:
             counts[spec.name] = int(_section_for(dep.config, spec).get("replicas", spec.replicas))
         return counts
 
+    def has(self, name: str) -> bool:
+        """Whether this backend currently holds the deployment's workload
+        (lets a restarted operator detect RUNNING records with no fleet)."""
+        return name in self.fleets
+
     def _drop_cfg(self, name: str) -> None:
         import os
 
@@ -165,9 +170,18 @@ class Operator:
                 logger.info("deployment %s finalized", dep.name)
                 self.reconciled.set()
                 return
-            if dep.observed_generation == dep.generation and dep.phase == DeploymentPhase.RUNNING.value:
+            has = getattr(self.backend, "has", None)
+            workload_live = has(dep.name) if has is not None else True
+            if (
+                dep.observed_generation == dep.generation
+                and dep.phase == DeploymentPhase.RUNNING.value
+                and (workload_live or not force)
+            ):
+                # Status echo / converged resync. On a *forced* pass a
+                # RUNNING record whose workload the backend doesn't hold
+                # (operator restart) falls through and re-creates it.
                 self.reconciled.set()
-                return  # status echo or already-converged resync
+                return
             if (
                 dep.observed_generation == dep.generation
                 and dep.phase == DeploymentPhase.FAILED.value

@@ -37,6 +37,7 @@ from dynamo_tpu.protocols.common import EngineOutput, FinishReason, Preprocessed
 from dynamo_tpu.protocols.kv import ForwardPassMetrics, KvCacheEvent
 from dynamo_tpu.runtime.engine import Context
 from dynamo_tpu.tokens import DEFAULT_SALT
+from dynamo_tpu.tracing import annotate
 
 logger = logging.getLogger(__name__)
 
@@ -195,9 +196,11 @@ class EngineCore:
             return out
         prefill = self._schedule_prefill()
         if prefill:
-            out = cancelled + self._run_prefill(prefill)
+            with annotate("engine.prefill"):
+                out = cancelled + self._run_prefill(prefill)
         elif self.running:
-            out = cancelled + self._run_decode()
+            with annotate("engine.decode"):
+                out = cancelled + self._run_decode()
         else:
             out = cancelled + self._drain_inflight()
         if not self.defer_offloads:

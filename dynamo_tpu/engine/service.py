@@ -206,14 +206,29 @@ class JaxEngineService(AsyncEngine[Any, dict]):
             out_q.put_nowait(EngineOutput(token_ids=[], finish_reason=FinishReason.ERROR))
             out_q.put_nowait(_SENTINEL)
         finished = False
+        from dynamo_tpu.tracing import Span
+
+        span = Span(
+            "request", request_id=request.request_id, prompt_tokens=len(request.token_ids)
+        )
+        span.__enter__()
+        tokens_out = 0
+        saw_finish = False
         try:
             while True:
                 item = await out_q.get()
                 if item is _SENTINEL:
                     finished = True
                     return
+                tokens_out += len(item.token_ids)
+                saw_finish = saw_finish or item.finish_reason is not None
                 yield item.to_dict()
         finally:
+            span.fields["output_tokens"] = tokens_out
+            # A consumer may stop at the finish item without draining the
+            # sentinel — that's still a completed request for the span.
+            span.fields["finished"] = finished or saw_finish
+            span.__exit__(None, None, None)
             if not finished:
                 # Consumer walked away (generator closed / task cancelled):
                 # stop the sequence so it doesn't decode to max_tokens.

@@ -66,6 +66,7 @@ class HttpService:
                 web.get("/live", self.live),
                 web.get("/metrics", self.prometheus),
                 web.post("/clear_kv_blocks", self.clear_kv_blocks),
+                web.post("/engine/profile", self.engine_profile),
             ]
         )
 
@@ -304,6 +305,26 @@ class HttpService:
 
     async def prometheus(self, request: web.Request) -> web.Response:
         return web.Response(body=self.metrics.render(), content_type="text/plain")
+
+    async def engine_profile(self, request: web.Request) -> web.Response:
+        """On-demand device trace: POST {"seconds": 3, "dir": "/tmp/trace"}.
+
+        Captures an XPlane trace of this process's JAX work (meaningful when
+        the engine runs in-process, `launch.run_local`); view with
+        TensorBoard/xprof. Parity: A1 tracing hook (reference exposes engine
+        profilers through its debug surface)."""
+        from dynamo_tpu.tracing import profile_for, trace_running
+
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        seconds = min(float(body.get("seconds", 3.0)), 60.0)
+        log_dir = str(body.get("dir", "/tmp/dynamo-trace"))
+        if trace_running():
+            return web.json_response({"error": "trace already running"}, status=409)
+        path = await profile_for(seconds, log_dir)
+        return web.json_response({"trace_dir": path, "seconds": seconds})
 
     async def clear_kv_blocks(self, request: web.Request) -> web.Response:
         if self.clear_kv_hook is None:

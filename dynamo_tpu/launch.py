@@ -141,6 +141,9 @@ def make_worker_spec(model: str, **engine_kw: Any) -> WorkerSpec:
 
 
 async def build_engine_service(spec: WorkerSpec, *, on_kv_event=None) -> JaxEngineService:
+    from dynamo_tpu.tracing import maybe_trace_from_env
+
+    maybe_trace_from_env()  # DYN_TRACE_DIR=dir captures worker bring-up + first steps
     if spec.mock:
         from dynamo_tpu.mocker import build_mock_core
 

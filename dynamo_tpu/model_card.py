@@ -15,9 +15,12 @@ card inlines the chat template and names a tokenizer source (shared path or
 from __future__ import annotations
 
 import json
+import logging
 import pathlib
 from dataclasses import dataclass, field
 from typing import Any
+
+logger = logging.getLogger(__name__)
 
 MODEL_PREFIX = "models"
 
@@ -90,6 +93,8 @@ class ModelDeploymentCard:
                 kw["bos_token_id"] = cfg["bos_token_id"]
         if (p / "tokenizer.json").exists():
             kw["tokenizer"] = str(p / "tokenizer.json")
+        elif (p / "tokenizer.model").exists():  # SentencePiece-only checkpoint
+            kw["tokenizer"] = str(p / "tokenizer.model")
         tc_file = p / "tokenizer_config.json"
         if tc_file.exists():
             tc = json.loads(tc_file.read_text())
@@ -97,6 +102,42 @@ class ModelDeploymentCard:
                 kw["chat_template"] = tc["chat_template"]
         kw.update(overrides)
         return cls(**kw)
+
+    async def move_to_store(self, objects: Any) -> "ModelDeploymentCard":
+        """Upload file artifacts to the object store, rewriting paths to
+        ``object://`` URLs — after this the card is fully portable: any
+        worker joined to the deployment store can serve it.
+
+        Parity: reference ``move_to_nats`` (`model_card/model.rs:230-326`).
+        """
+        tok = self.tokenizer
+        if tok and tok not in ("byte",) and not str(tok).startswith("object://"):
+            p = pathlib.Path(tok)
+            if p.is_dir():
+                # A model dir: ship the tokenizer artifact, not the weights.
+                for candidate in ("tokenizer.json", "tokenizer.model"):
+                    if (p / candidate).exists():
+                        p = p / candidate
+                        break
+            if p.is_file() and p.suffix != ".gguf":
+                self.tokenizer = await objects.put_file(f"cards/{self.name}/{p.name}", p)
+            elif p.suffix == ".gguf":
+                # The GGUF *is* the checkpoint — workers resolve it from the
+                # model path (shared storage), not the artifact plane.
+                logger.debug("card %s: leaving GGUF tokenizer path as-is", self.name)
+        return self
+
+    async def resolve_from_store(self, objects: Any, cache_dir: str | pathlib.Path) -> "ModelDeploymentCard":
+        """Materialize ``object://`` artifacts into ``cache_dir`` and point
+        the card back at local files (worker-side ``move_from_nats``)."""
+        from dynamo_tpu.runtime.objects import is_object_url, object_name
+
+        if is_object_url(self.tokenizer):
+            name = object_name(self.tokenizer)
+            local = pathlib.Path(cache_dir) / name
+            await objects.get_to_file(name, local)
+            self.tokenizer = str(local)
+        return self
 
     @classmethod
     def from_gguf(cls, name: str, path: str | pathlib.Path, *, reader: Any | None = None, **overrides: Any) -> "ModelDeploymentCard":

@@ -1,0 +1,123 @@
+"""Object store: chunked, checksummed blobs over the KeyValueStore.
+
+The reference distributes model-card artifacts (tokenizer files, prompt
+templates) through the NATS object store (`model_card/model.rs:230-326`
+``move_to_nats``/``move_from_nats``). Here the same role rides the
+deployment's existing KeyValueStore: an object is a metadata record plus
+fixed-size chunk entries, so any worker joined to the store can fetch a
+card's artifacts without shared filesystems. Chunking keeps single values
+within the TCP store codec's comfort zone; a sha256 in the metadata makes
+partial/overwritten uploads detectable at read time.
+
+URLs: ``object://<name>`` — `ModelDeploymentCard.move_to_store` rewrites
+artifact paths to these, `resolve_from_store` materializes them back to
+local files (worker-side cache dir).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import pathlib
+from typing import Any
+
+from dynamo_tpu.runtime.discovery import KeyValueStore
+
+logger = logging.getLogger(__name__)
+
+OBJECT_PREFIX = "objects/"
+DEFAULT_CHUNK = 256 * 1024
+URL_SCHEME = "object://"
+
+
+class ObjectError(RuntimeError):
+    pass
+
+
+class ObjectStore:
+    def __init__(self, store: KeyValueStore, *, chunk_size: int = DEFAULT_CHUNK) -> None:
+        self.store = store
+        self.chunk_size = chunk_size
+
+    @staticmethod
+    def _meta_key(name: str) -> str:
+        return f"{OBJECT_PREFIX}{name}/meta"
+
+    @staticmethod
+    def _chunk_key(name: str, i: int) -> str:
+        return f"{OBJECT_PREFIX}{name}/chunk/{i:08d}"
+
+    async def put(self, name: str, data: bytes, *, metadata: dict[str, Any] | None = None) -> str:
+        """Store ``data``; returns the object URL. Overwrites atomically
+        enough for this plane: meta is written last, so readers either see
+        the old complete object or the new one (chunk counts validated)."""
+        digest = hashlib.sha256(data).hexdigest()
+        n_chunks = max(1, -(-len(data) // self.chunk_size))
+        old_meta = await self.stat(name)
+        for i in range(n_chunks):
+            chunk = data[i * self.chunk_size : (i + 1) * self.chunk_size]
+            await self.store.put(self._chunk_key(name, i), chunk)
+        meta = {
+            "size": len(data),
+            "sha256": digest,
+            "chunks": n_chunks,
+            "chunk_size": self.chunk_size,
+            **({"metadata": metadata} if metadata else {}),
+        }
+        await self.store.put(self._meta_key(name), json.dumps(meta).encode())
+        # An overwrite with fewer chunks would otherwise orphan the old tail.
+        if old_meta is not None:
+            for i in range(n_chunks, int(old_meta.get("chunks", 0))):
+                await self.store.delete(self._chunk_key(name, i))
+        logger.info("object %s stored (%d bytes, %d chunks)", name, len(data), n_chunks)
+        return URL_SCHEME + name
+
+    async def get(self, name: str) -> bytes:
+        raw_meta = await self.store.get(self._meta_key(name))
+        if raw_meta is None:
+            raise ObjectError(f"object {name!r} not found")
+        meta = json.loads(raw_meta)
+        parts: list[bytes] = []
+        for i in range(int(meta["chunks"])):
+            chunk = await self.store.get(self._chunk_key(name, i))
+            if chunk is None:
+                raise ObjectError(f"object {name!r} missing chunk {i} (partial upload?)")
+            parts.append(chunk)
+        data = b"".join(parts)[: int(meta["size"])]
+        digest = hashlib.sha256(data).hexdigest()
+        if digest != meta["sha256"]:
+            raise ObjectError(f"object {name!r} checksum mismatch (concurrent overwrite?)")
+        return data
+
+    async def stat(self, name: str) -> dict[str, Any] | None:
+        raw = await self.store.get(self._meta_key(name))
+        return json.loads(raw) if raw is not None else None
+
+    async def delete(self, name: str) -> bool:
+        meta = await self.stat(name)
+        if meta is None:
+            return False
+        await self.store.delete(self._meta_key(name))
+        for i in range(int(meta["chunks"])):
+            await self.store.delete(self._chunk_key(name, i))
+        return True
+
+    async def put_file(self, name: str, path: str | pathlib.Path) -> str:
+        return await self.put(name, pathlib.Path(path).read_bytes())
+
+    async def get_to_file(self, name: str, path: str | pathlib.Path) -> pathlib.Path:
+        p = pathlib.Path(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_bytes(await self.get(name))
+        return p
+
+
+def is_object_url(value: str | None) -> bool:
+    return bool(value) and str(value).startswith(URL_SCHEME)
+
+
+def object_name(url: str) -> str:
+    if not is_object_url(url):
+        raise ObjectError(f"not an object url: {url!r}")
+    return url[len(URL_SCHEME) :]

@@ -95,7 +95,17 @@ def load_tokenizer(spec: str | pathlib.Path) -> BaseTokenizer:
         return ByteTokenizer()
     p = pathlib.Path(spec)
     if p.is_dir():
-        p = p / "tokenizer.json"
+        # Prefer the fast-tokenizer artifact; fall back to SentencePiece.
+        if (p / "tokenizer.json").exists():
+            p = p / "tokenizer.json"
+        elif (p / "tokenizer.model").exists():
+            p = p / "tokenizer.model"
+        else:
+            p = p / "tokenizer.json"
+    if p.suffix == ".model" and p.exists():
+        from dynamo_tpu.sentencepiece import load_sentencepiece
+
+        return load_sentencepiece(p)
     if p.suffix == ".gguf" and p.exists():
         from dynamo_tpu.models.gguf import shared_reader, tokenizer_from_gguf
 

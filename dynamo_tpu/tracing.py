@@ -1,0 +1,144 @@
+"""Tracing & profiling: JAX device traces (XPlane) + request-level spans.
+
+Two complementary planes, mirroring the reference's tracing stack
+(`logging.rs` tracing-subscriber spans + per-engine profilers):
+
+- **Device**: :func:`device_trace` wraps `jax.profiler.start_trace` — dumps
+  an XPlane/TensorBoard trace of everything the chip executed (XLA op
+  timeline, HBM transfers, fusion view). ``annotate()`` adds named host-side
+  regions (engine phases) to the same timeline via TraceAnnotation.
+  Enable on any process with ``DYN_TRACE_DIR=/tmp/trace`` (traces the first
+  ``DYN_TRACE_SECONDS``, default 5), or on demand over HTTP:
+  ``POST /engine/profile {"seconds": 3}`` on the frontend.
+- **Request spans**: :class:`Span` measures one phase of one request and
+  logs it as a structured JSONL record (``runtime/logging.py`` flattens the
+  fields), giving grep-able per-request latency breakdowns without a
+  collector service.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import threading
+import time
+from typing import Any, Iterator
+
+logger = logging.getLogger("dynamo.trace")
+
+_lock = threading.Lock()
+_active_dir: str | None = None
+
+
+def trace_running() -> bool:
+    return _active_dir is not None
+
+
+def start_device_trace(log_dir: str) -> bool:
+    """Begin an XPlane trace (idempotent; one at a time per process)."""
+    global _active_dir
+    import jax
+
+    with _lock:
+        if _active_dir is not None:
+            return False
+        jax.profiler.start_trace(log_dir)
+        _active_dir = log_dir
+    logger.info("device trace started -> %s", log_dir)
+    return True
+
+
+def stop_device_trace() -> str | None:
+    global _active_dir
+    import jax
+
+    with _lock:
+        if _active_dir is None:
+            return None
+        jax.profiler.stop_trace()
+        path, _active_dir = _active_dir, None
+    logger.info("device trace written -> %s", path)
+    return path
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str) -> Iterator[None]:
+    started = start_device_trace(log_dir)
+    try:
+        yield
+    finally:
+        if started:
+            stop_device_trace()
+
+
+def annotate(name: str):
+    """Named region on the profiler timeline.
+
+    A no-op context when no trace is active — callers can sit on hot paths
+    (the engine step loop) without paying TraceAnnotation construction."""
+    if _active_dir is None:
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+async def profile_for(seconds: float, log_dir: str) -> str | None:
+    """Trace the next ``seconds`` of device work (the HTTP hook's body)."""
+    import asyncio
+
+    if not start_device_trace(log_dir):
+        return None
+    try:
+        await asyncio.sleep(seconds)
+    finally:
+        path = stop_device_trace()  # stop even on cancellation, then propagate
+    return path
+
+
+def maybe_trace_from_env() -> None:
+    """Start a bounded trace when DYN_TRACE_DIR is set (worker bring-up)."""
+    log_dir = os.environ.get("DYN_TRACE_DIR")
+    if not log_dir:
+        return
+    seconds = float(os.environ.get("DYN_TRACE_SECONDS", "5"))
+    if not start_device_trace(log_dir):
+        return
+
+    def stop_later() -> None:
+        time.sleep(seconds)
+        stop_device_trace()
+
+    threading.Thread(target=stop_later, name="dyn-trace-stop", daemon=True).start()
+
+
+class Span:
+    """One timed phase of one request, logged as structured JSONL.
+
+    >>> with Span("prefill", request_id=rid, tokens=len(ids)):
+    ...     ...
+
+    Logs ``{"span": "prefill", "duration_ms": 12.3, "request_id": ..., ...}``
+    at DEBUG (set ``DYN_LOG_LEVEL=DEBUG`` + ``DYN_LOGGING_JSONL=1`` to
+    collect); exceptions mark the span failed and propagate.
+    """
+
+    __slots__ = ("name", "fields", "t0")
+
+    def __init__(self, name: str, **fields: Any) -> None:
+        self.name = name
+        self.fields = fields
+
+    def __enter__(self) -> "Span":
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, _exc, _tb) -> None:
+        ms = (time.perf_counter() - self.t0) * 1e3
+        extra = {"span": self.name, "duration_ms": round(ms, 3), **self.fields}
+        if exc_type is not None:
+            extra["error"] = exc_type.__name__
+            logger.warning("span %s failed after %.1fms", self.name, ms, extra=extra)
+        else:
+            logger.debug("span %s %.1fms", self.name, ms, extra=extra)

@@ -151,6 +151,43 @@ async def test_operator_failure_surfaces_in_status():
         await op.close()
 
 
+async def test_update_during_delete_rejected():
+    store = MemoryStore()
+    api = await ApiStore(store).start()
+    try:
+        base = f"http://127.0.0.1:{api.port}/api/v1/deployments"
+        async with aiohttp.ClientSession() as s:
+            await s.post(base, json={"name": "d", "graph": "m:S"})
+            await s.delete(base + "/d")
+            r = await s.put(base + "/d", json={"graph": "m:T"})
+            assert r.status == 409
+    finally:
+        await api.close()
+
+
+async def test_operator_restart_recreates_running_fleet():
+    """A RUNNING record whose workload the (new) backend doesn't hold must be
+    re-applied on the start/resync pass — the operator-restart case."""
+
+    class TrackingBackend(FakeBackend):
+        def has(self, name):
+            return any(d.name == name for d in self.applied)
+
+    store = MemoryStore()
+    dep = GraphDeployment(name="old", graph="m:S", phase="running", observed_generation=1)
+    await store.put(dep.key, dep.to_bytes())
+    backend = TrackingBackend()
+    op = await Operator(store, backend, resync_seconds=999).start()
+    try:
+        await _wait(op, lambda: _is(store, "old", phase="running"))
+        assert len(backend.applied) == 1  # re-created despite RUNNING status
+        # …and the status echo does not apply again (has() now True)
+        await asyncio.sleep(0.3)
+        assert len(backend.applied) == 1
+    finally:
+        await op.close()
+
+
 async def test_api_store_to_operator_integration():
     """REST create -> watch -> reconcile -> status visible over REST."""
     store = MemoryStore()
