@@ -26,7 +26,7 @@ from typing import Callable
 import numpy as np
 
 from dynamo_tpu.blocks.storage import DiskStorage, HostStorage, NullStorage, Payload
-from dynamo_tpu.blocks.tier import TierPool
+from dynamo_tpu.blocks.tier import SharedTierPool, TierPool
 
 logger = logging.getLogger(__name__)
 
@@ -39,29 +39,60 @@ class BlockManagerConfig:
     g2_capacity_blocks: int = 1024
     g3_capacity_blocks: int = 0  # 0 disables the disk tier
     g3_path: str | pathlib.Path = "/tmp/dynamo_tpu_g3"
+    g4_capacity_blocks: int = 0  # 0 disables the remote (object-store) tier
     onboard_limit: int = 64  # max blocks copied back per admission
     null_storage: bool = False  # CI: capacity logic without payload memory
 
 
 class KvBlockManager:
-    def __init__(self, config: BlockManagerConfig, *, read_page: ReadPage, write_page: WritePage) -> None:
+    def __init__(
+        self,
+        config: BlockManagerConfig,
+        *,
+        read_page: ReadPage,
+        write_page: WritePage,
+        g4_storage=None,
+    ) -> None:
         self.config = config
         self._read_page = read_page
         self._write_page = write_page
 
+        # G4: deployment-wide remote tier (object store). Pass a
+        # `storage.RemoteStorage` (launch wires it from the runtime store);
+        # capacity without a backend runs metadata-only (CI). SharedTierPool:
+        # local LRU over our own writes, fall-through probes for peers'.
+        self.g4: TierPool | None = None
+        if config.g4_capacity_blocks > 0:
+            self.g4 = SharedTierPool("g4", g4_storage or NullStorage(), config.g4_capacity_blocks)
+
         self.g3: TierPool | None = None
         if config.g3_capacity_blocks > 0:
             g3_storage = NullStorage() if config.null_storage else DiskStorage(config.g3_path)
-            self.g3 = TierPool("g3", g3_storage, config.g3_capacity_blocks)
+
+            def cascade_g4(block_hash: int, payload: Payload | None) -> None:
+                if self.g4 is not None and payload is not None:
+                    self.g4.put(block_hash, payload)
+
+            self.g3 = TierPool(
+                "g3", g3_storage, config.g3_capacity_blocks, on_evict=cascade_g4
+            )
 
         def cascade(block_hash: int, payload: Payload | None) -> None:
-            if self.g3 is not None and payload is not None:
+            if payload is None:
+                return
+            if self.g3 is not None:
                 self.g3.put(block_hash, payload)
+            elif self.g4 is not None:  # no disk tier: spill host -> remote
+                self.g4.put(block_hash, payload)
 
         g2_storage = NullStorage() if config.null_storage else HostStorage()
         self.g2 = TierPool("g2", g2_storage, config.g2_capacity_blocks, on_evict=cascade)
         self.offloaded = 0
         self.onboarded = 0
+
+    @property
+    def _tiers(self) -> list[TierPool]:
+        return [t for t in (self.g2, self.g3, self.g4) if t is not None]
 
     # -- offload path ------------------------------------------------------
 
@@ -79,9 +110,7 @@ class KvBlockManager:
         todo: list[tuple[int, int]] = []
         seen: set[int] = set()
         for block_hash, page_id in items:
-            if block_hash in seen or block_hash in self.g2:
-                continue
-            if self.g3 is not None and block_hash in self.g3:
+            if block_hash in seen or any(block_hash in tier for tier in self._tiers):
                 continue
             seen.add(block_hash)
             todo.append((block_hash, page_id))
@@ -98,12 +127,14 @@ class KvBlockManager:
     # -- onboard path ------------------------------------------------------
 
     def lookup(self, block_hash: int) -> Payload | None:
-        """G2 first, then G3 (promoting a G3 hit back into G2)."""
+        """G2 first, then G3, then G4 (a deeper hit promotes back into G2)."""
         payload = self.g2.get(block_hash)
         if payload is not None:
             return payload
-        if self.g3 is not None:
-            payload = self.g3.get(block_hash)
+        for tier in (self.g3, self.g4):
+            if tier is None:
+                continue
+            payload = tier.get(block_hash)
             if payload is not None:
                 self.g2.put(block_hash, payload)
                 return payload
@@ -120,7 +151,7 @@ class KvBlockManager:
         for h in block_hashes[start:]:
             if n >= self.config.onboard_limit:
                 break
-            if h in self.g2 or (self.g3 is not None and h in self.g3):
+            if any(h in tier for tier in self._tiers):
                 n += 1
             else:
                 break
@@ -146,13 +177,12 @@ class KvBlockManager:
     # -- admin -------------------------------------------------------------
 
     def clear(self) -> int:
-        n = self.g2.clear()
-        if self.g3 is not None:
-            n += self.g3.clear()
-        return n
+        return sum(tier.clear() for tier in self._tiers)
 
     def stats(self) -> dict:
         out = {"g2": self.g2.stats().__dict__, "offloaded": self.offloaded, "onboarded": self.onboarded}
         if self.g3 is not None:
             out["g3"] = self.g3.stats().__dict__
+        if self.g4 is not None:
+            out["g4"] = self.g4.stats().__dict__
         return out

@@ -72,7 +72,10 @@ class TierPool:
                 victim = h
                 break
         self._lru.pop(victim)
-        payload = self.storage.read(victim)
+        # Only fetch the payload when someone will receive it — for a
+        # terminal tier (no cascade) the read would be a pure waste, and on
+        # a remote backend a full round-trip per eviction.
+        payload = self.storage.read(victim) if self.on_evict is not None else None
         self.storage.delete(victim)
         self._evictions += 1
         if self.on_evict is not None:
@@ -103,4 +106,38 @@ class TierPool:
         return n
 
     def stats(self) -> TierStats:
-        return TierStats(self.capacity, len(self._lru), self._hits, self._misses, self._evictions)
+        return TierStats(
+            capacity=self.capacity,
+            used=len(self._lru),
+            hits=self._hits,
+            misses=self._misses,
+            evictions=self._evictions,
+        )
+
+
+class SharedTierPool(TierPool):
+    """A tier whose backend is shared between workers (the G4 object store).
+
+    Local LRU state tracks only **this worker's own writes** (capacity
+    applies to what we put there); membership and reads additionally fall
+    through to the backend, so blocks offloaded by *other* workers are
+    discoverable and onboardable. Semantics are a best-effort shared cache:
+    a peer enforcing its own capacity may delete a block between our probe
+    and fetch — readers must (and do) treat a None payload as a miss.
+    """
+
+    def __contains__(self, block_hash: int) -> bool:
+        if super().__contains__(block_hash):
+            return True
+        exists = getattr(self.storage, "exists", None)
+        return bool(exists(block_hash)) if exists is not None else False
+
+    def get(self, block_hash: int) -> Payload | None:
+        if super().__contains__(block_hash):
+            return super().get(block_hash)
+        payload = self.storage.read(block_hash)  # a peer's block
+        if payload is None:
+            self._misses += 1
+            return None
+        self._hits += 1
+        return payload

@@ -19,6 +19,7 @@ graphs/deployments).
 
 from __future__ import annotations
 
+import asyncio
 import logging
 from typing import Any
 
@@ -36,6 +37,9 @@ class ApiStore:
         self.host = host
         self.port = port
         self._runner: web.AppRunner | None = None
+        # Serializes read-modify-write mutations so a PUT interleaving a
+        # DELETE can't overwrite the DELETING phase with a stale copy.
+        self._mutate = asyncio.Lock()
 
     # -- handlers ----------------------------------------------------------
 
@@ -49,9 +53,10 @@ class ApiStore:
             config=dict(body.get("config", {})),
             labels={str(k): str(v) for k, v in dict(body.get("labels", {})).items()},
         )
-        if await self.store.get(dep.key) is not None:
-            return web.json_response({"error": f"deployment {dep.name!r} exists"}, status=409)
-        await self.store.put(dep.key, dep.to_bytes())
+        async with self._mutate:
+            if await self.store.get(dep.key) is not None:
+                return web.json_response({"error": f"deployment {dep.name!r} exists"}, status=409)
+            await self.store.put(dep.key, dep.to_bytes())
         logger.info("created deployment %s -> %s", dep.name, dep.graph)
         return web.json_response(self._view(dep), status=201)
 
@@ -79,39 +84,41 @@ class ApiStore:
         body = await self._json(request)
         if body is None:
             return web.json_response({"error": "invalid JSON body"}, status=400)
-        dep = await self._load(request.match_info["name"])
-        if dep is None:
-            return web.json_response({"error": "not found"}, status=404)
-        if dep.phase == DeploymentPhase.DELETING.value:
-            # A PUT must not cancel/resurrect an acknowledged deletion.
-            return web.json_response({"error": "deployment is being deleted"}, status=409)
-        changed = False
-        if "graph" in body and body["graph"] != dep.graph:
-            dep.graph = str(body["graph"])
-            changed = True
-        if "config" in body and body["config"] != dep.config:
-            dep.config = dict(body["config"])
-            changed = True
-        if "labels" in body:
-            dep.labels = {str(k): str(v) for k, v in dict(body["labels"]).items()}
-        if changed:
-            dep.generation += 1
-            dep.phase = DeploymentPhase.PENDING.value
-        # Best-effort existence re-check: if the operator finalized a delete
-        # between our load and now, don't resurrect the record.
-        if await self.store.get(dep.key) is None:
-            return web.json_response({"error": "not found"}, status=404)
-        await self.store.put(dep.key, dep.to_bytes())
+        async with self._mutate:
+            dep = await self._load(request.match_info["name"])
+            if dep is None:
+                return web.json_response({"error": "not found"}, status=404)
+            if dep.phase == DeploymentPhase.DELETING.value:
+                # A PUT must not cancel/resurrect an acknowledged deletion.
+                return web.json_response({"error": "deployment is being deleted"}, status=409)
+            changed = False
+            if "graph" in body and body["graph"] != dep.graph:
+                dep.graph = str(body["graph"])
+                changed = True
+            if "config" in body and body["config"] != dep.config:
+                dep.config = dict(body["config"])
+                changed = True
+            if "labels" in body:
+                dep.labels = {str(k): str(v) for k, v in dict(body["labels"]).items()}
+            if changed:
+                dep.generation += 1
+                dep.phase = DeploymentPhase.PENDING.value
+            # The operator may finalize a delete outside this lock: re-check
+            # so we don't resurrect a removed record.
+            if await self.store.get(dep.key) is None:
+                return web.json_response({"error": "not found"}, status=404)
+            await self.store.put(dep.key, dep.to_bytes())
         return web.json_response(self._view(dep))
 
     async def delete(self, request: web.Request) -> web.Response:
-        dep = await self._load(request.match_info["name"])
-        if dep is None:
-            return web.json_response({"error": "not found"}, status=404)
-        # Two-phase delete: the operator tears the fleet down, then removes
-        # the record (the finalizer pattern).
-        dep.phase = DeploymentPhase.DELETING.value
-        await self.store.put(dep.key, dep.to_bytes())
+        async with self._mutate:
+            dep = await self._load(request.match_info["name"])
+            if dep is None:
+                return web.json_response({"error": "not found"}, status=404)
+            # Two-phase delete: the operator tears the fleet down, then
+            # removes the record (the finalizer pattern).
+            dep.phase = DeploymentPhase.DELETING.value
+            await self.store.put(dep.key, dep.to_bytes())
         return web.json_response({"status": "deleting"}, status=202)
 
     async def healthz(self, _request: web.Request) -> web.Response:

@@ -319,7 +319,10 @@ class HttpService:
             body = await request.json()
         except Exception:
             body = {}
-        seconds = min(float(body.get("seconds", 3.0)), 60.0)
+        try:
+            seconds = min(max(float(body.get("seconds", 3.0)), 0.1), 60.0)
+        except (TypeError, ValueError):
+            return web.json_response({"error": "seconds must be a number"}, status=400)
         log_dir = str(body.get("dir", "/tmp/dynamo-trace"))
         if trace_running():
             return web.json_response({"error": "trace already running"}, status=409)

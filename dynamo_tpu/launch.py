@@ -140,7 +140,7 @@ def make_worker_spec(model: str, **engine_kw: Any) -> WorkerSpec:
     )
 
 
-async def build_engine_service(spec: WorkerSpec, *, on_kv_event=None) -> JaxEngineService:
+async def build_engine_service(spec: WorkerSpec, *, on_kv_event=None, g4_storage=None) -> JaxEngineService:
     from dynamo_tpu.tracing import maybe_trace_from_env
 
     maybe_trace_from_env()  # DYN_TRACE_DIR=dir captures worker bring-up + first steps
@@ -197,7 +197,10 @@ async def build_engine_service(spec: WorkerSpec, *, on_kv_event=None) -> JaxEngi
         from dynamo_tpu.blocks import KvBlockManager
 
         block_manager = KvBlockManager(
-            spec.block_manager_config, read_page=runner.read_page, write_page=runner.write_page
+            spec.block_manager_config,
+            read_page=runner.read_page,
+            write_page=runner.write_page,
+            g4_storage=g4_storage,
         )
     core = EngineCore(runner, spec.engine_config, on_kv_event=on_kv_event, block_manager=block_manager)
     return await JaxEngineService(core).start()
@@ -221,7 +224,9 @@ async def serve_worker(
 
     broadcaster = KvEventBroadcaster()
     broadcaster.bind_loop(asyncio.get_running_loop())
-    service = await build_engine_service(spec, on_kv_event=broadcaster.publish)
+    service = await build_engine_service(
+        spec, on_kv_event=broadcaster.publish, g4_storage=_g4_storage_for(spec, runtime)
+    )
     broadcaster.bind_snapshot(service.core.allocator.cache_snapshot)
     ns, comp, ep = spec.card.endpoint
     component = runtime.namespace(ns).component(comp)
@@ -270,11 +275,26 @@ async def serve_worker(
     return service
 
 
+def _g4_storage_for(spec: WorkerSpec, runtime: DistributedRuntime):
+    """RemoteStorage for the G4 tier when configured (decode AND prefill
+    workers): blocks offloaded here are onboardable by every worker joined
+    to the same store (shared best-effort cache, `blocks/tier.py`)."""
+    bm_cfg = spec.block_manager_config
+    if bm_cfg is None or getattr(bm_cfg, "g4_capacity_blocks", 0) <= 0 or bm_cfg.null_storage:
+        return None
+    from dynamo_tpu.blocks.storage import RemoteStorage
+    from dynamo_tpu.runtime.objects import ObjectStore
+
+    return RemoteStorage(
+        ObjectStore(runtime.store), asyncio.get_running_loop(), prefix=f"kv/{spec.card.name}"
+    )
+
+
 async def serve_prefill_worker(runtime: DistributedRuntime, spec: WorkerSpec, *, lease=None):
     """A prefill-fleet worker: engine + queue consumer, no model card."""
     from dynamo_tpu.disagg.prefill_worker import PrefillWorker
 
-    service = await build_engine_service(spec)
+    service = await build_engine_service(spec, g4_storage=_g4_storage_for(spec, runtime))
     worker = await PrefillWorker(runtime, service).start()
     service.aux.append(worker)
     logger.info("prefill worker up for %s", spec.card.name)
@@ -312,6 +332,7 @@ async def run_local(
     services = []
     g2_blocks = engine_kw.pop("g2_blocks", 0)
     g3_blocks = engine_kw.pop("g3_blocks", 0)
+    g4_blocks = engine_kw.pop("g4_blocks", 0)
     mesh_plan = engine_kw.pop("mesh", None)
     mock = engine_kw.pop("mock", False)
     total_workers = num_workers + num_prefill_workers
@@ -321,13 +342,14 @@ async def run_local(
         spec.card.router_mode = router_mode
         spec.mesh_plan = mesh_plan
         spec.mock = mock
-        if g2_blocks or g3_blocks:
+        if g2_blocks or g3_blocks or g4_blocks:
             from dynamo_tpu.blocks import BlockManagerConfig
 
             spec.block_manager_config = BlockManagerConfig(
                 g2_capacity_blocks=g2_blocks,
                 g3_capacity_blocks=g3_blocks,
                 g3_path=f"/tmp/dynamo_tpu_g3_w{i}",
+                g4_capacity_blocks=g4_blocks,
             )
         return spec
 
@@ -472,6 +494,7 @@ async def _amain(args: argparse.Namespace) -> None:
         max_batch_size=args.max_batch_size,
         g2_blocks=args.g2_blocks,
         g3_blocks=args.g3_blocks,
+        g4_blocks=args.g4_blocks,
         mock=args.mock,
     )
     logger.info("serving %s on port %d", args.model, handles["port"])
@@ -502,6 +525,7 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--router-mode", default=ws.router_mode, choices=["round_robin", "random", "kv"])
     parser.add_argument("--g2-blocks", type=int, default=0, help="host-RAM KV tier capacity (blocks); 0 disables")
     parser.add_argument("--g3-blocks", type=int, default=0, help="disk KV tier capacity (blocks); 0 disables")
+    parser.add_argument("--g4-blocks", type=int, default=0, help="remote (object-store) KV tier capacity (blocks); 0 disables")
     parser.add_argument("--prefill-workers", type=int, default=0, help="disaggregated prefill fleet size")
     parser.add_argument(
         "--role", default="local", choices=["local", "frontend", "worker", "prefill", "encode", "router", "store"],

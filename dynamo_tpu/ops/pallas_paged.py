@@ -62,12 +62,15 @@ NEG_INF = -1e30
 LANES = 128
 
 
-def _pages_per_block(pages_per_seq: int) -> int:
-    """Largest power-of-two divisor of the table width, capped at 8 pages."""
-    for cand in (8, 4, 2, 1):
-        if pages_per_seq % cand == 0:
-            return cand
-    return 1
+def _pages_per_block(pages_per_seq: int, page_size: int) -> int:
+    """Pages per compute block: target ~1024 tokens per block.
+
+    Deep blocks amortize the fori_loop/online-softmax overhead and batch
+    more DMA issues per wait (measured +45% decode throughput vs 2-page
+    blocks at serving shapes). No divisibility requirement — the tail block
+    clamps its page indices and masks by length."""
+    target = max(1, 1024 // page_size)
+    return max(1, min(pages_per_seq, target))
 
 
 def _decode_kernel(
@@ -104,9 +107,15 @@ def _decode_kernel(
         jax.lax.fori_loop(0, b, lambda bb, acc: acc + blocks_of(bb), jnp.int32(0)) % 2
     )
 
+    def page_index(bb, ii, j):
+        # The tail block may reach past the table: clamp to a valid page
+        # (its tokens are masked out by the length check in compute).
+        idx = ii * pages_per_block + j
+        return tables_ref[bb * pages_per_seq + jnp.minimum(idx, pages_per_seq - 1)]
+
     def start_block(slot, bb, ii):
         for j in range(pages_per_block):
-            page = tables_ref[bb * pages_per_seq + ii * pages_per_block + j]
+            page = page_index(bb, ii, j)
             rows = pl.ds(j * page_size, page_size)
             pltpu.make_async_copy(
                 k_hbm.at[page], k_buf.at[slot, rows, :], k_sem.at[slot]
@@ -117,7 +126,7 @@ def _decode_kernel(
 
     def wait_block(slot, bb, ii):
         for j in range(pages_per_block):
-            page = tables_ref[bb * pages_per_seq + ii * pages_per_block + j]
+            page = page_index(bb, ii, j)
             rows = pl.ds(j * page_size, page_size)
             pltpu.make_async_copy(
                 k_hbm.at[page], k_buf.at[slot, rows, :], k_sem.at[slot]
@@ -142,7 +151,11 @@ def _decode_kernel(
         start_block(0, 0, 0)
 
     n_heads, width = q_ref.shape
-    q_bd = q_ref[...]  # f32[H, W], block-diagonal, pre-scaled
+    # Keep matmul operands in the cache dtype (bf16): the MXU multiplies
+    # bf16 natively with f32 accumulation — an f32 formulation costs multiple
+    # MXU passes AND a whole-block VPU astype per K/V block, which measured
+    # ~3x slower than HBM DMA on v5e (the kernel must stay DMA-bound).
+    q_bd = q_ref[...]  # [H, W] block-diagonal, pre-scaled, cache dtype
 
     def body(i, carry):
         m, l, acc = carry
@@ -155,13 +168,13 @@ def _decode_kernel(
 
         wait_block(cur, b, i)
 
-        k = k_buf[cur].astype(jnp.float32)  # [bk, W]
-        v = v_buf[cur].astype(jnp.float32)
+        k = k_buf[cur]  # [bk, W] cache dtype
+        v = v_buf[cur]
         # Block-diagonal q: head h only overlaps its own KV head's strip, so
         # this one contraction is every head's logits against its KV head.
         s = jax.lax.dot_general(
             q_bd, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [H, bk]
+        )  # f32[H, bk]
         kpos = i * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(kpos < length, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))  # [H, 1]
@@ -169,8 +182,8 @@ def _decode_kernel(
         alpha = jnp.exp(m - m_new)
         l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = alpha * acc + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [H, W]; head h's answer lives in its own KV head's strip
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )  # f32[H, W]; head h's answer lives in its own KV head's strip
         return m_new, l_new, acc_new
 
     m0 = jnp.full((n_heads, 1), NEG_INF, jnp.float32)
@@ -215,7 +228,7 @@ def paged_decode_attention(
     n_kv = width // head_dim
     group = n_heads // n_kv
     pages_per_seq = block_tables.shape[1]
-    ppb = _pages_per_block(pages_per_seq)
+    ppb = _pages_per_block(pages_per_seq, page_size)
     bk = ppb * page_size
 
     kf, vf = k_cache, v_cache
@@ -224,11 +237,13 @@ def paged_decode_attention(
 
     # Block-diagonal query staging: head kv*G+g occupies lane strip
     # [kv*hd, (kv+1)*hd). One einsum against eye(n_kv); XLA fuses it.
+    # Scale in f32, then store in the cache dtype so the kernel's matmuls
+    # run at native MXU bf16 rate.
     q3 = q[:, 0].astype(jnp.float32) * scale  # [B, H, hd]
     eye = jnp.eye(n_kv, dtype=jnp.float32)
     q_bd = jnp.einsum(
         "bkgd,kK->bkgKd", q3.reshape(b, n_kv, group, head_dim), eye
-    ).reshape(b, n_heads, width)
+    ).reshape(b, n_heads, width).astype(k_cache.dtype)
 
     spec = pl.BlockSpec((None, n_heads, width), lambda bb, *_: (bb, 0, 0))
     kernel = functools.partial(

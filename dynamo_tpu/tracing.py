@@ -102,8 +102,17 @@ def maybe_trace_from_env() -> None:
     log_dir = os.environ.get("DYN_TRACE_DIR")
     if not log_dir:
         return
-    seconds = float(os.environ.get("DYN_TRACE_SECONDS", "5"))
-    if not start_device_trace(log_dir):
+    try:
+        seconds = float(os.environ.get("DYN_TRACE_SECONDS", "5"))
+    except ValueError:
+        logger.warning("ignoring malformed DYN_TRACE_SECONDS=%r", os.environ["DYN_TRACE_SECONDS"])
+        seconds = 5.0
+    try:
+        if not start_device_trace(log_dir):
+            return
+    except Exception:
+        # Observability must never take the serving worker down.
+        logger.exception("could not start device trace in %s", log_dir)
         return
 
     def stop_later() -> None:

@@ -76,6 +76,84 @@ def test_manager_cascade_g2_to_g3(tmp_path):
     np.testing.assert_array_equal(got[0], pages[1][0])
 
 
+def test_manager_cascade_to_g4_remote(tmp_path):
+    """G2 -> G3 -> G4 cascade over a real (in-memory) object store, and a G4
+    hit promoting back to G2 — the cross-worker reuse path."""
+    import asyncio
+    import threading
+
+    from dynamo_tpu.blocks.storage import RemoteStorage
+    from dynamo_tpu.runtime.discovery import MemoryStore
+    from dynamo_tpu.runtime.objects import ObjectStore
+
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    try:
+        remote = RemoteStorage(ObjectStore(MemoryStore()), loop)
+        cfg = BlockManagerConfig(
+            g2_capacity_blocks=1, g3_capacity_blocks=1, g3_path=tmp_path / "g3",
+            g4_capacity_blocks=4,
+        )
+        pages = {i: payload(i) for i in range(8)}
+        mgr = KvBlockManager(
+            cfg, read_page=lambda pid: pages[pid], write_page=lambda *a: None,
+            g4_storage=remote,
+        )
+        mgr.offload(201, 1)
+        mgr.offload(202, 2)  # 201 -> G3
+        mgr.offload(203, 3)  # 202 -> G3, 201 -> G4
+        assert 201 in mgr.g4 and 201 not in mgr.g2 and 201 not in mgr.g3
+        assert mgr.probe_prefix([201, 202, 203], 0) == 3
+        got = mgr.lookup(201)
+        assert got is not None and 201 in mgr.g2
+        np.testing.assert_array_equal(got[0], pages[1][0])
+        # a second manager sharing the same object store finds the peer's
+        # block through the shared tier (membership falls through to the
+        # backend) and onboards it into its own G2
+        mgr2 = KvBlockManager(
+            BlockManagerConfig(g2_capacity_blocks=2, g4_capacity_blocks=4),
+            read_page=lambda pid: pages[pid], write_page=lambda *a: None,
+            g4_storage=remote,
+        )
+        assert mgr2.probe_prefix([201], 0) == 1  # cross-worker membership
+        got2 = mgr2.lookup(201)
+        assert got2 is not None and 201 in mgr2.g2
+        np.testing.assert_array_equal(got2[0], pages[1][0])
+        assert "g4" in mgr.stats()
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(timeout=5)
+        loop.close()
+
+
+def test_g4_capacity_eviction_deletes_remote(tmp_path):
+    import asyncio
+    import threading
+
+    from dynamo_tpu.blocks.storage import RemoteStorage
+    from dynamo_tpu.runtime.discovery import MemoryStore
+    from dynamo_tpu.runtime.objects import ObjectStore
+
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    try:
+        remote = RemoteStorage(ObjectStore(MemoryStore()), loop)
+        from dynamo_tpu.blocks.tier import TierPool
+
+        g4 = TierPool("g4", remote, 2)
+        g4.put(1, payload(1))
+        g4.put(2, payload(2))
+        g4.put(3, payload(3))  # evicts 1
+        assert 1 not in g4 and remote.read(1) is None
+        assert remote.read(2) is not None
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(timeout=5)
+        loop.close()
+
+
 # -- engine integration ------------------------------------------------------
 
 

@@ -50,6 +50,32 @@ def test_decode_kernel_matches_reference(b, n_heads, n_kv, head_dim, pages_per_s
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
 
 
+def test_decode_kernel_tail_block_clamps():
+    """pages_per_seq > pages_per_block: the tail compute block reaches past
+    the table and must clamp page indices (masked by length) — the deep-block
+    path every page-16 serving config hits at long context."""
+    import dynamo_tpu.ops.pallas_paged as pp
+
+    rng = np.random.default_rng(3)
+    page_size, pages_per_seq = 16, 9
+    # Force small blocks so multiple blocks + a ragged tail exist.
+    orig = pp._pages_per_block
+    pp._pages_per_block = lambda pps, ps: 4  # bk=64; 9 pages -> 3 blocks, tail ragged
+    try:
+        q, k, v, tables, positions = _random_case(
+            rng, b=3, n_heads=8, n_kv=2, head_dim=64,
+            page_size=page_size, pages_per_seq=pages_per_seq,
+            max_len=page_size * pages_per_seq,
+        )
+        positions = jnp.asarray([[143], [64], [127]], jnp.int32)  # full, block edge, mid
+        scale = 0.125
+        want = paged_attention_reference(q, k, v, tables, positions, scale=scale)
+        got = paged_decode_attention(q, k, v, tables, positions, scale=scale, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-2, rtol=2e-2)
+    finally:
+        pp._pages_per_block = orig
+
+
 def test_decode_kernel_length_one():
     """Position 0 (only the just-written token) must not read other pages."""
     rng = np.random.default_rng(1)
