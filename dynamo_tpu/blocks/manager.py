@@ -110,7 +110,12 @@ class KvBlockManager:
         todo: list[tuple[int, int]] = []
         seen: set[int] = set()
         for block_hash, page_id in items:
-            if block_hash in seen or any(block_hash in tier for tier in self._tiers):
+            # Dedup against LOCAL membership only: a shared G4's full
+            # __contains__ does a remote round-trip per probe, which would
+            # gate flush_offloads (and thus the next engine step) on store
+            # latency for every freshly committed block. Re-offloading a
+            # block a peer already persisted is harmless.
+            if block_hash in seen or any(tier.has_local(block_hash) for tier in self._tiers):
                 continue
             seen.add(block_hash)
             todo.append((block_hash, page_id))

@@ -11,11 +11,14 @@ Parity: reference per-tier BlockPool with priority eviction
 
 from __future__ import annotations
 
+import logging
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Callable
 
 from dynamo_tpu.blocks.storage import BlockStorage, Payload
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass
@@ -46,6 +49,11 @@ class TierPool:
         self._evictions = 0
 
     def __contains__(self, block_hash: int) -> bool:
+        return block_hash in self._lru
+
+    def has_local(self, block_hash: int) -> bool:
+        """Membership in this tier's own (in-memory) index only — shared
+        tiers additionally consult the backend in ``__contains__``."""
         return block_hash in self._lru
 
     def __len__(self) -> int:
@@ -127,15 +135,27 @@ class SharedTierPool(TierPool):
     """
 
     def __contains__(self, block_hash: int) -> bool:
-        if super().__contains__(block_hash):
+        if self.has_local(block_hash):
             return True
         exists = getattr(self.storage, "exists", None)
-        return bool(exists(block_hash)) if exists is not None else False
+        if exists is None:
+            return False
+        try:
+            return bool(exists(block_hash))
+        except Exception:
+            # A degraded remote tier must read as a miss, never break the
+            # engine step that's probing it.
+            logger.warning("shared tier %s: membership probe failed", self.name, exc_info=True)
+            return False
 
     def get(self, block_hash: int) -> Payload | None:
-        if super().__contains__(block_hash):
+        if self.has_local(block_hash):
             return super().get(block_hash)
-        payload = self.storage.read(block_hash)  # a peer's block
+        try:
+            payload = self.storage.read(block_hash)  # a peer's block
+        except Exception:
+            logger.warning("shared tier %s: remote read failed", self.name, exc_info=True)
+            payload = None
         if payload is None:
             self._misses += 1
             return None

@@ -319,6 +319,8 @@ class HttpService:
             body = await request.json()
         except Exception:
             body = {}
+        if not isinstance(body, dict):
+            return web.json_response({"error": "body must be a JSON object"}, status=400)
         try:
             seconds = min(max(float(body.get("seconds", 3.0)), 0.1), 60.0)
         except (TypeError, ValueError):

@@ -75,12 +75,63 @@ async def cmd_remove(store, args) -> int:
     return 0
 
 
+async def cmd_deployments(store, args) -> int:
+    """List/scale/delete GraphDeployment records (the operator acts on them)."""
+    from dynamo_tpu.deploy.objects import STORE_PREFIX, DeploymentPhase, GraphDeployment
+
+    if args.dep_cmd == "list":
+        records = await store.get_prefix(STORE_PREFIX)
+        deps = sorted(
+            (GraphDeployment.from_bytes(v) for v in records.values()), key=lambda d: d.name
+        )
+        if args.json:
+            import dataclasses
+
+            print(json.dumps([dataclasses.asdict(d) for d in deps]))
+            return 0
+        if not deps:
+            print("(no deployments)")
+            return 0
+        print(f"{'NAME':<20} {'PHASE':<10} {'GEN':>4} {'GRAPH':<40} READY")
+        for d in deps:
+            ready = ",".join(f"{k}={v}" for k, v in sorted(d.services_ready.items())) or "-"
+            print(f"{d.name:<20} {d.phase:<10} {d.generation:>4} {d.graph:<40} {ready}")
+        return 0
+    raw = await store.get(STORE_PREFIX + args.name)
+    if raw is None:
+        print(f"no deployment {args.name!r}", file=sys.stderr)
+        return 1
+    dep = GraphDeployment.from_bytes(raw)
+    if args.dep_cmd == "scale":
+        if dep.phase == DeploymentPhase.DELETING.value:
+            print(f"{args.name} is being deleted", file=sys.stderr)
+            return 1
+        service, sep, n = args.replicas.partition("=")
+        if not service or not sep or not n.isdigit():
+            print(f"replicas must be Service=N, got {args.replicas!r}", file=sys.stderr)
+            return 2
+        dep.config.setdefault(service, {})["replicas"] = int(n)
+        dep.generation += 1
+        dep.phase = DeploymentPhase.PENDING.value
+        await store.put(dep.key, dep.to_bytes())
+        print(f"{args.name}: {service} -> {n} replicas (gen {dep.generation})")
+    elif args.dep_cmd == "delete":
+        dep.phase = DeploymentPhase.DELETING.value
+        await store.put(dep.key, dep.to_bytes())
+        print(f"{args.name}: deleting")
+    return 0
+
+
 async def _amain(args: argparse.Namespace) -> int:
     from dynamo_tpu.runtime.store_server import StoreClient
 
     store = StoreClient.from_url(args.store)
     try:
-        return await {"list": cmd_list, "add": cmd_add, "remove": cmd_remove}[args.cmd](store, args)
+        handlers = {
+            "list": cmd_list, "add": cmd_add, "remove": cmd_remove,
+            "deployment": cmd_deployments,
+        }
+        return await handlers[args.cmd](store, args)
     finally:
         close = getattr(store, "close", None)
         if close:
@@ -102,6 +153,15 @@ def main(argv: list[str] | None = None) -> None:
     add.add_argument("--model-type", default="chat+completions")
     rem = sub.add_parser("remove", help="remove a model's registrations")
     rem.add_argument("--name", required=True)
+    dep = sub.add_parser("deployment", help="inspect/scale/delete graph deployments")
+    dep_sub = dep.add_subparsers(dest="dep_cmd", required=True)
+    dl = dep_sub.add_parser("list")
+    dl.add_argument("--json", action="store_true")
+    ds = dep_sub.add_parser("scale")
+    ds.add_argument("name")
+    ds.add_argument("replicas", help="Service=N")
+    dd = dep_sub.add_parser("delete")
+    dd.add_argument("name")
     args = p.parse_args(argv)
     raise SystemExit(asyncio.run(_amain(args)))
 

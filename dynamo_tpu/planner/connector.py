@@ -35,6 +35,68 @@ class Connector(Protocol):
     async def close(self) -> None: ...
 
 
+class DeploymentConnector:
+    """Scales by editing the declarative GraphDeployment record.
+
+    The planner's decision becomes a spec change on the deployment object
+    (replicas per service); the operator's watch reconciles the fleet. This
+    is the reference's kubernetes-connector shape
+    (`kubernetes_connector.py:25-46`: patch the DynamoGraphDeployment CRD,
+    let the controller act) on this framework's control plane — the planner
+    never touches processes, so it works identically against the local
+    ProcessBackend and a k8s rollout of the rendered manifests.
+    """
+
+    def __init__(
+        self,
+        store,
+        deployment: str,
+        *,
+        decode_service: str = "Worker",
+        prefill_service: str | None = None,
+    ) -> None:
+        self.store = store
+        self.deployment = deployment
+        self.decode_service = decode_service
+        self.prefill_service = prefill_service
+        self.scale_events = 0
+
+    async def apply(self, decision: PlanDecision) -> None:
+        from dynamo_tpu.deploy.objects import STORE_PREFIX, DeploymentPhase, GraphDeployment
+
+        raw = await self.store.get(STORE_PREFIX + self.deployment)
+        if raw is None:
+            logger.warning("deployment %s missing; cannot apply decision", self.deployment)
+            return
+        dep = GraphDeployment.from_bytes(raw)
+        if dep.phase == DeploymentPhase.DELETING.value:
+            return
+        want: dict[str, int] = {self.decode_service: max(decision.decode_workers, 0)}
+        if self.prefill_service is not None:
+            want[self.prefill_service] = max(decision.prefill_workers, 0)
+        changed = False
+        for service, replicas in want.items():
+            section = dep.config.setdefault(service, {})
+            if int(section.get("replicas", -1)) != replicas:
+                section["replicas"] = replicas
+                changed = True
+        if not changed:
+            return
+        dep.generation += 1
+        dep.phase = DeploymentPhase.PENDING.value
+        # The operator may have finalized a delete since our read — putting
+        # now would resurrect the record and respawn the torn-down fleet.
+        if await self.store.get(dep.key) is None:
+            logger.info("deployment %s deleted while scaling; dropping decision", self.deployment)
+            return
+        await self.store.put(dep.key, dep.to_bytes())
+        self.scale_events += 1
+        logger.info("deployment %s scaled: %s (gen %d)", self.deployment, want, dep.generation)
+
+    async def close(self) -> None:
+        pass
+
+
 class LocalProcessConnector:
     """Scales decode/prefill fleets as launch.py subprocesses."""
 

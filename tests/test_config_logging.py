@@ -138,6 +138,45 @@ async def test_llmctl_add_list_remove(capsys):
         await server.close()
 
 
+async def test_llmctl_deployment_commands(capsys):
+    import argparse
+
+    from dynamo_tpu.deploy.objects import GraphDeployment
+    from dynamo_tpu.llmctl import _amain
+    from dynamo_tpu.runtime.store_server import StoreClient, StoreServer
+
+    server = await StoreServer(host="127.0.0.1", port=0).start()
+    store_url = f"tcp://127.0.0.1:{server.port}"
+    client = StoreClient.from_url(store_url)
+    try:
+        dep = GraphDeployment(name="agg", graph="graphs:Frontend")
+        await client.put(dep.key, dep.to_bytes())
+
+        async def run(dep_cmd, name=None, replicas=None, json_out=False):
+            ns = argparse.Namespace(
+                store=store_url, cmd="deployment", dep_cmd=dep_cmd,
+                name=name, replicas=replicas, json=json_out,
+            )
+            return await _amain(ns)
+
+        assert await run("list") == 0
+        assert "agg" in capsys.readouterr().out
+        assert await run("scale", name="agg", replicas="Worker=4") == 0
+        capsys.readouterr()
+        updated = GraphDeployment.from_bytes(await client.get(dep.key))
+        assert updated.config["Worker"]["replicas"] == 4
+        assert updated.generation == 2 and updated.phase == "pending"
+        assert await run("delete", name="agg") == 0
+        assert GraphDeployment.from_bytes(await client.get(dep.key)).phase == "deleting"
+        assert await run("scale", name="agg", replicas="Worker=1") == 1  # deleting: refuse
+        assert await run("scale", name="missing", replicas="W=1") == 1
+    finally:
+        close = getattr(client, "close", None)
+        if close:
+            await close()
+        await server.close()
+
+
 async def test_standalone_router_service():
     """The router-as-a-service answers schedule queries against a live
     worker fleet, preferring the worker whose cache holds the prefix."""

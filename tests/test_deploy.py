@@ -208,6 +208,42 @@ async def test_api_store_to_operator_integration():
         await api.close()
 
 
+async def test_planner_deployment_connector_scales_through_operator():
+    """Planner decision -> deployment spec edit -> operator reconcile:
+    the kubernetes-connector control loop on the local backend."""
+    from dynamo_tpu.planner.connector import DeploymentConnector
+    from dynamo_tpu.planner.core import PlanDecision
+
+    store = MemoryStore()
+    backend = FakeBackend()
+    op = await Operator(store, backend, resync_seconds=999).start()
+    try:
+        dep = GraphDeployment(
+            name="svc", graph="m:S", config={"Worker": {"replicas": 1}}
+        )
+        await store.put(dep.key, dep.to_bytes())
+        await _wait(op, lambda: _is(store, "svc", phase="running"))
+        base_applies = len(backend.applied)
+
+        conn = DeploymentConnector(store, "svc", decode_service="Worker", prefill_service="Prefill")
+        await conn.apply(PlanDecision(decode_workers=3, prefill_workers=1,
+                                      predicted_prefill_tps=0, predicted_decode_tps=0))
+        await _wait(op, lambda: _is(store, "svc", observed_generation=2, phase="running"))
+        cur = GraphDeployment.from_bytes(await store.get(dep.key))
+        assert cur.config["Worker"]["replicas"] == 3
+        assert cur.config["Prefill"]["replicas"] == 1
+        assert len(backend.applied) == base_applies + 1
+        assert conn.scale_events == 1
+
+        # identical decision -> no spec churn, no re-reconcile
+        await conn.apply(PlanDecision(decode_workers=3, prefill_workers=1,
+                                      predicted_prefill_tps=0, predicted_decode_tps=0))
+        assert conn.scale_events == 1
+        assert GraphDeployment.from_bytes(await store.get(dep.key)).generation == 2
+    finally:
+        await op.close()
+
+
 async def test_process_backend_end_to_end(tmp_path):
     """A real deployment: operator spawns fleet subprocesses for the mock
     LLM graph and tears them down on delete."""
