@@ -52,6 +52,10 @@ def main() -> None:
     num_pages = BATCH * pages_per_seq + 8
 
     params = llama.init_params(cfg, 0)
+    if os.environ.get("BENCH_QUANT"):
+        from dynamo_tpu.models.quant import quantize_params
+
+        params = quantize_params(params, mode=os.environ["BENCH_QUANT"])
     runner = ModelRunner(
         cfg, params, num_pages=num_pages, page_size=page_size,
         max_batch_size=BATCH, prefill_bucket=max(ISL, 64),

@@ -134,6 +134,19 @@ class SharedTierPool(TierPool):
     and fetch — readers must (and do) treat a None payload as a miss.
     """
 
+    _degraded = False  # log-once latch: probes run per block on the request path
+
+    def _note_failure(self, what: str) -> None:
+        if not self._degraded:
+            self._degraded = True
+            logger.warning("shared tier %s degraded: %s failed (reads as misses "
+                           "until the backend recovers)", self.name, what, exc_info=True)
+
+    def _note_success(self) -> None:
+        if self._degraded:
+            self._degraded = False
+            logger.info("shared tier %s recovered", self.name)
+
     def __contains__(self, block_hash: int) -> bool:
         if self.has_local(block_hash):
             return True
@@ -141,20 +154,23 @@ class SharedTierPool(TierPool):
         if exists is None:
             return False
         try:
-            return bool(exists(block_hash))
+            hit = bool(exists(block_hash))
         except Exception:
             # A degraded remote tier must read as a miss, never break the
             # engine step that's probing it.
-            logger.warning("shared tier %s: membership probe failed", self.name, exc_info=True)
+            self._note_failure("membership probe")
             return False
+        self._note_success()
+        return hit
 
     def get(self, block_hash: int) -> Payload | None:
         if self.has_local(block_hash):
             return super().get(block_hash)
         try:
             payload = self.storage.read(block_hash)  # a peer's block
+            self._note_success()
         except Exception:
-            logger.warning("shared tier %s: remote read failed", self.name, exc_info=True)
+            self._note_failure("remote read")
             payload = None
         if payload is None:
             self._misses += 1

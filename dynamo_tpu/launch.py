@@ -53,6 +53,9 @@ class WorkerSpec:
     # Timing-model engine instead of JAX (planner/router fleets in CI and the
     # planner's local connector; parity: reference mocker, SURVEY.md row 35).
     mock: bool = False
+    # Weight-only quantization applied after load ("" = off, "int8"):
+    # halves weight HBM reads on the decode path (models/quant.py).
+    quantize: str = ""
 
     @classmethod
     def from_preset(cls, preset: str, *, card: ModelDeploymentCard | None = None, **engine_kw: Any) -> "WorkerSpec":
@@ -181,6 +184,10 @@ async def build_engine_service(spec: WorkerSpec, *, on_kv_event=None, g4_storage
             params = load_params(spec.model_dir, spec.model_config, mesh=mesh)
         else:
             params = llama.init_params(spec.model_config, 0)
+        if spec.quantize:
+            from dynamo_tpu.models.quant import quantize_params
+
+            params = quantize_params(params, mode=spec.quantize)
         return ModelRunner(
             spec.model_config,
             params,
@@ -335,6 +342,7 @@ async def run_local(
     g4_blocks = engine_kw.pop("g4_blocks", 0)
     mesh_plan = engine_kw.pop("mesh", None)
     mock = engine_kw.pop("mock", False)
+    quantize = engine_kw.pop("quantize", "")
     total_workers = num_workers + num_prefill_workers
 
     def make_spec(i: int) -> WorkerSpec:
@@ -342,6 +350,7 @@ async def run_local(
         spec.card.router_mode = router_mode
         spec.mesh_plan = mesh_plan
         spec.mock = mock
+        spec.quantize = quantize
         if g2_blocks or g3_blocks or g4_blocks:
             from dynamo_tpu.blocks import BlockManagerConfig
 
@@ -434,12 +443,14 @@ async def run_role(args: argparse.Namespace) -> None:
         spec.card.router_mode = args.router_mode
         spec.mesh_plan = _parse_mesh(args.mesh)
         spec.mock = args.mock
+        spec.quantize = args.quantize
         await serve_worker(runtime, spec, disagg=disagg)
         logger.info("worker ready")
     elif args.role == "prefill":
         spec = make_worker_spec(args.model, num_pages=args.num_pages, max_batch_size=args.max_batch_size)
         spec.mesh_plan = _parse_mesh(args.mesh)
         spec.mock = args.mock
+        spec.quantize = args.quantize
         await serve_prefill_worker(runtime, spec)
         logger.info("prefill worker ready")
     elif args.role == "encode":
@@ -496,12 +507,141 @@ async def _amain(args: argparse.Namespace) -> None:
         g3_blocks=args.g3_blocks,
         g4_blocks=args.g4_blocks,
         mock=args.mock,
+        quantize=args.quantize,
     )
     logger.info("serving %s on port %d", args.model, handles["port"])
     try:
-        await asyncio.Event().wait()
+        if args.input == "text":
+            await run_text_input(handles["port"], args.model)
+        elif args.input.startswith("batch:"):
+            await run_batch_input(handles["port"], args.model, args.input[len("batch:"):])
+        else:
+            await asyncio.Event().wait()
     finally:
         await handles["http"].stop()
+
+
+async def run_text_input(port: int, model: str) -> None:
+    """Interactive stdin chat against the local stack (``in=text``).
+
+    Parity: reference `dynamo-run in=text` (`launch/dynamo-run/src/input/text.rs`).
+    """
+    import aiohttp
+
+    loop = asyncio.get_running_loop()
+    history: list[dict] = []
+    print("interactive mode — empty line or EOF to exit", flush=True)
+    async with aiohttp.ClientSession() as session:
+        while True:
+            try:
+                line = await loop.run_in_executor(None, input, "> ")
+            except (EOFError, KeyboardInterrupt):
+                break
+            if not line.strip():
+                break
+            import json as _json
+
+            history.append({"role": "user", "content": line})
+            reply = ""
+            failed = False
+            async with session.post(
+                f"http://127.0.0.1:{port}/v1/chat/completions",
+                json={"model": model, "messages": history, "stream": True},
+            ) as resp:
+                if resp.status != 200:
+                    print(f"[error: {(await resp.text())[:200]}]", flush=True)
+                    history.pop()  # keep the conversation consistent
+                    continue
+                async for raw in resp.content:
+                    text = raw.decode().strip()
+                    if not text.startswith("data: ") or text == "data: [DONE]":
+                        continue
+                    doc = _json.loads(text[6:])
+                    if "error" in doc:
+                        print(f"\n[error: {doc['error']}]", flush=True)
+                        failed = True
+                        break
+                    delta = doc["choices"][0].get("delta", {})
+                    piece = delta.get("content") or ""
+                    reply += piece
+                    print(piece, end="", flush=True)
+            print(flush=True)
+            if failed:
+                history.pop()
+            else:
+                history.append({"role": "assistant", "content": reply})
+
+
+async def run_batch_input(port: int, model: str, input_path: str, *, concurrency: int = 64) -> None:
+    """Batch completion over a JSONL file of ``{"text": ...}`` entries.
+
+    Writes ``output.jsonl`` beside the input (response, token counts,
+    latency per entry) and prints an aggregate throughput line.
+    Parity: reference `dynamo-run in=batch:` (`input/batch.rs`).
+    """
+    import json as _json
+    import pathlib
+    import time
+
+    import aiohttp
+
+    src = pathlib.Path(input_path)
+    if not src.is_file():
+        raise SystemExit(f"batch input {src} is not a file")
+    entries = [
+        _json.loads(line) for line in src.read_text().splitlines() if line.strip()
+    ]
+    out_path = src.parent / "output.jsonl"
+    sem = asyncio.Semaphore(concurrency)
+    t0 = time.perf_counter()
+    totals = {"in": 0, "out": 0}
+
+    async def one(session: aiohttp.ClientSession, entry: dict) -> dict:
+        entry = dict(entry)
+        async with sem:
+            start = time.perf_counter()
+            try:
+                async with session.post(
+                    f"http://127.0.0.1:{port}/v1/completions",
+                    json={"model": model, "prompt": entry.get("text", ""), "max_tokens": 256},
+                ) as resp:
+                    try:
+                        doc = await resp.json()
+                    except Exception:
+                        doc = {"error": (await resp.text())[:200]}
+                if resp.status != 200 or "choices" not in doc:
+                    entry["response"] = None
+                    entry["finish_reason"] = "error"
+                    entry["error"] = str(doc.get("error", f"http {resp.status}"))
+                else:
+                    choice = doc["choices"][0]
+                    entry["response"] = choice.get("text", "")
+                    entry["finish_reason"] = choice.get("finish_reason")
+                    usage = doc.get("usage", {})
+                    entry["tokens_in"] = usage.get("prompt_tokens", 0)
+                    entry["tokens_out"] = usage.get("completion_tokens", 0)
+                    totals["in"] += entry["tokens_in"]
+                    totals["out"] += entry["tokens_out"]
+            except Exception as exc:
+                # One dead connection must not lose the rest of the batch.
+                entry["response"] = None
+                entry["finish_reason"] = "error"
+                entry["error"] = f"{type(exc).__name__}: {exc}"
+            entry["elapsed_ms"] = int((time.perf_counter() - start) * 1e3)
+            return entry
+
+    async with aiohttp.ClientSession() as session:
+        results = await asyncio.gather(*(one(session, e) for e in entries))
+    with out_path.open("w") as fh:
+        for entry in results:
+            fh.write(_json.dumps(entry) + "\n")
+    dt = time.perf_counter() - t0
+    print(
+        f"batch done: {len(results)} entries, {totals['in']} tokens in, "
+        f"{totals['out']} tokens out, {dt:.2f}s ({totals['out'] / max(dt, 1e-9):.0f} tok/s) "
+        f"-> {out_path}",
+        flush=True,
+    )
 
 
 def main(argv: list[str] | None = None) -> None:
@@ -533,6 +673,11 @@ def main(argv: list[str] | None = None) -> None:
     )
     parser.add_argument("--store", default=rs.store or None, help="tcp://host:port of the deployment's store server")
     parser.add_argument("--mock", action="store_true", help="timing-model engine instead of JAX (fleet tests, planner)")
+    parser.add_argument("--quantize", default="", choices=["", "int8"], help="weight-only quantization for serving")
+    parser.add_argument(
+        "--input", default="http",
+        help="ingress: 'http' (serve), 'text' (interactive stdin chat), or 'batch:FILE.jsonl'",
+    )
     parser.add_argument("--serve-store-port", type=int, default=None, help="run the store server in this process")
     parser.add_argument(
         "--disagg-threshold", type=int, default=None,

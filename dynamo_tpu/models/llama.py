@@ -29,6 +29,7 @@ import numpy as np
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.ops.attention import paged_attention, write_kv
 from dynamo_tpu.ops.norm import rms_norm
+from dynamo_tpu.models.quant import maybe_dequant as _dq
 from dynamo_tpu.ops.rope import apply_rope, rope_attention_factor, rope_frequencies
 
 Params = dict
@@ -122,8 +123,8 @@ def init_kv_cache(cfg: ModelConfig, num_pages: int, page_size: int, dtype: jnp.d
 
 
 def _mlp_dense(lp: Params, x: jnp.ndarray) -> jnp.ndarray:
-    gate = jax.nn.silu(x @ lp["w_gate"])
-    return (gate * (x @ lp["w_up"])) @ lp["w_down"]
+    gate = jax.nn.silu(x @ _dq(lp["w_gate"]))
+    return (gate * (x @ _dq(lp["w_up"]))) @ _dq(lp["w_down"])
 
 
 def _mlp_moe(lp: Params, x: jnp.ndarray, cfg: ModelConfig, mesh=None) -> jnp.ndarray:
@@ -149,7 +150,7 @@ def _mlp_moe(lp: Params, x: jnp.ndarray, cfg: ModelConfig, mesh=None) -> jnp.nda
             capacity=(b * t * cfg.num_experts_per_token) if cf <= 0 else None,
         )
     if cfg.shared_expert_size:
-        shared = (jax.nn.silu(xt @ lp["w_shared_gate"]) * (xt @ lp["w_shared_up"])) @ lp["w_shared_down"]
+        shared = (jax.nn.silu(xt @ _dq(lp["w_shared_gate"])) * (xt @ _dq(lp["w_shared_up"]))) @ _dq(lp["w_shared_down"])
         if cfg.shared_expert_gated:
             shared = shared * jax.nn.sigmoid((xt @ lp["shared_gate"]).astype(jnp.float32)).astype(shared.dtype)
         out = out + shared
@@ -166,9 +167,9 @@ def _mlp_moe_dense(lp: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
     topv, topi = jax.lax.top_k(router_logits, cfg.num_experts_per_token)
     weights = jax.nn.softmax(topv, axis=-1)  # [N, k]
     mix = jnp.zeros_like(router_logits).at[jnp.arange(xt.shape[0])[:, None], topi].set(weights)  # [N, E]
-    gate = jax.nn.silu(jnp.einsum("nd,edf->nef", xt, lp["w_gate"]))
-    up = jnp.einsum("nd,edf->nef", xt, lp["w_up"])
-    expert_out = jnp.einsum("nef,efd->ned", gate * up, lp["w_down"])  # [N, E, d]
+    gate = jax.nn.silu(jnp.einsum("nd,edf->nef", xt, _dq(lp["w_gate"])))
+    up = jnp.einsum("nd,edf->nef", xt, _dq(lp["w_up"]))
+    expert_out = jnp.einsum("nef,efd->ned", gate * up, _dq(lp["w_down"]))  # [N, E, d]
     out = jnp.einsum("ned,ne->nd", expert_out.astype(jnp.float32), mix)
     return out.reshape(b, t, d).astype(x.dtype)
 
@@ -248,7 +249,7 @@ def forward(
     def layer_step(carry, lp):
         x, k_full, v_full, li = carry
         h = rms_norm(x, lp["attn_norm"], eps=cfg.rms_eps)
-        qp, kp, vp = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]
+        qp, kp, vp = h @ _dq(lp["wq"]), h @ _dq(lp["wk"]), h @ _dq(lp["wv"])
         if cfg.attention_bias:
             qp, kp, vp = qp + lp["bq"], kp + lp["bk"], vp + lp["bv"]
         q = qp.reshape(b, t, cfg.num_heads, cfg.head_dim)
@@ -266,7 +267,7 @@ def forward(
         else:
             tables_l = block_tables + li * npages
             attn = paged_attention(q, k_full, v_full, tables_l, positions, impl=attn_impl)
-        x = x + attn.reshape(b, t, cfg.q_dim) @ lp["wo"]
+        x = x + attn.reshape(b, t, cfg.q_dim) @ _dq(lp["wo"])
         h2 = rms_norm(x, lp["mlp_norm"], eps=cfg.rms_eps)
         mlp = _mlp_moe(lp, h2, cfg, mesh) if cfg.is_moe else _mlp_dense(lp, h2)
         x = x + mlp
@@ -284,7 +285,7 @@ def forward(
 
     x = rms_norm(x, params["norm_f"], eps=cfg.rms_eps)
     last = jnp.take_along_axis(x, last_token_index[:, None, None], axis=1)[:, 0]  # [B, D]
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    head = params["embed"].T if cfg.tie_embeddings else _dq(params["lm_head"])
     # bf16 operands, f32 accumulate: no f32 materialization of the (huge)
     # embedding matrix per step.
     logits = jnp.matmul(last, head, preferred_element_type=jnp.float32)  # [B, vocab]
@@ -322,7 +323,7 @@ def encode(
 
     def layer_step(x, lp):
         h = rms_norm(x, lp["attn_norm"], eps=cfg.rms_eps)
-        qp, kp, vp = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]
+        qp, kp, vp = h @ _dq(lp["wq"]), h @ _dq(lp["wk"]), h @ _dq(lp["wv"])
         if cfg.attention_bias:
             qp, kp, vp = qp + lp["bq"], kp + lp["bk"], vp + lp["bv"]
         q = apply_rope(qp.reshape(b, t, cfg.num_heads, cfg.head_dim), positions, inv_freq)
@@ -335,7 +336,7 @@ def encode(
         scores = scores + bias[:, :, None, :, :]
         probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
         attn = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v).reshape(b, t, cfg.q_dim)
-        x = x + attn @ lp["wo"]
+        x = x + attn @ _dq(lp["wo"])
         h2 = rms_norm(x, lp["mlp_norm"], eps=cfg.rms_eps)
         mlp = _mlp_moe(lp, h2, cfg) if cfg.is_moe else _mlp_dense(lp, h2)
         return x + mlp, None

@@ -34,6 +34,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from dynamo_tpu.models.quant import maybe_dequant as _dq
+
 
 def moe_mlp_dropless(
     lp: dict,
@@ -63,9 +65,9 @@ def moe_mlp_dropless(
     xk = jnp.repeat(x, k, axis=0)[order]  # [N*k, D] grouped by expert
     group_sizes = jnp.bincount(flat_e, length=e).astype(jnp.int32)
 
-    gate = jax.nn.silu(jax.lax.ragged_dot(xk, lp["w_gate"], group_sizes))
-    up = jax.lax.ragged_dot(xk, lp["w_up"], group_sizes)
-    down = jax.lax.ragged_dot(gate * up, lp["w_down"], group_sizes)  # [N*k, D]
+    gate = jax.nn.silu(jax.lax.ragged_dot(xk, _dq(lp["w_gate"]), group_sizes))
+    up = jax.lax.ragged_dot(xk, _dq(lp["w_up"]), group_sizes)
+    down = jax.lax.ragged_dot(gate * up, _dq(lp["w_down"]), group_sizes)  # [N*k, D]
 
     rows = jnp.zeros_like(down).at[order].set(down)  # unsort
     out = (rows.astype(jnp.float32) * weights.reshape(-1)[:, None]).reshape(n, k, d).sum(axis=1)
@@ -117,9 +119,9 @@ def moe_mlp(
 
     # Batched expert FFN: one contraction over all experts; GSPMD shards the
     # leading axis on ep from the weight shardings.
-    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, lp["w_gate"]))
-    up = jnp.einsum("ecd,edf->ecf", expert_in, lp["w_up"])
-    expert_out = jnp.einsum("ecf,efd->ecd", gate * up, lp["w_down"])  # [E, C, D]
+    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in, _dq(lp["w_gate"])))
+    up = jnp.einsum("ecd,edf->ecf", expert_in, _dq(lp["w_up"]))
+    expert_out = jnp.einsum("ecf,efd->ecd", gate * up, _dq(lp["w_down"]))  # [E, C, D]
 
     # Combine: gather each choice's row, weight, and sum over the k choices.
     rows = expert_out[flat_e, jnp.minimum(slot, c - 1)]  # [N*k, D]

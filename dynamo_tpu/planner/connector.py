@@ -84,9 +84,11 @@ class DeploymentConnector:
             return
         dep.generation += 1
         dep.phase = DeploymentPhase.PENDING.value
-        # The operator may have finalized a delete since our read — putting
-        # now would resurrect the record and respawn the torn-down fleet.
-        if await self.store.get(dep.key) is None:
+        # A delete may have started or finalized since our read — putting now
+        # would cancel the teardown / resurrect the record. Re-read and drop
+        # the decision if the record is gone or marked DELETING.
+        fresh = await self.store.get(dep.key)
+        if fresh is None or GraphDeployment.from_bytes(fresh).phase == DeploymentPhase.DELETING.value:
             logger.info("deployment %s deleted while scaling; dropping decision", self.deployment)
             return
         await self.store.put(dep.key, dep.to_bytes())

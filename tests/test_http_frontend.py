@@ -9,6 +9,28 @@ import aiohttp
 from dynamo_tpu.launch import run_local
 
 
+async def test_batch_input_mode(tmp_path, capsys):
+    """`--input batch:file.jsonl`: every entry answered, output.jsonl written,
+    throughput summary printed (reference dynamo-run in=batch)."""
+    import json
+
+    from dynamo_tpu.launch import run_batch_input
+
+    src = tmp_path / "in.jsonl"
+    src.write_text('{"text": "hello"}\n{"text": "world"}\n')
+    handles = await run_local("test-tiny", port=0, mock=True, num_pages=64, max_batch_size=8)
+    try:
+        await run_batch_input(handles["port"], "test-tiny", str(src), concurrency=2)
+    finally:
+        await stop_stack(handles)
+    out = (tmp_path / "output.jsonl").read_text().splitlines()
+    assert len(out) == 2
+    docs = [json.loads(line) for line in out]
+    assert all(d["finish_reason"] == "length" for d in docs)
+    assert all(d["tokens_out"] > 0 and d["elapsed_ms"] >= 0 for d in docs)
+    assert "batch done: 2 entries" in capsys.readouterr().out
+
+
 async def start_stack(**kw):
     handles = await run_local("test-tiny", port=0, num_pages=64, max_batch_size=8, **kw)
     base = f"http://127.0.0.1:{handles['port']}"

@@ -1,0 +1,115 @@
+"""Weight-only int8 serving: quantization error bounds, forward closeness,
+sharding of quantized leaves, engine integration.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import PRESETS
+from dynamo_tpu.models.quant import is_quantized, maybe_dequant, quantize_leaf, quantize_params
+
+
+def test_quantize_leaf_error_bound():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((64, 128)), jnp.float32)
+    q = quantize_leaf(w)
+    assert q["qw"].dtype == jnp.int8 and q["scale"].shape == (128,)
+    back = np.asarray(maybe_dequant(q, jnp.float32))
+    # per-channel: error <= half a step of that channel's scale
+    step = np.abs(np.asarray(w)).max(axis=0) / 127.0
+    err = np.abs(back - np.asarray(w))
+    assert (err <= step[None, :] * 0.51 + 1e-7).all()
+
+
+def test_quantize_params_selects_matmul_leaves():
+    cfg = dataclasses.replace(PRESETS["test-tiny"], tie_embeddings=False)
+    params = quantize_params(llama.init_params(cfg, 1))
+    assert is_quantized(params["layers"]["wq"])
+    assert is_quantized(params["layers"]["w_down"])
+    assert is_quantized(params["lm_head"])
+    # non-matmul leaves untouched
+    assert not is_quantized(params["embed"]) and params["embed"].dtype != jnp.int8
+    assert params["layers"]["attn_norm"].dtype != jnp.int8
+    # idempotent
+    again = quantize_params(params)
+    assert again["layers"]["wq"] is params["layers"]["wq"]
+
+
+def test_moe_params_quantize():
+    cfg = PRESETS["test-tiny-moe"]
+    params = quantize_params(llama.init_params(cfg, 2))
+    lq = params["layers"]
+    assert is_quantized(lq["w_gate"]) and lq["w_gate"]["qw"].ndim == 4
+    assert lq["w_gate"]["scale"].ndim == 3  # [L, E, F]
+    assert not is_quantized(lq["router"])  # routing stays full precision
+
+
+def test_forward_close_to_unquantized():
+    cfg = PRESETS["test-tiny"]
+    params = llama.init_params(cfg, 3)
+    qparams = quantize_params(params)
+    B, T, PAGES, PS = 2, 8, 8, 16
+    tokens = jnp.arange(B * T, dtype=jnp.int32).reshape(B, T) % cfg.vocab_size
+    positions = jnp.tile(jnp.arange(T, dtype=jnp.int32)[None], (B, 1))
+    kc, vc = llama.init_kv_cache(cfg, PAGES, PS)
+    tables = jnp.arange(B * 4, dtype=jnp.int32).reshape(B, 4)
+    slots = (tables[:, :1] * PS + jnp.arange(T)[None]).astype(jnp.int32)
+    last = jnp.full((B,), T - 1, jnp.int32)
+
+    def fwd(p):
+        logits, _, _ = llama.forward(
+            p, cfg, tokens, positions, kc, vc, tables, slots, last, attn_impl="reference"
+        )
+        return np.asarray(logits, np.float32)
+
+    a, b = fwd(params), fwd(qparams)
+    # same argmax decisions and close logits (int8 weight error is <1%)
+    assert (a.argmax(-1) == b.argmax(-1)).mean() > 0.95
+    np.testing.assert_allclose(a, b, atol=0.25, rtol=0.1)
+
+
+def test_quantized_sharding_specs():
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from dynamo_tpu.parallel.sharding import param_shardings
+
+    cfg = dataclasses.replace(PRESETS["test-tiny-moe"], tie_embeddings=False)
+    params = quantize_params(llama.init_params(cfg, 4))
+    devices = np.array(jax.devices()[:4]).reshape(2, 2)
+    mesh = Mesh(devices, ("ep", "tp"))
+    sh = param_shardings(mesh, params)
+    assert sh["layers"]["wq"]["qw"].spec == P(None, None, "tp")
+    assert sh["layers"]["wq"]["scale"].spec == P(None, "tp")
+    assert sh["layers"]["w_gate"]["qw"].spec == P(None, "ep", None, "tp")
+    assert sh["layers"]["w_gate"]["scale"].spec == P(None, "ep", "tp")
+    assert sh["lm_head"]["qw"].spec == P(None, "tp")
+    assert sh["lm_head"]["scale"].spec == P("tp")
+
+
+async def test_quantized_serving_end_to_end():
+    import aiohttp
+
+    from dynamo_tpu.launch import run_local
+
+    handles = await run_local(
+        "test-tiny", port=0, num_pages=64, max_batch_size=4, quantize="int8"
+    )
+    try:
+        async with aiohttp.ClientSession() as s:
+            r = await s.post(
+                f"http://127.0.0.1:{handles['port']}/v1/completions",
+                json={"model": "test-tiny", "prompt": "ab", "max_tokens": 4},
+            )
+            doc = await r.json()
+            assert r.status == 200
+            assert doc["usage"]["completion_tokens"] == 4
+    finally:
+        await handles["http"].stop()
+        await handles["watcher"].close()
+        for svc in handles["services"]:
+            await svc.close()
+        await handles["runtime"].close()
