@@ -56,9 +56,14 @@ def main() -> None:
         from dynamo_tpu.models.quant import quantize_params
 
         params = quantize_params(params, mode=os.environ["BENCH_QUANT"])
+    runner_kw = {}
+    if os.environ.get("BENCH_KV_DTYPE"):
+        import jax.numpy as jnp
+
+        runner_kw["cache_dtype"] = jnp.dtype(os.environ["BENCH_KV_DTYPE"])
     runner = ModelRunner(
         cfg, params, num_pages=num_pages, page_size=page_size,
-        max_batch_size=BATCH, prefill_bucket=max(ISL, 64),
+        max_batch_size=BATCH, prefill_bucket=max(ISL, 64), **runner_kw,
     )
     core = EngineCore(
         runner,

@@ -408,7 +408,12 @@ async def run_role(args: argparse.Namespace) -> None:
 
     store_server = None
     if args.serve_store_port is not None:
-        store_server = await StoreServer(host=args.host, port=args.serve_store_port).start()
+        backing = None
+        if getattr(args, "store_persist", None):
+            from dynamo_tpu.runtime.persist import PersistentStore
+
+            backing = await PersistentStore.open(args.store_persist)
+        store_server = await StoreServer(backing, host=args.host, port=args.serve_store_port).start()
         store = store_server.store
     else:
         if not args.store:
@@ -487,6 +492,10 @@ async def _amain(args: argparse.Namespace) -> None:
     if args.role != "local":
         await run_role(args)
         return
+    if args.input not in ("http", "text") and not args.input.startswith("batch:"):
+        raise SystemExit(
+            f"--input must be 'http', 'text', or 'batch:FILE.jsonl' (got {args.input!r})"
+        )
     disagg = None
     if args.disagg_threshold is not None:
         from dynamo_tpu.disagg.router import DisaggConfig
@@ -518,7 +527,14 @@ async def _amain(args: argparse.Namespace) -> None:
         else:
             await asyncio.Event().wait()
     finally:
+        # Full teardown: text/batch modes exit here normally, and leaving
+        # engines/runtime to loop-shutdown cancellation risks the
+        # shutdown-hang class the soak tests guard against.
         await handles["http"].stop()
+        await handles["watcher"].close()
+        for svc in handles["services"]:
+            await svc.close()
+        await handles["runtime"].close()
 
 
 async def run_text_input(port: int, model: str) -> None:
@@ -679,6 +695,10 @@ def main(argv: list[str] | None = None) -> None:
         help="ingress: 'http' (serve), 'text' (interactive stdin chat), or 'batch:FILE.jsonl'",
     )
     parser.add_argument("--serve-store-port", type=int, default=None, help="run the store server in this process")
+    parser.add_argument(
+        "--store-persist", default=None,
+        help="WAL path for durable (lease-less) store state; replayed on restart",
+    )
     parser.add_argument(
         "--disagg-threshold", type=int, default=None,
         help="prompts longer than this prefill remotely (enables disaggregation)",

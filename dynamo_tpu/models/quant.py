@@ -82,11 +82,3 @@ def maybe_dequant(leaf: Any, dtype: Any = jnp.bfloat16) -> jnp.ndarray:
     return leaf
 
 
-def quantized_bytes(params: dict) -> tuple[int, int]:
-    """(bytes as stored, bytes if everything were bf16) — for logs/metrics."""
-    stored = 0
-    dense = 0
-    for leaf in jax.tree.leaves(params):
-        stored += leaf.size * leaf.dtype.itemsize
-        dense += leaf.size * (2 if leaf.dtype != jnp.int8 else 2)
-    return stored, dense

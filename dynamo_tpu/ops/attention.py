@@ -78,6 +78,9 @@ def paged_attention_reference(
     k = gather_pages(k_cache, block_tables, n_kv)  # [B, S, n_kv, hd]
     v = gather_pages(v_cache, block_tables, n_kv)
     s = k.shape[1]
+    if k.dtype.itemsize < 2:  # fp8 KV cache: matmuls run in the query dtype
+        k = k.astype(q.dtype)
+        v = v.astype(q.dtype)
 
     # GQA-native: fold query heads as [kv, group] and contract against the
     # un-repeated KV — no G-times materialization, f32 only as the einsum

@@ -170,6 +170,9 @@ def _decode_kernel(
 
         k = k_buf[cur]  # [bk, W] cache dtype
         v = v_buf[cur]
+        if k.dtype.itemsize < 2:  # fp8 cache: DMA at 1 B/elem, matmul in bf16
+            k = k.astype(jnp.bfloat16)
+            v = v.astype(jnp.bfloat16)
         # Block-diagonal q: head h only overlaps its own KV head's strip, so
         # this one contraction is every head's logits against its KV head.
         s = jax.lax.dot_general(
@@ -241,9 +244,12 @@ def paged_decode_attention(
     # run at native MXU bf16 rate.
     q3 = q[:, 0].astype(jnp.float32) * scale  # [B, H, hd]
     eye = jnp.eye(n_kv, dtype=jnp.float32)
+    # Queries never drop below bf16 (an fp8 cache quantizes K/V storage, not
+    # the live queries).
+    q_dtype = k_cache.dtype if k_cache.dtype.itemsize >= 2 else jnp.bfloat16
     q_bd = jnp.einsum(
         "bkgd,kK->bkgKd", q3.reshape(b, n_kv, group, head_dim), eye
-    ).reshape(b, n_heads, width).astype(k_cache.dtype)
+    ).reshape(b, n_heads, width).astype(q_dtype)
 
     spec = pl.BlockSpec((None, n_heads, width), lambda bb, *_: (bb, 0, 0))
     kernel = functools.partial(

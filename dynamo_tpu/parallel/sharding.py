@@ -35,18 +35,6 @@ def param_shardings(mesh: Mesh, params: dict[str, Any]) -> dict[str, Any]:
 
     def spec_for(path: tuple[str, ...], leaf) -> P:
         name = path[-1]
-        # Weight-only int8 leaves ({"qw": int8, "scale": [..., d_out]}):
-        # qw shards exactly like the float weight it replaces; scale keeps
-        # only the output-channel axis (the weight spec minus its -2 axis).
-        if name in ("qw", "scale") and len(path) >= 2:
-            if name == "qw":
-                return spec_for(path[:-1], leaf)
-            # MoE detection keys off the *weight's* ndim; scale has one less.
-            proxy = type("‹ndim›", (), {"ndim": leaf.ndim + 1})()
-            base = spec_for(path[:-1], proxy)
-            if len(base) < 2:
-                return base
-            return P(*base[:-2], base[-1])
         if name == "embed":
             return P("tp", None)
         if name == "lm_head":
@@ -73,6 +61,17 @@ def param_shardings(mesh: Mesh, params: dict[str, Any]) -> dict[str, Any]:
 
     def walk(tree, path):
         if isinstance(tree, dict):
+            # Weight-only int8 leaf {"qw": int8, "scale": [..., d_out]}:
+            # qw shards exactly like the float weight it replaces (derive
+            # the spec from the real qw array — same ndim); scale keeps only
+            # the output-channel axis (the weight spec minus its -2 axis).
+            if "qw" in tree and "scale" in tree:
+                base = spec_for(path, tree["qw"])
+                scale_spec = P(*base[:-2], base[-1]) if len(base) >= 2 else base
+                return {
+                    "qw": NamedSharding(mesh, base),
+                    "scale": NamedSharding(mesh, scale_spec),
+                }
             return {k: walk(v, path + (k,)) for k, v in tree.items()}
         return NamedSharding(mesh, spec_for(path, tree))
 

@@ -1,0 +1,106 @@
+"""Durable store state: a write-ahead log for non-ephemeral keys.
+
+The deployment store holds two kinds of state: *ephemeral* records bound to
+liveness leases (instances, metrics — their owners re-register after any
+restart) and *declarative* records with no lease (GraphDeployments, static
+model registrations, object-store chunks). A store-server restart must not
+lose the declarative kind — that's the gap the reference fills with etcd's
+own persistence; here the same durability comes from a JSONL WAL:
+
+- every lease-less put/delete appends one line ``{"op", "key", "v": b64}``
+- on start, the log is replayed into the fresh MemoryStore and compacted
+  (one line per surviving key)
+
+Lease-bound records are intentionally NOT persisted: restoring an instance
+record whose owner died with the store would advertise a dead endpoint.
+
+Usage: ``StoreServer(PersistentStore.open(path), ...)`` — or
+``--store-persist PATH`` on the launch CLI's store role.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import pathlib
+from typing import Any
+
+from dynamo_tpu.runtime.discovery import MemoryStore
+
+logger = logging.getLogger(__name__)
+
+
+class PersistentStore(MemoryStore):
+    """MemoryStore + WAL for lease-less writes."""
+
+    def __init__(self, path: str | pathlib.Path) -> None:
+        super().__init__()
+        self.path = pathlib.Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._fh = None
+
+    @classmethod
+    async def open(cls, path: str | pathlib.Path) -> "PersistentStore":
+        store = cls(path)
+        await store._replay_and_compact()
+        store._fh = store.path.open("a")
+        return store
+
+    async def _replay_and_compact(self) -> None:
+        if not self.path.exists():
+            return
+        state: dict[str, bytes] = {}
+        lines = 0
+        for line in self.path.read_text().splitlines():
+            if not line.strip():
+                continue
+            lines += 1
+            try:
+                doc = json.loads(line)
+                if doc["op"] == "put":
+                    state[doc["key"]] = base64.b64decode(doc["v"])
+                elif doc["op"] == "delete":
+                    state.pop(doc["key"], None)
+            except Exception:
+                logger.warning("skipping corrupt WAL line in %s", self.path)
+        for key, value in state.items():
+            await super().put(key, value)
+        # Compact: rewrite one put per surviving key (atomic replace).
+        tmp = self.path.with_suffix(".compact")
+        with tmp.open("w") as fh:
+            for key, value in state.items():
+                fh.write(self._entry("put", key, value))
+        tmp.replace(self.path)
+        logger.info(
+            "store WAL %s: replayed %d lines -> %d keys", self.path, lines, len(state)
+        )
+
+    @staticmethod
+    def _entry(op: str, key: str, value: bytes | None = None) -> str:
+        doc: dict[str, Any] = {"op": op, "key": key}
+        if value is not None:
+            doc["v"] = base64.b64encode(value).decode()
+        return json.dumps(doc) + "\n"
+
+    def _append(self, op: str, key: str, value: bytes | None = None) -> None:
+        if self._fh is None:
+            return
+        self._fh.write(self._entry(op, key, value))
+        self._fh.flush()
+
+    async def put(self, key: str, value: bytes, lease_id: int | None = None) -> None:
+        await super().put(key, value, lease_id=lease_id)
+        if lease_id is None:
+            self._append("put", key, value)
+
+    async def delete(self, key: str) -> bool:
+        existed = await super().delete(key)
+        if existed:
+            self._append("delete", key)
+        return existed
+
+    def close_log(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None

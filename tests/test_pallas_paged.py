@@ -76,6 +76,21 @@ def test_decode_kernel_tail_block_clamps():
         pp._pages_per_block = orig
 
 
+def test_decode_kernel_fp8_cache():
+    """Sub-2-byte KV caches upcast to bf16 inside the kernel; results stay
+    close to the f32 reference (fp8 storage error only)."""
+    rng = np.random.default_rng(5)
+    q, k, v, tables, positions = _random_case(
+        rng, b=2, n_heads=8, n_kv=2, head_dim=64, page_size=16, pages_per_seq=4, max_len=64,
+    )
+    k8 = k.astype(jnp.float8_e4m3fn)
+    v8 = v.astype(jnp.float8_e4m3fn)
+    scale = 0.125
+    want = paged_attention_reference(q, k, v, tables, positions, scale=scale)
+    got = paged_decode_attention(q, k8, v8, tables, positions, scale=scale, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=0.15, rtol=0.15)
+
+
 def test_decode_kernel_length_one():
     """Position 0 (only the just-written token) must not read other pages."""
     rng = np.random.default_rng(1)
