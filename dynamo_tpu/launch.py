@@ -529,12 +529,28 @@ async def _amain(args: argparse.Namespace) -> None:
     finally:
         # Full teardown: text/batch modes exit here normally, and leaving
         # engines/runtime to loop-shutdown cancellation risks the
-        # shutdown-hang class the soak tests guard against.
-        await handles["http"].stop()
-        await handles["watcher"].close()
-        for svc in handles["services"]:
-            await svc.close()
-        await handles["runtime"].close()
+        # shutdown-hang class the soak tests guard against. One shielded
+        # task runs every step (each isolated), so a Ctrl-C arriving during
+        # teardown can't skip the later closes.
+        async def _teardown() -> None:
+            for closer in (
+                handles["http"].stop,
+                handles["watcher"].close,
+                *(svc.close for svc in handles["services"]),
+                handles["runtime"].close,
+            ):
+                try:
+                    await closer()
+                except Exception:
+                    logger.exception("teardown step %r failed", closer)
+
+        task = asyncio.ensure_future(_teardown())
+        try:
+            await asyncio.shield(task)
+        except asyncio.CancelledError:
+            if not task.done():
+                await asyncio.wait([task])
+            raise
 
 
 async def run_text_input(port: int, model: str) -> None:

@@ -29,7 +29,7 @@ import numpy as np
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.ops.attention import paged_attention, write_kv
 from dynamo_tpu.ops.norm import rms_norm
-from dynamo_tpu.models.quant import maybe_dequant as _dq
+from dynamo_tpu.models.quant import maybe_dequant as _dq, quant_matmul as _qmm
 from dynamo_tpu.ops.rope import apply_rope, rope_attention_factor, rope_frequencies
 
 Params = dict
@@ -123,8 +123,8 @@ def init_kv_cache(cfg: ModelConfig, num_pages: int, page_size: int, dtype: jnp.d
 
 
 def _mlp_dense(lp: Params, x: jnp.ndarray) -> jnp.ndarray:
-    gate = jax.nn.silu(x @ _dq(lp["w_gate"]))
-    return (gate * (x @ _dq(lp["w_up"]))) @ _dq(lp["w_down"])
+    gate = jax.nn.silu(_qmm(x, lp["w_gate"]))
+    return _qmm(gate * _qmm(x, lp["w_up"]), lp["w_down"])
 
 
 def _mlp_moe(lp: Params, x: jnp.ndarray, cfg: ModelConfig, mesh=None) -> jnp.ndarray:
@@ -150,7 +150,7 @@ def _mlp_moe(lp: Params, x: jnp.ndarray, cfg: ModelConfig, mesh=None) -> jnp.nda
             capacity=(b * t * cfg.num_experts_per_token) if cf <= 0 else None,
         )
     if cfg.shared_expert_size:
-        shared = (jax.nn.silu(xt @ _dq(lp["w_shared_gate"])) * (xt @ _dq(lp["w_shared_up"]))) @ _dq(lp["w_shared_down"])
+        shared = _qmm(jax.nn.silu(_qmm(xt, lp["w_shared_gate"])) * _qmm(xt, lp["w_shared_up"]), lp["w_shared_down"])
         if cfg.shared_expert_gated:
             shared = shared * jax.nn.sigmoid((xt @ lp["shared_gate"]).astype(jnp.float32)).astype(shared.dtype)
         out = out + shared
@@ -249,7 +249,7 @@ def forward(
     def layer_step(carry, lp):
         x, k_full, v_full, li = carry
         h = rms_norm(x, lp["attn_norm"], eps=cfg.rms_eps)
-        qp, kp, vp = h @ _dq(lp["wq"]), h @ _dq(lp["wk"]), h @ _dq(lp["wv"])
+        qp, kp, vp = _qmm(h, lp["wq"]), _qmm(h, lp["wk"]), _qmm(h, lp["wv"])
         if cfg.attention_bias:
             qp, kp, vp = qp + lp["bq"], kp + lp["bk"], vp + lp["bv"]
         q = qp.reshape(b, t, cfg.num_heads, cfg.head_dim)
@@ -267,7 +267,7 @@ def forward(
         else:
             tables_l = block_tables + li * npages
             attn = paged_attention(q, k_full, v_full, tables_l, positions, impl=attn_impl)
-        x = x + attn.reshape(b, t, cfg.q_dim) @ _dq(lp["wo"])
+        x = x + _qmm(attn.reshape(b, t, cfg.q_dim), lp["wo"])
         h2 = rms_norm(x, lp["mlp_norm"], eps=cfg.rms_eps)
         mlp = _mlp_moe(lp, h2, cfg, mesh) if cfg.is_moe else _mlp_dense(lp, h2)
         x = x + mlp
@@ -285,10 +285,11 @@ def forward(
 
     x = rms_norm(x, params["norm_f"], eps=cfg.rms_eps)
     last = jnp.take_along_axis(x, last_token_index[:, None, None], axis=1)[:, 0]  # [B, D]
-    head = params["embed"].T if cfg.tie_embeddings else _dq(params["lm_head"])
     # bf16 operands, f32 accumulate: no f32 materialization of the (huge)
-    # embedding matrix per step.
-    logits = jnp.matmul(last, head, preferred_element_type=jnp.float32)  # [B, vocab]
+    # embedding matrix per step; quantized lm_head goes through the shared
+    # scale-after-dot helper.
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = _qmm(last, head, preferred_element_type=jnp.float32)  # [B, vocab]
     return logits, k_out, v_out
 
 
@@ -323,7 +324,7 @@ def encode(
 
     def layer_step(x, lp):
         h = rms_norm(x, lp["attn_norm"], eps=cfg.rms_eps)
-        qp, kp, vp = h @ _dq(lp["wq"]), h @ _dq(lp["wk"]), h @ _dq(lp["wv"])
+        qp, kp, vp = _qmm(h, lp["wq"]), _qmm(h, lp["wk"]), _qmm(h, lp["wv"])
         if cfg.attention_bias:
             qp, kp, vp = qp + lp["bq"], kp + lp["bk"], vp + lp["bv"]
         q = apply_rope(qp.reshape(b, t, cfg.num_heads, cfg.head_dim), positions, inv_freq)
@@ -336,7 +337,7 @@ def encode(
         scores = scores + bias[:, :, None, :, :]
         probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
         attn = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v).reshape(b, t, cfg.q_dim)
-        x = x + attn @ _dq(lp["wo"])
+        x = x + _qmm(attn, lp["wo"])
         h2 = rms_norm(x, lp["mlp_norm"], eps=cfg.rms_eps)
         mlp = _mlp_moe(lp, h2, cfg) if cfg.is_moe else _mlp_dense(lp, h2)
         return x + mlp, None

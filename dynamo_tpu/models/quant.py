@@ -70,6 +70,23 @@ def quantize_params(params: dict, *, mode: str = "int8") -> dict:
     return walk(params, None)
 
 
+def quant_matmul(x: jnp.ndarray, leaf: Any, *, preferred_element_type: Any | None = None) -> jnp.ndarray:
+    """``x @ w`` for a possibly-quantized last-two-dims weight.
+
+    For int8 leaves the per-output-channel scale is applied to the matmul
+    *output* (it commutes with the contraction), so the weight operand is a
+    bare int8→bf16 convert — which XLA fuses into the dot's operand read
+    (weights stream from HBM at 1 byte/elem). Scaling the weight before the
+    dot instead materializes a dequantized copy and loses the bandwidth win.
+    """
+    if is_quantized(leaf):
+        y = jnp.matmul(
+            x, leaf["qw"].astype(x.dtype), preferred_element_type=preferred_element_type
+        )
+        return y * leaf["scale"].astype(y.dtype)
+    return jnp.matmul(x, leaf, preferred_element_type=preferred_element_type)
+
+
 def maybe_dequant(leaf: Any, dtype: Any = jnp.bfloat16) -> jnp.ndarray:
     """The read-side accessor every matmul site goes through.
 

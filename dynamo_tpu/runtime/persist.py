@@ -52,12 +52,14 @@ class PersistentStore(MemoryStore):
             return
         state: dict[str, bytes] = {}
         lines = 0
-        for line in self.path.read_text().splitlines():
-            if not line.strip():
+        # Decode per line: a torn write after a crash may leave non-UTF-8
+        # garbage in the tail, and that exact scenario must not block start.
+        for raw in self.path.read_bytes().splitlines():
+            if not raw.strip():
                 continue
             lines += 1
             try:
-                doc = json.loads(line)
+                doc = json.loads(raw.decode("utf-8"))
                 if doc["op"] == "put":
                     state[doc["key"]] = base64.b64decode(doc["v"])
                 elif doc["op"] == "delete":
@@ -86,19 +88,37 @@ class PersistentStore(MemoryStore):
     def _append(self, op: str, key: str, value: bytes | None = None) -> None:
         if self._fh is None:
             return
+        import os
+
         self._fh.write(self._entry(op, key, value))
         self._fh.flush()
+        os.fsync(self._fh.fileno())  # durable against power loss, not just process crash
 
     async def put(self, key: str, value: bytes, lease_id: int | None = None) -> None:
         await super().put(key, value, lease_id=lease_id)
         if lease_id is None:
             self._append("put", key, value)
+        else:
+            # The key may have been durable before this lease-bound rewrite;
+            # its lifetime is now lease-governed (expiry bypasses delete()),
+            # so scrub any stale WAL entry.
+            self._append("delete", key)
+
+    async def put_if_absent(self, key: str, value: bytes, lease_id: int | None = None) -> bool:
+        created = await super().put_if_absent(key, value, lease_id=lease_id)
+        if created and lease_id is None:
+            self._append("put", key, value)
+        return created
 
     async def delete(self, key: str) -> bool:
         existed = await super().delete(key)
         if existed:
             self._append("delete", key)
         return existed
+
+    async def close(self) -> None:
+        self.close_log()
+        await super().close()
 
     def close_log(self) -> None:
         if self._fh is not None:

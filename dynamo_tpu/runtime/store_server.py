@@ -134,6 +134,8 @@ class StoreServer:
             self._server = None
         for t in list(self._conn_tasks):
             t.cancel()
+        # The served store may hold resources (e.g. a persistence WAL).
+        await self.store.close()
 
 
 class StoreClient(KeyValueStore):

@@ -42,6 +42,21 @@ async def test_lease_keys_not_persisted(tmp_path):
     s2.close_log()
 
 
+async def test_put_if_absent_and_lease_conversion_logged(tmp_path):
+    wal = tmp_path / "store.wal"
+    s1 = await PersistentStore.open(wal)
+    assert await s1.put_if_absent("cards/m", b"v1")
+    assert not await s1.put_if_absent("cards/m", b"v2")  # no duplicate WAL line
+    # converting a durable key to lease-bound scrubs it from the WAL
+    lease = await s1.create_lease(ttl=30)
+    await s1.put("cards/m", b"v3", lease_id=lease.id)
+    s1.close_log()
+
+    s2 = await PersistentStore.open(wal)
+    assert await s2.get("cards/m") is None  # lease-governed: not restored
+    s2.close_log()
+
+
 async def test_corrupt_wal_lines_skipped(tmp_path):
     wal = tmp_path / "store.wal"
     s1 = await PersistentStore.open(wal)
