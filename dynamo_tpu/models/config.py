@@ -155,6 +155,20 @@ PRESETS: dict[str, ModelConfig] = {
         rope_scaling={"rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
                       "high_freq_factor": 4.0, "original_max_position_embeddings": 8192},
     ),
+    # Qwen2.5-7B: Qwen2 family (q/k/v biases, untied head, 1M-theta rope).
+    "qwen2.5-7b": ModelConfig(
+        name="qwen2.5-7b", vocab_size=152064, hidden_size=3584, num_layers=28,
+        num_heads=28, num_kv_heads=4, head_dim=128, intermediate_size=18944,
+        rope_theta=1000000.0, max_position=32768, rms_eps=1e-6,
+        attention_bias=True,
+    ),
+    # Mixtral-8x7B: 8 routed experts / top-2, no shared expert.
+    "mixtral-8x7b": ModelConfig(
+        name="mixtral-8x7b", vocab_size=32000, hidden_size=4096, num_layers=32,
+        num_heads=32, num_kv_heads=8, head_dim=128, intermediate_size=14336,
+        rope_theta=1000000.0, max_position=32768,
+        num_experts=8, num_experts_per_token=2, moe_intermediate_size=14336,
+    ),
     # DeepSeek-V3-shaped wide-EP config (BASELINE tracked config #4):
     # 256 routed experts / top-8, GQA attention stand-in for MLA (MLA-specific
     # latent projections are tracked separately; expert-parallel serving is

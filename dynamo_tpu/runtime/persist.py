@@ -39,6 +39,7 @@ class PersistentStore(MemoryStore):
         self.path = pathlib.Path(path)
         self.path.parent.mkdir(parents=True, exist_ok=True)
         self._fh = None
+        self._durable: set[str] = set()  # keys with a live WAL put entry
 
     @classmethod
     async def open(cls, path: str | pathlib.Path) -> "PersistentStore":
@@ -68,6 +69,7 @@ class PersistentStore(MemoryStore):
                 logger.warning("skipping corrupt WAL line in %s", self.path)
         for key, value in state.items():
             await super().put(key, value)
+        self._durable = set(state)
         # Compact: rewrite one put per surviving key (atomic replace).
         tmp = self.path.with_suffix(".compact")
         with tmp.open("w") as fh:
@@ -85,35 +87,43 @@ class PersistentStore(MemoryStore):
             doc["v"] = base64.b64encode(value).decode()
         return json.dumps(doc) + "\n"
 
-    def _append(self, op: str, key: str, value: bytes | None = None) -> None:
+    async def _append(self, op: str, key: str, value: bytes | None = None) -> None:
         if self._fh is None:
             return
+        import asyncio
         import os
 
         self._fh.write(self._entry(op, key, value))
         self._fh.flush()
-        os.fsync(self._fh.fileno())  # durable against power loss, not just process crash
+        if op == "put":
+            self._durable.add(key)
+        else:
+            self._durable.discard(key)
+        # Durable against power loss, not just process crash — but fsync is
+        # a blocking syscall, so keep it off the store server's event loop
+        # (a stalled loop delays every op and lease keepalive).
+        await asyncio.get_running_loop().run_in_executor(None, os.fsync, self._fh.fileno())
 
     async def put(self, key: str, value: bytes, lease_id: int | None = None) -> None:
         await super().put(key, value, lease_id=lease_id)
         if lease_id is None:
-            self._append("put", key, value)
-        else:
-            # The key may have been durable before this lease-bound rewrite;
-            # its lifetime is now lease-governed (expiry bypasses delete()),
-            # so scrub any stale WAL entry.
-            self._append("delete", key)
+            await self._append("put", key, value)
+        elif key in self._durable:
+            # A previously durable key rewritten lease-bound: its lifetime is
+            # now lease-governed (expiry bypasses delete()), so scrub the
+            # stale WAL entry. Ephemeral-only keys never touch the WAL.
+            await self._append("delete", key)
 
     async def put_if_absent(self, key: str, value: bytes, lease_id: int | None = None) -> bool:
         created = await super().put_if_absent(key, value, lease_id=lease_id)
         if created and lease_id is None:
-            self._append("put", key, value)
+            await self._append("put", key, value)
         return created
 
     async def delete(self, key: str) -> bool:
         existed = await super().delete(key)
-        if existed:
-            self._append("delete", key)
+        if existed and key in self._durable:
+            await self._append("delete", key)
         return existed
 
     async def close(self) -> None:

@@ -35,6 +35,11 @@ def main(argv: list[str] | None = None) -> None:
     p_cfg = sub.add_parser("config", help="print the merged service config")
     p_cfg.add_argument("-f", "--config", default=None)
 
+    p_build = sub.add_parser("build", help="package a graph into a deployable archive")
+    p_build.add_argument("ref")
+    p_build.add_argument("-f", "--config", default=None)
+    p_build.add_argument("-o", "--output", default=None, help="output .tar.gz (default <graph>.tar.gz)")
+
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -45,6 +50,11 @@ def main(argv: list[str] | None = None) -> None:
         print(load_graph(args.ref).describe())
     elif args.cmd == "config":
         print(json.dumps(load_service_config(args.config), indent=2))
+    elif args.cmd == "build":
+        from dynamo_tpu.sdk.build import build_archive
+
+        out = build_archive(args.ref, config_path=args.config, output=args.output)
+        print(f"BUILT {out}")
     elif args.cmd == "serve":
         graph = load_graph(args.ref)
         config = load_service_config(args.config)

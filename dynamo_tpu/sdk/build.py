@@ -1,0 +1,117 @@
+"""``dynamo build``: package a service graph into a deployable archive.
+
+The archive is a tar.gz containing the graph's *user* source modules (the
+modules defining its services, with parent ``__init__.py`` files so
+``src/`` is a regular importable tree), the service config, and
+``manifest.json`` (graph ref, service inventory, resources, build
+metadata). A deploy host with dynamo-tpu installed extracts the archive,
+puts ``src/`` on ``sys.path``, and serves the manifest's graph ref —
+framework-internal modules (``dynamo_tpu.*``) are intentionally not
+packaged; they come with the installed framework.
+
+Parity: reference ``dynamo build`` packaging (`deploy/sdk` — builds a
+deployable service artifact consumed by the operator's image pipeline).
+"""
+
+from __future__ import annotations
+
+import inspect
+import io
+import json
+import pathlib
+import sys
+import tarfile
+import time
+from typing import Any
+
+from dynamo_tpu.sdk.graph import Graph, load_graph
+
+
+def _manifest(ref: str, graph: Graph, config: dict[str, Any]) -> dict[str, Any]:
+    return {
+        "schema": 1,
+        "graph": ref,
+        "entry": graph.entry.name,
+        "built_at": time.time(),
+        "services": [
+            {
+                "name": s.name,
+                "namespace": s.namespace,
+                "component": s.component,
+                "replicas": s.replicas,
+                "resources": s.resources,
+                "endpoints": [e.name for e in s.endpoints],
+                "apis": [f"{a.http_method} {a.path}" for a in s.apis],
+                "module": s.cls.__module__,
+            }
+            for s in graph.services
+        ],
+        "config": config,
+    }
+
+
+def build_archive(
+    ref: str,
+    *,
+    config_path: str | None = None,
+    output: str | None = None,
+) -> pathlib.Path:
+    """module:Service ref -> <name>.tar.gz with sources + manifest."""
+    from dynamo_tpu.sdk.serving import load_service_config
+
+    graph = load_graph(ref)
+    config = load_service_config(config_path)
+    module_names = {s.cls.__module__ for s in graph.services}
+    out = pathlib.Path(output or f"{graph.entry.name.lower()}.tar.gz")
+    manifest = _manifest(ref, graph, config)
+
+    with tarfile.open(out, "w:gz") as tar:
+        def add_bytes(name: str, data: bytes) -> None:
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            info.mtime = int(time.time())
+            tar.addfile(info, io.BytesIO(data))
+
+        add_bytes("manifest.json", json.dumps(manifest, indent=2).encode())
+        if config_path:
+            add_bytes(f"config{pathlib.Path(config_path).suffix}", pathlib.Path(config_path).read_bytes())
+        packaged: set[str] = set()
+        for module_name in sorted(module_names):
+            # Framework-internal graphs ship with the installed dynamo-tpu —
+            # packaging them would require shadowing the whole framework
+            # package at import time. Only user graph modules go in.
+            if module_name == "dynamo_tpu" or module_name.startswith("dynamo_tpu."):
+                continue
+            module = sys.modules[module_name]
+            src_file = inspect.getsourcefile(module)
+            if src_file is None:
+                continue
+            # store under src/<dotted path as path>; parent packages get
+            # their __init__.py so src/ is a regular importable tree
+            rel = module_name.replace(".", "/") + ".py"
+            add_bytes(f"src/{rel}", pathlib.Path(src_file).read_bytes())
+            packaged.add(module_name)
+            parts = module_name.split(".")[:-1]
+            for i in range(1, len(parts) + 1):
+                pkg = ".".join(parts[:i])
+                if pkg in packaged:
+                    continue
+                pkg_mod = sys.modules.get(pkg)
+                init_file = inspect.getsourcefile(pkg_mod) if pkg_mod else None
+                data = pathlib.Path(init_file).read_bytes() if init_file else b""
+                add_bytes("src/" + pkg.replace(".", "/") + "/__init__.py", data)
+                packaged.add(pkg)
+    return out
+
+
+def load_archive(path: str | pathlib.Path, extract_to: str | pathlib.Path) -> dict[str, Any]:
+    """Extract an archive and return its manifest; ``extract_to/src`` is
+    importable (add it to sys.path to serve the packaged graph)."""
+    dest = pathlib.Path(extract_to)
+    dest.mkdir(parents=True, exist_ok=True)
+    with tarfile.open(path, "r:gz") as tar:
+        tar.extractall(dest, filter="data")
+    manifest = json.loads((dest / "manifest.json").read_text())
+    if int(manifest.get("schema", 0)) != 1:
+        raise ValueError(f"unsupported archive schema {manifest.get('schema')!r}")
+    return manifest

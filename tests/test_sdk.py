@@ -163,6 +163,56 @@ async def test_llm_graph_end_to_end_mock():
         await handles.close()
 
 
+def test_build_archive_roundtrip(tmp_path, monkeypatch):
+    """`dynamo build` packages user graph modules + manifest; the extracted
+    src/ tree is genuinely importable on a deploy host (framework installed,
+    archive sources on sys.path)."""
+    import subprocess
+    import sys
+
+    from dynamo_tpu.sdk.build import build_archive, load_archive
+
+    # a user graph package, outside dynamo_tpu
+    pkg = tmp_path / "proj" / "mygraphs"
+    pkg.mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "agg.py").write_text(
+        "from dynamo_tpu.sdk import api, depends, endpoint, service\n\n"
+        "@service(namespace='u')\n"
+        "class Worker:\n"
+        "    @endpoint()\n"
+        "    async def generate(self, request, context):\n"
+        "        yield {'ok': True}\n\n"
+        "@service(namespace='u')\n"
+        "class Frontend:\n"
+        "    worker = depends(Worker)\n"
+    )
+    monkeypatch.syspath_prepend(str(tmp_path / "proj"))
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text("Worker:\n  model: test-tiny\n")
+    out = build_archive(
+        "mygraphs.agg:Frontend", config_path=str(cfg), output=str(tmp_path / "agg.tar.gz"),
+    )
+    assert out.exists()
+    manifest = load_archive(out, tmp_path / "x")
+    assert manifest["graph"] == "mygraphs.agg:Frontend"
+    assert [s["name"] for s in manifest["services"]] == ["Worker", "Frontend"]
+    assert manifest["config"]["Worker"]["model"] == "test-tiny"
+    src_root = tmp_path / "x" / "src"
+    assert (src_root / "mygraphs" / "agg.py").exists()
+    assert (src_root / "mygraphs" / "__init__.py").exists()
+    # deploy-host import: installed framework + ONLY the extracted sources
+    env = {"PYTHONPATH": f"{src_root}:/root/repo", "JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin"}
+    check = subprocess.run(
+        [sys.executable, "-c",
+         "from dynamo_tpu.sdk.graph import load_graph; "
+         "g = load_graph('mygraphs.agg:Frontend'); "
+         "print([s.name for s in g.services])"],
+        capture_output=True, text=True, env=env,
+    )
+    assert "['Worker', 'Frontend']" in check.stdout, check.stderr
+
+
 async def test_serve_fleet_subprocesses(tmp_path):
     """serve_entry subprocess + store server + TCP transport, called from a
     separate client process-side runtime."""
