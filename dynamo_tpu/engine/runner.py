@@ -437,6 +437,9 @@ class ModelRunner:
         0 inside this chunk, so attending only the in-flight K/V is exact.
         Chunk-continuations and decode use the paged path (they must read
         the cache)."""
+        if self.cfg.attn_type == "mla":
+            # MLA has no ring path (latent cache attends paged only).
+            return self.attn_impl
         t = padded.tokens.shape[1]
         if (
             self.mesh is not None

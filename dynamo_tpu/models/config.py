@@ -46,6 +46,14 @@ class ModelConfig:
     # Multimodal: the placeholder token id image embeddings substitute for
     # (None = text-only model); vision tower geometry lives in VisionConfig.
     image_token_id: int | None = None
+    # Attention family: "gqa" (default) or "mla" (DeepSeek latent attention,
+    # models/mla.py). MLA caches one latent + rope key per token.
+    attn_type: str = "gqa"
+    q_lora_rank: int = 0  # 0 = direct q projection
+    kv_lora_rank: int = 0
+    qk_nope_head_dim: int = 0
+    qk_rope_head_dim: int = 0
+    v_head_dim: int = 0
 
     @property
     def q_dim(self) -> int:
@@ -60,8 +68,11 @@ class ModelConfig:
         return self.num_experts > 0
 
     def kv_bytes_per_token(self) -> int:
-        """Bytes of KV cache per token across all layers (2 = K and V)."""
+        """Bytes of KV cache per token across all layers (2 = K and V; MLA
+        caches one latent + rope key instead)."""
         itemsize = 2 if self.dtype == "bfloat16" else 4
+        if self.attn_type == "mla":
+            return self.num_layers * (self.kv_lora_rank + self.qk_rope_head_dim) * itemsize
         return 2 * self.num_layers * self.kv_dim * itemsize
 
     def param_count(self) -> int:
@@ -104,6 +115,13 @@ class ModelConfig:
             or (config.get("n_shared_experts", 0) or 0) * (config.get("moe_intermediate_size", 0) or 0),
             shared_expert_gated=config.get("model_type") == "qwen2_moe",
             attention_bias=bool(config.get("attention_bias", config.get("model_type") in ("qwen2", "qwen2_moe"))),
+            # DeepSeek-V2/V3: MLA signalled by the latent-rank keys.
+            attn_type="mla" if config.get("kv_lora_rank") else "gqa",
+            q_lora_rank=config.get("q_lora_rank") or 0,
+            kv_lora_rank=config.get("kv_lora_rank") or 0,
+            qk_nope_head_dim=config.get("qk_nope_head_dim") or 0,
+            qk_rope_head_dim=config.get("qk_rope_head_dim") or 0,
+            v_head_dim=config.get("v_head_dim") or 0,
         )
 
 
@@ -170,13 +188,23 @@ PRESETS: dict[str, ModelConfig] = {
         num_experts=8, num_experts_per_token=2, moe_intermediate_size=14336,
     ),
     # DeepSeek-V3-shaped wide-EP config (BASELINE tracked config #4):
-    # 256 routed experts / top-8, GQA attention stand-in for MLA (MLA-specific
-    # latent projections are tracked separately; expert-parallel serving is
-    # what this preset exercises — see dynamo_tpu/parallel/moe.py).
+    # 256 routed experts / top-8 with real MLA (latent KV cache, absorbed
+    # up-projections — models/mla.py); expert-parallel serving exercises
+    # dynamo_tpu/parallel/moe.py.
     "deepseek-v3-ep": ModelConfig(
         name="deepseek-v3-ep", vocab_size=129280, hidden_size=7168,
         num_layers=61, num_heads=128, num_kv_heads=128, head_dim=64,
         intermediate_size=18432, rope_theta=10000.0, max_position=163840,
         num_experts=256, num_experts_per_token=8, moe_intermediate_size=2048,
+        attn_type="mla", q_lora_rank=1536, kv_lora_rank=512,
+        qk_nope_head_dim=128, qk_rope_head_dim=64, v_head_dim=128,
+    ),
+    # MLA test model (tiny): latent cache + absorbed projections.
+    "test-tiny-mla": ModelConfig(
+        name="test-tiny-mla", vocab_size=256, hidden_size=64, num_layers=2,
+        num_heads=4, num_kv_heads=4, head_dim=16, intermediate_size=128,
+        rope_theta=10000.0, max_position=512, tie_embeddings=True, dtype="float32",
+        attn_type="mla", q_lora_rank=32, kv_lora_rank=24,
+        qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
     ),
 }

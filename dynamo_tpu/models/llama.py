@@ -53,11 +53,20 @@ def init_params(cfg: ModelConfig, rng: jax.Array | int = 0) -> Params:
     layers = {
         "attn_norm": jnp.ones((l, d), dt),
         "mlp_norm": jnp.ones((l, d), dt),
-        "wq": w(keys[0], (l, d, q), d),
-        "wk": w(keys[1], (l, d, kv), d),
-        "wv": w(keys[2], (l, d, kv), d),
-        "wo": w(keys[3], (l, q, d), q),
     }
+    if cfg.attn_type == "mla":
+        from dynamo_tpu.models.mla import init_mla_params
+
+        layers.update(init_mla_params(cfg, keys[0], dt, l))
+    else:
+        layers.update(
+            {
+                "wq": w(keys[0], (l, d, q), d),
+                "wk": w(keys[1], (l, d, kv), d),
+                "wv": w(keys[2], (l, d, kv), d),
+                "wo": w(keys[3], (l, q, d), q),
+            }
+        )
     if cfg.attention_bias:
         layers.update(
             {
@@ -118,6 +127,16 @@ def init_kv_cache(cfg: ModelConfig, num_pages: int, page_size: int, dtype: jnp.d
     slices (fresh intermediates XLA can fuse), never the cache itself.
     """
     dt = dtype or param_dtype(cfg)
+    if cfg.attn_type == "mla":
+        # MLA: k_cache holds the per-token latents, v_cache the decoupled
+        # rope keys (models/mla.py) — same paged geometry, ~7x fewer bytes.
+        from dynamo_tpu.models.mla import mla_cache_widths
+
+        wk, wv = mla_cache_widths(cfg)
+        return (
+            jnp.zeros((cfg.num_layers, num_pages, page_size, wk), dt),
+            jnp.zeros((cfg.num_layers, num_pages, page_size, wv), dt),
+        )
     shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads * cfg.head_dim)
     return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
 
@@ -246,9 +265,30 @@ def forward(
         # A far-future sentinel position hides them from every real query.
         ring_pos = jnp.where(slot_mapping == 0, jnp.int32(2**30), positions)
 
+    mla = cfg.attn_type == "mla"
+    if mla:
+        assert not ring, "MLA does not support the ring (sp) prefill path yet"
+        inv_freq_mla = jnp.asarray(
+            rope_frequencies(cfg.qk_rope_head_dim, theta=cfg.rope_theta, scaling=cfg.rope_scaling)
+        )
+
     def layer_step(carry, lp):
         x, k_full, v_full, li = carry
         h = rms_norm(x, lp["attn_norm"], eps=cfg.rms_eps)
+        if mla:
+            from dynamo_tpu.models.mla import mla_attention
+
+            attn_out, k_full, v_full = mla_attention(
+                lp, cfg, h, positions, k_full, v_full,
+                block_tables + li * npages,
+                slot_mapping + li * (npages * ps),
+                inv_freq_mla,
+                attn_mscale=attn_mscale,
+            )
+            x = x + attn_out
+            h2 = rms_norm(x, lp["mlp_norm"], eps=cfg.rms_eps)
+            mlp = _mlp_moe(lp, h2, cfg, mesh) if cfg.is_moe else _mlp_dense(lp, h2)
+            return (x + mlp, k_full, v_full, li + 1), None
         qp, kp, vp = _qmm(h, lp["wq"]), _qmm(h, lp["wk"]), _qmm(h, lp["wv"])
         if cfg.attention_bias:
             qp, kp, vp = qp + lp["bq"], kp + lp["bk"], vp + lp["bv"]

@@ -195,6 +195,55 @@ class _LazyLeaf:
         return np.stack(out_layers).astype(self.dtype, copy=False)
 
 
+# MLA per-layer sources (DeepSeek-V2/V3 HF names). kv_b_proj packs per-head
+# [K_nope; V] row blocks and is split by _KvBLeaf.
+_MLA_MAP: dict[str, tuple[tuple[str, ...], bool]] = {
+    "w_q_a": (("self_attn.q_a_proj.weight",), True),
+    "q_norm": (("self_attn.q_a_layernorm.weight",), False),
+    "w_q_b": (("self_attn.q_b_proj.weight",), True),
+    "w_q": (("self_attn.q_proj.weight",), True),
+    "w_kv_a": (("self_attn.kv_a_proj_with_mqa.weight",), True),
+    "kv_norm": (("self_attn.kv_a_layernorm.weight",), False),
+    "wo_mla": (("self_attn.o_proj.weight",), True),
+}
+
+
+class _KvBLeaf:
+    """Stacked [L, r_kv, H, seg_width] view over per-layer kv_b_proj tensors.
+
+    kv_b_proj is torch-[H*(dn+dv), r_kv]; head h's rows are
+    ``h*(dn+dv) + offset .. + offset + width`` (offset 0/width dn for W_uk,
+    offset dn/width dv for W_uv). Reads materialize one layer's tensor
+    (~MBs) and slice — per-head lazy slicing isn't worth the complexity.
+    """
+
+    def __init__(self, index: "CheckpointIndex", num_layers: int, n_heads: int,
+                 dn: int, dv: int, offset: int, width: int, dtype) -> None:
+        self.index = index
+        self.shape = (num_layers, index.shape("model.layers.0.self_attn.kv_b_proj.weight")[1], n_heads, width)
+        self.n_heads, self.seg = n_heads, dn + dv
+        self.offset, self.width = offset, width
+        self.dtype = dtype
+        self.ndim = 4
+
+    def per_layer_name(self, li: int) -> str:
+        return f"model.layers.{li}.self_attn.kv_b_proj.weight"
+
+    def __getitem__(self, idx) -> np.ndarray:
+        if not isinstance(idx, tuple):
+            idx = (idx,)
+        idx = tuple(i if isinstance(i, slice) else slice(i, i + 1) for i in idx)
+        idx = idx + (slice(None),) * (4 - len(idx))
+        out_layers = []
+        for li in range(*idx[0].indices(self.shape[0])):
+            full = np.asarray(self.index.get_slice(self.per_layer_name(li))[:])  # [H*seg, r_kv]
+            per_head = full.reshape(self.n_heads, self.seg, -1)  # [H, dn+dv, r_kv]
+            part = per_head[:, self.offset : self.offset + self.width, :]  # [H, w, r_kv]
+            arr = np.transpose(part, (2, 0, 1))  # [r_kv, H, w]
+            out_layers.append(arr[idx[1], :, :][:, idx[2], :][:, :, idx[3]])
+        return np.stack(out_layers).astype(self.dtype, copy=False)
+
+
 def _leaf_specs(index: CheckpointIndex, cfg: ModelConfig, dtype: np.dtype) -> dict[str, Any]:
     """Build the params pytree of _LazyLeaf / lazy top-level reads."""
     d, l = cfg.hidden_size, cfg.num_layers
@@ -207,9 +256,32 @@ def _leaf_specs(index: CheckpointIndex, cfg: ModelConfig, dtype: np.dtype) -> di
             index, (l, *shp), lambda li, s=suffixes, t=transpose: [(_find(index, s, li), t)], dtype
         )
 
-    layers: dict[str, Any] = {
-        name: simple(suffixes, t) for name, (suffixes, t) in _LAYER_MAP.items() if name not in ("w_gate", "w_up", "w_down")
-    }
+    if cfg.attn_type == "mla":
+        layers = {
+            name: simple(suffixes, t)
+            for name, (suffixes, t) in _LAYER_MAP.items()
+            if name in ("attn_norm", "mlp_norm")
+        }
+        for name, (suffixes, t) in _MLA_MAP.items():
+            if name in ("w_q_a", "q_norm", "w_q_b") and cfg.q_lora_rank <= 0:
+                continue
+            if name == "w_q" and cfg.q_lora_rank > 0:
+                continue
+            layers[name] = simple(suffixes, t)
+        layers["w_uk"] = _KvBLeaf(
+            index, l, cfg.num_heads, cfg.qk_nope_head_dim, cfg.v_head_dim,
+            0, cfg.qk_nope_head_dim, dtype,
+        )
+        layers["w_uv"] = _KvBLeaf(
+            index, l, cfg.num_heads, cfg.qk_nope_head_dim, cfg.v_head_dim,
+            cfg.qk_nope_head_dim, cfg.v_head_dim, dtype,
+        )
+    else:
+        layers = {
+            name: simple(suffixes, t)
+            for name, (suffixes, t) in _LAYER_MAP.items()
+            if name not in ("w_gate", "w_up", "w_down")
+        }
     if cfg.attention_bias:
         for name, (suffixes, t) in _BIAS_MAP.items():
             layers[name] = simple(suffixes, t)
@@ -279,6 +351,8 @@ def _consumed_names(specs: dict, num_layers: int) -> set[str]:
             if isinstance(leaf, _LazyLeaf):
                 for li in range(num_layers):
                     names.update(n for n, _t in leaf.per_layer(li))
+            elif isinstance(leaf, _KvBLeaf):
+                names.update(leaf.per_layer_name(li) for li in range(num_layers))
             else:
                 names.add(leaf.name)
 
@@ -398,9 +472,22 @@ def save_params(
     if cfg.rope_scaling:
         hf_cfg["rope_scaling"] = cfg.rope_scaling
     hf_cfg["attention_bias"] = cfg.attention_bias
-    if cfg.is_moe:
+    if cfg.attn_type == "mla":
         hf_cfg.update(
-            model_type="qwen2_moe" if cfg.shared_expert_gated or not cfg.shared_expert_size else "deepseek_v2",
+            model_type="deepseek_v3",
+            architectures=["DeepseekV3ForCausalLM"],
+            q_lora_rank=cfg.q_lora_rank or None,
+            kv_lora_rank=cfg.kv_lora_rank,
+            qk_nope_head_dim=cfg.qk_nope_head_dim,
+            qk_rope_head_dim=cfg.qk_rope_head_dim,
+            v_head_dim=cfg.v_head_dim,
+        )
+    if cfg.is_moe:
+        if cfg.attn_type != "mla":  # MLA already pinned model_type deepseek_v3
+            hf_cfg["model_type"] = (
+                "qwen2_moe" if cfg.shared_expert_gated or not cfg.shared_expert_size else "deepseek_v2"
+            )
+        hf_cfg.update(
             num_experts=cfg.num_experts,
             num_experts_per_tok=cfg.num_experts_per_token,
             moe_intermediate_size=cfg.moe_intermediate_size,
@@ -428,7 +515,20 @@ def save_params(
         for leaf, (suffixes, transpose) in _LAYER_MAP.items():
             if cfg.is_moe and leaf in _MOE_EXPERT_MAP:
                 continue
+            if cfg.attn_type == "mla" and leaf in ("wq", "wk", "wv", "wo"):
+                continue
             put(base + suffixes[0], lp[leaf][li], transpose)
+        if cfg.attn_type == "mla":
+            for leaf, (suffixes, transpose) in _MLA_MAP.items():
+                if leaf in lp:
+                    put(base + suffixes[0], lp[leaf][li], transpose)
+            # kv_b_proj: interleave per-head [K_nope; V] row blocks
+            uk = np.asarray(lp["w_uk"][li])  # [r_kv, H, dn]
+            uv = np.asarray(lp["w_uv"][li])  # [r_kv, H, dv]
+            per_head = np.concatenate(
+                [np.transpose(uk, (1, 2, 0)), np.transpose(uv, (1, 2, 0))], axis=1
+            )  # [H, dn+dv, r_kv]
+            put(base + "self_attn.kv_b_proj.weight", per_head.reshape(-1, per_head.shape[-1]), False)
         if cfg.attention_bias:
             for leaf, (suffixes, transpose) in _BIAS_MAP.items():
                 put(base + suffixes[0], lp[leaf][li], transpose)

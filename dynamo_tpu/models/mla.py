@@ -1,0 +1,184 @@
+"""Multi-head Latent Attention (DeepSeek-V2/V3) on the paged cache.
+
+MLA caches a single shared **latent** per token — the KV-compressed vector
+``c`` (kv_lora_rank wide) plus a decoupled rope key — instead of per-head
+K/V. Per-token cache cost drops from ``2 * n_kv * head_dim`` to
+``kv_lora_rank + rope_dim`` (e.g. V3: 576 values vs 32k for an equivalent
+MHA), which is the architecture's whole point for long-context serving.
+
+Implementation is the **absorbed** formulation: the per-head up-projections
+``W_uk``/``W_uv`` never materialize per-head K/V. Queries are projected into
+latent space (``q_nope @ W_uk``) so attention scores and the weighted sum
+run directly against the cached latents; ``W_uv`` applies once to the
+attention output. Prefill and decode share the path (same trick as the
+dense forward), so chunked prefill/prefix reuse work unchanged.
+
+Paged-cache mapping — no engine changes needed:
+
+- ``k_cache`` stores the latents (width ``kv_lora_rank``)
+- ``v_cache`` stores the rope keys (width ``qk_rope_head_dim``)
+
+Both are ordinary ``[L, pages, page_size, W]`` arrays, so the allocator,
+prefix cache, tier offload, and disagg transfer treat MLA pages exactly
+like GQA pages. Attention itself uses the gather formulation (the latent
+cache is ~7x smaller than a GQA cache, so the gather's HBM cost is already
+below what the Pallas kernel saves on dense models).
+
+Parity: the MLA serving capability the reference gets from SGLang/vLLM's
+DeepSeek support (`examples/sglang`, BASELINE config #4).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.ops.norm import rms_norm
+from dynamo_tpu.ops.rope import apply_rope
+
+NEG_INF = -1e30
+
+Params = dict
+
+
+def init_mla_params(cfg: ModelConfig, key: jax.Array, dt, num_layers: int) -> dict[str, jnp.ndarray]:
+    """MLA attention leaves, layers stacked on the leading axis."""
+    d = cfg.hidden_size
+    h = cfg.num_heads
+    l = num_layers
+    r_q, r_kv = cfg.q_lora_rank, cfg.kv_lora_rank
+    dn, dr, dv = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+    keys = jax.random.split(key, 6)
+
+    def w(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32) * (fan_in**-0.5)).astype(dt)
+
+    leaves = {
+        # x -> compressed kv latent + decoupled rope key (shared, 1 "head")
+        "w_kv_a": w(keys[0], (l, d, r_kv + dr), d),
+        "kv_norm": jnp.ones((l, r_kv), dt),
+        # latent -> per-head K_nope / V
+        "w_uk": w(keys[1], (l, r_kv, h, dn), r_kv),
+        "w_uv": w(keys[2], (l, r_kv, h, dv), r_kv),
+        "wo_mla": w(keys[3], (l, h * dv, d), h * dv),
+    }
+    if r_q > 0:
+        leaves["w_q_a"] = w(keys[4], (l, d, r_q), d)
+        leaves["q_norm"] = jnp.ones((l, r_q), dt)
+        leaves["w_q_b"] = w(keys[5], (l, r_q, h * (dn + dr)), r_q)
+    else:
+        leaves["w_q"] = w(keys[4], (l, d, h * (dn + dr)), d)
+    return leaves
+
+
+def mla_cache_widths(cfg: ModelConfig) -> tuple[int, int]:
+    """(k_cache width, v_cache width): latents and rope keys."""
+    return cfg.kv_lora_rank, cfg.qk_rope_head_dim
+
+
+def mla_attention(
+    lp: Params,
+    cfg: ModelConfig,
+    h: jnp.ndarray,  # [B, T, D] normed input
+    positions: jnp.ndarray,  # i32[B, T]
+    c_cache: jnp.ndarray,  # [P, ps, r_kv]  (the layer's k_cache slice view)
+    r_cache: jnp.ndarray,  # [P, ps, dr]    (the layer's v_cache slice view)
+    block_tables: jnp.ndarray,  # i32[B, pages_per_seq]
+    slot_mapping: jnp.ndarray,  # i32[B, T]
+    inv_freq: jnp.ndarray,  # [qk_rope_head_dim // 2] (rope-dim frequencies)
+    attn_mscale: float = 1.0,  # YaRN temperature (mscale^2), applied to logits
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One MLA layer: returns (attn_out [B,T,D], c_cache, r_cache)."""
+    b, t, _ = h.shape
+    n_heads = cfg.num_heads
+    r_kv, dn, dr, dv = cfg.kv_lora_rank, cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+
+    # -- latent + rope key, written through to the paged cache -------------
+    kv_a = h @ lp["w_kv_a"]  # [B, T, r_kv + dr]
+    c = rms_norm(kv_a[..., :r_kv], lp["kv_norm"], eps=cfg.rms_eps)
+    k_rope = apply_rope(kv_a[..., None, r_kv:], positions, inv_freq)[:, :, 0]  # [B,T,dr]
+
+    num_pages, ps, _ = c_cache.shape
+    slots = slot_mapping.reshape(-1)
+    c_flat = c_cache.reshape(num_pages * ps, r_kv).at[slots].set(
+        c.reshape(-1, r_kv).astype(c_cache.dtype)
+    )
+    r_flat = r_cache.reshape(num_pages * ps, dr).at[slots].set(
+        k_rope.reshape(-1, dr).astype(r_cache.dtype)
+    )
+    c_cache = c_flat.reshape(num_pages, ps, r_kv)
+    r_cache = r_flat.reshape(num_pages, ps, dr)
+
+    # -- queries, absorbed into latent space -------------------------------
+    if "w_q_a" in lp:
+        q_a = rms_norm(h @ lp["w_q_a"], lp["q_norm"], eps=cfg.rms_eps)
+        q = (q_a @ lp["w_q_b"]).reshape(b, t, n_heads, dn + dr)
+    else:
+        q = (h @ lp["w_q"]).reshape(b, t, n_heads, dn + dr)
+    q_nope, q_rope = q[..., :dn], q[..., dn:]
+    q_rope = apply_rope(q_rope, positions, inv_freq)
+    # absorb W_uk: scores live in latent space
+    q_lat = jnp.einsum("bthn,rhn->bthr", q_nope, lp["w_uk"])  # [B,T,H,r_kv]
+
+    # -- gather this batch's pages and attend ------------------------------
+    pages_per_seq = block_tables.shape[1]
+    s = pages_per_seq * ps
+    c_pages = c_cache[block_tables.reshape(-1)].reshape(b, s, r_kv)
+    r_pages = r_cache[block_tables.reshape(-1)].reshape(b, s, dr)
+
+    scale = (dn + dr) ** -0.5 * attn_mscale
+    logits = (
+        jnp.einsum("bthr,bsr->bhts", q_lat, c_pages, preferred_element_type=jnp.float32)
+        + jnp.einsum("bthr,bsr->bhts", q_rope, r_pages, preferred_element_type=jnp.float32)
+    ) * scale
+    key_pos = jnp.arange(s, dtype=jnp.int32)
+    mask = key_pos[None, None, :] <= positions[:, :, None]  # [B, T, S]
+    logits = jnp.where(mask[:, None, :, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+
+    out_lat = jnp.einsum(
+        "bhts,bsr->bthr", probs.astype(c_pages.dtype), c_pages, preferred_element_type=jnp.float32
+    )  # [B, T, H, r_kv]
+    out = jnp.einsum("bthr,rhv->bthv", out_lat.astype(h.dtype), lp["w_uv"])  # [B,T,H,dv]
+    return out.reshape(b, t, n_heads * dv) @ lp["wo_mla"], c_cache, r_cache
+
+
+def mla_attention_naive(
+    lp: Params,
+    cfg: ModelConfig,
+    h: jnp.ndarray,  # [B, T, D]
+    positions: jnp.ndarray,
+    inv_freq: jnp.ndarray,
+    attn_mscale: float = 1.0,
+) -> jnp.ndarray:
+    """Golden reference: materialize per-head K/V (no cache, full self-attn).
+
+    The absorbed paged formulation must match this on whole sequences."""
+    b, t, _ = h.shape
+    n_heads = cfg.num_heads
+    r_kv, dn, dr, dv = cfg.kv_lora_rank, cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+
+    kv_a = h @ lp["w_kv_a"]
+    c = rms_norm(kv_a[..., :r_kv], lp["kv_norm"], eps=cfg.rms_eps)
+    k_rope = apply_rope(kv_a[..., None, r_kv:], positions, inv_freq)  # [B,T,1,dr]
+    k_nope = jnp.einsum("btr,rhn->bthn", c, lp["w_uk"])  # [B,T,H,dn]
+    v = jnp.einsum("btr,rhv->bthv", c, lp["w_uv"])  # [B,T,H,dv]
+
+    if "w_q_a" in lp:
+        q_a = rms_norm(h @ lp["w_q_a"], lp["q_norm"], eps=cfg.rms_eps)
+        q = (q_a @ lp["w_q_b"]).reshape(b, t, n_heads, dn + dr)
+    else:
+        q = (h @ lp["w_q"]).reshape(b, t, n_heads, dn + dr)
+    q_nope, q_rope = q[..., :dn], q[..., dn:]
+    q_rope = apply_rope(q_rope, positions, inv_freq)
+
+    k = jnp.concatenate([k_nope, jnp.broadcast_to(k_rope, (b, t, n_heads, dr))], axis=-1)
+    qf = jnp.concatenate([q_nope, q_rope], axis=-1)
+    scale = (dn + dr) ** -0.5 * attn_mscale
+    logits = jnp.einsum("bthd,bshd->bhts", qf, k, preferred_element_type=jnp.float32) * scale
+    mask = positions[:, :, None] >= positions[:, None, :]  # causal on true positions
+    logits = jnp.where(mask[:, None, :, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhts,bshv->bthv", probs.astype(v.dtype), v, preferred_element_type=jnp.float32)
+    return out.astype(h.dtype).reshape(b, t, n_heads * dv) @ lp["wo_mla"]

@@ -53,6 +53,15 @@ def param_shardings(mesh: Mesh, params: dict[str, Any]) -> dict[str, Any]:
             return P(None, "tp", None)
         if name in ("bq", "bk", "bv"):  # qkv biases follow the head split
             return P(None, "tp")
+        # MLA (models/mla.py): heads shard on tp; the shared latent
+        # projections replicate (they're rank-512-ish — tiny next to the
+        # per-head up-projections).
+        if name in ("w_uk", "w_uv"):  # [L, r_kv, H, dn|dv]
+            return P(None, None, "tp", None)
+        if name in ("w_q_b", "w_q"):  # output dim is H*(dn+dr)
+            return P(None, None, "tp")
+        if name == "wo_mla":  # [L, H*dv, D]
+            return P(None, "tp", None)
         if name in ("w_shared_gate", "w_shared_up"):
             return P(None, None, "tp")
         if name == "w_shared_down":

@@ -40,6 +40,11 @@ class PersistentStore(MemoryStore):
         self.path.parent.mkdir(parents=True, exist_ok=True)
         self._fh = None
         self._durable: set[str] = set()  # keys with a live WAL put entry
+        import asyncio
+
+        # Serializes appends with close(): an executor fsync must never race
+        # a close of (and fd-number reuse after) the WAL file.
+        self._wal_lock = asyncio.Lock()
 
     @classmethod
     async def open(cls, path: str | pathlib.Path) -> "PersistentStore":
@@ -88,21 +93,23 @@ class PersistentStore(MemoryStore):
         return json.dumps(doc) + "\n"
 
     async def _append(self, op: str, key: str, value: bytes | None = None) -> None:
-        if self._fh is None:
-            return
         import asyncio
         import os
 
-        self._fh.write(self._entry(op, key, value))
-        self._fh.flush()
-        if op == "put":
-            self._durable.add(key)
-        else:
-            self._durable.discard(key)
-        # Durable against power loss, not just process crash — but fsync is
-        # a blocking syscall, so keep it off the store server's event loop
-        # (a stalled loop delays every op and lease keepalive).
-        await asyncio.get_running_loop().run_in_executor(None, os.fsync, self._fh.fileno())
+        async with self._wal_lock:
+            if self._fh is None:
+                return
+            self._fh.write(self._entry(op, key, value))
+            self._fh.flush()
+            if op == "put":
+                self._durable.add(key)
+            else:
+                self._durable.discard(key)
+            # Durable against power loss, not just process crash — but fsync
+            # is a blocking syscall, so keep it off the store server's event
+            # loop (a stalled loop delays every op and lease keepalive). The
+            # lock keeps the fd alive until the fsync lands.
+            await asyncio.get_running_loop().run_in_executor(None, os.fsync, self._fh.fileno())
 
     async def put(self, key: str, value: bytes, lease_id: int | None = None) -> None:
         await super().put(key, value, lease_id=lease_id)
@@ -127,7 +134,8 @@ class PersistentStore(MemoryStore):
         return existed
 
     async def close(self) -> None:
-        self.close_log()
+        async with self._wal_lock:
+            self.close_log()
         await super().close()
 
     def close_log(self) -> None:

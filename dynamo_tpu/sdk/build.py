@@ -86,9 +86,13 @@ def build_archive(
             src_file = inspect.getsourcefile(module)
             if src_file is None:
                 continue
-            # store under src/<dotted path as path>; parent packages get
-            # their __init__.py so src/ is a regular importable tree
-            rel = module_name.replace(".", "/") + ".py"
+            # store under src/<dotted path as path>; packages (services
+            # defined in a pkg __init__) land as pkg/__init__.py, and parent
+            # packages get their __init__.py so src/ is a regular tree
+            if hasattr(module, "__path__"):
+                rel = module_name.replace(".", "/") + "/__init__.py"
+            else:
+                rel = module_name.replace(".", "/") + ".py"
             add_bytes(f"src/{rel}", pathlib.Path(src_file).read_bytes())
             packaged.add(module_name)
             parts = module_name.split(".")[:-1]

@@ -202,7 +202,10 @@ def test_build_archive_roundtrip(tmp_path, monkeypatch):
     assert (src_root / "mygraphs" / "agg.py").exists()
     assert (src_root / "mygraphs" / "__init__.py").exists()
     # deploy-host import: installed framework + ONLY the extracted sources
-    env = {"PYTHONPATH": f"{src_root}:/root/repo", "JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin"}
+    import pathlib
+
+    repo_root = pathlib.Path(__file__).resolve().parents[1]
+    env = {"PYTHONPATH": f"{src_root}:{repo_root}", "JAX_PLATFORMS": "cpu", "PATH": "/usr/bin:/bin"}
     check = subprocess.run(
         [sys.executable, "-c",
          "from dynamo_tpu.sdk.graph import load_graph; "
