@@ -100,8 +100,41 @@ def main() -> None:
         outputs = core.step()
         generated += sum(len(o.token_ids) for _, o in outputs)
     elapsed = time.perf_counter() - start
-
     tok_per_sec = generated / elapsed if elapsed > 0 else 0.0
+
+    # -- TTFT phase: fresh requests at moderate concurrency, pure prefill --
+    # The north star is tok/s *under a TTFT SLO* (BASELINE.md): measure the
+    # time from submit to each request's first sampled token, prefill running
+    # the Pallas flash path. Programs are already compiled by the phase above
+    # (same shapes), so this times the chip, not XLA.
+    ttft_batch = int(os.environ.get("BENCH_TTFT_CONCURRENCY", "32"))
+    prompts = [
+        rng.integers(1, cfg.vocab_size - 1, size=ISL).tolist() for _ in range(ttft_batch)
+    ]
+    submitted: dict[int, float] = {}
+    for prompt in prompts:
+        seq = core.add_request(
+            PreprocessedRequest(
+                token_ids=prompt,
+                sampling=SamplingOptions(temperature=0.0),
+                stop=StopConditions(max_tokens=1, ignore_eos=True),
+            )
+        )
+        submitted[id(seq)] = time.perf_counter()
+    first_seen: dict[int, float] = {}
+    while core.has_work and len(first_seen) < ttft_batch:
+        outputs = core.step()
+        now = time.perf_counter()
+        for seq, out in outputs:
+            if id(seq) not in first_seen and out.token_ids:
+                first_seen[id(seq)] = now - submitted[id(seq)]
+    ttfts = sorted(first_seen.values())
+
+    def pct(p: float) -> float:
+        if not ttfts:
+            return 0.0
+        return ttfts[min(len(ttfts) - 1, int(p * len(ttfts)))]
+
     print(
         json.dumps(
             {
@@ -113,6 +146,9 @@ def main() -> None:
                     "preset": PRESET, "batch": BATCH, "isl": ISL, "osl": OSL,
                     "decode_steps": DECODE_STEPS,
                     "decode_tokens": generated, "seconds": round(elapsed, 3),
+                    "ttft_p50_ms": round(pct(0.50) * 1e3, 1),
+                    "ttft_p99_ms": round(pct(0.99) * 1e3, 1),
+                    "ttft_concurrency": ttft_batch,
                     "backend": __import__("jax").default_backend(),
                 },
             }

@@ -46,8 +46,20 @@ class FrontendMetrics:
             f"{ns}_cached_prompt_tokens_total", "Prompt tokens served from prefix cache", ["model"],
             registry=self.registry,
         )
+        # Kernel-fallback visibility: compiled paged-attention programs that
+        # dropped to the ~5x-slower XLA gather formulation, by shape
+        # signature (ops/pallas_paged.FALLBACK_COUNTS; synced per scrape).
+        self.kernel_fallbacks = Gauge(
+            "dynamo_attention_kernel_fallback_programs",
+            "Compiled paged-attention programs that fell back to the XLA gather formulation",
+            ["signature"], registry=self.registry,
+        )
 
     def render(self) -> bytes:
+        from dynamo_tpu.ops.pallas_paged import fallback_snapshot
+
+        for sig, n in fallback_snapshot().items():
+            self.kernel_fallbacks.labels(sig).set(n)
         return generate_latest(self.registry)
 
     def tracker(self, model: str, endpoint: str) -> "RequestTracker":

@@ -44,12 +44,15 @@ stack (SURVEY.md §2 row 30, §7 hard part (a); `lib/llm/src/kernels/` is the
 reference's only first-party kernel code).
 
 Tests: ``tests/test_pallas_paged.py`` (interpret mode on CPU vs the
-reference formulation; TPU-marked variant compares on-device).
+reference formulation); ``tests_tpu/test_on_device.py`` (Mosaic-compiled
+parity on the real chip).
 """
 
 from __future__ import annotations
 
 import functools
+import logging
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -58,8 +61,45 @@ from jax.experimental.pallas import tpu as pltpu
 
 from dynamo_tpu.ops.attention import paged_attention_reference
 
+logger = logging.getLogger(__name__)
+
 NEG_INF = -1e30
 LANES = 128
+
+# Kernel-fallback observability: a config typo (odd GQA grouping, a page
+# slab width off the 128-lane grid) silently costs ~5x decode throughput if
+# the dispatch drops to the gather formulation. The dispatch runs at jit
+# trace time, so each entry counts *compiled programs* that fell back (one
+# per shape signature — exactly the "once per config" the operator needs),
+# warns on first occurrence, and is exported by the frontend /metrics
+# endpoint (frontend/metrics.py:FrontendMetrics.render).
+FALLBACK_COUNTS: dict[str, int] = {}
+_fallback_lock = threading.Lock()
+_warned_signatures: set[str] = set()
+
+
+def _record_fallback(phase: str, q: jnp.ndarray, k_cache: jnp.ndarray) -> None:
+    sig = (
+        f"{phase}:heads={q.shape[-2]},head_dim={q.shape[-1]},"
+        f"slab_width={k_cache.shape[2]}"
+    )
+    with _fallback_lock:
+        FALLBACK_COUNTS[sig] = FALLBACK_COUNTS.get(sig, 0) + 1
+        warn = sig not in _warned_signatures
+        _warned_signatures.add(sig)
+    if warn:
+        logger.warning(
+            "paged-attention Pallas kernel does not support this shape, "
+            "falling back to the XLA gather formulation (~5x slower %s): %s",
+            phase,
+            sig,
+        )
+
+
+def fallback_snapshot() -> dict[str, int]:
+    """Race-free copy for metrics scrapes (trace threads mutate the dict)."""
+    with _fallback_lock:
+        return dict(FALLBACK_COUNTS)
 
 
 def _pages_per_block(pages_per_seq: int, page_size: int) -> int:
@@ -108,10 +148,14 @@ def _decode_kernel(
     )
 
     def page_index(bb, ii, j):
-        # The tail block may reach past the table: clamp to a valid page
-        # (its tokens are masked out by the length check in compute).
-        idx = ii * pages_per_block + j
-        return tables_ref[bb * pages_per_seq + jnp.minimum(idx, pages_per_seq - 1)]
+        # The tail block may reach past the sequence's allocated pages:
+        # clamp to the row's own used range (not just the table width) so
+        # the DMA never dereferences entries the engine didn't fill —
+        # sentinel-filled tables (-1 tails) are safe, not just zero-filled
+        # ones. Clamped tokens are masked out by the length check.
+        last = jnp.maximum(lengths_ref[bb] - 1, 0) // page_size
+        idx = jnp.minimum(ii * pages_per_block + j, last)
+        return tables_ref[bb * pages_per_seq + idx]
 
     def start_block(slot, bb, ii):
         for j in range(pages_per_block):
@@ -304,15 +348,33 @@ def paged_attention_pallas(
     positions: jnp.ndarray,
     *,
     scale: float,
+    contiguous_positions: bool = True,
 ) -> jnp.ndarray:
-    """TPU dispatch: own decode kernel for T == 1, reference math otherwise.
+    """TPU dispatch: decode kernel for T == 1, prefill flash kernel for
+    T > 1, XLA gather formulation as the (counted, warned) fallback.
 
-    Prefill (T > 1) is MXU-bound and close to roofline under XLA fusion; the
-    chunked-prefill Pallas path is tracked separately (ops TODO)."""
-    if q.shape[1] == 1 and decode_supported(q, k_cache):
-        return paged_decode_attention(
-            q, k_cache, v_cache, block_tables, positions, scale=scale
+    The prefill kernel requires per-row contiguous positions
+    (``positions[b, t] = start_b + t``) — true for every engine prefill,
+    chunked or not. A T > 1 caller with gappy per-token positions (e.g. a
+    speculative-verify batch) must pass ``contiguous_positions=False`` to
+    get the exact reference formulation instead."""
+    if q.shape[1] == 1:
+        if decode_supported(q, k_cache):
+            return paged_decode_attention(
+                q, k_cache, v_cache, block_tables, positions, scale=scale
+            )
+        _record_fallback("decode", q, k_cache)
+    else:
+        from dynamo_tpu.ops.pallas_prefill import (
+            paged_prefill_attention,
+            prefill_supported,
         )
+
+        if contiguous_positions and prefill_supported(q, k_cache):
+            return paged_prefill_attention(
+                q, k_cache, v_cache, block_tables, positions, scale=scale
+            )
+        _record_fallback("prefill", q, k_cache)
     return paged_attention_reference(
         q, k_cache, v_cache, block_tables, positions, scale=scale
     )

@@ -1,0 +1,142 @@
+"""Pallas chunked-prefill (flash) kernel vs the XLA reference formulation.
+
+Interpret mode on CPU (bit-exact semantics); the on-device tier
+(tests_tpu/test_on_device.py) compares the Mosaic-compiled kernel on a
+real chip.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dynamo_tpu.ops.attention import paged_attention_reference, write_kv
+from dynamo_tpu.ops.pallas_prefill import paged_prefill_attention, prefill_supported
+
+
+def _case(rng, *, b, t, n_heads, n_kv, head_dim, page_size, pages_per_seq, starts):
+    """Build a paged cache holding each row's full context (history + chunk)
+    with the chunk's queries at absolute positions starts[b] + t."""
+    width = n_kv * head_dim
+    num_pages = b * pages_per_seq + 1
+    k = jnp.asarray(rng.standard_normal((num_pages, page_size, width)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((num_pages, page_size, width)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((b, t, n_heads, head_dim)), jnp.float32)
+    tables = jnp.asarray(
+        1 + rng.permutation(num_pages - 1)[: b * pages_per_seq].reshape(b, pages_per_seq),
+        jnp.int32,
+    )
+    positions = jnp.asarray(np.asarray(starts)[:, None] + np.arange(t)[None, :], jnp.int32)
+    return q, k, v, tables, positions
+
+
+@pytest.mark.parametrize(
+    "b,t,n_heads,n_kv,head_dim,page_size,pages_per_seq,starts",
+    [
+        (2, 32, 8, 2, 64, 16, 4, [0, 0]),          # whole-prompt prefill
+        (2, 32, 8, 2, 64, 16, 8, [48, 16]),        # chunked continuation (history)
+        (3, 24, 4, 4, 32, 8, 8, [0, 8, 40]),       # MHA, t not a block multiple
+        (1, 64, 4, 1, 128, 16, 8, [32]),           # MQA, head_dim 128
+    ],
+)
+def test_prefill_kernel_matches_reference(b, t, n_heads, n_kv, head_dim, page_size, pages_per_seq, starts):
+    rng = np.random.default_rng(0)
+    q, k, v, tables, positions = _case(
+        rng, b=b, t=t, n_heads=n_heads, n_kv=n_kv, head_dim=head_dim,
+        page_size=page_size, pages_per_seq=pages_per_seq, starts=starts,
+    )
+    scale = head_dim**-0.5
+    want = paged_attention_reference(q, k, v, tables, positions, scale=scale)
+    got = paged_prefill_attention(q, k, v, tables, positions, scale=scale, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-2, atol=2e-2)
+
+
+def test_prefill_kernel_small_blocks_multi_qblock():
+    """Force multiple query blocks AND multiple KV blocks per query block so
+    the causal early-exit bound, DMA double buffering, and the online-softmax
+    carry across blocks are all exercised."""
+    import dynamo_tpu.ops.pallas_prefill as pf
+
+    rng = np.random.default_rng(2)
+    orig_bt, orig_tq = pf._block_tokens, pf._tq_for
+    pf._block_tokens = lambda ps, w: 2 * ps   # bk = 32 tokens
+    pf._tq_for = lambda g, t, kv, hd: 16      # 16-token query blocks
+    try:
+        q, k, v, tables, positions = _case(
+            rng, b=2, t=48, n_heads=8, n_kv=2, head_dim=64,
+            page_size=16, pages_per_seq=8, starts=[0, 64],
+        )
+        scale = 0.125
+        want = paged_attention_reference(q, k, v, tables, positions, scale=scale)
+        got = paged_prefill_attention(q, k, v, tables, positions, scale=scale, interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-2, atol=2e-2)
+    finally:
+        pf._block_tokens, pf._tq_for = orig_bt, orig_tq
+
+
+def test_prefill_kernel_padding_rows_are_safe():
+    """Batch-padding rows (positions all 0, table row all zeros -> null page)
+    must not poison real rows and must not produce NaN."""
+    rng = np.random.default_rng(3)
+    q, k, v, tables, positions = _case(
+        rng, b=2, t=16, n_heads=4, n_kv=2, head_dim=64,
+        page_size=16, pages_per_seq=4, starts=[0, 0],
+    )
+    tables = tables.at[1].set(0)
+    positions = positions.at[1].set(0)
+    scale = 0.125
+    got = paged_prefill_attention(q, k, v, tables, positions, scale=scale, interpret=True)
+    want = paged_attention_reference(q, k, v, tables, positions, scale=scale)
+    np.testing.assert_allclose(np.asarray(got)[0], np.asarray(want)[0], rtol=2e-2, atol=2e-2)
+    assert np.isfinite(np.asarray(got)).all()
+
+
+def test_prefill_kernel_sentinel_tables_clamp():
+    """Table entries past the row's used range may be sentinels (-1): the
+    kernel must clamp page lookups to the row's own length, never load them."""
+    rng = np.random.default_rng(4)
+    q, k, v, tables, positions = _case(
+        rng, b=1, t=16, n_heads=4, n_kv=2, head_dim=64,
+        page_size=16, pages_per_seq=8, starts=[16],
+    )
+    want = paged_attention_reference(q, k, v, tables, positions, scale=0.125)
+    # kv_len = 32 -> 2 pages used; poison the rest of the table row.
+    tables = tables.at[0, 2:].set(-1)
+    got = paged_prefill_attention(q, k, v, tables, positions, scale=0.125, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-2, atol=2e-2)
+
+
+def test_prefill_matches_incremental_decode():
+    """Prefilling a chunk must equal token-by-token decode over the same
+    cache — the cross-check that positions/causality line up end to end."""
+    from dynamo_tpu.ops.pallas_paged import paged_decode_attention
+
+    rng = np.random.default_rng(5)
+    b, t, n_heads, n_kv, head_dim, page_size = 1, 8, 4, 2, 64, 4
+    width = n_kv * head_dim
+    num_pages = 4
+    tables = jnp.asarray([[1, 2]], jnp.int32)
+    k_cache = jnp.zeros((num_pages, page_size, width), jnp.float32)
+    v_cache = jnp.zeros((num_pages, page_size, width), jnp.float32)
+    new_k = jnp.asarray(rng.standard_normal((b, t, n_kv, head_dim)), jnp.float32)
+    new_v = jnp.asarray(rng.standard_normal((b, t, n_kv, head_dim)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((b, t, n_heads, head_dim)), jnp.float32)
+    slots = jnp.asarray([[1 * page_size + i for i in range(t)]], jnp.int32)
+    k_cache, v_cache = write_kv(k_cache, v_cache, new_k, new_v, slots)
+    positions = jnp.arange(t, dtype=jnp.int32)[None, :]
+    scale = 0.125
+
+    pre = paged_prefill_attention(q, k_cache, v_cache, tables, positions, scale=scale, interpret=True)
+    for i in range(t):
+        dec = paged_decode_attention(
+            q[:, i : i + 1], k_cache, v_cache, tables, positions[:, i : i + 1],
+            scale=scale, interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(pre[:, i : i + 1]), np.asarray(dec), rtol=2e-2, atol=2e-2
+        )
+
+
+def test_prefill_supported_predicate():
+    q = jnp.zeros((2, 8, 32, 64))
+    assert prefill_supported(q, jnp.zeros((8, 16, 8 * 64)))
+    assert not prefill_supported(q, jnp.zeros((8, 16, 8 * 64 + 8)))

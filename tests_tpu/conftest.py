@@ -1,0 +1,17 @@
+"""On-device (real TPU) test tier.
+
+Unlike ``tests/`` (which forces an 8-device virtual CPU mesh), this suite
+runs on whatever accelerator JAX finds and skips itself entirely when that
+is not a TPU. Run explicitly: ``python -m pytest tests_tpu/ -q`` — it is
+NOT in pyproject's default testpaths, because CI sandboxes have no chip.
+"""
+
+import jax
+import pytest
+
+
+def pytest_collection_modifyitems(config, items):
+    if jax.default_backend() != "tpu":
+        skip = pytest.mark.skip(reason="no TPU backend; on-device tier requires a chip")
+        for item in items:
+            item.add_marker(skip)
