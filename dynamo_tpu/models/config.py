@@ -54,6 +54,12 @@ class ModelConfig:
     qk_nope_head_dim: int = 0
     qk_rope_head_dim: int = 0
     v_head_dim: int = 0
+    # DeepSeek-V2/V3 checkpoints store the rope dims of q_b_proj /
+    # kv_a_proj_with_mqa in interleaved pair order (HF `rope_interleave`,
+    # default true there); the loader permutes them to the half-split
+    # convention models/ops use (models/loader.py). False for every
+    # non-MLA family: their HF checkpoints are already half-split.
+    rope_interleave: bool = False
 
     @property
     def q_dim(self) -> int:
@@ -122,6 +128,13 @@ class ModelConfig:
             qk_nope_head_dim=config.get("qk_nope_head_dim") or 0,
             qk_rope_head_dim=config.get("qk_rope_head_dim") or 0,
             v_head_dim=config.get("v_head_dim") or 0,
+            # HF defaults rope_interleave=True for DeepSeek MLA configs, so
+            # a missing key means interleaved (matches every real V2/V3
+            # checkpoint; this repo's own save_params always writes the key,
+            # so round-trips are unambiguous).
+            rope_interleave=bool(config.get("rope_interleave", True))
+            if config.get("kv_lora_rank")
+            else False,
         )
 
 
@@ -198,6 +211,7 @@ PRESETS: dict[str, ModelConfig] = {
         num_experts=256, num_experts_per_token=8, moe_intermediate_size=2048,
         attn_type="mla", q_lora_rank=1536, kv_lora_rank=512,
         qk_nope_head_dim=128, qk_rope_head_dim=64, v_head_dim=128,
+        rope_interleave=True,  # real V3 checkpoints ship interleaved rope dims
     ),
     # MLA test model (tiny): latent cache + absorbed projections.
     "test-tiny-mla": ModelConfig(

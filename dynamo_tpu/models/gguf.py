@@ -546,6 +546,10 @@ _GGUF_LAYER_MAP: dict[str, tuple[str, bool]] = {
     "w_down": ("ffn_down.weight", True),
 }
 _GGUF_BIAS_MAP = {"bq": "attn_q.bias", "bk": "attn_k.bias", "bv": "attn_v.bias"}
+# Architectures whose GGUFs use GGML NORM (interleaved-pair) rope and whose
+# Q/K were therefore permuted by llama.cpp's converter. Mistral/Mixtral are
+# written under arch "llama"; qwen2/deepseek2 are NEOX (unpermuted).
+_NORM_ROPE_ARCHS = {"llama"}
 # MoE: experts are pre-stacked 3D tensors in GGUF ([E, out, in] in numpy order).
 _GGUF_MOE_MAP: dict[str, str] = {
     "w_gate": "ffn_gate_exps.weight",
@@ -581,15 +585,34 @@ def load_gguf_params(
     want = str(dtype or cfg.dtype)
     np_dtype = ml_dtypes.bfloat16 if want == "bfloat16" else np.dtype(jnp.dtype(want).name)
 
-    def rd(name: str, transpose: bool) -> np.ndarray:
+    # llama.cpp's converter permutes llama-family Q/K weights (arch "llama"
+    # covers Mistral/Mixtral too) into GGML NORM-rope interleaved-pair order;
+    # ops/rope.apply_rope uses the half-split (NEOX/HF) convention, so invert
+    # that permutation at load (qwen2 etc. are NEOX in GGUF — no permute).
+    from dynamo_tpu.models.loader import rope_load_perm
+
+    arch = reader.metadata.get("general.architecture")
+    qk_perms: dict[str, np.ndarray] = {}
+    if arch in _NORM_ROPE_ARCHS:
+        qk_perms = {
+            "wq": rope_load_perm(cfg.num_heads, cfg.head_dim, cfg.head_dim),
+            "wk": rope_load_perm(cfg.num_kv_heads, cfg.head_dim, cfg.head_dim),
+            "bq": rope_load_perm(cfg.num_heads, cfg.head_dim, cfg.head_dim),
+            "bk": rope_load_perm(cfg.num_kv_heads, cfg.head_dim, cfg.head_dim),
+        }
+
+    def rd(name: str, transpose: bool, perm: np.ndarray | None = None) -> np.ndarray:
         arr = reader.read(name)
+        if perm is not None:  # permute GGML rows (pre-transpose orientation)
+            arr = arr[perm]
         return arr.T if transpose else arr
 
     L = cfg.num_layers
     layers: dict[str, np.ndarray] = {}
 
     def stack(leaf: str, suffix: str, transpose: bool) -> np.ndarray:
-        return np.stack([rd(f"blk.{li}.{suffix}", transpose) for li in range(L)]).astype(np_dtype, copy=False)
+        perm = qk_perms.get(leaf)
+        return np.stack([rd(f"blk.{li}.{suffix}", transpose, perm) for li in range(L)]).astype(np_dtype, copy=False)
 
     for leaf, (suffix, t) in _GGUF_LAYER_MAP.items():
         if leaf in ("w_gate", "w_up", "w_down") and cfg.is_moe:
@@ -680,15 +703,33 @@ def save_params_gguf(
     if "lm_head" in host:
         tensors["output.weight"] = np.ascontiguousarray(host["lm_head"].T)
     layers = host["layers"]
+    # Exports are written under arch "llama": permute Q/K (and their biases)
+    # from the half-split runtime convention back to GGML NORM interleaved
+    # order so llama.cpp-ecosystem consumers rope them correctly.
+    from dynamo_tpu.models.loader import rope_save_perm
+
+    save_perms = {
+        "wq": rope_save_perm(cfg.num_heads, cfg.head_dim, cfg.head_dim),
+        "wk": rope_save_perm(cfg.num_kv_heads, cfg.head_dim, cfg.head_dim),
+        "bq": rope_save_perm(cfg.num_heads, cfg.head_dim, cfg.head_dim),
+        "bk": rope_save_perm(cfg.num_kv_heads, cfg.head_dim, cfg.head_dim),
+    }
     for li in range(cfg.num_layers):
         for leaf, (suffix, t) in _GGUF_LAYER_MAP.items():
             if leaf not in layers:
                 continue
             arr = layers[leaf][li]
-            tensors[f"blk.{li}.{suffix}"] = np.ascontiguousarray(arr.T) if t else arr
+            if t:
+                arr = arr.T
+            if leaf in save_perms:
+                arr = arr[save_perms[leaf]]
+            tensors[f"blk.{li}.{suffix}"] = np.ascontiguousarray(arr)
         for leaf, suffix in _GGUF_BIAS_MAP.items():
             if leaf in layers:
-                tensors[f"blk.{li}.{suffix}"] = layers[leaf][li]
+                arr = layers[leaf][li]
+                if leaf in save_perms:
+                    arr = arr[save_perms[leaf]]
+                tensors[f"blk.{li}.{suffix}"] = arr
         if "router" in layers:
             tensors[f"blk.{li}.ffn_gate_inp.weight"] = np.ascontiguousarray(layers["router"][li].T)
             for leaf, suffix in _GGUF_MOE_MAP.items():

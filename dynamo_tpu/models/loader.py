@@ -153,12 +153,18 @@ class _LazyLeaf:
         per_layer: Callable[[int], list[tuple[str, bool]]],
         dtype: np.dtype,
         expert_axis: bool = False,
+        row_perm: np.ndarray | None = None,
     ) -> None:
         self.index = index
         self.shape = shape
         self.per_layer = per_layer  # li -> [(tensor name, transpose?)] (len>1 = expert stack)
         self.dtype = dtype
         self.expert_axis = expert_axis
+        # Source-row (torch [out, in] axis-0) permutation applied at read
+        # time (rope interleaved -> half-split, see rope_load_perm). A
+        # permuted leaf materializes the full per-layer tensor: a shard's
+        # slice no longer maps to contiguous source rows.
+        self.row_perm = row_perm
 
     @property
     def ndim(self) -> int:
@@ -166,6 +172,11 @@ class _LazyLeaf:
 
     def _read(self, name: str, transpose: bool, idx: tuple[slice, ...]) -> np.ndarray:
         sl = self.index.get_slice(name)
+        if self.row_perm is not None:
+            arr = np.asarray(sl[:])[self.row_perm]
+            if transpose:
+                arr = arr.T
+            return arr[idx] if idx else arr
         if transpose:
             src = sl[idx[1], idx[0]] if len(idx) == 2 else sl[:]
             arr = np.asarray(src).T
@@ -193,6 +204,34 @@ class _LazyLeaf:
                 arr = self._read(name, transpose, rest)
             out_layers.append(arr)
         return np.stack(out_layers).astype(self.dtype, copy=False)
+
+
+def rope_load_perm(n_heads: int, head_size: int, rope_dim: int) -> np.ndarray:
+    """Row permutation (torch ``[out, in]`` orientation) converting each
+    head's trailing ``rope_dim`` rows from interleaved pair order to the
+    half-split order ``ops/rope.apply_rope`` expects: ``new = old[perm]``.
+
+    DeepSeek-V2/V3 checkpoints ship rope dims interleaved (HF
+    ``rope_interleave=True``: modeling does ``view(d//2, 2).transpose`` on
+    the activations before rotate_half — `modeling_deepseek_v3.py:311`);
+    llama.cpp's converter likewise permutes whole Q/K heads of llama-family
+    GGUFs into interleaved (GGML NORM-rope) order. Permuting the *weights*
+    once at load is equivalent and keeps the runtime half-split everywhere.
+    Half-split row ``p*half + d`` reads interleaved row ``2*d + p``.
+    """
+    half = rope_dim // 2
+    idx = np.arange(n_heads * head_size)
+    head, r = idx // head_size, idx % head_size
+    off = head_size - rope_dim
+    j = r - off
+    src_r = np.where(r >= off, off + 2 * (j % max(half, 1)) + j // max(half, 1), r)
+    return head * head_size + src_r
+
+
+def rope_save_perm(n_heads: int, head_size: int, rope_dim: int) -> np.ndarray:
+    """Inverse of :func:`rope_load_perm` (half-split -> interleaved), applied
+    by the checkpoint writers so exports match the ecosystem convention."""
+    return np.argsort(rope_load_perm(n_heads, head_size, rope_dim))
 
 
 # MLA per-layer sources (DeepSeek-V2/V3 HF names). kv_b_proj packs per-head
@@ -248,12 +287,14 @@ def _leaf_specs(index: CheckpointIndex, cfg: ModelConfig, dtype: np.dtype) -> di
     """Build the params pytree of _LazyLeaf / lazy top-level reads."""
     d, l = cfg.hidden_size, cfg.num_layers
 
-    def simple(suffixes: tuple[str, ...], transpose: bool, width: int | None = None):
+    def simple(suffixes: tuple[str, ...], transpose: bool, row_perm: np.ndarray | None = None):
         name0 = _find(index, suffixes, 0)
         shp = index.shape(name0)
         shp = shp[::-1] if transpose else shp
         return _LazyLeaf(
-            index, (l, *shp), lambda li, s=suffixes, t=transpose: [(_find(index, s, li), t)], dtype
+            index, (l, *shp),
+            lambda li, s=suffixes, t=transpose: [(_find(index, s, li), t)],
+            dtype, row_perm=row_perm,
         )
 
     if cfg.attn_type == "mla":
@@ -262,12 +303,24 @@ def _leaf_specs(index: CheckpointIndex, cfg: ModelConfig, dtype: np.dtype) -> di
             for name, (suffixes, t) in _LAYER_MAP.items()
             if name in ("attn_norm", "mlp_norm")
         }
+        # DeepSeek checkpoints store rope dims interleaved: permute the rope
+        # rows of the q projection (per head) and kv_a_proj (single shared
+        # rope key) to half-split at load (rope_load_perm docstring).
+        q_perm = kv_perm = None
+        if cfg.rope_interleave:
+            q_perm = rope_load_perm(
+                cfg.num_heads, cfg.qk_nope_head_dim + cfg.qk_rope_head_dim, cfg.qk_rope_head_dim
+            )
+            kv_perm = rope_load_perm(
+                1, cfg.kv_lora_rank + cfg.qk_rope_head_dim, cfg.qk_rope_head_dim
+            )
         for name, (suffixes, t) in _MLA_MAP.items():
             if name in ("w_q_a", "q_norm", "w_q_b") and cfg.q_lora_rank <= 0:
                 continue
             if name == "w_q" and cfg.q_lora_rank > 0:
                 continue
-            layers[name] = simple(suffixes, t)
+            perm = {"w_q_b": q_perm, "w_q": q_perm, "w_kv_a": kv_perm}.get(name)
+            layers[name] = simple(suffixes, t, row_perm=perm)
         layers["w_uk"] = _KvBLeaf(
             index, l, cfg.num_heads, cfg.qk_nope_head_dim, cfg.v_head_dim,
             0, cfg.qk_nope_head_dim, dtype,
@@ -481,6 +534,7 @@ def save_params(
             qk_nope_head_dim=cfg.qk_nope_head_dim,
             qk_rope_head_dim=cfg.qk_rope_head_dim,
             v_head_dim=cfg.v_head_dim,
+            rope_interleave=cfg.rope_interleave,
         )
     if cfg.is_moe:
         if cfg.attn_type != "mla":  # MLA already pinned model_type deepseek_v3
@@ -501,9 +555,13 @@ def save_params(
 
     tensors: dict[str, np.ndarray] = {}
 
-    def put(name: str, arr, transpose: bool) -> None:
+    def put(name: str, arr, transpose: bool, row_perm: np.ndarray | None = None) -> None:
         a = np.asarray(arr)
-        tensors[name] = np.ascontiguousarray(a.T if transpose else a)
+        if transpose:
+            a = a.T
+        if row_perm is not None:  # half-split -> checkpoint (interleaved) order
+            a = a[row_perm]
+        tensors[name] = np.ascontiguousarray(a)
 
     put("model.embed_tokens.weight", params["embed"], False)
     put("model.norm.weight", params["norm_f"], False)
@@ -519,9 +577,18 @@ def save_params(
                 continue
             put(base + suffixes[0], lp[leaf][li], transpose)
         if cfg.attn_type == "mla":
+            q_sperm = kv_sperm = None
+            if cfg.rope_interleave:
+                q_sperm = rope_save_perm(
+                    cfg.num_heads, cfg.qk_nope_head_dim + cfg.qk_rope_head_dim, cfg.qk_rope_head_dim
+                )
+                kv_sperm = rope_save_perm(
+                    1, cfg.kv_lora_rank + cfg.qk_rope_head_dim, cfg.qk_rope_head_dim
+                )
             for leaf, (suffixes, transpose) in _MLA_MAP.items():
                 if leaf in lp:
-                    put(base + suffixes[0], lp[leaf][li], transpose)
+                    sperm = {"w_q_b": q_sperm, "w_q": q_sperm, "w_kv_a": kv_sperm}.get(leaf)
+                    put(base + suffixes[0], lp[leaf][li], transpose, row_perm=sperm)
             # kv_b_proj: interleave per-head [K_nope; V] row blocks
             uk = np.asarray(lp["w_uk"][li])  # [r_kv, H, dn]
             uv = np.asarray(lp["w_uv"][li])  # [r_kv, H, dv]

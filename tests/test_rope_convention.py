@@ -1,0 +1,201 @@
+"""Checkpoint rope-layout conventions (ADVICE r2 high/medium findings).
+
+Two ecosystems store Q/K rope dims in *interleaved pair* order while this
+framework (like HF Llama) runs *half-split* rope everywhere:
+
+- llama.cpp-converted GGUFs: the converter permutes whole Q/K heads of
+  llama-family (arch "llama") models into GGML NORM order.
+- DeepSeek-V2/V3 HF checkpoints (``rope_interleave=True``): q/kv_a rope
+  segments are interleaved; HF modeling un-interleaves the *activations*
+  (`modeling_deepseek_v3.py:apply_rotary_pos_emb_interleave`).
+
+The loaders must invert these at load time (and writers re-apply on save).
+These tests pin the permutations against independent re-implementations of
+the source conventions — not against the loader's own inverse.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import PRESETS
+from dynamo_tpu.models.loader import rope_load_perm, rope_save_perm
+
+
+def _llamacpp_permute(w: np.ndarray, n_head: int) -> np.ndarray:
+    """llama.cpp convert_hf_to_gguf LlamaModel.permute, re-implemented from
+    its documented semantics: HF half-split rows -> GGML interleaved rows."""
+    return (
+        w.reshape(n_head, 2, w.shape[0] // n_head // 2, *w.shape[1:])
+        .swapaxes(1, 2)
+        .reshape(w.shape)
+    )
+
+
+def _hf_interleave(w: np.ndarray, n_head: int, head_size: int, rope_dim: int) -> np.ndarray:
+    """DeepSeek HF convention: produce the *checkpoint* (interleaved) row
+    order from half-split rows — per head, rope row ``2d+p`` holds
+    half-split row ``p*half+d``; non-rope rows untouched."""
+    out = w.copy()
+    half = rope_dim // 2
+    for h in range(n_head):
+        off = h * head_size + (head_size - rope_dim)
+        seg = w[off : off + rope_dim].copy()
+        for d in range(half):
+            for p in range(2):
+                out[off + 2 * d + p] = seg[p * half + d]
+    return out
+
+
+def test_rope_load_perm_inverts_llamacpp_permute():
+    rng = np.random.default_rng(0)
+    n_head, head_dim = 4, 16
+    hf = rng.standard_normal((n_head * head_dim, 8))
+    gguf = _llamacpp_permute(hf, n_head)
+    perm = rope_load_perm(n_head, head_dim, head_dim)
+    np.testing.assert_array_equal(gguf[perm], hf)
+
+
+def test_rope_save_perm_is_inverse():
+    perm = rope_load_perm(3, 24, 8)
+    inv = rope_save_perm(3, 24, 8)
+    n = 3 * 24
+    np.testing.assert_array_equal(perm[inv], np.arange(n))
+    np.testing.assert_array_equal(inv[perm], np.arange(n))
+
+
+def test_rope_load_perm_inverts_hf_interleave_partial_head():
+    """MLA heads rope only their trailing qk_rope_head_dim rows."""
+    rng = np.random.default_rng(1)
+    n_head, head_size, rope_dim = 2, 24, 8
+    half_split = rng.standard_normal((n_head * head_size, 6))
+    ckpt = _hf_interleave(half_split, n_head, head_size, rope_dim)
+    perm = rope_load_perm(n_head, head_size, rope_dim)
+    np.testing.assert_array_equal(ckpt[perm], half_split)
+
+
+def test_gguf_llamacpp_converted_checkpoint_loads_correctly(tmp_path):
+    """Simulate a llama.cpp conversion of an HF checkpoint (independent
+    permute implementation) and assert the GGUF loader recovers the original
+    HF-convention weights — the ADVICE r2 'high' finding."""
+    from dynamo_tpu.models.gguf import load_gguf_params, write_gguf
+
+    cfg = PRESETS["test-tiny"]
+    params = llama.init_params(cfg, 0)
+    n_h, n_kv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    tensors: dict[str, np.ndarray] = {
+        "token_embd.weight": np.asarray(params["embed"], np.float32),
+        "output_norm.weight": np.asarray(params["norm_f"], np.float32),
+    }
+    lp = params["layers"]
+    for li in range(cfg.num_layers):
+        for leaf, suffix in [
+            ("attn_norm", "attn_norm.weight"), ("mlp_norm", "ffn_norm.weight"),
+        ]:
+            tensors[f"blk.{li}.{suffix}"] = np.asarray(lp[leaf][li], np.float32)
+        for leaf, suffix, permute_heads in [
+            ("wq", "attn_q.weight", n_h), ("wk", "attn_k.weight", n_kv),
+            ("wv", "attn_v.weight", None), ("wo", "attn_output.weight", None),
+            ("w_gate", "ffn_gate.weight", None), ("w_up", "ffn_up.weight", None),
+            ("w_down", "ffn_down.weight", None),
+        ]:
+            torch_w = np.asarray(lp[leaf][li], np.float32).T  # [out, in]
+            if permute_heads is not None:
+                torch_w = _llamacpp_permute(torch_w, permute_heads)
+            tensors[f"blk.{li}.{suffix}"] = np.ascontiguousarray(torch_w)
+
+    md = {"general.architecture": "llama", "llama.block_count": cfg.num_layers}
+    path = tmp_path / "converted.gguf"
+    write_gguf(path, md, tensors)
+
+    loaded = load_gguf_params(path, cfg, dtype="float32")
+    for leaf in ("wq", "wk", "wv", "wo"):
+        np.testing.assert_allclose(
+            np.asarray(loaded["layers"][leaf]), np.asarray(lp[leaf]), rtol=1e-6, atol=1e-6,
+            err_msg=leaf,
+        )
+
+
+def test_gguf_writer_loader_round_trip_with_permutation(tmp_path):
+    """Our writer exports under arch 'llama' (now permuting to GGML order);
+    the loader must invert it exactly."""
+    from dynamo_tpu.models.gguf import load_gguf_params, save_params_gguf
+
+    cfg = PRESETS["test-tiny"]
+    params = llama.init_params(cfg, 3)
+    path = tmp_path / "export.gguf"
+    save_params_gguf(path, cfg, params)
+    loaded = load_gguf_params(path, cfg, dtype="float32")
+    for leaf in ("wq", "wk"):
+        np.testing.assert_allclose(
+            np.asarray(loaded["layers"][leaf]), np.asarray(params["layers"][leaf]),
+            rtol=1e-3, atol=1e-3, err_msg=leaf,
+        )
+
+
+def test_mla_interleaved_checkpoint_loads_correctly(tmp_path):
+    """Simulate a DeepSeek HF checkpoint (rope_interleave=True): write the
+    safetensors with *interleaved* rope rows via the independent formula and
+    assert load_params recovers half-split weights — the ADVICE r2 'medium'
+    finding."""
+    from safetensors.numpy import save_file
+
+    from dynamo_tpu.models.loader import load_params, save_params
+
+    cfg = dataclasses.replace(PRESETS["test-tiny-mla"], rope_interleave=True)
+    params = llama.init_params(cfg, 5)
+
+    # First materialize the HF layout via save_params (which applies the
+    # inverse perm), then independently verify the written rope rows match
+    # the hand-rolled interleave of the in-memory half-split weights.
+    save_params(tmp_path, cfg, params)
+    loaded = load_params(tmp_path, cfg, dtype="float32")
+    for leaf in ("w_q_b", "w_kv_a", "w_uk", "w_uv", "wo_mla"):
+        np.testing.assert_allclose(
+            np.asarray(loaded["layers"][leaf]), np.asarray(params["layers"][leaf]),
+            rtol=1e-6, atol=1e-6, err_msg=leaf,
+        )
+
+    # Absolute check against the independent interleave implementation.
+    from safetensors import safe_open
+
+    dn, dr = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
+    with safe_open(str(tmp_path / "model.safetensors"), framework="numpy") as f:
+        written = f.get_tensor("model.layers.0.self_attn.q_b_proj.weight")
+    half_split_torch = np.asarray(params["layers"]["w_q_b"][0], np.float32).T
+    expect = _hf_interleave(half_split_torch, cfg.num_heads, dn + dr, dr)
+    np.testing.assert_allclose(written, expect, rtol=1e-6, atol=1e-6)
+
+
+def test_mla_forward_differs_if_permutation_skipped(tmp_path):
+    """Guard that the permutation is load-bearing: loading an interleaved
+    checkpoint as if half-split must change the forward pass (otherwise the
+    fix is vacuous for this geometry)."""
+    import jax.numpy as jnp
+
+    from dynamo_tpu.models.loader import load_params, save_params
+
+    cfg = dataclasses.replace(PRESETS["test-tiny-mla"], rope_interleave=True)
+    params = llama.init_params(cfg, 7)
+    save_params(tmp_path, cfg, params)
+    cfg_no_fix = dataclasses.replace(cfg, rope_interleave=False)
+    wrong = load_params(tmp_path, cfg_no_fix, dtype="float32")
+
+    tokens = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    positions = jnp.asarray([[0, 1, 2, 3]], jnp.int32)
+    tables = jnp.asarray([[1]], jnp.int32)
+    slots = jnp.asarray([[8, 9, 10, 11]], jnp.int32)  # page 1 @ page_size 8
+    last = jnp.asarray([3], jnp.int32)
+
+    def run(p):
+        k, v = llama.init_kv_cache(cfg, num_pages=2, page_size=8)
+        logits, _, _ = llama.forward(p, cfg, tokens, positions, k, v, tables, slots, last)
+        return np.asarray(logits)
+
+    good, bad = run(params), run(wrong)
+    assert not np.allclose(good, bad, atol=1e-4), (
+        "permuted and unpermuted loads agree — the rope permutation is not being exercised"
+    )
