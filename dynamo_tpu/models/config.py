@@ -98,6 +98,13 @@ class ModelConfig:
             config = json.loads(pathlib.Path(config).read_text())
         hidden = config["hidden_size"]
         heads = config["num_attention_heads"]
+        # DeepSeek replaces the first k MoE layers with dense MLPs
+        # (first_k_dense_replace). k >= num_layers means the model is
+        # effectively dense (handled here); mixed stacks (0 < k < layers,
+        # real V2/V3 checkpoints) are not yet supported — the loader fails
+        # loudly on the dense layers' mlp.gate_proj tensors via strict mode.
+        first_dense = int(config.get("first_k_dense_replace", 0) or 0)
+        all_dense = first_dense >= config["num_hidden_layers"]
         return cls(
             name=name or config.get("_name_or_path", config.get("model_type", "model")),
             vocab_size=config["vocab_size"],
@@ -112,13 +119,15 @@ class ModelConfig:
             rms_eps=config.get("rms_norm_eps", 1e-5),
             max_position=config.get("max_position_embeddings", 8192),
             tie_embeddings=config.get("tie_word_embeddings", False),
-            num_experts=(n_experts := config.get("num_experts", config.get("num_local_experts", config.get("n_routed_experts", 0))) or 0),
-            num_experts_per_token=config.get("num_experts_per_tok", 0) or 0,
+            num_experts=(n_experts := 0 if all_dense else (
+                config.get("num_experts", config.get("num_local_experts", config.get("n_routed_experts", 0))) or 0
+            )),
+            num_experts_per_token=(config.get("num_experts_per_tok", 0) or 0) if n_experts else 0,
             # Mixtral stores the expert width in intermediate_size itself.
-            moe_intermediate_size=(config.get("moe_intermediate_size", 0) or 0) or (config["intermediate_size"] if n_experts else 0),
+            moe_intermediate_size=((config.get("moe_intermediate_size", 0) or 0) or config["intermediate_size"]) if n_experts else 0,
             # Qwen2-MoE names the width directly; DeepSeek counts experts.
-            shared_expert_size=(config.get("shared_expert_intermediate_size", 0) or 0)
-            or (config.get("n_shared_experts", 0) or 0) * (config.get("moe_intermediate_size", 0) or 0),
+            shared_expert_size=((config.get("shared_expert_intermediate_size", 0) or 0)
+            or (config.get("n_shared_experts", 0) or 0) * (config.get("moe_intermediate_size", 0) or 0)) if n_experts else 0,
             shared_expert_gated=config.get("model_type") == "qwen2_moe",
             attention_bias=bool(config.get("attention_bias", config.get("model_type") in ("qwen2", "qwen2_moe"))),
             # DeepSeek-V2/V3: MLA signalled by the latent-rank keys.
@@ -129,9 +138,11 @@ class ModelConfig:
             qk_rope_head_dim=config.get("qk_rope_head_dim") or 0,
             v_head_dim=config.get("v_head_dim") or 0,
             # HF defaults rope_interleave=True for DeepSeek MLA configs, so
-            # a missing key means interleaved (matches every real V2/V3
-            # checkpoint; this repo's own save_params always writes the key,
-            # so round-trips are unambiguous).
+            # a missing key means interleaved — matching every real V2/V3
+            # checkpoint. save_params now always writes the key; MLA
+            # checkpoints exported by THIS repo before the rope fix (no key,
+            # weights half-split) load wrong under this default — re-export,
+            # or add "rope_interleave": false to their config.json.
             rope_interleave=bool(config.get("rope_interleave", True))
             if config.get("kv_lora_rank")
             else False,

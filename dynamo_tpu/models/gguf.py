@@ -2,7 +2,7 @@
 
 Reads llama.cpp-style GGUF (v2/v3) files — header, typed metadata KV pairs,
 tensor index — via mmap, dequantizes the common quant formats (F32/F16/BF16/
-Q8_0/Q4_0/Q4_1) to numpy, maps GGUF metadata onto :class:`ModelConfig`,
+Q8_0/Q4_0/Q4_1/Q4_K/Q5_K/Q6_K) to numpy, maps GGUF metadata onto :class:`ModelConfig`,
 reconstructs the embedded tokenizer as a ``tokenizers`` object, and loads the
 tensor set into the stacked-layer params pytree used by ``models/llama.py``.
 
@@ -45,12 +45,15 @@ _SCALAR_FMT = {
 GGML_F32, GGML_F16 = 0, 1
 GGML_Q4_0, GGML_Q4_1 = 2, 3
 GGML_Q8_0 = 8
+GGML_Q4_K, GGML_Q5_K, GGML_Q6_K = 12, 13, 14
 GGML_BF16 = 30
 
 _TYPE_NAMES = {GGML_F32: "F32", GGML_F16: "F16", GGML_Q4_0: "Q4_0", GGML_Q4_1: "Q4_1",
-               GGML_Q8_0: "Q8_0", GGML_BF16: "BF16"}
+               GGML_Q8_0: "Q8_0", GGML_BF16: "BF16",
+               GGML_Q4_K: "Q4_K", GGML_Q5_K: "Q5_K", GGML_Q6_K: "Q6_K"}
 
 _BLOCK = 32  # quant block size for Q4_0/Q4_1/Q8_0
+_QK_K = 256  # K-quant super-block size
 
 # bytes per block / elements per block
 _TYPE_SIZES = {
@@ -60,6 +63,9 @@ _TYPE_SIZES = {
     GGML_Q8_0: (2 + _BLOCK, _BLOCK),
     GGML_Q4_0: (2 + _BLOCK // 2, _BLOCK),
     GGML_Q4_1: (4 + _BLOCK // 2, _BLOCK),
+    GGML_Q4_K: (2 + 2 + 12 + _QK_K // 2, _QK_K),       # 144
+    GGML_Q5_K: (2 + 2 + 12 + _QK_K // 8 + _QK_K // 2, _QK_K),  # 176
+    GGML_Q6_K: (_QK_K // 2 + _QK_K // 4 + _QK_K // 16 + 2, _QK_K),  # 210
 }
 
 
@@ -234,7 +240,71 @@ def _dequant(raw: bytes | memoryview, ggml_type: int, shape: tuple[int, ...]) ->
         hi = (rec["qs"] >> 4).astype(np.float32)
         q = np.concatenate([lo, hi], axis=1)
         return (q * rec["d"].astype(np.float32)[:, None] + rec["m"].astype(np.float32)[:, None]).reshape(shape)
+    if ggml_type == GGML_Q4_K:
+        rec = np.frombuffer(raw, dtype=np.dtype(
+            [("d", "<f2"), ("dmin", "<f2"), ("scales", "u1", (12,)), ("qs", "u1", (_QK_K // 2,))]
+        ))
+        nb = rec.shape[0]
+        sc, mn = _k_scale_min(rec["scales"])
+        qs = rec["qs"].reshape(nb, 4, 32)
+        # Sub-block order within each 64-elem chunk: low nibbles then high.
+        q = np.stack([qs & 0xF, qs >> 4], axis=2).reshape(nb, 8, 32).astype(np.float32)
+        d = rec["d"].astype(np.float32)[:, None, None]
+        dmin = rec["dmin"].astype(np.float32)[:, None, None]
+        return (d * sc[:, :, None] * q - dmin * mn[:, :, None]).reshape(shape)
+    if ggml_type == GGML_Q5_K:
+        rec = np.frombuffer(raw, dtype=np.dtype(
+            [("d", "<f2"), ("dmin", "<f2"), ("scales", "u1", (12,)),
+             ("qh", "u1", (_QK_K // 8,)), ("qs", "u1", (_QK_K // 2,))]
+        ))
+        nb = rec.shape[0]
+        sc, mn = _k_scale_min(rec["scales"])
+        qs = rec["qs"].reshape(nb, 4, 32)
+        qh = rec["qh"][:, None, :]  # [nb, 1, 32]
+        shift = 2 * np.arange(4, dtype=np.uint8)[None, :, None]
+        lo = (qs & 0xF) + (((qh >> shift) & 1) << 4)
+        hi = (qs >> 4) + (((qh >> (shift + 1)) & 1) << 4)
+        q = np.stack([lo, hi], axis=2).reshape(nb, 8, 32).astype(np.float32)
+        d = rec["d"].astype(np.float32)[:, None, None]
+        dmin = rec["dmin"].astype(np.float32)[:, None, None]
+        return (d * sc[:, :, None] * q - dmin * mn[:, :, None]).reshape(shape)
+    if ggml_type == GGML_Q6_K:
+        rec = np.frombuffer(raw, dtype=np.dtype(
+            [("ql", "u1", (_QK_K // 2,)), ("qh", "u1", (_QK_K // 4,)),
+             ("scales", "i1", (_QK_K // 16,)), ("d", "<f2")]
+        ))
+        nb = rec.shape[0]
+        ql = rec["ql"].reshape(nb, 2, 2, 32)  # [nb, half, {l, l+32}, 32]
+        qh = rec["qh"].reshape(nb, 2, 32)
+        # Quarters within a 128-elem half: (ql[l]&F|h0), (ql[l+32]&F|h1),
+        # (ql[l]>>4|h2), (ql[l+32]>>4|h3) with h = 2-bit fields of qh[l].
+        q = np.stack(
+            [
+                (ql[:, :, 0] & 0xF) | (((qh >> 0) & 3) << 4),
+                (ql[:, :, 1] & 0xF) | (((qh >> 2) & 3) << 4),
+                (ql[:, :, 0] >> 4) | (((qh >> 4) & 3) << 4),
+                (ql[:, :, 1] >> 4) | (((qh >> 6) & 3) << 4),
+            ],
+            axis=2,
+        ).astype(np.int16) - 32  # [nb, 2, 4, 32]
+        sc = rec["scales"].reshape(nb, 2, 4, 2).astype(np.float32)
+        scq = np.repeat(sc, 16, axis=3)  # scale index l // 16 within a quarter
+        d = rec["d"].astype(np.float32)[:, None, None, None]
+        return (d * scq * q).reshape(shape)
     raise ValueError(f"unsupported ggml type {ggml_type}")
+
+
+def _k_scale_min(scales: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Unpack Q4_K/Q5_K 6-bit packed (scale, min) pairs: [nb, 12] u8 ->
+    ([nb, 8], [nb, 8]) — ggml's get_scale_min_k4, vectorized."""
+    s = scales.astype(np.uint8)
+    sc = np.empty((s.shape[0], 8), np.uint8)
+    mn = np.empty_like(sc)
+    sc[:, :4] = s[:, 0:4] & 63
+    mn[:, :4] = s[:, 4:8] & 63
+    sc[:, 4:] = (s[:, 8:12] & 0xF) | ((s[:, 0:4] >> 6) << 4)
+    mn[:, 4:] = (s[:, 8:12] >> 4) | ((s[:, 4:8] >> 6) << 4)
+    return sc, mn
 
 
 # ---------------------------------------------------------------------------
@@ -316,10 +386,14 @@ def write_gguf(
     *,
     quant: dict[str, int] | int | None = None,
     align: int = 32,
+    raw_tensors: dict[str, tuple[tuple[int, ...], int, bytes]] | None = None,
 ) -> None:
     """Write a GGUF v3 file. ``quant`` selects ggml storage per tensor
     (a single type for all, or a per-name map); default stores float tensors
-    in their native width (f32/f16/bf16)."""
+    in their native width (f32/f16/bf16). ``raw_tensors`` carries
+    pre-encoded payloads as ``name -> (shape, ggml_type, bytes)`` — the
+    passthrough for block formats this writer has no encoder for
+    (K-quants), used by re-export tooling and fixtures."""
     import ml_dtypes
 
     # A caller round-tripping reader.metadata would otherwise duplicate the
@@ -361,10 +435,16 @@ def write_gguf(
             return _quantize_q4_0(arr)
         raise ValueError(f"writer does not support ggml type {t} (readable-only format)")
 
-    blobs: list[tuple[str, np.ndarray, int, bytes]] = []
+    blobs: list[tuple[str, tuple[int, ...], int, bytes]] = []
     for name, arr in tensors.items():
         t = ttype(name, np.asarray(arr))
-        blobs.append((name, np.asarray(arr), t, payload(np.asarray(arr), t)))
+        blobs.append((name, np.asarray(arr).shape, t, payload(np.asarray(arr), t)))
+    for name, (shape, t, data) in (raw_tensors or {}).items():
+        bpb, epb = _TYPE_SIZES[t]
+        expect = int(np.prod(shape)) // epb * bpb
+        if len(data) != expect:
+            raise ValueError(f"raw tensor {name}: {len(data)} bytes != {expect} for shape {shape}")
+        blobs.append((name, tuple(shape), t, data))
 
     with open(path, "wb") as f:
         f.write(MAGIC)
@@ -375,9 +455,9 @@ def write_gguf(
             _write_string(f, key)
             _write_value(f, val)
         offset = 0
-        for name, arr, t, data in blobs:
+        for name, shape, t, data in blobs:
             _write_string(f, name)
-            dims = tuple(reversed(arr.shape))  # ggml order: innermost first
+            dims = tuple(reversed(shape))  # ggml order: innermost first
             f.write(struct.pack("<I", len(dims)))
             for d in dims:
                 f.write(struct.pack("<Q", d))
@@ -385,7 +465,7 @@ def write_gguf(
             offset += (len(data) + align - 1) // align * align
         pad = (-f.tell()) % align
         f.write(b"\x00" * pad)
-        for _name, _arr, _t, data in blobs:
+        for _name, _shape, _t, data in blobs:
             f.write(data)
             f.write(b"\x00" * ((-len(data)) % align))
 

