@@ -433,13 +433,11 @@ class ModelRunner:
         """Pick the attention path for a (mesh-sharded) step.
 
         Whole-prompt prefills on a mesh with an ``sp`` axis run sequence-
-        parallel ring attention: every sequence's context starts at position
-        0 inside this chunk, so attending only the in-flight K/V is exact.
-        Chunk-continuations and decode use the paged path (they must read
-        the cache)."""
-        if self.cfg.attn_type == "mla":
-            # MLA has no ring path (latent cache attends paged only).
-            return self.attn_impl
+        parallel ring attention (MLA included — its absorbed form rings the
+        latent/rope stream, ``models/mla.py``): every sequence's context
+        starts at position 0 inside this chunk, so attending only the
+        in-flight K/V is exact. Chunk-continuations and decode use the
+        paged path (they must read the cache)."""
         t = padded.tokens.shape[1]
         if (
             self.mesh is not None

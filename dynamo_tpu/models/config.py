@@ -41,6 +41,19 @@ class ModelConfig:
     shared_expert_size: int = 0
     # Qwen2-MoE gates the shared expert with sigmoid(x @ g); DeepSeek doesn't.
     shared_expert_gated: bool = False
+    # Router semantics (parallel/moe.py:route_tokens). DeepSeek-V3:
+    # sigmoid scoring + aux-free e_score_correction_bias (noaux_tc) +
+    # group-limited top-k + routed scaling; Mixtral: softmax + renorm;
+    # Qwen2-MoE: softmax without renorm.
+    moe_scoring: str = "softmax"  # "softmax" | "sigmoid"
+    moe_norm_topk: bool = True  # renormalize the top-k weights
+    moe_routed_scaling: float = 1.0  # DeepSeek routed_scaling_factor
+    moe_n_group: int = 0  # group-limited routing (V3 n_group); 0 = off
+    moe_topk_group: int = 0
+    moe_router_bias: bool = False  # e_score_correction_bias present (noaux_tc)
+    # DeepSeek first_k_dense_replace: the first k layers use a dense MLP
+    # (params["dense_layers"]) while the rest are MoE (params["layers"]).
+    first_k_dense: int = 0
     # Biases on q/k/v projections (Qwen2 family).
     attention_bias: bool = False
     # Multimodal: the placeholder token id image embeddings substitute for
@@ -99,10 +112,10 @@ class ModelConfig:
         hidden = config["hidden_size"]
         heads = config["num_attention_heads"]
         # DeepSeek replaces the first k MoE layers with dense MLPs
-        # (first_k_dense_replace). k >= num_layers means the model is
-        # effectively dense (handled here); mixed stacks (0 < k < layers,
-        # real V2/V3 checkpoints) are not yet supported — the loader fails
-        # loudly on the dense layers' mlp.gate_proj tensors via strict mode.
+        # (first_k_dense_replace). k >= num_layers collapses to a plain
+        # dense model; mixed stacks (0 < k < layers, real V2/V3) carry
+        # first_k_dense through to the dense_layers/layers subtree split
+        # (models/llama.py dual scan, models/loader._leaf_specs).
         first_dense = int(config.get("first_k_dense_replace", 0) or 0)
         all_dense = first_dense >= config["num_hidden_layers"]
         return cls(
@@ -129,6 +142,24 @@ class ModelConfig:
             shared_expert_size=((config.get("shared_expert_intermediate_size", 0) or 0)
             or (config.get("n_shared_experts", 0) or 0) * (config.get("moe_intermediate_size", 0) or 0)) if n_experts else 0,
             shared_expert_gated=config.get("model_type") == "qwen2_moe",
+            moe_scoring=config.get("scoring_func", "softmax") if n_experts else "softmax",
+            # Mixtral renormalizes unconditionally (no config key) and
+            # DeepSeek-V3 defaults norm_topk_prob=True; Qwen2-MoE/V2 default
+            # False (real checkpoints set the key explicitly either way).
+            moe_norm_topk=bool(config.get(
+                "norm_topk_prob", config.get("model_type") in ("mixtral", "deepseek_v3")
+            )),
+            moe_routed_scaling=float(config.get("routed_scaling_factor", 1.0) or 1.0),
+            moe_n_group=(config.get("n_group", 0) or 0) if n_experts else 0,
+            moe_topk_group=(config.get("topk_group", 0) or 0) if n_experts else 0,
+            # noaux_tc correction bias: native transformers' DeepseekV3Config
+            # doesn't serialize topk_method, but its modeling always creates
+            # e_score_correction_bias — key off model_type too.
+            moe_router_bias=bool(n_experts) and (
+                config.get("topk_method", "") == "noaux_tc"
+                or config.get("model_type") == "deepseek_v3"
+            ),
+            first_k_dense=0 if all_dense else first_dense,
             attention_bias=bool(config.get("attention_bias", config.get("model_type") in ("qwen2", "qwen2_moe"))),
             # DeepSeek-V2/V3: MLA signalled by the latent-rank keys.
             attn_type="mla" if config.get("kv_lora_rank") else "gqa",
@@ -220,9 +251,29 @@ PRESETS: dict[str, ModelConfig] = {
         num_layers=61, num_heads=128, num_kv_heads=128, head_dim=64,
         intermediate_size=18432, rope_theta=10000.0, max_position=163840,
         num_experts=256, num_experts_per_token=8, moe_intermediate_size=2048,
+        shared_expert_size=2048,  # n_shared_experts=1
         attn_type="mla", q_lora_rank=1536, kv_lora_rank=512,
         qk_nope_head_dim=128, qk_rope_head_dim=64, v_head_dim=128,
         rope_interleave=True,  # real V3 checkpoints ship interleaved rope dims
+        # V3 router: sigmoid scores + aux-free correction bias, 8 groups
+        # with the best 4 eligible, renormalized weights scaled 2.5x.
+        moe_scoring="sigmoid", moe_router_bias=True, moe_norm_topk=True,
+        moe_routed_scaling=2.5, moe_n_group=8, moe_topk_group=4,
+        first_k_dense=3,
+    ),
+    # Tiny V3-true-shape test model: MLA + sigmoid/noaux_tc routing +
+    # group-limited top-k + a leading dense layer (mirrors the golden test).
+    "test-tiny-v3": ModelConfig(
+        name="test-tiny-v3", vocab_size=256, hidden_size=64, num_layers=3,
+        num_heads=4, num_kv_heads=4, head_dim=16, intermediate_size=128,
+        rope_theta=10000.0, max_position=512, tie_embeddings=True, dtype="float32",
+        num_experts=4, num_experts_per_token=2, moe_intermediate_size=32,
+        shared_expert_size=32,
+        attn_type="mla", q_lora_rank=32, kv_lora_rank=24,
+        qk_nope_head_dim=16, qk_rope_head_dim=8, v_head_dim=16,
+        rope_interleave=True, moe_scoring="sigmoid", moe_router_bias=True,
+        moe_norm_topk=True, moe_routed_scaling=2.5, moe_n_group=2,
+        moe_topk_group=1, first_k_dense=1,
     ),
     # MLA test model (tiny): latent cache + absorbed projections.
     "test-tiny-mla": ModelConfig(

@@ -40,75 +40,88 @@ def param_dtype(cfg: ModelConfig) -> jnp.dtype:
 
 
 def init_params(cfg: ModelConfig, rng: jax.Array | int = 0) -> Params:
-    """Random-init parameters (tests / benchmarks without checkpoint download)."""
+    """Random-init parameters (tests / benchmarks without checkpoint download).
+
+    With ``cfg.first_k_dense > 0`` (DeepSeek first_k_dense_replace) the
+    pytree carries two stacked subtrees: ``dense_layers`` (the first k
+    layers, dense MLP) and ``layers`` (the remaining MoE layers)."""
     if isinstance(rng, int):
         rng = jax.random.PRNGKey(rng)
     dt = param_dtype(cfg)
     keys = jax.random.split(rng, 12)
-    d, q, kv, f, l = cfg.hidden_size, cfg.q_dim, cfg.kv_dim, cfg.intermediate_size, cfg.num_layers
+    d, q, kv, f = cfg.hidden_size, cfg.q_dim, cfg.kv_dim, cfg.intermediate_size
 
     def w(key, shape, fan_in):
         return (jax.random.normal(key, shape, jnp.float32) * (fan_in**-0.5)).astype(dt)
 
-    layers = {
-        "attn_norm": jnp.ones((l, d), dt),
-        "mlp_norm": jnp.ones((l, d), dt),
-    }
-    if cfg.attn_type == "mla":
-        from dynamo_tpu.models.mla import init_mla_params
+    def layer_stack(l: int, moe: bool, key_salt: int) -> dict:
+        ks = [jax.random.fold_in(k, key_salt) for k in keys]
+        layers = {
+            "attn_norm": jnp.ones((l, d), dt),
+            "mlp_norm": jnp.ones((l, d), dt),
+        }
+        if cfg.attn_type == "mla":
+            from dynamo_tpu.models.mla import init_mla_params
 
-        layers.update(init_mla_params(cfg, keys[0], dt, l))
-    else:
-        layers.update(
-            {
-                "wq": w(keys[0], (l, d, q), d),
-                "wk": w(keys[1], (l, d, kv), d),
-                "wv": w(keys[2], (l, d, kv), d),
-                "wo": w(keys[3], (l, q, d), q),
-            }
-        )
-    if cfg.attention_bias:
-        layers.update(
-            {
-                "bq": jnp.zeros((l, q), dt),
-                "bk": jnp.zeros((l, kv), dt),
-                "bv": jnp.zeros((l, kv), dt),
-            }
-        )
-    if cfg.is_moe:
-        e, mf = cfg.num_experts, cfg.moe_intermediate_size
-        layers.update(
-            {
-                "router": w(keys[4], (l, d, e), d),
-                "w_gate": w(keys[5], (l, e, d, mf), d),
-                "w_up": w(keys[6], (l, e, d, mf), d),
-                "w_down": w(keys[7], (l, e, mf, d), mf),
-            }
-        )
-        if cfg.shared_expert_size:
-            fs = cfg.shared_expert_size
+            layers.update(init_mla_params(cfg, ks[0], dt, l))
+        else:
             layers.update(
                 {
-                    "w_shared_gate": w(keys[10], (l, d, fs), d),
-                    "w_shared_up": w(keys[11], (l, d, fs), d),
-                    "w_shared_down": w(keys[9], (l, fs, d), fs),
+                    "wq": w(ks[0], (l, d, q), d),
+                    "wk": w(ks[1], (l, d, kv), d),
+                    "wv": w(ks[2], (l, d, kv), d),
+                    "wo": w(ks[3], (l, q, d), q),
                 }
             )
-            if cfg.shared_expert_gated:
-                layers["shared_gate"] = w(keys[8], (l, d, 1), d)
-    else:
-        layers.update(
-            {
-                "w_gate": w(keys[5], (l, d, f), d),
-                "w_up": w(keys[6], (l, d, f), d),
-                "w_down": w(keys[7], (l, f, d), f),
-            }
-        )
+        if cfg.attention_bias:
+            layers.update(
+                {
+                    "bq": jnp.zeros((l, q), dt),
+                    "bk": jnp.zeros((l, kv), dt),
+                    "bv": jnp.zeros((l, kv), dt),
+                }
+            )
+        if moe:
+            e, mf = cfg.num_experts, cfg.moe_intermediate_size
+            layers.update(
+                {
+                    "router": w(ks[4], (l, d, e), d),
+                    "w_gate": w(ks[5], (l, e, d, mf), d),
+                    "w_up": w(ks[6], (l, e, d, mf), d),
+                    "w_down": w(ks[7], (l, e, mf, d), mf),
+                }
+            )
+            if cfg.moe_router_bias:
+                layers["router_bias"] = jnp.zeros((l, e), jnp.float32)
+            if cfg.shared_expert_size:
+                fs = cfg.shared_expert_size
+                layers.update(
+                    {
+                        "w_shared_gate": w(ks[10], (l, d, fs), d),
+                        "w_shared_up": w(ks[11], (l, d, fs), d),
+                        "w_shared_down": w(ks[9], (l, fs, d), fs),
+                    }
+                )
+                if cfg.shared_expert_gated:
+                    layers["shared_gate"] = w(ks[8], (l, d, 1), d)
+        else:
+            layers.update(
+                {
+                    "w_gate": w(ks[5], (l, d, f), d),
+                    "w_up": w(ks[6], (l, d, f), d),
+                    "w_down": w(ks[7], (l, f, d), f),
+                }
+            )
+        return layers
+
+    k_dense = cfg.first_k_dense if cfg.is_moe else 0
     params: Params = {
         "embed": w(keys[8], (cfg.vocab_size, d), d),
         "norm_f": jnp.ones((d,), dt),
-        "layers": layers,
+        "layers": layer_stack(cfg.num_layers - k_dense, cfg.is_moe, 0),
     }
+    if k_dense:
+        params["dense_layers"] = layer_stack(k_dense, False, 1)
     if not cfg.tie_embeddings:
         params["lm_head"] = w(keys[9], (d, cfg.vocab_size), d)
     return params
@@ -146,6 +159,20 @@ def _mlp_dense(lp: Params, x: jnp.ndarray) -> jnp.ndarray:
     return _qmm(gate * _qmm(x, lp["w_up"]), lp["w_down"])
 
 
+def _routing_kwargs(cfg: ModelConfig) -> dict:
+    """Family router semantics for ``parallel/moe.route_tokens``."""
+    return dict(
+        scoring=cfg.moe_scoring,
+        norm_topk=cfg.moe_norm_topk,
+        scaling=cfg.moe_routed_scaling,
+        n_group=cfg.moe_n_group,
+        topk_group=cfg.moe_topk_group,
+        # noaux_tc (V3) ranks groups by top-2 sum of biased scores;
+        # group_limited_greedy (V2) by per-group max.
+        group_score="top2sum" if cfg.moe_router_bias else "max",
+    )
+
+
 def _mlp_moe(lp: Params, x: jnp.ndarray, cfg: ModelConfig, mesh=None) -> jnp.ndarray:
     """Top-k routed MoE (``dynamo_tpu/parallel/moe.py``).
 
@@ -158,8 +185,11 @@ def _mlp_moe(lp: Params, x: jnp.ndarray, cfg: ModelConfig, mesh=None) -> jnp.nda
     b, t, d = x.shape
     xt = x.reshape(b * t, d)
     ep = int(mesh.shape.get("ep", 1)) if mesh is not None else 1
+    routing = _routing_kwargs(cfg)
     if ep <= 1:
-        out = moe_mlp_dropless(lp, xt, num_experts_per_token=cfg.num_experts_per_token)
+        out = moe_mlp_dropless(
+            lp, xt, num_experts_per_token=cfg.num_experts_per_token, routing=routing
+        )
     else:
         cf = cfg.moe_capacity_factor
         out = moe_mlp(
@@ -167,6 +197,7 @@ def _mlp_moe(lp: Params, x: jnp.ndarray, cfg: ModelConfig, mesh=None) -> jnp.nda
             num_experts_per_token=cfg.num_experts_per_token,
             capacity_factor=cf,
             capacity=(b * t * cfg.num_experts_per_token) if cf <= 0 else None,
+            routing=routing,
         )
     if cfg.shared_expert_size:
         shared = _qmm(jax.nn.silu(_qmm(xt, lp["w_shared_gate"])) * _qmm(xt, lp["w_shared_up"]), lp["w_shared_down"])
@@ -180,12 +211,17 @@ def _mlp_moe_dense(lp: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
     """Dense-compute MoE reference: every token through every expert, mixed
     by routing weights. O(N*E) FLOPs — kept as the golden model for tests of
     the dispatched path, never used for serving."""
+    from dynamo_tpu.parallel.moe import route_tokens
+
     b, t, d = x.shape
     xt = x.reshape(b * t, d)
-    router_logits = (xt @ lp["router"]).astype(jnp.float32)  # [N, E]
-    topv, topi = jax.lax.top_k(router_logits, cfg.num_experts_per_token)
-    weights = jax.nn.softmax(topv, axis=-1)  # [N, k]
-    mix = jnp.zeros_like(router_logits).at[jnp.arange(xt.shape[0])[:, None], topi].set(weights)  # [N, E]
+    weights, topi = route_tokens(
+        lp, xt, k=cfg.num_experts_per_token, **_routing_kwargs(cfg)
+    )
+    e = lp["router"].shape[-1]
+    mix = jnp.zeros((xt.shape[0], e), jnp.float32).at[
+        jnp.arange(xt.shape[0])[:, None], topi
+    ].set(weights)  # [N, E]
     gate = jax.nn.silu(jnp.einsum("nd,edf->nef", xt, _dq(lp["w_gate"])))
     up = jnp.einsum("nd,edf->nef", xt, _dq(lp["w_up"]))
     expert_out = jnp.einsum("nef,efd->ned", gate * up, _dq(lp["w_down"]))  # [N, E, d]
@@ -267,57 +303,66 @@ def forward(
 
     mla = cfg.attn_type == "mla"
     if mla:
-        assert not ring, "MLA does not support the ring (sp) prefill path yet"
         inv_freq_mla = jnp.asarray(
             rope_frequencies(cfg.qk_rope_head_dim, theta=cfg.rope_theta, scaling=cfg.rope_scaling)
         )
 
-    def layer_step(carry, lp):
-        x, k_full, v_full, li = carry
-        h = rms_norm(x, lp["attn_norm"], eps=cfg.rms_eps)
-        if mla:
-            from dynamo_tpu.models.mla import mla_attention
+    def make_layer_step(moe_layer: bool):
+        def layer_step(carry, lp):
+            x, k_full, v_full, li = carry
+            h = rms_norm(x, lp["attn_norm"], eps=cfg.rms_eps)
+            if mla:
+                from dynamo_tpu.models.mla import mla_attention
 
-            attn_out, k_full, v_full = mla_attention(
-                lp, cfg, h, positions, k_full, v_full,
-                block_tables + li * npages,
-                slot_mapping + li * (npages * ps),
-                inv_freq_mla,
-                attn_mscale=attn_mscale,
-            )
-            x = x + attn_out
+                attn_out, k_full, v_full = mla_attention(
+                    lp, cfg, h, positions, k_full, v_full,
+                    block_tables + li * npages,
+                    slot_mapping + li * (npages * ps),
+                    inv_freq_mla,
+                    attn_mscale=attn_mscale,
+                    ring=ring, mesh=mesh,
+                    ring_positions=ring_pos if ring else None,
+                )
+                x = x + attn_out
+                h2 = rms_norm(x, lp["mlp_norm"], eps=cfg.rms_eps)
+                mlp = _mlp_moe(lp, h2, cfg, mesh) if moe_layer else _mlp_dense(lp, h2)
+                return (x + mlp, k_full, v_full, li + 1), None
+            qp, kp, vp = _qmm(h, lp["wq"]), _qmm(h, lp["wk"]), _qmm(h, lp["wv"])
+            if cfg.attention_bias:
+                qp, kp, vp = qp + lp["bq"], kp + lp["bk"], vp + lp["bv"]
+            q = qp.reshape(b, t, cfg.num_heads, cfg.head_dim)
+            k = kp.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+            v = vp.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+            q = apply_rope(q, positions, inv_freq)
+            k = apply_rope(k, positions, inv_freq)
+            if attn_mscale != 1.0:  # YaRN temperature: logits scale by mscale^2
+                q = q * jnp.asarray(attn_mscale, q.dtype)
+            k_full, v_full = write_kv(k_full, v_full, k, v, slot_mapping + li * (npages * ps))
+            if ring:
+                from dynamo_tpu.parallel.ring import ring_attention
+
+                attn = ring_attention(q, k, v, ring_pos, mesh, scale=cfg.head_dim**-0.5)
+            else:
+                tables_l = block_tables + li * npages
+                attn = paged_attention(q, k_full, v_full, tables_l, positions, impl=attn_impl)
+            x = x + _qmm(attn.reshape(b, t, cfg.q_dim), lp["wo"])
             h2 = rms_norm(x, lp["mlp_norm"], eps=cfg.rms_eps)
-            mlp = _mlp_moe(lp, h2, cfg, mesh) if cfg.is_moe else _mlp_dense(lp, h2)
-            return (x + mlp, k_full, v_full, li + 1), None
-        qp, kp, vp = _qmm(h, lp["wq"]), _qmm(h, lp["wk"]), _qmm(h, lp["wv"])
-        if cfg.attention_bias:
-            qp, kp, vp = qp + lp["bq"], kp + lp["bk"], vp + lp["bv"]
-        q = qp.reshape(b, t, cfg.num_heads, cfg.head_dim)
-        k = kp.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
-        v = vp.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
-        q = apply_rope(q, positions, inv_freq)
-        k = apply_rope(k, positions, inv_freq)
-        if attn_mscale != 1.0:  # YaRN temperature: logits scale by mscale^2
-            q = q * jnp.asarray(attn_mscale, q.dtype)
-        k_full, v_full = write_kv(k_full, v_full, k, v, slot_mapping + li * (npages * ps))
-        if ring:
-            from dynamo_tpu.parallel.ring import ring_attention
+            mlp = _mlp_moe(lp, h2, cfg, mesh) if moe_layer else _mlp_dense(lp, h2)
+            x = x + mlp
+            return (x, k_full, v_full, li + 1), None
 
-            attn = ring_attention(q, k, v, ring_pos, mesh, scale=cfg.head_dim**-0.5)
-        else:
-            tables_l = block_tables + li * npages
-            attn = paged_attention(q, k_full, v_full, tables_l, positions, impl=attn_impl)
-        x = x + _qmm(attn.reshape(b, t, cfg.q_dim), lp["wo"])
-        h2 = rms_norm(x, lp["mlp_norm"], eps=cfg.rms_eps)
-        mlp = _mlp_moe(lp, h2, cfg, mesh) if cfg.is_moe else _mlp_dense(lp, h2)
-        x = x + mlp
-        return (x, k_full, v_full, li + 1), None
+        return layer_step
 
     # Scan over layers: one layer's program is traced once — compile time is
-    # O(1) in depth (matters at 70B/80-layer scale).
+    # O(1) in depth (matters at 70B/80-layer scale). Mixed DeepSeek stacks
+    # (first_k_dense_replace) run two scans — dense layers first — with the
+    # layer counter (cache offsets) carried straight through.
+    carry = (x, kf0, vf0, jnp.int32(0))
+    if "dense_layers" in params:
+        carry, _ = jax.lax.scan(make_layer_step(False), carry, params["dense_layers"])
     (x, k_out, v_out, _), _ = jax.lax.scan(
-        layer_step,
-        (x, kf0, vf0, jnp.int32(0)),
+        make_layer_step(cfg.is_moe),
+        carry,
         params["layers"],
     )
     k_out = k_out.reshape(k_cache.shape)
@@ -362,27 +407,32 @@ def encode(
     groups = cfg.num_heads // cfg.num_kv_heads
     scale = cfg.head_dim**-0.5
 
-    def layer_step(x, lp):
-        h = rms_norm(x, lp["attn_norm"], eps=cfg.rms_eps)
-        qp, kp, vp = _qmm(h, lp["wq"]), _qmm(h, lp["wk"]), _qmm(h, lp["wv"])
-        if cfg.attention_bias:
-            qp, kp, vp = qp + lp["bq"], kp + lp["bk"], vp + lp["bv"]
-        q = apply_rope(qp.reshape(b, t, cfg.num_heads, cfg.head_dim), positions, inv_freq)
-        k = apply_rope(kp.reshape(b, t, cfg.num_kv_heads, cfg.head_dim), positions, inv_freq)
-        if attn_mscale != 1.0:  # YaRN temperature: logits scale by mscale^2
-            q = q * jnp.asarray(attn_mscale, q.dtype)
-        v = vp.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
-        q = q.reshape(b, t, cfg.num_kv_heads, groups, cfg.head_dim)
-        scores = jnp.einsum("bqhgd,bkhd->bhgqk", q, k).astype(jnp.float32) * scale
-        scores = scores + bias[:, :, None, :, :]
-        probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
-        attn = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v).reshape(b, t, cfg.q_dim)
-        x = x + _qmm(attn, lp["wo"])
-        h2 = rms_norm(x, lp["mlp_norm"], eps=cfg.rms_eps)
-        mlp = _mlp_moe(lp, h2, cfg) if cfg.is_moe else _mlp_dense(lp, h2)
-        return x + mlp, None
+    def make_layer_step(moe_layer: bool):
+        def layer_step(x, lp):
+            h = rms_norm(x, lp["attn_norm"], eps=cfg.rms_eps)
+            qp, kp, vp = _qmm(h, lp["wq"]), _qmm(h, lp["wk"]), _qmm(h, lp["wv"])
+            if cfg.attention_bias:
+                qp, kp, vp = qp + lp["bq"], kp + lp["bk"], vp + lp["bv"]
+            q = apply_rope(qp.reshape(b, t, cfg.num_heads, cfg.head_dim), positions, inv_freq)
+            k = apply_rope(kp.reshape(b, t, cfg.num_kv_heads, cfg.head_dim), positions, inv_freq)
+            if attn_mscale != 1.0:  # YaRN temperature: logits scale by mscale^2
+                q = q * jnp.asarray(attn_mscale, q.dtype)
+            v = vp.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+            q = q.reshape(b, t, cfg.num_kv_heads, groups, cfg.head_dim)
+            scores = jnp.einsum("bqhgd,bkhd->bhgqk", q, k).astype(jnp.float32) * scale
+            scores = scores + bias[:, :, None, :, :]
+            probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+            attn = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v).reshape(b, t, cfg.q_dim)
+            x = x + _qmm(attn, lp["wo"])
+            h2 = rms_norm(x, lp["mlp_norm"], eps=cfg.rms_eps)
+            mlp = _mlp_moe(lp, h2, cfg) if moe_layer else _mlp_dense(lp, h2)
+            return x + mlp, None
 
-    x, _ = jax.lax.scan(layer_step, x, params["layers"])
+        return layer_step
+
+    if "dense_layers" in params:
+        x, _ = jax.lax.scan(make_layer_step(False), x, params["dense_layers"])
+    x, _ = jax.lax.scan(make_layer_step(cfg.is_moe), x, params["layers"])
     x = rms_norm(x, params["norm_f"], eps=cfg.rms_eps).astype(jnp.float32)
     m = mask[:, :, None].astype(jnp.float32)
     pooled = (x * m).sum(1) / jnp.maximum(m.sum(1), 1.0)

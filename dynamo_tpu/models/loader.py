@@ -257,16 +257,22 @@ class _KvBLeaf:
     """
 
     def __init__(self, index: "CheckpointIndex", num_layers: int, n_heads: int,
-                 dn: int, dv: int, offset: int, width: int, dtype) -> None:
+                 dn: int, dv: int, offset: int, width: int, dtype,
+                 layer_offset: int = 0) -> None:
         self.index = index
-        self.shape = (num_layers, index.shape("model.layers.0.self_attn.kv_b_proj.weight")[1], n_heads, width)
+        self.layer_offset = layer_offset
+        self.shape = (
+            num_layers,
+            index.shape(f"model.layers.{layer_offset}.self_attn.kv_b_proj.weight")[1],
+            n_heads, width,
+        )
         self.n_heads, self.seg = n_heads, dn + dv
         self.offset, self.width = offset, width
         self.dtype = dtype
         self.ndim = 4
 
     def per_layer_name(self, li: int) -> str:
-        return f"model.layers.{li}.self_attn.kv_b_proj.weight"
+        return f"model.layers.{li + self.layer_offset}.self_attn.kv_b_proj.weight"
 
     def __getitem__(self, idx) -> np.ndarray:
         if not isinstance(idx, tuple):
@@ -283,85 +289,104 @@ class _KvBLeaf:
         return np.stack(out_layers).astype(self.dtype, copy=False)
 
 
+_MOE_ROUTER_BIAS = ("mlp.gate.e_score_correction_bias",)
+
+
 def _leaf_specs(index: CheckpointIndex, cfg: ModelConfig, dtype: np.dtype) -> dict[str, Any]:
-    """Build the params pytree of _LazyLeaf / lazy top-level reads."""
-    d, l = cfg.hidden_size, cfg.num_layers
+    """Build the params pytree of _LazyLeaf / lazy top-level reads.
 
-    def simple(suffixes: tuple[str, ...], transpose: bool, row_perm: np.ndarray | None = None):
-        name0 = _find(index, suffixes, 0)
-        shp = index.shape(name0)
-        shp = shp[::-1] if transpose else shp
-        return _LazyLeaf(
-            index, (l, *shp),
-            lambda li, s=suffixes, t=transpose: [(_find(index, s, li), t)],
-            dtype, row_perm=row_perm,
-        )
+    Mixed DeepSeek stacks (``cfg.first_k_dense``) produce two subtrees:
+    ``dense_layers`` (checkpoint layers [0, k), dense MLP) and ``layers``
+    (checkpoint layers [k, L), MoE)."""
+    d = cfg.hidden_size
 
-    if cfg.attn_type == "mla":
-        layers = {
-            name: simple(suffixes, t)
-            for name, (suffixes, t) in _LAYER_MAP.items()
-            if name in ("attn_norm", "mlp_norm")
-        }
-        # DeepSeek checkpoints store rope dims interleaved: permute the rope
-        # rows of the q projection (per head) and kv_a_proj (single shared
-        # rope key) to half-split at load (rope_load_perm docstring).
-        q_perm = kv_perm = None
-        if cfg.rope_interleave:
-            q_perm = rope_load_perm(
-                cfg.num_heads, cfg.qk_nope_head_dim + cfg.qk_rope_head_dim, cfg.qk_rope_head_dim
+    def subtree(l0: int, count: int, moe: bool) -> dict[str, Any]:
+        def simple(suffixes: tuple[str, ...], transpose: bool,
+                   row_perm: np.ndarray | None = None, leaf_dtype=None):
+            name0 = _find(index, suffixes, l0)
+            shp = index.shape(name0)
+            shp = shp[::-1] if transpose else shp
+            return _LazyLeaf(
+                index, (count, *shp),
+                lambda li, s=suffixes, t=transpose: [(_find(index, s, li + l0), t)],
+                leaf_dtype or dtype, row_perm=row_perm,
             )
-            kv_perm = rope_load_perm(
-                1, cfg.kv_lora_rank + cfg.qk_rope_head_dim, cfg.qk_rope_head_dim
+
+        if cfg.attn_type == "mla":
+            layers = {
+                name: simple(suffixes, t)
+                for name, (suffixes, t) in _LAYER_MAP.items()
+                if name in ("attn_norm", "mlp_norm")
+            }
+            # DeepSeek checkpoints store rope dims interleaved: permute the
+            # rope rows of the q projection (per head) and kv_a_proj (single
+            # shared rope key) to half-split at load (rope_load_perm).
+            q_perm = kv_perm = None
+            if cfg.rope_interleave:
+                q_perm = rope_load_perm(
+                    cfg.num_heads, cfg.qk_nope_head_dim + cfg.qk_rope_head_dim, cfg.qk_rope_head_dim
+                )
+                kv_perm = rope_load_perm(
+                    1, cfg.kv_lora_rank + cfg.qk_rope_head_dim, cfg.qk_rope_head_dim
+                )
+            for name, (suffixes, t) in _MLA_MAP.items():
+                if name in ("w_q_a", "q_norm", "w_q_b") and cfg.q_lora_rank <= 0:
+                    continue
+                if name == "w_q" and cfg.q_lora_rank > 0:
+                    continue
+                perm = {"w_q_b": q_perm, "w_q": q_perm, "w_kv_a": kv_perm}.get(name)
+                layers[name] = simple(suffixes, t, row_perm=perm)
+            layers["w_uk"] = _KvBLeaf(
+                index, count, cfg.num_heads, cfg.qk_nope_head_dim, cfg.v_head_dim,
+                0, cfg.qk_nope_head_dim, dtype, layer_offset=l0,
             )
-        for name, (suffixes, t) in _MLA_MAP.items():
-            if name in ("w_q_a", "q_norm", "w_q_b") and cfg.q_lora_rank <= 0:
-                continue
-            if name == "w_q" and cfg.q_lora_rank > 0:
-                continue
-            perm = {"w_q_b": q_perm, "w_q": q_perm, "w_kv_a": kv_perm}.get(name)
-            layers[name] = simple(suffixes, t, row_perm=perm)
-        layers["w_uk"] = _KvBLeaf(
-            index, l, cfg.num_heads, cfg.qk_nope_head_dim, cfg.v_head_dim,
-            0, cfg.qk_nope_head_dim, dtype,
-        )
-        layers["w_uv"] = _KvBLeaf(
-            index, l, cfg.num_heads, cfg.qk_nope_head_dim, cfg.v_head_dim,
-            cfg.qk_nope_head_dim, cfg.v_head_dim, dtype,
-        )
-    else:
-        layers = {
-            name: simple(suffixes, t)
-            for name, (suffixes, t) in _LAYER_MAP.items()
-            if name not in ("w_gate", "w_up", "w_down")
-        }
-    if cfg.attention_bias:
-        for name, (suffixes, t) in _BIAS_MAP.items():
-            layers[name] = simple(suffixes, t)
-    moe = cfg.is_moe and any(
-        f"model.layers.0.{c}" in index for c in _MOE_ROUTER
-    )
-    if moe:
-        e = cfg.num_experts
-        layers["router"] = simple(_MOE_ROUTER, True)
-        for name, (suffixes, t) in _MOE_EXPERT_MAP.items():
-            name0 = _find(index, suffixes, 0, 0)
-            shp = index.shape(name0)[::-1]
-            layers[name] = _LazyLeaf(
-                index,
-                (l, e, *shp),
-                lambda li, s=suffixes, t=t: [(_find(index, s, li, ei), t) for ei in range(e)],
-                dtype,
-                expert_axis=True,
+            layers["w_uv"] = _KvBLeaf(
+                index, count, cfg.num_heads, cfg.qk_nope_head_dim, cfg.v_head_dim,
+                cfg.qk_nope_head_dim, cfg.v_head_dim, dtype, layer_offset=l0,
             )
-        if cfg.shared_expert_size:
-            for name, (suffixes, t) in _SHARED_EXPERT_MAP.items():
+        else:
+            layers = {
+                name: simple(suffixes, t)
+                for name, (suffixes, t) in _LAYER_MAP.items()
+                if name not in ("w_gate", "w_up", "w_down")
+            }
+        if cfg.attention_bias:
+            for name, (suffixes, t) in _BIAS_MAP.items():
                 layers[name] = simple(suffixes, t)
-            if cfg.shared_expert_gated:
-                layers["shared_gate"] = simple(_SHARED_GATE, True)
-    else:
-        for name in ("w_gate", "w_up", "w_down"):
-            layers[name] = simple(_LAYER_MAP[name][0], True)
+        if moe:
+            e = cfg.num_experts
+            layers["router"] = simple(_MOE_ROUTER, True)
+            if cfg.moe_router_bias:
+                # The correction bias competes with sigmoid scores at O(1e-2)
+                # margins: keep it fp32 (as HF does), never the compute dtype.
+                layers["router_bias"] = simple(
+                    _MOE_ROUTER_BIAS, False, leaf_dtype=np.float32
+                )
+            for name, (suffixes, t) in _MOE_EXPERT_MAP.items():
+                name0 = _find(index, suffixes, l0, 0)
+                shp = index.shape(name0)[::-1]
+                layers[name] = _LazyLeaf(
+                    index,
+                    (count, e, *shp),
+                    lambda li, s=suffixes, t=t: [(_find(index, s, li + l0, ei), t) for ei in range(e)],
+                    dtype,
+                    expert_axis=True,
+                )
+            if cfg.shared_expert_size:
+                for name, (suffixes, t) in _SHARED_EXPERT_MAP.items():
+                    layers[name] = simple(suffixes, t)
+                if cfg.shared_expert_gated:
+                    layers["shared_gate"] = simple(_SHARED_GATE, True)
+        else:
+            for name in ("w_gate", "w_up", "w_down"):
+                layers[name] = simple(_LAYER_MAP[name][0], True)
+        return layers
+
+    k_dense = cfg.first_k_dense if cfg.is_moe else 0
+    moe = cfg.is_moe and any(
+        f"model.layers.{k_dense}.{c}" in index for c in _MOE_ROUTER
+    )
+    layers = subtree(k_dense, cfg.num_layers - k_dense, moe)
 
     class _TopLeaf:
         def __init__(self, name: str, transpose: bool) -> None:
@@ -387,6 +412,8 @@ def _leaf_specs(index: CheckpointIndex, cfg: ModelConfig, dtype: np.dtype) -> di
         "norm_f": _TopLeaf("model.norm.weight", False),
         "layers": layers,
     }
+    if k_dense:
+        params["dense_layers"] = subtree(0, k_dense, False)
     if not cfg.tie_embeddings:
         if "lm_head.weight" in index:
             params["lm_head"] = _TopLeaf("lm_head.weight", True)
@@ -397,15 +424,16 @@ def _leaf_specs(index: CheckpointIndex, cfg: ModelConfig, dtype: np.dtype) -> di
 
 def _consumed_names(specs: dict, num_layers: int) -> set[str]:
     """Every checkpoint tensor the spec tree will read."""
+    del num_layers  # each stacked leaf knows its own layer count (shape[0])
     names: set[str] = set()
 
     def walk(tree):
         for leaf in jax.tree.leaves(tree, is_leaf=lambda x: hasattr(x, "shape")):
             if isinstance(leaf, _LazyLeaf):
-                for li in range(num_layers):
+                for li in range(leaf.shape[0]):
                     names.update(n for n, _t in leaf.per_layer(li))
             elif isinstance(leaf, _KvBLeaf):
-                names.update(leaf.per_layer_name(li) for li in range(num_layers))
+                names.update(leaf.per_layer_name(li) for li in range(leaf.shape[0]))
             else:
                 names.add(leaf.name)
 
@@ -545,7 +573,16 @@ def save_params(
             num_experts=cfg.num_experts,
             num_experts_per_tok=cfg.num_experts_per_token,
             moe_intermediate_size=cfg.moe_intermediate_size,
+            scoring_func=cfg.moe_scoring,
+            norm_topk_prob=cfg.moe_norm_topk,
+            routed_scaling_factor=cfg.moe_routed_scaling,
         )
+        if cfg.moe_n_group:
+            hf_cfg.update(n_group=cfg.moe_n_group, topk_group=cfg.moe_topk_group)
+        if cfg.moe_router_bias:
+            hf_cfg["topk_method"] = "noaux_tc"
+        if cfg.first_k_dense:
+            hf_cfg["first_k_dense_replace"] = cfg.first_k_dense
         if cfg.shared_expert_size:
             if cfg.shared_expert_gated:
                 hf_cfg["shared_expert_intermediate_size"] = cfg.shared_expert_size
@@ -567,49 +604,56 @@ def save_params(
     put("model.norm.weight", params["norm_f"], False)
     if not cfg.tie_embeddings and "lm_head" in params:
         put("lm_head.weight", params["lm_head"], True)
-    lp = params["layers"]
-    for li in range(cfg.num_layers):
-        base = f"model.layers.{li}."
-        for leaf, (suffixes, transpose) in _LAYER_MAP.items():
-            if cfg.is_moe and leaf in _MOE_EXPERT_MAP:
-                continue
-            if cfg.attn_type == "mla" and leaf in ("wq", "wk", "wv", "wo"):
-                continue
-            put(base + suffixes[0], lp[leaf][li], transpose)
-        if cfg.attn_type == "mla":
-            q_sperm = kv_sperm = None
-            if cfg.rope_interleave:
-                q_sperm = rope_save_perm(
-                    cfg.num_heads, cfg.qk_nope_head_dim + cfg.qk_rope_head_dim, cfg.qk_rope_head_dim
-                )
-                kv_sperm = rope_save_perm(
-                    1, cfg.kv_lora_rank + cfg.qk_rope_head_dim, cfg.qk_rope_head_dim
-                )
-            for leaf, (suffixes, transpose) in _MLA_MAP.items():
-                if leaf in lp:
-                    sperm = {"w_q_b": q_sperm, "w_q": q_sperm, "w_kv_a": kv_sperm}.get(leaf)
-                    put(base + suffixes[0], lp[leaf][li], transpose, row_perm=sperm)
-            # kv_b_proj: interleave per-head [K_nope; V] row blocks
-            uk = np.asarray(lp["w_uk"][li])  # [r_kv, H, dn]
-            uv = np.asarray(lp["w_uv"][li])  # [r_kv, H, dv]
-            per_head = np.concatenate(
-                [np.transpose(uk, (1, 2, 0)), np.transpose(uv, (1, 2, 0))], axis=1
-            )  # [H, dn+dv, r_kv]
-            put(base + "self_attn.kv_b_proj.weight", per_head.reshape(-1, per_head.shape[-1]), False)
-        if cfg.attention_bias:
-            for leaf, (suffixes, transpose) in _BIAS_MAP.items():
+    def write_subtree(lp, l0: int, count: int, moe: bool) -> None:
+        for li in range(count):
+            base = f"model.layers.{li + l0}."
+            for leaf, (suffixes, transpose) in _LAYER_MAP.items():
+                if moe and leaf in _MOE_EXPERT_MAP:
+                    continue
+                if cfg.attn_type == "mla" and leaf in ("wq", "wk", "wv", "wo"):
+                    continue
                 put(base + suffixes[0], lp[leaf][li], transpose)
-        if cfg.is_moe:
-            put(base + _MOE_ROUTER[0], lp["router"][li], True)
-            for leaf, (suffixes, transpose) in _MOE_EXPERT_MAP.items():
-                for e in range(cfg.num_experts):
-                    put(base + suffixes[0].format(e=e), lp[leaf][li, e], transpose)
-            if cfg.shared_expert_size:
-                src = 0 if cfg.shared_expert_gated else 1
-                for leaf, (suffixes, transpose) in _SHARED_EXPERT_MAP.items():
-                    put(base + suffixes[src], lp[leaf][li], transpose)
-                if cfg.shared_expert_gated:
-                    put(base + _SHARED_GATE[0], lp["shared_gate"][li], True)
+            if cfg.attn_type == "mla":
+                q_sperm = kv_sperm = None
+                if cfg.rope_interleave:
+                    q_sperm = rope_save_perm(
+                        cfg.num_heads, cfg.qk_nope_head_dim + cfg.qk_rope_head_dim, cfg.qk_rope_head_dim
+                    )
+                    kv_sperm = rope_save_perm(
+                        1, cfg.kv_lora_rank + cfg.qk_rope_head_dim, cfg.qk_rope_head_dim
+                    )
+                for leaf, (suffixes, transpose) in _MLA_MAP.items():
+                    if leaf in lp:
+                        sperm = {"w_q_b": q_sperm, "w_q": q_sperm, "w_kv_a": kv_sperm}.get(leaf)
+                        put(base + suffixes[0], lp[leaf][li], transpose, row_perm=sperm)
+                # kv_b_proj: interleave per-head [K_nope; V] row blocks
+                uk = np.asarray(lp["w_uk"][li])  # [r_kv, H, dn]
+                uv = np.asarray(lp["w_uv"][li])  # [r_kv, H, dv]
+                per_head = np.concatenate(
+                    [np.transpose(uk, (1, 2, 0)), np.transpose(uv, (1, 2, 0))], axis=1
+                )  # [H, dn+dv, r_kv]
+                put(base + "self_attn.kv_b_proj.weight", per_head.reshape(-1, per_head.shape[-1]), False)
+            if cfg.attention_bias:
+                for leaf, (suffixes, transpose) in _BIAS_MAP.items():
+                    put(base + suffixes[0], lp[leaf][li], transpose)
+            if moe:
+                put(base + _MOE_ROUTER[0], lp["router"][li], True)
+                if "router_bias" in lp:
+                    put(base + _MOE_ROUTER_BIAS[0], lp["router_bias"][li], False)
+                for leaf, (suffixes, transpose) in _MOE_EXPERT_MAP.items():
+                    for e in range(cfg.num_experts):
+                        put(base + suffixes[0].format(e=e), lp[leaf][li, e], transpose)
+                if cfg.shared_expert_size:
+                    src = 0 if cfg.shared_expert_gated else 1
+                    for leaf, (suffixes, transpose) in _SHARED_EXPERT_MAP.items():
+                        put(base + suffixes[src], lp[leaf][li], transpose)
+                    if cfg.shared_expert_gated:
+                        put(base + _SHARED_GATE[0], lp["shared_gate"][li], True)
+
+    k_dense = cfg.first_k_dense if cfg.is_moe else 0
+    if k_dense:
+        write_subtree(params["dense_layers"], 0, k_dense, False)
+    write_subtree(params["layers"], k_dense, cfg.num_layers - k_dense, cfg.is_moe)
 
     from safetensors.numpy import save_file
 

@@ -37,11 +37,70 @@ import jax.numpy as jnp
 from dynamo_tpu.models.quant import maybe_dequant as _dq
 
 
+def route_tokens(
+    lp: dict,
+    x: jnp.ndarray,  # [N, D] flattened tokens
+    *,
+    k: int,
+    scoring: str = "softmax",
+    norm_topk: bool = True,
+    scaling: float = 1.0,
+    n_group: int = 0,
+    topk_group: int = 0,
+    group_score: str = "max",
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Router semantics shared by every MoE family; returns (weights f32[N,k],
+    expert ids i32[N,k]).
+
+    - ``softmax`` scoring + ``norm_topk``: Mixtral (softmax over all logits,
+      gather top-k, renormalize — algebraically softmax(top-k logits)).
+    - ``softmax`` without norm: Qwen2-MoE (weights are raw softmax probs).
+    - ``sigmoid``: DeepSeek-V3. Selection uses scores *plus* the aux-free
+      load-balancing bias ``router_bias`` (e_score_correction_bias,
+      topk_method=noaux_tc), optionally group-limited: experts are split
+      into ``n_group`` groups, only the best ``topk_group`` groups stay
+      eligible. The *weights* use the unbiased scores, renormalized, then
+      scaled by ``routed_scaling_factor``. (HF `modeling_deepseek_v3.py`.)
+    - ``group_score``: how a group is ranked — DeepSeek-V2's
+      group_limited_greedy uses the per-group ``"max"`` score
+      (`modeling_deepseek_v2.py:76`); V3's noaux_tc uses the ``"top2sum"``
+      of biased scores (`modeling_deepseek_v3.py:127`).
+    """
+    logits = (x @ lp["router"]).astype(jnp.float32)  # [N, E]
+    if scoring == "sigmoid":
+        scores = jax.nn.sigmoid(logits)
+    elif scoring == "softmax":
+        scores = jax.nn.softmax(logits, axis=-1)
+    else:
+        raise ValueError(f"unknown moe scoring {scoring!r}")
+    choice = scores + lp["router_bias"] if "router_bias" in lp else scores
+    if n_group > 1 and 0 < topk_group < n_group:
+        n, e = choice.shape
+        grouped = choice.reshape(n, n_group, e // n_group)
+        if group_score == "top2sum":
+            gscore = jax.lax.top_k(grouped, min(2, e // n_group))[0].sum(-1)  # [N, G]
+        else:
+            gscore = grouped.max(-1)
+        _, gidx = jax.lax.top_k(gscore, topk_group)
+        gmask = jnp.zeros_like(gscore, dtype=bool).at[
+            jnp.arange(n)[:, None], gidx
+        ].set(True)
+        choice = jnp.where(
+            jnp.repeat(gmask, e // n_group, axis=1), choice, -jnp.inf
+        )
+    _, topi = jax.lax.top_k(choice, k)
+    weights = jnp.take_along_axis(scores, topi, axis=1)  # [N, k] unbiased
+    if norm_topk:
+        weights = weights / (weights.sum(-1, keepdims=True) + 1e-20)
+    return weights * scaling, topi
+
+
 def moe_mlp_dropless(
     lp: dict,
     x: jnp.ndarray,  # [N, D] flattened tokens
     *,
     num_experts_per_token: int,
+    routing: dict | None = None,
 ) -> jnp.ndarray:
     """Dropless routed MoE via ``lax.ragged_dot`` (TPU grouped matmul).
 
@@ -56,9 +115,7 @@ def moe_mlp_dropless(
     e = lp["router"].shape[-1]
     k = num_experts_per_token
 
-    router_logits = (x @ lp["router"]).astype(jnp.float32)  # [N, E]
-    topv, topi = jax.lax.top_k(router_logits, k)
-    weights = jax.nn.softmax(topv, axis=-1)  # [N, k]
+    weights, topi = route_tokens(lp, x, k=k, **(routing or {}))
 
     flat_e = topi.reshape(-1)  # [N*k]
     order = jnp.argsort(flat_e, stable=True)
@@ -89,6 +146,7 @@ def moe_mlp(
     num_experts_per_token: int,
     capacity_factor: float = 1.25,
     capacity: int | None = None,
+    routing: dict | None = None,
 ) -> jnp.ndarray:
     """Routed MoE FFN over flattened tokens; returns [N, D].
 
@@ -100,9 +158,7 @@ def moe_mlp(
     k = num_experts_per_token
     c = capacity if capacity is not None else expert_capacity(n, e, k, capacity_factor)
 
-    router_logits = (x @ lp["router"]).astype(jnp.float32)  # [N, E]
-    topv, topi = jax.lax.top_k(router_logits, k)  # [N, k]; E is small — cheap
-    weights = jax.nn.softmax(topv, axis=-1)  # [N, k]
+    weights, topi = route_tokens(lp, x, k=k, **(routing or {}))
 
     # Buffer position of each (token, choice) within its expert: rank among
     # all earlier assignments to the same expert (token-major priority).

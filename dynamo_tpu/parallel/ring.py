@@ -71,11 +71,12 @@ def ring_attention_sharded(q, k, v, q_pos, kv_pos, *, axis_name: str, scale: flo
     kv_pos: [B, Ts_local] global positions of the local K/V shard (rotates too)
     """
     n = jax.lax.psum(1, axis_name)
-    b, tq, h, hd = q.shape
+    b, tq, h, _ = q.shape
+    hd_v = v.shape[-1]  # may differ from q/k (MLA: value = latent, k = latent+rope)
 
     # pvary: mark the fresh accumulators as varying over the ring axis so the
     # fori_loop carry type matches the (device-varying) merged partials.
-    acc = jax.lax.pvary(jnp.zeros((b, tq, h, hd), jnp.float32), (axis_name,))
+    acc = jax.lax.pvary(jnp.zeros((b, tq, h, hd_v), jnp.float32), (axis_name,))
     m = jax.lax.pvary(jnp.full((b, h, tq), NEG_INF, jnp.float32), (axis_name,))
     l = jax.lax.pvary(jnp.zeros((b, h, tq), jnp.float32), (axis_name,))
 

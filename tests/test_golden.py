@@ -138,6 +138,70 @@ def test_golden_mixtral_moe(tmp_path):
     _assert_family_matches(m, tmp_path)
 
 
+def test_golden_qwen2_moe_shared_expert(tmp_path):
+    """Qwen2-MoE: softmax routing WITHOUT top-k renormalization
+    (norm_topk_prob=False) plus the sigmoid-gated always-on shared expert."""
+    from transformers import Qwen2MoeConfig, Qwen2MoeForCausalLM
+
+    torch.manual_seed(6)
+    m = Qwen2MoeForCausalLM(Qwen2MoeConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_experts=4, num_experts_per_tok=2, moe_intermediate_size=32,
+        shared_expert_intermediate_size=48, norm_topk_prob=False,
+        decoder_sparse_step=1, mlp_only_layers=[], tie_word_embeddings=False,
+    ))
+    _assert_family_matches(m, tmp_path)
+
+
+def test_golden_deepseek_v3_true_shape(tmp_path):
+    """DeepSeek-V3's ACTUAL architecture in one model: MLA attention
+    (interleaved rope), sigmoid routing with the aux-free correction bias
+    (noaux_tc), group-limited top-k, routed scaling, a shared expert, and a
+    leading dense layer (first_k_dense_replace=1) — BASELINE tracked config
+    #4's semantics at test scale."""
+    from transformers.models.deepseek_v3 import DeepseekV3Config, DeepseekV3ForCausalLM
+
+    torch.manual_seed(5)
+    m = DeepseekV3ForCausalLM(DeepseekV3Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=4,
+        q_lora_rank=32, kv_lora_rank=24, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16, first_k_dense_replace=1,
+        n_routed_experts=4, num_experts_per_tok=2, moe_intermediate_size=32,
+        n_shared_experts=1, n_group=2, topk_group=1, topk_method="noaux_tc",
+        routed_scaling_factor=2.5, norm_topk_prob=True, scoring_func="sigmoid",
+        rope_interleave=True, tie_word_embeddings=False, rope_scaling=None,
+        attention_bias=False,
+    ))
+    # Random correction bias so the noaux_tc path is load-bearing.
+    with torch.no_grad():
+        for layer in m.model.layers[1:]:
+            layer.mlp.gate.e_score_correction_bias.uniform_(-0.2, 0.2)
+    _assert_family_matches(m, tmp_path)
+
+
+def test_golden_deepseek_v2_group_limited_greedy(tmp_path):
+    """DeepSeek-V2 routing semantics: softmax scoring, group_limited_greedy
+    (groups ranked by per-group MAX, not V3's top-2 sum), no correction
+    bias, unnormalized weights with routed scaling."""
+    from transformers.models.deepseek_v2 import DeepseekV2Config, DeepseekV2ForCausalLM
+
+    torch.manual_seed(7)
+    m = DeepseekV2ForCausalLM(DeepseekV2Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=4,
+        q_lora_rank=32, kv_lora_rank=24, qk_nope_head_dim=16,
+        qk_rope_head_dim=8, v_head_dim=16, first_k_dense_replace=1,
+        n_routed_experts=4, num_experts_per_tok=2, moe_intermediate_size=32,
+        n_shared_experts=1, n_group=2, topk_group=1,
+        topk_method="group_limited_greedy", routed_scaling_factor=1.0,
+        norm_topk_prob=False, tie_word_embeddings=False, rope_scaling=None,
+        attention_bias=False,
+    ))
+    _assert_family_matches(m, tmp_path)
+
+
 def test_golden_deepseek_mla_dense(tmp_path):
     """MLA attention (q/kv low-rank, rope_interleave=True checkpoint layout)
     with dense MLPs (first_k_dense_replace covers every layer) — isolates

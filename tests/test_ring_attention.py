@@ -49,3 +49,42 @@ def test_ring_under_jit():
     out = jitted(q, k, v, positions)
     ref = full_causal_attention(q, k, v, positions, hd**-0.5)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_ring_mla_matches_paged_mla():
+    """MLA ring prefill (absorbed MQA over [latent; rope-key] streams) must
+    match the paged MLA formulation on a whole-prompt prefill — the
+    DeepSeek long-context sp path (VERDICT r2 item 3)."""
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import PRESETS
+
+    mesh = make_mesh(MeshPlan(sp=8), jax.devices())
+    cfg = PRESETS["test-tiny-mla"]
+    params = llama.init_params(cfg, 0)
+    b, t, page_size = 2, 32, 8
+    pages_per_seq = t // page_size
+    num_pages = 1 + b * pages_per_seq
+    rng = np.random.default_rng(2)
+    tokens = jnp.asarray(rng.integers(1, cfg.vocab_size - 1, (b, t)), jnp.int32)
+    positions = jnp.tile(jnp.arange(t, dtype=jnp.int32), (b, 1))
+    tables = jnp.asarray(
+        1 + np.arange(b * pages_per_seq).reshape(b, pages_per_seq), jnp.int32
+    )
+    slots = tables[:, :, None] * page_size + jnp.arange(page_size)[None, None, :]
+    slots = slots.reshape(b, t)
+    last = jnp.full((b,), t - 1, jnp.int32)
+
+    def run(attn_impl):
+        k, v = llama.init_kv_cache(cfg, num_pages=num_pages, page_size=page_size)
+        logits, k, v = llama.forward(
+            params, cfg, tokens, positions, k, v, tables, slots, last,
+            attn_impl=attn_impl, mesh=mesh if attn_impl == "ring" else None,
+        )
+        return np.asarray(logits), np.asarray(k), np.asarray(v)
+
+    ref_logits, ref_k, ref_v = run(None)
+    ring_logits, ring_k, ring_v = run("ring")
+    np.testing.assert_allclose(ring_logits, ref_logits, atol=2e-4, rtol=2e-4)
+    # The latent/rope caches must still be written through for decode.
+    np.testing.assert_allclose(ring_k, ref_k, atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(ring_v, ref_v, atol=2e-5, rtol=2e-5)
