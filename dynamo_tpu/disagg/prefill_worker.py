@@ -14,7 +14,7 @@ import asyncio
 import logging
 
 from dynamo_tpu.disagg.queue import DistributedQueue
-from dynamo_tpu.disagg.transfer import collect_prefill_blocks, send_blocks
+from dynamo_tpu.disagg.transfer import collect_prefill_blocks, send_blocks, send_pull_offer
 from dynamo_tpu.engine.service import JaxEngineService
 from dynamo_tpu.protocols.common import PreprocessedRequest, SamplingOptions, StopConditions
 from dynamo_tpu.runtime.component import DistributedRuntime
@@ -98,6 +98,25 @@ class PrefillWorker:
                     request_id, len(token_ids), injected, peer.stats(),
                 )
                 return
+
+        # Cross-process device path: offer the chain for a transfer-engine
+        # pull (jax.experimental.transfer — ICI/DCN, no host bounce). The
+        # receiver's response tells us whether it could pull; any failure
+        # falls through to the packed-bytes TCP stream below.
+        try:
+            result = await send_pull_offer(
+                self.runtime.transport, task["transfer_address"], request_id,
+                self.service.core, hashes,
+            )
+        except Exception:
+            logger.exception("prefill %s: pull offer failed, falling back to TCP", request_id)
+            result = None
+        if result is not None:
+            logger.info(
+                "prefill %s: %d tokens -> %s blocks via cross-process device pull (%s)",
+                request_id, len(token_ids), result.get("injected"), result.get("stats"),
+            )
+            return
 
         loop = asyncio.get_running_loop()
         blocks = await loop.run_in_executor(None, collect_prefill_blocks, self.service.core, hashes)

@@ -1,0 +1,147 @@
+"""Cross-process device-path KV pull transport.
+
+The reference's NIXL writes KV blocks straight into a remote worker's GPU
+memory (`lib/llm/src/block_manager/block/transfer/nixl.rs:86`). The TPU
+equivalent is JAX's cross-slice transfer engine
+(``jax.experimental.transfer``): the source stages device arrays under a
+uuid on its ``TransferServer``; the destination connects to the source's
+transfer address and *pulls* them — bytes move device-to-device over
+ICI/DCN through the PJRT transfer engine, never through Python or the
+host heap.
+
+Protocol shape (sender-initiated, receiver-pulled):
+
+1. The prefill worker gathers the chain's pages into stacked device arrays
+   and ``offer()``s them under a fresh uuid.
+2. It sends a *descriptor* (address, uuid, shapes, dtypes, hash chain) to
+   the decode worker's ``kv_transfer`` endpoint — a tiny control message on
+   the ordinary transport.
+3. The decode worker allocates destination pages, ``pull()``s the arrays
+   with its own cache sharding (the transfer engine delivers each shard to
+   the device that owns it), scatters them into the paged cache, commits.
+4. The response releases the sender's staged arrays.
+
+Not every PJRT plugin implements the transfer-engine API (the CPU backend
+and tunneled dev chips don't): :func:`device_pull_supported` probes once,
+and senders fall back to the packed-bytes TCP path (``disagg/transfer.py``)
+when either end lacks support — same fallback the reference takes when
+NIXL is unavailable.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import threading
+from typing import Any, Sequence
+
+logger = logging.getLogger(__name__)
+
+_uuid_counter = itertools.count(1)
+_lock = threading.Lock()
+
+
+class JaxPullTransport:
+    """``jax.experimental.transfer`` wrapper: one server + cached peer
+    connections per process."""
+
+    def __init__(self) -> None:
+        self._server = None
+        self._connections: dict[str, Any] = {}
+        # Offered arrays are kept alive until acknowledged: the transfer
+        # engine holds device buffers, but the Python references pin them
+        # against donation/GC races on our side.
+        self._offers: dict[int, Any] = {}
+
+    def _ensure_server(self):
+        if self._server is None:
+            import jax
+            from jax.experimental import transfer
+
+            self._server = transfer.start_transfer_server(
+                jax.local_devices()[0].client
+            )
+        return self._server
+
+    def address(self) -> str:
+        """This process's transfer address (host-reachable form)."""
+        import socket
+
+        addr = self._ensure_server().address()
+        if addr.startswith("[::]"):
+            addr = socket.gethostbyname(socket.gethostname()) + addr[4:]
+        return addr
+
+    def new_uuid(self) -> int:
+        return next(_uuid_counter)
+
+    def offer(self, uuid: int, arrays: Sequence[Any]) -> None:
+        """Source side: stage device arrays for a remote pull."""
+        server = self._ensure_server()
+        with _lock:
+            self._offers[uuid] = list(arrays)
+        server.await_pull(uuid, list(arrays))
+
+    def finish_offer(self, uuid: int) -> None:
+        with _lock:
+            self._offers.pop(uuid, None)
+
+    def pull(self, address: str, uuid: int, specs: Sequence[Any]) -> list:
+        """Destination side: fetch staged arrays device-path (blocking —
+        call via run_in_executor). ``specs``: ShapeDtypeStructs carrying the
+        *destination* sharding."""
+        server = self._ensure_server()
+        with _lock:
+            conn = self._connections.get(address)
+        if conn is None:
+            conn = server.connect(address)
+            with _lock:
+                self._connections[address] = conn
+        return conn.pull(uuid, list(specs))
+
+
+_supported: bool | None = None
+_transport: JaxPullTransport | None = None
+
+
+def device_pull_supported() -> bool:
+    """Whether this process's PJRT backend implements the transfer engine
+    (probed once with a loopback self-pull of a tiny array)."""
+    global _supported
+    if _supported is None:
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            t = get_transport()
+            probe = jnp.zeros((8,), jnp.float32)
+            uuid = t.new_uuid()
+            t.offer(uuid, [probe])
+            sds = jax.ShapeDtypeStruct(
+                probe.shape, probe.dtype,
+                sharding=jax.sharding.SingleDeviceSharding(jax.local_devices()[0]),
+            )
+            [back] = t.pull(t.address(), uuid, [sds])
+            back.block_until_ready()
+            t.finish_offer(uuid)
+            _supported = True
+        except Exception as e:  # UNIMPLEMENTED on cpu/tunneled backends
+            logger.info("device pull transport unavailable (%s); TCP fallback", e)
+            _supported = False
+    return _supported
+
+
+def get_transport() -> JaxPullTransport:
+    """Process-wide transport (tests may substitute a stub via
+    ``set_transport``)."""
+    global _transport
+    if _transport is None:
+        _transport = JaxPullTransport()
+    return _transport
+
+
+def set_transport(transport, supported: bool | None = None) -> None:
+    """Test seam: install a stub transport and force the capability probe."""
+    global _transport, _supported
+    _transport = transport
+    _supported = supported

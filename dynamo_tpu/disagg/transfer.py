@@ -177,6 +177,74 @@ class KvTransferService(AsyncEngine[Any, dict]):
             ev.set()
         return len(pinned) + len(staged)
 
+    async def _ingest_pull(self, request_id: str, pull: dict) -> dict:
+        """Cross-process device-path ingestion: pull the sender's staged
+        stacked page arrays through the transfer engine
+        (``disagg/pull_transport.py``) and scatter them into the cache.
+
+        Returns the summary dict; ``pull_unsupported``/``pull_failed`` tell
+        the sender to fall back to the packed-bytes TCP path."""
+        import time
+
+        import jax
+        import numpy as np
+
+        from dynamo_tpu.disagg.pull_transport import device_pull_supported, get_transport
+
+        if not device_pull_supported():
+            return {"request_id": request_id, "injected": 0, "pull_unsupported": True}
+        hashes = list(pull["hashes"])[: pull["n"]]
+        parents = list(pull["parents"])[: pull["n"]]
+        pinned: list[int] = []
+        staged: list[tuple[int, int, Any]] = []  # payload = chain index
+        t0 = time.perf_counter()
+        try:
+            pinned, staged = self._stage_chain((h, i) for i, h in enumerate(hashes))
+            if staged:
+                runner = self.core.runner
+                sharding = runner.k_cache.sharding
+                k_sds = jax.ShapeDtypeStruct(
+                    tuple(pull["k_shape"]), np.dtype(pull["k_dtype"]), sharding=sharding
+                )
+                v_sds = jax.ShapeDtypeStruct(
+                    tuple(pull["v_shape"]), np.dtype(pull["v_dtype"]), sharding=sharding
+                )
+                transport = get_transport()
+                try:
+                    k, v = await asyncio.get_running_loop().run_in_executor(
+                        None, transport.pull, pull["address"], pull["uuid"], [k_sds, v_sds]
+                    )
+                    idxs = [i for _pid, _h, i in staged]
+                    # Device-side select of the freshly-missing pages; the
+                    # already-cached hits' slots are simply not scattered.
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, self.core.runner.write_pages,
+                        [pid for pid, _h, _i in staged], k[:, idxs], v[:, idxs],
+                    )
+                except Exception:
+                    self._release_staged(staged)
+                    logger.exception("device pull ingestion failed; sender will fall back")
+                    return {"request_id": request_id, "injected": 0, "pull_failed": True}
+                self._commit_staged(
+                    (pid, h, parents[i], ()) for pid, h, i in staged
+                )
+                self.bytes_received += int(np.prod(pull["k_shape"])) * np.dtype(pull["k_dtype"]).itemsize
+                self.bytes_received += int(np.prod(pull["v_shape"])) * np.dtype(pull["v_dtype"]).itemsize
+                self.transfer_seconds += time.perf_counter() - t0
+                self.device_path_blocks += len(staged)
+        finally:
+            self.core.allocator.release(pinned)
+        ev = self._completions.get(request_id)
+        if ev is not None:
+            ev.set()
+        return {
+            "request_id": request_id,
+            "injected": len(pinned) + len(staged),
+            "total": len(hashes),
+            "pull": True,
+            "stats": self.stats(),
+        }
+
     def expect(self, request_id: str) -> asyncio.Event:
         """Register interest in a transfer's completion (disagg operator)."""
         ev = self._completions.setdefault(request_id, asyncio.Event())
@@ -186,7 +254,9 @@ class KvTransferService(AsyncEngine[Any, dict]):
         self._completions.pop(request_id, None)
 
     async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
-        """Request: {"request_id": str, "blocks": [packed blocks...]}.
+        """Request: {"request_id": str, "blocks": [packed blocks...]} — the
+        packed-bytes stream — or {"request_id", "pull": descriptor} — the
+        cross-process device-path form (see :meth:`_ingest_pull`).
 
         Responds with one summary item. The whole chain is staged (allocate +
         unpack) then written as one batched scatter and committed; a failure
@@ -196,6 +266,9 @@ class KvTransferService(AsyncEngine[Any, dict]):
         import time
 
         request_id = request.get("request_id", "")
+        if request.get("pull") is not None:
+            yield await self._ingest_pull(request_id, request["pull"])
+            return
         blocks = request.get("blocks", [])
         injected = 0
         t0 = time.perf_counter()
@@ -244,6 +317,85 @@ async def send_blocks(
     result: dict = {}
     async for item in transport.generate(address, {"request_id": request_id, "blocks": blocks}, context):
         result = item
+    return result
+
+
+def collect_prefill_offer(core: EngineCore, block_hashes: list[int]):
+    """Sender side of the device-path pull: gather the chain's pages into
+    stacked DEVICE arrays (never host-materialized) plus the descriptor
+    metadata the receiver needs.
+
+    Returns ``(k, v, hashes, parents, n)`` or ``None`` when the chain has no
+    committed pages. Page count is padded to a power of two (null page 0)
+    so the gather reuses the runner's compiled shapes; ``n`` is the real
+    count.
+    """
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.runner import next_pow2
+
+    allocator = core.allocator
+    runner = core.runner
+    pages = allocator.match_prefix(block_hashes)
+    if not pages:
+        allocator.release(pages)
+        return None
+    try:
+        n = len(pages)
+        padded = np.zeros(next_pow2(n), np.int32)
+        padded[:n] = pages
+        with runner.io_lock:
+            k, v = runner._gather_pages_fn(runner.k_cache, runner.v_cache, jnp.asarray(padded))
+        parents = [allocator.page_parent_hash(pid) for pid in pages]
+        return k, v, block_hashes[:n], parents, n
+    finally:
+        # The gathered stack is an independent copy: safe to release now.
+        allocator.release(pages)
+
+
+async def send_pull_offer(
+    transport: Transport,
+    address: str,
+    request_id: str,
+    core: EngineCore,
+    block_hashes: list[int],
+) -> dict | None:
+    """Offer the chain for a device-path pull; returns the receiver's
+    summary, or None when the pull path didn't complete (caller falls back
+    to packed bytes). The staged arrays stay alive until the response."""
+    from dynamo_tpu.disagg.pull_transport import device_pull_supported, get_transport
+
+    if not device_pull_supported():
+        return None
+    loop = asyncio.get_running_loop()
+    offered = await loop.run_in_executor(None, collect_prefill_offer, core, block_hashes)
+    if offered is None:
+        return None
+    k, v, hashes, parents, n = offered
+    t = get_transport()
+    uuid = t.new_uuid()
+    t.offer(uuid, [k, v])
+    descriptor = {
+        "address": t.address(),
+        "uuid": uuid,
+        "hashes": list(hashes),
+        "parents": list(parents),
+        "n": n,
+        "k_shape": list(k.shape),
+        "v_shape": list(v.shape),
+        "k_dtype": str(k.dtype),
+        "v_dtype": str(v.dtype),
+    }
+    try:
+        result: dict = {}
+        async for item in transport.generate(
+            address, {"request_id": request_id, "pull": descriptor}, Context()
+        ):
+            result = item
+    finally:
+        t.finish_offer(uuid)
+    if result.get("pull_unsupported") or result.get("pull_failed") or "injected" not in result:
+        return None
     return result
 
 

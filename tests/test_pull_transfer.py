@@ -1,0 +1,175 @@
+"""Cross-process device-path KV pull (disagg/pull_transport.py).
+
+The production wire is ``jax.experimental.transfer`` (PJRT transfer engine
+— ICI/DCN device-to-device), which the CPU backend doesn't implement, so
+these tests drive the FULL orchestration (descriptor protocol, staging,
+sharded pull specs, scatter, commit, fallback negotiation) over stub
+transports; ``tests/test_pull_two_process.py`` repeats it across two real
+OS processes.
+"""
+
+import asyncio
+
+import jax
+import numpy as np
+import pytest
+
+from dynamo_tpu.disagg.pull_transport import set_transport
+from dynamo_tpu.disagg.router import DisaggConfig
+from dynamo_tpu.launch import run_local
+
+aiohttp = pytest.importorskip("aiohttp")
+
+
+class StubPullTransport:
+    """In-process stand-in for the PJRT transfer engine: offers hold host
+    copies (simulating the wire), pull re-places them with the *puller's*
+    sharding — exactly the contract JaxPullTransport provides."""
+
+    def __init__(self) -> None:
+        self.offers: dict[int, list[np.ndarray]] = {}
+        self.pulled = 0
+        self._uuid = 0
+
+    def address(self) -> str:
+        return "stub-transfer:0"
+
+    def new_uuid(self) -> int:
+        self._uuid += 1
+        return self._uuid
+
+    def offer(self, uuid, arrays):
+        self.offers[uuid] = [np.asarray(a) for a in arrays]
+
+    def finish_offer(self, uuid):
+        self.offers.pop(uuid, None)
+
+    def pull(self, address, uuid, specs):
+        assert address == self.address()
+        out = []
+        for arr, spec in zip(self.offers[uuid], specs):
+            assert tuple(arr.shape) == tuple(spec.shape), (arr.shape, spec.shape)
+            out.append(jax.device_put(arr, spec.sharding))
+        self.pulled += 1
+        return out
+
+
+@pytest.fixture
+def stub_transport():
+    stub = StubPullTransport()
+    set_transport(stub, supported=True)
+    yield stub
+    set_transport(None, None)
+
+
+@pytest.mark.e2e
+async def test_disagg_pull_path_e2e(stub_transport, monkeypatch):
+    """Remote prefill with the in-process registry disabled: KV must arrive
+    via the pull protocol (offer -> descriptor -> sharded pull -> scatter ->
+    commit) and the output must match a pure-local run."""
+    from dynamo_tpu.disagg import device_transfer
+
+    monkeypatch.setattr(device_transfer.REGISTRY, "lookup", lambda addr: None)
+
+    prompt = "p" * 48
+
+    async def run_topology(**kw):
+        handles = await run_local("test-tiny", port=0, num_pages=64, max_batch_size=8, **kw)
+        try:
+            async with aiohttp.ClientSession() as s:
+                body = {"model": "test-tiny", "prompt": prompt, "max_tokens": 4, "temperature": 0}
+                async with s.post(
+                    f"http://127.0.0.1:{handles['port']}/v1/completions", json=body
+                ) as r:
+                    assert r.status == 200, await r.text()
+                    out = await r.json()
+            stats = [s.stats() for s in device_transfer.REGISTRY._services.values()]
+            return out, stats
+        finally:
+            await handles["http"].stop()
+            await handles["watcher"].close()
+            for svc in handles["services"]:
+                await svc.close()
+            await handles["runtime"].close()
+
+    out, stats = await run_topology(
+        num_workers=1, num_prefill_workers=1,
+        disagg=DisaggConfig(max_local_prefill_length=24, min_remote_prefill_blocks=1),
+    )
+    # The pull transport actually carried the pages.
+    assert stub_transport.pulled >= 1
+    assert out["usage"]["prompt_tokens_details"]["cached_tokens"] >= 32
+
+    st = stats[0]
+    assert st["device_path_blocks"] >= 2, st
+    assert st["gbytes_per_sec"] > 0, st
+
+    # Offered arrays were released after the response.
+    assert not stub_transport.offers
+
+    out_local, _ = await run_topology(num_workers=1)
+    assert out["choices"][0]["text"] == out_local["choices"][0]["text"]
+
+
+async def test_pull_unsupported_receiver_falls_back(monkeypatch):
+    """A receiver without transfer-engine support answers pull_unsupported
+    and the sender must take the packed-bytes path (send_pull_offer -> None)."""
+    from types import SimpleNamespace
+
+    from dynamo_tpu.disagg.transfer import KvTransferService
+    from dynamo_tpu.runtime.engine import Context
+
+    set_transport(None, supported=False)  # receiver probe says no
+    try:
+        svc = KvTransferService(SimpleNamespace(allocator=None, runner=None))
+        items = []
+
+        async def run():
+            async for item in svc.generate(
+                {"request_id": "r1", "pull": {"hashes": [1], "parents": [None], "n": 1,
+                                              "address": "x", "uuid": 1,
+                                              "k_shape": [1, 1, 4, 8], "v_shape": [1, 1, 4, 8],
+                                              "k_dtype": "float32", "v_dtype": "float32"}},
+                Context(),
+            ):
+                items.append(item)
+
+        await run()
+        assert items and items[0]["pull_unsupported"] and items[0]["injected"] == 0
+    finally:
+        set_transport(None, None)
+
+
+async def test_pull_failure_releases_staged_pages(stub_transport):
+    """A pull that raises must release the freshly-allocated destination
+    pages (no leak) and report pull_failed so the sender falls back."""
+    from types import SimpleNamespace
+
+    from dynamo_tpu.engine.allocator import PageAllocator
+    from dynamo_tpu.runtime.engine import Context
+    from dynamo_tpu.disagg.transfer import KvTransferService
+
+    alloc = PageAllocator(num_pages=8, page_size=4)
+    free_before = alloc.num_free()
+
+    class Runner:
+        class _C:
+            sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        k_cache = _C()
+
+    def boom(*a, **kw):
+        raise RuntimeError("wire down")
+
+    stub_transport.pull = boom
+    svc = KvTransferService(SimpleNamespace(allocator=alloc, runner=Runner()))
+    items = []
+    async for item in svc.generate(
+        {"request_id": "r2", "pull": {"hashes": [11, 22], "parents": [None, 11], "n": 2,
+                                      "address": stub_transport.address(), "uuid": 5,
+                                      "k_shape": [1, 2, 4, 8], "v_shape": [1, 2, 4, 8],
+                                      "k_dtype": "float32", "v_dtype": "float32"}},
+        Context(),
+    ):
+        items.append(item)
+    assert items[0].get("pull_failed")
+    assert alloc.num_free() == free_before, "staged pages leaked"
