@@ -82,9 +82,27 @@ class JaxPullTransport:
             self._offers[uuid] = list(arrays)
         server.await_pull(uuid, list(arrays))
 
-    def finish_offer(self, uuid: int) -> None:
+    def finish_offer(self, uuid: int, consumed: bool = True) -> None:
+        """Release an offer. ``consumed=False`` means the receiver never
+        pulled it — TransferServer has no cancel/deregister API (jax 0.9),
+        and an un-pulled offer pins the staged device buffers forever, so we
+        drain it ourselves with a loopback self-pull (the same mechanism the
+        capability probe uses) to make the server release them."""
         with _lock:
-            self._offers.pop(uuid, None)
+            arrays = self._offers.pop(uuid, None)
+        if consumed or arrays is None:
+            return
+        try:
+            import jax
+
+            specs = [
+                jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding)
+                for a in arrays
+            ]
+            for drained in self.pull(self.address(), uuid, specs):
+                drained.block_until_ready()
+        except Exception as e:
+            logger.warning("draining un-pulled offer %d failed: %s", uuid, e)
 
     def pull(self, address: str, uuid: int, specs: Sequence[Any]) -> list:
         """Destination side: fetch staged arrays device-path (blocking —

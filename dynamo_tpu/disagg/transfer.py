@@ -69,9 +69,16 @@ class KvTransferService(AsyncEngine[Any, dict]):
     reports cumulative bandwidth, a tracked metric (BASELINE.md).
     """
 
+    #: Staged pull state older than this is assumed abandoned (sender died
+    #: between phases) and rolled back on the next service interaction.
+    PENDING_PULL_MAX_AGE = 120.0
+
     def __init__(self, core: EngineCore) -> None:
         self.core = core
         self._completions: dict[str, asyncio.Event] = {}
+        # request_id -> (pinned, staged, parents, t_monotonic): pages staged
+        # by a pull_query, awaiting the matching pull (two-phase protocol).
+        self._pending_pulls: dict[str, tuple[list[int], list, list, float]] = {}
         self.blocks_received = 0
         self.bytes_received = 0
         self.transfer_seconds = 0.0
@@ -177,61 +184,127 @@ class KvTransferService(AsyncEngine[Any, dict]):
             ev.set()
         return len(pinned) + len(staged)
 
-    async def _ingest_pull(self, request_id: str, pull: dict) -> dict:
-        """Cross-process device-path ingestion: pull the sender's staged
-        stacked page arrays through the transfer engine
-        (``disagg/pull_transport.py``) and scatter them into the cache.
+    def _abort_pull(self, request_id: str) -> None:
+        """Roll back pages staged by a pull_query whose pull never arrived."""
+        pending = self._pending_pulls.pop(request_id, None)
+        if pending is None:
+            return
+        pinned, staged, _parents, _t0 = pending
+        self._release_staged(staged)
+        self.core.allocator.release(pinned)
 
-        Returns the summary dict; ``pull_unsupported``/``pull_failed`` tell
-        the sender to fall back to the packed-bytes TCP path."""
+    def _sweep_pending_pulls(self) -> None:
+        import time
+
+        now = time.monotonic()
+        for rid in [
+            rid for rid, (_p, _s, _pa, t0) in self._pending_pulls.items()
+            if now - t0 > self.PENDING_PULL_MAX_AGE
+        ]:
+            logger.warning("abandoned pull staging for %s rolled back", rid)
+            self._abort_pull(rid)
+
+    async def _handle_pull_query(self, request_id: str, query: dict) -> dict:
+        """Phase 1 of the two-phase device-path pull: report which chain
+        blocks are missing locally, staging destination pages for them.
+
+        The sender gathers and offers ONLY the missed pages afterwards — a
+        fully-cached chain completes right here with zero gather work and
+        zero transfer-server staging on either side (the un-pulled-offer
+        device-memory leak class, ADVICE r3)."""
+        import time
+
+        from dynamo_tpu.disagg.pull_transport import device_pull_supported
+
+        if not device_pull_supported():
+            return {"request_id": request_id, "injected": 0, "pull_unsupported": True}
+        self._abort_pull(request_id)  # a re-query replaces stale staging
+        hashes = list(query["hashes"])
+        parents = list(query["parents"])
+        pinned, staged = self._stage_chain((h, i) for i, h in enumerate(hashes))
+        if not staged:
+            # Warm cache: the whole chain is already here.
+            self.core.allocator.release(pinned)
+            ev = self._completions.get(request_id)
+            if ev is not None:
+                ev.set()
+            return {
+                "request_id": request_id,
+                "injected": len(pinned),
+                "total": len(hashes),
+                "miss": [],
+                "pull": True,
+                "stats": self.stats(),
+            }
+        self._pending_pulls[request_id] = (pinned, staged, parents, time.monotonic())
+        return {
+            "request_id": request_id,
+            "miss": [i for _pid, _h, i in staged],
+            "hits": len(pinned),
+            "pull": True,
+        }
+
+    async def _ingest_pull(self, request_id: str, pull: dict) -> dict:
+        """Phase 2: pull the sender's staged miss-page stack through the
+        transfer engine (``disagg/pull_transport.py``) and scatter it into
+        the pages staged by :meth:`_handle_pull_query`.
+
+        Returns the summary dict; ``pull_failed`` tells the sender to fall
+        back to the packed-bytes TCP path (its offer stays un-pulled, so it
+        must drain it — ``finish_offer(consumed=False)``)."""
         import time
 
         import jax
         import numpy as np
 
-        from dynamo_tpu.disagg.pull_transport import device_pull_supported, get_transport
+        from dynamo_tpu.disagg.pull_transport import get_transport
 
-        if not device_pull_supported():
-            return {"request_id": request_id, "injected": 0, "pull_unsupported": True}
-        hashes = list(pull["hashes"])[: pull["n"]]
-        parents = list(pull["parents"])[: pull["n"]]
-        pinned: list[int] = []
-        staged: list[tuple[int, int, Any]] = []  # payload = chain index
+        pending = self._pending_pulls.pop(request_id, None)
+        if pending is None:
+            logger.warning("pull for %s without a pending pull_query", request_id)
+            return {"request_id": request_id, "injected": 0, "pull_failed": True}
+        pinned, staged, parents, _t0 = pending
         t0 = time.perf_counter()
+        wire_pulled = False  # whether the transfer-engine pull itself completed
         try:
-            pinned, staged = self._stage_chain((h, i) for i, h in enumerate(hashes))
-            if staged:
-                runner = self.core.runner
-                sharding = runner.k_cache.sharding
-                k_sds = jax.ShapeDtypeStruct(
-                    tuple(pull["k_shape"]), np.dtype(pull["k_dtype"]), sharding=sharding
+            runner = self.core.runner
+            sharding = runner.k_cache.sharding
+            k_sds = jax.ShapeDtypeStruct(
+                tuple(pull["k_shape"]), np.dtype(pull["k_dtype"]), sharding=sharding
+            )
+            v_sds = jax.ShapeDtypeStruct(
+                tuple(pull["v_shape"]), np.dtype(pull["v_dtype"]), sharding=sharding
+            )
+            transport = get_transport()
+            try:
+                k, v = await asyncio.get_running_loop().run_in_executor(
+                    None, transport.pull, pull["address"], pull["uuid"], [k_sds, v_sds]
                 )
-                v_sds = jax.ShapeDtypeStruct(
-                    tuple(pull["v_shape"]), np.dtype(pull["v_dtype"]), sharding=sharding
+                wire_pulled = True
+                # The stack holds exactly the missed pages (staged order),
+                # padded to a power of two; slice off the pad device-side.
+                n = len(staged)
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self.core.runner.write_pages,
+                    [pid for pid, _h, _i in staged], k[:, :n], v[:, :n],
                 )
-                transport = get_transport()
-                try:
-                    k, v = await asyncio.get_running_loop().run_in_executor(
-                        None, transport.pull, pull["address"], pull["uuid"], [k_sds, v_sds]
-                    )
-                    idxs = [i for _pid, _h, i in staged]
-                    # Device-side select of the freshly-missing pages; the
-                    # already-cached hits' slots are simply not scattered.
-                    await asyncio.get_running_loop().run_in_executor(
-                        None, self.core.runner.write_pages,
-                        [pid for pid, _h, _i in staged], k[:, idxs], v[:, idxs],
-                    )
-                except Exception:
-                    self._release_staged(staged)
-                    logger.exception("device pull ingestion failed; sender will fall back")
-                    return {"request_id": request_id, "injected": 0, "pull_failed": True}
-                self._commit_staged(
-                    (pid, h, parents[i], ()) for pid, h, i in staged
-                )
-                self.bytes_received += int(np.prod(pull["k_shape"])) * np.dtype(pull["k_dtype"]).itemsize
-                self.bytes_received += int(np.prod(pull["v_shape"])) * np.dtype(pull["v_dtype"]).itemsize
-                self.transfer_seconds += time.perf_counter() - t0
-                self.device_path_blocks += len(staged)
+            except Exception:
+                self._release_staged(staged)
+                logger.exception("device pull ingestion failed; sender will fall back")
+                # "pulled" tells the sender whether its offer was consumed:
+                # a consumed one-shot offer must NOT be drained again (a
+                # second pull of the same uuid can block forever).
+                return {
+                    "request_id": request_id, "injected": 0,
+                    "pull_failed": True, "pulled": wire_pulled,
+                }
+            self._commit_staged(
+                (pid, h, parents[i], ()) for pid, h, i in staged
+            )
+            self.bytes_received += int(np.prod(pull["k_shape"])) * np.dtype(pull["k_dtype"]).itemsize
+            self.bytes_received += int(np.prod(pull["v_shape"])) * np.dtype(pull["v_dtype"]).itemsize
+            self.transfer_seconds += time.perf_counter() - t0
+            self.device_path_blocks += len(staged)
         finally:
             self.core.allocator.release(pinned)
         ev = self._completions.get(request_id)
@@ -240,7 +313,7 @@ class KvTransferService(AsyncEngine[Any, dict]):
         return {
             "request_id": request_id,
             "injected": len(pinned) + len(staged),
-            "total": len(hashes),
+            "total": pull.get("total", len(pinned) + len(staged)),
             "pull": True,
             "stats": self.stats(),
         }
@@ -254,9 +327,16 @@ class KvTransferService(AsyncEngine[Any, dict]):
         self._completions.pop(request_id, None)
 
     async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
-        """Request: {"request_id": str, "blocks": [packed blocks...]} — the
-        packed-bytes stream — or {"request_id", "pull": descriptor} — the
-        cross-process device-path form (see :meth:`_ingest_pull`).
+        """Request forms:
+
+        - ``{"request_id", "blocks": [packed blocks...]}`` — packed-bytes
+          stream (DCN fallback);
+        - ``{"request_id", "pull_query": {hashes, parents}}`` — phase 1 of
+          the device-path pull (:meth:`_handle_pull_query`);
+        - ``{"request_id", "pull": descriptor}`` — phase 2
+          (:meth:`_ingest_pull`);
+        - ``{"request_id", "pull_abort": true}`` — sender abandoned a
+          staged pull (falls back to packed bytes); roll back staging.
 
         Responds with one summary item. The whole chain is staged (allocate +
         unpack) then written as one batched scatter and committed; a failure
@@ -266,9 +346,22 @@ class KvTransferService(AsyncEngine[Any, dict]):
         import time
 
         request_id = request.get("request_id", "")
+        # Reclaim staging abandoned by dead senders on EVERY interaction,
+        # not just pull queries — otherwise packed-bytes-only traffic never
+        # frees it.
+        self._sweep_pending_pulls()
+        if request.get("pull_query") is not None:
+            yield await self._handle_pull_query(request_id, request["pull_query"])
+            return
         if request.get("pull") is not None:
             yield await self._ingest_pull(request_id, request["pull"])
             return
+        if request.get("pull_abort"):
+            self._abort_pull(request_id)
+            yield {"request_id": request_id, "aborted": True}
+            return
+        # Packed-bytes path: supersedes any staged pull for this request.
+        self._abort_pull(request_id)
         blocks = request.get("blocks", [])
         injected = 0
         t0 = time.perf_counter()
@@ -320,37 +413,27 @@ async def send_blocks(
     return result
 
 
-def collect_prefill_offer(core: EngineCore, block_hashes: list[int]):
-    """Sender side of the device-path pull: gather the chain's pages into
-    stacked DEVICE arrays (never host-materialized) plus the descriptor
-    metadata the receiver needs.
-
-    Returns ``(k, v, hashes, parents, n)`` or ``None`` when the chain has no
-    committed pages. Page count is padded to a power of two (null page 0)
-    so the gather reuses the runner's compiled shapes; ``n`` is the real
-    count.
-    """
+def _gather_page_stack(core: EngineCore, page_ids: list[int]):
+    """Gather specific cache pages into stacked DEVICE arrays (never
+    host-materialized). Page count is padded to a power of two (null page 0)
+    so the gather reuses the runner's compiled shapes."""
     import jax.numpy as jnp
 
     from dynamo_tpu.engine.runner import next_pow2
 
-    allocator = core.allocator
     runner = core.runner
-    pages = allocator.match_prefix(block_hashes)
-    if not pages:
-        allocator.release(pages)
-        return None
-    try:
-        n = len(pages)
-        padded = np.zeros(next_pow2(n), np.int32)
-        padded[:n] = pages
-        with runner.io_lock:
-            k, v = runner._gather_pages_fn(runner.k_cache, runner.v_cache, jnp.asarray(padded))
-        parents = [allocator.page_parent_hash(pid) for pid in pages]
-        return k, v, block_hashes[:n], parents, n
-    finally:
-        # The gathered stack is an independent copy: safe to release now.
-        allocator.release(pages)
+    n = len(page_ids)
+    padded = np.zeros(next_pow2(n), np.int32)
+    padded[:n] = page_ids
+    with runner.io_lock:
+        return runner._gather_pages_fn(runner.k_cache, runner.v_cache, jnp.asarray(padded))
+
+
+async def _round_trip(transport: Transport, address: str, request: dict) -> dict:
+    result: dict = {}
+    async for item in transport.generate(address, request, Context()):
+        result = item
+    return result
 
 
 async def send_pull_offer(
@@ -360,43 +443,78 @@ async def send_pull_offer(
     core: EngineCore,
     block_hashes: list[int],
 ) -> dict | None:
-    """Offer the chain for a device-path pull; returns the receiver's
-    summary, or None when the pull path didn't complete (caller falls back
-    to packed bytes). The staged arrays stay alive until the response."""
+    """Two-phase device-path pull. Returns the receiver's summary, or None
+    when the pull path didn't complete (caller falls back to packed bytes).
+
+    Phase 1 (``pull_query``) asks the receiver which chain blocks it is
+    missing; phase 2 gathers and offers ONLY those pages for a
+    transfer-engine pull. A fully-cached chain therefore costs one control
+    message — no gather, no transfer-server staging — and an offer that the
+    receiver never consumed is drained (``finish_offer(consumed=False)``)
+    instead of pinning device buffers on the TransferServer forever
+    (ADVICE r3)."""
     from dynamo_tpu.disagg.pull_transport import device_pull_supported, get_transport
 
     if not device_pull_supported():
         return None
     loop = asyncio.get_running_loop()
-    offered = await loop.run_in_executor(None, collect_prefill_offer, core, block_hashes)
-    if offered is None:
-        return None
-    k, v, hashes, parents, n = offered
-    t = get_transport()
-    uuid = t.new_uuid()
-    t.offer(uuid, [k, v])
-    descriptor = {
-        "address": t.address(),
-        "uuid": uuid,
-        "hashes": list(hashes),
-        "parents": list(parents),
-        "n": n,
-        "k_shape": list(k.shape),
-        "v_shape": list(v.shape),
-        "k_dtype": str(k.dtype),
-        "v_dtype": str(v.dtype),
-    }
+    allocator = core.allocator
+    # Hold the chain's refcounts across both phases so eviction can't reuse
+    # the source pages between the query and the gather.
+    pages = await loop.run_in_executor(None, allocator.match_prefix, block_hashes)
+    staged_on_receiver = False
     try:
-        result: dict = {}
-        async for item in transport.generate(
-            address, {"request_id": request_id, "pull": descriptor}, Context()
-        ):
-            result = item
+        if not pages:
+            return None
+        hashes = list(block_hashes[: len(pages)])
+        parents = [allocator.page_parent_hash(pid) for pid in pages]
+        resp = await _round_trip(
+            transport, address,
+            {"request_id": request_id, "pull_query": {"hashes": hashes, "parents": parents}},
+        )
+        if resp.get("pull_unsupported") or not resp.get("pull"):
+            return None
+        miss = resp.get("miss")
+        if not miss:
+            # Warm cache: the receiver already has the whole chain.
+            return resp if "injected" in resp else None
+        staged_on_receiver = True
+        k, v = await loop.run_in_executor(
+            None, _gather_page_stack, core, [pages[i] for i in miss]
+        )
+        t = get_transport()
+        uuid = t.new_uuid()
+        t.offer(uuid, [k, v])
+        consumed = False
+        try:
+            resp2 = await _round_trip(
+                transport, address,
+                {"request_id": request_id, "pull": {
+                    "address": t.address(), "uuid": uuid, "total": len(hashes),
+                    "k_shape": list(k.shape), "v_shape": list(v.shape),
+                    "k_dtype": str(k.dtype), "v_dtype": str(v.dtype),
+                }},
+            )
+            # The receiver popped its staging on any pull response (success
+            # or pull_failed); only a transport failure leaves it pending.
+            staged_on_receiver = False
+            ok = "injected" in resp2 and not resp2.get("pull_failed")
+            # Consumed also when the wire pull succeeded but the receiver's
+            # scatter failed afterwards — draining a consumed one-shot offer
+            # would block.
+            consumed = ok or bool(resp2.get("pulled"))
+            return resp2 if ok else None
+        finally:
+            await loop.run_in_executor(None, t.finish_offer, uuid, consumed)
     finally:
-        t.finish_offer(uuid)
-    if result.get("pull_unsupported") or result.get("pull_failed") or "injected" not in result:
-        return None
-    return result
+        if staged_on_receiver:
+            # Best-effort: tell the receiver to roll back its staged pages
+            # before we fall back to the packed-bytes path.
+            try:
+                await _round_trip(transport, address, {"request_id": request_id, "pull_abort": True})
+            except Exception:
+                logger.warning("pull abort for %s not delivered", request_id)
+        await loop.run_in_executor(None, allocator.release, pages)
 
 
 def collect_prefill_blocks(core: EngineCore, block_hashes: list[int]) -> list[dict]:

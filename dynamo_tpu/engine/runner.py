@@ -362,8 +362,13 @@ class ModelRunner:
         pids[:n] = page_ids
         if padded_n != n:
             pad = ((0, 0), (0, padded_n - n)) + ((0, 0),) * (k_stack.ndim - 2)
-            k_stack = np.pad(np.asarray(k_stack), pad)
-            v_stack = np.pad(np.asarray(v_stack), pad)
+            # Device inputs (pull-transport ingestion) must stay on device:
+            # np.pad would bounce the whole stack through the host, defeating
+            # the no-host-bounce pull path. jnp.pad keeps it a device op and
+            # still works for host ndarrays.
+            xp = jnp if isinstance(k_stack, jax.Array) else np
+            k_stack = xp.pad(k_stack, pad)
+            v_stack = xp.pad(v_stack, pad)
             pids[n:] = 0  # padding writes land in the reserved null page
         self.k_cache, self.v_cache = self._scatter_pages_fn(
             self.k_cache, self.v_cache, jnp.asarray(k_stack), jnp.asarray(v_stack),

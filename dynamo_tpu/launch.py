@@ -183,11 +183,20 @@ async def build_engine_service(spec: WorkerSpec, *, on_kv_event=None, g4_storage
             # slice; the runner then skips re-placement of placed params.
             params = load_params(spec.model_dir, spec.model_config, mesh=mesh)
         else:
-            params = llama.init_params(spec.model_config, 0)
-        if spec.quantize:
+            params = None  # random-init below, possibly directly quantized
+        if spec.quantize and params is None:
+            # Random-init + quantize without ever materializing the
+            # full-precision tree: an 8B-class random model OOMs a 16 GB
+            # chip before quantize_params could shrink it.
+            from dynamo_tpu.models.quant import init_params_quantized
+
+            params = init_params_quantized(spec.model_config, 0, mode=spec.quantize)
+        elif spec.quantize:
             from dynamo_tpu.models.quant import quantize_params
 
             params = quantize_params(params, mode=spec.quantize)
+        elif params is None:
+            params = llama.init_params(spec.model_config, 0)
         return ModelRunner(
             spec.model_config,
             params,

@@ -99,3 +99,74 @@ def maybe_dequant(leaf: Any, dtype: Any = jnp.bfloat16) -> jnp.ndarray:
     return leaf
 
 
+
+
+def init_params_quantized(cfg, rng: int | jax.Array = 0, *, mode: str = "int8") -> dict:
+    """Random-init parameters directly in quantized form, never
+    materializing the bf16/f32 tree.
+
+    ``init_params`` + ``quantize_params`` peaks at full-precision model size
+    plus f32 transients — an 8B-class model OOMs a 16 GB chip before the
+    quantization that would have made it fit. Benchmarks need only
+    identically-SHAPED (and finite) weights, so matmul leaves are generated
+    as int8 draws with a constant fan-in scale, chunked along the stacked
+    layer axis to bound the RNG's int32 transient; everything else follows
+    ``init_params``'s shapes via ``jax.eval_shape``.
+    """
+    import math
+
+    from dynamo_tpu.models import llama
+
+    if mode in ("", "none", None):
+        return llama.init_params(cfg, rng)
+    if mode != "int8":
+        raise ValueError(f"unknown quantization mode {mode!r} (supported: int8)")
+    if isinstance(rng, int):
+        rng = jax.random.PRNGKey(rng)
+    shapes = jax.eval_shape(lambda: llama.init_params(cfg, jax.random.PRNGKey(0)))
+    max_chunk_elems = 2**28  # 1 GiB int32 RNG transient ceiling
+
+    def gen_quant(key, sds):
+        fan_in = sds.shape[-2]
+        scale = jnp.full(
+            sds.shape[:-2] + sds.shape[-1:], (fan_in**-0.5) / 127.0, jnp.bfloat16
+        )
+        n = math.prod(sds.shape)
+        if sds.ndim >= 3 and n > max_chunk_elems:
+            l = sds.shape[0]
+            step = max(1, max_chunk_elems // max(1, n // l))
+            parts = [
+                jax.random.randint(
+                    jax.random.fold_in(key, i),
+                    (min(step, l - i),) + sds.shape[1:], -127, 128, jnp.int8,
+                )
+                for i in range(0, l, step)
+            ]
+            qw = jnp.concatenate(parts, axis=0)
+        else:
+            qw = jax.random.randint(key, sds.shape, -127, 128, jnp.int8)
+        return {"qw": qw, "scale": scale}
+
+    def gen_plain(key, name, sds):
+        if "norm" in name:
+            return jnp.ones(sds.shape, sds.dtype)
+        if sds.ndim == 1:
+            return jnp.zeros(sds.shape, sds.dtype)
+        fan_in = sds.shape[-2]
+        return (
+            jax.random.normal(key, sds.shape, jnp.float32) * fan_in**-0.5
+        ).astype(sds.dtype)
+
+    idx = 0
+
+    def walk(tree, name):
+        nonlocal idx
+        if isinstance(tree, dict):
+            return {k: walk(v, k) for k, v in tree.items()}
+        key = jax.random.fold_in(rng, idx)
+        idx += 1
+        if name in _MATMUL_LEAVES:
+            return gen_quant(key, tree)
+        return gen_plain(key, name, tree)
+
+    return walk(shapes, None)

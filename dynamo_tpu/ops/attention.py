@@ -142,8 +142,16 @@ def paged_attention(
     *,
     scale: float | None = None,
     impl: str | None = None,
+    contiguous_positions: bool = True,
 ) -> jnp.ndarray:
-    """Backend-dispatching paged attention (see module docstring)."""
+    """Backend-dispatching paged attention (see module docstring).
+
+    ``contiguous_positions`` declares that every real row of ``positions``
+    steps by exactly 1 (engine prefill, chunked or not). Callers with gappy
+    per-row positions — speculative verify, sliding window — MUST pass
+    False: the T > 1 Pallas prefill kernel derives its causal mask and KV
+    lengths from row start/end only and silently computes wrong attention
+    on gappy layouts (it is bypassed when False)."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if impl is None:
@@ -153,4 +161,7 @@ def paged_attention(
         return paged_attention_reference(q, k_cache, v_cache, block_tables, positions, scale=scale)
     from dynamo_tpu.ops.pallas_paged import paged_attention_pallas
 
-    return paged_attention_pallas(q, k_cache, v_cache, block_tables, positions, scale=scale)
+    return paged_attention_pallas(
+        q, k_cache, v_cache, block_tables, positions, scale=scale,
+        contiguous_positions=contiguous_positions,
+    )

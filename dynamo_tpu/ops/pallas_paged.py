@@ -357,7 +357,34 @@ def paged_attention_pallas(
     (``positions[b, t] = start_b + t``) — true for every engine prefill,
     chunked or not. A T > 1 caller with gappy per-token positions (e.g. a
     speculative-verify batch) must pass ``contiguous_positions=False`` to
-    get the exact reference formulation instead."""
+    get the exact reference formulation instead. When ``positions`` is a
+    concrete array (outside jit) the contract is verified for real; under
+    tracing the declaration is trusted — it is static routing, a traced
+    check would force compiling both kernels behind a cond."""
+    if q.shape[1] > 1 and contiguous_positions and not isinstance(
+        jnp.asarray(positions), jax.core.Tracer
+    ):
+        import numpy as np
+
+        def _row_ok(row) -> bool:
+            # A valid engine row is a contiguous run starting anywhere,
+            # padded with trailing zeros (runner._pad fill) — position 0 can
+            # legitimately appear only at the row start. Pure-padding rows
+            # are all zeros.
+            nz = np.nonzero(row)[0]
+            last = int(nz[-1]) if nz.size else 0
+            return bool(
+                (np.diff(row[: last + 1]) == 1).all() and not row[last + 1:].any()
+            )
+
+        pos = np.asarray(positions)
+        bad = [i for i in range(pos.shape[0]) if not _row_ok(pos[i])]
+        if bad:
+            raise ValueError(
+                f"paged_attention_pallas: positions are not per-row contiguous "
+                f"(rows {bad}); pass contiguous_positions=False for gappy "
+                f"layouts (speculative verify, sliding window)"
+            )
     if q.shape[1] == 1:
         if decode_supported(q, k_cache):
             return paged_decode_attention(

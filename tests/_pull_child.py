@@ -1,0 +1,83 @@
+"""Receiver process for tests/test_pull_two_process.py.
+
+Runs a decode-side engine core with a KvTransferService on a real
+TcpTransport. Mode "wire" installs the socket-backed pull wire
+(tests/_pull_wire.py) so phase-2 pulls fetch bytes from the sender
+process; mode "unsupported" forces the capability probe to False so the
+phase-1 query answers pull_unsupported and the sender must fall back to
+the packed-bytes stream.
+
+Prints ``ADDR <kv_transfer addr> <kv_read addr>`` once serving, then waits
+for stdin EOF.
+"""
+
+import asyncio
+import os
+import sys
+
+MODE = sys.argv[1]  # "wire" | "unsupported"
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(__file__)))  # repo root
+
+
+async def main() -> None:
+    from dynamo_tpu.disagg.pull_transport import set_transport
+    from dynamo_tpu.disagg.transfer import KV_TRANSFER_ENDPOINT, KvTransferService
+    from dynamo_tpu.engine.core import EngineConfig, EngineCore
+    from dynamo_tpu.engine.runner import ModelRunner
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import PRESETS
+    from dynamo_tpu.runtime.engine import AsyncEngine, Context
+    from dynamo_tpu.runtime.tcp import TcpTransport
+
+    if MODE == "wire":
+        from _pull_wire import SocketWireTransport
+
+        set_transport(SocketWireTransport(), supported=True)
+    else:
+        set_transport(None, supported=False)
+
+    cfg = PRESETS["test-tiny"]
+    params = llama.init_params(cfg, 0)
+    runner = ModelRunner(
+        cfg, params, num_pages=32, page_size=4, max_batch_size=4,
+        prefill_bucket=16, attn_impl="reference",
+    )
+    config = EngineConfig(
+        num_pages=32, page_size=4, max_batch_size=4,
+        max_prefill_tokens=128, max_seq_len=128,
+    )
+    core = EngineCore(runner, config)
+    svc = KvTransferService(core)
+
+    class KvRead(AsyncEngine):
+        """Test-only readback: the parent verifies injected page CONTENT."""
+
+        async def generate(self, request, context: Context):
+            pages = core.allocator.match_prefix(request["hashes"])
+            try:
+                payloads = core.runner.read_pages(pages)
+                yield {
+                    "n": len(pages),
+                    "k": [k.tobytes() for k, _v in payloads],
+                    "v": [v.tobytes() for _k, v in payloads],
+                }
+            finally:
+                core.allocator.release(pages)
+
+    transport = TcpTransport(host="127.0.0.1")
+    await transport.register_engine(KV_TRANSFER_ENDPOINT, svc)
+    await transport.register_engine("kv_read", KvRead())
+    print(
+        "ADDR",
+        transport.address_of(KV_TRANSFER_ENDPOINT),
+        transport.address_of("kv_read"),
+        flush=True,
+    )
+    await asyncio.get_running_loop().run_in_executor(None, sys.stdin.read)
+    await transport.close()
+
+
+asyncio.run(main())

@@ -1,0 +1,125 @@
+"""Socket-backed stand-in for the PJRT transfer engine.
+
+Used by ``tests/test_pull_two_process.py``: the CPU backend doesn't
+implement ``jax.experimental.transfer``, so this provides the same
+offer/pull/finish contract as ``JaxPullTransport`` with the bytes carried
+over a real TCP socket — offers staged in one OS process are genuinely
+pulled by another. The production wire differs only in moving device
+buffers over ICI/DCN instead of host copies over loopback.
+
+Framing (little-endian): request = uuid:i64. Response = count:i64 (−1 when
+the offer is unknown), then per array: ndim:i64, dims:i64*, dtype-name
+length:i64 + utf8, payload length:i64 + raw bytes. Raw-bytes framing
+because numpy's save formats can't represent bfloat16.
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import socketserver
+import struct
+import threading
+
+import numpy as np
+
+
+def _send_arrays(sock, arrays) -> None:
+    sock.sendall(struct.pack("<q", len(arrays)))
+    for a in arrays:
+        a = np.asarray(a)
+        name = a.dtype.name.encode()
+        payload = np.ascontiguousarray(a).tobytes()
+        sock.sendall(struct.pack(f"<q{a.ndim}q", a.ndim, *a.shape))
+        sock.sendall(struct.pack("<q", len(name)) + name)
+        sock.sendall(struct.pack("<q", len(payload)))
+        sock.sendall(payload)
+
+
+def _recv_arrays(raw) -> list[np.ndarray] | None:
+    (count,) = struct.unpack("<q", raw.read(8))
+    if count < 0:
+        return None
+    out = []
+    for _ in range(count):
+        (ndim,) = struct.unpack("<q", raw.read(8))
+        shape = struct.unpack(f"<{ndim}q", raw.read(8 * ndim))
+        (nlen,) = struct.unpack("<q", raw.read(8))
+        dtype = np.dtype(raw.read(nlen).decode())  # ml_dtypes registers bf16
+        (plen,) = struct.unpack("<q", raw.read(8))
+        out.append(np.frombuffer(raw.read(plen), dtype=dtype).reshape(shape))
+    return out
+
+
+class SocketWireTransport:
+    def __init__(self) -> None:
+        self.offers: dict[int, list] = {}
+        self._lock = threading.Lock()
+        self._server: socketserver.ThreadingTCPServer | None = None
+        self._uuids = itertools.count(1)
+        self.offered = 0
+        self.served = 0  # pulls answered by this side's socket server
+        self.pulled = 0  # pulls performed by this side
+        self.drained = 0
+
+    def _ensure_server(self) -> socketserver.ThreadingTCPServer:
+        if self._server is None:
+            transport = self
+
+            class Handler(socketserver.BaseRequestHandler):
+                def handle(self) -> None:
+                    raw = self.request.makefile("rb")
+                    (uuid,) = struct.unpack("<q", raw.read(8))
+                    with transport._lock:
+                        arrays = transport.offers.get(uuid)
+                    if arrays is None:
+                        self.request.sendall(struct.pack("<q", -1))
+                        return
+                    _send_arrays(self.request, arrays)
+                    transport.served += 1
+
+            self._server = socketserver.ThreadingTCPServer(("127.0.0.1", 0), Handler)
+            self._server.daemon_threads = True
+            threading.Thread(target=self._server.serve_forever, daemon=True).start()
+        return self._server
+
+    def address(self) -> str:
+        host, port = self._ensure_server().server_address
+        return f"{host}:{port}"
+
+    def new_uuid(self) -> int:
+        return next(self._uuids)
+
+    def offer(self, uuid: int, arrays) -> None:
+        self._ensure_server()
+        with self._lock:
+            self.offers[uuid] = list(arrays)
+        self.offered += 1
+
+    def finish_offer(self, uuid: int, consumed: bool = True) -> None:
+        with self._lock:
+            popped = self.offers.pop(uuid, None)
+        if popped is not None and not consumed:
+            self.drained += 1
+
+    def pull(self, address: str, uuid: int, specs) -> list:
+        import jax
+
+        host, port = address.rsplit(":", 1)
+        with socket.create_connection((host, int(port)), timeout=30) as sock:
+            sock.sendall(struct.pack("<q", uuid))
+            arrays = _recv_arrays(sock.makefile("rb"))
+        if arrays is None:
+            raise KeyError(f"no offer {uuid} at {address}")
+        out = [
+            jax.device_put(a.astype(spec.dtype), spec.sharding)
+            for a, spec in zip(arrays, specs)
+        ]
+        self.pulled += 1
+        return out
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None

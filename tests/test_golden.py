@@ -170,10 +170,13 @@ def test_golden_deepseek_v3_true_shape(tmp_path):
         qk_rope_head_dim=8, v_head_dim=16, first_k_dense_replace=1,
         n_routed_experts=4, num_experts_per_tok=2, moe_intermediate_size=32,
         n_shared_experts=1, n_group=2, topk_group=1, topk_method="noaux_tc",
-        routed_scaling_factor=2.5, norm_topk_prob=True, scoring_func="sigmoid",
+        routed_scaling_factor=2.5, norm_topk_prob=True,
         rope_interleave=True, tie_word_embeddings=False, rope_scaling=None,
         attention_bias=False,
     ))
+    # Deliberately NO scoring_func kwarg: native DeepseekV3Config does not
+    # serialize it (its modeling hardcodes sigmoid), so this golden pins the
+    # from_hf model_type→sigmoid fallback rather than an explicit key.
     # Random correction bias so the noaux_tc path is load-bearing.
     with torch.no_grad():
         for layer in m.model.layers[1:]:

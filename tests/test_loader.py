@@ -194,3 +194,28 @@ def test_strict_load_rejects_dropped_tensors(tmp_path):
         load_params(tmp_path, bad_cfg)
     # Explicit opt-out still works.
     load_params(tmp_path, bad_cfg, strict=False)
+
+
+def test_bare_deepseek_v3_config_defaults_sigmoid_scoring():
+    """Native transformers DeepseekV3Config doesn't serialize scoring_func
+    (its modeling hardcodes sigmoid routing); a bare config.json must parse
+    to sigmoid scoring + router bias via the model_type fallback — same gap
+    as moe_router_bias (ADVICE r3 medium)."""
+    bare = {
+        "model_type": "deepseek_v3",
+        "vocab_size": 256, "hidden_size": 64, "intermediate_size": 128,
+        "num_hidden_layers": 3, "num_attention_heads": 4,
+        "num_key_value_heads": 4, "q_lora_rank": 32, "kv_lora_rank": 24,
+        "qk_nope_head_dim": 16, "qk_rope_head_dim": 8, "v_head_dim": 16,
+        "first_k_dense_replace": 1, "n_routed_experts": 4,
+        "num_experts_per_tok": 2, "moe_intermediate_size": 32,
+        # NO scoring_func, NO topk_method, NO norm_topk_prob keys.
+    }
+    cfg = ModelConfig.from_hf(bare)
+    assert cfg.moe_scoring == "sigmoid"
+    assert cfg.moe_router_bias is True
+    assert cfg.moe_norm_topk is True
+    # Non-V3 MoE without the key still defaults to softmax.
+    qwen = dict(bare, model_type="qwen2_moe", kv_lora_rank=None,
+                q_lora_rank=None, first_k_dense_replace=0)
+    assert ModelConfig.from_hf(qwen).moe_scoring == "softmax"

@@ -140,3 +140,34 @@ def test_prefill_supported_predicate():
     q = jnp.zeros((2, 8, 32, 64))
     assert prefill_supported(q, jnp.zeros((8, 16, 8 * 64)))
     assert not prefill_supported(q, jnp.zeros((8, 16, 8 * 64 + 8)))
+
+
+def test_gappy_positions_rejected_outside_jit(monkeypatch):
+    """The T>1 Pallas route derives causality from row start/end only, so a
+    concrete gappy-positions call must be rejected loudly unless the caller
+    declares contiguous_positions=False (ADVICE r3)."""
+    import dynamo_tpu.ops.pallas_prefill as pf
+    from dynamo_tpu.ops.pallas_paged import paged_attention_pallas
+
+    # The guard fires before kernel selection; route the post-guard calls to
+    # the reference formulation so this runs on CPU.
+    monkeypatch.setattr(pf, "prefill_supported", lambda *a: False)
+
+    b, t, n_heads, head_dim, page_size = 1, 4, 4, 64, 4
+    q = jnp.zeros((b, t, n_heads, head_dim), jnp.float32)
+    k_cache = jnp.zeros((4, page_size, 2 * head_dim), jnp.float32)
+    v_cache = jnp.zeros_like(k_cache)
+    tables = jnp.asarray([[1, 2]], jnp.int32)
+    gappy = jnp.asarray([[0, 2, 4, 6]], jnp.int32)  # speculative-verify shape
+    with pytest.raises(ValueError, match="contiguous"):
+        paged_attention_pallas(q, k_cache, v_cache, tables, gappy, scale=0.125)
+    # Declared gappy: routed to the exact reference formulation instead.
+    out = paged_attention_pallas(
+        q, k_cache, v_cache, tables, gappy, scale=0.125, contiguous_positions=False
+    )
+    assert out.shape == q.shape
+    # Contiguous rows (and all-zero padding rows) pass the check.
+    ok = jnp.asarray([[3, 4, 5, 6]], jnp.int32)
+    paged_attention_pallas(q, k_cache, v_cache, tables, ok, scale=0.125)
+    pad = jnp.asarray([[0, 0, 0, 0]], jnp.int32)
+    paged_attention_pallas(q, k_cache, v_cache, tables, pad, scale=0.125)

@@ -2,10 +2,12 @@
 
 The production wire is ``jax.experimental.transfer`` (PJRT transfer engine
 — ICI/DCN device-to-device), which the CPU backend doesn't implement, so
-these tests drive the FULL orchestration (descriptor protocol, staging,
-sharded pull specs, scatter, commit, fallback negotiation) over stub
-transports; ``tests/test_pull_two_process.py`` repeats it across two real
-OS processes.
+these tests drive the FULL two-phase orchestration (pull_query miss
+negotiation, staging, sharded pull specs, scatter, commit, abort/fallback
+negotiation) over stub transports. ``tests/test_pull_two_process.py`` runs
+the descriptor exchange across two real OS processes over the runtime
+transport; ``tests_tpu/test_on_device.py`` exercises the real
+transfer-engine wire on hardware where the backend implements it.
 """
 
 import asyncio
@@ -29,6 +31,8 @@ class StubPullTransport:
     def __init__(self) -> None:
         self.offers: dict[int, list[np.ndarray]] = {}
         self.pulled = 0
+        self.offered = 0
+        self.drained = 0
         self._uuid = 0
 
     def address(self) -> str:
@@ -39,10 +43,12 @@ class StubPullTransport:
         return self._uuid
 
     def offer(self, uuid, arrays):
+        self.offered += 1
         self.offers[uuid] = [np.asarray(a) for a in arrays]
 
-    def finish_offer(self, uuid):
-        self.offers.pop(uuid, None)
+    def finish_offer(self, uuid, consumed=True):
+        if self.offers.pop(uuid, None) is not None and not consumed:
+            self.drained += 1
 
     def pull(self, address, uuid, specs):
         assert address == self.address()
@@ -65,8 +71,8 @@ def stub_transport():
 @pytest.mark.e2e
 async def test_disagg_pull_path_e2e(stub_transport, monkeypatch):
     """Remote prefill with the in-process registry disabled: KV must arrive
-    via the pull protocol (offer -> descriptor -> sharded pull -> scatter ->
-    commit) and the output must match a pure-local run."""
+    via the pull protocol (pull_query -> miss set -> offer -> sharded pull
+    -> scatter -> commit) and the output must match a pure-local run."""
     from dynamo_tpu.disagg import device_transfer
 
     monkeypatch.setattr(device_transfer.REGISTRY, "lookup", lambda addr: None)
@@ -113,7 +119,8 @@ async def test_disagg_pull_path_e2e(stub_transport, monkeypatch):
 
 async def test_pull_unsupported_receiver_falls_back(monkeypatch):
     """A receiver without transfer-engine support answers pull_unsupported
-    and the sender must take the packed-bytes path (send_pull_offer -> None)."""
+    to the phase-1 query and the sender must take the packed-bytes path
+    (send_pull_offer -> None) without gathering or offering anything."""
     from types import SimpleNamespace
 
     from dynamo_tpu.disagg.transfer import KvTransferService
@@ -126,10 +133,8 @@ async def test_pull_unsupported_receiver_falls_back(monkeypatch):
 
         async def run():
             async for item in svc.generate(
-                {"request_id": "r1", "pull": {"hashes": [1], "parents": [None], "n": 1,
-                                              "address": "x", "uuid": 1,
-                                              "k_shape": [1, 1, 4, 8], "v_shape": [1, 1, 4, 8],
-                                              "k_dtype": "float32", "v_dtype": "float32"}},
+                {"request_id": "r1",
+                 "pull_query": {"hashes": [1], "parents": [None]}},
                 Context(),
             ):
                 items.append(item)
@@ -140,36 +145,137 @@ async def test_pull_unsupported_receiver_falls_back(monkeypatch):
         set_transport(None, None)
 
 
-async def test_pull_failure_releases_staged_pages(stub_transport):
-    """A pull that raises must release the freshly-allocated destination
-    pages (no leak) and report pull_failed so the sender falls back."""
+def _make_service(num_pages=8, page_size=4):
     from types import SimpleNamespace
 
-    from dynamo_tpu.engine.allocator import PageAllocator
-    from dynamo_tpu.runtime.engine import Context
     from dynamo_tpu.disagg.transfer import KvTransferService
+    from dynamo_tpu.engine.allocator import PageAllocator
 
-    alloc = PageAllocator(num_pages=8, page_size=4)
-    free_before = alloc.num_free()
+    alloc = PageAllocator(num_pages=num_pages, page_size=page_size)
 
     class Runner:
         class _C:
             sharding = jax.sharding.SingleDeviceSharding(jax.devices()[0])
         k_cache = _C()
 
+        def write_pages(self, page_ids, ks, vs):
+            self.written = list(page_ids)
+
+    runner = Runner()
+    return KvTransferService(SimpleNamespace(allocator=alloc, runner=runner)), alloc, runner
+
+
+async def _one(svc, request):
+    from dynamo_tpu.runtime.engine import Context
+
+    items = []
+    async for item in svc.generate(request, Context()):
+        items.append(item)
+    return items[-1]
+
+
+async def test_pull_failure_releases_staged_pages(stub_transport):
+    """Phase 2 whose wire pull raises must release the pages staged by
+    phase 1 (no leak) and report pull_failed so the sender falls back."""
+    svc, alloc, _runner = _make_service()
+    free_before = alloc.num_free()
+
+    q = await _one(svc, {"request_id": "r2",
+                         "pull_query": {"hashes": [11, 22], "parents": [None, 11]}})
+    assert q["miss"] == [0, 1]
+    assert alloc.num_free() == free_before - 2  # staged
+
     def boom(*a, **kw):
         raise RuntimeError("wire down")
 
     stub_transport.pull = boom
-    svc = KvTransferService(SimpleNamespace(allocator=alloc, runner=Runner()))
-    items = []
-    async for item in svc.generate(
-        {"request_id": "r2", "pull": {"hashes": [11, 22], "parents": [None, 11], "n": 2,
-                                      "address": stub_transport.address(), "uuid": 5,
-                                      "k_shape": [1, 2, 4, 8], "v_shape": [1, 2, 4, 8],
-                                      "k_dtype": "float32", "v_dtype": "float32"}},
-        Context(),
-    ):
-        items.append(item)
-    assert items[0].get("pull_failed")
+    out = await _one(svc, {"request_id": "r2",
+                           "pull": {"address": stub_transport.address(), "uuid": 5,
+                                    "k_shape": [1, 2, 4, 8], "v_shape": [1, 2, 4, 8],
+                                    "k_dtype": "float32", "v_dtype": "float32"}})
+    assert out.get("pull_failed")
     assert alloc.num_free() == free_before, "staged pages leaked"
+
+
+async def test_warm_cache_chain_completes_in_phase_one(stub_transport):
+    """A fully-cached chain must finish at pull_query: no gather, no offer,
+    no transfer-server staging on the sender (the ADVICE r3 leak class)."""
+    svc, alloc, _runner = _make_service()
+    # Pre-commit the chain locally: hashes 11 -> 22.
+    [p1] = alloc.allocate(1)
+    alloc.commit(p1, 11, None, (1, 2, 3, 4))
+    alloc.release([p1])
+    [p2] = alloc.allocate(1)
+    alloc.commit(p2, 22, 11, (5, 6, 7, 8))
+    alloc.release([p2])
+
+    q = await _one(svc, {"request_id": "warm",
+                         "pull_query": {"hashes": [11, 22], "parents": [None, 11]}})
+    assert q["miss"] == [] and q["injected"] == 2
+    assert stub_transport.offered == 0 and stub_transport.pulled == 0
+    assert not svc._pending_pulls
+
+
+async def test_pull_abort_rolls_back_staging(stub_transport):
+    """A sender that abandons a staged pull (pull_abort or a superseding
+    packed-bytes stream) must not leak the receiver's staged pages."""
+    svc, alloc, _runner = _make_service()
+    free_before = alloc.num_free()
+    await _one(svc, {"request_id": "r3",
+                     "pull_query": {"hashes": [7, 8], "parents": [None, 7]}})
+    assert alloc.num_free() == free_before - 2
+    out = await _one(svc, {"request_id": "r3", "pull_abort": True})
+    assert out["aborted"]
+    assert alloc.num_free() == free_before
+    assert not svc._pending_pulls
+
+
+async def test_unconsumed_offer_is_drained(stub_transport, monkeypatch):
+    """When phase 2 fails on the receiver, the sender must drain its
+    un-pulled offer (finish_offer(consumed=False)) instead of leaving the
+    staged device buffers pinned on the TransferServer."""
+    from types import SimpleNamespace
+
+    from dynamo_tpu.disagg import transfer as tr
+    from dynamo_tpu.engine.allocator import PageAllocator
+    from dynamo_tpu.runtime.engine import Context
+
+    # Sender core with two committed pages.
+    alloc = PageAllocator(num_pages=8, page_size=4)
+    for h, parent in [(11, None), (22, 11)]:
+        [pid] = alloc.allocate(1)
+        alloc.commit(pid, h, parent, ())
+        alloc.release([pid])
+
+    class Runner:
+        import threading
+        io_lock = threading.RLock()
+        k_cache = jax.numpy.zeros((1, 8, 4, 8), jax.numpy.float32)
+        v_cache = jax.numpy.zeros((1, 8, 4, 8), jax.numpy.float32)
+
+        @staticmethod
+        def _gather_pages_fn(k, v, pids):
+            return k[:, pids], v[:, pids]
+
+    core = SimpleNamespace(allocator=alloc, runner=Runner())
+
+    class FailingReceiverTransport:
+        """Runtime transport stub: phase 1 reports misses, phase 2 fails."""
+
+        async def generate(self, address, request, context):
+            if request.get("pull_query") is not None:
+                yield {"request_id": request["request_id"], "miss": [0, 1],
+                       "hits": 0, "pull": True}
+            elif request.get("pull") is not None:
+                yield {"request_id": request["request_id"], "injected": 0,
+                       "pull_failed": True}
+            else:
+                yield {"request_id": request["request_id"], "aborted": True}
+
+    result = await tr.send_pull_offer(
+        FailingReceiverTransport(), "addr", "rx", core, [11, 22]
+    )
+    assert result is None
+    assert stub_transport.offered == 1
+    assert stub_transport.drained == 1, "un-consumed offer was not drained"
+    assert not stub_transport.offers
