@@ -46,6 +46,16 @@ async def bench_topology(
         "router_mode": topo.get("router_mode", "round_robin"),
         "num_workers": args.workers,
     }
+    if args.page_size:
+        kw["page_size"] = args.page_size
+    if args.max_seq_len:
+        kw["max_seq_len"] = args.max_seq_len
+    if args.max_prefill_tokens:
+        kw["max_prefill_tokens"] = args.max_prefill_tokens
+    if args.decode_steps:
+        kw["decode_steps"] = args.decode_steps
+    if args.quantize:
+        kw["quantize"] = args.quantize
     if topo.get("prefill"):
         kw["num_prefill_workers"] = max(1, args.prefill_workers)
         kw["disagg"] = DisaggConfig(
@@ -129,6 +139,11 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument("--osl", type=int, default=48)
     p.add_argument("--num-pages", type=int, default=2048)
     p.add_argument("--max-batch-size", type=int, default=64)
+    p.add_argument("--page-size", type=int, default=0, help="0 = engine default (serving on TPU: use 128)")
+    p.add_argument("--max-seq-len", type=int, default=0, help="0 = engine default")
+    p.add_argument("--max-prefill-tokens", type=int, default=0, help="chunked-prefill budget per step; 0 = engine default")
+    p.add_argument("--decode-steps", type=int, default=0, help="fused decode burst length; 0 = engine default")
+    p.add_argument("--quantize", default="", help="weight-only quantization (int8)")
     p.add_argument("--mock", action="store_true", help="timing-model engine (CI)")
     p.add_argument("--seed", type=int, default=0)
     args = p.parse_args(argv)

@@ -103,7 +103,9 @@ class WorkerSpec:
     def _engine_cfg(card: ModelDeploymentCard, engine_kw: dict) -> EngineConfig:
         import os
 
-        return EngineConfig(
+        # Explicit engine_kw wins over the card-derived defaults (the bench
+        # CLI overrides page_size/max_seq_len/decode_steps per run).
+        defaults = dict(
             max_seq_len=card.context_length,
             eos_token_ids=tuple(card.eos_token_ids),
             page_size=card.kv_page_size,
@@ -111,8 +113,9 @@ class WorkerSpec:
                 os.environ.get("DYNAMO_DECODE_STEPS")
                 or os.environ.get("DYN_WORKER_DECODE_STEPS", "1")
             ),
-            **engine_kw,
         )
+        defaults.update(engine_kw)
+        return EngineConfig(**defaults)
 
 
 def _parse_mesh(spec: str | None):

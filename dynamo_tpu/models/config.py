@@ -91,7 +91,10 @@ class ModelConfig:
         caches one latent + rope key instead)."""
         itemsize = 2 if self.dtype == "bfloat16" else 4
         if self.attn_type == "mla":
-            return self.num_layers * (self.kv_lora_rank + self.qk_rope_head_dim) * itemsize
+            # Physical bytes: the rope stream is padded to one 128-lane tile
+            # (models/mla.py:mla_cache_widths — Mosaic DMA alignment).
+            rope_width = max(self.qk_rope_head_dim, 128)
+            return self.num_layers * (self.kv_lora_rank + rope_width) * itemsize
         return 2 * self.num_layers * self.kv_dim * itemsize
 
     def param_count(self) -> int:
@@ -193,6 +196,15 @@ PRESETS: dict[str, ModelConfig] = {
     "test-tiny": ModelConfig(
         name="test-tiny", vocab_size=256, hidden_size=64, num_layers=2,
         num_heads=4, num_kv_heads=2, head_dim=16, intermediate_size=128,
+        rope_theta=10000.0, max_position=512, tie_embeddings=True, dtype="float32",
+    ),
+    # Kernel-geometry test model: shapes chosen so the Pallas paged kernels'
+    # support predicate holds on the LOCAL shard at tp=2 (n_kv/tp * head_dim
+    # = 2*64 = 128 lanes) — used by the sharded-kernel tests and the
+    # attn_impl="pallas" multichip dryrun pass.
+    "test-kernel": ModelConfig(
+        name="test-kernel", vocab_size=256, hidden_size=512, num_layers=2,
+        num_heads=8, num_kv_heads=4, head_dim=64, intermediate_size=256,
         rope_theta=10000.0, max_position=512, tie_embeddings=True, dtype="float32",
     ),
     # Vision-language test model: test-tiny plus an image placeholder token.

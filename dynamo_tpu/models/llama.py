@@ -182,11 +182,17 @@ def _mlp_moe(lp: Params, x: jnp.ndarray, cfg: ModelConfig, mesh=None) -> jnp.nda
     the buffer movement into all-to-all over the expert axis."""
     from dynamo_tpu.parallel.moe import moe_mlp, moe_mlp_dropless
 
+    import os
+
     b, t, d = x.shape
     xt = x.reshape(b * t, d)
     ep = int(mesh.shape.get("ep", 1)) if mesh is not None else 1
     routing = _routing_kwargs(cfg)
-    if ep <= 1:
+    # DYNAMO_MOE_DISPATCH=capacity forces the capacity-bounded scatter
+    # dispatch even without an ep axis — escape hatch for toolchains where
+    # lax.ragged_dot fails to compile (observed: axon remote-compile helper
+    # crash at 64 experts), and an A/B lever for benchmarks.
+    if ep <= 1 and os.environ.get("DYNAMO_MOE_DISPATCH", "") != "capacity":
         out = moe_mlp_dropless(
             lp, xt, num_experts_per_token=cfg.num_experts_per_token, routing=routing
         )
@@ -294,6 +300,14 @@ def forward(
     kf0 = k_cache.reshape(nl * npages, ps, k_cache.shape[3])
     vf0 = v_cache.reshape(nl * npages, ps, v_cache.shape[3])
 
+    if attn_impl is None:
+        # Resolve the backend default up front: an unresolved None on a TPU
+        # mesh would skip the sharded kernel wrapper below and run the
+        # pallas_call under GSPMD, which replicates the whole cache onto
+        # every device.
+        from dynamo_tpu.ops.attention import default_impl
+
+        attn_impl = default_impl()
     ring = attn_impl == "ring"
     if ring:
         # Padding tokens (slot 0) must not act as attendable keys in the ring
@@ -322,6 +336,7 @@ def forward(
                     attn_mscale=attn_mscale,
                     ring=ring, mesh=mesh,
                     ring_positions=ring_pos if ring else None,
+                    impl=attn_impl,
                 )
                 x = x + attn_out
                 h2 = rms_norm(x, lp["mlp_norm"], eps=cfg.rms_eps)
@@ -344,7 +359,18 @@ def forward(
                 attn = ring_attention(q, k, v, ring_pos, mesh, scale=cfg.head_dim**-0.5)
             else:
                 tables_l = block_tables + li * npages
-                attn = paged_attention(q, k_full, v_full, tables_l, positions, impl=attn_impl)
+                if attn_impl == "pallas" and mesh is not None:
+                    # Explicit tp/dp layout around the kernel: GSPMD would
+                    # otherwise all-gather the cache and replicate the
+                    # pallas_call on every device.
+                    from dynamo_tpu.ops.attention import paged_attention_sharded
+
+                    attn = paged_attention_sharded(
+                        q, k_full, v_full, tables_l, positions,
+                        mesh=mesh, impl=attn_impl,
+                    )
+                else:
+                    attn = paged_attention(q, k_full, v_full, tables_l, positions, impl=attn_impl)
             x = x + _qmm(attn.reshape(b, t, cfg.q_dim), lp["wo"])
             h2 = rms_norm(x, lp["mlp_norm"], eps=cfg.rms_eps)
             mlp = _mlp_moe(lp, h2, cfg, mesh) if moe_layer else _mlp_dense(lp, h2)

@@ -20,6 +20,7 @@ checkpoint (safetensors/GGUF/random) can serve quantized:
 
 from __future__ import annotations
 
+import functools
 from typing import Any
 
 import jax
@@ -126,26 +127,25 @@ def init_params_quantized(cfg, rng: int | jax.Array = 0, *, mode: str = "int8") 
     shapes = jax.eval_shape(lambda: llama.init_params(cfg, jax.random.PRNGKey(0)))
     max_chunk_elems = 2**28  # 1 GiB int32 RNG transient ceiling
 
+    @functools.partial(jax.jit, static_argnames=("shape",))
+    def _rand_int8(key, shape):
+        # ONE dispatch per leaf: lax.map over the stacked leading axis keeps
+        # the RNG's int32 transient at one slice, and avoids the per-chunk
+        # host round trips that dominate init on a tunneled chip.
+        if len(shape) >= 3 and math.prod(shape) > max_chunk_elems:
+            keys = jax.random.split(key, shape[0])
+            return jax.lax.map(
+                lambda k: jax.random.randint(k, shape[1:], -127, 128, jnp.int8),
+                keys,
+            )
+        return jax.random.randint(key, shape, -127, 128, jnp.int8)
+
     def gen_quant(key, sds):
         fan_in = sds.shape[-2]
         scale = jnp.full(
             sds.shape[:-2] + sds.shape[-1:], (fan_in**-0.5) / 127.0, jnp.bfloat16
         )
-        n = math.prod(sds.shape)
-        if sds.ndim >= 3 and n > max_chunk_elems:
-            l = sds.shape[0]
-            step = max(1, max_chunk_elems // max(1, n // l))
-            parts = [
-                jax.random.randint(
-                    jax.random.fold_in(key, i),
-                    (min(step, l - i),) + sds.shape[1:], -127, 128, jnp.int8,
-                )
-                for i in range(0, l, step)
-            ]
-            qw = jnp.concatenate(parts, axis=0)
-        else:
-            qw = jax.random.randint(key, sds.shape, -127, 128, jnp.int8)
-        return {"qw": qw, "scale": scale}
+        return {"qw": _rand_int8(key, tuple(sds.shape)), "scale": scale}
 
     def gen_plain(key, name, sds):
         if "norm" in name:

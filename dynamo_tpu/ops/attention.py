@@ -165,3 +165,54 @@ def paged_attention(
         q, k_cache, v_cache, block_tables, positions, scale=scale,
         contiguous_positions=contiguous_positions,
     )
+
+
+def paged_attention_sharded(
+    q: jnp.ndarray,  # [B, T, n_heads, head_dim]
+    k_cache: jnp.ndarray,  # [P, page_size, n_kv * head_dim]
+    v_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    positions: jnp.ndarray,
+    *,
+    mesh,
+    scale: float | None = None,
+    impl: str | None = None,
+) -> jnp.ndarray:
+    """Paged attention under a device mesh: tp shards heads, dp the batch.
+
+    GSPMD cannot partition a ``pallas_call`` — left alone it replicates the
+    operands (an all-gather of the whole KV cache) and runs the full kernel
+    per device. This wrapper makes the production tp layout explicit with
+    ``shard_map``: each device runs the kernel on its KV-head slice of the
+    cache (``W_local = n_kv/tp * head_dim`` lanes) and its dp slice of the
+    batch; no collectives anywhere — heads are embarrassingly parallel in
+    attention, and the GQA q-head group moves with its KV head.
+
+    Kernel-support predicates apply to the LOCAL shapes: pick tp so
+    ``(n_kv/tp) * head_dim`` stays a multiple of 128 lanes.
+
+    Reference counterpart: vLLM's paged kernels under tensor parallelism
+    (SURVEY.md §7 hard parts (a)+(b) combined).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    batch_axis = "dp" if "dp" in mesh.axis_names else None
+    tp_axis = "tp" if "tp" in mesh.axis_names else None
+    from jax.sharding import PartitionSpec as P
+
+    q_spec = P(batch_axis, None, tp_axis, None)
+    cache_spec = P(None, None, tp_axis)
+    row_spec = P(batch_axis, None)
+
+    def body(q, kc, vc, bt, pos):
+        return paged_attention(q, kc, vc, bt, pos, scale=scale, impl=impl)
+
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(q_spec, cache_spec, cache_spec, row_spec, row_spec),
+        out_specs=q_spec,
+        # pallas_call's out_shape carries no vma metadata; the body has no
+        # cross-device communication to check anyway (heads/batch are
+        # embarrassingly parallel here).
+        check_vma=False,
+    )(q, k_cache, v_cache, block_tables, positions)

@@ -1,0 +1,234 @@
+"""Pallas TPU decode kernel for MLA (DeepSeek latent attention).
+
+In the absorbed formulation MLA decode IS multi-query attention: every
+query head attends to ONE shared K/V stream — key ``[c ; k_rope]``
+(latent width r_kv + rope width dr) and value ``c`` — so the paged cache
+holds just ``r_kv + dr`` lanes per token (`models/mla.py`). The XLA gather
+formulation materializes the gathered latents and reads them three times
+per step (gather write, score einsum, output einsum): measured 0.21x of
+the HBM roofline on v5e at DeepSeek-V3 MLA geometry (BENCH r04). This
+kernel streams each page from HBM exactly once — double-buffered DMA,
+online softmax, accumulation in latent space — the same structure as the
+GQA decode kernel (`pallas_paged.py`), with two differences:
+
+- TWO key streams per block: scores are ``q_lat @ c^T + q_rope @ r^T``
+  (the rope part is a narrow 64-lane contraction riding the same DMA wave).
+- The value IS the latent: ``acc += p @ c`` — no separate V stream at all,
+  so HBM traffic per token is r_kv + dr bytes where GQA pays 2 * H_kv * hd.
+
+Reference counterpart: none — the reference outsources kernels to
+vLLM/TRT-LLM (SURVEY.md §2 row 30); this is the TPU-native equivalent of
+their MLA/MQA decode kernels (flash-MLA class).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+LANES = 128
+
+
+def mla_decode_supported(r_kv: int, r_width: int) -> bool:
+    """Geometry the kernel handles: both streams lane-aligned (the rope
+    stream is pre-padded to a 128-lane tile by ``mla_cache_widths`` —
+    Mosaic cannot DMA sub-tile HBM slices)."""
+    return r_kv % LANES == 0 and r_width % LANES == 0
+
+
+def _mla_decode_kernel(
+    # scalar prefetch (SMEM)
+    lengths_ref,  # i32[B]
+    tables_ref,  # i32[B * pages_per_seq]
+    # blocked operands
+    q_lat_ref,  # [n_heads, r_kv]  pre-scaled, cache dtype
+    q_rope_ref,  # [n_heads, dr]
+    c_hbm,  # [P, page_size, r_kv] in HBM/ANY
+    r_hbm,  # [P, page_size, dr]
+    o_ref,  # f32[n_heads, r_kv]
+    # scratch
+    c_buf,  # [2, block_tokens, r_kv] VMEM
+    r_buf,  # [2, block_tokens, dr] VMEM
+    c_sem,
+    r_sem,
+    *,
+    batch: int,
+    pages_per_seq: int,
+    pages_per_block: int,
+    page_size: int,
+):
+    b = pl.program_id(0)
+    bk = pages_per_block * page_size
+    length = lengths_ref[b]
+    num_blocks = pl.cdiv(length, bk)
+
+    def blocks_of(bb):
+        return pl.cdiv(jnp.maximum(lengths_ref[bb], 1), bk)
+
+    start_parity = (
+        jax.lax.fori_loop(0, b, lambda bb, acc: acc + blocks_of(bb), jnp.int32(0)) % 2
+    )
+
+    def page_index(bb, ii, j):
+        last = jnp.maximum(lengths_ref[bb] - 1, 0) // page_size
+        idx = jnp.minimum(ii * pages_per_block + j, last)
+        return tables_ref[bb * pages_per_seq + idx]
+
+    def start_block(slot, bb, ii):
+        for j in range(pages_per_block):
+            page = page_index(bb, ii, j)
+            rows = pl.ds(j * page_size, page_size)
+            pltpu.make_async_copy(
+                c_hbm.at[page], c_buf.at[slot, rows, :], c_sem.at[slot]
+            ).start()
+            pltpu.make_async_copy(
+                r_hbm.at[page], r_buf.at[slot, rows, :], r_sem.at[slot]
+            ).start()
+
+    def wait_block(slot, bb, ii):
+        for j in range(pages_per_block):
+            page = page_index(bb, ii, j)
+            rows = pl.ds(j * page_size, page_size)
+            pltpu.make_async_copy(
+                c_hbm.at[page], c_buf.at[slot, rows, :], c_sem.at[slot]
+            ).wait()
+            pltpu.make_async_copy(
+                r_hbm.at[page], r_buf.at[slot, rows, :], r_sem.at[slot]
+            ).wait()
+
+    def next_indices(ii):
+        advance = ii + 1 >= num_blocks
+        nb = jnp.where(advance, b + 1, b)
+        ni = jnp.where(advance, 0, ii + 1)
+        is_last_overall = jnp.logical_and(nb >= batch, advance)
+        return jnp.minimum(nb, batch - 1), ni, is_last_overall
+
+    @pl.when(b == 0)
+    def _():
+        start_block(0, 0, 0)
+
+    n_heads, r_kv = q_lat_ref.shape
+    q_lat = q_lat_ref[...]
+    q_rope = q_rope_ref[...]
+
+    def body(i, carry):
+        m, l, acc = carry
+        cur = (start_parity + i) % 2
+        nb, ni, is_last = next_indices(i)
+
+        @pl.when(jnp.logical_not(is_last))
+        def _():
+            start_block(1 - cur, nb, ni)
+
+        wait_block(cur, b, i)
+
+        c = c_buf[cur]  # [bk, r_kv] cache dtype
+        r = r_buf[cur]  # [bk, dr]
+        if c.dtype.itemsize < 2:  # fp8 cache: DMA at 1 B/elem, matmul in bf16
+            c = c.astype(jnp.bfloat16)
+            r = r.astype(jnp.bfloat16)
+        # MQA: one shared K stream; scores are the latent contraction plus
+        # the narrow rope contraction (both MXU, f32 accumulation).
+        s = jax.lax.dot_general(
+            q_lat, c, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) + jax.lax.dot_general(
+            q_rope, r, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # f32[H, bk]
+        kpos = i * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(kpos < length, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        # The value IS the latent stream.
+        acc_new = alpha * acc + jax.lax.dot_general(
+            p.astype(c.dtype), c, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # f32[H, r_kv]
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((n_heads, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((n_heads, 1), jnp.float32)
+    acc0 = jnp.zeros((n_heads, r_kv), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, num_blocks, body, (m0, l0, acc0))
+    o_ref[...] = acc / l
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def mla_paged_decode(
+    q_lat: jnp.ndarray,  # [B, n_heads, r_kv] absorbed queries (NOT scaled)
+    q_rope: jnp.ndarray,  # [B, n_heads, dr] rope queries (NOT scaled)
+    c_cache: jnp.ndarray,  # [P, page_size, r_kv] latent pages
+    r_cache: jnp.ndarray,  # [P, page_size, dr] rope-key pages
+    block_tables: jnp.ndarray,  # i32[B, pages_per_seq]
+    positions: jnp.ndarray,  # i32[B, 1] decode-token position
+    *,
+    scale: float,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Paged MLA decode; returns latent-space output f32[B, n_heads, r_kv]
+    (callers apply the absorbed W_uv up-projection)."""
+    from dynamo_tpu.ops.pallas_paged import _pages_per_block
+
+    b, n_heads, r_kv = q_lat.shape
+    num_pages, page_size, _ = c_cache.shape
+    pages_per_seq = block_tables.shape[1]
+    ppb = _pages_per_block(pages_per_seq, page_size)
+    bk = ppb * page_size
+    dr = r_cache.shape[2]
+
+    lengths = positions[:, 0] + 1
+
+    q_dtype = c_cache.dtype if c_cache.dtype.itemsize >= 2 else jnp.bfloat16
+    q_lat_s = (q_lat.astype(jnp.float32) * scale).astype(q_dtype)
+    q_rope_s = (q_rope.astype(jnp.float32) * scale).astype(q_dtype)
+
+    kernel = functools.partial(
+        _mla_decode_kernel,
+        batch=b,
+        pages_per_seq=pages_per_seq,
+        pages_per_block=ppb,
+        page_size=page_size,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b,),
+            in_specs=[
+                pl.BlockSpec((None, n_heads, r_kv), lambda bb, *_: (bb, 0, 0)),
+                pl.BlockSpec((None, n_heads, dr), lambda bb, *_: (bb, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=pl.BlockSpec((None, n_heads, r_kv), lambda bb, *_: (bb, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((2, bk, r_kv), c_cache.dtype),
+                pltpu.VMEM((2, bk, dr), r_cache.dtype),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, n_heads, r_kv), jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)
+        ),
+        interpret=interpret,
+    )(
+        lengths,
+        block_tables.reshape(-1),
+        q_lat_s,
+        q_rope_s,
+        c_cache,
+        r_cache,
+    )
+    return out
+
+
+from dynamo_tpu.ops.pallas_paged import interpret_mode  # noqa: E402  (shared flag)

@@ -52,6 +52,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import os
 import threading
 
 import jax
@@ -100,6 +101,13 @@ def fallback_snapshot() -> dict[str, int]:
     """Race-free copy for metrics scrapes (trace threads mutate the dict)."""
     with _fallback_lock:
         return dict(FALLBACK_COUNTS)
+
+
+def interpret_mode() -> bool:
+    """DYNAMO_PALLAS_INTERPRET=1 runs every Pallas kernel (GQA decode,
+    prefill flash, MLA decode) through the interpreter — CPU-executable, so
+    multi-chip tests/dryruns cover the kernel path on a virtual mesh."""
+    return os.environ.get("DYNAMO_PALLAS_INTERPRET", "") == "1"
 
 
 def _pages_per_block(pages_per_seq: int, page_size: int) -> int:
@@ -385,10 +393,12 @@ def paged_attention_pallas(
                 f"(rows {bad}); pass contiguous_positions=False for gappy "
                 f"layouts (speculative verify, sliding window)"
             )
+    interpret = interpret_mode()
     if q.shape[1] == 1:
         if decode_supported(q, k_cache):
             return paged_decode_attention(
-                q, k_cache, v_cache, block_tables, positions, scale=scale
+                q, k_cache, v_cache, block_tables, positions, scale=scale,
+                interpret=interpret,
             )
         _record_fallback("decode", q, k_cache)
     else:
@@ -399,7 +409,8 @@ def paged_attention_pallas(
 
         if contiguous_positions and prefill_supported(q, k_cache):
             return paged_prefill_attention(
-                q, k_cache, v_cache, block_tables, positions, scale=scale
+                q, k_cache, v_cache, block_tables, positions, scale=scale,
+                interpret=interpret,
             )
         _record_fallback("prefill", q, k_cache)
     return paged_attention_reference(

@@ -62,7 +62,8 @@ def _merge(acc1, m1, l1, acc2, m2, l2):
     return acc, m, l
 
 
-def ring_attention_sharded(q, k, v, q_pos, kv_pos, *, axis_name: str, scale: float):
+def ring_attention_sharded(q, k, v, q_pos, kv_pos, *, axis_name: str, scale: float,
+                           vary_axes: tuple[str, ...] | None = None):
     """Body to run under shard_map: local shards, full-sequence semantics.
 
     q:      [B, Tq_local, H, hd]      (local Q shard)
@@ -74,11 +75,14 @@ def ring_attention_sharded(q, k, v, q_pos, kv_pos, *, axis_name: str, scale: flo
     b, tq, h, _ = q.shape
     hd_v = v.shape[-1]  # may differ from q/k (MLA: value = latent, k = latent+rope)
 
-    # pvary: mark the fresh accumulators as varying over the ring axis so the
-    # fori_loop carry type matches the (device-varying) merged partials.
-    acc = jax.lax.pvary(jnp.zeros((b, tq, h, hd_v), jnp.float32), (axis_name,))
-    m = jax.lax.pvary(jnp.full((b, h, tq), NEG_INF, jnp.float32), (axis_name,))
-    l = jax.lax.pvary(jnp.zeros((b, h, tq), jnp.float32), (axis_name,))
+    # pvary: mark the fresh accumulators as varying over every mapped axis
+    # (the ring axis, plus dp when the batch dim is sharded through the
+    # shard_map) so the fori_loop carry type matches the (device-varying)
+    # merged partials.
+    vary = tuple(vary_axes) if vary_axes else (axis_name,)
+    acc = jax.lax.pvary(jnp.zeros((b, tq, h, hd_v), jnp.float32), vary)
+    m = jax.lax.pvary(jnp.full((b, h, tq), NEG_INF, jnp.float32), vary)
+    l = jax.lax.pvary(jnp.zeros((b, h, tq), jnp.float32), vary)
 
     def ring_step(i, carry):
         acc, m, l, k_cur, v_cur, kv_pos_cur = carry
@@ -114,10 +118,19 @@ def ring_attention(
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    seq_spec = P(None, axis_name, None, None)
-    pos_spec = P(None, axis_name)
+    # Keep the batch dim dp-sharded through the ring: the engine's step
+    # inputs arrive P("dp", ...), and replicating batch here (P(None, sp))
+    # forces an SPMD involuntary full rematerialization of every ring input
+    # at the prefill boundary (a real collective on ICI). The ring's own
+    # collectives ride only ``axis_name``; dp stays pure data parallel.
+    batch_axis = "dp" if "dp" in mesh.axis_names else None
+    seq_spec = P(batch_axis, axis_name, None, None)
+    pos_spec = P(batch_axis, axis_name)
 
-    body = functools.partial(ring_attention_sharded, axis_name=axis_name, scale=scale)
+    body = functools.partial(
+        ring_attention_sharded, axis_name=axis_name, scale=scale,
+        vary_axes=(axis_name,) + ((batch_axis,) if batch_axis else ()),
+    )
     fn = jax.shard_map(
         body,
         mesh=mesh,

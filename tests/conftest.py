@@ -22,10 +22,46 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compile cache: jit compiles dominate suite wall time, and
+# the programs are identical run to run. ~4x faster warm suite; the fast
+# tier (-m fast) depends on this to stay under its budget.
+_cache_dir = os.environ.get(
+    "DYNAMO_TEST_COMPILE_CACHE", os.path.expanduser("~/.cache/dynamo_tpu_test_xla")
+)
+if _cache_dir != "0":
+    os.makedirs(_cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
 import asyncio  # noqa: E402
 import inspect  # noqa: E402
 
 import pytest  # noqa: E402
+
+# The fast CI tier: modules whose tests are quick (no big jit programs, no
+# multi-process spawns, no soak loops). `pytest -m fast` must stay a
+# pre-commit-sized run (< 3 min cold, seconds warm); anything slower lives
+# in the default tier. Module granularity keeps the list maintainable.
+FAST_MODULES = {
+    "test_blocks", "test_config_logging", "test_deploy", "test_gguf",
+    "test_kubernetes_backend", "test_loader", "test_model_card",
+    "test_native", "test_persist", "test_pipeline",
+    "test_planner_connector", "test_preprocess_backend", "test_protocols",
+    "test_pull_transfer", "test_router", "test_rope_convention",
+    "test_runtime_component", "test_runtime_discovery",
+    "test_runtime_transport", "test_sampling", "test_sentencepiece",
+    "test_tokens", "test_tool_calls", "test_tracing_objects",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        module = item.nodeid.split("::")[0].rsplit("/", 1)[-1].removesuffix(".py")
+        if module in FAST_MODULES and not any(
+            m.name in ("e2e", "slow", "tpu_1", "tpu_8") for m in item.iter_markers()
+        ):
+            item.add_marker(pytest.mark.fast)
 
 
 def pytest_pyfunc_call(pyfuncitem):

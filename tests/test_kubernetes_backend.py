@@ -1,0 +1,243 @@
+"""KubernetesBackend against a mocked k8s API server (VERDICT r3 item 6).
+
+The mock implements the three verbs the backend uses — server-side apply
+(PATCH application/apply-patch+yaml), labeled deletecollection, and list —
+over an in-memory object store, so the full operator control loop runs:
+GraphDeployment record -> reconcile -> objects materialized in the
+"cluster"; planner DeploymentConnector scale -> re-reconcile -> Deployment
+spec.replicas patched.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+aiohttp = pytest.importorskip("aiohttp")
+from aiohttp import web  # noqa: E402
+
+from dynamo_tpu.deploy.kubernetes import (  # noqa: E402
+    DEPLOYMENT_LABEL,
+    KubernetesBackend,
+    ManifestError,
+    validate_manifest,
+)
+from dynamo_tpu.deploy.objects import STORE_PREFIX, GraphDeployment  # noqa: E402
+
+
+class MockApiServer:
+    """Minimal k8s apiserver: namespaced objects in a dict."""
+
+    def __init__(self) -> None:
+        self.objects: dict[tuple[str, str, str], dict] = {}  # (plural, ns, name)
+        self.patches = 0
+
+    def _routes(self, app: web.Application) -> None:
+        for prefix, plural in (
+            ("/apis/apps/v1", "deployments"),
+            ("/api/v1", "services"),
+            ("/api/v1", "configmaps"),
+        ):
+            base = f"{prefix}/namespaces/{{ns}}/{plural}"
+            app.router.add_patch(base + "/{name}", self._make_patch(plural))
+            app.router.add_get(base, self._make_list(plural))
+            app.router.add_delete(base, self._make_delete_collection(plural))
+
+    def _make_patch(self, plural):
+        async def handler(request: web.Request) -> web.Response:
+            assert request.headers["Content-Type"] == "application/apply-patch+yaml"
+            assert request.query.get("fieldManager"), "server-side apply needs fieldManager"
+            doc = json.loads(await request.text())
+            key = (plural, request.match_info["ns"], request.match_info["name"])
+            created = key not in self.objects
+            self.objects[key] = doc
+            self.patches += 1
+            return web.json_response(doc, status=201 if created else 200)
+
+        return handler
+
+    def _make_list(self, plural):
+        async def handler(request: web.Request) -> web.Response:
+            sel = request.query.get("labelSelector", "")
+            items = [
+                doc for (pl, ns, _n), doc in self.objects.items()
+                if pl == plural and ns == request.match_info["ns"]
+                and self._matches(doc, sel)
+            ]
+            return web.json_response({"items": items})
+
+        return handler
+
+    def _make_delete_collection(self, plural):
+        async def handler(request: web.Request) -> web.Response:
+            sel = request.query.get("labelSelector", "")
+            doomed = [
+                key for key, doc in self.objects.items()
+                if key[0] == plural and key[1] == request.match_info["ns"]
+                and self._matches(doc, sel)
+            ]
+            for key in doomed:
+                del self.objects[key]
+            return web.json_response({"deleted": len(doomed)})
+
+        return handler
+
+    @staticmethod
+    def _matches(doc: dict, selector: str) -> bool:
+        if not selector:
+            return True
+        labels = doc.get("metadata", {}).get("labels", {})
+        for clause in selector.split(","):
+            k, _, v = clause.partition("=")
+            if labels.get(k) != v:
+                return False
+        return True
+
+
+import contextlib
+
+
+@contextlib.asynccontextmanager
+async def mock_cluster():
+    """(server, base_url) — the repo's test runner has no async fixtures."""
+    server = MockApiServer()
+    app = web.Application()
+    server._routes(app)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    try:
+        yield server, f"http://127.0.0.1:{port}"
+    finally:
+        await runner.cleanup()
+
+
+def _dep(name="demo", replicas=2):
+    return GraphDeployment(
+        name=name, graph="dynamo_tpu.sdk.graphs:Frontend",
+        config={"Worker": {"replicas": replicas, "mock": True}},
+        generation=1,
+    )
+
+
+async def test_apply_materializes_objects_and_delete_clears_them():
+    async with mock_cluster() as (server, url):
+        backend = KubernetesBackend(url, namespace="prod")
+        try:
+            counts = await backend.apply(_dep(replicas=3))
+            assert counts.get("Worker") == 3
+            kinds = {k[0] for k in server.objects}
+            assert kinds == {"deployments", "services", "configmaps"}
+            # Every object is namespaced where asked and labeled for deletion.
+            for (plural, ns, _name), doc in server.objects.items():
+                assert ns == "prod"
+                assert doc["metadata"]["labels"][DEPLOYMENT_LABEL] == "demo"
+            live = await backend.replicas("demo")
+            assert live.get("Worker") == 3
+
+            await backend.delete("demo")
+            assert not server.objects, "labeled deletecollection left objects behind"
+        finally:
+            await backend.close()
+
+
+async def test_reapply_scales_replicas():
+    """Spec change -> server-side re-apply patches spec.replicas."""
+    async with mock_cluster() as (server, url):
+        backend = KubernetesBackend(url)
+        try:
+            await backend.apply(_dep(replicas=1))
+            assert (await backend.replicas("demo")).get("Worker") == 1
+            await backend.apply(_dep(replicas=4))
+            assert (await backend.replicas("demo")).get("Worker") == 4
+        finally:
+            await backend.close()
+
+
+async def test_operator_with_k8s_backend_and_planner_scale():
+    """Full control loop: store record -> Operator(reconcile) -> k8s objects;
+    planner DeploymentConnector scale -> reconcile -> replicas patched."""
+    from dynamo_tpu.deploy.operator import Operator
+    from dynamo_tpu.planner.connector import DeploymentConnector
+    from dynamo_tpu.planner.core import PlanDecision
+    from dynamo_tpu.runtime.discovery import MemoryStore
+
+    async with mock_cluster() as (server, url):
+        store = MemoryStore()
+        backend = KubernetesBackend(url)
+        op = Operator(store, backend, resync_seconds=3600)
+        try:
+            dep = _dep(replicas=2)
+            await store.put(dep.key, dep.to_bytes())
+            await op.start()
+            for _ in range(100):
+                if (await backend.replicas("demo")).get("Worker") == 2:
+                    break
+                await asyncio.sleep(0.05)
+            assert (await backend.replicas("demo")).get("Worker") == 2
+
+            connector = DeploymentConnector(store, "demo", decode_service="Worker")
+            await connector.apply(PlanDecision(decode_workers=5, prefill_workers=0, predicted_prefill_tps=0.0, predicted_decode_tps=0.0))
+            assert connector.scale_events == 1
+            for _ in range(100):
+                if (await backend.replicas("demo")).get("Worker") == 5:
+                    break
+                await asyncio.sleep(0.05)
+            assert (await backend.replicas("demo")).get("Worker") == 5
+
+            # Status written back to the record.
+            rec = GraphDeployment.from_bytes(await store.get(STORE_PREFIX + "demo"))
+            from dynamo_tpu.deploy.objects import DeploymentPhase
+            assert rec.phase == DeploymentPhase.RUNNING.value and rec.services_ready.get("Worker") == 5
+        finally:
+            await op.close()
+
+
+def test_validate_manifest_rejects_bad_shapes():
+    good = {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": {"name": "ok-name", "labels": {DEPLOYMENT_LABEL: "d"}},
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {"app": "x"}},
+            "template": {
+                "metadata": {"labels": {"app": "x"}},
+                "spec": {"containers": [{"name": "c", "image": "img"}]},
+            },
+        },
+    }
+    validate_manifest(good)
+
+    import copy
+
+    bad_name = copy.deepcopy(good)
+    bad_name["metadata"]["name"] = "Bad_Name"
+    with pytest.raises(ManifestError, match="DNS-1123"):
+        validate_manifest(bad_name)
+
+    bad_sel = copy.deepcopy(good)
+    bad_sel["spec"]["template"]["metadata"]["labels"] = {"app": "y"}
+    with pytest.raises(ManifestError, match="selector"):
+        validate_manifest(bad_sel)
+
+    no_label = copy.deepcopy(good)
+    del no_label["metadata"]["labels"]
+    with pytest.raises(ManifestError, match="label"):
+        validate_manifest(no_label)
+
+    no_img = copy.deepcopy(good)
+    del no_img["spec"]["template"]["spec"]["containers"][0]["image"]
+    with pytest.raises(ManifestError, match="image"):
+        validate_manifest(no_img)
+
+
+async def test_rendered_bundle_passes_validation():
+    """Everything the renderer emits must pre-flight clean."""
+    from dynamo_tpu.deploy.manifests import render_deployment
+    from dynamo_tpu.sdk.graph import load_graph
+
+    dep = _dep()
+    for doc in render_deployment(dep, load_graph(dep.graph)):
+        validate_manifest(doc)

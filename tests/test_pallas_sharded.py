@@ -1,0 +1,128 @@
+"""Pallas paged kernels under a sharded mesh (tp over KV heads, dp batch).
+
+Production 8B/70B serving runs the decode/prefill kernels tensor-parallel;
+GSPMD cannot partition a pallas_call, so `paged_attention_sharded`
+(ops/attention.py) shard_maps the kernel over the mesh — each device runs
+on its KV-head slice. These tests run that exact dispatch on the virtual
+CPU mesh with the kernels in Pallas interpret mode
+(DYNAMO_PALLAS_INTERPRET=1) and pin it against the unsharded reference
+formulation. VERDICT r3 item 5 / SURVEY §7 hard parts (a)+(b) combined.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import PRESETS
+from dynamo_tpu.ops.attention import (
+    paged_attention_reference,
+    paged_attention_sharded,
+    write_kv,
+)
+from dynamo_tpu.parallel.mesh import MeshPlan, make_mesh
+
+CFG = PRESETS["test-kernel"]  # heads 8, kv 4, head_dim 64: local W=128 at tp=2
+
+
+@pytest.fixture
+def interpret_kernels(monkeypatch):
+    monkeypatch.setenv("DYNAMO_PALLAS_INTERPRET", "1")
+
+
+def _case(rng, b, t, page_size=8, pages_per_seq=4):
+    n_heads, n_kv, hd = CFG.num_heads, CFG.num_kv_heads, CFG.head_dim
+    width = n_kv * hd
+    num_pages = 1 + b * pages_per_seq
+    q = jnp.asarray(rng.standard_normal((b, t, n_heads, hd)), jnp.float32)
+    k_cache = jnp.zeros((num_pages, page_size, width), jnp.float32)
+    v_cache = jnp.zeros((num_pages, page_size, width), jnp.float32)
+    tables = jnp.asarray(
+        [[1 + i * pages_per_seq + j for j in range(pages_per_seq)] for i in range(b)],
+        jnp.int32,
+    )
+    # Fill each sequence's cache with ctx_len tokens of K/V, then the query
+    # block positions [ctx_len - t, ctx_len).
+    ctx = page_size * pages_per_seq - 2
+    new_k = jnp.asarray(rng.standard_normal((b, ctx, n_kv, hd)), jnp.float32)
+    new_v = jnp.asarray(rng.standard_normal((b, ctx, n_kv, hd)), jnp.float32)
+    pos_all = np.arange(ctx)
+    slots = np.asarray(
+        [[int(tables[i, p // page_size]) * page_size + p % page_size for p in pos_all]
+         for i in range(b)], np.int32,
+    )
+    k_cache, v_cache = write_kv(k_cache, v_cache, new_k, new_v, jnp.asarray(slots))
+    positions = jnp.tile(jnp.arange(ctx - t, ctx, dtype=jnp.int32)[None], (b, 1))
+    return q, k_cache, v_cache, tables, positions
+
+
+@pytest.mark.parametrize("t", [1, 8])  # decode kernel / prefill flash kernel
+def test_sharded_kernel_matches_reference(interpret_kernels, t):
+    from dynamo_tpu.ops import pallas_paged
+
+    rng = np.random.default_rng(0)
+    b = 4
+    q, k_cache, v_cache, tables, positions = _case(rng, b, t)
+    mesh = make_mesh(MeshPlan(dp=2, tp=2), jax.devices()[:4])
+
+    before = dict(pallas_paged.fallback_snapshot())
+    got = paged_attention_sharded(
+        q, k_cache, v_cache, tables, positions, mesh=mesh, impl="pallas"
+    )
+    want = paged_attention_reference(
+        q, k_cache, v_cache, tables, positions, scale=CFG.head_dim**-0.5
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-2, atol=2e-2)
+    # The KERNEL ran on the local shard — no new fallback signature.
+    assert pallas_paged.fallback_snapshot() == before, "kernel fell back under tp"
+
+
+def test_sharded_kernel_under_jit_with_dp_sharded_batch(interpret_kernels):
+    """The dispatch must compose with the engine's jitted step: dp-sharded
+    batch inputs, cache sharded on the W axis, inside jax.jit."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rng = np.random.default_rng(1)
+    b, t = 4, 8
+    q, k_cache, v_cache, tables, positions = _case(rng, b, t)
+    mesh = make_mesh(MeshPlan(dp=2, tp=2), jax.devices()[:4])
+    q = jax.device_put(q, NamedSharding(mesh, P("dp", None, "tp", None)))
+    k_cache = jax.device_put(k_cache, NamedSharding(mesh, P(None, None, "tp")))
+    v_cache = jax.device_put(v_cache, NamedSharding(mesh, P(None, None, "tp")))
+    tables = jax.device_put(tables, NamedSharding(mesh, P("dp", None)))
+    positions = jax.device_put(positions, NamedSharding(mesh, P("dp", None)))
+
+    fn = jax.jit(lambda *a: paged_attention_sharded(*a, mesh=mesh, impl="pallas"))
+    got = fn(q, k_cache, v_cache, tables, positions)
+    want = paged_attention_reference(
+        q, k_cache, v_cache, tables, positions, scale=CFG.head_dim**-0.5
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-2, atol=2e-2)
+
+
+def test_full_forward_pallas_under_mesh(interpret_kernels):
+    """llama.forward with attn_impl="pallas" and a tp>1 mesh routes through
+    the sharded kernel dispatch and matches the reference forward."""
+    mesh = make_mesh(MeshPlan(dp=2, tp=2), jax.devices()[:4])
+    params = llama.init_params(CFG, 0)
+    page_size, num_pages = 8, 16
+    b, t = 2, 8
+    tokens = jnp.asarray(np.arange(b * t).reshape(b, t) % CFG.vocab_size, jnp.int32)
+    positions = jnp.tile(jnp.arange(t, dtype=jnp.int32)[None], (b, 1))
+    tables = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+    slots = jnp.take_along_axis(tables, positions // page_size, axis=1) * page_size + positions % page_size
+    last = jnp.full((b,), t - 1, jnp.int32)
+
+    def run(impl, use_mesh):
+        kc, vc = llama.init_kv_cache(CFG, num_pages, page_size)
+        logits, _, _ = llama.forward(
+            params, CFG, tokens, positions, kc, vc, tables, slots, last,
+            attn_impl=impl, mesh=mesh if use_mesh else None,
+        )
+        return np.asarray(logits)
+
+    want = run("reference", False)
+    got = run("pallas", True)
+    np.testing.assert_allclose(got, want, rtol=2e-2, atol=2e-2)

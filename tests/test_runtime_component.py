@@ -93,8 +93,11 @@ async def test_lease_expiry_removes_instance_from_client():
     await client.wait_for_instances(count=1, timeout=5)
     # Kill the worker's keep-alive: simulate process death.
     rt_worker._keepalive_task.cancel()
-    await asyncio.sleep(0.6)
-    assert client.instances() == []
+    # Expiry + reap + watch delivery are wall-clock paths: poll instead of a
+    # fixed sleep so suite-load scheduling jitter can't flake this.
+    from conftest import wait_for
+
+    assert await wait_for(lambda: client.instances() == [], timeout=10)
     with pytest.raises(NoInstancesError):
         await collect(client.generate({}))
     await rt_worker.close()
