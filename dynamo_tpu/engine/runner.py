@@ -143,6 +143,7 @@ class ModelRunner:
         forward_fn=None,
         cache_dtype: jnp.dtype | None = None,
         mesh=None,  # jax.sharding.Mesh for TP/DP execution (see dynamo_tpu.parallel)
+        embed_pooling: str = "mean",  # /v1/embeddings pooling ("mean" | "last")
     ) -> None:
         self.cfg = cfg
         self.num_pages = num_pages
@@ -307,7 +308,7 @@ class ModelRunner:
 
         @jax.jit
         def _embed(params, tokens, mask):
-            return llama.encode(params, self.cfg, tokens, mask)
+            return llama.encode(params, self.cfg, tokens, mask, pooling=embed_pooling)
 
         self._embed_fn = _embed
 

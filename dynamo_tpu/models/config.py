@@ -112,6 +112,15 @@ class ModelConfig:
         """Build from an HF ``config.json`` dict or path (Llama/Qwen-style keys)."""
         if not isinstance(config, dict):
             config = json.loads(pathlib.Path(config).read_text())
+        if "text_config" in config and "vision_config" in config:
+            # VLM (LLaVA-class) config: the LM is the nested text_config;
+            # the tower is models/vision.VisionConfig.from_hf_llava.
+            import dataclasses as _dc
+
+            inner = dict(config["text_config"])
+            inner.setdefault("_name_or_path", config.get("_name_or_path", "vlm"))
+            cfg = cls.from_hf(inner, name=name)
+            return _dc.replace(cfg, image_token_id=config.get("image_token_index"))
         hidden = config["hidden_size"]
         heads = config["num_attention_heads"]
         # DeepSeek replaces the first k MoE layers with dense MLPs

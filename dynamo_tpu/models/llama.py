@@ -188,11 +188,23 @@ def _mlp_moe(lp: Params, x: jnp.ndarray, cfg: ModelConfig, mesh=None) -> jnp.nda
     xt = x.reshape(b * t, d)
     ep = int(mesh.shape.get("ep", 1)) if mesh is not None else 1
     routing = _routing_kwargs(cfg)
-    # DYNAMO_MOE_DISPATCH=capacity forces the capacity-bounded scatter
-    # dispatch even without an ep axis — escape hatch for toolchains where
-    # lax.ragged_dot fails to compile (observed: axon remote-compile helper
-    # crash at 64 experts), and an A/B lever for benchmarks.
-    if ep <= 1 and os.environ.get("DYNAMO_MOE_DISPATCH", "") != "capacity":
+    # DYNAMO_MOE_DISPATCH overrides the ragged-matmul default without an ep
+    # axis — escape hatches for toolchains where the default explodes:
+    #  - "capacity": GShard scatter dispatch (lax.ragged_dot crashes the
+    #    axon AOT helper at 64 experts).
+    #  - "dense": decode-sized batches (N*k tokens-choices <= 2048) run the
+    #    dense formulation — every token through every expert, mixed by
+    #    routing weight. At decode N the extra FLOPs are MXU-noise and the
+    #    step stays weight-bandwidth-bound; crucially there is NO scatter
+    #    feeding a batched matmul, the exact composition the axon AOT
+    #    compiler fails to schedule (compile probes: scatter alone 2s,
+    #    einsums alone 1s, composed > 25 min). Larger (prefill) batches fall
+    #    through to the capacity dispatch.
+    dispatch = os.environ.get("DYNAMO_MOE_DISPATCH", "")
+    dense_ok = b * t * cfg.num_experts_per_token <= 2048
+    if ep <= 1 and dispatch == "dense" and dense_ok:
+        out = _routed_dense(lp, xt, cfg)
+    elif ep <= 1 and dispatch not in ("capacity", "dense"):
         out = moe_mlp_dropless(
             lp, xt, num_experts_per_token=cfg.num_experts_per_token, routing=routing
         )
@@ -213,14 +225,13 @@ def _mlp_moe(lp: Params, x: jnp.ndarray, cfg: ModelConfig, mesh=None) -> jnp.nda
     return out.reshape(b, t, d)
 
 
-def _mlp_moe_dense(lp: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
-    """Dense-compute MoE reference: every token through every expert, mixed
-    by routing weights. O(N*E) FLOPs — kept as the golden model for tests of
-    the dispatched path, never used for serving."""
+def _routed_dense(lp: Params, xt: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+    """Routed MoE via dense compute over flattened tokens [N, D]: every
+    token through every expert, mixed by routing weight. Exact (same output
+    as the dropless dispatch); O(N*E) FLOPs, so only sensible for
+    decode-sized N where the step is weight-bandwidth-bound anyway."""
     from dynamo_tpu.parallel.moe import route_tokens
 
-    b, t, d = x.shape
-    xt = x.reshape(b * t, d)
     weights, topi = route_tokens(
         lp, xt, k=cfg.num_experts_per_token, **_routing_kwargs(cfg)
     )
@@ -232,7 +243,14 @@ def _mlp_moe_dense(lp: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
     up = jnp.einsum("nd,edf->nef", xt, _dq(lp["w_up"]))
     expert_out = jnp.einsum("nef,efd->ned", gate * up, _dq(lp["w_down"]))  # [N, E, d]
     out = jnp.einsum("ned,ne->nd", expert_out.astype(jnp.float32), mix)
-    return out.reshape(b, t, d).astype(x.dtype)
+    return out.astype(xt.dtype)
+
+
+def _mlp_moe_dense(lp: Params, x: jnp.ndarray, cfg: ModelConfig) -> jnp.ndarray:
+    """Dense-compute MoE golden model for tests of the dispatched paths
+    (and the serving decode path under DYNAMO_MOE_DISPATCH=dense)."""
+    b, t, d = x.shape
+    return _routed_dense(lp, x.reshape(b * t, d), cfg).reshape(b, t, d)
 
 
 def forward(
@@ -409,17 +427,23 @@ def encode(
     cfg: ModelConfig,
     tokens: jnp.ndarray,  # i32[B, T]
     mask: jnp.ndarray,  # bool[B, T] — True on real tokens
+    pooling: str = "mean",  # "mean" | "last"
 ) -> jnp.ndarray:
-    """Sentence-embedding forward: hidden states, mean-pooled, L2-normalized.
+    """Sentence-embedding forward: pooled final hidden states, L2-normalized.
 
     Runs the same stacked-layer scan as :func:`forward` but with plain
     in-batch causal attention — no paged cache, nothing donated, so it can
     run concurrently with serving steps. Returns f32[B, D].
 
+    BE EXPLICIT about what this is: embeddings come from the SERVING LM's
+    hidden states (masked mean, or last-token with ``pooling="last"`` — the
+    E5-Mistral-class recipe). Meaningful retrieval quality requires
+    deploying a checkpoint actually trained for embeddings (e.g. a
+    gte-Qwen2 / E5 model through the normal loader); on a plain chat
+    checkpoint this endpoint is API-parity, not a quality claim.
+
     Parity: the reference's /v1/embeddings route + EmbeddingEngine adapter
-    (`lib/llm/src/http/service/openai.rs:580`, `engines.rs:321`); pooling
-    follows the common decoder-LLM embedding recipe (masked mean of the
-    final hidden states).
+    (`lib/llm/src/http/service/openai.rs:580`, `engines.rs:321`).
     """
     b, t = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32), (b, t))
@@ -461,5 +485,11 @@ def encode(
     x, _ = jax.lax.scan(make_layer_step(cfg.is_moe), x, params["layers"])
     x = rms_norm(x, params["norm_f"], eps=cfg.rms_eps).astype(jnp.float32)
     m = mask[:, :, None].astype(jnp.float32)
-    pooled = (x * m).sum(1) / jnp.maximum(m.sum(1), 1.0)
+    if pooling == "last":
+        # Last real token's hidden state — the recipe instruction-tuned
+        # embedders (E5-Mistral / gte-Qwen class) are trained with.
+        last = jnp.maximum(mask.sum(1) - 1, 0)  # [B]
+        pooled = jnp.take_along_axis(x, last[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+    else:
+        pooled = (x * m).sum(1) / jnp.maximum(m.sum(1), 1.0)
     return pooled / jnp.maximum(jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9)

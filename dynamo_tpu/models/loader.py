@@ -445,6 +445,56 @@ def _consumed_names(specs: dict, num_layers: int) -> set[str]:
 _IGNORABLE = ("rotary_emb.inv_freq", "masked_bias", ".attn.bias")
 
 
+class _RenamedIndex:
+    """View over a CheckpointIndex translating canonical Llama names
+    (``model.X`` / ``lm_head.weight``) to a VLM checkpoint's language-model
+    subtree. Handles both HF layouts: the post-refactor
+    ``model.language_model.X`` (+ top-level ``lm_head.weight``) and the
+    legacy ``language_model.model.X`` (+ ``language_model.lm_head.weight``).
+    Vision/projector tensors are hidden from ``keys()`` so the strict
+    leftover check applies to the LM subtree only."""
+
+    def __init__(self, index: CheckpointIndex) -> None:
+        self._index = index
+        self._legacy = any(k.startswith("language_model.model.") for k in index.keys())
+
+    def _translate(self, name: str) -> str:
+        if self._legacy:
+            if name == "lm_head.weight":
+                return "language_model.lm_head.weight"
+            if name.startswith("model."):
+                return "language_model." + name
+            return name
+        if name.startswith("model."):
+            return "model.language_model." + name[len("model."):]
+        return name
+
+    def keys(self) -> list[str]:
+        out = []
+        for k in self._index.keys():
+            if self._legacy and k.startswith("language_model.model."):
+                out.append("model." + k[len("language_model.model."):])
+            elif self._legacy and k == "language_model.lm_head.weight":
+                out.append("lm_head.weight")
+            elif k.startswith("model.language_model."):
+                out.append("model." + k[len("model.language_model."):])
+            elif k == "lm_head.weight" and not self._legacy:
+                out.append(k)
+        return out
+
+    def __contains__(self, name: str) -> bool:
+        return self._translate(name) in self._index
+
+    def get_slice(self, name: str):
+        return self._index.get_slice(self._translate(name))
+
+    def shape(self, name: str) -> tuple[int, ...]:
+        return self._index.shape(self._translate(name))
+
+    def read(self, name: str) -> np.ndarray:
+        return self._index.read(self._translate(name))
+
+
 def load_params(
     model_dir: str | pathlib.Path,
     cfg: ModelConfig,
@@ -452,6 +502,7 @@ def load_params(
     mesh: jax.sharding.Mesh | None = None,
     dtype: Any | None = None,
     strict: bool = True,
+    index: Any | None = None,
 ) -> Params:
     """Load a params pytree from an HF-style safetensors checkpoint.
 
@@ -468,7 +519,7 @@ def load_params(
     import ml_dtypes
 
     np_dtype = ml_dtypes.bfloat16 if target_dtype == jnp.bfloat16 else np.dtype(target_dtype)
-    index = CheckpointIndex(model_dir)
+    index = index if index is not None else CheckpointIndex(model_dir)
     specs = _leaf_specs(index, cfg, np_dtype)
     if strict:
         consumed = _consumed_names(specs, cfg.num_layers)
@@ -519,6 +570,104 @@ def load_model(
 
         cfg = dataclasses.replace(cfg, dtype=str(jnp.dtype(dtype).name))
     return cfg, load_params(p, cfg, mesh=mesh, dtype=dtype)
+
+
+def load_vision_params(index: CheckpointIndex, dtype: Any = np.float32) -> Params:
+    """CLIP tower + LLaVA projector weights -> the vision pytree that
+    ``models/vision.encode_image`` consumes.
+
+    Maps HF names (``[model.]vision_tower.vision_model.*`` +
+    ``[model.]multi_modal_projector.*``, reference
+    `examples/multimodal/components/encode_worker.py:61-179` serves exactly
+    this tower via HF). Conv patch embedding becomes the patchify matmul
+    weight ([d,3,ph,pw] -> [(ph,pw,c), d] matching encode_image's flatten
+    order); q/k/v projections stack into one ``wqkv``."""
+    names = set(index.keys())
+    pre = "model." if any(n.startswith("model.vision_tower.") for n in names) else ""
+    vt = pre + "vision_tower.vision_model."
+    proj = pre + "multi_modal_projector."
+
+    def rd(name: str) -> np.ndarray:
+        return index.read(name).astype(dtype)
+
+    conv = rd(vt + "embeddings.patch_embedding.weight")  # [d, 3, ph, pw]
+    d = conv.shape[0]
+    patch_embed = conv.transpose(2, 3, 1, 0).reshape(-1, d)
+
+    n_layers = 1 + max(
+        int(n.split("encoder.layers.")[1].split(".")[0])
+        for n in names if "encoder.layers." in n
+    )
+
+    def layer(li: int) -> dict:
+        p = f"{vt}encoder.layers.{li}."
+        q, k, v = (rd(p + f"self_attn.{x}_proj.weight") for x in "qkv")
+        bq, bk, bv = (rd(p + f"self_attn.{x}_proj.bias") for x in "qkv")
+        return {
+            "ln1": rd(p + "layer_norm1.weight"), "ln1_b": rd(p + "layer_norm1.bias"),
+            "ln2": rd(p + "layer_norm2.weight"), "ln2_b": rd(p + "layer_norm2.bias"),
+            "wqkv": np.concatenate([q.T, k.T, v.T], axis=1),
+            "bqkv": np.concatenate([bq, bk, bv]),
+            "wo": rd(p + "self_attn.out_proj.weight").T,
+            "bo": rd(p + "self_attn.out_proj.bias"),
+            "w1": rd(p + "mlp.fc1.weight").T, "b1": rd(p + "mlp.fc1.bias"),
+            "w2": rd(p + "mlp.fc2.weight").T, "b2": rd(p + "mlp.fc2.bias"),
+        }
+
+    layers = jax.tree.map(
+        lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+        *[layer(i) for i in range(n_layers)],
+    )
+    params: Params = {
+        "patch_embed": jnp.asarray(patch_embed),
+        "cls": jnp.asarray(rd(vt + "embeddings.class_embedding")),
+        "pos_embed": jnp.asarray(rd(vt + "embeddings.position_embedding.weight")),
+        "pre_ln_g": jnp.asarray(rd(vt + "pre_layrnorm.weight")),
+        "pre_ln_b": jnp.asarray(rd(vt + "pre_layrnorm.bias")),
+        "ln_f": jnp.asarray(rd(vt + "post_layernorm.weight")),
+        "ln_f_b": jnp.asarray(rd(vt + "post_layernorm.bias")),
+        "proj1": jnp.asarray(rd(proj + "linear_1.weight").T),
+        "b_proj1": jnp.asarray(rd(proj + "linear_1.bias")),
+        "proj2": jnp.asarray(rd(proj + "linear_2.weight").T),
+        "b_proj2": jnp.asarray(rd(proj + "linear_2.bias")),
+        "layers": layers,
+    }
+    return params
+
+
+def load_vlm(
+    model_dir: str | pathlib.Path,
+    *,
+    mesh: jax.sharding.Mesh | None = None,
+    dtype: Any | None = None,
+    name: str | None = None,
+    load_tower: bool = True,
+):
+    """LLaVA-style VLM checkpoint -> (text ModelConfig, VisionConfig,
+    lm_params, vision_params). The LM half loads through the standard Llama
+    mapping via a renamed-index view; the tower loads eagerly (it is small
+    relative to the LM). VERDICT r3 item 4."""
+    import json as _json
+
+    from dynamo_tpu.models.vision import VisionConfig
+
+    p = pathlib.Path(model_dir)
+    config = _json.loads((p / "config.json").read_text())
+    if "vision_config" not in config:
+        raise ValueError(f"{model_dir}: not a VLM checkpoint (no vision_config)")
+    tcfg = ModelConfig.from_hf(config, name=name or p.name)
+    if dtype is not None:
+        import dataclasses as _dc
+
+        tcfg = _dc.replace(tcfg, dtype=str(jnp.dtype(dtype).name))
+    vcfg = VisionConfig.from_hf_llava(config)
+    index = CheckpointIndex(p)
+    lm_params = load_params(p, tcfg, mesh=mesh, dtype=dtype, index=_RenamedIndex(index))
+    # The tower stays f32: it is tiny next to the LM and LayerNorm-heavy.
+    # load_tower=False skips it entirely — in a multi-worker deployment only
+    # the worker backing the encode service needs a tower copy.
+    vision_params = load_vision_params(index, dtype=np.float32) if load_tower else None
+    return tcfg, vcfg, lm_params, vision_params
 
 
 def save_params(

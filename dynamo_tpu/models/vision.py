@@ -37,14 +37,66 @@ class VisionConfig:
     num_heads: int = 4
     mlp_ratio: int = 4
     out_dim: int = 2048  # the LLM's hidden size
+    # CLIP/LLaVA tower semantics (all off for the plain first-party tower):
+    cls_token: bool = False  # learned class embedding prepended (CLIP)
+    pre_ln: bool = False  # CLIP pre_layrnorm after embeddings
+    bias: bool = False  # attention/MLP/projector biases present
+    act: str = "gelu"  # "gelu" | "quick_gelu" (CLIP)
+    # Which encoder output feeds the projector: 0 = all layers + final LN
+    # (first-party tower); -2 = skip the LAST layer, no post-LN, drop the
+    # CLS row — HF LLaVA's vision_feature_layer=-2 / "default" selection.
+    feature_layer: int = 0
+    mlp_dim: int = 0  # explicit intermediate size (0 = hidden * mlp_ratio)
+    ln_eps: float = 1e-6  # CLIP uses 1e-5
+    # Per-channel pixel normalization (defaults = the /127.5-1 recipe).
+    image_mean: tuple = (0.5, 0.5, 0.5)
+    image_std: tuple = (0.5, 0.5, 0.5)
 
     @property
     def num_patches(self) -> int:
         return (self.image_size // self.patch_size) ** 2
 
     @property
+    def num_tokens(self) -> int:
+        return self.num_patches + (1 if self.cls_token else 0)
+
+    @property
     def patch_dim(self) -> int:
         return 3 * self.patch_size * self.patch_size
+
+    @property
+    def mlp_hidden(self) -> int:
+        return self.mlp_dim or self.hidden_size * self.mlp_ratio
+
+    @classmethod
+    def from_hf_llava(cls, config: dict) -> "VisionConfig":
+        """HF ``LlavaConfig.vision_config`` (CLIP tower) -> VisionConfig."""
+        v = config["vision_config"]
+        t = config["text_config"]
+        fl = config.get("vision_feature_layer", -2)
+        if fl not in (-1, -2):
+            # A silently-mishandled selection corrupts the mm-embed splice;
+            # fail at load, not per request.
+            raise ValueError(
+                f"unsupported vision_feature_layer {fl!r} (supported: -1, -2)"
+            )
+        if config.get("vision_feature_select_strategy", "default") != "default":
+            raise ValueError("only vision_feature_select_strategy='default' supported")
+        return cls(
+            image_size=v.get("image_size", 336),
+            patch_size=v.get("patch_size", 14),
+            hidden_size=v["hidden_size"],
+            num_layers=v["num_hidden_layers"],
+            num_heads=v["num_attention_heads"],
+            mlp_dim=v.get("intermediate_size", 0),
+            out_dim=t["hidden_size"],
+            cls_token=True, pre_ln=True, bias=True, act="quick_gelu",
+            feature_layer=int(fl),
+            ln_eps=float(v.get("layer_norm_eps", 1e-5)),
+            # CLIP image processor statistics (openai/clip-vit defaults).
+            image_mean=(0.48145466, 0.4578275, 0.40821073),
+            image_std=(0.26862954, 0.26130258, 0.27577711),
+        )
 
 
 # A tiny tower matching the test-tiny-vl preset (out_dim = 64).
@@ -62,74 +114,139 @@ def init_vision_params(cfg: VisionConfig, rng: jax.Array | int = 0) -> Params:
         return (jax.random.normal(key, shape, jnp.float32) * (fan_in**-0.5))
 
     d, p = cfg.hidden_size, cfg.patch_dim
-    mlp = cfg.hidden_size * cfg.mlp_ratio
+    mlp = cfg.mlp_hidden
     layer_keys = jax.random.split(ks[7], cfg.num_layers)
 
     def layer(key):
         lk = jax.random.split(key, 6)
-        return {
+        leaves = {
             "ln1": jnp.ones(d), "ln2": jnp.ones(d),
             "wqkv": w(lk[0], (d, 3 * d), d), "wo": w(lk[1], (d, d), d),
             "w1": w(lk[2], (d, mlp), d), "w2": w(lk[3], (mlp, d), mlp),
         }
+        if cfg.bias:
+            leaves.update({
+                "ln1_b": jnp.zeros(d), "ln2_b": jnp.zeros(d),
+                "bqkv": jnp.zeros(3 * d), "bo": jnp.zeros(d),
+                "b1": jnp.zeros(mlp), "b2": jnp.zeros(d),
+            })
+        return leaves
 
     layers = jax.tree.map(lambda *xs: jnp.stack(xs), *[layer(k) for k in layer_keys])
-    return {
+    params = {
         "patch_embed": w(ks[0], (p, d), p),
-        "pos_embed": w(ks[1], (cfg.num_patches, d), d) * 0.02,
+        "pos_embed": w(ks[1], (cfg.num_tokens, d), d) * 0.02,
         "ln_f": jnp.ones(d),
         # LLaVA-style 2-layer MLP projector into the LLM hidden space.
         "proj1": w(ks[2], (d, cfg.out_dim), d),
         "proj2": w(ks[3], (cfg.out_dim, cfg.out_dim), cfg.out_dim),
         "layers": layers,
     }
+    if cfg.cls_token:
+        params["cls"] = w(ks[4], (d,), d)
+    if cfg.pre_ln:
+        params["pre_ln_g"] = jnp.ones(d)
+        if cfg.bias:
+            params["pre_ln_b"] = jnp.zeros(d)
+    if cfg.bias:
+        params["b_proj1"] = jnp.zeros(cfg.out_dim)
+        params["b_proj2"] = jnp.zeros(cfg.out_dim)
+        params["ln_f_b"] = jnp.zeros(d)
+    return params
 
 
-def _ln(x: jnp.ndarray, g: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
+def _ln(x: jnp.ndarray, g: jnp.ndarray, eps: float = 1e-6, b: jnp.ndarray | None = None) -> jnp.ndarray:
     mu = x.mean(-1, keepdims=True)
     var = ((x - mu) ** 2).mean(-1, keepdims=True)
-    return (x - mu) * jax.lax.rsqrt(var + eps) * g
+    y = (x - mu) * jax.lax.rsqrt(var + eps) * g
+    return y if b is None else y + b
 
 
 def encode_image(params: Params, cfg: VisionConfig, pixels: jnp.ndarray) -> jnp.ndarray:
-    """[B, H, W, 3] float in [-1, 1] -> [B, num_patches, out_dim]."""
+    """[B, H, W, 3] float (normalized) -> [B, num_patches, out_dim].
+
+    One forward serves both tower flavors: the first-party minimal ViT and
+    the CLIP/LLaVA geometry (CLS token, pre-LN, biases, quick_gelu,
+    vision_feature_layer=-2 selection) when the config flags say so — the
+    flags mirror exactly what HF's CLIPVisionTransformer + LLaVA projector
+    compute, so real LLaVA checkpoints reproduce HF logits
+    (tests/test_golden_vision.py).
+    """
     b = pixels.shape[0]
     g = cfg.image_size // cfg.patch_size
+    # HF "gelu" is the exact erf form; jax.nn.gelu defaults to the tanh
+    # approximation (~1e-3 divergence — enough to fail logit parity).
+    exact_gelu = lambda v: jax.nn.gelu(v, approximate=False)  # noqa: E731
+    act = exact_gelu if cfg.act == "gelu" else (lambda v: v * jax.nn.sigmoid(1.702 * v))
     # Patchify as one reshape + matmul (a conv with stride == kernel).
     x = pixels.reshape(b, g, cfg.patch_size, g, cfg.patch_size, 3)
     x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, g * g, cfg.patch_dim)
-    x = x @ params["patch_embed"] + params["pos_embed"]
+    x = x @ params["patch_embed"]
+    if cfg.cls_token:
+        cls = jnp.broadcast_to(params["cls"], (b, 1, cfg.hidden_size))
+        x = jnp.concatenate([cls, x], axis=1)
+    x = x + params["pos_embed"]
+    if cfg.pre_ln:
+        x = _ln(x, params["pre_ln_g"], eps=cfg.ln_eps, b=params.get("pre_ln_b"))
 
     h = cfg.num_heads
     hd = cfg.hidden_size // h
     scale = hd**-0.5
 
     def layer_step(x, lp):
-        y = _ln(x, lp["ln1"])
-        qkv = (y @ lp["wqkv"]).reshape(b, -1, 3, h, hd)
+        x_in = x  # emitted below: hidden_states[i] = this layer's INPUT
+        y = _ln(x, lp["ln1"], eps=cfg.ln_eps, b=lp.get("ln1_b"))
+        qkv = y @ lp["wqkv"]
+        if "bqkv" in lp:
+            qkv = qkv + lp["bqkv"]
+        qkv = qkv.reshape(b, -1, 3, h, hd)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         att = jax.nn.softmax(jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale, axis=-1)
         o = jnp.einsum("bhqk,bkhd->bqhd", att, v).reshape(b, -1, cfg.hidden_size)
-        x = x + o @ lp["wo"]
-        y = _ln(x, lp["ln2"])
-        x = x + jax.nn.gelu(y @ lp["w1"]) @ lp["w2"]
-        return x, None
+        o = o @ lp["wo"]
+        if "bo" in lp:
+            o = o + lp["bo"]
+        x = x + o
+        y = _ln(x, lp["ln2"], eps=cfg.ln_eps, b=lp.get("ln2_b"))
+        y = y @ lp["w1"]
+        if "b1" in lp:
+            y = y + lp["b1"]
+        y = act(y) @ lp["w2"]
+        if "b2" in lp:
+            y = y + lp["b2"]
+        return x + y, x_in
 
-    x, _ = jax.lax.scan(layer_step, x, params["layers"])
-    x = _ln(x, params["ln_f"])
-    x = jax.nn.gelu(x @ params["proj1"]) @ params["proj2"]
-    return x
+    x, hiddens = jax.lax.scan(layer_step, x, params["layers"])
+    if cfg.feature_layer in (-1, -2):
+        # LLaVA selection: hidden_states[-1] is the final layer output,
+        # [-2] the input to the last layer; no post-LN, CLS dropped.
+        if cfg.feature_layer == -2:
+            x = hiddens[-1]
+        x = x[:, 1:] if cfg.cls_token else x
+    else:
+        x = _ln(x, params["ln_f"], eps=cfg.ln_eps, b=params.get("ln_f_b"))
+    y = x @ params["proj1"]
+    if "b_proj1" in params:
+        y = y + params["b_proj1"]
+    # The LLaVA projector uses plain (exact) GELU regardless of the tower act.
+    y = jax.nn.gelu(y, approximate=False) @ params["proj2"]
+    if "b_proj2" in params:
+        y = y + params["b_proj2"]
+    return y
 
 
 def preprocess_image(data: bytes, cfg: VisionConfig) -> np.ndarray:
-    """Decode + resize + normalize one image -> [H, W, 3] float32 in [-1, 1]."""
+    """Decode + resize + normalize one image -> [H, W, 3] float32 using the
+    tower's per-channel statistics (CLIP stats for LLaVA towers)."""
     from PIL import Image
 
     img = Image.open(io.BytesIO(data)).convert("RGB").resize(
-        (cfg.image_size, cfg.image_size), Image.BILINEAR
+        (cfg.image_size, cfg.image_size), Image.BICUBIC if cfg.cls_token else Image.BILINEAR
     )
-    arr = np.asarray(img, np.float32) / 127.5 - 1.0
-    return arr
+    arr = np.asarray(img, np.float32) / 255.0
+    mean = np.asarray(cfg.image_mean, np.float32)
+    std = np.asarray(cfg.image_std, np.float32)
+    return (arr - mean) / std
 
 
 def decode_data_url(url: str) -> bytes:

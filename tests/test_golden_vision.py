@@ -1,0 +1,183 @@
+"""Golden-logit parity for the VLM path vs HF transformers LLaVA.
+
+Same technique as tests/test_golden.py (VERDICT r3 item 4): a tiny seeded
+HF LlavaForConditionalGeneration is saved as a real checkpoint, loaded
+through ``load_vlm`` (CLIP tower + projector + renamed-LM mapping), and an
+image request — pixel tensor through ``encode_image``, embeddings spliced
+over the placeholder tokens via ``llama.forward(mm_embeds=...)`` — must
+reproduce HF's logits. This pins: the conv->matmul patch embedding
+conversion, CLS/pre-LN/bias/quick_gelu CLIP semantics, the
+vision_feature_layer=-2 selection, projector mapping, the language_model
+weight-name translation, and placeholder substitution.
+
+Reference parity target: `examples/multimodal/components/encode_worker.py:61-179`
+(serves the HF tower; here the tower is first-party JAX).
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax.numpy as jnp  # noqa: E402
+
+from dynamo_tpu.models import llama  # noqa: E402
+from dynamo_tpu.models.loader import load_vlm  # noqa: E402
+from dynamo_tpu.models.vision import encode_image  # noqa: E402
+
+IMAGE_TOKEN = 250
+
+
+def _tiny_llava():
+    from transformers import CLIPVisionConfig, LlamaConfig, LlavaConfig, LlavaForConditionalGeneration
+
+    torch.manual_seed(0)
+    cfg = LlavaConfig(
+        vision_config=CLIPVisionConfig(
+            hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+            num_attention_heads=2, image_size=32, patch_size=8,
+        ),
+        text_config=LlamaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            head_dim=16, tie_word_embeddings=False, rope_theta=10000.0,
+        ),
+        image_token_index=IMAGE_TOKEN,
+    )
+    return LlavaForConditionalGeneration(cfg).eval().float()
+
+
+def test_golden_llava_image_logits(tmp_path):
+    m = _tiny_llava()
+    m.save_pretrained(str(tmp_path), safe_serialization=True)
+
+    tcfg, vcfg, lm_params, vis_params = load_vlm(tmp_path, dtype="float32")
+    assert tcfg.image_token_id == IMAGE_TOKEN
+    assert vcfg.cls_token and vcfg.pre_ln and vcfg.act == "quick_gelu"
+    n_img = vcfg.num_patches  # 16 placeholder tokens at 32px / patch 8
+
+    rng = np.random.default_rng(0)
+    pixels_hwc = rng.standard_normal((1, 32, 32, 3)).astype(np.float32) * 0.5
+    prompt = [3, 7] + [IMAGE_TOKEN] * n_img + [11, 42, 99, 5]
+    t = len(prompt)
+
+    # HF reference.
+    with torch.no_grad():
+        hf_logits = m(
+            input_ids=torch.tensor([prompt]),
+            pixel_values=torch.tensor(pixels_hwc.transpose(0, 3, 1, 2)),
+        ).logits[0].float().numpy()
+
+    # Ours: encode -> substitute -> paged forward.
+    mm = encode_image(vis_params, vcfg, jnp.asarray(pixels_hwc))
+    assert mm.shape == (1, n_img, tcfg.hidden_size)
+
+    page_size = 8
+    k_cache, v_cache = llama.init_kv_cache(tcfg, num_pages=8, page_size=page_size)
+    n_pages = -(-t // page_size)
+    tables = jnp.asarray([list(range(1, 1 + n_pages))], jnp.int32)
+    positions = jnp.arange(t, dtype=jnp.int32)[None]
+    slots = jnp.take_along_axis(tables, positions // page_size, axis=1) * page_size + positions % page_size
+    ours, k_cache, v_cache = llama.forward(
+        lm_params, tcfg, jnp.asarray([prompt], jnp.int32), positions,
+        k_cache, v_cache, tables, slots, jnp.asarray([t - 1], jnp.int32),
+        mm_embeds=mm,
+    )
+    # forward returns the LAST position's logits only ([B, V]).
+    np.testing.assert_allclose(
+        np.asarray(ours)[0], hf_logits[t - 1], atol=2e-3, rtol=1e-3,
+    )
+
+    # One decode step on the image-conditioned cache must also match.
+    tok = 42
+    pos = jnp.asarray([[t]], jnp.int32)
+    slot = jnp.take_along_axis(tables, pos // page_size, axis=1) * page_size + pos % page_size
+    ours2, _, _ = llama.forward(
+        lm_params, tcfg, jnp.asarray([[tok]], jnp.int32), pos,
+        k_cache, v_cache, tables, slot, jnp.asarray([0], jnp.int32),
+    )
+    with torch.no_grad():
+        hf2 = m(
+            input_ids=torch.tensor([prompt + [tok]]),
+            pixel_values=torch.tensor(pixels_hwc.transpose(0, 3, 1, 2)),
+        ).logits[0, -1].float().numpy()
+    np.testing.assert_allclose(np.asarray(ours2)[0], hf2, atol=2e-3, rtol=1e-3)
+
+
+def test_golden_llava_tower_alone(tmp_path):
+    """The tower+projector in isolation against HF's get_image_features —
+    localizes failures to vision vs LM."""
+    m = _tiny_llava()
+    m.save_pretrained(str(tmp_path), safe_serialization=True)
+    _tcfg, vcfg, _lm, vis_params = load_vlm(tmp_path, dtype="float32")
+
+    rng = np.random.default_rng(1)
+    pixels_hwc = rng.standard_normal((2, 32, 32, 3)).astype(np.float32) * 0.5
+    with torch.no_grad():
+        want = m.get_image_features(
+            pixel_values=torch.tensor(pixels_hwc.transpose(0, 3, 1, 2)),
+        )
+        if isinstance(want, (list, tuple)):
+            want = torch.cat([w[None] if w.ndim == 2 else w for w in want])
+        want = want.float().numpy()
+    got = np.asarray(encode_image(vis_params, vcfg, jnp.asarray(pixels_hwc)))
+    np.testing.assert_allclose(got.reshape(want.shape), want, atol=2e-4, rtol=1e-3)
+
+
+@pytest.mark.e2e
+async def test_real_vlm_checkpoint_served_e2e(tmp_path):
+    """A real (tiny, seeded) LLaVA checkpoint DIRECTORY served through the
+    full HTTP stack: loader -> real CLIP tower in the encode worker ->
+    placeholder splice -> prefill. Pixels must matter."""
+    import base64
+    import io
+
+    import aiohttp
+    from PIL import Image
+
+    from dynamo_tpu.launch import run_local
+
+    m = _tiny_llava()
+    m.save_pretrained(str(tmp_path), safe_serialization=True)
+    name = tmp_path.name
+
+    def data_url(color):
+        img = Image.new("RGB", (32, 32), color)
+        buf = io.BytesIO()
+        img.save(buf, format="PNG")
+        return "data:image/png;base64," + base64.b64encode(buf.getvalue()).decode()
+
+    handles = await run_local(str(tmp_path), port=0, num_pages=128, max_batch_size=4)
+    base = f"http://127.0.0.1:{handles['port']}"
+    try:
+        async def ask(color):
+            body = {
+                "model": name,
+                "messages": [{"role": "user", "content": [
+                    {"type": "text", "text": "what is this? "},
+                    {"type": "image_url", "image_url": {"url": data_url(color)}},
+                ]}],
+                "max_tokens": 6, "temperature": 0,
+            }
+            async with aiohttp.ClientSession() as s:
+                async with s.post(base + "/v1/chat/completions", json=body) as r:
+                    assert r.status == 200, await r.text()
+                    return await r.json()
+
+        red = await ask((255, 0, 0))
+        blue = await ask((0, 0, 255))
+        assert red["usage"]["prompt_tokens"] > 16  # placeholders accounted
+        assert red["choices"][0]["message"]["content"] != blue["choices"][0]["message"]["content"]
+
+        from dynamo_tpu.encode import EncodeService
+        enc = next(s for s in handles["services"] if isinstance(s, EncodeService))
+        assert enc.images_encoded == 2
+        # The REAL tower (CLS + CLIP semantics), not the random-init default.
+        assert enc.cfg.cls_token and enc.cfg.act == "quick_gelu"
+    finally:
+        await handles["http"].stop()
+        await handles["watcher"].close()
+        for svc in handles["services"]:
+            await svc.close()
+        await handles["runtime"].close()
