@@ -270,14 +270,30 @@ def probe_kv_pull_gbps() -> dict:
 def main() -> None:
     import jax
 
+    from dynamo_tpu.models.config import PRESETS
+
     suite = parse_suite()
     configs = []
     for entry in suite:
+        # MoE on the axon AOT toolchain: lax.ragged_dot crashes the compile
+        # helper at 64 experts and the capacity scatter->batched-matmul
+        # composition never finishes scheduling at decode shapes; the dense
+        # decode formulation compiles and hits roofline (models/llama.py
+        # _mlp_moe). Opt MoE configs in automatically unless the caller set
+        # a dispatch explicitly.
+        preset_cfg = PRESETS.get(entry[0])
+        moe_env = (preset_cfg is not None and preset_cfg.is_moe
+                   and "DYNAMO_MOE_DISPATCH" not in os.environ)
+        if moe_env:
+            os.environ["DYNAMO_MOE_DISPATCH"] = "dense"
         try:
             configs.append(run_config(*entry))
         except Exception as e:  # OOM or compile failure: record, continue
             configs.append({"preset": entry[0], "quant": entry[1] or "bf16",
                             "error": f"{type(e).__name__}: {e}"[:300]})
+        finally:
+            if moe_env:
+                del os.environ["DYNAMO_MOE_DISPATCH"]
         gc.collect()
     try:
         pull = probe_kv_pull_gbps()

@@ -328,6 +328,47 @@ def _quantize_q4_0(arr: np.ndarray) -> bytes:
     return rec.tobytes()
 
 
+def _quantize_q4_k(arr: np.ndarray) -> bytes:
+    """Q4_K encoder: 256-elem superblocks, 8 sub-blocks of 32 with 6-bit
+    quantized (scale, min) pairs against f16 super-scales — the exact
+    layout ``_dequant``'s Q4_K branch (and ggml) decodes:
+    ``x ≈ d*sc*q - dmin*mn`` with q in [0, 15]."""
+    flat = np.ascontiguousarray(arr, dtype=np.float32).reshape(-1, 8, 32)
+    nb = flat.shape[0]
+    lo = flat.min(axis=2)  # [nb, 8]
+    hi = flat.max(axis=2)
+    mins = np.maximum(0.0, -lo)  # positive offset subtracted at decode
+    scales = np.maximum(hi + mins, 1e-30) / 15.0
+    d = (scales.max(axis=1) / 63.0).astype("<f2").astype(np.float32)  # [nb]
+    dmin = (mins.max(axis=1) / 63.0).astype("<f2").astype(np.float32)
+    inv_d = np.where(d > 0, 1.0 / np.where(d == 0, 1, d), 0.0)
+    inv_dm = np.where(dmin > 0, 1.0 / np.where(dmin == 0, 1, dmin), 0.0)
+    sc = np.clip(np.rint(scales * inv_d[:, None]), 0, 63).astype(np.uint8)
+    mn = np.clip(np.rint(mins * inv_dm[:, None]), 0, 63).astype(np.uint8)
+    eff_scale = d[:, None] * sc  # [nb, 8]
+    eff_min = dmin[:, None] * mn
+    denom = np.where(eff_scale > 0, eff_scale, 1.0)
+    q = np.clip(
+        np.rint((flat + eff_min[:, :, None]) / denom[:, :, None]), 0, 15
+    ).astype(np.uint8)
+    # Pack 6-bit (sc, mn): inverse of _k_scale_min.
+    packed = np.empty((nb, 12), np.uint8)
+    packed[:, 0:4] = (sc[:, :4] & 63) | ((sc[:, 4:] >> 4) << 6)
+    packed[:, 4:8] = (mn[:, :4] & 63) | ((mn[:, 4:] >> 4) << 6)
+    packed[:, 8:12] = (sc[:, 4:] & 0xF) | ((mn[:, 4:] & 0xF) << 4)
+    # Pack nibbles: chunk c holds sub-blocks (2c, 2c+1) as (low, high).
+    q4 = q.reshape(nb, 4, 2, 32)
+    qs = (q4[:, :, 0] | (q4[:, :, 1] << 4)).reshape(nb, 128)
+    rec = np.empty(nb, dtype=np.dtype(
+        [("d", "<f2"), ("dmin", "<f2"), ("scales", "u1", (12,)), ("qs", "u1", (_QK_K // 2,))]
+    ))
+    rec["d"] = d.astype("<f2")
+    rec["dmin"] = dmin.astype("<f2")
+    rec["scales"] = packed
+    rec["qs"] = qs
+    return rec.tobytes()
+
+
 def _quantize_q8_0(arr: np.ndarray) -> bytes:
     flat = np.ascontiguousarray(arr, dtype=np.float32).reshape(-1, _BLOCK)
     amax = np.abs(flat).max(axis=1)
@@ -410,11 +451,13 @@ def write_gguf(
         else:
             q = -1
         if q >= 0:
-            if q == GGML_Q4_1:
-                raise ValueError("writer supports Q8_0/Q4_0 quantization; Q4_1 is read-only")
+            if q in (GGML_Q4_1, GGML_Q5_K, GGML_Q6_K):
+                raise ValueError("writer supports Q8_0/Q4_0/Q4_K quantization; Q4_1/Q5_K/Q6_K are read-only")
             n = int(np.prod(arr.shape))
             if q in (GGML_Q8_0, GGML_Q4_0) and n % _BLOCK:
                 q = GGML_F16  # not blockable; fall back
+            if q == GGML_Q4_K and n % _QK_K:
+                q = GGML_F16  # superblocks need 256-elem multiples
             return q
         if arr.dtype == np.float16:
             return GGML_F16
@@ -433,6 +476,8 @@ def write_gguf(
             return _quantize_q8_0(arr)
         if t == GGML_Q4_0:
             return _quantize_q4_0(arr)
+        if t == GGML_Q4_K:
+            return _quantize_q4_k(arr)
         raise ValueError(f"writer does not support ggml type {t} (readable-only format)")
 
     blobs: list[tuple[str, tuple[int, ...], int, bytes]] = []
@@ -735,7 +780,7 @@ def save_params_gguf(
     cfg: ModelConfig,
     params: dict,
     *,
-    quant: int | None = None,
+    quant: dict[str, int] | int | None = None,
     tokenizer_metadata: dict[str, Any] | None = None,
 ) -> None:
     """Reverse mapping: params pytree -> GGUF file (tests / export tool)."""
@@ -822,7 +867,9 @@ def save_params_gguf(
     # Norm vectors and biases aren't blockable/meaningfully quantizable; apply
     # `quant` only to matrices.
     qmap: dict[str, int] | None = None
-    if quant is not None:
+    if isinstance(quant, dict):
+        qmap = {n: q for n, q in quant.items() if np.asarray(tensors[n]).ndim >= 2}
+    elif quant is not None:
         qmap = {n: quant for n, a in tensors.items() if np.asarray(a).ndim >= 2}
     write_gguf(path, md, {n: np.asarray(a, dtype=np.float32) for n, a in tensors.items()}, quant=qmap)
 

@@ -194,7 +194,6 @@ def encode_image(params: Params, cfg: VisionConfig, pixels: jnp.ndarray) -> jnp.
     scale = hd**-0.5
 
     def layer_step(x, lp):
-        x_in = x  # emitted below: hidden_states[i] = this layer's INPUT
         y = _ln(x, lp["ln1"], eps=cfg.ln_eps, b=lp.get("ln1_b"))
         qkv = y @ lp["wqkv"]
         if "bqkv" in lp:
@@ -214,14 +213,17 @@ def encode_image(params: Params, cfg: VisionConfig, pixels: jnp.ndarray) -> jnp.
         y = act(y) @ lp["w2"]
         if "b2" in lp:
             y = y + lp["b2"]
-        return x + y, x_in
+        return x + y, None
 
-    x, hiddens = jax.lax.scan(layer_step, x, params["layers"])
+    # LLaVA feature selection: [-2] is the input to the LAST layer, so skip
+    # that layer entirely (its output would be discarded) instead of running
+    # it and stacking every per-layer hidden state.
+    layer_tree = params["layers"]
+    if cfg.feature_layer == -2:
+        layer_tree = jax.tree.map(lambda a: a[:-1], layer_tree)
+    x, _ = jax.lax.scan(layer_step, x, layer_tree)
     if cfg.feature_layer in (-1, -2):
-        # LLaVA selection: hidden_states[-1] is the final layer output,
-        # [-2] the input to the last layer; no post-LN, CLS dropped.
-        if cfg.feature_layer == -2:
-            x = hiddens[-1]
+        # No post-LN; CLS dropped ("default" select strategy).
         x = x[:, 1:] if cfg.cls_token else x
     else:
         x = _ln(x, params["ln_f"], eps=cfg.ln_eps, b=params.get("ln_f_b"))
@@ -237,12 +239,26 @@ def encode_image(params: Params, cfg: VisionConfig, pixels: jnp.ndarray) -> jnp.
 
 def preprocess_image(data: bytes, cfg: VisionConfig) -> np.ndarray:
     """Decode + resize + normalize one image -> [H, W, 3] float32 using the
-    tower's per-channel statistics (CLIP stats for LLaVA towers)."""
+    tower's per-channel statistics.
+
+    CLIP towers follow HF's CLIPImageProcessor geometry — shortest edge to
+    image_size (bicubic), then CENTER CROP — so non-square photos produce
+    the same pixel tensor HF would (a squash-resize diverges everywhere
+    outside the center square). The plain tower keeps the original
+    squash-resize (its own historical contract)."""
     from PIL import Image
 
-    img = Image.open(io.BytesIO(data)).convert("RGB").resize(
-        (cfg.image_size, cfg.image_size), Image.BICUBIC if cfg.cls_token else Image.BILINEAR
-    )
+    img = Image.open(io.BytesIO(data)).convert("RGB")
+    if cfg.cls_token:  # CLIP geometry
+        w, h = img.size
+        scale = cfg.image_size / min(w, h)
+        img = img.resize((max(1, round(w * scale)), max(1, round(h * scale))), Image.BICUBIC)
+        w, h = img.size
+        left = (w - cfg.image_size) // 2
+        top = (h - cfg.image_size) // 2
+        img = img.crop((left, top, left + cfg.image_size, top + cfg.image_size))
+    else:
+        img = img.resize((cfg.image_size, cfg.image_size), Image.BILINEAR)
     arr = np.asarray(img, np.float32) / 255.0
     mean = np.asarray(cfg.image_mean, np.float32)
     std = np.asarray(cfg.image_std, np.float32)

@@ -179,9 +179,9 @@ def mla_paged_decode(
     b, n_heads, r_kv = q_lat.shape
     num_pages, page_size, _ = c_cache.shape
     pages_per_seq = block_tables.shape[1]
-    ppb = _pages_per_block(pages_per_seq, page_size)
-    bk = ppb * page_size
     dr = r_cache.shape[2]
+    ppb = _pages_per_block(pages_per_seq, page_size, r_kv + dr, c_cache.dtype.itemsize)
+    bk = ppb * page_size
 
     lengths = positions[:, 0] + 1
 

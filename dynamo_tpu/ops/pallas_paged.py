@@ -110,14 +110,27 @@ def interpret_mode() -> bool:
     return os.environ.get("DYNAMO_PALLAS_INTERPRET", "") == "1"
 
 
-def _pages_per_block(pages_per_seq: int, page_size: int) -> int:
-    """Pages per compute block: target ~1024 tokens per block.
+def _pages_per_block(
+    pages_per_seq: int, page_size: int, width: int | None = None, itemsize: int = 2
+) -> int:
+    """Pages per compute block: target ~1024 tokens per block, capped by the
+    kernel's scoped-VMEM budget.
 
     Deep blocks amortize the fori_loop/online-softmax overhead and batch
     more DMA issues per wait (measured +45% decode throughput vs 2-page
-    blocks at serving shapes). No divisibility requirement — the tail block
-    clamps its page indices and masks by length."""
+    blocks at serving shapes). But the double-buffered K+V tiles
+    (2 slots x 2 streams x bk x width) live in scoped VMEM with a hard
+    ~16 MiB limit — wide slabs (e.g. 16 kv-heads x 128 = 2048 lanes) blow
+    it at the 1024-token target (observed: OLMoE decode failing AOT
+    compile with "scoped vmem ... exceeded"), so when ``width`` is given
+    the block shrinks to keep the tiles within an 8 MiB budget. No
+    divisibility requirement — the tail block clamps its page indices and
+    masks by length."""
     target = max(1, 1024 // page_size)
+    if width is not None:
+        budget = 8 * 2**20
+        max_tokens = max(page_size, budget // (4 * width * itemsize))
+        target = min(target, max(1, max_tokens // page_size))
     return max(1, min(pages_per_seq, target))
 
 
@@ -283,7 +296,7 @@ def paged_decode_attention(
     n_kv = width // head_dim
     group = n_heads // n_kv
     pages_per_seq = block_tables.shape[1]
-    ppb = _pages_per_block(pages_per_seq, page_size)
+    ppb = _pages_per_block(pages_per_seq, page_size, width, k_cache.dtype.itemsize)
     bk = ppb * page_size
 
     kf, vf = k_cache, v_cache

@@ -32,6 +32,7 @@ the first-party TPU equivalent of that capability.
 from __future__ import annotations
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 
 from dynamo_tpu.models.quant import maybe_dequant as _dq
@@ -137,6 +138,31 @@ def expert_capacity(num_tokens: int, num_experts: int, k: int, capacity_factor: 
     c = int(num_tokens * k * capacity_factor / num_experts + 0.999)
     c = max(k, min(c, num_tokens * k))
     return -(-c // 8) * 8
+
+
+def moe_drop_stats(
+    lp: dict,
+    x: jnp.ndarray,  # [N, D] flattened tokens
+    *,
+    num_experts_per_token: int,
+    capacity_factor: float = 1.25,
+    capacity: int | None = None,
+    routing: dict | None = None,
+) -> tuple[int, int]:
+    """(total choices, dropped choices) for this batch under the capacity
+    dispatch's drop rule — the observability hook for drop rate (the
+    dispatch itself is pure jit; this recomputes routing on demand, so call
+    it on sampled batches, not the hot path)."""
+    n = x.shape[0]
+    e = lp["router"].shape[-1]
+    k = num_experts_per_token
+    c = capacity if capacity is not None else expert_capacity(n, e, k, capacity_factor)
+    _w, topi = route_tokens(lp, x, k=k, **(routing or {}))
+    flat_e = np.asarray(topi).reshape(-1)
+    oh = np.eye(e, dtype=np.int64)[flat_e]
+    pos = (np.cumsum(oh, axis=0) * oh).sum(-1) - 1
+    dropped = int((pos >= c).sum())
+    return n * k, dropped
 
 
 def moe_mlp(

@@ -126,3 +126,64 @@ def test_shared_expert_and_bias_forward():
     p2 = {**params, "layers": {**params["layers"], "w_shared_down": params["layers"]["w_shared_down"] * 0}}
     out2 = np.asarray(fwd(p2, cfg))
     assert not np.allclose(out, out2)
+
+
+def test_over_capacity_degrades_gracefully_exact():
+    """At over-capacity the output must equal a reference that applies the
+    SAME drop rule (token-major priority per expert): surviving choices keep
+    their exact routing weights, dropped choices contribute exactly zero —
+    not a renormalized or corrupted mix (VERDICT r3 weak #7)."""
+    from dynamo_tpu.parallel.moe import route_tokens
+
+    n, c = 32, 8  # force drops: balanced load would need N*k/E slots
+    x = _x(n, seed=5)
+    k = CFG.num_experts_per_token
+    got = np.asarray(moe_mlp(LP0, x, num_experts_per_token=k, capacity=c))
+
+    # Reference: dense per-(token, choice) expert outputs combined with the
+    # dispatch's drop rule re-derived independently.
+    weights, topi = route_tokens(LP0, x, k=k)
+    weights, topi = np.asarray(weights), np.asarray(topi)
+    e = LP0["router"].shape[-1]
+    seen = {ei: 0 for ei in range(e)}
+    expected = np.zeros((n, x.shape[-1]), np.float32)
+    dropped = 0
+    for t in range(n):
+        for j in range(k):
+            ei = int(topi[t, j])
+            if seen[ei] < c:
+                seen[ei] += 1
+                xe = np.asarray(_expert_forward(LP0, x[t : t + 1], ei))
+                expected[t] += weights[t, j] * xe[0]
+            else:
+                dropped += 1
+    assert dropped > 0, "test must actually exercise the drop path"
+    np.testing.assert_allclose(got, expected, rtol=2e-4, atol=2e-4)
+
+
+def _expert_forward(lp, xt, ei):
+    import jax.numpy as jnp
+
+    gate = jax.nn.silu(xt @ lp["w_gate"][ei])
+    up = xt @ lp["w_up"][ei]
+    return np.asarray((gate * up) @ lp["w_down"][ei], np.float32)
+
+
+def test_drop_fraction_estimator():
+    """moe_drop_stats: the serving-side observability hook for capacity
+    dispatch — reports (total choices, dropped) for a routing batch so
+    operators can alarm on drop rate without instrumenting the jit."""
+    from dynamo_tpu.parallel.moe import moe_drop_stats
+
+    x = _x(32, seed=6)
+    total, dropped = moe_drop_stats(
+        LP0, x, num_experts_per_token=CFG.num_experts_per_token, capacity=8
+    )
+    assert total == 32 * CFG.num_experts_per_token
+    assert 0 < dropped < total
+    # No-drop capacity reports zero.
+    total2, dropped2 = moe_drop_stats(
+        LP0, x, num_experts_per_token=CFG.num_experts_per_token,
+        capacity=32 * CFG.num_experts_per_token,
+    )
+    assert dropped2 == 0

@@ -60,7 +60,7 @@ def test_decode_kernel_tail_block_clamps():
     page_size, pages_per_seq = 16, 9
     # Force small blocks so multiple blocks + a ragged tail exist.
     orig = pp._pages_per_block
-    pp._pages_per_block = lambda pps, ps: 4  # bk=64; 9 pages -> 3 blocks, tail ragged
+    pp._pages_per_block = lambda pps, ps, *a: 4  # bk=64; 9 pages -> 3 blocks, tail ragged
     try:
         q, k, v, tables, positions = _random_case(
             rng, b=3, n_heads=8, n_kv=2, head_dim=64,
