@@ -258,12 +258,17 @@ def probe_kv_pull_gbps() -> dict:
     perm = jnp.asarray(np.random.default_rng(0).permutation(pages.shape[0]))
     shuffle = jax.jit(lambda x, p: x[p])
     shuffle(pages, perm).block_until_ready()  # compile
+    # Iterate INSIDE jit (single dispatch): per-call tunnel latency (~10 ms
+    # pipelined, ~100 ms cold) would otherwise dominate the measurement.
+    iters = 16
+    chain = jax.jit(lambda x, p: jax.lax.fori_loop(0, iters, lambda i, y: y[p], x))
+    chain(pages, perm).block_until_ready()  # compile
     t0 = time.perf_counter()
-    shuffle(pages, perm).block_until_ready()
+    chain(pages, perm).block_until_ready()
     dt = time.perf_counter() - t0
-    out.update(wire="in_process_page_gather",
+    out.update(wire="in_process_page_gather", iters=iters,
                transfer_engine="unsupported_on_this_plugin",
-               gbytes_per_sec=round(2 * stack.nbytes / dt / 1e9, 3))
+               gbytes_per_sec=round(2 * stack.nbytes * iters / dt / 1e9, 3))
     return out
 
 
