@@ -256,8 +256,6 @@ def probe_kv_pull_gbps() -> dict:
     # device_put can alias without copying, so it would overstate).
     pages = stack.reshape(-1, 128 * 1024 // 2)  # 128 KiB pages
     perm = jnp.asarray(np.random.default_rng(0).permutation(pages.shape[0]))
-    shuffle = jax.jit(lambda x, p: x[p])
-    shuffle(pages, perm).block_until_ready()  # compile
     # Iterate INSIDE jit (single dispatch): per-call tunnel latency (~10 ms
     # pipelined, ~100 ms cold) would otherwise dominate the measurement.
     iters = 16

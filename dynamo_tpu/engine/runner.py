@@ -166,7 +166,7 @@ class ModelRunner:
             from dynamo_tpu.parallel.sharding import cache_shardings, shard_params
 
             params = shard_params(params, mesh)
-            cs = cache_shardings(mesh)
+            cs = cache_shardings(mesh, cfg.attn_type)
             self.k_cache = jax.device_put(self.k_cache, cs)
             self.v_cache = jax.device_put(self.v_cache, cs)
             self._dp = int(mesh.shape["dp"])

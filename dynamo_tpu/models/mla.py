@@ -20,9 +20,11 @@ Paged-cache mapping — no engine changes needed:
 
 Both are ordinary ``[L, pages, page_size, W]`` arrays, so the allocator,
 prefix cache, tier offload, and disagg transfer treat MLA pages exactly
-like GQA pages. Attention itself uses the gather formulation (the latent
-cache is ~7x smaller than a GQA cache, so the gather's HBM cost is already
-below what the Pallas kernel saves on dense models).
+like GQA pages. Decode attention streams pages through the Pallas MLA
+kernel (``ops/pallas_mla.py`` — 6.2x the gather formulation on v5e);
+prefill and non-kernel geometries use the gather formulation. The 2D
+projections (w_kv_a, w_q*, wo_mla) are int8-quantizable like every other
+matmul weight.
 
 Parity: the MLA serving capability the reference gets from SGLang/vLLM's
 DeepSeek support (`examples/sglang`, BASELINE config #4).
@@ -34,6 +36,7 @@ import jax
 import jax.numpy as jnp
 
 from dynamo_tpu.models.config import ModelConfig
+from dynamo_tpu.models.quant import quant_matmul as _qmm
 from dynamo_tpu.ops.norm import rms_norm
 from dynamo_tpu.ops.rope import apply_rope
 
@@ -112,7 +115,7 @@ def mla_attention(
     r_kv, dn, dr, dv = cfg.kv_lora_rank, cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
 
     # -- latent + rope key, written through to the paged cache -------------
-    kv_a = h @ lp["w_kv_a"]  # [B, T, r_kv + dr]
+    kv_a = _qmm(h, lp["w_kv_a"])  # [B, T, r_kv + dr]
     c = rms_norm(kv_a[..., :r_kv], lp["kv_norm"], eps=cfg.rms_eps)
     k_rope = apply_rope(kv_a[..., None, r_kv:], positions, inv_freq)[:, :, 0]  # [B,T,dr]
 
@@ -133,10 +136,10 @@ def mla_attention(
 
     # -- queries, absorbed into latent space -------------------------------
     if "w_q_a" in lp:
-        q_a = rms_norm(h @ lp["w_q_a"], lp["q_norm"], eps=cfg.rms_eps)
-        q = (q_a @ lp["w_q_b"]).reshape(b, t, n_heads, dn + dr)
+        q_a = rms_norm(_qmm(h, lp["w_q_a"]), lp["q_norm"], eps=cfg.rms_eps)
+        q = _qmm(q_a, lp["w_q_b"]).reshape(b, t, n_heads, dn + dr)
     else:
-        q = (h @ lp["w_q"]).reshape(b, t, n_heads, dn + dr)
+        q = _qmm(h, lp["w_q"]).reshape(b, t, n_heads, dn + dr)
     q_nope, q_rope = q[..., :dn], q[..., dn:]
     q_rope = apply_rope(q_rope, positions, inv_freq)
     # absorb W_uk: scores live in latent space
@@ -155,23 +158,25 @@ def mla_attention(
             mesh, scale=scale,
         )  # [B, T, H, r_kv]
         out = jnp.einsum("bthr,rhv->bthv", out_lat.astype(h.dtype), lp["w_uv"])
-        return out.reshape(b, t, n_heads * dv) @ lp["wo_mla"], c_cache, r_cache
+        return _qmm(out.reshape(b, t, n_heads * dv), lp["wo_mla"]), c_cache, r_cache
 
     # -- decode: stream pages through the Pallas MLA kernel ----------------
     # The gather formulation below reads the latent cache ~4x per step
     # (gather write + score read + output read): measured 0.21x roofline at
-    # V3 MLA geometry. The kernel reads each page once (BENCH r04).
-    # Multi-chip meshes keep the gather formulation (GSPMD shards it); the
-    # kernel path is the single-chip serving hot loop.
+    # V3 MLA geometry. The kernel reads each page once (6.2x measured,
+    # BENCH r04). Under a mesh it runs per-device on the query-head shard
+    # against the replicated latent cache (shard_map — no collectives
+    # inside attention; see parallel/sharding.cache_shardings).
     if impl is None:
         from dynamo_tpu.ops.attention import default_impl
 
         impl = default_impl()
-    if t == 1 and impl == "pallas" and mesh is None:
+    if t == 1 and impl == "pallas":
         from dynamo_tpu.ops.pallas_mla import (
             interpret_mode,
             mla_decode_supported,
             mla_paged_decode,
+            mla_paged_decode_sharded,
         )
 
         if mla_decode_supported(r_kv, r_width):
@@ -179,13 +184,20 @@ def mla_attention(
             q_rope_k = q_rope[:, 0]
             if r_width != dr:  # match the lane-padded rope stream
                 q_rope_k = jnp.pad(q_rope_k, ((0, 0), (0, 0), (0, r_width - dr)))
-            out_lat = mla_paged_decode(
-                q_lat[:, 0], q_rope_k, c_cache, r_cache,
-                block_tables, positions,
-                scale=scale, interpret=interpret_mode(),
-            )[:, None]  # [B, 1, H, r_kv]
+            if mesh is None:
+                out_lat = mla_paged_decode(
+                    q_lat[:, 0], q_rope_k, c_cache, r_cache,
+                    block_tables, positions,
+                    scale=scale, interpret=interpret_mode(),
+                )[:, None]  # [B, 1, H, r_kv]
+            else:
+                out_lat = mla_paged_decode_sharded(
+                    q_lat[:, 0], q_rope_k, c_cache, r_cache,
+                    block_tables, positions,
+                    mesh=mesh, scale=scale, interpret=interpret_mode(),
+                )[:, None]
             out = jnp.einsum("bthr,rhv->bthv", out_lat.astype(h.dtype), lp["w_uv"])
-            return out.reshape(b, t, n_heads * dv) @ lp["wo_mla"], c_cache, r_cache
+            return _qmm(out.reshape(b, t, n_heads * dv), lp["wo_mla"]), c_cache, r_cache
 
     # -- gather this batch's pages and attend ------------------------------
     pages_per_seq = block_tables.shape[1]
@@ -207,7 +219,7 @@ def mla_attention(
         "bhts,bsr->bthr", probs.astype(c_pages.dtype), c_pages, preferred_element_type=jnp.float32
     )  # [B, T, H, r_kv]
     out = jnp.einsum("bthr,rhv->bthv", out_lat.astype(h.dtype), lp["w_uv"])  # [B,T,H,dv]
-    return out.reshape(b, t, n_heads * dv) @ lp["wo_mla"], c_cache, r_cache
+    return _qmm(out.reshape(b, t, n_heads * dv), lp["wo_mla"]), c_cache, r_cache
 
 
 def mla_attention_naive(
@@ -225,17 +237,17 @@ def mla_attention_naive(
     n_heads = cfg.num_heads
     r_kv, dn, dr, dv = cfg.kv_lora_rank, cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
 
-    kv_a = h @ lp["w_kv_a"]
+    kv_a = _qmm(h, lp["w_kv_a"])
     c = rms_norm(kv_a[..., :r_kv], lp["kv_norm"], eps=cfg.rms_eps)
     k_rope = apply_rope(kv_a[..., None, r_kv:], positions, inv_freq)  # [B,T,1,dr]
     k_nope = jnp.einsum("btr,rhn->bthn", c, lp["w_uk"])  # [B,T,H,dn]
     v = jnp.einsum("btr,rhv->bthv", c, lp["w_uv"])  # [B,T,H,dv]
 
     if "w_q_a" in lp:
-        q_a = rms_norm(h @ lp["w_q_a"], lp["q_norm"], eps=cfg.rms_eps)
-        q = (q_a @ lp["w_q_b"]).reshape(b, t, n_heads, dn + dr)
+        q_a = rms_norm(_qmm(h, lp["w_q_a"]), lp["q_norm"], eps=cfg.rms_eps)
+        q = _qmm(q_a, lp["w_q_b"]).reshape(b, t, n_heads, dn + dr)
     else:
-        q = (h @ lp["w_q"]).reshape(b, t, n_heads, dn + dr)
+        q = _qmm(h, lp["w_q"]).reshape(b, t, n_heads, dn + dr)
     q_nope, q_rope = q[..., :dn], q[..., dn:]
     q_rope = apply_rope(q_rope, positions, inv_freq)
 
@@ -247,4 +259,4 @@ def mla_attention_naive(
     logits = jnp.where(mask[:, None, :, :], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhts,bshv->bthv", probs.astype(v.dtype), v, preferred_element_type=jnp.float32)
-    return out.astype(h.dtype).reshape(b, t, n_heads * dv) @ lp["wo_mla"]
+    return _qmm(out.astype(h.dtype).reshape(b, t, n_heads * dv), lp["wo_mla"])

@@ -33,6 +33,10 @@ _MATMUL_LEAVES = frozenset(
         "w_gate", "w_up", "w_down",
         "w_shared_gate", "w_shared_up", "w_shared_down",
         "lm_head",
+        # MLA 2D projections (models/mla.py) — ~95% of its attention weight
+        # bytes. The absorbed per-head tensors (w_uk/w_uv, 3-axis einsums)
+        # stay bf16: their contraction axis is not the stored-scale axis.
+        "w_q_a", "w_q_b", "w_q", "w_kv_a", "wo_mla",
     }
 )
 

@@ -231,4 +231,42 @@ def mla_paged_decode(
     return out
 
 
+def mla_paged_decode_sharded(
+    q_lat: jnp.ndarray,  # [B, n_heads, r_kv]
+    q_rope: jnp.ndarray,  # [B, n_heads, r_width]
+    c_cache: jnp.ndarray,
+    r_cache: jnp.ndarray,
+    block_tables: jnp.ndarray,
+    positions: jnp.ndarray,
+    *,
+    mesh,
+    scale: float,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """MLA decode kernel under a device mesh: tp shards the QUERY heads,
+    dp the batch; the latent/rope caches are replicated (MQA — every head
+    reads the same stream; `parallel/sharding.cache_shardings` places the
+    MLA cache replicated for exactly this reason). No collectives inside:
+    each device streams the full cache once for its head slice — the same
+    total HBM traffic as single-chip, split across chips' own HBM copies."""
+    from jax.sharding import PartitionSpec as P
+
+    batch_axis = "dp" if "dp" in mesh.axis_names else None
+    tp_axis = "tp" if "tp" in mesh.axis_names else None
+    q_spec = P(batch_axis, tp_axis, None)
+    row_spec = P(batch_axis, None)
+
+    def body(ql, qr, cc, rc, bt, pos):
+        return mla_paged_decode(
+            ql, qr, cc, rc, bt, pos, scale=scale, interpret=interpret
+        )
+
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(q_spec, q_spec, P(), P(), row_spec, row_spec),
+        out_specs=q_spec,
+        check_vma=False,  # pallas out_shape carries no vma metadata
+    )(q_lat, q_rope, c_cache, r_cache, block_tables, positions)
+
+
 from dynamo_tpu.ops.pallas_paged import interpret_mode  # noqa: E402  (shared flag)

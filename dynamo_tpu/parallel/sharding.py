@@ -87,10 +87,20 @@ def param_shardings(mesh: Mesh, params: dict[str, Any]) -> dict[str, Any]:
     return walk(params, ())
 
 
-def cache_shardings(mesh: Mesh) -> NamedSharding:
-    """Paged KV cache [L, pages, ps, n_kv*hd]: shard the head-major flattened
-    KV-head dim on tp (head h occupies [h*hd, (h+1)*hd), so a tp-split is a
-    contiguous block of whole heads, matching the k/v projection sharding)."""
+def cache_shardings(mesh: Mesh, attn_type: str = "gqa") -> NamedSharding:
+    """Paged KV cache [L, pages, ps, W] placement.
+
+    GQA: shard the head-major flattened KV-head dim on tp (head h occupies
+    [h*hd, (h+1)*hd), so a tp-split is a contiguous block of whole heads,
+    matching the k/v projection sharding).
+
+    MLA: REPLICATE. The latent stream is shared by every query head (MQA) —
+    a width split would slice latent channels and force per-layer
+    collectives inside attention. Replication is what DeepSeek TP serving
+    does everywhere: the latent cache is ~7-25x smaller than an equivalent
+    GQA cache, so one copy per tp rank still beats a sharded GQA cache."""
+    if attn_type == "mla":
+        return NamedSharding(mesh, P())
     return NamedSharding(mesh, P(None, None, None, "tp"))
 
 

@@ -108,3 +108,46 @@ def test_full_mla_forward_kernel_vs_gather(monkeypatch):
     np.testing.assert_allclose(
         decode("pallas"), decode("reference"), rtol=2e-2, atol=2e-2
     )
+
+
+def test_mla_kernel_under_tp_mesh(monkeypatch):
+    """MLA decode kernel via shard_map on a (dp x tp) mesh: query heads
+    shard, the latent cache replicates (MQA), output matches the
+    single-device gather formulation."""
+    from dynamo_tpu.ops.pallas_mla import mla_paged_decode_sharded
+    from dynamo_tpu.parallel.mesh import MeshPlan, make_mesh
+
+    rng = np.random.default_rng(2)
+    b, page_size, pages_per_seq = 4, 8, 3
+    r_kv, dr = CFG.kv_lora_rank, CFG.qk_rope_head_dim
+    n_heads = CFG.num_heads  # 4: splits over tp=2
+    num_pages = 1 + b * pages_per_seq
+    c_cache = jnp.asarray(rng.standard_normal((num_pages, page_size, r_kv)), jnp.float32)
+    r_cache = jnp.asarray(rng.standard_normal((num_pages, page_size, dr)), jnp.float32)
+    tables = jnp.asarray(
+        [[1 + i * pages_per_seq + j for j in range(pages_per_seq)] for i in range(b)],
+        jnp.int32,
+    )
+    positions = jnp.asarray([[5], [11], [17], [23]], jnp.int32)
+    q_lat = jnp.asarray(rng.standard_normal((b, n_heads, r_kv)), jnp.float32)
+    q_rope = jnp.asarray(rng.standard_normal((b, n_heads, dr)), jnp.float32)
+    scale = (CFG.qk_nope_head_dim + dr) ** -0.5
+
+    mesh = make_mesh(MeshPlan(dp=2, tp=2), jax.devices()[:4])
+    got = mla_paged_decode_sharded(
+        q_lat, q_rope, c_cache, r_cache, tables, positions,
+        mesh=mesh, scale=scale, interpret=True,
+    )
+
+    s = pages_per_seq * page_size
+    c_pages = c_cache[tables.reshape(-1)].reshape(b, s, r_kv)
+    r_pages = r_cache[tables.reshape(-1)].reshape(b, s, dr)
+    logits = (
+        jnp.einsum("bhr,bsr->bhs", q_lat, c_pages)
+        + jnp.einsum("bhr,bsr->bhs", q_rope, r_pages)
+    ) * scale
+    key_pos = jnp.arange(s)[None, None, :]
+    logits = jnp.where(key_pos <= positions[:, None, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    want = jnp.einsum("bhs,bsr->bhr", probs, c_pages)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-2, atol=2e-2)
