@@ -56,6 +56,9 @@ class ModelConfig:
     first_k_dense: int = 0
     # Biases on q/k/v projections (Qwen2 family).
     attention_bias: bool = False
+    # Q/K RMS-norm before rope: "" (none), "head" (per-head over head_dim —
+    # Qwen3), "flat" (over the full projection width — OLMoE).
+    qk_norm: str = ""
     # Multimodal: the placeholder token id image embeddings substitute for
     # (None = text-only model); vision tower geometry lives in VisionConfig.
     image_token_id: int | None = None
@@ -180,6 +183,9 @@ class ModelConfig:
             ),
             first_k_dense=0 if all_dense else first_dense,
             attention_bias=bool(config.get("attention_bias", config.get("model_type") in ("qwen2", "qwen2_moe"))),
+            qk_norm={"qwen3": "head", "qwen3_moe": "head", "olmoe": "flat"}.get(
+                config.get("model_type", ""), ""
+            ),
             # DeepSeek-V2/V3: MLA signalled by the latent-rank keys.
             attn_type="mla" if config.get("kv_lora_rank") else "gqa",
             q_lora_rank=config.get("q_lora_rank") or 0,
@@ -322,7 +328,7 @@ PRESETS: dict[str, ModelConfig] = {
         num_layers=16, num_heads=16, num_kv_heads=16, head_dim=128,
         intermediate_size=1024, rope_theta=10000.0, max_position=4096,
         num_experts=64, num_experts_per_token=8, moe_intermediate_size=1024,
-        moe_scoring="softmax", moe_norm_topk=True,
+        moe_scoring="softmax", moe_norm_topk=True, qk_norm="flat",
     ),
     # MLA throughput proxy at 8B-class scale: DeepSeek-V3's per-layer MLA
     # geometry (kv_lora 512 + rope 64 latent cache, absorbed projections)

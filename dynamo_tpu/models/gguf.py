@@ -589,6 +589,18 @@ def config_from_gguf(reader: GGUFReader, *, name: str | None = None) -> ModelCon
         shared_expert_size=shared_ffn,
         shared_expert_gated="blk.0.ffn_gate_inp_shexp.weight" in reader.tensors,
         attention_bias="blk.0.attn_q.bias" in reader.tensors,
+        # Q/K RMS norms: present as blk.N.attn_{q,k}_norm.weight. The WIDTH
+        # distinguishes the style — per-head (Qwen3) vs full projection
+        # width (OLMoE) — so detection is shape-driven, not arch-name-driven.
+        qk_norm=(
+            ""
+            if "blk.0.attn_q_norm.weight" not in reader.tensors
+            else (
+                "head"
+                if reader.tensors["blk.0.attn_q_norm.weight"].shape[-1] == head_dim
+                else "flat"
+            )
+        ),
     )
 
 
@@ -746,6 +758,9 @@ def load_gguf_params(
     if cfg.attention_bias:
         for leaf, suffix in _GGUF_BIAS_MAP.items():
             layers[leaf] = stack(leaf, suffix, False)
+    if cfg.qk_norm:
+        layers["q_norm"] = stack("q_norm", "attn_q_norm.weight", False)
+        layers["k_norm"] = stack("k_norm", "attn_k_norm.weight", False)
     if cfg.is_moe:
         layers["router"] = stack("router", "ffn_gate_inp.weight", True)
         for leaf, suffix in _GGUF_MOE_MAP.items():
@@ -840,6 +855,9 @@ def save_params_gguf(
         "bk": rope_save_perm(cfg.num_kv_heads, cfg.head_dim, cfg.head_dim),
     }
     for li in range(cfg.num_layers):
+        if cfg.qk_norm:
+            tensors[f"blk.{li}.attn_q_norm.weight"] = np.ascontiguousarray(layers["q_norm"][li])
+            tensors[f"blk.{li}.attn_k_norm.weight"] = np.ascontiguousarray(layers["k_norm"][li])
         for leaf, (suffix, t) in _GGUF_LAYER_MAP.items():
             if leaf not in layers:
                 continue

@@ -60,6 +60,11 @@ def init_params(cfg: ModelConfig, rng: jax.Array | int = 0) -> Params:
             "attn_norm": jnp.ones((l, d), dt),
             "mlp_norm": jnp.ones((l, d), dt),
         }
+        if cfg.qk_norm:
+            qn = cfg.head_dim if cfg.qk_norm == "head" else cfg.q_dim
+            kn = cfg.head_dim if cfg.qk_norm == "head" else cfg.kv_dim
+            layers["q_norm"] = jnp.ones((l, qn), dt)
+            layers["k_norm"] = jnp.ones((l, kn), dt)
         if cfg.attn_type == "mla":
             from dynamo_tpu.models.mla import init_mla_params
 
@@ -363,9 +368,15 @@ def forward(
             qp, kp, vp = _qmm(h, lp["wq"]), _qmm(h, lp["wk"]), _qmm(h, lp["wv"])
             if cfg.attention_bias:
                 qp, kp, vp = qp + lp["bq"], kp + lp["bk"], vp + lp["bv"]
+            if cfg.qk_norm == "flat":  # OLMoE: norm the flat projection
+                qp = rms_norm(qp, lp["q_norm"], eps=cfg.rms_eps)
+                kp = rms_norm(kp, lp["k_norm"], eps=cfg.rms_eps)
             q = qp.reshape(b, t, cfg.num_heads, cfg.head_dim)
             k = kp.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
             v = vp.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+            if cfg.qk_norm == "head":  # Qwen3: per-head norm before rope
+                q = rms_norm(q, lp["q_norm"], eps=cfg.rms_eps)
+                k = rms_norm(k, lp["k_norm"], eps=cfg.rms_eps)
             q = apply_rope(q, positions, inv_freq)
             k = apply_rope(k, positions, inv_freq)
             if attn_mscale != 1.0:  # YaRN temperature: logits scale by mscale^2
@@ -463,8 +474,16 @@ def encode(
             qp, kp, vp = _qmm(h, lp["wq"]), _qmm(h, lp["wk"]), _qmm(h, lp["wv"])
             if cfg.attention_bias:
                 qp, kp, vp = qp + lp["bq"], kp + lp["bk"], vp + lp["bv"]
-            q = apply_rope(qp.reshape(b, t, cfg.num_heads, cfg.head_dim), positions, inv_freq)
-            k = apply_rope(kp.reshape(b, t, cfg.num_kv_heads, cfg.head_dim), positions, inv_freq)
+            if cfg.qk_norm == "flat":
+                qp = rms_norm(qp, lp["q_norm"], eps=cfg.rms_eps)
+                kp = rms_norm(kp, lp["k_norm"], eps=cfg.rms_eps)
+            qh = qp.reshape(b, t, cfg.num_heads, cfg.head_dim)
+            kh = kp.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+            if cfg.qk_norm == "head":
+                qh = rms_norm(qh, lp["q_norm"], eps=cfg.rms_eps)
+                kh = rms_norm(kh, lp["k_norm"], eps=cfg.rms_eps)
+            q = apply_rope(qh, positions, inv_freq)
+            k = apply_rope(kh, positions, inv_freq)
             if attn_mscale != 1.0:  # YaRN temperature: logits scale by mscale^2
                 q = q * jnp.asarray(attn_mscale, q.dtype)
             v = vp.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)

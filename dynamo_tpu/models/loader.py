@@ -350,6 +350,9 @@ def _leaf_specs(index: CheckpointIndex, cfg: ModelConfig, dtype: np.dtype) -> di
                 for name, (suffixes, t) in _LAYER_MAP.items()
                 if name not in ("w_gate", "w_up", "w_down")
             }
+            if cfg.qk_norm:  # Qwen3 (per-head) / OLMoE (flat) q/k RMS norms
+                layers["q_norm"] = simple(("self_attn.q_norm.weight",), False)
+                layers["k_norm"] = simple(("self_attn.k_norm.weight",), False)
         if cfg.attention_bias:
             for name, (suffixes, t) in _BIAS_MAP.items():
                 layers[name] = simple(suffixes, t)
@@ -702,6 +705,16 @@ def save_params(
     if cfg.rope_scaling:
         hf_cfg["rope_scaling"] = cfg.rope_scaling
     hf_cfg["attention_bias"] = cfg.attention_bias
+    if cfg.qk_norm:
+        # qk_norm is reconstructed from model_type at load (from_hf): pin
+        # the family whose modeling carries these norms so a save->load
+        # round-trip keeps them (head: Qwen3; flat: OLMoE).
+        if cfg.qk_norm == "head":
+            hf_cfg["model_type"] = "qwen3_moe" if cfg.is_moe else "qwen3"
+            hf_cfg["architectures"] = ["Qwen3MoeForCausalLM" if cfg.is_moe else "Qwen3ForCausalLM"]
+        else:
+            hf_cfg["model_type"] = "olmoe"
+            hf_cfg["architectures"] = ["OlmoeForCausalLM"]
     if cfg.attn_type == "mla":
         hf_cfg.update(
             model_type="deepseek_v3",
@@ -714,7 +727,8 @@ def save_params(
             rope_interleave=cfg.rope_interleave,
         )
     if cfg.is_moe:
-        if cfg.attn_type != "mla":  # MLA already pinned model_type deepseek_v3
+        if cfg.attn_type != "mla" and not cfg.qk_norm:
+            # MLA pinned deepseek_v3; qk_norm pinned qwen3_moe/olmoe above.
             hf_cfg["model_type"] = (
                 "qwen2_moe" if cfg.shared_expert_gated or not cfg.shared_expert_size else "deepseek_v2"
             )
@@ -762,6 +776,9 @@ def save_params(
                 if cfg.attn_type == "mla" and leaf in ("wq", "wk", "wv", "wo"):
                     continue
                 put(base + suffixes[0], lp[leaf][li], transpose)
+            if cfg.qk_norm and cfg.attn_type != "mla":
+                put(base + "self_attn.q_norm.weight", lp["q_norm"][li], False)
+                put(base + "self_attn.k_norm.weight", lp["k_norm"][li], False)
             if cfg.attn_type == "mla":
                 q_sperm = kv_sperm = None
                 if cfg.rope_interleave:

@@ -222,3 +222,41 @@ def test_golden_deepseek_mla_dense(tmp_path):
         rope_scaling=None, attention_bias=False,
     ))
     _assert_family_matches(m, tmp_path)
+
+
+def test_golden_qwen3_qk_norm(tmp_path):
+    """Qwen3: per-head Q/K RMS norm before rope (head_dim-wide weights)."""
+    from transformers import Qwen3Config, Qwen3ForCausalLM
+
+    torch.manual_seed(8)
+    m = Qwen3ForCausalLM(Qwen3Config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, tie_word_embeddings=False, rope_theta=1000000.0,
+    ))
+    # Random norm weights so the qk-norm path is load-bearing.
+    with torch.no_grad():
+        for layer in m.model.layers:
+            layer.self_attn.q_norm.weight.uniform_(0.5, 1.5)
+            layer.self_attn.k_norm.weight.uniform_(0.5, 1.5)
+    _assert_family_matches(m, tmp_path)
+
+
+def test_golden_olmoe_flat_qk_norm(tmp_path):
+    """OLMoE: flat Q/K RMS norm over the full projection width, plus its
+    64-expert top-8 softmax routing (norm_topk_prob=False) — the family the
+    on-chip MoE bench models."""
+    from transformers import OlmoeConfig, OlmoeForCausalLM
+
+    torch.manual_seed(9)
+    m = OlmoeForCausalLM(OlmoeConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_experts=8, num_experts_per_tok=2, norm_topk_prob=False,
+        tie_word_embeddings=False,
+    ))
+    with torch.no_grad():
+        for layer in m.model.layers:
+            layer.self_attn.q_norm.weight.uniform_(0.5, 1.5)
+            layer.self_attn.k_norm.weight.uniform_(0.5, 1.5)
+    _assert_family_matches(m, tmp_path)
