@@ -269,6 +269,22 @@ PRESETS: dict[str, ModelConfig] = {
         rope_theta=1000000.0, max_position=32768, rms_eps=1e-6,
         attention_bias=True,
     ),
+    # Qwen3-8B: per-head Q/K RMS norm, untied head, no attention bias.
+    "qwen3-8b": ModelConfig(
+        name="qwen3-8b", vocab_size=151936, hidden_size=4096, num_layers=36,
+        num_heads=32, num_kv_heads=8, head_dim=128, intermediate_size=12288,
+        rope_theta=1000000.0, max_position=40960, rms_eps=1e-6,
+        qk_norm="head",
+    ),
+    # Qwen3-30B-A3B: 128 experts / top-8 MoE with per-head qk-norm; needs
+    # ep>=2 on 16 GB chips (~30 GB int8).
+    "qwen3-30b-a3b": ModelConfig(
+        name="qwen3-30b-a3b", vocab_size=151936, hidden_size=2048, num_layers=48,
+        num_heads=32, num_kv_heads=4, head_dim=128, intermediate_size=6144,
+        rope_theta=1000000.0, max_position=40960, rms_eps=1e-6,
+        num_experts=128, num_experts_per_token=8, moe_intermediate_size=768,
+        moe_scoring="softmax", moe_norm_topk=True, qk_norm="head",
+    ),
     # Mixtral-8x7B: 8 routed experts / top-2, no shared expert.
     "mixtral-8x7b": ModelConfig(
         name="mixtral-8x7b", vocab_size=32000, hidden_size=4096, num_layers=32,
