@@ -59,6 +59,9 @@ class ModelConfig:
     # Q/K RMS-norm before rope: "" (none), "head" (per-head over head_dim —
     # Qwen3), "flat" (over the full projection width — OLMoE).
     qk_norm: str = ""
+    # Sliding-window attention (Mistral): queries attend to the last
+    # `sliding_window` positions only. 0 = full causal.
+    sliding_window: int = 0
     # Multimodal: the placeholder token id image embeddings substitute for
     # (None = text-only model); vision tower geometry lives in VisionConfig.
     image_token_id: int | None = None
@@ -186,6 +189,16 @@ class ModelConfig:
             qk_norm={"qwen3": "head", "qwen3_moe": "head", "olmoe": "flat"}.get(
                 config.get("model_type", ""), ""
             ),
+            # HF gates the window: Qwen2-family configs carry sliding_window
+            # together with use_sliding_window=false (full causal). Adopt the
+            # key only when the gate is on (absent = on, Mistral-style) AND
+            # it applies to every layer (max_window_layers partial-SWA is
+            # unsupported — full attention is the conservative fallback).
+            sliding_window=int(config.get("sliding_window") or 0)
+            if config.get("use_sliding_window", True)
+            and int(config.get("max_window_layers") or config["num_hidden_layers"])
+            >= config["num_hidden_layers"]
+            else 0,
             # DeepSeek-V2/V3: MLA signalled by the latent-rank keys.
             attn_type="mla" if config.get("kv_lora_rank") else "gqa",
             q_lora_rank=config.get("q_lora_rank") or 0,
@@ -268,6 +281,12 @@ PRESETS: dict[str, ModelConfig] = {
         num_heads=28, num_kv_heads=4, head_dim=128, intermediate_size=18944,
         rope_theta=1000000.0, max_position=32768, rms_eps=1e-6,
         attention_bias=True,
+    ),
+    # Mistral-7B-v0.1: Llama architecture + 4096-token sliding window.
+    "mistral-7b": ModelConfig(
+        name="mistral-7b", vocab_size=32000, hidden_size=4096, num_layers=32,
+        num_heads=32, num_kv_heads=8, head_dim=128, intermediate_size=14336,
+        rope_theta=10000.0, max_position=32768, sliding_window=4096,
     ),
     # Qwen3-8B: per-head Q/K RMS norm, untied head, no attention bias.
     "qwen3-8b": ModelConfig(

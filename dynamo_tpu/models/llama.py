@@ -332,6 +332,13 @@ def forward(
 
         attn_impl = default_impl()
     ring = attn_impl == "ring"
+    if ring and cfg.sliding_window > 0:
+        # Ring attention computes full causal attention over the sp axis;
+        # silently serving a windowed model through it would change logits.
+        raise ValueError(
+            "ring attention does not implement sliding-window masking; "
+            "serve SWA models with the paged path (no sp axis)"
+        )
     if ring:
         # Padding tokens (slot 0) must not act as attendable keys in the ring
         # path (the paged path excludes them structurally via the null page).
@@ -388,7 +395,12 @@ def forward(
                 attn = ring_attention(q, k, v, ring_pos, mesh, scale=cfg.head_dim**-0.5)
             else:
                 tables_l = block_tables + li * npages
-                if attn_impl == "pallas" and mesh is not None:
+                if cfg.sliding_window > 0:
+                    attn = paged_attention(
+                        q, k_full, v_full, tables_l, positions,
+                        impl=attn_impl, sliding_window=cfg.sliding_window,
+                    )
+                elif attn_impl == "pallas" and mesh is not None:
                     # Explicit tp/dp layout around the kernel: GSPMD would
                     # otherwise all-gather the cache and replicate the
                     # pallas_call on every device.
@@ -463,6 +475,10 @@ def encode(
     x = params["embed"][tokens]  # [B, T, D]
 
     causal = jnp.tril(jnp.ones((t, t), bool))
+    if cfg.sliding_window > 0:
+        causal = causal & (
+            jnp.arange(t)[None, :] > jnp.arange(t)[:, None] - cfg.sliding_window
+        )
     attendable = causal[None, :, :] & mask[:, None, :]  # [B, Tq, Tk]
     bias = jnp.where(attendable, 0.0, -jnp.inf).astype(jnp.float32)[:, None, :, :]
     groups = cfg.num_heads // cfg.num_kv_heads

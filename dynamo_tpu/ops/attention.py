@@ -62,6 +62,7 @@ def paged_attention_reference(
     positions: jnp.ndarray,  # i32[B, T] absolute position of each query token
     *,
     scale: float | None = None,
+    sliding_window: int = 0,  # >0: keys older than q_pos - (w-1) are masked
 ) -> jnp.ndarray:
     """Causal paged attention; returns [B, T, n_heads, head_dim].
 
@@ -89,6 +90,13 @@ def paged_attention_reference(
     logits = jnp.einsum("btkgd,bskd->bkgts", qg, k, preferred_element_type=jnp.float32)
     key_pos = jnp.arange(s, dtype=jnp.int32)
     mask = key_pos[None, None, :] <= positions[:, :, None]  # [B, T, S]
+    if sliding_window > 0:
+        # HF window semantics: a query at p attends to keys in
+        # [p - (w - 1), p] — the page pool still HOLDS older pages (parity
+        # with vLLM's non-rolled paged SWA); masking alone preserves exact
+        # logits. Out-of-window page reclamation is an allocator policy on
+        # top, not an attention change.
+        mask = mask & (key_pos[None, None, :] > positions[:, :, None] - sliding_window)
     logits = jnp.where(mask[:, None, None, :, :], logits, NEG_INF)
     weights = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bkgts,bskd->btkgd", weights.astype(v.dtype), v, preferred_element_type=jnp.float32)
@@ -143,6 +151,7 @@ def paged_attention(
     scale: float | None = None,
     impl: str | None = None,
     contiguous_positions: bool = True,
+    sliding_window: int = 0,
 ) -> jnp.ndarray:
     """Backend-dispatching paged attention (see module docstring).
 
@@ -156,9 +165,22 @@ def paged_attention(
         scale = q.shape[-1] ** -0.5
     if impl is None:
         impl = default_impl()
-    if impl == "reference":
-        # Callers are usually already inside jit; skip the extra dispatch wrapper.
-        return paged_attention_reference(q, k_cache, v_cache, block_tables, positions, scale=scale)
+    if impl == "reference" or sliding_window > 0:
+        if sliding_window > 0 and impl == "pallas":
+            # Make the downgrade VISIBLE: an operator asking for the kernel
+            # gets the reference formulation until a windowed kernel
+            # variant exists (counted + one-time warned like every other
+            # kernel fallback; exported at /metrics).
+            from dynamo_tpu.ops.pallas_paged import _record_fallback
+
+            _record_fallback("sliding_window", q, k_cache)
+        # SWA uses the reference formulation: the Pallas kernels derive
+        # causality from block walks that assume a full prefix (windowed
+        # block skipping is a future kernel variant).
+        return paged_attention_reference(
+            q, k_cache, v_cache, block_tables, positions, scale=scale,
+            sliding_window=sliding_window,
+        )
     from dynamo_tpu.ops.pallas_paged import paged_attention_pallas
 
     return paged_attention_pallas(

@@ -260,3 +260,22 @@ def test_golden_olmoe_flat_qk_norm(tmp_path):
             layer.self_attn.q_norm.weight.uniform_(0.5, 1.5)
             layer.self_attn.k_norm.weight.uniform_(0.5, 1.5)
     _assert_family_matches(m, tmp_path)
+
+
+def test_golden_mistral_sliding_window(tmp_path):
+    """Mistral: sliding-window attention with a window SHORTER than the
+    prompt (w=4 < 8 tokens), so the windowed mask is load-bearing — full
+    causal attention would produce different logits."""
+    from transformers import MistralConfig, MistralForCausalLM
+
+    torch.manual_seed(10)
+    m = MistralForCausalLM(MistralConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, sliding_window=4, tie_word_embeddings=False,
+        rope_theta=10000.0,
+    ))
+    _assert_family_matches(m, tmp_path)
+    from dynamo_tpu.models.config import ModelConfig
+
+    assert ModelConfig.from_hf(tmp_path / "config.json").sliding_window == 4
