@@ -29,7 +29,9 @@ Also probes the device-path KV pull bandwidth (loopback
 wire; falls back to the in-process gather→put→scatter path where the PJRT
 plugin lacks the transfer engine).
 
-Prints exactly ONE JSON line; the headline metric/value is the 1B config
+Prints a cumulative JSON snapshot line after every config (a driver
+timeout mid-suite still leaves a parseable last line) and the final line
+after the KV-pull probe; the headline metric/value is the 1B config
 (continuity with BENCH_r01..r03), with every config under detail.configs.
 """
 
@@ -275,6 +277,25 @@ def main() -> None:
 
     from dynamo_tpu.models.config import PRESETS
 
+    def emit(configs, pull):
+        head = next((c for c in configs if c.get("preset") == "llama-3.2-1b"
+                     and "error" not in c), None) or \
+            next((c for c in configs if "error" not in c), {})
+        print(json.dumps({
+            "metric": "output_tokens_per_sec_per_chip",
+            "value": head.get("tok_per_sec", 0.0),
+            "unit": "tok/s",
+            "vs_baseline": round(head.get("tok_per_sec", 0.0) / HEADLINE_TARGET, 4),
+            "detail": {
+                "backend": jax.default_backend(),
+                "suite": [c.get("preset") for c in configs],
+                "configs": configs,
+                "kv_pull": pull,
+                "ttft_note": "ttft_idle_* is the drained-engine best case; "
+                             "under-load TTFT: bench/results pareto artifacts",
+            },
+        }), flush=True)
+
     suite = parse_suite()
     configs = []
     for entry in suite:
@@ -298,28 +319,15 @@ def main() -> None:
             if moe_env:
                 del os.environ["DYNAMO_MOE_DISPATCH"]
         gc.collect()
+        # Cumulative snapshot after EVERY config: if a driver timeout kills
+        # the suite mid-run, the last stdout line still parses with every
+        # config completed so far.
+        emit(configs, {"pending": True})
     try:
         pull = probe_kv_pull_gbps()
     except Exception as e:
         pull = {"error": f"{type(e).__name__}: {e}"[:200]}
-
-    head = next((c for c in configs if c.get("preset") == "llama-3.2-1b"
-                 and "error" not in c), None) or \
-        next((c for c in configs if "error" not in c), {})
-    print(json.dumps({
-        "metric": "output_tokens_per_sec_per_chip",
-        "value": head.get("tok_per_sec", 0.0),
-        "unit": "tok/s",
-        "vs_baseline": round(head.get("tok_per_sec", 0.0) / HEADLINE_TARGET, 4),
-        "detail": {
-            "backend": jax.default_backend(),
-            "suite": [c.get("preset") for c in configs],
-            "configs": configs,
-            "kv_pull": pull,
-            "ttft_note": "ttft_idle_* is the drained-engine best case; "
-                         "under-load TTFT: bench/results pareto artifacts",
-        },
-    }))
+    emit(configs, pull)
 
 
 if __name__ == "__main__":
