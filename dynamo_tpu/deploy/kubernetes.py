@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import json
 import logging
+import re
 from typing import Any
 
 import aiohttp
@@ -61,7 +62,13 @@ def validate_manifest(doc: dict[str, Any]) -> None:
     if kind not in _API:
         raise ManifestError(f"unsupported kind {kind!r}")
     name = doc["metadata"].get("name", "")
-    if not name or len(name) > 253 or name.strip("abcdefghijklmnopqrstuvwxyz0123456789.-"):
+    # DNS-1123 subdomain rule, per dot-separated label: alphanumeric ends,
+    # label <= 63 chars (a strip()-based check accepted '-svc' / 'svc.' /
+    # 'a..b', which the API server rejects, ADVICE r4).
+    label = r"[a-z0-9]([-a-z0-9]*[a-z0-9])?"
+    if (not name or len(name) > 253
+            or not re.fullmatch(rf"{label}(\.{label})*", name)
+            or any(len(part) > 63 for part in name.split("."))):
         raise ManifestError(f"{kind}: invalid DNS-1123 name {name!r}")
     if doc["metadata"].get("labels", {}).get(DEPLOYMENT_LABEL) is None:
         raise ManifestError(f"{kind}/{name}: missing {DEPLOYMENT_LABEL} label (deletion selector)")

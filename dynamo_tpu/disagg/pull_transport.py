@@ -82,27 +82,50 @@ class JaxPullTransport:
             self._offers[uuid] = list(arrays)
         server.await_pull(uuid, list(arrays))
 
+    #: How long a loopback drain may run before we stop waiting for it.
+    DRAIN_TIMEOUT = 10.0
+
     def finish_offer(self, uuid: int, consumed: bool = True) -> None:
         """Release an offer. ``consumed=False`` means the receiver never
         pulled it — TransferServer has no cancel/deregister API (jax 0.9),
         and an un-pulled offer pins the staged device buffers forever, so we
         drain it ourselves with a loopback self-pull (the same mechanism the
-        capability probe uses) to make the server release them."""
+        capability probe uses) to make the server release them.
+
+        ``consumed`` is inferred from the receiver's phase-2 reply, which can
+        be lost *after* a successful pull — in that case the drain would
+        re-pull a consumed one-shot offer and block forever. The drain
+        therefore runs on a daemon thread bounded by :attr:`DRAIN_TIMEOUT`:
+        on timeout we give up and log the (possible) buffer leak instead of
+        hanging the caller's executor thread (ADVICE r4)."""
         with _lock:
             arrays = self._offers.pop(uuid, None)
         if consumed or arrays is None:
             return
-        try:
-            import jax
 
-            specs = [
-                jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding)
-                for a in arrays
-            ]
-            for drained in self.pull(self.address(), uuid, specs):
-                drained.block_until_ready()
-        except Exception as e:
-            logger.warning("draining un-pulled offer %d failed: %s", uuid, e)
+        def _drain() -> None:
+            try:
+                import jax
+
+                specs = [
+                    jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding)
+                    for a in arrays
+                ]
+                for drained in self.pull(self.address(), uuid, specs):
+                    drained.block_until_ready()
+            except Exception as e:
+                logger.warning("draining un-pulled offer %d failed: %s", uuid, e)
+
+        t = threading.Thread(target=_drain, name=f"drain-offer-{uuid}", daemon=True)
+        t.start()
+        t.join(self.DRAIN_TIMEOUT)
+        if t.is_alive():
+            logger.warning(
+                "drain of offer %d still blocked after %.0fs (receiver likely "
+                "consumed it and the reply was lost); abandoning the drain — "
+                "staged buffers may stay pinned until process exit", uuid,
+                self.DRAIN_TIMEOUT,
+            )
 
     def pull(self, address: str, uuid: int, specs: Sequence[Any]) -> list:
         """Destination side: fetch staged arrays device-path (blocking —

@@ -79,10 +79,42 @@ class KvTransferService(AsyncEngine[Any, dict]):
         # request_id -> (pinned, staged, parents, t_monotonic): pages staged
         # by a pull_query, awaiting the matching pull (two-phase protocol).
         self._pending_pulls: dict[str, tuple[list[int], list, list, float]] = {}
+        self._sweeper: asyncio.Task | None = None
         self.blocks_received = 0
         self.bytes_received = 0
         self.transfer_seconds = 0.0
         self.device_path_blocks = 0
+
+    def start_sweeper(self, interval: float | None = None) -> "KvTransferService":
+        """Run :meth:`_sweep_pending_pulls` on a timer, so staging abandoned
+        by a dead sender is reclaimed even when no further transfer traffic
+        arrives (the in-band sweep in :meth:`generate` only fires on
+        interaction — ADVICE r4). Returns self so callers can register it
+        for ``close()``."""
+        interval = interval or self.PENDING_PULL_MAX_AGE / 4
+
+        async def _loop() -> None:
+            while True:
+                await asyncio.sleep(interval)
+                try:
+                    self._sweep_pending_pulls()
+                except Exception:
+                    # A sweep failure must not kill the task (or surface as a
+                    # stale exception out of close()) — the next tick retries.
+                    logger.exception("pending-pull sweep failed")
+
+        if self._sweeper is None:
+            self._sweeper = asyncio.create_task(_loop(), name="kv-transfer-sweeper")
+        return self
+
+    async def close(self) -> None:
+        if self._sweeper is not None:
+            self._sweeper.cancel()
+            try:
+                await self._sweeper
+            except asyncio.CancelledError:
+                pass
+            self._sweeper = None
 
     def stats(self) -> dict:
         gbps = (self.bytes_received / 1e9) / self.transfer_seconds if self.transfer_seconds else 0.0

@@ -286,6 +286,7 @@ async def serve_worker(
         from dynamo_tpu.disagg.transfer import KV_TRANSFER_ENDPOINT, KvTransferService
 
         transfer = KvTransferService(service.core)
+        service.aux.append(transfer.start_sweeper())
         t_inst = await component.endpoint(KV_TRANSFER_ENDPOINT).serve(
             transfer, metadata={"model": spec.card.name}, lease=lease
         )

@@ -75,14 +75,14 @@ def ring_attention_sharded(q, k, v, q_pos, kv_pos, *, axis_name: str, scale: flo
     b, tq, h, _ = q.shape
     hd_v = v.shape[-1]  # may differ from q/k (MLA: value = latent, k = latent+rope)
 
-    # pvary: mark the fresh accumulators as varying over every mapped axis
-    # (the ring axis, plus dp when the batch dim is sharded through the
-    # shard_map) so the fori_loop carry type matches the (device-varying)
-    # merged partials.
+    # pcast-to-varying: mark the fresh accumulators as varying over every
+    # mapped axis (the ring axis, plus dp when the batch dim is sharded
+    # through the shard_map) so the fori_loop carry type matches the
+    # (device-varying) merged partials.
     vary = tuple(vary_axes) if vary_axes else (axis_name,)
-    acc = jax.lax.pvary(jnp.zeros((b, tq, h, hd_v), jnp.float32), vary)
-    m = jax.lax.pvary(jnp.full((b, h, tq), NEG_INF, jnp.float32), vary)
-    l = jax.lax.pvary(jnp.zeros((b, h, tq), jnp.float32), vary)
+    acc = jax.lax.pcast(jnp.zeros((b, tq, h, hd_v), jnp.float32), vary, to="varying")
+    m = jax.lax.pcast(jnp.full((b, h, tq), NEG_INF, jnp.float32), vary, to="varying")
+    l = jax.lax.pcast(jnp.zeros((b, h, tq), jnp.float32), vary, to="varying")
 
     def ring_step(i, carry):
         acc, m, l, k_cur, v_cur, kv_pos_cur = carry

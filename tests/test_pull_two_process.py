@@ -14,8 +14,13 @@ runtime transport:
   must get ``None`` back (no gather, no offer) and the packed-bytes
   fallback must inject the chain — the fallback negotiation end to end.
 
-The real ``jax.experimental.transfer`` wire is exercised on hardware in
-``tests_tpu/test_on_device.py`` (loopback pull of cache pages).
+The real ``jax.experimental.transfer`` wire has NOT been exercised on any
+available hardware: the axon-tunneled v5e's PJRT plugin does not implement
+the transfer-engine API. ``bench.py``'s kv_pull probe attempts it on every
+hardware run and records the fallback
+(``"transfer_engine": "unsupported_on_this_plugin"`` in BENCH_r04) — the
+hardware numbers there are in-process page gathers plus the cross-process
+packed-bytes TCP wire, not a device-path pull.
 """
 
 import asyncio
