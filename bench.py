@@ -94,8 +94,7 @@ def kv_bytes_per_token(cfg, cache_itemsize: int = 2) -> int:
     the *physical* cache layout (rope stream lane-padded to 128 — a local
     re-derivation here under-counted the streamed bytes by ~10%, ADVICE r4).
     """
-    cfg_itemsize = 2 if cfg.dtype == "bfloat16" else 4
-    return cfg.kv_bytes_per_token() * cache_itemsize // cfg_itemsize
+    return cfg.kv_bytes_per_token(itemsize=cache_itemsize)
 
 
 def roofline_tok_per_sec(weight_bytes: int, cfg, batch: int, mean_ctx: int) -> float:

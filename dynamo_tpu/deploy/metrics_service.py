@@ -35,6 +35,8 @@ _GAUGES = (
 _COUNTERS = (
     ("prompt_tokens_total", "Prompt tokens processed"),
     ("generated_tokens_total", "Tokens generated"),
+    ("moe_choices_total", "MoE (token, choice) pairs routed through the capacity dispatch (incl. bucket padding)"),
+    ("moe_dropped_total", "MoE choices dropped for over-capacity (dispatch-level, incl. bucket padding)"),
 )
 
 

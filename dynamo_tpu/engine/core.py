@@ -709,7 +709,10 @@ class EngineCore:
     # -- bookkeeping -------------------------------------------------------
 
     def metrics(self) -> ForwardPassMetrics:
+        from dynamo_tpu.parallel.moe import DROP_COUNTER
+
         st = self.allocator.stats()
+        moe_choices, moe_dropped = DROP_COUNTER.snapshot()
         return ForwardPassMetrics(
             worker_id=self.config.worker_id,
             kv_active_blocks=st.active_pages,
@@ -720,4 +723,6 @@ class EngineCore:
             cache_hit_rate=st.hit_rate,
             prompt_tokens_total=self._prompt_tokens_total,
             generated_tokens_total=self._generated_tokens_total,
+            moe_choices_total=moe_choices,
+            moe_dropped_total=moe_dropped,
         )

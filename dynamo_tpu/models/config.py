@@ -92,10 +92,13 @@ class ModelConfig:
     def is_moe(self) -> bool:
         return self.num_experts > 0
 
-    def kv_bytes_per_token(self) -> int:
+    def kv_bytes_per_token(self, itemsize: int | None = None) -> int:
         """Bytes of KV cache per token across all layers (2 = K and V; MLA
-        caches one latent + rope key instead)."""
-        itemsize = 2 if self.dtype == "bfloat16" else 4
+        caches one latent + rope key instead). ``itemsize`` overrides the
+        dtype-derived cache element size (e.g. a bf16 cache for an f32
+        model)."""
+        if itemsize is None:
+            itemsize = 2 if self.dtype == "bfloat16" else 4
         if self.attn_type == "mla":
             # Physical bytes: the rope stream is padded to one 128-lane tile
             # (models/mla.py:mla_cache_widths — Mosaic DMA alignment).

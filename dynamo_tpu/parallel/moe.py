@@ -31,11 +31,117 @@ the first-party TPU equivalent of that capability.
 
 from __future__ import annotations
 
+import os
+import threading
+
 import jax
 import numpy as np
 import jax.numpy as jnp
 
 from dynamo_tpu.models.quant import maybe_dequant as _dq
+
+
+class _DropCounter:
+    """Process-wide cumulative (choices, drops) across every capacity
+    dispatch — the live counterpart of :func:`moe_drop_stats` (which
+    recomputes routing offline). Fed from inside the jitted dispatch via
+    ``jax.debug.callback`` (two scalars per MoE layer per step, async — no
+    device stall), read by ``EngineCore.metrics()`` into
+    ``ForwardPassMetrics.moe_*`` and from there onto the Prometheus plane
+    (`deploy/metrics_service.py`). Process-wide because the dispatch has no
+    engine identity; workers run one engine per process, so per-worker
+    series stay exact (a dual-engine test process sees the sum).
+
+    The dropless and dense dispatches never drop, so their zero is implicit.
+    On backends without host-callback support (axon tunnel) the counter
+    stays 0 — see :func:`_host_callback_supported`.
+
+    Counts are DISPATCH-level: the runner bucket-pads batch/time, and padded
+    rows route and occupy capacity slots like real ones, so ``choices``
+    includes them. The drop *rate* stays representative because
+    :func:`expert_capacity` is sized from the same padded N — padding
+    inflates numerator and denominator together, it does not mask real
+    drops.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.choices = 0
+        self.dropped = 0
+
+    def add(self, choices: int, dropped: int) -> None:
+        with self._lock:
+            self.choices += int(choices)
+            self.dropped += int(dropped)
+
+    def snapshot(self) -> tuple[int, int]:
+        with self._lock:
+            return self.choices, self.dropped
+
+    def reset(self) -> None:
+        with self._lock:
+            self.choices = 0
+            self.dropped = 0
+
+
+DROP_COUNTER = _DropCounter()
+
+
+_callback_ok: bool | None = None
+
+
+def _host_callback_supported() -> bool:
+    """Probe once whether the active backend implements host callbacks.
+
+    Not a given: the axon-tunneled v5e PJRT plugin raises UNIMPLEMENTED for
+    send/recv host callbacks (discovered by running the counter on it), so
+    the drop counter must degrade to disabled there instead of crashing the
+    first capacity-dispatch step."""
+    global _callback_ok
+    if _callback_ok is None:
+        # The first call usually happens while TRACING a model forward; a jit
+        # execution is illegal under an ambient trace, so probe on a fresh
+        # thread (no trace context — JAX traces are thread-local).
+        result: dict[str, object] = {}
+
+        def _probe() -> None:
+            try:
+                out = jax.jit(
+                    lambda x: (jax.debug.callback(lambda _v: None, x), x + 1)[1]
+                )(jnp.int32(0))
+                out.block_until_ready()
+                result["ok"] = True
+            except Exception as e:
+                result["ok"] = False
+                result["err"] = repr(e)
+
+        t = threading.Thread(target=_probe, name="moe-callback-probe")
+        t.start()
+        t.join()
+        _callback_ok = result.get("ok", False)
+        if not _callback_ok:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "backend rejects host callbacks (%s): MoE drop counters "
+                "disabled — moe_dropped_total will read 0 regardless of "
+                "drops; set DYNAMO_MOE_DROP_STATS=1 to force (and crash "
+                "loudly) if this backend should support them",
+                result.get("err", "probe thread died"),
+            )
+    return _callback_ok
+
+
+def _drop_stats_enabled() -> bool:
+    """DYNAMO_MOE_DROP_STATS=0 disables the in-dispatch counter, =1 forces
+    it (crashing loudly on backends without host callbacks); default is
+    on wherever the backend supports it."""
+    env = os.environ.get("DYNAMO_MOE_DROP_STATS", "")
+    if env == "0":
+        return False
+    if env == "1":
+        return True
+    return _host_callback_supported()
 
 
 def route_tokens(
@@ -193,6 +299,11 @@ def moe_mlp(
     pos = (jnp.cumsum(oh, axis=0) * oh).sum(-1) - 1  # [N*k]
     keep = pos < c
     slot = jnp.where(keep, pos, c)  # dropped choices land in a spill row
+
+    if _drop_stats_enabled():
+        jax.debug.callback(
+            DROP_COUNTER.add, jnp.int32(n * k), (~keep).sum().astype(jnp.int32)
+        )
 
     # Scatter tokens into expert buffers (+1 spill row, sliced off).
     xk = jnp.repeat(x, k, axis=0)  # [N*k, D] — choice j of token t at t*k+j

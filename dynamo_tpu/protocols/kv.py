@@ -93,6 +93,11 @@ class ForwardPassMetrics:
     # Cumulative counters for throughput accounting.
     prompt_tokens_total: int = 0
     generated_tokens_total: int = 0
+    # MoE capacity-dispatch routing: cumulative (token, choice) pairs seen
+    # and dropped for over-capacity (parallel/moe.py DROP_COUNTER). Zero for
+    # dense models and for the dropless/dense dispatches.
+    moe_choices_total: int = 0
+    moe_dropped_total: int = 0
 
     @property
     def cache_usage(self) -> float:
@@ -109,6 +114,8 @@ class ForwardPassMetrics:
             "cache_hit_rate": self.cache_hit_rate,
             "prompt_tokens_total": self.prompt_tokens_total,
             "generated_tokens_total": self.generated_tokens_total,
+            "moe_choices_total": self.moe_choices_total,
+            "moe_dropped_total": self.moe_dropped_total,
         }
 
     @classmethod
