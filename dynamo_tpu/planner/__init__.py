@@ -7,7 +7,7 @@ policies, pluggable load predictors, pre-profiled performance
 interpolation, and local/k8s connectors.
 
 - :mod:`dynamo_tpu.planner.predictor` — constant / moving-average / linear-
-  trend load predictors.
+  trend / seasonal load predictors.
 - :mod:`dynamo_tpu.planner.core` — pure decision logic (testable without a
   cluster): rates from the metrics plane -> target replica counts.
 - :mod:`dynamo_tpu.planner.connector` — applies targets: in-process worker
@@ -16,7 +16,13 @@ interpolation, and local/k8s connectors.
 
 from dynamo_tpu.planner.connector import LocalProcessConnector, PlannerLoop
 from dynamo_tpu.planner.core import Planner, PlannerConfig, WorkerProfile
-from dynamo_tpu.planner.predictor import ConstantPredictor, LinearTrendPredictor, MovingAveragePredictor
+from dynamo_tpu.planner.predictor import (
+    ConstantPredictor,
+    LinearTrendPredictor,
+    MovingAveragePredictor,
+    SeasonalPredictor,
+    make_predictor,
+)
 
 __all__ = [
     "Planner",
@@ -27,4 +33,6 @@ __all__ = [
     "ConstantPredictor",
     "MovingAveragePredictor",
     "LinearTrendPredictor",
+    "SeasonalPredictor",
+    "make_predictor",
 ]

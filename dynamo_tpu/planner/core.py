@@ -19,7 +19,7 @@ import logging
 from dataclasses import dataclass, field
 from typing import Mapping
 
-from dynamo_tpu.planner.predictor import LinearTrendPredictor
+from dynamo_tpu.planner.predictor import make_predictor
 from dynamo_tpu.protocols.kv import ForwardPassMetrics
 
 logger = logging.getLogger(__name__)
@@ -86,6 +86,9 @@ class PlannerConfig:
     itl_slo_seconds: float = 0.05
     scale_down_headroom: float = 0.3  # hysteresis: only shrink below (target - headroom)
     interval_seconds: float = 10.0
+    # Load model: "linear" (ramps), "seasonal" (repeating peaks; falls back
+    # to linear when no period is detected), "moving_average", "constant".
+    predictor: str = "linear"
 
 
 @dataclass
@@ -100,8 +103,8 @@ class Planner:
     def __init__(self, config: PlannerConfig, profile: WorkerProfile) -> None:
         self.config = config
         self.profile = profile
-        self._prefill_pred = LinearTrendPredictor()
-        self._decode_pred = LinearTrendPredictor()
+        self._prefill_pred = make_predictor(config.predictor)
+        self._decode_pred = make_predictor(config.predictor)
         self._last_counters: dict[int, tuple[int, int]] = {}
         self._last_decision: PlanDecision | None = None
 
