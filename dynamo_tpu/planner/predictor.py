@@ -131,7 +131,9 @@ class SeasonalPredictor:
             r = sum(resid[i] * resid[i + p] for i in range(n - p)) / energy
             if r > best_r:
                 best_p, best_r = p, r
-        if best_r < self.threshold:
+        if best_p == 0 or best_r < self.threshold:
+            # best_p == 0: no lag had positive correlation (possible when
+            # threshold <= 0, which would otherwise index y[n]).
             return self._fallback.predict()
 
         self.last_period = best_p
