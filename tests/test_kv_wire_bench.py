@@ -1,0 +1,32 @@
+"""Cross-process KV-wire probe (bench module): two real OS processes over a
+real TCP socket, tiny geometry. The hardware run (chip-side sender) uses the
+same code path via bench.py's probe_cross_process_wire."""
+
+import sys
+
+import pytest
+
+from dynamo_tpu.bench.kv_wire import measure_cross_process, wire_config
+
+
+@pytest.mark.e2e
+async def test_cross_process_wire_measures(tmp_path):
+    cfg = wire_config(num_layers=2, num_kv_heads=2, head_dim=16)
+    out = await measure_cross_process(
+        pages_per_chain=2, iters=3, cfg=cfg, page_size=16,
+        child_cmd=[
+            sys.executable, "-m", "dynamo_tpu.bench.kv_wire",
+            "2", "2", "16", "16", str(2 * 3 + 4), str(2 * 16),
+        ],
+    )
+    assert out["wire"] == "tcp_cross_process"
+    assert out["iters"] == 3 and len(out["per_iter"]) == 3
+    # Exact payload geometry: every transfer moved the full chain's bytes —
+    # L(2) * ps(16) * kv_heads(2) * hd(16) * 2B, K and V, 2 pages per chain.
+    page_bytes = 2 * 16 * 2 * 16 * 2 * 2
+    for it in out["per_iter"]:
+        assert it["bytes"] == 2 * page_bytes
+        assert it["total_s"] > 0
+    assert out["cold_gbytes_per_sec"] > 0
+    assert out["amortized_gbytes_per_sec"] > 0
+    assert out["amortized_wire_only_gbytes_per_sec"] >= out["amortized_gbytes_per_sec"]
