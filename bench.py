@@ -339,8 +339,14 @@ def probe_kv_pull_gbps() -> dict:
     out.update(
         wire="in_process_page_gather", iters=iters,
         transfer_engine="unsupported_on_this_plugin",
+        definition=(
+            "amortized = iters gathers inside ONE jit dispatch (raw HBM "
+            "bandwidth); per_dispatch = one warm, already-compiled gather "
+            "per dispatch (includes the tunnel round trip; NOT the "
+            "compile-inclusive 'cold' of kv_wire_cross_process)"
+        ),
         amortized_gbytes_per_sec=round(2 * stack.nbytes * iters / dt_amortized / 1e9, 3),
-        cold_gbytes_per_sec=round(2 * stack.nbytes / dt_cold / 1e9, 3),
+        per_dispatch_gbytes_per_sec=round(2 * stack.nbytes / dt_cold / 1e9, 3),
     )
     return out
 

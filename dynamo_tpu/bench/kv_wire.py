@@ -42,8 +42,9 @@ PAGE_SIZE = 128
 
 
 def wire_config(num_layers: int = 4, num_kv_heads: int = 32, head_dim: int = 128) -> ModelConfig:
-    """Wide-KV / tiny-weights geometry: 16 MiB per 128-token page at the
-    defaults (L * 2 * kv * hd * 2B * 128), ~50 MB of weights."""
+    """Wide-KV / tiny-weights geometry: 8 MiB per 128-token page at the
+    defaults (4L * 2(K,V) * 32kv * 128hd * 2B * 128 tokens), ~50 MB of
+    weights — the default 8-page chain moves ~64 MB per iteration."""
     return ModelConfig(
         name="kv-wire-proxy", vocab_size=512, hidden_size=512,
         num_layers=num_layers, num_heads=num_kv_heads, num_kv_heads=num_kv_heads,
@@ -138,6 +139,15 @@ async def measure_cross_process(
             asyncio.get_running_loop().run_in_executor(None, _await_addr),
             timeout=180,
         )
+        # Keep draining the merged stdout/stderr afterwards: a chatty child
+        # filling the 64 KiB pipe would block mid-write and deadlock the
+        # un-timed send_blocks round trips.
+        import threading
+
+        threading.Thread(
+            target=lambda: [None for _ in proc.stdout], daemon=True,
+            name="kv-wire-child-drain",
+        ).start()
 
         core = _build_core(cfg, pages_per_chain * iters + 4, page_size, chain_tokens)
         transport = TcpTransport(host="127.0.0.1")

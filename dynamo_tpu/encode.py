@@ -42,19 +42,45 @@ VISION_PRESETS: dict[str, VisionConfig] = {
 
 
 class EncodeService(AsyncEngine[Any, dict]):
-    """Serves the vision tower; one request = one batched image encode."""
+    """Serves the vision tower; one request = one batched image encode.
 
-    def __init__(self, cfg: VisionConfig, params=None) -> None:
+    Two tower flavors share the service: fixed-geometry CLIP/LLaVA towers
+    (`models/vision.VisionConfig` — batched encode, constant patch count)
+    and native-resolution Qwen2-VL towers
+    (`models/qwen2_vl.Qwen2VLVisionConfig` — per-image grids; the response
+    carries ``grids`` so the engine can build M-RoPE positions)."""
+
+    def __init__(self, cfg, params=None) -> None:
         import functools
 
         import jax
 
+        from dynamo_tpu.models.qwen2_vl import (
+            Qwen2VLVisionConfig,
+            init_qwen2vl_vision_params,
+        )
+
         self.cfg = cfg
-        self.params = params if params is not None else init_vision_params(cfg, 0)
-        self._encode = jax.jit(functools.partial(encode_image, self.params, cfg))
+        self.is_qwen2vl = isinstance(cfg, Qwen2VLVisionConfig)
+        if self.is_qwen2vl:
+            self.params = params if params is not None else init_qwen2vl_vision_params(cfg, 0)
+            # Per-grid compiled programs, LRU-bounded: aspect-preserving
+            # resize means arbitrary client images produce many distinct
+            # grids, and each compile's executable is retained by jit.
+            # Params are a traced ARGUMENT (not a closure constant), so
+            # executables don't each embed a copy of the tower weights.
+            self._encode_by_grid: dict = {}
+            self._grid_cache_cap = 32
+        else:
+            self.params = params if params is not None else init_vision_params(cfg, 0)
+            self._encode = jax.jit(functools.partial(encode_image, self.params, cfg))
         self.images_encoded = 0
 
-    def _encode_batch(self, images: list[bytes]) -> np.ndarray:
+    def _encode_batch(self, images: list[bytes]) -> tuple[np.ndarray, list[int], list | None]:
+        """-> (flattened embeds [total, D], per-image LLM token counts,
+        per-image grids or None)."""
+        if self.is_qwen2vl:
+            return self._encode_qwen2vl(images)
         pixels = np.stack([preprocess_image(b, self.cfg) for b in images])
         # Pow2 batch bucketing: without it every new image count compiles a
         # fresh tower program (the runner's bucket lattice, applied here).
@@ -62,7 +88,31 @@ class EncodeService(AsyncEngine[Any, dict]):
         bucket = 1 if n <= 1 else 1 << (n - 1).bit_length()
         if bucket != n:
             pixels = np.concatenate([pixels, np.zeros((bucket - n, *pixels.shape[1:]), pixels.dtype)])
-        return np.asarray(self._encode(pixels), np.float32)[:n]
+        embeds = np.asarray(self._encode(pixels), np.float32)[:n]
+        return embeds.reshape(-1, embeds.shape[-1]), [self.cfg.num_patches] * n, None
+
+    def _encode_qwen2vl(self, images: list[bytes]) -> tuple[np.ndarray, list[int], list]:
+        import jax
+
+        from dynamo_tpu.models.qwen2_vl import encode_qwen2vl, preprocess_qwen2vl
+
+        outs, counts, grids = [], [], []
+        for data in images:
+            patches, grid = preprocess_qwen2vl(data, self.cfg)
+            fn = self._encode_by_grid.pop(grid, None)
+            if fn is None:  # one compiled program per image geometry
+                fn = jax.jit(
+                    lambda p, x, _cfg=self.cfg, _g=grid: encode_qwen2vl(p, _cfg, x, _g)
+                )
+                if len(self._encode_by_grid) >= self._grid_cache_cap:
+                    evicted = next(iter(self._encode_by_grid))
+                    del self._encode_by_grid[evicted]
+            self._encode_by_grid[grid] = fn  # (re)insert at LRU tail
+            out = np.asarray(fn(self.params, patches), np.float32)
+            outs.append(out)
+            counts.append(out.shape[0])
+            grids.append(list(grid))
+        return np.concatenate(outs, axis=0), counts, grids
 
     async def close(self) -> None:  # lifecycle parity with engine services
         pass
@@ -74,14 +124,19 @@ class EncodeService(AsyncEngine[Any, dict]):
         if not raw:
             yield {"error": "no images"}
             return
-        embeds = await asyncio.get_running_loop().run_in_executor(None, self._encode_batch, raw)
+        embeds, counts, grids = await asyncio.get_running_loop().run_in_executor(
+            None, self._encode_batch, raw
+        )
         self.images_encoded += len(raw)
-        yield {
+        resp = {
             "embeds_b64": base64.b64encode(np.ascontiguousarray(embeds).tobytes()).decode(),
             "shape": list(embeds.shape),
             "dtype": "float32",
-            "patches_per_image": [self.cfg.num_patches] * len(raw),
+            "patches_per_image": counts,
         }
+        if grids is not None:
+            resp["grids"] = grids
+        yield resp
 
 
 async def serve_encode_worker(
@@ -93,21 +148,23 @@ async def serve_encode_worker(
     lease=None,
 ) -> EncodeService:
     service = EncodeService(cfg, params)
+    patches = getattr(cfg, "num_patches", "native")  # Qwen2-VL: per-image
     await runtime.namespace(namespace).component(ENCODE_COMPONENT).endpoint(ENCODE_ENDPOINT).serve(
-        service, metadata={"patches": cfg.num_patches}, lease=lease
+        service, metadata={"patches": patches}, lease=lease
     )
-    logger.info("encode worker up (%d patches -> %d dim)", cfg.num_patches, cfg.out_dim)
+    logger.info("encode worker up (%s patches -> %d dim)", patches, cfg.out_dim)
     return service
 
 
 def make_encoder(runtime: DistributedRuntime, namespace: str = "dynamo"):
-    """Frontend-side encoder callable: images (bytes) -> (embeds, patch counts).
+    """Frontend-side encoder callable:
+    images (bytes) -> (embeds, patch counts, per-image grids | None).
 
     Returns an async fn the preprocessor calls; it routes to any live encode
     worker instance."""
     client = runtime.namespace(namespace).component(ENCODE_COMPONENT).endpoint(ENCODE_ENDPOINT).client()
 
-    async def encode(images: list[bytes]) -> tuple[np.ndarray, list[int]]:
+    async def encode(images: list[bytes]) -> tuple[np.ndarray, list[int], list | None]:
         req = {"images_b64": [base64.b64encode(b).decode() for b in images]}
         async for resp in client.generate(req, Context()):
             if "error" in resp:
@@ -115,7 +172,7 @@ def make_encoder(runtime: DistributedRuntime, namespace: str = "dynamo"):
             arr = np.frombuffer(
                 base64.b64decode(resp["embeds_b64"]), dtype=np.dtype(resp["dtype"])
             ).reshape(resp["shape"])
-            return arr, list(resp["patches_per_image"])
+            return arr, list(resp["patches_per_image"]), resp.get("grids")
         raise RuntimeError("encode worker returned no response")
 
     return encode

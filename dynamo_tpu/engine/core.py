@@ -126,6 +126,7 @@ class EngineCore:
         if request.mm_inputs:
             try:
                 seq.mm_embeds = self._decode_mm_inputs(request)
+                seq.mrope = self._mrope_for(request)
             except ValueError as exc:
                 logger.warning("rejecting multimodal request: %s", exc)
                 seq.status = SeqStatus.FINISHED
@@ -170,6 +171,27 @@ class EngineCore:
                 f"{n_placeholders} image placeholders vs {arr.shape[0]} embedding rows"
             )
         return arr
+
+    def _mrope_for(self, request: PreprocessedRequest):
+        """(pos3, delta) for an M-RoPE model's multimodal request; None for
+        standard-rope models. The encode worker ships per-image grids in
+        mm_inputs — without them the 3D positions are unknowable, so their
+        absence on an M-RoPE model is a rejection, not a silent 1D fallback
+        (which would quietly diverge from HF on every image prompt)."""
+        cfg = getattr(self.runner, "cfg", None)
+        if cfg is None or not getattr(cfg, "mrope_section", None):
+            return None
+        from dynamo_tpu.models.qwen2_vl import mrope_position_ids
+
+        grids = request.mm_inputs.get("grids")
+        if not grids:
+            raise ValueError("M-RoPE model needs per-image grids in mm_inputs")
+        pos3, delta = mrope_position_ids(
+            request.token_ids, [tuple(g) for g in grids],
+            image_token_id=cfg.image_token_id,
+            video_token_id=cfg.video_token_id,
+        )
+        return pos3, delta
 
     @property
     def has_work(self) -> bool:
@@ -341,6 +363,25 @@ class EngineCore:
                         np.asarray(s.tokens[: s.num_cached], np.int32) == img_id
                     ))
             sb.mm_embeds, sb.mm_slot_offset, sb.mm_counts = mm, off, counts
+        if any(s.mrope is not None for s in batch):
+            # Per-token 3D rope coords for this chunk's columns. Rows without
+            # mrope (text prompts sharing the batch) use sequential positions
+            # on all axes — exactly 1D rope. Indices past the stored prompt
+            # coords (recomputed generated tokens) sit at index + delta.
+            mrope3 = np.broadcast_to(positions[:, None, :], (b, 3, t)).copy()
+            for i, s in enumerate(batch):
+                if s.mrope is None:
+                    continue
+                pos3, delta = s.mrope
+                new = len(s.tokens) - s.num_cached
+                idx = np.arange(s.num_cached, len(s.tokens))
+                in_prompt = idx < pos3.shape[1]
+                cols = np.where(
+                    in_prompt[None, :], pos3[:, np.minimum(idx, pos3.shape[1] - 1)],
+                    (idx + delta)[None, :],
+                )
+                mrope3[i, :, :new] = cols
+            sb.mrope_positions = mrope3.astype(np.int32)
         try:
             next_tokens = self.runner.step(sb)
         except Exception:
@@ -587,6 +628,7 @@ class EngineCore:
         freq = np.zeros(b, np.float32)
         pres = np.zeros(b, np.float32)
         limits = np.zeros(b, np.int32)
+        mrope_delta = np.zeros(b, np.int32)
         for i, s in enumerate(batch):
             sp = s.request.sampling
             temp[i] = sp.temperature
@@ -597,6 +639,8 @@ class EngineCore:
             freq[i] = sp.frequency_penalty
             pres[i] = sp.presence_penalty
             limits[i] = s.position_limit(self.config.max_seq_len)
+            if s.mrope is not None:
+                mrope_delta[i] = s.mrope[1]
         # Generated-token history feeds the sampler's repetition penalties.
         # Only shipped when some request actually set a penalty: H collapses
         # to 1 otherwise, keeping the packed step input small. Width covers
@@ -610,7 +654,8 @@ class EngineCore:
         else:
             history = np.full((b, 1), -1, np.int32)
         return StepBatch(tokens, positions, block_tables, slots, last, temp, top_k, top_p,
-                         seeds, steps, freq, pres, limits, history)
+                         seeds, steps, freq, pres, limits, history,
+                         mrope_delta=mrope_delta)
 
     def _commit_filled_pages(self, seq: Sequence) -> None:
         """Publish newly-filled pages to the prefix cache (emits stored events)

@@ -51,6 +51,15 @@ def _locked(fn):
     return wrapper
 
 
+def _delta_mrope(positions: jnp.ndarray, delta: jnp.ndarray | None) -> jnp.ndarray:
+    """Equal-coords 3D rope positions from sequential positions + per-row
+    delta: [B, T] (+ [B]) -> [B, 3, T]. Exact for decode and for text spans
+    after the prompt (HF: position = seq_index + mrope_delta on all axes)."""
+    b, t = positions.shape
+    p = positions if delta is None else positions + delta[:, None]
+    return jnp.broadcast_to(p[:, None, :], (b, 3, t))
+
+
 def _pack(padded: "StepBatch") -> np.ndarray:
     """Flatten every step input into one i32 buffer (single host->device
     transfer — on a tunneled/remote chip each separate transfer costs fixed
@@ -72,13 +81,14 @@ def _pack(padded: "StepBatch") -> np.ndarray:
             padded.pres_pen.view(np.int32),
             padded.pos_limit,
             padded.history.ravel(),
+            padded.mrope_delta,
         ]
     )
 
 
 def _unpack(packed: jnp.ndarray, b: int, t: int, n: int, h: int):
     """In-graph inverse of :func:`_pack` (static offsets, free slices)."""
-    sizes = [b * t, b * t, b * n, b * t, b, b, b, b, b, b, b, b, b, b * h]
+    sizes = [b * t, b * t, b * n, b * t, b, b, b, b, b, b, b, b, b, b * h, b]
     offs = np.concatenate([[0], np.cumsum(sizes)])
     part = [packed[offs[i] : offs[i + 1]] for i in range(len(sizes))]
     return (
@@ -96,6 +106,7 @@ def _unpack(packed: jnp.ndarray, b: int, t: int, n: int, h: int):
         jax.lax.bitcast_convert_type(part[11], jnp.float32),
         part[12],
         part[13].reshape(b, h),
+        part[14],
     )
 
 
@@ -121,6 +132,12 @@ class StepBatch:
     mm_embeds: np.ndarray | None = None  # f32[B, M, D] image embeddings
     mm_slot_offset: np.ndarray | None = None  # i32[B] placeholders already cached; -1 = text row
     mm_counts: np.ndarray | None = None  # i32[B] embedding rows provided per row
+    # Qwen2-VL M-RoPE. Delta rides every packed step (one i32 per row; 0 for
+    # text rows — equal coords reduce to 1D rope, so zero-delta is exact);
+    # explicit per-token 3D coords are prefill-only (image spans need grid
+    # coords a scalar shift can't express).
+    mrope_delta: np.ndarray | None = None  # i32[B]; None -> zeros at pad time
+    mrope_positions: np.ndarray | None = None  # i32[B, 3, T] (mm prefill only)
 
     @property
     def batch_size(self) -> int:
@@ -175,14 +192,20 @@ class ModelRunner:
         @functools.partial(jax.jit, static_argnames=("impl",), donate_argnums=(1, 2))
         def _step(params, k_cache, v_cache, tokens, positions, block_tables, slot_mapping,
                   last_idx, temperature, top_k, top_p, seeds, sample_steps,
-                  freq_pen, pres_pen, pos_limit, history,
-                  mm_embeds=None, mm_slot_offset=None, mm_counts=None, *, impl):
+                  freq_pen, pres_pen, pos_limit, history, mrope_delta=None,
+                  mm_embeds=None, mm_slot_offset=None, mm_counts=None,
+                  mrope_positions=None, *, impl):
             del pos_limit  # single/prefill steps never write past the finish line
             # mm_* None on text batches; jit specializes once per presence
             # pattern, so the text program carries no multimodal cost.
             mm_kw = {}
             if mm_embeds is not None:
                 mm_kw = dict(mm_embeds=mm_embeds, mm_slot_offset=mm_slot_offset, mm_counts=mm_counts)
+            if self.cfg.mrope_section:
+                mm_kw["mrope_positions"] = (
+                    mrope_positions if mrope_positions is not None
+                    else _delta_mrope(positions, mrope_delta)
+                )
             logits, k_cache, v_cache = self._forward(
                 params, self.cfg, tokens, positions, k_cache, v_cache,
                 block_tables, slot_mapping, last_idx, attn_impl=impl, mesh=self.mesh,
@@ -207,7 +230,8 @@ class ModelRunner:
         @functools.partial(jax.jit, static_argnames=("num_steps",), donate_argnums=(1, 2))
         def _multi_step(params, k_cache, v_cache, tokens, positions, block_tables,
                         temperature, top_k, top_p, seeds, sample_steps,
-                        freq_pen, pres_pen, pos_limit, history, *, num_steps):
+                        freq_pen, pres_pen, pos_limit, history, mrope_delta=None,
+                        *, num_steps):
             """``num_steps`` fused decode iterations in one dispatch.
 
             The sampled token of step i is step i+1's input; slot mapping is
@@ -230,9 +254,13 @@ class ModelRunner:
                 # lands in the reserved null page 0. This is what makes
                 # page allocation capped at remaining-tokens safe.
                 slot = jnp.where(pos < pos_limit, slot, 0)
+                mm_kw = {}
+                if self.cfg.mrope_section:
+                    mm_kw["mrope_positions"] = _delta_mrope(pos[:, None], mrope_delta)
                 logits, kc, vc = self._forward(
                     params, self.cfg, tok[:, None], pos[:, None], kc, vc,
                     block_tables, slot[:, None], zeros, attn_impl=self.attn_impl,
+                    **mm_kw,
                 )
                 keys = jax.vmap(lambda s, c: jax.random.fold_in(jax.random.PRNGKey(s), c))(seeds, cnt)
                 nxt = sample_tokens(
@@ -255,11 +283,11 @@ class ModelRunner:
         def _multi_step_packed(params, k_cache, v_cache, packed, *, b, t, n, h, num_steps):
             (tokens, positions, block_tables, _slot, _last,
              temperature, top_k, top_p, seeds, sample_steps,
-             freq_pen, pres_pen, pos_limit, history) = _unpack(packed, b, t, n, h)
+             freq_pen, pres_pen, pos_limit, history, mrope_delta) = _unpack(packed, b, t, n, h)
             return _multi_step(
                 params, k_cache, v_cache, tokens[:, 0], positions[:, 0], block_tables,
                 temperature, top_k, top_p, seeds, sample_steps,
-                freq_pen, pres_pen, pos_limit, history, num_steps=num_steps,
+                freq_pen, pres_pen, pos_limit, history, mrope_delta, num_steps=num_steps,
             )
 
         self._multi_step_packed_fn = _multi_step_packed
@@ -271,11 +299,11 @@ class ModelRunner:
             never blocks on them — see multi_step_async)."""
             (_tok, positions, block_tables, _slot, _last,
              temperature, top_k, top_p, seeds, sample_steps,
-             freq_pen, pres_pen, pos_limit, history) = _unpack(packed, b, t, n, h)
+             freq_pen, pres_pen, pos_limit, history, mrope_delta) = _unpack(packed, b, t, n, h)
             return _multi_step(
                 params, k_cache, v_cache, chain_tokens, positions[:, 0], block_tables,
                 temperature, top_k, top_p, seeds, sample_steps,
-                freq_pen, pres_pen, pos_limit, history, num_steps=num_steps,
+                freq_pen, pres_pen, pos_limit, history, mrope_delta, num_steps=num_steps,
             )
 
         self._multi_step_chained_fn = _multi_step_chained
@@ -402,6 +430,10 @@ class ModelRunner:
             mp = next_pow2(batch.mm_embeds.shape[1])
             mm = np.zeros((bp, mp, batch.mm_embeds.shape[2]), batch.mm_embeds.dtype)
             mm[: batch.mm_embeds.shape[0], : batch.mm_embeds.shape[1]] = batch.mm_embeds
+        mrope3 = None
+        if batch.mrope_positions is not None:
+            mrope3 = np.zeros((bp, 3, tp), np.int32)
+            mrope3[: batch.mrope_positions.shape[0], :, : batch.mrope_positions.shape[2]] = batch.mrope_positions
 
         def pad2(a, rows, cols, fill=0):
             out = np.full((rows, cols), fill, a.dtype)
@@ -431,6 +463,9 @@ class ModelRunner:
             mm_embeds=mm,
             mm_slot_offset=None if batch.mm_slot_offset is None else pad1(batch.mm_slot_offset, bp, fill=-1),
             mm_counts=None if batch.mm_counts is None else pad1(batch.mm_counts, bp),
+            mrope_delta=(np.zeros(bp, np.int32) if batch.mrope_delta is None
+                         else pad1(batch.mrope_delta, bp)),
+            mrope_positions=mrope3,
         )
 
     # -- execution ---------------------------------------------------------
@@ -477,7 +512,9 @@ class ModelRunner:
                 put(padded.seeds), put(padded.sample_steps),
                 put(padded.freq_pen), put(padded.pres_pen),
                 put(padded.pos_limit), put(padded.history),
+                put(padded.mrope_delta),
                 put(padded.mm_embeds), put(padded.mm_slot_offset), put(padded.mm_counts),
+                None if padded.mrope_positions is None else put(padded.mrope_positions),
                 impl=self._select_impl(padded) if self.mesh is not None else self.attn_impl,
             )
             return np.asarray(next_tokens)[:b_real]
@@ -496,6 +533,7 @@ class ModelRunner:
                 put(padded.seeds), put(padded.sample_steps),
                 put(padded.freq_pen), put(padded.pres_pen),
                 put(padded.pos_limit), put(padded.history),
+                put(padded.mrope_delta),
                 impl=self._select_impl(padded),
             )
         else:
@@ -530,6 +568,7 @@ class ModelRunner:
                 put(padded.seeds), put(padded.sample_steps),
                 put(padded.freq_pen), put(padded.pres_pen),
                 put(padded.pos_limit), put(padded.history),
+                put(padded.mrope_delta),
                 num_steps=num_steps,
             )
         else:

@@ -42,6 +42,9 @@ class Sequence:
     # Image embeddings [total_image_tokens, D] substituted at placeholder
     # positions during prefill (multimodal; survives preemption/recompute).
     mm_embeds: "object | None" = None
+    # Qwen2-VL M-RoPE: (pos3 i32[3, prompt_len], delta). Tokens past the
+    # prompt (generated, incl. recompute) sit at index + delta on all axes.
+    mrope: "tuple | None" = None
     arrival_time: float = field(default_factory=time.monotonic)
     first_token_time: float | None = None
 

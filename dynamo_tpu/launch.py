@@ -112,9 +112,14 @@ class WorkerSpec:
         if not (p.is_file() and p.suffix == ".gguf"):
             raw_cfg = _json.loads((p / "config.json").read_text())
             if "vision_config" in raw_cfg:
-                from dynamo_tpu.models.vision import VisionConfig
+                if raw_cfg.get("model_type") == "qwen2_vl":
+                    from dynamo_tpu.models.qwen2_vl import Qwen2VLVisionConfig
 
-                spec.vision_config = VisionConfig.from_hf_llava(raw_cfg)
+                    spec.vision_config = Qwen2VLVisionConfig.from_hf(raw_cfg)
+                else:
+                    from dynamo_tpu.models.vision import VisionConfig
+
+                    spec.vision_config = VisionConfig.from_hf_llava(raw_cfg)
                 if mc.image_token_id is not None:
                     card.extra.setdefault("image_token_id", mc.image_token_id)
         return spec

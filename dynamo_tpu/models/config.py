@@ -65,6 +65,10 @@ class ModelConfig:
     # Multimodal: the placeholder token id image embeddings substitute for
     # (None = text-only model); vision tower geometry lives in VisionConfig.
     image_token_id: int | None = None
+    # Qwen2-VL M-RoPE: frequency-dim split for (temporal, height, width)
+    # coordinates, e.g. (16, 24, 24). None = standard 1D rope.
+    mrope_section: tuple | None = None
+    video_token_id: int | None = None
     # Attention family: "gqa" (default) or "mla" (DeepSeek latent attention,
     # models/mla.py). MLA caches one latent + rope key per token.
     attn_type: str = "gqa"
@@ -121,15 +125,38 @@ class ModelConfig:
         """Build from an HF ``config.json`` dict or path (Llama/Qwen-style keys)."""
         if not isinstance(config, dict):
             config = json.loads(pathlib.Path(config).read_text())
+        if "vision_config" in config and "text_config" not in config:
+            # Original flat Qwen2-VL layout (Qwen/Qwen2-VL-*-Instruct):
+            # text keys live at top level next to vision_config. Normalize
+            # to the nested shape so one branch handles both.
+            inner_flat = {k: v for k, v in config.items() if k != "vision_config"}
+            config = {**config, "text_config": inner_flat}
         if "text_config" in config and "vision_config" in config:
-            # VLM (LLaVA-class) config: the LM is the nested text_config;
-            # the tower is models/vision.VisionConfig.from_hf_llava.
+            # VLM config: the LM is the nested text_config; the tower is
+            # models/vision.VisionConfig.from_hf_llava (LLaVA/CLIP) or
+            # models/qwen2_vl.Qwen2VLVisionConfig.from_hf (Qwen2-VL).
             import dataclasses as _dc
 
             inner = dict(config["text_config"])
             inner.setdefault("_name_or_path", config.get("_name_or_path", "vlm"))
+            # Qwen2-VL M-RoPE rides in rope_scaling; it is a position-id
+            # scheme, not a frequency modifier — extract it and neutralize
+            # the scaling dict so rope_frequencies sees plain rope.
+            mrope = None
+            rs = inner.get("rope_scaling") or {}
+            if rs.get("mrope_section"):
+                mrope = tuple(rs["mrope_section"])
+                rest = {k: v for k, v in rs.items() if k != "mrope_section"}
+                if rest.get("rope_type", rest.get("type")) in (None, "default", "mrope"):
+                    rest = None
+                inner["rope_scaling"] = rest
             cfg = cls.from_hf(inner, name=name)
-            return _dc.replace(cfg, image_token_id=config.get("image_token_index"))
+            return _dc.replace(
+                cfg,
+                image_token_id=config.get("image_token_index", config.get("image_token_id")),
+                video_token_id=config.get("video_token_id"),
+                mrope_section=mrope,
+            )
         hidden = config["hidden_size"]
         heads = config["num_attention_heads"]
         # DeepSeek replaces the first k MoE layers with dense MLPs
@@ -188,7 +215,8 @@ class ModelConfig:
                 or config.get("model_type") == "deepseek_v3"
             ),
             first_k_dense=0 if all_dense else first_dense,
-            attention_bias=bool(config.get("attention_bias", config.get("model_type") in ("qwen2", "qwen2_moe"))),
+            attention_bias=bool(config.get("attention_bias", config.get("model_type") in (
+                "qwen2", "qwen2_moe", "qwen2_vl", "qwen2_vl_text"))),
             qk_norm={"qwen3": "head", "qwen3_moe": "head", "olmoe": "flat"}.get(
                 config.get("model_type", ""), ""
             ),

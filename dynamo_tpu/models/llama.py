@@ -30,7 +30,7 @@ from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.ops.attention import paged_attention, write_kv
 from dynamo_tpu.ops.norm import rms_norm
 from dynamo_tpu.models.quant import maybe_dequant as _dq, quant_matmul as _qmm
-from dynamo_tpu.ops.rope import apply_rope, rope_attention_factor, rope_frequencies
+from dynamo_tpu.ops.rope import apply_mrope, apply_rope, rope_attention_factor, rope_frequencies
 
 Params = dict
 
@@ -274,6 +274,7 @@ def forward(
     mm_embeds: jnp.ndarray | None = None,  # [B, M, D] image embeddings (vision tower)
     mm_slot_offset: jnp.ndarray | None = None,  # i32[B] placeholders already cached; -1 = text row
     mm_counts: jnp.ndarray | None = None,  # i32[B] embedding rows provided per row
+    mrope_positions: jnp.ndarray | None = None,  # i32[B, 3, T] Qwen2-VL 3D rope coords
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One forward step. Returns (logits f32[B, vocab], k_cache, v_cache).
 
@@ -384,8 +385,14 @@ def forward(
             if cfg.qk_norm == "head":  # Qwen3: per-head norm before rope
                 q = rms_norm(q, lp["q_norm"], eps=cfg.rms_eps)
                 k = rms_norm(k, lp["k_norm"], eps=cfg.rms_eps)
-            q = apply_rope(q, positions, inv_freq)
-            k = apply_rope(k, positions, inv_freq)
+            if mrope_positions is not None and cfg.mrope_section:
+                # Qwen2-VL 3D rope: ONLY the rotation angles change; cache
+                # slots, masking, and lengths keep the sequential positions.
+                q = apply_mrope(q, mrope_positions, inv_freq, cfg.mrope_section)
+                k = apply_mrope(k, mrope_positions, inv_freq, cfg.mrope_section)
+            else:
+                q = apply_rope(q, positions, inv_freq)
+                k = apply_rope(k, positions, inv_freq)
             if attn_mscale != 1.0:  # YaRN temperature: logits scale by mscale^2
                 q = q * jnp.asarray(attn_mscale, q.dtype)
             k_full, v_full = write_kv(k_full, v_full, k, v, slot_mapping + li * (npages * ps))

@@ -638,6 +638,73 @@ def load_vision_params(index: CheckpointIndex, dtype: Any = np.float32) -> Param
     return params
 
 
+class _HiddenPrefixIndex:
+    """View over a CheckpointIndex hiding non-LM subtrees (``visual.*``) so
+    the strict leftover check applies to the LM only. Qwen2-VL checkpoints
+    store the LM under canonical ``model.*`` names already."""
+
+    def __init__(self, index: CheckpointIndex, hidden: tuple[str, ...]) -> None:
+        self._index = index
+        self._hidden = hidden
+
+    def keys(self) -> list[str]:
+        return [k for k in self._index.keys() if not k.startswith(self._hidden)]
+
+    def __contains__(self, name: str) -> bool:
+        return not name.startswith(self._hidden) and name in self._index
+
+    def read(self, name: str) -> np.ndarray:
+        return self._index.read(name)
+
+    def __getattr__(self, attr):  # shape(), dtype(), ... — name-keyed reads
+        return getattr(self._index, attr)
+
+
+def load_qwen2vl_vision_params(index: CheckpointIndex, dtype: Any = np.float32) -> Params:
+    """Qwen2-VL tower + merger weights -> the pytree
+    ``models/qwen2_vl.encode_qwen2vl`` consumes. Maps ``[model.]visual.*``:
+    the Conv3d patch embedding becomes the patchify matmul weight
+    ([D, C, tp, ph, pw] -> [(c, tp, ph, pw), D], the flatten order
+    ``patchify_frames`` produces); qkv stays one fused projection."""
+    names = set(index.keys())
+    pre = "model.visual." if any(n.startswith("model.visual.") for n in names) else "visual."
+
+    def rd(name: str) -> np.ndarray:
+        return index.read(pre + name).astype(dtype)
+
+    conv = rd("patch_embed.proj.weight")  # [D, C, tp, ph, pw]
+    d = conv.shape[0]
+    n_layers = 1 + max(
+        int(n.split("blocks.")[1].split(".")[0])
+        for n in names if n.startswith(pre + "blocks.")
+    )
+
+    def layer(li: int) -> dict:
+        p = f"blocks.{li}."
+        return {
+            "ln1": rd(p + "norm1.weight"), "ln1_b": rd(p + "norm1.bias"),
+            "ln2": rd(p + "norm2.weight"), "ln2_b": rd(p + "norm2.bias"),
+            "wqkv": rd(p + "attn.qkv.weight").T, "bqkv": rd(p + "attn.qkv.bias"),
+            "wo": rd(p + "attn.proj.weight").T, "bo": rd(p + "attn.proj.bias"),
+            "w1": rd(p + "mlp.fc1.weight").T, "b1": rd(p + "mlp.fc1.bias"),
+            "w2": rd(p + "mlp.fc2.weight").T, "b2": rd(p + "mlp.fc2.bias"),
+        }
+
+    return {
+        "patch_embed": jnp.asarray(conv.reshape(d, -1).T),
+        "merger_ln": jnp.asarray(rd("merger.ln_q.weight")),
+        "merger_ln_b": jnp.asarray(rd("merger.ln_q.bias")),
+        "merger_w1": jnp.asarray(rd("merger.mlp.0.weight").T),
+        "merger_b1": jnp.asarray(rd("merger.mlp.0.bias")),
+        "merger_w2": jnp.asarray(rd("merger.mlp.2.weight").T),
+        "merger_b2": jnp.asarray(rd("merger.mlp.2.bias")),
+        "layers": jax.tree.map(
+            lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+            *[layer(i) for i in range(n_layers)],
+        ),
+    }
+
+
 def load_vlm(
     model_dir: str | pathlib.Path,
     *,
@@ -663,13 +730,21 @@ def load_vlm(
         import dataclasses as _dc
 
         tcfg = _dc.replace(tcfg, dtype=str(jnp.dtype(dtype).name))
-    vcfg = VisionConfig.from_hf_llava(config)
     index = CheckpointIndex(p)
-    lm_params = load_params(p, tcfg, mesh=mesh, dtype=dtype, index=_RenamedIndex(index))
     # The tower stays f32: it is tiny next to the LM and LayerNorm-heavy.
     # load_tower=False skips it entirely — in a multi-worker deployment only
     # the worker backing the encode service needs a tower copy.
-    vision_params = load_vision_params(index, dtype=np.float32) if load_tower else None
+    if config.get("model_type") == "qwen2_vl":
+        from dynamo_tpu.models.qwen2_vl import Qwen2VLVisionConfig
+
+        vcfg = Qwen2VLVisionConfig.from_hf(config)
+        lm_index = _HiddenPrefixIndex(index, ("visual.", "model.visual."))
+        lm_params = load_params(p, tcfg, mesh=mesh, dtype=dtype, index=lm_index)
+        vision_params = load_qwen2vl_vision_params(index, dtype=np.float32) if load_tower else None
+    else:
+        vcfg = VisionConfig.from_hf_llava(config)
+        lm_params = load_params(p, tcfg, mesh=mesh, dtype=dtype, index=_RenamedIndex(index))
+        vision_params = load_vision_params(index, dtype=np.float32) if load_tower else None
     return tcfg, vcfg, lm_params, vision_params
 
 

@@ -1,0 +1,331 @@
+"""Qwen2-VL: 2D-rope ViT vision tower, patch merger, and M-RoPE indices.
+
+The reference's primary multimodal family
+(`examples/multimodal/components/encode_worker.py:61-179` serves Qwen2-VL
+through HF). Architecture (vs the CLIP/LLaVA tower in `models/vision.py`):
+
+- **Native-resolution patching**: images resize to multiples of
+  ``patch_size * spatial_merge_size`` (smart_resize) instead of a fixed
+  square; the patch sequence length varies per image and a ``(t, h, w)``
+  grid describes it. Patches flatten in MERGE-GROUP order (each 2x2 spatial
+  group contiguous) with the temporal axis folded into the patch dim
+  (temporal_patch_size=2 — a still image is duplicated).
+- **2D rotary embeddings** in the tower: each patch's rope angle vector is
+  ``[freqs(h_pos), freqs(w_pos)]`` over head_dim/2, applied in the
+  half-split (rotate_half) convention. No learned position embeddings, no
+  CLS token.
+- **Patch merger**: LayerNorm then each 2x2 group's features concatenate
+  ([4*D]) through a 2-layer MLP into the LLM hidden size — so the LLM sees
+  ``t*h*w/4`` tokens per image.
+- **M-RoPE** in the LLM: position ids are 3D (temporal, height, width).
+  Text tokens carry equal coords (reduces exactly to 1D rope); image spans
+  carry grid coords. :func:`mrope_position_ids` mirrors HF
+  ``get_rope_index`` (modeling_qwen2_vl.py); the rope application lives in
+  ``ops/rope.apply_mrope``.
+
+TPU notes: everything below is static-shaped per (grid) — one jit
+specialization per distinct image geometry; the serving encoder bounds the
+per-grid program cache with LRU eviction (encode.py). Attention is dense
+over one image's patches (a few hundred to a few thousand tokens) —
+MXU-friendly einsums, no paging needed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = dict
+
+
+@dataclasses.dataclass(frozen=True)
+class Qwen2VLVisionConfig:
+    embed_dim: int = 1280
+    depth: int = 32
+    num_heads: int = 16
+    mlp_ratio: float = 4.0
+    patch_size: int = 14
+    temporal_patch_size: int = 2
+    spatial_merge_size: int = 2
+    in_channels: int = 3
+    out_dim: int = 3584  # LLM hidden size
+    act: str = "quick_gelu"
+    ln_eps: float = 1e-6
+    # Qwen2-VL image processor statistics (OPENAI_CLIP).
+    image_mean: tuple = (0.48145466, 0.4578275, 0.40821073)
+    image_std: tuple = (0.26862954, 0.26130258, 0.27577711)
+    min_pixels: int = 56 * 56
+    max_pixels: int = 14 * 14 * 4 * 1280
+
+    @property
+    def patch_dim(self) -> int:
+        return self.in_channels * self.temporal_patch_size * self.patch_size**2
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+    @property
+    def mlp_hidden(self) -> int:
+        return int(self.embed_dim * self.mlp_ratio)
+
+    @property
+    def merge_dim(self) -> int:
+        return self.embed_dim * self.spatial_merge_size**2
+
+    def merged_tokens(self, grid: tuple[int, int, int]) -> int:
+        t, h, w = grid
+        return t * h * w // self.spatial_merge_size**2
+
+    @classmethod
+    def from_hf(cls, config: dict) -> "Qwen2VLVisionConfig":
+        """HF ``Qwen2VLConfig.vision_config`` dict -> Qwen2VLVisionConfig."""
+        v = config["vision_config"]
+        t = config.get("text_config", config)
+        return cls(
+            embed_dim=v.get("embed_dim", v.get("hidden_size", 1280)),
+            depth=v.get("depth", 32),
+            num_heads=v.get("num_heads", 16),
+            mlp_ratio=float(v.get("mlp_ratio", 4.0)),
+            patch_size=v.get("patch_size", 14),
+            temporal_patch_size=v.get("temporal_patch_size", 2),
+            spatial_merge_size=v.get("spatial_merge_size", 2),
+            in_channels=v.get("in_channels", 3),
+            # HF names the OUTPUT dim "hidden_size" on the vision config
+            # when embed_dim is present (Qwen2-VL quirk).
+            out_dim=t["hidden_size"],
+            act=v.get("hidden_act", "quick_gelu"),
+        )
+
+
+TEST_TINY_QWEN2VL_VISION = Qwen2VLVisionConfig(
+    embed_dim=32, depth=2, num_heads=2, patch_size=4, out_dim=64,
+    min_pixels=4 * 4 * 4, max_pixels=4 * 4 * 4 * 1280,
+)
+
+
+def init_qwen2vl_vision_params(cfg: Qwen2VLVisionConfig, rng: jax.Array | int = 0) -> Params:
+    if isinstance(rng, int):
+        rng = jax.random.PRNGKey(rng)
+    ks = jax.random.split(rng, 4)
+    d, mlp, md = cfg.embed_dim, cfg.mlp_hidden, cfg.merge_dim
+
+    def w(key, shape, fan_in):
+        return jax.random.normal(key, shape, jnp.float32) * (fan_in**-0.5)
+
+    def layer(key):
+        lk = jax.random.split(key, 4)
+        return {
+            "ln1": jnp.ones(d), "ln1_b": jnp.zeros(d),
+            "ln2": jnp.ones(d), "ln2_b": jnp.zeros(d),
+            "wqkv": w(lk[0], (d, 3 * d), d), "bqkv": jnp.zeros(3 * d),
+            "wo": w(lk[1], (d, d), d), "bo": jnp.zeros(d),
+            "w1": w(lk[2], (d, mlp), d), "b1": jnp.zeros(mlp),
+            "w2": w(lk[3], (mlp, d), mlp), "b2": jnp.zeros(d),
+        }
+
+    layer_keys = jax.random.split(ks[3], cfg.depth)
+    return {
+        "patch_embed": w(ks[0], (cfg.patch_dim, d), cfg.patch_dim),
+        "merger_ln": jnp.ones(d), "merger_ln_b": jnp.zeros(d),
+        "merger_w1": w(ks[1], (md, md), md), "merger_b1": jnp.zeros(md),
+        "merger_w2": w(ks[2], (md, cfg.out_dim), md), "merger_b2": jnp.zeros(cfg.out_dim),
+        "layers": jax.tree.map(lambda *xs: jnp.stack(xs), *[layer(k) for k in layer_keys]),
+    }
+
+
+def _ln(x, g, b, eps):
+    mu = x.mean(-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * g + b
+
+
+def _vision_rope_angles(cfg: Qwen2VLVisionConfig, grid: tuple[int, int, int]) -> np.ndarray:
+    """Per-patch rope angle vector [S, head_dim/2] = [freqs(h), freqs(w)],
+    with h/w indices in the same merge-group order the patches arrive in
+    (HF ``rot_pos_emb``)."""
+    t, h, w = grid
+    m = cfg.spatial_merge_size
+    hpos = np.broadcast_to(np.arange(h)[:, None], (h, w))
+    wpos = np.broadcast_to(np.arange(w)[None, :], (h, w))
+
+    def merge_order(a):
+        return a.reshape(h // m, m, w // m, m).transpose(0, 2, 1, 3).reshape(-1)
+
+    hpos, wpos = merge_order(hpos), merge_order(wpos)
+    dim = cfg.head_dim // 2  # angles per coordinate axis: dim/2 freqs each
+    inv_freq = 1.0 / (10000.0 ** (np.arange(0, dim, 2, dtype=np.float64) / dim))
+    angles = np.concatenate(
+        [hpos[:, None] * inv_freq, wpos[:, None] * inv_freq], axis=1
+    )  # [h*w, head_dim/2]
+    return np.tile(angles, (t, 1)).astype(np.float32)
+
+
+def _rotate(x: jnp.ndarray, angles: jnp.ndarray) -> jnp.ndarray:
+    """Half-split rotation of [S, H, hd] by per-token angles [S, hd/2]."""
+    cos = jnp.cos(angles)[:, None, :]
+    sin = jnp.sin(angles)[:, None, :]
+    x32 = x.astype(jnp.float32)
+    half = x.shape[-1] // 2
+    x1, x2 = x32[..., :half], x32[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], -1).astype(x.dtype)
+
+
+def encode_qwen2vl(
+    params: Params,
+    cfg: Qwen2VLVisionConfig,
+    patches: jnp.ndarray,  # [S, patch_dim] flattened patches (one image/video)
+    grid: tuple[int, int, int],
+) -> jnp.ndarray:
+    """One image (or video clip) -> [t*h*w/4, out_dim] merged embeddings.
+
+    Matches HF ``Qwen2VisionTransformerPretrainedModel.forward`` for a
+    single grid (attention is full over this image's patches; multi-image
+    batches are block-diagonal there, i.e. exactly a loop over this)."""
+    act = (lambda v: v * jax.nn.sigmoid(1.702 * v)) if cfg.act == "quick_gelu" \
+        else (lambda v: jax.nn.gelu(v, approximate=False))
+    x = patches @ params["patch_embed"]  # [S, D]
+    angles = jnp.asarray(_vision_rope_angles(cfg, grid))
+    h, hd = cfg.num_heads, cfg.head_dim
+    scale = hd**-0.5
+
+    def layer_step(x, lp):
+        y = _ln(x, lp["ln1"], lp["ln1_b"], cfg.ln_eps)
+        qkv = (y @ lp["wqkv"] + lp["bqkv"]).reshape(-1, 3, h, hd)
+        q, k, v = _rotate(qkv[:, 0], angles), _rotate(qkv[:, 1], angles), qkv[:, 2]
+        att = jax.nn.softmax(
+            jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale,
+            axis=-1,
+        ).astype(v.dtype)
+        o = jnp.einsum("hqk,khd->qhd", att, v).reshape(-1, cfg.embed_dim)
+        x = x + (o @ lp["wo"] + lp["bo"])
+        y = _ln(x, lp["ln2"], lp["ln2_b"], cfg.ln_eps)
+        y = act(y @ lp["w1"] + lp["b1"]) @ lp["w2"] + lp["b2"]
+        return x + y, None
+
+    x, _ = jax.lax.scan(layer_step, x, params["layers"])
+    # Merger: LN, then each spatial merge group's 4 patch features concat.
+    y = _ln(x, params["merger_ln"], params["merger_ln_b"], cfg.ln_eps)
+    y = y.reshape(-1, cfg.merge_dim)
+    y = jax.nn.gelu(y @ params["merger_w1"] + params["merger_b1"], approximate=False)
+    return y @ params["merger_w2"] + params["merger_b2"]
+
+
+# -- image preprocessing (HF Qwen2VLImageProcessor parity) -------------------
+
+def smart_resize(height: int, width: int, factor: int, min_pixels: int, max_pixels: int) -> tuple[int, int]:
+    """HF smart_resize: dims to multiples of ``factor``, pixel count into
+    [min_pixels, max_pixels], aspect ratio approximately kept."""
+    if max(height, width) / min(height, width) > 200:
+        raise ValueError("aspect ratio must be < 200")
+    h_bar = max(factor, round(height / factor) * factor)
+    w_bar = max(factor, round(width / factor) * factor)
+    if h_bar * w_bar > max_pixels:
+        beta = math.sqrt((height * width) / max_pixels)
+        h_bar = max(factor, math.floor(height / beta / factor) * factor)
+        w_bar = max(factor, math.floor(width / beta / factor) * factor)
+    elif h_bar * w_bar < min_pixels:
+        beta = math.sqrt(min_pixels / (height * width))
+        h_bar = math.ceil(height * beta / factor) * factor
+        w_bar = math.ceil(width * beta / factor) * factor
+    return h_bar, w_bar
+
+
+def preprocess_qwen2vl(data: bytes, cfg: Qwen2VLVisionConfig) -> tuple[np.ndarray, tuple[int, int, int]]:
+    """Image bytes -> (flattened patches [S, patch_dim] f32, (t, h, w) grid),
+    matching HF Qwen2VLImageProcessor: smart_resize (bicubic), normalize,
+    duplicate to temporal_patch_size frames, flatten in merge-group order."""
+    import io
+
+    from PIL import Image
+
+    img = Image.open(io.BytesIO(data)).convert("RGB")
+    w0, h0 = img.size
+    factor = cfg.patch_size * cfg.spatial_merge_size
+    h1, w1 = smart_resize(h0, w0, factor, cfg.min_pixels, cfg.max_pixels)
+    img = img.resize((w1, h1), Image.BICUBIC)
+    arr = np.asarray(img, np.float32) / 255.0
+    arr = (arr - np.asarray(cfg.image_mean, np.float32)) / np.asarray(cfg.image_std, np.float32)
+    frames = np.repeat(arr.transpose(2, 0, 1)[None], cfg.temporal_patch_size, axis=0)  # [T, C, H, W]
+    return patchify_frames(frames, cfg)
+
+
+def patchify_frames(frames: np.ndarray, cfg: Qwen2VLVisionConfig) -> tuple[np.ndarray, tuple[int, int, int]]:
+    """[T*tp?, C, H, W] normalized frames -> (patches [S, patch_dim], grid).
+
+    ``T`` must be a multiple of temporal_patch_size (callers pad by
+    repeating the last frame, as HF does). Mirrors the exact reshape/
+    transpose of Qwen2VLImageProcessor._preprocess."""
+    ps, m, tp = cfg.patch_size, cfg.spatial_merge_size, cfg.temporal_patch_size
+    nt, c, hh, ww = frames.shape
+    if nt % tp:
+        frames = np.concatenate([frames, np.repeat(frames[-1:], tp - nt % tp, axis=0)])
+        nt = frames.shape[0]
+    gt, gh, gw = nt // tp, hh // ps, ww // ps
+    p = frames.reshape(gt, tp, c, gh // m, m, ps, gw // m, m, ps)
+    p = p.transpose(0, 3, 6, 4, 7, 2, 1, 5, 8)
+    return p.reshape(gt * gh * gw, c * tp * ps * ps).astype(np.float32), (gt, gh, gw)
+
+
+# -- M-RoPE position ids (HF get_rope_index parity) --------------------------
+
+def mrope_position_ids(
+    tokens: list[int],
+    grids: list[tuple[int, int, int]],
+    *,
+    image_token_id: int,
+    video_token_id: int | None = None,
+    spatial_merge_size: int = 2,
+) -> tuple[np.ndarray, int]:
+    """One sequence's 3D rope positions: (pos3 i32[3, T], delta).
+
+    Text spans get equal coords continuing from the running max; each
+    vision span (``grids`` consumed in order, h/w pre-merge as in HF) gets
+    (t, h/m, w/m) grid coords offset by the running max. ``delta`` is
+    ``max_pos + 1 - T``: decode token i (0-based from T) sits at position
+    ``T + i + delta`` on all three axes. Mirrors HF ``get_rope_index``
+    (modeling_qwen2_vl.py:925-1052) without needing vision_start tokens —
+    spans are located by runs of the placeholder ids themselves."""
+    arr = np.asarray(tokens, np.int64)
+    t_len = len(arr)
+    is_vis = arr == image_token_id
+    if video_token_id is not None:
+        is_vis |= arr == video_token_id
+    pos3 = np.zeros((3, t_len), np.int64)
+    gi = 0
+    st = 0
+    run = 0  # next position index (running max + 1)
+    i = 0
+    while i < t_len:
+        if is_vis[i]:
+            if gi >= len(grids):
+                raise ValueError(f"{len(grids)} grids but more vision spans in prompt")
+            gt, gh, gw = grids[gi]
+            gh, gw = gh // spatial_merge_size, gw // spatial_merge_size
+            n = gt * gh * gw
+            if not bool(is_vis[i : i + n].all()) or i + n > t_len:
+                raise ValueError("vision span shorter than its grid")
+            # Text before this span.
+            for c in range(3):
+                pos3[c, st:i] = np.arange(i - st) + run
+            run = run + (i - st)
+            ti = np.repeat(np.arange(gt), gh * gw)
+            hi = np.tile(np.repeat(np.arange(gh), gw), gt)
+            wi = np.tile(np.arange(gw), gt * gh)
+            pos3[0, i : i + n] = ti + run
+            pos3[1, i : i + n] = hi + run
+            pos3[2, i : i + n] = wi + run
+            run = run + max(gt, gh, gw)
+            gi += 1
+            st = i + n
+            i = i + n
+        else:
+            i += 1
+    for c in range(3):
+        pos3[c, st:] = np.arange(t_len - st) + run
+    delta = int(pos3.max()) + 1 - t_len
+    return pos3.astype(np.int32), delta

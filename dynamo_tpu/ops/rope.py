@@ -84,6 +84,34 @@ def rope_attention_factor(scaling: dict | None) -> float:
     return 0.1 * float(np.log(factor)) + 1.0 if factor > 1.0 else 1.0
 
 
+def apply_mrope(
+    x: jnp.ndarray,  # [B, T, H, hd]
+    positions3: jnp.ndarray,  # i32[B, 3, T] — (temporal, height, width)
+    inv_freq: jnp.ndarray,  # [hd/2]
+    sections: tuple[int, ...],  # e.g. (16, 24, 24), sums to hd/2
+) -> jnp.ndarray:
+    """Multimodal 3D rope (Qwen2-VL): frequency dims are partitioned into
+    ``sections``; section j's dims take their rotation angle from coordinate
+    axis j. Text tokens carry equal coords on all three axes, for which this
+    reduces exactly to :func:`apply_rope`. Mirrors HF
+    ``apply_multimodal_rotary_pos_emb`` (modeling_qwen2_vl.py:156) in the
+    half-split convention."""
+    angles3 = positions3[..., None].astype(jnp.float32) * inv_freq  # [B, 3, T, hd/2]
+    oh = np.zeros((3, inv_freq.shape[0]), np.float32)
+    start = 0
+    for j, s in enumerate(sections):
+        oh[j, start : start + s] = 1.0
+        start += s
+    angles = jnp.einsum("bctf,cf->btf", angles3, jnp.asarray(oh))
+    cos = jnp.cos(angles)[..., None, :]  # [B, T, 1, hd/2]
+    sin = jnp.sin(angles)[..., None, :]
+    x32 = x.astype(jnp.float32)
+    half = x.shape[-1] // 2
+    x1, x2 = x32[..., :half], x32[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
 def apply_rope(x: jnp.ndarray, positions: jnp.ndarray, inv_freq: jnp.ndarray) -> jnp.ndarray:
     """Rotate ``x`` [..., T, n_heads, head_dim] at absolute ``positions`` [..., T]."""
     angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., T, hd/2]

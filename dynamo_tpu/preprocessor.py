@@ -225,7 +225,7 @@ class OpenAIPreprocessor(Operator):
 
                 import numpy as np
 
-                embeds, patches = await self.encoder(images)
+                embeds, patches, grids = await self.encoder(images)
                 req = self.preprocess(body, image_patches=patches)
                 req.mm_inputs = {
                     "embeds_b64": base64.b64encode(
@@ -234,6 +234,8 @@ class OpenAIPreprocessor(Operator):
                     "shape": list(embeds.shape),
                     "dtype": "float32",
                 }
+                if grids:  # Qwen2-VL: engine builds M-RoPE positions from these
+                    req.mm_inputs["grids"] = grids
                 return req.to_dict()
             request = body
         return self.preprocess(request).to_dict()

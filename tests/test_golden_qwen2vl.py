@@ -1,0 +1,232 @@
+"""Golden-logit parity for Qwen2-VL vs HF transformers (VERDICT r4 item 4).
+
+Same technique as tests/test_golden_vision.py: a tiny seeded HF
+Qwen2VLForConditionalGeneration saved as a real checkpoint, loaded through
+``load_vlm`` (2D-rope ViT tower + patch merger + canonical-name LM), and an
+image request must reproduce HF's logits end to end. This pins: the Conv3d
+-> patchify-matmul conversion, merge-group patch ordering, the tower's 2D
+rotary embeddings, the merger MLP, M-RoPE position-id construction
+(``mrope_position_ids`` vs HF ``get_rope_index``), and the sectioned 3D
+rope application in the LM (``ops/rope.apply_mrope``).
+
+Reference parity target:
+`examples/multimodal/components/encode_worker.py:61-179` (Qwen2-VL is the
+reference's primary multimodal family).
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax.numpy as jnp  # noqa: E402
+
+from dynamo_tpu.models import llama  # noqa: E402
+from dynamo_tpu.models.loader import load_vlm  # noqa: E402
+from dynamo_tpu.models.qwen2_vl import (  # noqa: E402
+    encode_qwen2vl,
+    mrope_position_ids,
+    patchify_frames,
+)
+
+IMAGE_TOKEN, VIDEO_TOKEN, VISION_START = 250, 251, 252
+
+
+def _tiny_qwen2vl():
+    from transformers import Qwen2VLConfig, Qwen2VLForConditionalGeneration
+
+    torch.manual_seed(0)
+    cfg = Qwen2VLConfig(
+        vision_config=dict(
+            embed_dim=32, depth=2, num_heads=2, patch_size=4,
+            temporal_patch_size=2, spatial_merge_size=2, in_channels=3,
+            hidden_size=64, mlp_ratio=2.0,
+        ),
+        text_config=dict(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            rope_theta=10000.0, tie_word_embeddings=False,
+            rope_scaling={"type": "mrope", "mrope_section": [2, 3, 3]},
+        ),
+        image_token_id=IMAGE_TOKEN, video_token_id=VIDEO_TOKEN,
+        vision_start_token_id=VISION_START,
+    )
+    return Qwen2VLForConditionalGeneration(cfg).eval().float()
+
+
+def _patches(seed: int, grid_hw=(8, 8)):
+    """Random normalized frames -> (flattened patches, grid) in both our and
+    HF's layout (identical by construction — patchify parity is separately
+    pinned against HF's processor in test_multimodal_qwen2vl.py)."""
+    from dynamo_tpu.models.qwen2_vl import TEST_TINY_QWEN2VL_VISION as VC
+
+    h, w = grid_hw[0] * VC.patch_size, grid_hw[1] * VC.patch_size
+    rng = np.random.default_rng(seed)
+    frames = rng.standard_normal((VC.temporal_patch_size, 3, h, w)).astype(np.float32) * 0.4
+    return patchify_frames(frames, VC)
+
+
+def test_golden_qwen2vl_tower(tmp_path):
+    """Tower + merger in isolation vs HF ``model.visual`` — localizes
+    failures to vision vs LM."""
+    m = _tiny_qwen2vl()
+    m.save_pretrained(str(tmp_path), safe_serialization=True)
+    _tcfg, vcfg, _lm, vis_params = load_vlm(tmp_path, dtype="float32")
+    assert vcfg.embed_dim == 32 and vcfg.spatial_merge_size == 2
+
+    patches, grid = _patches(0)
+    with torch.no_grad():
+        want = m.model.visual(
+            torch.tensor(patches), grid_thw=torch.tensor([list(grid)])
+        ).float().numpy()
+    got = np.asarray(encode_qwen2vl(vis_params, vcfg, jnp.asarray(patches), grid))
+    assert got.shape == want.shape == (grid[0] * grid[1] * grid[2] // 4, 64)
+    np.testing.assert_allclose(got, want, atol=2e-4, rtol=1e-3)
+
+
+def test_golden_qwen2vl_image_logits(tmp_path):
+    """Full model: image + text prompt -> logits must match HF, prefill AND
+    one decode step on the image-conditioned paged cache (M-RoPE deltas)."""
+    m = _tiny_qwen2vl()
+    m.save_pretrained(str(tmp_path), safe_serialization=True)
+    tcfg, vcfg, lm_params, vis_params = load_vlm(tmp_path, dtype="float32")
+    assert tcfg.image_token_id == IMAGE_TOKEN
+    assert tcfg.mrope_section == (2, 3, 3)
+    assert tcfg.attention_bias  # Qwen2-VL text uses qkv biases
+
+    patches, grid = _patches(1)
+    n_img = grid[0] * grid[1] * grid[2] // 4  # merged tokens
+    prompt = [3, 7, VISION_START] + [IMAGE_TOKEN] * n_img + [11, 42, 99, 5]
+    t = len(prompt)
+
+    with torch.no_grad():
+        hf_logits = m(
+            input_ids=torch.tensor([prompt]),
+            pixel_values=torch.tensor(patches),
+            image_grid_thw=torch.tensor([list(grid)]),
+        ).logits[0].float().numpy()
+
+    mm = encode_qwen2vl(vis_params, vcfg, jnp.asarray(patches), grid)
+    pos3, delta = mrope_position_ids(
+        prompt, [grid], image_token_id=IMAGE_TOKEN, video_token_id=VIDEO_TOKEN,
+    )
+
+    page_size = 8
+    k_cache, v_cache = llama.init_kv_cache(tcfg, num_pages=16, page_size=page_size)
+    n_pages = -(-t // page_size)
+    tables = jnp.asarray([list(range(1, 1 + n_pages))], jnp.int32)
+    positions = jnp.arange(t, dtype=jnp.int32)[None]
+    slots = jnp.take_along_axis(tables, positions // page_size, axis=1) * page_size + positions % page_size
+    ours, k_cache, v_cache = llama.forward(
+        lm_params, tcfg, jnp.asarray([prompt], jnp.int32), positions,
+        k_cache, v_cache, tables, slots, jnp.asarray([t - 1], jnp.int32),
+        mm_embeds=mm[None], mrope_positions=jnp.asarray(pos3)[None],
+    )
+    np.testing.assert_allclose(np.asarray(ours)[0], hf_logits[t - 1], atol=2e-3, rtol=1e-3)
+
+    # Decode step: all three coords sit at (t + delta).
+    tok = 42
+    pos = jnp.asarray([[t]], jnp.int32)
+    pos3_dec = jnp.full((1, 3, 1), t + delta, jnp.int32)
+    slot = jnp.take_along_axis(tables, pos // page_size, axis=1) * page_size + pos % page_size
+    ours2, _, _ = llama.forward(
+        lm_params, tcfg, jnp.asarray([[tok]], jnp.int32), pos,
+        k_cache, v_cache, tables, slot, jnp.asarray([0], jnp.int32),
+        mrope_positions=pos3_dec,
+    )
+    with torch.no_grad():
+        hf2 = m(
+            input_ids=torch.tensor([prompt + [tok]]),
+            pixel_values=torch.tensor(patches),
+            image_grid_thw=torch.tensor([list(grid)]),
+        ).logits[0, -1].float().numpy()
+    np.testing.assert_allclose(np.asarray(ours2)[0], hf2, atol=2e-3, rtol=1e-3)
+
+
+@pytest.mark.e2e
+async def test_real_qwen2vl_checkpoint_served_e2e(tmp_path):
+    """A real (tiny, seeded) Qwen2-VL checkpoint directory served through the
+    full HTTP stack: loader -> native-resolution tower in the encode worker
+    -> grid-dependent placeholder expansion -> M-RoPE prefill + decode.
+    Pixels must matter."""
+    import base64
+    import io
+
+    import aiohttp
+    from PIL import Image
+
+    from dynamo_tpu.launch import run_local
+
+    m = _tiny_qwen2vl()
+    m.save_pretrained(str(tmp_path), safe_serialization=True)
+    name = tmp_path.name
+
+    def data_url(color, size=(32, 24)):
+        img = Image.new("RGB", size, color)
+        buf = io.BytesIO()
+        img.save(buf, format="PNG")
+        return "data:image/png;base64," + base64.b64encode(buf.getvalue()).decode()
+
+    handles = await run_local(str(tmp_path), port=0, num_pages=128, max_batch_size=4)
+    base = f"http://127.0.0.1:{handles['port']}"
+    try:
+        async def ask(color):
+            body = {
+                "model": name,
+                "messages": [{"role": "user", "content": [
+                    {"type": "text", "text": "describe: "},
+                    {"type": "image_url", "image_url": {"url": data_url(color)}},
+                ]}],
+                "max_tokens": 6, "temperature": 0,
+            }
+            async with aiohttp.ClientSession() as s:
+                async with s.post(base + "/v1/chat/completions", json=body) as r:
+                    assert r.status == 200, await r.text()
+                    return await r.json()
+
+        red = await ask((255, 0, 0))
+        blue = await ask((0, 0, 255))
+        # 32x24 at patch 4 -> grid (1, 6, 8) -> 12 merged placeholder tokens.
+        assert red["usage"]["prompt_tokens"] > 12
+        assert red["choices"][0]["message"]["content"] != blue["choices"][0]["message"]["content"]
+
+        from dynamo_tpu.encode import EncodeService
+        enc = next(s for s in handles["services"] if isinstance(s, EncodeService))
+        assert enc.images_encoded == 2
+        assert enc.is_qwen2vl
+        # The engine actually built M-RoPE state for the requests.
+        eng = next(s for s in handles["services"] if hasattr(s, "core"))
+        assert eng.core.runner.cfg.mrope_section == (2, 3, 3)
+    finally:
+        await handles["http"].stop()
+        await handles["watcher"].close()
+        for svc in handles["services"]:
+            await svc.close()
+        await handles["runtime"].close()
+
+
+def test_mrope_position_ids_match_hf(tmp_path):
+    """``mrope_position_ids`` vs HF ``get_rope_index`` on text+image+text,
+    two images, and a trailing-image prompt."""
+    m = _tiny_qwen2vl()
+    grids = [(1, 8, 8), (1, 4, 8)]
+    n1 = 8 * 8 // 4
+    n2 = 4 * 8 // 4
+    prompts = [
+        [1, 2, VISION_START] + [IMAGE_TOKEN] * n1 + [5, 6, 7],
+        [VISION_START] + [IMAGE_TOKEN] * n1 + [9, VISION_START] + [IMAGE_TOKEN] * n2 + [4],
+        [8, 3, VISION_START] + [IMAGE_TOKEN] * n2,
+    ]
+    uses = [[grids[0]], grids, [grids[1]]]
+    for prompt, gs in zip(prompts, uses):
+        # ``gs`` entries are PRE-merge patch grids, HF's image_grid_thw unit.
+        want_pos, want_delta = m.model.get_rope_index(
+            input_ids=torch.tensor([prompt]),
+            image_grid_thw=torch.tensor([list(g) for g in gs]),
+        )
+        got_pos, got_delta = mrope_position_ids(
+            prompt, gs, image_token_id=IMAGE_TOKEN, video_token_id=VIDEO_TOKEN,
+        )
+        np.testing.assert_array_equal(got_pos, want_pos[:, 0].numpy())
+        assert got_delta == int(want_delta[0, 0])
