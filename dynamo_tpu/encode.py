@@ -76,31 +76,54 @@ class EncodeService(AsyncEngine[Any, dict]):
             self._encode = jax.jit(functools.partial(encode_image, self.params, cfg))
         self.images_encoded = 0
 
-    def _encode_batch(self, images: list[bytes]) -> tuple[np.ndarray, list[int], list | None]:
-        """-> (flattened embeds [total, D], per-image LLM token counts,
-        per-image grids or None)."""
+    def _encode_batch(self, media: list[tuple[str, bytes]]) -> tuple[np.ndarray, list[int], list | None]:
+        """``media``: (kind, bytes) with kind "image" | "video", in prompt
+        order. -> (flattened embeds [total, D], per-item LLM token counts,
+        per-item grids or None)."""
         if self.is_qwen2vl:
-            return self._encode_qwen2vl(images)
-        pixels = np.stack([preprocess_image(b, self.cfg) for b in images])
-        # Pow2 batch bucketing: without it every new image count compiles a
+            return self._encode_qwen2vl(media)
+        from dynamo_tpu.models.vision import preprocess_video
+
+        # Fixed-geometry tower: videos become frame stacks through the same
+        # tower; an item's embedding rows = frames * num_patches (reference
+        # video_prefill recipe). Frames and stills share one batched encode.
+        pixels_list, frames_per_item = [], []
+        for kind, data in media:
+            if kind == "video":
+                stack = preprocess_video(data, self.cfg)
+                pixels_list.extend(stack)
+                frames_per_item.append(stack.shape[0])
+            else:
+                pixels_list.append(preprocess_image(data, self.cfg))
+                frames_per_item.append(1)
+        pixels = np.stack(pixels_list)
+        # Pow2 batch bucketing: without it every new frame count compiles a
         # fresh tower program (the runner's bucket lattice, applied here).
-        n = len(images)
+        n = pixels.shape[0]
         bucket = 1 if n <= 1 else 1 << (n - 1).bit_length()
         if bucket != n:
             pixels = np.concatenate([pixels, np.zeros((bucket - n, *pixels.shape[1:]), pixels.dtype)])
         embeds = np.asarray(self._encode(pixels), np.float32)[:n]
-        return embeds.reshape(-1, embeds.shape[-1]), [self.cfg.num_patches] * n, None
+        counts = [f * self.cfg.num_patches for f in frames_per_item]
+        return embeds.reshape(-1, embeds.shape[-1]), counts, None
 
-    def _encode_qwen2vl(self, images: list[bytes]) -> tuple[np.ndarray, list[int], list]:
+    def _encode_qwen2vl(self, media: list[tuple[str, bytes]]) -> tuple[np.ndarray, list[int], list]:
         import jax
 
-        from dynamo_tpu.models.qwen2_vl import encode_qwen2vl, preprocess_qwen2vl
+        from dynamo_tpu.models.qwen2_vl import (
+            encode_qwen2vl,
+            preprocess_qwen2vl,
+            preprocess_qwen2vl_video,
+        )
 
         outs, counts, grids = [], [], []
-        for data in images:
-            patches, grid = preprocess_qwen2vl(data, self.cfg)
+        for kind, data in media:
+            if kind == "video":
+                patches, grid = preprocess_qwen2vl_video(data, self.cfg)
+            else:
+                patches, grid = preprocess_qwen2vl(data, self.cfg)
             fn = self._encode_by_grid.pop(grid, None)
-            if fn is None:  # one compiled program per image geometry
+            if fn is None:  # one compiled program per media geometry
                 fn = jax.jit(
                     lambda p, x, _cfg=self.cfg, _g=grid: encode_qwen2vl(p, _cfg, x, _g)
                 )
@@ -120,14 +143,18 @@ class EncodeService(AsyncEngine[Any, dict]):
     async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
         import asyncio
 
-        raw = [base64.b64decode(s) for s in request.get("images_b64", [])]
-        if not raw:
-            yield {"error": "no images"}
+        media = [("image", base64.b64decode(s)) for s in request.get("images_b64", [])]
+        media += [
+            (m.get("kind", "image"), base64.b64decode(m["b64"]))
+            for m in request.get("media", [])
+        ]
+        if not media:
+            yield {"error": "no media"}
             return
         embeds, counts, grids = await asyncio.get_running_loop().run_in_executor(
-            None, self._encode_batch, raw
+            None, self._encode_batch, media
         )
-        self.images_encoded += len(raw)
+        self.images_encoded += len(media)
         resp = {
             "embeds_b64": base64.b64encode(np.ascontiguousarray(embeds).tobytes()).decode(),
             "shape": list(embeds.shape),
@@ -164,8 +191,18 @@ def make_encoder(runtime: DistributedRuntime, namespace: str = "dynamo"):
     worker instance."""
     client = runtime.namespace(namespace).component(ENCODE_COMPONENT).endpoint(ENCODE_ENDPOINT).client()
 
-    async def encode(images: list[bytes]) -> tuple[np.ndarray, list[int], list | None]:
-        req = {"images_b64": [base64.b64encode(b).decode() for b in images]}
+    async def encode(media) -> tuple[np.ndarray, list[int], list | None]:
+        """``media``: list of bytes (images, back-compat) or of
+        (kind, bytes) tuples with kind "image" | "video"."""
+        norm = [("image", m) if isinstance(m, bytes) else m for m in media]
+        if all(kind == "image" for kind, _ in norm):
+            # Image-only requests ride the original wire key so a new
+            # frontend keeps working against a not-yet-upgraded worker.
+            req = {"images_b64": [base64.b64encode(b).decode() for _k, b in norm]}
+        else:
+            req = {"media": [
+                {"kind": kind, "b64": base64.b64encode(b).decode()} for kind, b in norm
+            ]}
         async for resp in client.generate(req, Context()):
             if "error" in resp:
                 raise ValueError(f"encode worker: {resp['error']}")

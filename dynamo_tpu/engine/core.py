@@ -165,7 +165,8 @@ class EngineCore:
         img_id = getattr(self.runner.cfg, "image_token_id", None) if hasattr(self.runner, "cfg") else None
         if img_id is None:
             raise ValueError("model has no image placeholder token")
-        n_placeholders = sum(1 for t in request.token_ids if t == img_id)
+        vid_id = getattr(self.runner.cfg, "video_token_id", None)
+        n_placeholders = sum(1 for t in request.token_ids if t == img_id or t == vid_id)
         if n_placeholders != arr.shape[0]:
             raise ValueError(
                 f"{n_placeholders} image placeholders vs {arr.shape[0]} embedding rows"
@@ -351,6 +352,7 @@ class EngineCore:
             d = next(s.mm_embeds.shape[1] for s in batch if s.mm_embeds is not None)
             m = max(s.mm_embeds.shape[0] for s in batch if s.mm_embeds is not None)
             img_id = self.runner.cfg.image_token_id
+            vid_id = self.runner.cfg.video_token_id
             mm = np.zeros((b, m, d), np.float32)
             off = np.full(b, -1, np.int32)  # -1: text row, no substitution
             counts = np.zeros(b, np.int32)
@@ -359,8 +361,9 @@ class EngineCore:
                     mm[i, : s.mm_embeds.shape[0]] = s.mm_embeds
                     counts[i] = s.mm_embeds.shape[0]
                     # Placeholders already covered by cached/previous chunks.
+                    cached = np.asarray(s.tokens[: s.num_cached], np.int32)
                     off[i] = int(np.count_nonzero(
-                        np.asarray(s.tokens[: s.num_cached], np.int32) == img_id
+                        (cached == img_id) | (cached == (vid_id if vid_id is not None else -1))
                     ))
             sb.mm_embeds, sb.mm_slot_offset, sb.mm_counts = mm, off, counts
         if any(s.mrope is not None for s in batch):

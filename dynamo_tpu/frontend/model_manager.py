@@ -125,6 +125,7 @@ async def build_pipeline(
         max_embed_tokens=max(1, min(card.context_length, 2048)),
         encoder=encoder,
         image_token_id=image_token_id,
+        video_token_id=card.extra.get("video_token_id"),
     )
     return pre, client, aux
 

@@ -122,6 +122,8 @@ class WorkerSpec:
                     spec.vision_config = VisionConfig.from_hf_llava(raw_cfg)
                 if mc.image_token_id is not None:
                     card.extra.setdefault("image_token_id", mc.image_token_id)
+                if mc.video_token_id is not None:
+                    card.extra.setdefault("video_token_id", mc.video_token_id)
         return spec
 
     @staticmethod

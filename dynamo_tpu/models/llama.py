@@ -300,6 +300,10 @@ def forward(
     x = params["embed"][tokens]  # [B, T, D]
     if mm_embeds is not None and cfg.image_token_id is not None:
         is_img = tokens == jnp.int32(cfg.image_token_id)  # [B, T]
+        if cfg.video_token_id is not None:
+            # Video placeholders substitute from the same embedding stream,
+            # rows ordered by span position (images and videos interleaved).
+            is_img = is_img | (tokens == jnp.int32(cfg.video_token_id))
         slot = jnp.cumsum(is_img.astype(jnp.int32), axis=1) - 1
         if mm_slot_offset is not None:
             slot = slot + jnp.maximum(mm_slot_offset, 0)[:, None]

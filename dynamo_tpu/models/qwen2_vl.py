@@ -184,24 +184,27 @@ def encode_qwen2vl(
     """One image (or video clip) -> [t*h*w/4, out_dim] merged embeddings.
 
     Matches HF ``Qwen2VisionTransformerPretrainedModel.forward`` for a
-    single grid (attention is full over this image's patches; multi-image
-    batches are block-diagonal there, i.e. exactly a loop over this)."""
+    single grid. Attention is block-diagonal per TEMPORAL slice (HF's
+    cu_seqlens repeat h*w per t): frames of a video don't attend to each
+    other; a still image (t=1) is one full-attention block. Multi-image
+    batches there are additional blocks, i.e. exactly a loop over this."""
     act = (lambda v: v * jax.nn.sigmoid(1.702 * v)) if cfg.act == "quick_gelu" \
         else (lambda v: jax.nn.gelu(v, approximate=False))
     x = patches @ params["patch_embed"]  # [S, D]
     angles = jnp.asarray(_vision_rope_angles(cfg, grid))
     h, hd = cfg.num_heads, cfg.head_dim
+    t, hw = grid[0], grid[1] * grid[2]
     scale = hd**-0.5
 
     def layer_step(x, lp):
         y = _ln(x, lp["ln1"], lp["ln1_b"], cfg.ln_eps)
         qkv = (y @ lp["wqkv"] + lp["bqkv"]).reshape(-1, 3, h, hd)
         q, k, v = _rotate(qkv[:, 0], angles), _rotate(qkv[:, 1], angles), qkv[:, 2]
-        att = jax.nn.softmax(
-            jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale,
-            axis=-1,
-        ).astype(v.dtype)
-        o = jnp.einsum("hqk,khd->qhd", att, v).reshape(-1, cfg.embed_dim)
+        q = q.reshape(t, hw, h, hd).astype(jnp.float32)
+        k = k.reshape(t, hw, h, hd).astype(jnp.float32)
+        v = v.reshape(t, hw, h, hd)
+        att = jax.nn.softmax(jnp.einsum("tqhd,tkhd->thqk", q, k) * scale, axis=-1)
+        o = jnp.einsum("thqk,tkhd->tqhd", att.astype(v.dtype), v).reshape(-1, cfg.embed_dim)
         x = x + (o @ lp["wo"] + lp["bo"])
         y = _ln(x, lp["ln2"], lp["ln2_b"], cfg.ln_eps)
         y = act(y @ lp["w1"] + lp["b1"]) @ lp["w2"] + lp["b2"]
@@ -269,6 +272,34 @@ def patchify_frames(frames: np.ndarray, cfg: Qwen2VLVisionConfig) -> tuple[np.nd
     p = frames.reshape(gt, tp, c, gh // m, m, ps, gw // m, m, ps)
     p = p.transpose(0, 3, 6, 4, 7, 2, 1, 5, 8)
     return p.reshape(gt * gh * gw, c * tp * ps * ps).astype(np.float32), (gt, gh, gw)
+
+
+def preprocess_qwen2vl_video(
+    data: bytes, cfg: Qwen2VLVisionConfig, *, num_frames: int = 8
+) -> tuple[np.ndarray, tuple[int, int, int]]:
+    """Video bytes (animated GIF/APNG/WebP) -> (patches [S, patch_dim],
+    (t, h, w) grid with t = sampled_frames / temporal_patch_size).
+
+    Uniform frame sampling (the reference's video_processor recipe:
+    sample N frames, encode, stack — `examples/multimodal/utils/
+    video_processor.py`), shared smart_resize target across frames so the
+    grid is consistent, then the same merge-group patchify as images with
+    the real temporal axis instead of frame duplication."""
+    from dynamo_tpu.models.vision import extract_frames
+
+    frames_pil = extract_frames(data, num_frames)
+    w0, h0 = frames_pil[0].size
+    factor = cfg.patch_size * cfg.spatial_merge_size
+    h1, w1 = smart_resize(h0, w0, factor, cfg.min_pixels, cfg.max_pixels)
+    mean = np.asarray(cfg.image_mean, np.float32)
+    std = np.asarray(cfg.image_std, np.float32)
+    stack = []
+    for f in frames_pil:
+        from PIL import Image
+
+        arr = np.asarray(f.convert("RGB").resize((w1, h1), Image.BICUBIC), np.float32) / 255.0
+        stack.append(((arr - mean) / std).transpose(2, 0, 1))
+    return patchify_frames(np.stack(stack), cfg)
 
 
 # -- M-RoPE position ids (HF get_rope_index parity) --------------------------

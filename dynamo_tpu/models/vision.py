@@ -248,7 +248,15 @@ def preprocess_image(data: bytes, cfg: VisionConfig) -> np.ndarray:
     squash-resize (its own historical contract)."""
     from PIL import Image
 
-    img = Image.open(io.BytesIO(data)).convert("RGB")
+    return preprocess_pil_image(Image.open(io.BytesIO(data)), cfg)
+
+
+def preprocess_pil_image(img, cfg: VisionConfig) -> np.ndarray:
+    """The resize/crop/normalize tail of :func:`preprocess_image`, for
+    callers that already hold a PIL Image (video frame stacks)."""
+    from PIL import Image
+
+    img = img.convert("RGB")
     if cfg.cls_token:  # CLIP geometry
         w, h = img.size
         scale = cfg.image_size / min(w, h)
@@ -263,6 +271,46 @@ def preprocess_image(data: bytes, cfg: VisionConfig) -> np.ndarray:
     mean = np.asarray(cfg.image_mean, np.float32)
     std = np.asarray(cfg.image_std, np.float32)
     return (arr - mean) / std
+
+
+def extract_frames(data: bytes, num_frames: int):
+    """Uniformly sample up to ``num_frames`` frames from an animated image
+    container (GIF/APNG/WebP — the formats PIL decodes without ffmpeg;
+    zero-egress environments have no video codecs). Returns
+    ``min(available, num_frames)`` PIL Images — a still image yields one.
+
+    Only the sampled frames are decoded (seek, not full iteration): a long
+    clip must not materialize thousands of RGB frames to pick 8.
+
+    Parity: the reference's video workers sample frames with decord/ffmpeg
+    before per-frame encoding (`examples/multimodal/components/
+    video_encode_worker.py`); the sampling recipe (uniform over the clip)
+    is the same."""
+    import io as _io
+
+    from PIL import Image
+
+    img = Image.open(_io.BytesIO(data))
+    total = getattr(img, "n_frames", 1)
+    if total <= 0:
+        raise ValueError("no decodable frames in video payload")
+    idx = (np.linspace(0, total - 1, num_frames).round().astype(int)
+           if total > num_frames else np.arange(total))
+    out = []
+    for i in idx:
+        img.seek(int(i))
+        out.append(img.copy().convert("RGB"))
+    return out
+
+
+def preprocess_video(data: bytes, cfg: VisionConfig, *, num_frames: int = 8) -> np.ndarray:
+    """Video bytes -> [T, H, W, 3] float32 frame stack for fixed-geometry
+    (CLIP/LLaVA) towers: each sampled frame goes through the tower's own
+    image geometry; the encode worker encodes the stack as a frame batch
+    and concatenates the embeddings (reference video_prefill recipe)."""
+    return np.stack([
+        preprocess_pil_image(f, cfg) for f in extract_frames(data, num_frames)
+    ])
 
 
 def decode_data_url(url: str) -> bytes:

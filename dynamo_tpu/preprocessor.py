@@ -99,8 +99,9 @@ class OpenAIPreprocessor(Operator):
         default_max_tokens: int = 512,
         add_bos: bool = True,
         max_embed_tokens: int = 2048,
-        encoder=None,  # async (images: list[bytes]) -> (embeds, patches_per_image)
+        encoder=None,  # async (media: [(kind, bytes)]) -> (embeds, counts, grids|None)
         image_token_id: int | None = None,
+        video_token_id: int | None = None,
     ) -> None:
         super().__init__(downstream)
         self.tokenizer = tokenizer
@@ -110,18 +111,24 @@ class OpenAIPreprocessor(Operator):
         self.max_embed_tokens = max_embed_tokens
         self.encoder = encoder
         self.image_token_id = image_token_id
+        # Models without a distinct video placeholder (LLaVA-class) expand
+        # video frames under the image token, like the reference's video
+        # prefill workers do.
+        self.video_token_id = video_token_id
 
     IMAGE_SENTINEL = "<|dyn_image|>"
 
-    def _extract_images(self, body: dict[str, Any]) -> tuple[dict[str, Any], list[bytes]]:
-        """Pull data-URL images out of chat content parts; each becomes a
+    def _extract_images(self, body: dict[str, Any]) -> tuple[dict[str, Any], list]:
+        """Pull data-URL media out of chat content parts; each becomes a
         sentinel in the flattened text that tokenization replaces with
-        image placeholder tokens. Returns (copied body, images in order)."""
+        placeholder tokens. Returns (copied body, [(kind, bytes)] in
+        order) — kind "image" (``image_url`` parts) or "video"
+        (``video_url`` parts, reference video workers)."""
         from dynamo_tpu.models.vision import decode_data_url
 
-        images: list[bytes] = []
+        media: list = []
         if not isinstance(body.get("messages"), list):
-            return body, images
+            return body, media
         out = dict(body)
         messages = []
         for msg in body["messages"]:
@@ -130,16 +137,19 @@ class OpenAIPreprocessor(Operator):
                 parts = []
                 for part in content:
                     if isinstance(part, dict) and part.get("type") == "image_url":
-                        images.append(decode_data_url(part["image_url"]["url"]))
+                        media.append(("image", decode_data_url(part["image_url"]["url"])))
+                        parts.append(self.IMAGE_SENTINEL)
+                    elif isinstance(part, dict) and part.get("type") == "video_url":
+                        media.append(("video", decode_data_url(part["video_url"]["url"])))
                         parts.append(self.IMAGE_SENTINEL)
                     elif isinstance(part, dict) and part.get("type") == "text":
                         parts.append(part.get("text", ""))
                 msg = {**msg, "content": "".join(parts)}
             messages.append(msg)
         out["messages"] = messages
-        return out, images
+        return out, media
 
-    def preprocess(self, body: dict[str, Any], *, image_patches: list[int] | None = None) -> PreprocessedRequest:
+    def preprocess(self, body: dict[str, Any], *, image_patches: list[tuple[int, int]] | None = None) -> PreprocessedRequest:
         prompt: str | None
         token_ids: list[int] | None = None
         if "messages" in body:
@@ -163,15 +173,16 @@ class OpenAIPreprocessor(Operator):
                 raise ValueError("unsupported 'prompt' type: expected string, token-id array, or single-element string array")
         if token_ids is None:
             if image_patches and prompt is not None:
+                # image_patches: per-media (count, placeholder_token_id).
                 segments = prompt.split(self.IMAGE_SENTINEL)
                 if len(segments) != len(image_patches) + 1:
                     raise ValueError(
-                        f"{len(segments) - 1} image sentinels in the rendered prompt "
-                        f"vs {len(image_patches)} images (does the chat template drop content?)"
+                        f"{len(segments) - 1} media sentinels in the rendered prompt "
+                        f"vs {len(image_patches)} media items (does the chat template drop content?)"
                     )
                 token_ids = self.tokenizer.encode(segments[0], add_bos=self.add_bos)
-                for n_patches, seg in zip(image_patches, segments[1:]):
-                    token_ids += [self.image_token_id] * n_patches
+                for (n_patches, tok_id), seg in zip(image_patches, segments[1:]):
+                    token_ids += [tok_id] * n_patches
                     if seg:
                         token_ids += self.tokenizer.encode(seg, add_bos=False)
             else:
@@ -219,14 +230,19 @@ class OpenAIPreprocessor(Operator):
         if not isinstance(request, dict):
             raise TypeError(f"preprocessor expects an OpenAI body dict, got {type(request)}")
         if self.encoder is not None and self.image_token_id is not None:
-            body, images = self._extract_images(request)
-            if images:
+            body, media = self._extract_images(request)
+            if media:
                 import base64
 
                 import numpy as np
 
-                embeds, patches, grids = await self.encoder(images)
-                req = self.preprocess(body, image_patches=patches)
+                embeds, patches, grids = await self.encoder(media)
+                expansion = [
+                    (n, self.video_token_id if kind == "video" and self.video_token_id is not None
+                     else self.image_token_id)
+                    for n, (kind, _b) in zip(patches, media)
+                ]
+                req = self.preprocess(body, image_patches=expansion)
                 req.mm_inputs = {
                     "embeds_b64": base64.b64encode(
                         np.ascontiguousarray(embeds, np.float32).tobytes()

@@ -1,0 +1,199 @@
+"""Video multimodal pipeline (VERDICT r4 item 7).
+
+Golden: a Qwen2-VL video (temporal grid t>1) must reproduce HF logits —
+pinning per-frame block-diagonal tower attention, the temporal patchify,
+video M-RoPE coords (t axis advances per temporal group), and video
+placeholder substitution. E2E: a served video_url request produces tokens
+and the frame-count/placeholder accounting holds, for both the Qwen2-VL
+native path and the LLaVA frame-stack path.
+
+Reference: `examples/multimodal/components/video_encode_worker.py`,
+`video_decode_worker.py`, `video_processor.py` (frame sampling -> encode ->
+embeddings handed to prefill).
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax.numpy as jnp  # noqa: E402
+
+from dynamo_tpu.models import llama  # noqa: E402
+from dynamo_tpu.models.loader import load_vlm  # noqa: E402
+from dynamo_tpu.models.qwen2_vl import (  # noqa: E402
+    TEST_TINY_QWEN2VL_VISION,
+    encode_qwen2vl,
+    mrope_position_ids,
+    patchify_frames,
+)
+from tests.test_golden_qwen2vl import IMAGE_TOKEN, VIDEO_TOKEN, VISION_START, _tiny_qwen2vl  # noqa: E402
+
+
+def _gif(num_frames=4, size=(32, 24)):
+    """Animated GIF whose frames differ (content must matter)."""
+    from PIL import Image
+
+    frames = []
+    for i in range(num_frames):
+        img = Image.new("RGB", size, ((i * 60) % 256, 30, (255 - i * 50) % 256))
+        px = img.load()
+        for x in range(size[0]):
+            px[x, (x + i) % size[1]] = (255, 255, 0)
+        frames.append(img)
+    buf = io.BytesIO()
+    frames[0].save(buf, format="GIF", save_all=True, append_images=frames[1:],
+                   duration=100, loop=0)
+    return buf.getvalue()
+
+
+def test_golden_qwen2vl_video_logits(tmp_path):
+    m = _tiny_qwen2vl()
+    m.save_pretrained(str(tmp_path), safe_serialization=True)
+    tcfg, vcfg, lm_params, vis_params = load_vlm(tmp_path, dtype="float32")
+
+    # 4 frames -> temporal grid t=2 at temporal_patch_size 2.
+    rng = np.random.default_rng(3)
+    frames = rng.standard_normal((4, 3, 24, 32)).astype(np.float32) * 0.4
+    patches, grid = patchify_frames(frames, TEST_TINY_QWEN2VL_VISION)
+    assert grid[0] == 2
+    n_vid = grid[0] * grid[1] * grid[2] // 4
+    prompt = [3, VISION_START] + [VIDEO_TOKEN] * n_vid + [7, 42]
+    t = len(prompt)
+
+    with torch.no_grad():
+        hf_logits = m(
+            input_ids=torch.tensor([prompt]),
+            pixel_values_videos=torch.tensor(patches),
+            video_grid_thw=torch.tensor([list(grid)]),
+        ).logits[0].float().numpy()
+
+    mm = encode_qwen2vl(vis_params, vcfg, jnp.asarray(patches), grid)
+    assert mm.shape == (n_vid, 64)
+    pos3, _delta = mrope_position_ids(
+        prompt, [grid], image_token_id=IMAGE_TOKEN, video_token_id=VIDEO_TOKEN,
+    )
+    # Temporal coordinate advances across the video's frame groups.
+    vid_cols = pos3[0, 2 : 2 + n_vid]
+    assert vid_cols.max() > vid_cols.min()
+
+    page_size = 8
+    k_cache, v_cache = llama.init_kv_cache(tcfg, num_pages=16, page_size=page_size)
+    n_pages = -(-t // page_size)
+    tables = jnp.asarray([list(range(1, 1 + n_pages))], jnp.int32)
+    positions = jnp.arange(t, dtype=jnp.int32)[None]
+    slots = jnp.take_along_axis(tables, positions // page_size, axis=1) * page_size + positions % page_size
+    ours, _, _ = llama.forward(
+        lm_params, tcfg, jnp.asarray([prompt], jnp.int32), positions,
+        k_cache, v_cache, tables, slots, jnp.asarray([t - 1], jnp.int32),
+        mm_embeds=mm[None], mrope_positions=jnp.asarray(pos3)[None],
+    )
+    np.testing.assert_allclose(np.asarray(ours)[0], hf_logits[t - 1], atol=2e-3, rtol=1e-3)
+
+
+@pytest.mark.e2e
+async def test_video_request_served_e2e_qwen2vl(tmp_path):
+    """Served video_url request through the full stack: frame sampling ->
+    temporal tower -> video placeholders -> M-RoPE prefill -> tokens."""
+    import base64
+
+    import aiohttp
+
+    from dynamo_tpu.launch import run_local
+
+    m = _tiny_qwen2vl()
+    m.save_pretrained(str(tmp_path), safe_serialization=True)
+    name = tmp_path.name
+    url = "data:image/gif;base64," + base64.b64encode(_gif()).decode()
+
+    handles = await run_local(str(tmp_path), port=0, num_pages=256, max_batch_size=4)
+    base = f"http://127.0.0.1:{handles['port']}"
+    try:
+        body = {
+            "model": name,
+            "messages": [{"role": "user", "content": [
+                {"type": "text", "text": "what happens? "},
+                {"type": "video_url", "video_url": {"url": url}},
+            ]}],
+            "max_tokens": 5, "temperature": 0,
+        }
+        async with aiohttp.ClientSession() as s:
+            async with s.post(base + "/v1/chat/completions", json=body) as r:
+                assert r.status == 200, await r.text()
+                out = await r.json()
+        assert out["choices"][0]["message"]["content"]
+        # Placeholder accounting: the video expanded to t*h*w/4 tokens under
+        # the VIDEO token id, all covered by embeddings (engine would have
+        # rejected a mismatch).
+        from dynamo_tpu.encode import EncodeService
+        enc = next(sv for sv in handles["services"] if isinstance(sv, EncodeService))
+        assert enc.images_encoded == 1
+        (grid,) = enc._encode_by_grid  # one video geometry compiled
+        assert grid[0] >= 2  # real temporal extent
+        assert out["usage"]["prompt_tokens"] > grid[0] * grid[1] * grid[2] // 4
+    finally:
+        await handles["http"].stop()
+        await handles["watcher"].close()
+        for svc in handles["services"]:
+            await svc.close()
+        await handles["runtime"].close()
+
+
+@pytest.mark.e2e
+async def test_video_request_served_e2e_llava(tmp_path):
+    """LLaVA-class tower: a video becomes a sampled frame stack through the
+    image tower; placeholders expand to frames * num_patches under the image
+    token (the reference's video_prefill recipe)."""
+    import base64
+
+    import aiohttp
+
+    from tests.test_golden_vision import _tiny_llava
+
+    from dynamo_tpu.launch import run_local
+
+    m = _tiny_llava()
+    m.save_pretrained(str(tmp_path), safe_serialization=True)
+    name = tmp_path.name
+    url = "data:image/gif;base64," + base64.b64encode(_gif(num_frames=6)).decode()
+
+    handles = await run_local(str(tmp_path), port=0, num_pages=256, max_batch_size=4)
+    base = f"http://127.0.0.1:{handles['port']}"
+    try:
+        body = {
+            "model": name,
+            "messages": [{"role": "user", "content": [
+                {"type": "text", "text": "clip: "},
+                {"type": "video_url", "video_url": {"url": url}},
+            ]}],
+            "max_tokens": 4, "temperature": 0,
+        }
+        async with aiohttp.ClientSession() as s:
+            async with s.post(base + "/v1/chat/completions", json=body) as r:
+                assert r.status == 200, await r.text()
+                out = await r.json()
+        assert out["choices"][0]["message"]["content"]
+        # 6 frames x 16 patches = 96 placeholders + text.
+        assert out["usage"]["prompt_tokens"] > 96
+    finally:
+        await handles["http"].stop()
+        await handles["watcher"].close()
+        for svc in handles["services"]:
+            await svc.close()
+        await handles["runtime"].close()
+
+
+def test_extract_frames_sampling():
+    from dynamo_tpu.models.vision import extract_frames
+
+    frames = extract_frames(_gif(num_frames=10), 4)
+    assert len(frames) == 4
+    # A still PNG yields one frame.
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.new("RGB", (8, 8), (1, 2, 3)).save(buf, format="PNG")
+    assert len(extract_frames(buf.getvalue(), 4)) == 1
