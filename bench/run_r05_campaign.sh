@@ -1,0 +1,57 @@
+#!/bin/bash
+# Round-5 on-chip pareto campaign (VERDICT r4 items 1 and 5).
+# Sequential: the chip fits one engine config at a time.
+set -x
+cd "$(dirname "$0")/.."
+mkdir -p bench/results
+export DYNAMO_MOE_DISPATCH=  # not MoE configs; keep defaults
+
+# 1. 8B int8 @ ISL 3000 / OSL 150, agg, conc 1..12 (HBM-bound ceiling:
+#    8.1 GB weights + 0.42 GB KV/seq).
+timeout 5400 python -m dynamo_tpu.bench \
+  --model llama-3-8b --quantize int8 --topologies agg \
+  --levels 1,4,8,12 --num-requests 24 \
+  --shared-prefix 1024 --groups 4 --group-prefix 1024 --unique-len 952 --osl 150 \
+  --num-pages 336 --max-batch-size 12 --page-size 128 --max-seq-len 3328 \
+  --max-prefill-tokens 4096 --decode-steps 8 \
+  > bench/results/pareto_isl3000_8b_int8_r05.json \
+  2> bench/results/pareto_isl3000_8b_int8_r05.log
+
+# 2. MLA-8B proxy int8 @ ISL 3000 / OSL 150, agg, conc 1..32 (latent cache
+#    is 3.2x smaller per token).
+timeout 5400 python -m dynamo_tpu.bench \
+  --model mla-8b-proxy --quantize int8 --topologies agg \
+  --levels 1,8,16,32 --num-requests 64 \
+  --shared-prefix 1024 --groups 4 --group-prefix 1024 --unique-len 952 --osl 150 \
+  --num-pages 848 --max-batch-size 32 --page-size 128 --max-seq-len 3328 \
+  --max-prefill-tokens 4096 --decode-steps 8 \
+  > bench/results/pareto_isl3000_mla_r05.json \
+  2> bench/results/pareto_isl3000_mla_r05.log
+
+# 3. Agg vs disagg on the 1B, same chip, real dual-engine device path.
+timeout 5400 python -m dynamo_tpu.bench \
+  --model llama-3.2-1b --topologies agg,disagg \
+  --levels 1,8,32 --num-requests 64 --workers 1 --prefill-workers 1 \
+  --disagg-threshold 256 \
+  --shared-prefix 512 --groups 4 --group-prefix 384 --unique-len 256 --osl 150 \
+  --num-pages 512 --max-batch-size 32 --page-size 128 --max-seq-len 1536 \
+  --max-prefill-tokens 4096 --decode-steps 8 \
+  > bench/results/pareto_agg_vs_disagg_1b_r05.json \
+  2> bench/results/pareto_agg_vs_disagg_1b_r05.log
+
+# 4. Mocker-fleet agg vs disagg (multi-worker shape, CPU platform).
+timeout 1800 python - <<'EOF' \
+  > bench/results/pareto_agg_vs_disagg_mock_r05.json \
+  2> bench/results/pareto_agg_vs_disagg_mock_r05.log
+import jax
+jax.config.update("jax_platforms", "cpu")
+from dynamo_tpu.bench.__main__ import main
+main([
+    "--model", "test-tiny", "--mock", "--topologies", "agg,disagg",
+    "--levels", "1,8,32", "--num-requests", "64", "--workers", "2",
+    "--prefill-workers", "2", "--disagg-threshold", "64",
+    "--shared-prefix", "64", "--group-prefix", "64", "--unique-len", "64",
+    "--osl", "48", "--num-pages", "4096", "--max-batch-size", "32",
+])
+EOF
+echo CAMPAIGN-DONE

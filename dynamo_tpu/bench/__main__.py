@@ -93,7 +93,26 @@ async def _amain(args: argparse.Namespace) -> None:
         synthesize(dataclasses.replace(cfg, seed=cfg.seed + 1000 * i))
         for i in range(len(levels))
     ]
+    import jax
+
     report: dict = {
+        # The model/engine config lives INSIDE the artifact: an unlabeled
+        # pareto row is unreproducible (VERDICT r4 weak #2).
+        "model": args.model,
+        "quantize": args.quantize or "bf16",
+        "backend": jax.default_backend(),
+        "engine": {
+            "workers": args.workers,
+            "prefill_workers": args.prefill_workers,
+            "num_pages": args.num_pages,
+            "max_batch_size": args.max_batch_size,
+            "page_size": args.page_size or "default",
+            "max_seq_len": args.max_seq_len or "default",
+            "max_prefill_tokens": args.max_prefill_tokens or "default",
+            "decode_steps": args.decode_steps or "default",
+            "disagg_threshold": args.disagg_threshold,
+            "mock": args.mock,
+        },
         "workload": {
             "num_requests": cfg.num_requests,
             "isl": cfg.shared_prefix_len + cfg.group_prefix_len + cfg.unique_len,

@@ -69,6 +69,20 @@ def main(argv: list[str] | None = None) -> None:
     p_man.add_argument("--image", default=None)
     p_man.add_argument("--crd", action="store_true", help="print the CRD instead")
 
+    p_helm = sub.add_parser("helm", help="write a Helm chart for a graph")
+    p_helm.add_argument("graph", help="module:Service ref")
+    p_helm.add_argument("--name", default="dynamo")
+    p_helm.add_argument("-f", "--config", default=None)
+    p_helm.add_argument("--image", default=None)
+    p_helm.add_argument("-o", "--out", required=True, help="chart output directory")
+
+    p_gw = sub.add_parser("gateway", help="print Gateway API ingress assets")
+    p_gw.add_argument("graph", help="module:Service ref")
+    p_gw.add_argument("--name", default="dynamo")
+    p_gw.add_argument("-f", "--config", default=None)
+    p_gw.add_argument("--gateway-class", default="istio")
+    p_gw.add_argument("--model", action="append", default=[], help="InferenceModel entries")
+
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -87,6 +101,36 @@ def main(argv: list[str] | None = None) -> None:
             name=args.name, graph=args.graph, config=load_service_config(args.config)
         )
         print(render_bundle(dep, load_graph(args.graph), image=args.image or DEFAULT_IMAGE))
+        return
+    if args.cmd == "helm":
+        from dynamo_tpu.deploy.helm import render_helm_chart, write_chart
+        from dynamo_tpu.deploy.manifests import DEFAULT_IMAGE
+        from dynamo_tpu.deploy.objects import GraphDeployment
+        from dynamo_tpu.sdk.graph import load_graph
+        from dynamo_tpu.sdk.serving import load_service_config
+
+        dep = GraphDeployment(
+            name=args.name, graph=args.graph, config=load_service_config(args.config)
+        )
+        files = render_helm_chart(
+            dep, load_graph(args.graph), image=args.image or DEFAULT_IMAGE
+        )
+        write_chart(files, args.out)
+        print(f"wrote {len(files)} chart files to {args.out}")
+        return
+    if args.cmd == "gateway":
+        from dynamo_tpu.deploy.helm import render_gateway_bundle
+        from dynamo_tpu.deploy.objects import GraphDeployment
+        from dynamo_tpu.sdk.graph import load_graph
+        from dynamo_tpu.sdk.serving import load_service_config
+
+        dep = GraphDeployment(
+            name=args.name, graph=args.graph, config=load_service_config(args.config)
+        )
+        print(render_gateway_bundle(
+            dep, load_graph(args.graph),
+            gateway_class=args.gateway_class, models=args.model or None,
+        ))
         return
 
     async def run() -> None:

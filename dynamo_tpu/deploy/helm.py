@@ -1,0 +1,219 @@
+"""Helm chart + Gateway API asset rendering for graph deployments.
+
+``python -m dynamo_tpu.deploy helm graphs.agg:Frontend -o chart/`` writes a
+self-contained Helm chart whose templates are generated FROM the same
+manifest renderer the operator applies (`deploy/manifests.py`) — the chart
+can never drift from what the reconciler would produce. Tunables (the
+image and per-service replicas) are lifted into ``values.yaml``; ports and
+commands stay baked into the templates, as in the rendered manifests.
+
+``render_gateway`` emits the Gateway API ingress assets: a Gateway, an
+HTTPRoute to the frontend Service, and an InferencePool/InferenceModel
+pair (Gateway API Inference Extension). The reference deploys a separate
+endpoint-picker service (EPP) for model-aware routing
+(`deploy/inference-gateway/example/resources/`); here the KV-aware router
+is first-party inside the frontend, so the route points straight at it and
+the pool documents that distinction.
+
+Parity: reference `deploy/helm/chart/{Chart,values}.yaml` + templates and
+`deploy/inference-gateway/example/` (VERDICT r4 missing #6).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import yaml
+
+from dynamo_tpu.deploy.manifests import DEFAULT_IMAGE, render_deployment
+from dynamo_tpu.deploy.objects import GraphDeployment
+from dynamo_tpu.sdk.graph import Graph
+
+CHART_VERSION = "0.1.0"
+
+# Sentinel -> Go-template expression. Sentinels survive yaml.safe_dump
+# (plain strings); the post-pass swaps them in UNQUOTED so numeric fields
+# render as numbers, which a naive "quote the template" approach breaks.
+# The tag is deliberately improbable: user config is embedded verbatim in
+# the ConfigMap, so a generic marker (e.g. '@@x@@') could collide with
+# config content and corrupt it.
+_TAG = "dyntpl-c4a91b"
+
+
+def _t(expr: str) -> str:
+    return f"@@{_TAG}:{expr}@@"
+
+
+def _untemplate(text: str) -> str:
+    # Quoted-whole-scalar form first (strip the dumper's quotes), then bare.
+    text = re.sub(rf"'@@{_TAG}:(.+?)@@'", r"{{ \1 }}", text)
+    return re.sub(rf"@@{_TAG}:(.+?)@@", r"{{ \1 }}", text)
+
+
+def _values_key(name: str) -> str:
+    """Service/component name -> a valid Go-template map key."""
+    return re.sub(r"[^a-zA-Z0-9_]", "_", name)
+
+
+def render_helm_chart(
+    dep: GraphDeployment,
+    graph: Graph,
+    *,
+    image: str = DEFAULT_IMAGE,
+) -> dict[str, str]:
+    """-> {relative path: file content} for a complete chart."""
+    docs = render_deployment(dep, graph, image=image)
+    values: dict[str, Any] = {"image": image, "services": {}}
+
+    templates: dict[str, list[dict]] = {}
+    for doc in docs:
+        kind = doc["kind"]
+        name = doc["metadata"]["name"]
+        # Lift tunables into values, replacing them with sentinels.
+        if kind == "Deployment":
+            key = _values_key(name.removeprefix(f"{dep.name}-"))
+            values["services"][key] = {"replicas": doc["spec"]["replicas"]}
+            doc["spec"]["replicas"] = _t(f"int .Values.services.{key}.replicas")
+            for c in doc["spec"]["template"]["spec"]["containers"]:
+                c["image"] = _t(".Values.image")
+        fname = f"{kind.lower()}s.yaml"
+        templates.setdefault(fname, []).append(doc)
+
+    chart = {
+        "apiVersion": "v2",
+        "name": dep.name,
+        "description": f"dynamo-tpu graph deployment {dep.graph}",
+        "type": "application",
+        "version": CHART_VERSION,
+        "appVersion": CHART_VERSION,
+    }
+    files = {
+        "Chart.yaml": yaml.safe_dump(chart, sort_keys=False),
+        "values.yaml": yaml.safe_dump(values, sort_keys=False),
+        ".helmignore": "*.tgz\n",
+    }
+    for fname, docs_ in templates.items():
+        files[f"templates/{fname}"] = _untemplate(
+            "---\n".join(yaml.safe_dump(d, sort_keys=False) for d in docs_)
+        )
+    return files
+
+
+def write_chart(files: dict[str, str], out_dir: str) -> None:
+    import pathlib
+
+    root = pathlib.Path(out_dir)
+    for rel, content in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(content)
+
+
+def render_gateway(
+    dep: GraphDeployment,
+    graph: Graph,
+    *,
+    gateway_class: str = "istio",
+    models: list[str] | None = None,
+) -> list[dict[str, Any]]:
+    """Gateway API ingress for the deployment's frontend service."""
+    from dynamo_tpu.sdk.serving import _section_for
+
+    frontend = None
+    port = 0
+    for spec in graph.services:
+        section = _section_for(dep.config, spec)
+        p = int(section.get("http_port", 0))
+        if p:
+            frontend, port = f"{dep.name}-{spec.component}", p
+            break
+    if frontend is None:
+        raise ValueError("graph has no service with an http_port (no frontend to route to)")
+    labels = {"dynamo.tpu/deployment": dep.name}
+    docs: list[dict[str, Any]] = [
+        {
+            "apiVersion": "gateway.networking.k8s.io/v1",
+            "kind": "Gateway",
+            "metadata": {"name": f"{dep.name}-gateway", "labels": labels},
+            "spec": {
+                "gatewayClassName": gateway_class,
+                "listeners": [
+                    {"name": "http", "protocol": "HTTP", "port": 80,
+                     "allowedRoutes": {"namespaces": {"from": "Same"}}}
+                ],
+            },
+        },
+        {
+            "apiVersion": "gateway.networking.k8s.io/v1",
+            "kind": "HTTPRoute",
+            "metadata": {"name": f"{dep.name}-route", "labels": labels},
+            "spec": {
+                "parentRefs": [{"name": f"{dep.name}-gateway"}],
+                "rules": [
+                    {
+                        "matches": [{"path": {"type": "PathPrefix", "value": "/v1"}}],
+                        "backendRefs": [{"name": frontend, "port": port}],
+                    }
+                ],
+            },
+        },
+        # Inference Extension pool: model-aware endpoint picking is done by
+        # the FRONTEND's first-party KV router (router/scheduler.py), not an
+        # external EPP sidecar — the pool targets the frontend pods and the
+        # extensionRef is intentionally absent (reference: dynamo-epp.yaml).
+        {
+            "apiVersion": "inference.networking.x-k8s.io/v1alpha2",
+            "kind": "InferencePool",
+            "metadata": {"name": f"{dep.name}-pool", "labels": labels},
+            "spec": {
+                "targetPortNumber": port,
+                "selector": {"app": frontend},
+            },
+        },
+    ]
+    for model in models or []:
+        docs.append({
+            "apiVersion": "inference.networking.x-k8s.io/v1alpha2",
+            "kind": "InferenceModel",
+            "metadata": {
+                "name": re.sub(r"[^a-z0-9.-]", "-", model.lower())[:253],
+                "labels": labels,
+            },
+            "spec": {
+                "modelName": model,
+                "criticality": "Critical",
+                "poolRef": {"name": f"{dep.name}-pool"},
+            },
+        })
+    return docs
+
+
+def render_gateway_bundle(dep: GraphDeployment, graph: Graph, **kw: Any) -> str:
+    return "---\n".join(
+        yaml.safe_dump(d, sort_keys=False) for d in render_gateway(dep, graph, **kw)
+    )
+
+
+def simulate_helm_template(files: dict[str, str]) -> list[dict[str, Any]]:
+    """Minimal `helm template` stand-in for tests (no helm binary in the
+    image): substitutes ``{{ [int] .Values.x.y }}`` from values.yaml and
+    parses every template document."""
+    values = yaml.safe_load(files["values.yaml"])
+
+    def resolve(m: re.Match) -> str:
+        expr = m.group(1).strip()
+        expr = expr.removeprefix("int ").strip()
+        node: Any = values
+        assert expr.startswith(".Values."), expr
+        for part in expr[len(".Values."):].split("."):
+            node = node[part]
+        return str(node)
+
+    docs: list[dict[str, Any]] = []
+    for rel, content in files.items():
+        if not rel.startswith("templates/"):
+            continue
+        rendered = re.sub(r"\{\{(.+?)\}\}", resolve, content)
+        docs.extend(d for d in yaml.safe_load_all(rendered) if d)
+    return docs

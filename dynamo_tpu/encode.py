@@ -60,8 +60,17 @@ class EncodeService(AsyncEngine[Any, dict]):
             init_qwen2vl_vision_params,
         )
 
+        import os
+
         self.cfg = cfg
         self.is_qwen2vl = isinstance(cfg, Qwen2VLVisionConfig)
+        # Video sampling: DYNAMO_VIDEO_FRAMES frames per clip, clamped for
+        # fixed-geometry towers so frames * num_patches stays within
+        # DYNAMO_VIDEO_EMBED_BUDGET LLM tokens (an unclamped 8-frame default
+        # at LLaVA's 576 patches/frame would exceed typical contexts and
+        # reject every video request).
+        self.video_frames = int(os.environ.get("DYNAMO_VIDEO_FRAMES", "8"))
+        self.video_embed_budget = int(os.environ.get("DYNAMO_VIDEO_EMBED_BUDGET", "2048"))
         if self.is_qwen2vl:
             self.params = params if params is not None else init_qwen2vl_vision_params(cfg, 0)
             # Per-grid compiled programs, LRU-bounded: aspect-preserving
@@ -87,10 +96,12 @@ class EncodeService(AsyncEngine[Any, dict]):
         # Fixed-geometry tower: videos become frame stacks through the same
         # tower; an item's embedding rows = frames * num_patches (reference
         # video_prefill recipe). Frames and stills share one batched encode.
+        nf = max(1, min(self.video_frames,
+                        self.video_embed_budget // max(self.cfg.num_patches, 1)))
         pixels_list, frames_per_item = [], []
         for kind, data in media:
             if kind == "video":
-                stack = preprocess_video(data, self.cfg)
+                stack = preprocess_video(data, self.cfg, num_frames=nf)
                 pixels_list.extend(stack)
                 frames_per_item.append(stack.shape[0])
             else:
@@ -119,7 +130,9 @@ class EncodeService(AsyncEngine[Any, dict]):
         outs, counts, grids = [], [], []
         for kind, data in media:
             if kind == "video":
-                patches, grid = preprocess_qwen2vl_video(data, self.cfg)
+                patches, grid = preprocess_qwen2vl_video(
+                    data, self.cfg, num_frames=self.video_frames
+                )
             else:
                 patches, grid = preprocess_qwen2vl(data, self.cfg)
             fn = self._encode_by_grid.pop(grid, None)

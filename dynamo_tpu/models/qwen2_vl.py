@@ -246,15 +246,28 @@ def preprocess_qwen2vl(data: bytes, cfg: Qwen2VLVisionConfig) -> tuple[np.ndarra
 
     from PIL import Image
 
-    img = Image.open(io.BytesIO(data)).convert("RGB")
-    w0, h0 = img.size
-    factor = cfg.patch_size * cfg.spatial_merge_size
-    h1, w1 = smart_resize(h0, w0, factor, cfg.min_pixels, cfg.max_pixels)
-    img = img.resize((w1, h1), Image.BICUBIC)
-    arr = np.asarray(img, np.float32) / 255.0
-    arr = (arr - np.asarray(cfg.image_mean, np.float32)) / np.asarray(cfg.image_std, np.float32)
-    frames = np.repeat(arr.transpose(2, 0, 1)[None], cfg.temporal_patch_size, axis=0)  # [T, C, H, W]
+    img = Image.open(io.BytesIO(data))
+    arr = _normalize_frame(img, cfg, _resize_target(img.size, cfg))
+    frames = np.repeat(arr[None], cfg.temporal_patch_size, axis=0)  # [T, C, H, W]
     return patchify_frames(frames, cfg)
+
+
+def _resize_target(size_wh: tuple[int, int], cfg: Qwen2VLVisionConfig) -> tuple[int, int]:
+    w0, h0 = size_wh
+    factor = cfg.patch_size * cfg.spatial_merge_size
+    return smart_resize(h0, w0, factor, cfg.min_pixels, cfg.max_pixels)
+
+
+def _normalize_frame(img, cfg: Qwen2VLVisionConfig, target_hw: tuple[int, int]) -> np.ndarray:
+    """PIL image -> [C, H, W] float32, resized (bicubic) + normalized — the
+    shared tail of the image and video paths (an HF-parity fix here fixes
+    both)."""
+    from PIL import Image
+
+    h1, w1 = target_hw
+    arr = np.asarray(img.convert("RGB").resize((w1, h1), Image.BICUBIC), np.float32) / 255.0
+    arr = (arr - np.asarray(cfg.image_mean, np.float32)) / np.asarray(cfg.image_std, np.float32)
+    return arr.transpose(2, 0, 1)
 
 
 def patchify_frames(frames: np.ndarray, cfg: Qwen2VLVisionConfig) -> tuple[np.ndarray, tuple[int, int, int]]:
@@ -288,18 +301,10 @@ def preprocess_qwen2vl_video(
     from dynamo_tpu.models.vision import extract_frames
 
     frames_pil = extract_frames(data, num_frames)
-    w0, h0 = frames_pil[0].size
-    factor = cfg.patch_size * cfg.spatial_merge_size
-    h1, w1 = smart_resize(h0, w0, factor, cfg.min_pixels, cfg.max_pixels)
-    mean = np.asarray(cfg.image_mean, np.float32)
-    std = np.asarray(cfg.image_std, np.float32)
-    stack = []
-    for f in frames_pil:
-        from PIL import Image
-
-        arr = np.asarray(f.convert("RGB").resize((w1, h1), Image.BICUBIC), np.float32) / 255.0
-        stack.append(((arr - mean) / std).transpose(2, 0, 1))
-    return patchify_frames(np.stack(stack), cfg)
+    target = _resize_target(frames_pil[0].size, cfg)
+    return patchify_frames(
+        np.stack([_normalize_frame(f, cfg, target) for f in frames_pil]), cfg
+    )
 
 
 # -- M-RoPE position ids (HF get_rope_index parity) --------------------------
