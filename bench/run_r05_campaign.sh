@@ -54,4 +54,10 @@ main([
     "--osl", "48", "--num-pages", "4096", "--max-batch-size", "32",
 ])
 EOF
+# A killed/failed phase leaves an empty or unparseable artifact: rename it
+# .failed so nothing downstream mistakes a dead run for a result.
+for f in bench/results/pareto_*_r05.json; do
+  python -c "import json,sys; json.load(open(sys.argv[1]))" "$f" 2>/dev/null \
+    || { mv "$f" "$f.failed"; echo "FAILED ARTIFACT: $f"; }
+done
 echo CAMPAIGN-DONE

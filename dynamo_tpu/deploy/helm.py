@@ -73,6 +73,12 @@ def render_helm_chart(
         # Lift tunables into values, replacing them with sentinels.
         if kind == "Deployment":
             key = _values_key(name.removeprefix(f"{dep.name}-"))
+            if key in values["services"]:  # '-'/'_' or store-name collisions
+                key = _values_key(name)
+            n = 2
+            while key in values["services"]:
+                key = f"{key}_{n}"
+                n += 1
             values["services"][key] = {"replicas": doc["spec"]["replicas"]}
             doc["spec"]["replicas"] = _t(f"int .Values.services.{key}.replicas")
             for c in doc["spec"]["template"]["spec"]["containers"]:

@@ -133,6 +133,17 @@ class EncodeService(AsyncEngine[Any, dict]):
                 patches, grid = preprocess_qwen2vl_video(
                     data, self.cfg, num_frames=self.video_frames
                 )
+                per_group = grid[1] * grid[2] // self.cfg.spatial_merge_size**2
+                if grid[0] * per_group > self.video_embed_budget:
+                    # Native resolution can yield ~1k LLM tokens per temporal
+                    # group: re-sample fewer frames so the clip fits the
+                    # embedding budget (same guarantee as the fixed-geometry
+                    # clamp below).
+                    groups = max(1, self.video_embed_budget // max(per_group, 1))
+                    patches, grid = preprocess_qwen2vl_video(
+                        data, self.cfg,
+                        num_frames=groups * self.cfg.temporal_patch_size,
+                    )
             else:
                 patches, grid = preprocess_qwen2vl(data, self.cfg)
             fn = self._encode_by_grid.pop(grid, None)
