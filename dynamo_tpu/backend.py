@@ -103,6 +103,21 @@ class Backend(Operator):
                 if out.finish_reason is not None:  # one output per batch input
                     return
                 continue
+            lp = None
+            if out.logprobs:
+                # Per-token text for the OpenAI logprobs schema. A lone token
+                # may be a partial UTF-8 piece; "bytes" carries the exact
+                # bytes (the schema's escape hatch for that).
+                lp = []
+                for e in out.logprobs:
+                    piece = self.tokenizer.decode([e["id"]], skip_special_tokens=False)
+                    lp.append({
+                        **e, "token": piece, "bytes": list(piece.encode()),
+                        "top": [
+                            [tid, tlp, self.tokenizer.decode([tid], skip_special_tokens=False)]
+                            for tid, tlp in e.get("top", [])
+                        ],
+                    })
             text = detok.push(out.token_ids) if out.token_ids else ""
             released = jail.push(text)
             if jail.triggered is not None:
@@ -114,6 +129,7 @@ class Backend(Operator):
                     cumulative_tokens=out.cumulative_tokens,
                     prompt_tokens=out.prompt_tokens,
                     cached_tokens=out.cached_tokens,
+                    logprobs=lp,
                 )
                 return  # Operator.generate closes the stream -> engine cancels
             final = out.finish_reason is not None
@@ -127,6 +143,7 @@ class Backend(Operator):
                     cumulative_tokens=out.cumulative_tokens,
                     prompt_tokens=out.prompt_tokens,
                     cached_tokens=out.cached_tokens,
+                    logprobs=lp,
                 )
             if final:
                 return

@@ -136,13 +136,21 @@ class EncodeService(AsyncEngine[Any, dict]):
                 per_group = grid[1] * grid[2] // self.cfg.spatial_merge_size**2
                 if grid[0] * per_group > self.video_embed_budget:
                     # Native resolution can yield ~1k LLM tokens per temporal
-                    # group: re-sample fewer frames so the clip fits the
-                    # embedding budget (same guarantee as the fixed-geometry
-                    # clamp below).
+                    # group: first drop frames; if ONE group still exceeds
+                    # the budget, downscale spatially via max_pixels (each
+                    # merged token covers (patch*merge)^2 pixels) so the
+                    # budget actually holds.
+                    import dataclasses
+
+                    cfg = self.cfg
                     groups = max(1, self.video_embed_budget // max(per_group, 1))
+                    if per_group > self.video_embed_budget:
+                        px_per_tok = (cfg.patch_size * cfg.spatial_merge_size) ** 2
+                        cfg = dataclasses.replace(
+                            cfg, max_pixels=self.video_embed_budget * px_per_tok
+                        )
                     patches, grid = preprocess_qwen2vl_video(
-                        data, self.cfg,
-                        num_frames=groups * self.cfg.temporal_patch_size,
+                        data, cfg, num_frames=groups * cfg.temporal_patch_size,
                     )
             else:
                 patches, grid = preprocess_qwen2vl(data, self.cfg)

@@ -41,6 +41,12 @@ from dynamo_tpu.tracing import annotate
 
 logger = logging.getLogger(__name__)
 
+# Logprobs requests always compute this many alternatives on-device (one
+# compiled program; per-request top_logprobs slices host-side — a static
+# per-value k would recompile the step program for every distinct request
+# setting). 20 = the OpenAI top_logprobs cap.
+LOGPROBS_TOP_K = 20
+
 
 @dataclasses.dataclass
 class EngineConfig:
@@ -385,14 +391,16 @@ class EngineCore:
                 )
                 mrope3[i, :, :new] = cols
             sb.mrope_positions = mrope3.astype(np.int32)
+        lp_k = LOGPROBS_TOP_K if any(s.request.sampling.logprobs for s in batch) else 0
         try:
-            next_tokens = self.runner.step(sb)
+            stepped = self.runner.step(sb, lp_k=lp_k) if lp_k else self.runner.step(sb)
         except Exception:
             # Batch seqs were popped from waiting but are not yet in running:
             # without cleanup here their pages would leak forever.
             for s in batch:
                 self._finish(s, FinishReason.ERROR)
             raise
+        next_tokens, lp_aux = stepped if lp_k else (stepped, None)
         outputs: list[tuple[Sequence, EngineOutput]] = []
         for i, s in enumerate(batch):
             self._prompt_tokens_total += max(0, s.num_prompt - s.num_cached)
@@ -400,7 +408,7 @@ class EngineCore:
             s.append_token(int(next_tokens[i]))
             self._generated_tokens_total += 1
             self._commit_filled_pages(s)
-            outputs.append(self._emit(s, int(next_tokens[i])))
+            outputs.append(self._emit(s, int(next_tokens[i]), self._lp_entries(s, lp_aux, i)))
         self.running.extend(s for s in batch if not s.is_finished)
         return outputs
 
@@ -424,6 +432,13 @@ class EngineCore:
             s.request.sampling.frequency_penalty or s.request.sampling.presence_penalty
             for s in self.running
         )
+        # Logprobs ride the single-step sync path: the fused burst's scan
+        # doesn't surface per-step logits, and mixing would stall the
+        # pipeline anyway (same trade as penalties).
+        if any(s.request.sampling.logprobs for s in self.running):
+            if self._inflight is not None:
+                return self._drain_inflight()
+            return self._run_decode_sync(1)
         use_pipelined = (
             k > 1
             and not penalized
@@ -495,7 +510,7 @@ class EngineCore:
             sb.sample_steps += offset  # rng fold-counter continuity across bursts
         return sb
 
-    def _process_burst_tokens(self, batch: list[Sequence], next_tokens) -> list[tuple[Sequence, EngineOutput]]:
+    def _process_burst_tokens(self, batch: list[Sequence], next_tokens, lp_aux=None) -> list[tuple[Sequence, EngineOutput]]:
         """Apply a burst's sampled tokens to the batch's sequences.
 
         Sequences that left RUNNING while the burst was in flight (cancelled,
@@ -514,7 +529,7 @@ class EngineCore:
                 if s.check_stop(self._eos, self.config.max_seq_len) is not None:
                     break  # overshoot from the burst is discarded
             self._commit_filled_pages(s)
-            outputs.append(self._emit_many(s, accepted))
+            outputs.append(self._emit_many(s, accepted, self._lp_entries(s, lp_aux, i)))
         return outputs
 
     def _run_decode_sync(self, k: int) -> list[tuple[Sequence, EngineOutput]]:
@@ -526,16 +541,22 @@ class EngineCore:
         if not batch:
             return []
         step_batch = self._decode_step_batch(batch)
+        lp_k = LOGPROBS_TOP_K if any(s.request.sampling.logprobs for s in batch) else 0
+        lp_aux = None
         try:
             if k == 1:
-                next_tokens = self.runner.step(step_batch)[:, None]
+                if lp_k:
+                    stepped, lp_aux = self.runner.step(step_batch, lp_k=lp_k)
+                else:
+                    stepped = self.runner.step(step_batch)
+                next_tokens = stepped[:, None]
             else:
                 next_tokens = self.runner.multi_step(step_batch, k)  # [B, k]
         except Exception:
             for s in batch:
                 self._finish(s, FinishReason.ERROR)
             raise
-        return self._process_burst_tokens(batch, next_tokens)
+        return self._process_burst_tokens(batch, next_tokens, lp_aux)
 
     def _run_decode_pipelined(self, k: int) -> list[tuple[Sequence, EngineOutput]]:
         """One-burst-deep pipelined decode.
@@ -707,10 +728,10 @@ class EngineCore:
             self._finish(seq, reason)
         self.pending_offloads = []
 
-    def _emit(self, seq: Sequence, token: int) -> tuple[Sequence, EngineOutput]:
-        return self._emit_many(seq, [token])
+    def _emit(self, seq: Sequence, token: int, logprobs: list[dict] | None = None) -> tuple[Sequence, EngineOutput]:
+        return self._emit_many(seq, [token], logprobs)
 
-    def _emit_many(self, seq: Sequence, tokens: list[int]) -> tuple[Sequence, EngineOutput]:
+    def _emit_many(self, seq: Sequence, tokens: list[int], logprobs: list[dict] | None = None) -> tuple[Sequence, EngineOutput]:
         reason = seq.check_stop(self._eos, self.config.max_seq_len)
         if reason is not None and not seq.is_finished:
             self._finish(seq, reason)
@@ -720,8 +741,25 @@ class EngineCore:
             cumulative_tokens=seq.num_generated,
             prompt_tokens=seq.num_prompt if seq.finish_reason else None,
             cached_tokens=seq.num_cached_at_start if seq.finish_reason else None,
+            logprobs=logprobs[: len(tokens)] if logprobs else None,
         )
         return seq, out
+
+    def _lp_entries(self, seq: Sequence, lp_aux, i: int) -> list[dict] | None:
+        """One request's logprobs entry from a step's aux arrays (row i):
+        chosen-token logprob + this request's own alternatives slice.
+        SamplingOptions.logprobs uses the +1 encoding (N = N-1 alternatives);
+        the step always computes the full LOGPROBS_TOP_K bucket (one
+        compiled program regardless of what each request asked for)."""
+        enc = seq.request.sampling.logprobs
+        if not enc or lp_aux is None:
+            return None
+        alts = min(enc - 1, lp_aux["top_ids"].shape[1])
+        top = [
+            [int(t), float(lp)]
+            for t, lp in zip(lp_aux["top_ids"][i][:alts], lp_aux["top_lps"][i][:alts])
+        ]
+        return [{"id": int(seq.tokens[-1]), "logprob": float(lp_aux["logprob"][i]), "top": top}]
 
     def _final_output(self, seq: Sequence) -> EngineOutput:
         return EngineOutput(

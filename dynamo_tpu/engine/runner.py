@@ -189,12 +189,12 @@ class ModelRunner:
             self._dp = int(mesh.shape["dp"])
         self.params = params
 
-        @functools.partial(jax.jit, static_argnames=("impl",), donate_argnums=(1, 2))
+        @functools.partial(jax.jit, static_argnames=("impl", "lp_k"), donate_argnums=(1, 2))
         def _step(params, k_cache, v_cache, tokens, positions, block_tables, slot_mapping,
                   last_idx, temperature, top_k, top_p, seeds, sample_steps,
                   freq_pen, pres_pen, pos_limit, history, mrope_delta=None,
                   mm_embeds=None, mm_slot_offset=None, mm_counts=None,
-                  mrope_positions=None, *, impl):
+                  mrope_positions=None, *, impl, lp_k=0):
             del pos_limit  # single/prefill steps never write past the finish line
             # mm_* None on text batches; jit specializes once per presence
             # pattern, so the text program carries no multimodal cost.
@@ -216,14 +216,19 @@ class ModelRunner:
                 logits, keys, temperature, top_k, top_p,
                 history=history, frequency_penalty=freq_pen, presence_penalty=pres_pen,
             )
+            if lp_k:
+                from dynamo_tpu.ops.sampling import token_logprobs
+
+                chosen, top_ids, top_lps = token_logprobs(logits, next_tokens, lp_k)
+                return next_tokens, k_cache, v_cache, chosen, top_ids, top_lps
             return next_tokens, k_cache, v_cache
 
         self._step_fn = _step
 
-        @functools.partial(jax.jit, static_argnames=("b", "t", "n", "h"), donate_argnums=(1, 2))
-        def _step_packed(params, k_cache, v_cache, packed, *, b, t, n, h):
+        @functools.partial(jax.jit, static_argnames=("b", "t", "n", "h", "lp_k"), donate_argnums=(1, 2))
+        def _step_packed(params, k_cache, v_cache, packed, *, b, t, n, h, lp_k=0):
             args = _unpack(packed, b, t, n, h)
-            return _step(params, k_cache, v_cache, *args, impl=self.attn_impl)
+            return _step(params, k_cache, v_cache, *args, impl=self.attn_impl, lp_k=lp_k)
 
         self._step_packed_fn = _step_packed
 
@@ -491,8 +496,14 @@ class ModelRunner:
         return self.attn_impl
 
     @_locked
-    def step(self, batch: StepBatch) -> np.ndarray:
-        """Run one forward+sample step; returns sampled token ids i32[B_real]."""
+    def step(self, batch: StepBatch, lp_k: int = 0):
+        """Run one forward+sample step; returns sampled token ids i32[B_real].
+
+        ``lp_k > 0`` additionally returns a logprobs dict (chosen-token
+        logprob + top-``lp_k`` alternatives, OpenAI semantics):
+        ``(tokens, {"logprob": f32[B], "top_ids": i32[B, k], "top_lps":
+        f32[B, k]})``. A separate compiled program per lp_k presence — text
+        traffic pays nothing."""
         b_real = batch.batch_size
         padded = self._pad(batch)
         if padded.mm_embeds is not None:
@@ -503,7 +514,7 @@ class ModelRunner:
                     return jax.device_put(a, batch_sharding(self.mesh, a.ndim))
             else:
                 put = jnp.asarray
-            next_tokens, self.k_cache, self.v_cache = self._step_fn(
+            out = self._step_fn(
                 self.params, self.k_cache, self.v_cache,
                 put(padded.tokens), put(padded.positions),
                 put(padded.block_tables), put(padded.slot_mapping),
@@ -516,15 +527,15 @@ class ModelRunner:
                 put(padded.mm_embeds), put(padded.mm_slot_offset), put(padded.mm_counts),
                 None if padded.mrope_positions is None else put(padded.mrope_positions),
                 impl=self._select_impl(padded) if self.mesh is not None else self.attn_impl,
+                lp_k=lp_k,
             )
-            return np.asarray(next_tokens)[:b_real]
-        if self.mesh is not None:
+        elif self.mesh is not None:
             from dynamo_tpu.parallel.sharding import batch_sharding
 
             def put(a):
                 return jax.device_put(a, batch_sharding(self.mesh, a.ndim))
 
-            next_tokens, self.k_cache, self.v_cache = self._step_fn(
+            out = self._step_fn(
                 self.params, self.k_cache, self.v_cache,
                 put(padded.tokens), put(padded.positions),
                 put(padded.block_tables), put(padded.slot_mapping),
@@ -534,14 +545,23 @@ class ModelRunner:
                 put(padded.freq_pen), put(padded.pres_pen),
                 put(padded.pos_limit), put(padded.history),
                 put(padded.mrope_delta),
-                impl=self._select_impl(padded),
+                impl=self._select_impl(padded), lp_k=lp_k,
             )
         else:
             b, t = padded.tokens.shape
-            next_tokens, self.k_cache, self.v_cache = self._step_packed_fn(
+            out = self._step_packed_fn(
                 self.params, self.k_cache, self.v_cache, jnp.asarray(_pack(padded)),
                 b=b, t=t, n=padded.block_tables.shape[1], h=padded.history.shape[1],
+                lp_k=lp_k,
             )
+        if lp_k:
+            next_tokens, self.k_cache, self.v_cache, chosen, top_ids, top_lps = out
+            return np.asarray(next_tokens)[:b_real], {
+                "logprob": np.asarray(chosen)[:b_real],
+                "top_ids": np.asarray(top_ids)[:b_real],
+                "top_lps": np.asarray(top_lps)[:b_real],
+            }
+        next_tokens, self.k_cache, self.v_cache = out
         return np.asarray(next_tokens)[:b_real]
 
     @_locked

@@ -41,6 +41,25 @@ def _usage(prompt_tokens: int | None, completion_tokens: int, cached_tokens: int
     return usage
 
 
+
+
+def _chat_lp_content(entries: list[dict]) -> list[dict[str, Any]]:
+    """BackendOutput.logprobs entries -> OpenAI chat `logprobs.content`."""
+    out = []
+    for e in entries:
+        out.append({
+            "token": e.get("token", ""),
+            "logprob": e["logprob"],
+            "bytes": e.get("bytes"),
+            "top_logprobs": [
+                {"token": t[2] if len(t) > 2 else "", "logprob": t[1],
+                 "bytes": list(str(t[2]).encode()) if len(t) > 2 else None}
+                for t in e.get("top", [])
+            ],
+        })
+    return out
+
+
 class ChatStream:
     """Builds chat.completion.chunk objects from BackendOutput deltas."""
 
@@ -69,11 +88,14 @@ class ChatStream:
         usage = None
         if out.finish_reason is not None and self.send_usage:
             usage = _usage(out.prompt_tokens, out.cumulative_tokens, out.cached_tokens)
-        return self._chunk(
+        chunk = self._chunk(
             {"content": out.text} if out.text else {},
             finish=_finish_str(out.finish_reason),
             usage=usage,
         )
+        if out.logprobs:
+            chunk["choices"][0]["logprobs"] = {"content": _chat_lp_content(out.logprobs)}
+        return chunk
 
     def text_chunk(self, text: str) -> dict[str, Any]:
         return self._chunk({"content": text})
@@ -107,7 +129,15 @@ class CompletionStream:
             "created": self.created,
             "model": self.model,
             "choices": [
-                {"index": 0, "text": out.text, "finish_reason": _finish_str(out.finish_reason), "logprobs": None}
+                {"index": 0, "text": out.text, "finish_reason": _finish_str(out.finish_reason),
+                 "logprobs": None if not out.logprobs else {
+                     "tokens": [e.get("token", "") for e in out.logprobs],
+                     "token_logprobs": [e["logprob"] for e in out.logprobs],
+                     "top_logprobs": [
+                         {(t[2] if len(t) > 2 else str(t[0])): t[1] for t in e.get("top", [])}
+                         for e in out.logprobs
+                     ],
+                 }}
             ],
         }
         if out.finish_reason is not None and self.send_usage:
@@ -127,9 +157,12 @@ async def aggregate_chat(
     finish: FinishReason | None = None
     prompt_tokens = cached = None
     completion_tokens = 0
+    lp_entries: list[dict] = []
     async for out in stream:
         text_parts.append(out.text)
         completion_tokens = max(completion_tokens, out.cumulative_tokens)
+        if out.logprobs:
+            lp_entries.extend(out.logprobs)
         if out.finish_reason is not None:
             finish = out.finish_reason
             prompt_tokens, cached = out.prompt_tokens, out.cached_tokens
@@ -153,6 +186,7 @@ async def aggregate_chat(
                 "index": 0,
                 "message": message,
                 "finish_reason": finish_str,
+                **({"logprobs": {"content": _chat_lp_content(lp_entries)}} if lp_entries else {}),
             }
         ],
         "usage": _usage(prompt_tokens, completion_tokens, cached),
@@ -164,9 +198,12 @@ async def aggregate_completion(model: str, stream: AsyncIterator[BackendOutput])
     finish: FinishReason | None = None
     prompt_tokens = cached = None
     completion_tokens = 0
+    lp_entries: list[dict] = []
     async for out in stream:
         text_parts.append(out.text)
         completion_tokens = max(completion_tokens, out.cumulative_tokens)
+        if out.logprobs:
+            lp_entries.extend(out.logprobs)
         if out.finish_reason is not None:
             finish = out.finish_reason
             prompt_tokens, cached = out.prompt_tokens, out.cached_tokens
@@ -176,7 +213,15 @@ async def aggregate_completion(model: str, stream: AsyncIterator[BackendOutput])
         "created": int(time.time()),
         "model": model,
         "choices": [
-            {"index": 0, "text": "".join(text_parts), "finish_reason": _finish_str(finish) or "stop", "logprobs": None}
+            {"index": 0, "text": "".join(text_parts), "finish_reason": _finish_str(finish) or "stop",
+             "logprobs": None if not lp_entries else {
+                 "tokens": [e.get("token", "") for e in lp_entries],
+                 "token_logprobs": [e["logprob"] for e in lp_entries],
+                 "top_logprobs": [
+                     {(t[2] if len(t) > 2 else str(t[0])): t[1] for t in e.get("top", [])}
+                     for e in lp_entries
+                 ],
+             }}
         ],
         "usage": _usage(prompt_tokens, completion_tokens, cached),
     }

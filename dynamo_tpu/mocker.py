@@ -61,7 +61,7 @@ class MockRunner:
             np.int32
         )
 
-    def step(self, batch: StepBatch) -> np.ndarray:
+    def step(self, batch: StepBatch, lp_k: int = 0):
         b, t = batch.tokens.shape
         if t > 1:  # prefill
             new_tokens = int((batch.last_token_index + 1).sum())
@@ -70,7 +70,19 @@ class MockRunner:
             self._sleep_us(self.decode_us_base + self.decode_us_per_seq * b)
         last_tok = batch.tokens[np.arange(b), batch.last_token_index]
         last_pos = batch.positions[np.arange(b), batch.last_token_index]
-        return self._tokens_for(last_pos, last_tok)
+        toks = self._tokens_for(last_pos, last_tok)
+        if lp_k:
+            # Synthetic but schema-complete logprobs (mock fleets exercise
+            # the full API surface): chosen "probability" 0.5, alternatives
+            # decaying deterministically.
+            lps = np.full(b, np.log(0.5), np.float32)
+            top_ids = (toks[:, None] + np.arange(lp_k)[None, :]) % self.vocab_size
+            top_lps = np.log(0.5) - 0.5 * np.arange(1, lp_k + 1, dtype=np.float32)
+            top_lps = np.broadcast_to(top_lps, (b, lp_k)).copy()
+            top_lps[:, 0] = np.log(0.5)
+            top_ids[:, 0] = toks
+            return toks, {"logprob": lps, "top_ids": top_ids.astype(np.int32), "top_lps": top_lps}
+        return toks
 
     def multi_step(self, batch: StepBatch, num_steps: int) -> np.ndarray:
         b = batch.tokens.shape[0]

@@ -101,3 +101,21 @@ def sample_tokens(
     sampled = jnp.take_along_axis(top_idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
 
     return jnp.where(temperature > 0, sampled, greedy)
+
+
+def token_logprobs(
+    logits: jnp.ndarray,  # f32[B, vocab] RAW model logits
+    tokens: jnp.ndarray,  # i32[B] sampled token per row
+    k: int,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """OpenAI-style logprobs: (chosen_lp f32[B], top_ids i32[B, k],
+    top_lps f32[B, k]) under log-softmax of the RAW logits — the model's
+    distribution, before temperature/penalties (the convention the major
+    serving stacks report; sampling modifiers change what is PICKED, not
+    what the model believed)."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1, keepdims=True)
+    lps = logits - logz
+    chosen = jnp.take_along_axis(lps, tokens[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    top_lps, top_ids = jax.lax.top_k(lps, k)
+    return chosen, top_ids.astype(jnp.int32), top_lps

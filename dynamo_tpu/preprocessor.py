@@ -58,6 +58,24 @@ class PromptFormatter:
 def extract_sampling(body: dict[str, Any]) -> SamplingOptions:
     nvext = body.get("nvext") or {}
     temperature = body.get("temperature")
+    # OpenAI logprobs: chat sends `logprobs: true` (+ `top_logprobs: N`,
+    # which may legitimately be 0 = chosen token only); completions sends
+    # `logprobs: N` (N alternatives; 0 = chosen only). SamplingOptions
+    # encodes "enabled with A alternatives" as A + 1 so 0 stays "off".
+    raw_lp = body.get("logprobs")
+    try:
+        if raw_lp is True:
+            n_alts = int(body.get("top_logprobs") or 0)
+            lp = 1 + n_alts
+        elif raw_lp is None or raw_lp is False or raw_lp == "":
+            lp, n_alts = 0, 0
+        else:
+            n_alts = int(raw_lp)
+            lp = 1 + n_alts
+    except (TypeError, ValueError):
+        raise ValueError(f"logprobs/top_logprobs must be integers, got {raw_lp!r}")
+    if n_alts < 0 or n_alts > 20:  # OpenAI's top_logprobs range
+        raise ValueError(f"logprobs/top_logprobs must be in [0, 20], got {n_alts}")
     return SamplingOptions(
         temperature=1.0 if temperature is None else float(temperature),
         top_k=int(nvext.get("top_k", body.get("top_k", 0)) or 0),
@@ -65,6 +83,7 @@ def extract_sampling(body: dict[str, Any]) -> SamplingOptions:
         seed=body.get("seed"),
         frequency_penalty=float(body.get("frequency_penalty", 0.0) or 0.0),
         presence_penalty=float(body.get("presence_penalty", 0.0) or 0.0),
+        logprobs=lp,  # +1 encoding; range-checked above (OpenAI cap 20)
     )
 
 

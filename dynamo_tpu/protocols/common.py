@@ -30,6 +30,12 @@ class SamplingOptions:
     seed: int | None = None
     frequency_penalty: float = 0.0
     presence_penalty: float = 0.0
+    # OpenAI logprobs: 0 = off; N > 0 = enabled with N-1 top alternatives
+    # per generated token (the +1 encoding lets "chosen token only, zero
+    # alternatives" — chat top_logprobs: 0 / completions logprobs: 0 —
+    # stay distinct from off). The reference leaves this a TODO
+    # (`completions.rs:262`); first-party here.
+    logprobs: int = 0
 
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
@@ -103,6 +109,9 @@ class BackendOutput:
     prompt_tokens: int | None = None
     cached_tokens: int | None = None
     embedding: list[float] | None = None  # /v1/embeddings result (no tokens stream)
+    # Per generated token: {"id", "token", "bytes", "logprob",
+    # "top": [[id, lp, token], ...]} (wire order: id, logprob, token).
+    logprobs: list[dict] | None = None
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -113,6 +122,7 @@ class BackendOutput:
             "prompt_tokens": self.prompt_tokens,
             "cached_tokens": self.cached_tokens,
             "embedding": self.embedding,
+            "logprobs": self.logprobs,
         }
 
     @classmethod
@@ -126,6 +136,7 @@ class BackendOutput:
             prompt_tokens=d.get("prompt_tokens"),
             cached_tokens=d.get("cached_tokens"),
             embedding=d.get("embedding"),
+            logprobs=d.get("logprobs"),
         )
 
 
@@ -140,6 +151,9 @@ class EngineOutput:
     prompt_tokens: int | None = None
     cached_tokens: int | None = None
     embedding: list[float] | None = None  # /v1/embeddings result (no tokens stream)
+    # Per token in token_ids: {"id", "logprob", "top": [[id, lp], ...]};
+    # None when the request didn't ask (SamplingOptions.logprobs == 0).
+    logprobs: list[dict] | None = None
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -149,6 +163,7 @@ class EngineOutput:
             "prompt_tokens": self.prompt_tokens,
             "cached_tokens": self.cached_tokens,
             "embedding": self.embedding,
+            "logprobs": self.logprobs,
         }
 
     @classmethod
@@ -161,4 +176,5 @@ class EngineOutput:
             prompt_tokens=d.get("prompt_tokens"),
             cached_tokens=d.get("cached_tokens"),
             embedding=d.get("embedding"),
+            logprobs=d.get("logprobs"),
         )

@@ -1,0 +1,166 @@
+"""OpenAI logprobs: engine-level math + full HTTP schema.
+
+The reference leaves logprobs a TODO (`lib/llm/src/protocols/openai/
+completions.rs:262`); this is first-party. Semantics: log-softmax of the
+RAW model logits (the model's distribution — temperature/penalties change
+what is picked, not what the model believed), chosen token + top-N.
+SamplingOptions.logprobs uses the +1 encoding (N = enabled, N-1
+alternatives) so "chosen only" and "off" stay distinct.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dynamo_tpu.engine.core import EngineConfig, EngineCore
+from dynamo_tpu.engine.runner import ModelRunner
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import PRESETS
+from dynamo_tpu.protocols.common import PreprocessedRequest, SamplingOptions, StopConditions
+from dynamo_tpu.runtime.engine import Context
+
+CFG = PRESETS["test-tiny"]
+PARAMS = llama.init_params(CFG, 0)
+PAGE = 4
+
+
+def _core():
+    runner = ModelRunner(CFG, PARAMS, num_pages=64, page_size=PAGE,
+                         max_batch_size=4, prefill_bucket=16, attn_impl="reference")
+    return EngineCore(runner, EngineConfig(
+        num_pages=64, page_size=PAGE, max_batch_size=4,
+        max_prefill_tokens=64, max_seq_len=64, decode_steps=4,
+    ))
+
+
+def _run(core, prompts, lp_k, max_tokens=4):
+    outs = {}
+    for i, p in enumerate(prompts):
+        core.add_request(PreprocessedRequest(
+            token_ids=list(p), sampling=SamplingOptions(temperature=0.0, logprobs=lp_k),
+            stop=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+        ), Context())
+    while core.has_work:
+        for seq, out in core.step():
+            o = outs.setdefault(seq.seq_id, {"tokens": [], "lp": []})
+            o["tokens"].extend(out.token_ids)
+            if out.logprobs:
+                o["lp"].extend(out.logprobs)
+    return outs
+
+
+def _reference_logprobs(prompt_plus_gen):
+    """Full-context forward -> log-softmax at the last position."""
+    tokens = list(prompt_plus_gen)
+    t = len(tokens)
+    pages = list(range(1, (t + PAGE - 1) // PAGE + 1))
+    bt = jnp.asarray([pages], jnp.int32)
+    pos = jnp.arange(t, dtype=jnp.int32)[None]
+    slots = jnp.asarray([[pages[i // PAGE] * PAGE + i % PAGE for i in range(t)]], jnp.int32)
+    kc, vc = llama.init_kv_cache(CFG, 64, PAGE)
+    logits, _, _ = llama.forward(
+        PARAMS, CFG, jnp.asarray([tokens], jnp.int32), pos, kc, vc,
+        bt, slots, jnp.asarray([t - 1], jnp.int32), attn_impl="reference",
+    )
+    row = np.asarray(logits[0], np.float64)
+    return row - np.log(np.exp(row - row.max()).sum()) - row.max()
+
+
+def test_engine_logprobs_match_reference_softmax():
+    """Every generated token's reported logprob equals the log-softmax of a
+    naive full-context forward at that step; greedy => chosen is the top-1
+    alternative; tops are sorted descending."""
+    core = _core()
+    prompt = [3, 5, 7, 11, 13]
+    (out,) = _run(core, [prompt], lp_k=4).values()  # +1 encoding: 3 alternatives
+    assert len(out["lp"]) == len(out["tokens"]) == 4
+    ctx = list(prompt)
+    for tok, e in zip(out["tokens"], out["lp"]):
+        assert e["id"] == tok
+        want = _reference_logprobs(ctx)
+        np.testing.assert_allclose(e["logprob"], want[tok], rtol=2e-3, atol=2e-3)
+        tops = e["top"]
+        assert len(tops) == 3
+        assert tops[0][0] == tok  # greedy: chosen IS the argmax
+        lps = [lp for _id, lp in tops]
+        assert lps == sorted(lps, reverse=True)
+        for tid, tlp in tops:
+            np.testing.assert_allclose(tlp, want[tid], rtol=2e-3, atol=2e-3)
+        ctx.append(tok)
+
+
+def test_logprobs_and_plain_requests_share_a_batch():
+    """A logprobs request must not change a text-only neighbor's tokens, and
+    only the requester gets entries."""
+    core = _core()
+    p1, p2 = [2, 4, 6, 8], [9, 7, 5, 3]
+    plain_core = _core()
+    plain = _run(plain_core, [p1, p2], lp_k=0)
+    mixed_core = _core()
+    for i, (p, k) in enumerate([(p1, 2), (p2, 0)]):
+        mixed_core.add_request(PreprocessedRequest(
+            token_ids=list(p), sampling=SamplingOptions(temperature=0.0, logprobs=k),
+            stop=StopConditions(max_tokens=4, ignore_eos=True),
+        ), Context())
+    mixed = {}
+    while mixed_core.has_work:
+        for seq, out in mixed_core.step():
+            o = mixed.setdefault(seq.seq_id, {"tokens": [], "lp": []})
+            o["tokens"].extend(out.token_ids)
+            if out.logprobs:
+                o["lp"].extend(out.logprobs)
+    assert mixed[0]["tokens"] == plain[0]["tokens"]
+    assert mixed[1]["tokens"] == plain[1]["tokens"]
+    assert len(mixed[0]["lp"]) == 4
+    assert mixed[1]["lp"] == []
+
+
+@pytest.mark.e2e
+async def test_logprobs_served_http():
+    """Chat + completions logprobs over the full HTTP stack (OpenAI schema)."""
+    import aiohttp
+
+    from dynamo_tpu.launch import run_local
+
+    handles = await run_local("test-tiny", port=0, num_pages=256, max_batch_size=4)
+    base = f"http://127.0.0.1:{handles['port']}"
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "test-tiny", "max_tokens": 3, "temperature": 0,
+                    "logprobs": True, "top_logprobs": 2,
+                    "messages": [{"role": "user", "content": "hi"}]}
+            r = await (await s.post(base + "/v1/chat/completions", json=body)).json()
+            content = r["choices"][0]["logprobs"]["content"]
+            assert len(content) == 3
+            for e in content:
+                assert isinstance(e["token"], str) and e["logprob"] <= 0
+                assert len(e["top_logprobs"]) == 2
+                assert e["top_logprobs"][0]["logprob"] >= e["top_logprobs"][1]["logprob"]
+
+            body2 = {"model": "test-tiny", "prompt": "abc", "max_tokens": 3,
+                     "temperature": 0, "logprobs": 2}
+            r2 = await (await s.post(base + "/v1/completions", json=body2)).json()
+            lp = r2["choices"][0]["logprobs"]
+            assert len(lp["tokens"]) == len(lp["token_logprobs"]) == 3
+            assert all(v <= 0 for v in lp["token_logprobs"])
+            assert all(len(d) == 2 for d in lp["top_logprobs"])
+
+            # Streaming chat: chunks carry per-token logprobs too.
+            body["stream"] = True
+            got_lp_chunks = 0
+            async with s.post(base + "/v1/chat/completions", json=body) as resp:
+                async for line in resp.content:
+                    if line.startswith(b"data: ") and b"[DONE]" not in line:
+                        import json as _json
+
+                        chunk = _json.loads(line[6:])
+                        if (chunk.get("choices") or [{}])[0].get("logprobs"):
+                            got_lp_chunks += 1
+            assert got_lp_chunks >= 3
+    finally:
+        await handles["http"].stop()
+        await handles["watcher"].close()
+        for svc in handles["services"]:
+            await svc.close()
+        await handles["runtime"].close()
