@@ -57,6 +57,12 @@ class EngineConfig:
     max_seq_len: int = 4096
     eos_token_ids: tuple[int, ...] = ()
     enable_prefix_caching: bool = True
+    # Sliding-window models: release pages whose every token has slid out of
+    # the attention window (they can never be attended again). Committed
+    # pages demote to evictable prefix cache; uncommitted ones free
+    # immediately. A 32k-context window-4k Mistral stream otherwise pins
+    # ~28k tokens of dead KV per sequence.
+    swa_free_pages: bool = True
     salt: int = DEFAULT_SALT
     worker_id: int = 0
     # Fused decode steps per dispatch. >1 amortizes host<->device round trips
@@ -408,6 +414,7 @@ class EngineCore:
             s.append_token(int(next_tokens[i]))
             self._generated_tokens_total += 1
             self._commit_filled_pages(s)
+            self._release_out_of_window(s)
             outputs.append(self._emit(s, int(next_tokens[i]), self._lp_entries(s, lp_aux, i)))
         self.running.extend(s for s in batch if not s.is_finished)
         return outputs
@@ -529,6 +536,7 @@ class EngineCore:
                 if s.check_stop(self._eos, self.config.max_seq_len) is not None:
                     break  # overshoot from the burst is discarded
             self._commit_filled_pages(s)
+            self._release_out_of_window(s)
             outputs.append(self._emit_many(s, accepted, self._lp_entries(s, lp_aux, i)))
         return outputs
 
@@ -681,6 +689,35 @@ class EngineCore:
                          seeds, steps, freq, pres, limits, history,
                          mrope_delta=mrope_delta)
 
+    def _release_out_of_window(self, seq: Sequence) -> None:
+        """Free pages fully below the sliding-attention window.
+
+        The block table keeps its positional shape: released entries point
+        at the reserved null page 0 — the SWA mask derives key positions
+        from table INDEX, not page content, so reads of page 0 there are
+        masked out regardless of what another sequence later writes in it.
+        Release paths (finish/preempt) skip the zeros."""
+        win = getattr(self.runner.cfg, "sliding_window", 0) if hasattr(self.runner, "cfg") else 0
+        if not win or not self.config.swa_free_pages:
+            return
+        ps = self.config.page_size
+        # Tokens at absolute positions < (next_pos - win) are out of every
+        # future query's window; a page is releasable once its LAST slot is.
+        keep_from = max(0, len(seq.tokens) - win) // ps
+        if keep_from <= 0:
+            return
+        drop = [pid for pid in seq.pages[:keep_from] if pid != 0]
+        if not drop:
+            return
+        # Never release pages the commit walk hasn't published yet (caching
+        # on: commit runs first each step, so this only guards odd orderings).
+        if self.config.enable_prefix_caching and seq.committed_pages < keep_from:
+            drop = [pid for pid in seq.pages[: seq.committed_pages] if pid != 0]
+            keep_from = seq.committed_pages
+        self.allocator.release(drop)
+        for i in range(keep_from):
+            seq.pages[i] = 0
+
     def _commit_filled_pages(self, seq: Sequence) -> None:
         """Publish newly-filled pages to the prefix cache (emits stored events)
         and write them through to the capacity tiers."""
@@ -773,7 +810,7 @@ class EngineCore:
     def _preempt(self, seq: Sequence) -> None:
         logger.info("preempting seq %d (%d pages)", seq.seq_id, len(seq.pages))
         self.num_preemptions += 1
-        self.allocator.release(seq.pages)
+        self.allocator.release([p for p in seq.pages if p != 0])
         seq.pages = []
         seq.committed_pages = 0
         seq.num_cached = 0
@@ -785,7 +822,7 @@ class EngineCore:
         seq.status = SeqStatus.FINISHED
         seq.finish_reason = reason
         if seq.pages:
-            self.allocator.release(seq.pages)
+            self.allocator.release([p for p in seq.pages if p != 0])
             seq.pages = []
         if seq in self.running:
             self.running.remove(seq)
