@@ -1,0 +1,369 @@
+"""Constrained decoding: OpenAI ``response_format: {"type": "json_object"}``.
+
+Guarantees every generated token keeps the output a valid JSON *prefix*,
+and (unlike OpenAI's "may truncate at max_tokens" caveat) force-closes
+open structures when the remaining token budget runs low, so finished
+responses parse. The reference has no counterpart (vLLM-level feature the
+wrapped engines provide; first-party here).
+
+Design, sized for a 128k-vocab TPU serving path:
+
+- **Char-level JSON pushdown machine** (:class:`JsonMachine`): mode +
+  container stack; accepts exactly the prefixes of JSON values (strings
+  with escapes, numbers, literals, arrays, objects).
+- **Token masks cached by machine summary** (:class:`TokenMaskCache`):
+  the set of allowed next TOKENS depends only on a bounded summary of the
+  machine (mode, pending literal, top few stack symbols) — a few dozen
+  distinct summaries in practice. Computing a mask walks every vocab
+  piece through the machine once per NEW summary (~O(vocab) chars) and
+  is cached forever after; steady-state per-step cost is a dict lookup.
+  Pieces that would close deeper than the summary records are
+  conservatively disallowed (the output stays valid JSON; the model just
+  closes one level per token in >3-deep nests).
+- The engine applies the mask on-device (logits + ``where(mask, x,
+  -inf)``) on the single-step sync path, and advances the machine on the
+  host with each accepted token (`engine/core.py`).
+
+Token text comes from ``tokenizer.decode([id])`` per piece; tokenizers
+whose single-token decode is lossy (partial UTF-8 fragments render as
+replacement chars) get those tokens conservatively disallowed inside
+strings only when they decode to the replacement char.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+# Modes.
+VALUE = "V"        # expecting a value start
+IN_STRING = "S"    # inside a string
+STR_ESCAPE = "E"   # after backslash in a string
+IN_NUMBER = "N"    # inside a number (last char was part of a number)
+AFTER_VALUE = "A"  # a value just completed; expect , } ] or end
+EXPECT_KEY = "K"   # inside an object, expecting a key string or }
+AFTER_KEY = "C"    # key string done, expecting :
+LITERAL = "L"      # partway through true/false/null
+REJECT = "X"
+
+_WS = " \t\n\r"
+_LITERALS = {"t": "rue", "f": "alse", "n": "ull"}
+_ESCAPABLE = set('"\\/bfnrtu0123456789abcdefABCDEF')
+
+
+@dataclasses.dataclass(frozen=True)
+class MachineState:
+    mode: str = VALUE
+    literal: str = ""          # remaining chars of a pending literal
+    stack: tuple = ()          # container stack, innermost last: '{' / '['
+    # IN_NUMBER only: the number is terminable (has digits, doesn't end in
+    # '.', 'e', '+', '-' — "-" or "1e+" must not count as complete).
+    num_ok: bool = False
+    # VALUE/EXPECT_KEY reached via ',': an immediate closer would produce a
+    # trailing comma ('[1,]' / '{"a":1,}'), which is not JSON.
+    no_close: bool = False
+
+    @property
+    def depth(self) -> int:
+        return len(self.stack)
+
+    def summary(self) -> tuple:
+        """Bounded cache key: masks computed from equal summaries are equal
+        for every piece that closes at most len(kept stack) levels."""
+        return (self.mode, self.literal, self.stack[-3:], min(self.depth, 3),
+                self.num_ok, self.no_close)
+
+    def complete(self) -> bool:
+        """The text so far is a COMPLETE JSON value."""
+        if self.depth != 0:
+            return False
+        return self.mode == AFTER_VALUE or (self.mode == IN_NUMBER and self.num_ok)
+
+
+def advance(state: MachineState, ch: str) -> MachineState:
+    """One character step; returns a REJECT-mode state on invalid input."""
+    mode, lit, stack = state.mode, state.literal, state.stack
+
+    def st(m, l="", s=stack):
+        return MachineState(m, l, s)
+
+    bad = MachineState(REJECT)
+    if mode == REJECT:
+        return bad
+    if mode == IN_STRING:
+        if ch == '"':
+            # Key strings finish to AFTER_KEY; value strings to AFTER_VALUE.
+            return st(AFTER_KEY if lit == "k" else AFTER_VALUE)
+        if ch == "\\":
+            return st(STR_ESCAPE, lit)
+        return bad if ch in "\n" else st(IN_STRING, lit)
+    if mode == STR_ESCAPE:
+        return st(IN_STRING, lit) if ch in _ESCAPABLE else bad
+    if mode == LITERAL:
+        if lit and ch == lit[0]:
+            return st(AFTER_VALUE) if len(lit) == 1 else st(LITERAL, lit[1:])
+        return bad
+    if mode == IN_NUMBER:
+        # Full JSON number grammar; phase rides in ``literal``:
+        # sign -> (zero | int) -> [frac0 -> frac] -> [exp0 -> exp1? -> exp]
+        ph = lit
+
+        def num(phase, ok):
+            return MachineState(IN_NUMBER, phase, stack, num_ok=ok)
+
+        if ph == "sign":
+            if ch == "0":
+                return num("zero", True)
+            return num("int", True) if ch.isdigit() else bad
+        if ph in ("zero", "int", "frac", "exp"):
+            if ch.isdigit():
+                if ph == "zero":
+                    return bad  # leading-zero rule: "01" is not JSON
+                return num(ph, True)
+            if ch == "." and ph in ("zero", "int"):
+                return num("frac0", False)
+            if ch in "eE" and ph in ("zero", "int", "frac"):
+                return num("exp0", False)
+            # Delimiter ends a terminable number (reinterpreted from
+            # AFTER_VALUE); "-," / "1e+," are not JSON.
+            return advance(st(AFTER_VALUE), ch) if state.num_ok else bad
+        if ph == "frac0":
+            return num("frac", True) if ch.isdigit() else bad
+        if ph == "exp0":
+            if ch in "+-":
+                return num("exp1", False)
+            return num("exp", True) if ch.isdigit() else bad
+        if ph == "exp1":
+            return num("exp", True) if ch.isdigit() else bad
+        return bad
+    if mode == VALUE:
+        if ch in _WS:
+            return state
+        if ch == '"':
+            return st(IN_STRING)
+        if ch == "-":
+            return MachineState(IN_NUMBER, "sign", stack, num_ok=False)
+        if ch == "0":
+            return MachineState(IN_NUMBER, "zero", stack, num_ok=True)
+        if ch in "123456789":
+            return MachineState(IN_NUMBER, "int", stack, num_ok=True)
+        if ch in _LITERALS:
+            return st(LITERAL, _LITERALS[ch])
+        if ch == "{":
+            return MachineState(EXPECT_KEY, "", stack + ("{",))
+        if ch == "[":
+            return MachineState(VALUE, "", stack + ("[",))
+        if ch == "]" and stack and stack[-1] == "[" and not state.no_close:
+            # Empty array closes straight from VALUE (but not right after a
+            # comma — '[1,]' is not JSON).
+            return MachineState(AFTER_VALUE, "", stack[:-1])
+        return bad
+    if mode == EXPECT_KEY:
+        if ch in _WS:
+            return state
+        if ch == '"':
+            return st(IN_STRING, "k")
+        if ch == "}" and stack and stack[-1] == "{" and not state.no_close:
+            return MachineState(AFTER_VALUE, "", stack[:-1])
+        return bad
+    if mode == AFTER_KEY:
+        if ch in _WS:
+            return state
+        return st(VALUE) if ch == ":" else bad
+    if mode == AFTER_VALUE:
+        if ch in _WS:
+            return state
+        if ch == "," and stack:
+            return MachineState(
+                EXPECT_KEY if stack[-1] == "{" else VALUE, "", stack, no_close=True
+            )
+        if ch == "}" and stack and stack[-1] == "{":
+            return MachineState(AFTER_VALUE, "", stack[:-1])
+        if ch == "]" and stack and stack[-1] == "[":
+            return MachineState(AFTER_VALUE, "", stack[:-1])
+        return bad
+    return bad
+
+
+def advance_text(state: MachineState, text: str) -> MachineState:
+    for ch in text:
+        state = advance(state, ch)
+        if state.mode == REJECT:
+            return state
+    return state
+
+
+def advance_text_tracked(state: MachineState, text: str) -> tuple[MachineState, int]:
+    """Like :func:`advance_text`, also returning the MINIMUM stack depth
+    touched — a piece whose simulation dips below the depths the summary
+    records consulted stack symbols the cache key doesn't know about, so
+    its verdict must not be cached for that summary."""
+    min_depth = state.depth
+    for ch in text:
+        state = advance(state, ch)
+        if state.mode == REJECT:
+            return state, min_depth
+        min_depth = min(min_depth, state.depth)
+    return state, min_depth
+
+
+#: A piece per closing token used by force-close (one level per step).
+_CLOSERS = {"{": "}", "[": "]"}
+
+
+class TokenMaskCache:
+    """Per-tokenizer vocab masks keyed by machine summary."""
+
+    def __init__(self, tokenizer, vocab_size: int, eos_ids: tuple[int, ...]) -> None:
+        self.vocab_size = vocab_size
+        self.eos_ids = tuple(eos_ids)
+        self._pieces: list[str] | None = None
+        self._tok = tokenizer
+        self._masks: dict[tuple, np.ndarray] = {}
+        self._close_ids: dict[str, int | None] = {}
+
+    def _ensure_pieces(self) -> list[str]:
+        if self._pieces is None:
+            dec = self._tok.decode
+            self._pieces = [
+                dec([t], skip_special_tokens=False) for t in range(self.vocab_size)
+            ]
+        return self._pieces
+
+    def mask_for(self, state: MachineState, *, force_close: bool = False,
+                 remaining: int | None = None) -> np.ndarray:
+        """bool[vocab]: tokens that keep the output a valid JSON prefix.
+
+        ``force_close``: remaining budget is nearly exhausted — restrict to
+        tokens that strictly make progress toward closing (closers, the
+        string terminator, escapes' completion), so the response parses
+        when it finishes.
+
+        ``remaining``: token budget left — pieces whose resulting state
+        cannot be closed within it are excluded (a single BPE token like
+        '[[[[' opens four levels; admitting it just above the force-close
+        threshold would make the close unaffordable and truncate mid-JSON).
+        """
+        if force_close:
+            return self._force_close_mask(state)
+        allowed, close_after = self._base_mask(state)
+        if remaining is not None:
+            allowed = allowed & (close_after <= max(remaining - 1, 1))
+            if not allowed.any():
+                return self._force_close_mask(state)
+        return self._finalize(allowed, state)
+
+    def _base_mask(self, state: MachineState) -> tuple[np.ndarray, np.ndarray]:
+        """(allowed bool[vocab], budget_to_close after each piece i16[vocab])
+        for a machine summary. Sound under the bounded summary: a piece
+        whose simulation dips below the recorded stack suffix (min depth <
+        depth - 3) is conservatively disallowed — its verdict would depend
+        on symbols the cache key doesn't carry."""
+        key = state.summary()
+        cached = self._masks.get(key)
+        if cached is not None:
+            return cached
+        pieces = self._ensure_pieces()
+        allowed = np.zeros(self.vocab_size, bool)
+        close_after = np.zeros(self.vocab_size, np.int16)
+        floor = state.depth - min(state.depth, 3)
+        for t, piece in enumerate(pieces):
+            if not piece:
+                continue
+            if "�" in piece and state.mode in (IN_STRING, STR_ESCAPE, VALUE, EXPECT_KEY):
+                continue  # lossy single-token decode: keep strings clean
+            ns, min_depth = advance_text_tracked(state, piece)
+            if ns.mode != REJECT and min_depth >= floor:
+                allowed[t] = True
+                close_after[t] = min(self.budget_to_close(ns), 2**14)
+        self._masks[key] = (allowed, close_after)
+        return allowed, close_after
+
+    def _finalize(self, base: np.ndarray, state: MachineState) -> np.ndarray:
+        out = base.copy()
+        complete = state.complete()
+        for e in self.eos_ids:
+            if 0 <= e < self.vocab_size:
+                out[e] = complete  # EOS exactly when the JSON is complete
+        return out
+
+    def _closer_token(self, piece: str) -> int | None:
+        if piece not in self._close_ids:
+            pieces = self._ensure_pieces()
+            self._close_ids[piece] = next(
+                (t for t, p in enumerate(pieces) if p == piece), None
+            )
+        return self._close_ids[piece]
+
+    def _force_close_mask(self, state: MachineState) -> np.ndarray:
+        out = np.zeros(self.vocab_size, bool)
+        if state.complete():
+            for e in self.eos_ids:
+                if 0 <= e < self.vocab_size:
+                    out[e] = True
+            if not out.any():
+                # No EOS in this vocab: nothing to force — the ENGINE ends
+                # completed json_mode sequences itself (a zero-allowed mask
+                # would send the sampler into arbitrary tokens).
+                return self.mask_for(state)
+            return out
+        want: str | None = None
+        if state.mode in (IN_STRING, STR_ESCAPE):
+            want = '"' if state.mode == IN_STRING else "n"  # finish escape minimally
+        elif state.mode == AFTER_KEY:
+            want = ":"
+        elif state.mode == VALUE:
+            # Close an empty array where legal; otherwise produce a value.
+            if state.stack and state.stack[-1] == "[" and not state.no_close:
+                want = "]"
+            else:
+                want = "0"
+        elif state.mode == LITERAL:
+            want = state.literal[0] if state.literal else None
+        elif state.mode == EXPECT_KEY:
+            want = '"' if state.no_close else "}"
+        elif state.mode == IN_NUMBER and not state.num_ok:
+            want = "0"
+        elif state.mode in (AFTER_VALUE, IN_NUMBER) and state.stack:
+            want = _CLOSERS[state.stack[-1]]
+        if want is not None:
+            tid = self._closer_token(want)
+            if tid is not None:
+                out[tid] = True
+        if not out.any():
+            # No single-char closing token in this vocab: fall back to the
+            # unconstrained-valid mask rather than deadlocking the sampler.
+            return self.mask_for(state)
+        return out
+
+    def budget_to_close(self, state: MachineState) -> int:
+        """Upper bound on tokens needed to reach a complete JSON value by
+        single-char force-close steps."""
+        extra = {IN_STRING: 1, STR_ESCAPE: 2, AFTER_KEY: 2, VALUE: 1,
+                 EXPECT_KEY: 1, LITERAL: len(state.literal)}.get(state.mode, 0)
+        if state.mode == IN_NUMBER and not state.num_ok:
+            extra = 1  # one digit terminates any incomplete number phase
+        if state.mode == EXPECT_KEY and state.no_close:
+            extra = 5  # '"' + '"' + ':' + value before the '}' can come
+        return state.depth + extra + 1  # +1 for EOS
+
+
+@dataclasses.dataclass
+class JsonConstraint:
+    """Per-request constrained-decoding state (lives on the Sequence)."""
+
+    cache: TokenMaskCache
+    state: MachineState = dataclasses.field(default_factory=MachineState)
+
+    def mask(self, remaining_tokens: int) -> np.ndarray:
+        force = remaining_tokens <= self.cache.budget_to_close(self.state) + 2
+        return self.cache.mask_for(
+            self.state, force_close=force, remaining=remaining_tokens
+        )
+
+    def accept(self, token_id: int) -> None:
+        piece = self.cache._ensure_pieces()[token_id] if token_id < self.cache.vocab_size else ""
+        if token_id in self.cache.eos_ids:
+            return
+        self.state = advance_text(self.state, piece)

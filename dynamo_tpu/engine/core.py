@@ -110,6 +110,15 @@ class EngineCore:
         # Pipelined decode: the burst in flight on device, not yet consumed.
         # (batch snapshot, DeviceTokens handle, burst length)
         self._inflight: tuple[list[Sequence], object, int] | None = None
+        # Constrained decoding (response_format json_object): the mask cache
+        # needs token TEXT, so a tokenizer (or factory) must be installed
+        # before json_mode requests are admitted.
+        self._constraint_tok = None
+        self._constraint_tok_factory = None
+        self._mask_cache = None
+        import threading as _threading
+
+        self._constraint_lock = _threading.Lock()
 
     # -- request intake ----------------------------------------------------
 
@@ -135,6 +144,14 @@ class EngineCore:
             seq.status = SeqStatus.FINISHED
             seq.finish_reason = FinishReason.LENGTH
             return seq
+        if request.sampling.json_mode:
+            try:
+                seq.constraint = self._make_constraint()
+            except ValueError as exc:
+                logger.warning("rejecting json_mode request: %s", exc)
+                seq.status = SeqStatus.FINISHED
+                seq.finish_reason = FinishReason.ERROR
+                return seq
         if request.mm_inputs:
             try:
                 seq.mm_embeds = self._decode_mm_inputs(request)
@@ -158,6 +175,45 @@ class EngineCore:
             return seq
         self.waiting.append(seq)
         return seq
+
+    def set_constraint_tokenizer(self, tokenizer) -> None:
+        self._constraint_tok = tokenizer
+
+    def set_constraint_tokenizer_factory(self, factory) -> None:
+        """Lazy variant: the tokenizer loads on the first json_mode request
+        (workers without constrained traffic never pay the load)."""
+        self._constraint_tok_factory = factory
+
+    def _make_constraint(self):
+        from dynamo_tpu.constrained import JsonConstraint, TokenMaskCache
+
+        with self._constraint_lock:
+            if self._mask_cache is None:
+                tok = self._constraint_tok
+                if tok is None and self._constraint_tok_factory is not None:
+                    tok = self._constraint_tok = self._constraint_tok_factory()
+                if tok is None:
+                    raise ValueError("json_mode needs a tokenizer on the engine worker")
+                self._mask_cache = TokenMaskCache(
+                    tok, self.runner.cfg.vocab_size, tuple(self._eos)
+                )
+            return JsonConstraint(self._mask_cache)
+
+    def warm_constraints(self) -> None:
+        """Pre-build the vocab piece table and the hot mask summaries OFF
+        the serving loop (a cold 128k-vocab build walks every piece through
+        the machine — seconds of work that must not land inside
+        add_request and stall co-resident decode). Launch calls this on a
+        daemon thread at worker startup; a json_mode request racing the
+        warm-up just blocks on the same lock until it finishes."""
+        from dynamo_tpu.constrained import MachineState, advance_text
+
+        try:
+            c = self._make_constraint()
+            for prefix in ("", "{", '{"', '{"k"', '{"k":', '{"k": 1', "["):
+                c.cache.mask_for(advance_text(MachineState(), prefix))
+        except Exception:
+            logger.debug("constraint warm-up skipped", exc_info=True)
 
     def _decode_mm_inputs(self, request: PreprocessedRequest):
         """mm_inputs wire format -> [total_image_tokens, D] embeddings.
@@ -398,6 +454,7 @@ class EngineCore:
                 mrope3[i, :, :new] = cols
             sb.mrope_positions = mrope3.astype(np.int32)
         lp_k = LOGPROBS_TOP_K if any(s.request.sampling.logprobs for s in batch) else 0
+        sb.logit_mask = self._constraint_masks(batch)
         try:
             stepped = self.runner.step(sb, lp_k=lp_k) if lp_k else self.runner.step(sb)
         except Exception:
@@ -415,6 +472,8 @@ class EngineCore:
             self._generated_tokens_total += 1
             self._commit_filled_pages(s)
             self._release_out_of_window(s)
+            # May finish the sequence (page release) — must follow commit.
+            self._accept_constrained(s, [int(next_tokens[i])])
             outputs.append(self._emit(s, int(next_tokens[i]), self._lp_entries(s, lp_aux, i)))
         self.running.extend(s for s in batch if not s.is_finished)
         return outputs
@@ -442,7 +501,9 @@ class EngineCore:
         # Logprobs ride the single-step sync path: the fused burst's scan
         # doesn't surface per-step logits, and mixing would stall the
         # pipeline anyway (same trade as penalties).
-        if any(s.request.sampling.logprobs for s in self.running):
+        if any(s.request.sampling.logprobs or s.constraint is not None
+               for s in self.running):
+            # (constraints additionally need a fresh mask per token)
             if self._inflight is not None:
                 return self._drain_inflight()
             return self._run_decode_sync(1)
@@ -537,6 +598,8 @@ class EngineCore:
                     break  # overshoot from the burst is discarded
             self._commit_filled_pages(s)
             self._release_out_of_window(s)
+            # May finish the sequence (page release) — must follow commit.
+            self._accept_constrained(s, accepted)
             outputs.append(self._emit_many(s, accepted, self._lp_entries(s, lp_aux, i)))
         return outputs
 
@@ -550,6 +613,8 @@ class EngineCore:
             return []
         step_batch = self._decode_step_batch(batch)
         lp_k = LOGPROBS_TOP_K if any(s.request.sampling.logprobs for s in batch) else 0
+        if k == 1:
+            step_batch.logit_mask = self._constraint_masks(batch)
         lp_aux = None
         try:
             if k == 1:
@@ -781,6 +846,31 @@ class EngineCore:
             logprobs=logprobs[: len(tokens)] if logprobs else None,
         )
         return seq, out
+
+    def _constraint_masks(self, batch: list[Sequence]) -> np.ndarray | None:
+        """bool[B, vocab] for a step: constrained rows get their machine's
+        allowed set (force-closing near the budget), others all-True."""
+        if not any(s.constraint is not None for s in batch):
+            return None
+        vocab = self.runner.cfg.vocab_size
+        mask = np.ones((len(batch), vocab), bool)
+        for i, s in enumerate(batch):
+            if s.constraint is not None:
+                mask[i] = s.constraint.mask(s.remaining_tokens(self.config.max_seq_len))
+        return mask
+
+    def _accept_constrained(self, seq: Sequence, tokens: list[int]) -> None:
+        if seq.constraint is None:
+            return
+        for t in tokens:
+            seq.constraint.accept(int(t))
+        # Vocabularies without an EOS id can't signal completion through
+        # sampling: end the sequence the moment its JSON completes. (With an
+        # EOS, the mask steers the model to emit it instead.)
+        st = seq.constraint.state
+        definitively_done = st.complete() and st.mode == "A"  # not an extendable number
+        if not self._eos and definitively_done and not seq.is_finished:
+            self._finish(seq, FinishReason.STOP)
 
     def _lp_entries(self, seq: Sequence, lp_aux, i: int) -> list[dict] | None:
         """One request's logprobs entry from a step's aux arrays (row i):

@@ -138,6 +138,8 @@ class StepBatch:
     # coords a scalar shift can't express).
     mrope_delta: np.ndarray | None = None  # i32[B]; None -> zeros at pad time
     mrope_positions: np.ndarray | None = None  # i32[B, 3, T] (mm prefill only)
+    # Constrained decoding (sync path only): bool[B, vocab] allowed tokens.
+    logit_mask: np.ndarray | None = None
 
     @property
     def batch_size(self) -> int:
@@ -194,7 +196,7 @@ class ModelRunner:
                   last_idx, temperature, top_k, top_p, seeds, sample_steps,
                   freq_pen, pres_pen, pos_limit, history, mrope_delta=None,
                   mm_embeds=None, mm_slot_offset=None, mm_counts=None,
-                  mrope_positions=None, *, impl, lp_k=0):
+                  mrope_positions=None, logit_mask=None, *, impl, lp_k=0):
             del pos_limit  # single/prefill steps never write past the finish line
             # mm_* None on text batches; jit specializes once per presence
             # pattern, so the text program carries no multimodal cost.
@@ -212,8 +214,16 @@ class ModelRunner:
                 **mm_kw,
             )
             keys = jax.vmap(lambda s, c: jax.random.fold_in(jax.random.PRNGKey(s), c))(seeds, sample_steps)
+            sample_logits = logits
+            if logit_mask is not None:
+                # Constrained decoding: disallowed tokens can never sample.
+                # Logprobs (below) stay on the RAW logits — they report the
+                # model's distribution, not the constrained one.
+                from dynamo_tpu.ops.attention import NEG_INF
+
+                sample_logits = jnp.where(logit_mask, logits, NEG_INF)
             next_tokens = sample_tokens(
-                logits, keys, temperature, top_k, top_p,
+                sample_logits, keys, temperature, top_k, top_p,
                 history=history, frequency_penalty=freq_pen, presence_penalty=pres_pen,
             )
             if lp_k:
@@ -439,6 +449,10 @@ class ModelRunner:
         if batch.mrope_positions is not None:
             mrope3 = np.zeros((bp, 3, tp), np.int32)
             mrope3[: batch.mrope_positions.shape[0], :, : batch.mrope_positions.shape[2]] = batch.mrope_positions
+        lmask = None
+        if batch.logit_mask is not None:
+            lmask = np.ones((bp, batch.logit_mask.shape[1]), bool)
+            lmask[: batch.logit_mask.shape[0]] = batch.logit_mask
 
         def pad2(a, rows, cols, fill=0):
             out = np.full((rows, cols), fill, a.dtype)
@@ -471,6 +485,7 @@ class ModelRunner:
             mrope_delta=(np.zeros(bp, np.int32) if batch.mrope_delta is None
                          else pad1(batch.mrope_delta, bp)),
             mrope_positions=mrope3,
+            logit_mask=lmask,
         )
 
     # -- execution ---------------------------------------------------------
@@ -506,7 +521,7 @@ class ModelRunner:
         traffic pays nothing."""
         b_real = batch.batch_size
         padded = self._pad(batch)
-        if padded.mm_embeds is not None:
+        if padded.mm_embeds is not None or padded.logit_mask is not None:
             if self.mesh is not None:
                 from dynamo_tpu.parallel.sharding import batch_sharding
 
@@ -514,6 +529,10 @@ class ModelRunner:
                     return jax.device_put(a, batch_sharding(self.mesh, a.ndim))
             else:
                 put = jnp.asarray
+
+            def opt(a):
+                return None if a is None else put(a)
+
             out = self._step_fn(
                 self.params, self.k_cache, self.v_cache,
                 put(padded.tokens), put(padded.positions),
@@ -524,8 +543,8 @@ class ModelRunner:
                 put(padded.freq_pen), put(padded.pres_pen),
                 put(padded.pos_limit), put(padded.history),
                 put(padded.mrope_delta),
-                put(padded.mm_embeds), put(padded.mm_slot_offset), put(padded.mm_counts),
-                None if padded.mrope_positions is None else put(padded.mrope_positions),
+                opt(padded.mm_embeds), opt(padded.mm_slot_offset), opt(padded.mm_counts),
+                opt(padded.mrope_positions), opt(padded.logit_mask),
                 impl=self._select_impl(padded) if self.mesh is not None else self.attn_impl,
                 lp_k=lp_k,
             )

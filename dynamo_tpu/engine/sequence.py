@@ -45,6 +45,9 @@ class Sequence:
     # Qwen2-VL M-RoPE: (pos3 i32[3, prompt_len], delta). Tokens past the
     # prompt (generated, incl. recompute) sit at index + delta on all axes.
     mrope: "tuple | None" = None
+    # Constrained decoding state (response_format json_object); survives
+    # preemption (the machine replays nothing — it tracks generated text).
+    constraint: "object | None" = None
     arrival_time: float = field(default_factory=time.monotonic)
     first_token_time: float | None = None
 

@@ -255,6 +255,14 @@ async def build_engine_service(spec: WorkerSpec, *, on_kv_event=None, g4_storage
             g4_storage=g4_storage,
         )
     core = EngineCore(runner, spec.engine_config, on_kv_event=on_kv_event, block_manager=block_manager)
+    # Constrained decoding (response_format json_object) needs token text;
+    # warm the vocab piece table + hot masks on a background thread so the
+    # first json_mode request doesn't stall the serving loop.
+    import threading
+
+    core.set_constraint_tokenizer_factory(lambda: load_tokenizer(spec.card.tokenizer))
+    threading.Thread(target=core.warm_constraints, daemon=True,
+                     name="constraint-warmup").start()
     return await JaxEngineService(core).start()
 
 

@@ -84,7 +84,24 @@ def extract_sampling(body: dict[str, Any]) -> SamplingOptions:
         frequency_penalty=float(body.get("frequency_penalty", 0.0) or 0.0),
         presence_penalty=float(body.get("presence_penalty", 0.0) or 0.0),
         logprobs=lp,  # +1 encoding; range-checked above (OpenAI cap 20)
+        json_mode=_json_mode_from(body.get("response_format")),
     )
+
+
+def _json_mode_from(rf) -> bool:
+    """Validate response_format: silently ignoring an unsupported type
+    would return unconstrained output to a caller who asked for schema
+    compliance."""
+    if rf is None:
+        return False
+    if not isinstance(rf, dict) or "type" not in rf:
+        raise ValueError(f"response_format must be an object with a 'type', got {rf!r}")
+    kind = rf["type"]
+    if kind == "json_object":
+        return True
+    if kind == "text":
+        return False
+    raise ValueError(f"unsupported response_format type {kind!r} (supported: text, json_object)")
 
 
 def extract_stop(body: dict[str, Any], *, default_max_tokens: int) -> StopConditions:

@@ -36,6 +36,10 @@ class SamplingOptions:
     # stay distinct from off). The reference leaves this a TODO
     # (`completions.rs:262`); first-party here.
     logprobs: int = 0
+    # OpenAI response_format {"type": "json_object"}: constrain sampling so
+    # the output is always a valid JSON prefix and force-close before the
+    # token budget runs out (dynamo_tpu/constrained.py).
+    json_mode: bool = False
 
     def to_dict(self) -> dict[str, Any]:
         return dataclasses.asdict(self)
