@@ -97,7 +97,8 @@ def advance(state: MachineState, ch: str) -> MachineState:
             return st(AFTER_KEY if lit == "k" else AFTER_VALUE)
         if ch == "\\":
             return st(STR_ESCAPE, lit)
-        return bad if ch in "\n" else st(IN_STRING, lit)
+        # RFC 8259: control characters U+0000..U+001F must be escaped.
+        return bad if ord(ch) < 0x20 else st(IN_STRING, lit)
     if mode == STR_ESCAPE:
         return st(IN_STRING, lit) if ch in _ESCAPABLE else bad
     if mode == LITERAL:
@@ -216,19 +217,27 @@ class TokenMaskCache:
     """Per-tokenizer vocab masks keyed by machine summary."""
 
     def __init__(self, tokenizer, vocab_size: int, eos_ids: tuple[int, ...]) -> None:
+        import threading
+
         self.vocab_size = vocab_size
         self.eos_ids = tuple(eos_ids)
         self._pieces: list[str] | None = None
         self._tok = tokenizer
         self._masks: dict[tuple, np.ndarray] = {}
         self._close_ids: dict[str, int | None] = {}
+        # Serializes the seconds-long cold builds (piece table, per-summary
+        # vocab walks): the warm-up thread and a racing request must not
+        # duplicate them, and the second comer blocks instead of recomputing.
+        self._build_lock = threading.Lock()
 
     def _ensure_pieces(self) -> list[str]:
         if self._pieces is None:
-            dec = self._tok.decode
-            self._pieces = [
-                dec([t], skip_special_tokens=False) for t in range(self.vocab_size)
-            ]
+            with self._build_lock:
+                if self._pieces is None:
+                    dec = self._tok.decode
+                    self._pieces = [
+                        dec([t], skip_special_tokens=False) for t in range(self.vocab_size)
+                    ]
         return self._pieces
 
     def mask_for(self, state: MachineState, *, force_close: bool = False,
@@ -247,9 +256,9 @@ class TokenMaskCache:
         """
         if force_close:
             return self._force_close_mask(state)
-        allowed, close_after = self._base_mask(state)
+        allowed, close_rel = self._base_mask(state)
         if remaining is not None:
-            allowed = allowed & (close_after <= max(remaining - 1, 1))
+            allowed = allowed & (close_rel + state.depth <= max(remaining - 1, 1))
             if not allowed.any():
                 return self._force_close_mask(state)
         return self._finalize(allowed, state)
@@ -265,6 +274,13 @@ class TokenMaskCache:
         if cached is not None:
             return cached
         pieces = self._ensure_pieces()
+        with self._build_lock:
+            cached = self._masks.get(key)  # built while we waited?
+            if cached is not None:
+                return cached
+            return self._build_mask(state, key, pieces)
+
+    def _build_mask(self, state: MachineState, key: tuple, pieces) -> tuple[np.ndarray, np.ndarray]:
         allowed = np.zeros(self.vocab_size, bool)
         close_after = np.zeros(self.vocab_size, np.int16)
         floor = state.depth - min(state.depth, 3)
@@ -276,7 +292,9 @@ class TokenMaskCache:
             ns, min_depth = advance_text_tracked(state, piece)
             if ns.mode != REJECT and min_depth >= floor:
                 allowed[t] = True
-                close_after[t] = min(self.budget_to_close(ns), 2**14)
+                # Depth-RELATIVE: states deeper than the summary cap share
+                # this entry; the caller adds its own depth back.
+                close_after[t] = min(self.budget_to_close(ns) - state.depth, 2**14)
         self._masks[key] = (allowed, close_after)
         return allowed, close_after
 

@@ -180,8 +180,10 @@ class EngineCore:
         self._constraint_tok = tokenizer
 
     def set_constraint_tokenizer_factory(self, factory) -> None:
-        """Lazy variant: the tokenizer loads on the first json_mode request
-        (workers without constrained traffic never pay the load)."""
+        """Install the tokenizer source for constrained decoding. Loaded by
+        warm_constraints (launch starts it at worker bring-up unless
+        DYNAMO_WARM_CONSTRAINTS=0) or, failing that, by the first json_mode
+        request."""
         self._constraint_tok_factory = factory
 
     def _make_constraint(self):

@@ -258,11 +258,18 @@ async def build_engine_service(spec: WorkerSpec, *, on_kv_event=None, g4_storage
     # Constrained decoding (response_format json_object) needs token text;
     # warm the vocab piece table + hot masks on a background thread so the
     # first json_mode request doesn't stall the serving loop.
+    import os
     import threading
 
     core.set_constraint_tokenizer_factory(lambda: load_tokenizer(spec.card.tokenizer))
-    threading.Thread(target=core.warm_constraints, daemon=True,
-                     name="constraint-warmup").start()
+    # Default-on warm-up trades a background thread at startup for never
+    # paying the cold vocab walk on the serving loop; fleets that never see
+    # json_mode can set DYNAMO_WARM_CONSTRAINTS=0 to skip it entirely (the
+    # first constrained request then pays the build, serialized by the
+    # cache's build lock).
+    if os.environ.get("DYNAMO_WARM_CONSTRAINTS", "1") != "0":
+        threading.Thread(target=core.warm_constraints, daemon=True,
+                         name="constraint-warmup").start()
     return await JaxEngineService(core).start()
 
 

