@@ -71,7 +71,10 @@ class MachineState:
     def summary(self) -> tuple:
         """Bounded cache key: masks computed from equal summaries are equal
         for every piece that closes at most len(kept stack) levels."""
-        return (self.mode, self.literal, self.stack[-3:], min(self.depth, 3),
+        # min(depth, 4): depth <= 3 states carry their FULL stack (every
+        # piece verdict is determined) and must never share a key with
+        # deeper states whose 4th-from-top symbol is unrecorded.
+        return (self.mode, self.literal, self.stack[-3:], min(self.depth, 4),
                 self.num_ok, self.no_close)
 
     def complete(self) -> bool:
@@ -283,7 +286,13 @@ class TokenMaskCache:
     def _build_mask(self, state: MachineState, key: tuple, pieces) -> tuple[np.ndarray, np.ndarray]:
         allowed = np.zeros(self.vocab_size, bool)
         close_after = np.zeros(self.vocab_size, np.int16)
-        floor = state.depth - min(state.depth, 3)
+        # Soundness floor: with depth <= 3 the summary records the WHOLE
+        # stack, so the machine's own verdict is exact. Deeper states may
+        # only admit pieces whose every stack consult (pop / ',' / closer
+        # match, each reading the top at sim depth s = index s-1) touches a
+        # recorded symbol: indices >= depth-3, i.e. sim depth stays
+        # >= depth-2 throughout.
+        floor = 0 if state.depth <= 3 else state.depth - 2
         for t, piece in enumerate(pieces):
             if not piece:
                 continue

@@ -63,6 +63,51 @@ def test_sharded_engine_matches_single_device():
         assert outputs[i] == greedy_reference(p, 5), f"sharded mismatch for prompt {i}"
 
 
+def test_mrope_forward_sharded_matches_single_device():
+    """Qwen2-VL M-RoPE shards like everything else: the same 3D-rope
+    forward under a dp*tp mesh reproduces the single-device logits (the
+    sectioned rope is elementwise per head slice, so tp must be exact)."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    cfg = dataclasses.replace(
+        PRESETS["test-tiny"], mrope_section=(2, 3, 3), image_token_id=250,
+    )
+    params = llama.init_params(cfg, 3)
+    b, t, ps = 2, 8, 4
+    tokens = jnp.asarray(np.random.default_rng(0).integers(1, 200, (b, t)), jnp.int32)
+    positions = jnp.tile(jnp.arange(t, dtype=jnp.int32)[None], (b, 1))
+    # Divergent 3D coords (as an image span would produce).
+    pos3 = jnp.stack([positions, positions // 2, positions % 3], axis=1)
+    tables = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+    slots = jnp.take_along_axis(tables, positions // ps, axis=1) * ps + positions % ps
+    last = jnp.full((b,), t - 1, jnp.int32)
+
+    def fwd(p):
+        kc, vc = llama.init_kv_cache(cfg, num_pages=8, page_size=ps)
+        logits, _, _ = llama.forward(
+            p, cfg, tokens, positions, kc, vc, tables, slots, last,
+            attn_impl="reference", mrope_positions=pos3,
+        )
+        return logits
+
+    want = np.asarray(fwd(params))
+    # tp <= num_kv_heads (test-tiny has 2): the documented GQA invariant.
+    mesh = make_mesh(MeshPlan(dp=4, tp=2), jax.devices())
+    placed = shard_params(params, mesh)
+    got = np.asarray(jax.jit(fwd)(placed))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+    # The 3D coords actually mattered (not silently 1D).
+    base = np.asarray(
+        jax.jit(lambda p: llama.forward(
+            p, cfg, tokens, positions, *llama.init_kv_cache(cfg, 8, ps),
+            tables, slots, last, attn_impl="reference",
+        )[0])(placed)
+    )
+    assert not np.allclose(got, base)
+
+
 def test_graft_entry_dryrun_multichip():
     import __graft_entry__ as ge
 
