@@ -107,6 +107,26 @@ def cpu_devices():
     return devices
 
 
+async def start_stack(model="test-tiny", **kw):
+    """Serve ``model`` in-process; returns (handles, base_url). Shared by
+    the HTTP-level e2e tests — keep teardown in stop_stack so handle-shape
+    changes touch one place."""
+    from dynamo_tpu.launch import run_local
+
+    kw.setdefault("num_pages", 64)
+    kw.setdefault("max_batch_size", 8)
+    handles = await run_local(model, port=0, **kw)
+    return handles, f"http://127.0.0.1:{handles['port']}"
+
+
+async def stop_stack(handles):
+    await handles["http"].stop()
+    await handles["watcher"].close()
+    for s in handles["services"]:
+        await s.close()
+    await handles["runtime"].close()
+
+
 async def wait_for(cond, timeout=5.0, interval=0.05):
     """Poll ``cond()`` until truthy or timeout; returns whether it held."""
     loop = asyncio.get_running_loop()

@@ -31,18 +31,7 @@ async def test_batch_input_mode(tmp_path, capsys):
     assert "batch done: 2 entries" in capsys.readouterr().out
 
 
-async def start_stack(**kw):
-    handles = await run_local("test-tiny", port=0, num_pages=64, max_batch_size=8, **kw)
-    base = f"http://127.0.0.1:{handles['port']}"
-    return handles, base
-
-
-async def stop_stack(handles):
-    await handles["http"].stop()
-    await handles["watcher"].close()
-    for s in handles["services"]:
-        await s.close()
-    await handles["runtime"].close()
+from tests.conftest import start_stack, stop_stack  # noqa: E402 — shared stack helpers
 
 
 async def test_models_health_live_metrics():

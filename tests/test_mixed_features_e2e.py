@@ -1,0 +1,72 @@
+"""Mixed-feature serving: text, logprobs, json_mode, and penalized requests
+CONCURRENTLY against one stack. These features each force different decode
+paths (pipelined bursts vs sync single-step with masks/aux), and the
+engine switches per batch composition — this pins the interplay: nobody's
+output corrupts anybody else's, and every contract holds simultaneously.
+"""
+
+import asyncio
+import json
+
+import pytest
+
+
+@pytest.mark.e2e
+async def test_mixed_feature_traffic_one_stack():
+    import aiohttp
+
+    from tests.conftest import start_stack, stop_stack
+
+    handles, base = await start_stack(num_pages=512)
+
+    async def post(s, body):
+        async with s.post(base + "/v1/chat/completions", json=body) as r:
+            assert r.status == 200, await r.text()
+            return await r.json()
+
+    def msg(text):
+        return [{"role": "user", "content": text}]
+
+    try:
+        async with aiohttp.ClientSession() as s:
+            # Baseline: the same text request alone, for interference checks.
+            plain_body = {"model": "test-tiny", "max_tokens": 12, "temperature": 0,
+                          "messages": msg("hello there")}
+            baseline = (await post(s, plain_body))["choices"][0]["message"]["content"]
+
+            jobs = [
+                post(s, dict(plain_body)),
+                post(s, {"model": "test-tiny", "max_tokens": 10, "temperature": 0,
+                         "logprobs": True, "top_logprobs": 3,
+                         "messages": msg("with logprobs")}),
+                post(s, {"model": "test-tiny", "max_tokens": 30, "temperature": 1.1,
+                         "seed": 7, "response_format": {"type": "json_object"},
+                         "messages": msg("json now")}),
+                post(s, {"model": "test-tiny", "max_tokens": 10, "temperature": 0.5,
+                         "seed": 3, "frequency_penalty": 0.8,
+                         "messages": msg("penalized")}),
+                post(s, {"model": "test-tiny", "max_tokens": 8, "temperature": 0,
+                         "logprobs": True, "top_logprobs": 0,
+                         "response_format": {"type": "json_object"},
+                         "messages": msg("json AND logprobs")}),
+            ]
+            plain, lp, js, pen, combo = await asyncio.gather(*jobs)
+
+            # Text neighbor unchanged by the zoo around it.
+            assert plain["choices"][0]["message"]["content"] == baseline
+
+            content = lp["choices"][0]["logprobs"]["content"]
+            assert len(content) == 10
+            assert all(len(e["top_logprobs"]) == 3 for e in content)
+
+            json.loads(js["choices"][0]["message"]["content"])
+
+            assert pen["usage"]["completion_tokens"] == 10
+
+            # Combined json_mode + logprobs: both contracts at once.
+            json.loads(combo["choices"][0]["message"]["content"])
+            centries = combo["choices"][0]["logprobs"]["content"]
+            assert len(centries) == combo["usage"]["completion_tokens"]
+            assert all(e["top_logprobs"] == [] for e in centries)
+    finally:
+        await stop_stack(handles)
