@@ -56,6 +56,11 @@ class ModelConfig:
     first_k_dense: int = 0
     # Biases on q/k/v projections (Qwen2 family).
     attention_bias: bool = False
+    # Gemma family: GeGLU MLP ("gelu_tanh"), zero-centered norm weights
+    # ((1+w) convention), sqrt(hidden) embedding scaling.
+    mlp_act: str = "silu"  # "silu" | "gelu_tanh"
+    norm_plus_one: bool = False
+    embed_scale: bool = False
     # Q/K RMS-norm before rope: "" (none), "head" (per-head over head_dim —
     # Qwen3), "flat" (over the full projection width — OLMoE).
     qk_norm: str = ""
@@ -157,6 +162,15 @@ class ModelConfig:
                 video_token_id=config.get("video_token_id"),
                 mrope_section=mrope,
             )
+        if config.get("model_type") in ("gemma2", "gemma3", "gemma3_text"):
+            # Gemma-2/3 add logit softcapping and alternating local/global
+            # attention; running them through Gemma-1 math would silently
+            # produce wrong logits. Refuse loudly.
+            raise ValueError(
+                f"model_type {config['model_type']!r} is unsupported "
+                "(Gemma-2/3 softcapping + alternating-window attention); "
+                "supported Gemma family: model_type 'gemma'"
+            )
         hidden = config["hidden_size"]
         heads = config["num_attention_heads"]
         # DeepSeek replaces the first k MoE layers with dense MLPs
@@ -217,6 +231,11 @@ class ModelConfig:
             first_k_dense=0 if all_dense else first_dense,
             attention_bias=bool(config.get("attention_bias", config.get("model_type") in (
                 "qwen2", "qwen2_moe", "qwen2_vl", "qwen2_vl_text"))),
+            # Gemma: hidden_activation gelu_pytorch_tanh (None in older
+            # configs means the same), (1+w) norms, sqrt(hidden) embeds.
+            mlp_act="gelu_tanh" if config.get("model_type") == "gemma" else "silu",
+            norm_plus_one=config.get("model_type") == "gemma",
+            embed_scale=config.get("model_type") == "gemma",
             qk_norm={"qwen3": "head", "qwen3_moe": "head", "olmoe": "flat"}.get(
                 config.get("model_type", ""), ""
             ),

@@ -543,6 +543,10 @@ def config_from_gguf(reader: GGUFReader, *, name: str | None = None) -> ModelCon
         toks = md.get("tokenizer.ggml.tokens")
         vocab = len(toks) if toks else 32000
     head_dim = int(get("attention.key_length", hidden // max(heads, 1)))
+    # Gemma GGUFs: GeGLU + scaled embeddings come from the arch; the (1+w)
+    # norm convention does NOT apply — llama.cpp's converter bakes the +1
+    # into the exported norm weights.
+    gemma = arch == "gemma"
     tied = "output.weight" not in reader.tensors
     # Rope scaling: GGUF stores {arch}.rope.scaling.* (llama.cpp key names);
     # map onto the HF-schema dict rope_frequencies consumes. Llama-3-style
@@ -583,6 +587,8 @@ def config_from_gguf(reader: GGUFReader, *, name: str | None = None) -> ModelCon
         rms_eps=float(get("attention.layer_norm_rms_epsilon", 1e-5)),
         max_position=int(get("context_length", 4096)),
         tie_embeddings=tied,
+        mlp_act="gelu_tanh" if gemma else "silu",
+        embed_scale=gemma,  # norm_plus_one deliberately NOT set (see above)
         num_experts=int(get("expert_count", 0)),
         num_experts_per_token=int(get("expert_used_count", 0)),
         moe_intermediate_size=int(get("expert_feed_forward_length", 0)),

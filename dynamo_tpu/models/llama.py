@@ -159,8 +159,10 @@ def init_kv_cache(cfg: ModelConfig, num_pages: int, page_size: int, dtype: jnp.d
     return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
 
 
-def _mlp_dense(lp: Params, x: jnp.ndarray) -> jnp.ndarray:
-    gate = jax.nn.silu(_qmm(x, lp["w_gate"]))
+def _mlp_dense(lp: Params, x: jnp.ndarray, act: str = "silu") -> jnp.ndarray:
+    gp = _qmm(x, lp["w_gate"])
+    # Gemma's GeGLU uses the tanh-approximate gelu (HF gelu_pytorch_tanh).
+    gate = jax.nn.gelu(gp, approximate=True) if act == "gelu_tanh" else jax.nn.silu(gp)
     return _qmm(gate * _qmm(x, lp["w_up"]), lp["w_down"])
 
 
@@ -298,6 +300,8 @@ def forward(
     inv_freq = jnp.asarray(rope_frequencies(cfg.head_dim, theta=cfg.rope_theta, scaling=cfg.rope_scaling))
     attn_mscale = rope_attention_factor(cfg.rope_scaling) ** 2
     x = params["embed"][tokens]  # [B, T, D]
+    if cfg.embed_scale:  # Gemma: embeddings scale by sqrt(hidden)
+        x = x * jnp.asarray(cfg.hidden_size**0.5, x.dtype)
     if mm_embeds is not None and cfg.image_token_id is not None:
         is_img = tokens == jnp.int32(cfg.image_token_id)  # [B, T]
         if cfg.video_token_id is not None:
@@ -359,7 +363,7 @@ def forward(
     def make_layer_step(moe_layer: bool):
         def layer_step(carry, lp):
             x, k_full, v_full, li = carry
-            h = rms_norm(x, lp["attn_norm"], eps=cfg.rms_eps)
+            h = rms_norm(x, lp["attn_norm"], eps=cfg.rms_eps, plus_one=cfg.norm_plus_one)
             if mla:
                 from dynamo_tpu.models.mla import mla_attention
 
@@ -374,8 +378,8 @@ def forward(
                     impl=attn_impl,
                 )
                 x = x + attn_out
-                h2 = rms_norm(x, lp["mlp_norm"], eps=cfg.rms_eps)
-                mlp = _mlp_moe(lp, h2, cfg, mesh) if moe_layer else _mlp_dense(lp, h2)
+                h2 = rms_norm(x, lp["mlp_norm"], eps=cfg.rms_eps, plus_one=cfg.norm_plus_one)
+                mlp = _mlp_moe(lp, h2, cfg, mesh) if moe_layer else _mlp_dense(lp, h2, cfg.mlp_act)
                 return (x + mlp, k_full, v_full, li + 1), None
             qp, kp, vp = _qmm(h, lp["wq"]), _qmm(h, lp["wk"]), _qmm(h, lp["wv"])
             if cfg.attention_bias:
@@ -424,8 +428,8 @@ def forward(
                 else:
                     attn = paged_attention(q, k_full, v_full, tables_l, positions, impl=attn_impl)
             x = x + _qmm(attn.reshape(b, t, cfg.q_dim), lp["wo"])
-            h2 = rms_norm(x, lp["mlp_norm"], eps=cfg.rms_eps)
-            mlp = _mlp_moe(lp, h2, cfg, mesh) if moe_layer else _mlp_dense(lp, h2)
+            h2 = rms_norm(x, lp["mlp_norm"], eps=cfg.rms_eps, plus_one=cfg.norm_plus_one)
+            mlp = _mlp_moe(lp, h2, cfg, mesh) if moe_layer else _mlp_dense(lp, h2, cfg.mlp_act)
             x = x + mlp
             return (x, k_full, v_full, li + 1), None
 
@@ -446,7 +450,7 @@ def forward(
     k_out = k_out.reshape(k_cache.shape)
     v_out = v_out.reshape(v_cache.shape)
 
-    x = rms_norm(x, params["norm_f"], eps=cfg.rms_eps)
+    x = rms_norm(x, params["norm_f"], eps=cfg.rms_eps, plus_one=cfg.norm_plus_one)
     last = jnp.take_along_axis(x, last_token_index[:, None, None], axis=1)[:, 0]  # [B, D]
     # bf16 operands, f32 accumulate: no f32 materialization of the (huge)
     # embedding matrix per step; quantized lm_head goes through the shared
@@ -484,6 +488,8 @@ def encode(
     inv_freq = jnp.asarray(rope_frequencies(cfg.head_dim, theta=cfg.rope_theta, scaling=cfg.rope_scaling))
     attn_mscale = rope_attention_factor(cfg.rope_scaling) ** 2
     x = params["embed"][tokens]  # [B, T, D]
+    if cfg.embed_scale:  # Gemma: embeddings scale by sqrt(hidden)
+        x = x * jnp.asarray(cfg.hidden_size**0.5, x.dtype)
 
     causal = jnp.tril(jnp.ones((t, t), bool))
     if cfg.sliding_window > 0:
@@ -497,7 +503,7 @@ def encode(
 
     def make_layer_step(moe_layer: bool):
         def layer_step(x, lp):
-            h = rms_norm(x, lp["attn_norm"], eps=cfg.rms_eps)
+            h = rms_norm(x, lp["attn_norm"], eps=cfg.rms_eps, plus_one=cfg.norm_plus_one)
             qp, kp, vp = _qmm(h, lp["wq"]), _qmm(h, lp["wk"]), _qmm(h, lp["wv"])
             if cfg.attention_bias:
                 qp, kp, vp = qp + lp["bq"], kp + lp["bk"], vp + lp["bv"]
@@ -520,8 +526,8 @@ def encode(
             probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
             attn = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v).reshape(b, t, cfg.q_dim)
             x = x + _qmm(attn, lp["wo"])
-            h2 = rms_norm(x, lp["mlp_norm"], eps=cfg.rms_eps)
-            mlp = _mlp_moe(lp, h2, cfg) if moe_layer else _mlp_dense(lp, h2)
+            h2 = rms_norm(x, lp["mlp_norm"], eps=cfg.rms_eps, plus_one=cfg.norm_plus_one)
+            mlp = _mlp_moe(lp, h2, cfg) if moe_layer else _mlp_dense(lp, h2, cfg.mlp_act)
             return x + mlp, None
 
         return layer_step
@@ -529,7 +535,7 @@ def encode(
     if "dense_layers" in params:
         x, _ = jax.lax.scan(make_layer_step(False), x, params["dense_layers"])
     x, _ = jax.lax.scan(make_layer_step(cfg.is_moe), x, params["layers"])
-    x = rms_norm(x, params["norm_f"], eps=cfg.rms_eps).astype(jnp.float32)
+    x = rms_norm(x, params["norm_f"], eps=cfg.rms_eps, plus_one=cfg.norm_plus_one).astype(jnp.float32)
     m = mask[:, :, None].astype(jnp.float32)
     if pooling == "last":
         # Last real token's hidden state — the recipe instruction-tuned

@@ -790,6 +790,13 @@ def save_params(
         else:
             hf_cfg["model_type"] = "olmoe"
             hf_cfg["architectures"] = ["OlmoeForCausalLM"]
+    if cfg.norm_plus_one:
+        # Gemma's math (GeGLU, (1+w) norms, scaled embeddings) is keyed off
+        # model_type at load — a "llama"-typed save would silently reload
+        # with silu/plain-norm math over Gemma weights.
+        hf_cfg["model_type"] = "gemma"
+        hf_cfg["architectures"] = ["GemmaForCausalLM"]
+        hf_cfg["hidden_activation"] = "gelu_pytorch_tanh"
     if cfg.attn_type == "mla":
         hf_cfg.update(
             model_type="deepseek_v3",

@@ -279,3 +279,55 @@ def test_golden_mistral_sliding_window(tmp_path):
     from dynamo_tpu.models.config import ModelConfig
 
     assert ModelConfig.from_hf(tmp_path / "config.json").sliding_window == 4
+
+
+def test_golden_gemma(tmp_path):
+    """Gemma family: GeGLU (gelu_pytorch_tanh) MLP, zero-centered (1+w)
+    norm weights, sqrt(hidden) embedding scaling, tied head."""
+    from transformers import GemmaConfig, GemmaForCausalLM
+
+    torch.manual_seed(0)
+    m = GemmaForCausalLM(GemmaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, rope_theta=10000.0, tie_word_embeddings=True,
+        hidden_activation="gelu_pytorch_tanh",
+    ))
+    _save(m, tmp_path)
+    cfg = ModelConfig.from_hf(tmp_path / "config.json")
+    assert cfg.mlp_act == "gelu_tanh" and cfg.norm_plus_one and cfg.embed_scale
+    _assert_family_matches(m, tmp_path)
+
+
+def test_gemma_save_load_round_trip(tmp_path):
+    """save_params pins model_type 'gemma' so the family math survives a
+    save->load cycle; gemma2/3 configs are rejected loudly (softcapping +
+    alternating windows are not Gemma-1 math)."""
+    from transformers import GemmaConfig, GemmaForCausalLM
+
+    from dynamo_tpu.models.loader import save_params
+
+    torch.manual_seed(1)
+    m = GemmaForCausalLM(GemmaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, rope_theta=10000.0, tie_word_embeddings=True,
+        hidden_activation="gelu_pytorch_tanh",
+    ))
+    _save(m, tmp_path)
+    cfg = ModelConfig.from_hf(tmp_path / "config.json")
+    params = load_params(tmp_path, cfg, dtype="float32")
+    out = tmp_path / "resaved"
+    save_params(out, cfg, params)
+    cfg2 = ModelConfig.from_hf(out / "config.json")
+    assert cfg2.mlp_act == "gelu_tanh" and cfg2.norm_plus_one and cfg2.embed_scale
+    params2 = load_params(out, cfg2, dtype="float32")
+    a, b = __import__("jax").tree.leaves(params), __import__("jax").tree.leaves(params2)
+    for x, y in zip(a, b):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-6)
+
+    with pytest.raises(ValueError, match="gemma2"):
+        ModelConfig.from_hf({"model_type": "gemma2", "hidden_size": 64,
+                             "num_attention_heads": 4, "num_hidden_layers": 2,
+                             "vocab_size": 8, "intermediate_size": 8,
+                             "num_key_value_heads": 2})
