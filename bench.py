@@ -361,7 +361,10 @@ def probe_cross_process_wire() -> dict:
 
     pages = int(os.environ.get("BENCH_WIRE_PAGES", "8"))
     iters = int(os.environ.get("BENCH_WIRE_ITERS", "5"))
-    return asyncio.run(measure_cross_process(pages_per_chain=pages, iters=iters))
+    chunk = int(os.environ.get("BENCH_WIRE_CHUNK", "0")) or None  # 0 = auto
+    return asyncio.run(
+        measure_cross_process(pages_per_chain=pages, iters=iters, chunk_pages=chunk)
+    )
 
 
 def main() -> None:

@@ -40,6 +40,7 @@ import numpy as np
 VALUE = "V"        # expecting a value start
 IN_STRING = "S"    # inside a string
 STR_ESCAPE = "E"   # after backslash in a string
+STR_HEX = "U"      # inside \uXXXX: literal = key-marker + one 'h' per digit left
 IN_NUMBER = "N"    # inside a number (last char was part of a number)
 AFTER_VALUE = "A"  # a value just completed; expect , } ] or end
 EXPECT_KEY = "K"   # inside an object, expecting a key string or }
@@ -49,7 +50,11 @@ REJECT = "X"
 
 _WS = " \t\n\r"
 _LITERALS = {"t": "rue", "f": "alse", "n": "ull"}
-_ESCAPABLE = set('"\\/bfnrtu0123456789abcdefABCDEF')
+# RFC 8259 string escapes: exactly these after a backslash; \u is handled
+# as its own pending-hex state so it consumes exactly 4 hex digits ('\u12',
+# '\uZZZZ' or a bare '\q' must not be accepted — json.loads rejects them).
+_ESCAPABLE = set('"\\/bfnrt')
+_HEX = set("0123456789abcdefABCDEF")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -103,7 +108,14 @@ def advance(state: MachineState, ch: str) -> MachineState:
         # RFC 8259: control characters U+0000..U+001F must be escaped.
         return bad if ord(ch) < 0x20 else st(IN_STRING, lit)
     if mode == STR_ESCAPE:
+        if ch == "u":
+            return st(STR_HEX, lit + "hhhh")
         return st(IN_STRING, lit) if ch in _ESCAPABLE else bad
+    if mode == STR_HEX:
+        if ch not in _HEX:
+            return bad
+        rest = lit[:-1]  # one pending hex digit consumed
+        return st(STR_HEX, rest) if rest.endswith("h") else st(IN_STRING, rest)
     if mode == LITERAL:
         if lit and ch == lit[0]:
             return st(AFTER_VALUE) if len(lit) == 1 else st(LITERAL, lit[1:])
@@ -296,7 +308,7 @@ class TokenMaskCache:
         for t, piece in enumerate(pieces):
             if not piece:
                 continue
-            if "�" in piece and state.mode in (IN_STRING, STR_ESCAPE, VALUE, EXPECT_KEY):
+            if "�" in piece and state.mode in (IN_STRING, STR_ESCAPE, STR_HEX, VALUE, EXPECT_KEY):
                 continue  # lossy single-token decode: keep strings clean
             ns, min_depth = advance_text_tracked(state, piece)
             if ns.mode != REJECT and min_depth >= floor:
@@ -336,8 +348,10 @@ class TokenMaskCache:
                 return self.mask_for(state)
             return out
         want: str | None = None
-        if state.mode in (IN_STRING, STR_ESCAPE):
-            want = '"' if state.mode == IN_STRING else "n"  # finish escape minimally
+        if state.mode in (IN_STRING, STR_ESCAPE, STR_HEX):
+            # IN_STRING: terminate; STR_ESCAPE: finish the escape minimally;
+            # STR_HEX: feed hex digits until the 4 are consumed.
+            want = {IN_STRING: '"', STR_ESCAPE: "n", STR_HEX: "0"}[state.mode]
         elif state.mode == AFTER_KEY:
             want = ":"
         elif state.mode == VALUE:
@@ -369,6 +383,11 @@ class TokenMaskCache:
         single-char force-close steps."""
         extra = {IN_STRING: 1, STR_ESCAPE: 2, AFTER_KEY: 2, VALUE: 1,
                  EXPECT_KEY: 1, LITERAL: len(state.literal)}.get(state.mode, 0)
+        if state.mode == STR_HEX:
+            extra = state.literal.count("h") + 1  # pending hex digits + '"'
+        if state.mode in (IN_STRING, STR_ESCAPE, STR_HEX) and state.literal.startswith("k"):
+            extra += 2  # key string: the closing '"' lands in AFTER_KEY, so
+            #             ':' + a one-char value must still fit
         if state.mode == IN_NUMBER and not state.num_ok:
             extra = 1  # one digit terminates any incomplete number phase
         if state.mode == EXPECT_KEY and state.no_close:

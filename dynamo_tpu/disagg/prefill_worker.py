@@ -14,7 +14,12 @@ import asyncio
 import logging
 
 from dynamo_tpu.disagg.queue import DistributedQueue
-from dynamo_tpu.disagg.transfer import collect_prefill_blocks, send_blocks, send_pull_offer
+from dynamo_tpu.disagg.transfer import (
+    collect_prefill_blocks,
+    send_blocks,
+    send_blocks_chunked,
+    send_pull_offer,
+)
 from dynamo_tpu.engine.service import JaxEngineService
 from dynamo_tpu.protocols.common import PreprocessedRequest, SamplingOptions, StopConditions
 from dynamo_tpu.runtime.component import DistributedRuntime
@@ -115,6 +120,28 @@ class PrefillWorker:
             logger.info(
                 "prefill %s: %d tokens -> %s blocks via cross-process device pull (%s)",
                 request_id, len(token_ids), result.get("injected"), result.get("stats"),
+            )
+            return
+
+        # Chunked TCP stream (wire v2): gather, pack and wire pipelined per
+        # chunk, runner lock released between chunks. The monolithic v1
+        # collect-then-send below is the last-resort fallback.
+        try:
+            result = await send_blocks_chunked(
+                self.runtime.transport, task["transfer_address"], request_id,
+                self.service.core, hashes,
+            )
+        except Exception:
+            logger.exception(
+                "prefill %s: chunked stream failed, falling back to monolithic TCP", request_id
+            )
+        else:
+            if result.get("total", 0) == 0:
+                logger.warning("prefill %s produced no transferable blocks", request_id)
+            logger.info(
+                "prefill %s: %d tokens -> %s blocks streamed in chunks (%s injected, phases %s)",
+                request_id, len(token_ids), result.get("total"),
+                result.get("injected"), result.get("phases"),
             )
             return
 

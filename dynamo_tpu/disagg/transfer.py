@@ -11,6 +11,20 @@ Wire format per block (msgpack-native, no base64):
   {"hash": int, "parent": int|None, "tokens": [int], "k": bytes, "v": bytes,
    "shape": [L, ps, kv, hd], "dtype": str}
 
+Two framings carry those blocks (docs/KV_TRANSFER_WIRE_V2.md):
+
+- v1 (monolithic): one ``{"request_id", "blocks": [...]}`` message with the
+  whole chain — collect-then-send, retained as the last-resort fallback.
+- v2 (streaming): a sequence of ``{"request_id", "seq", "blocks", "last"}``
+  chunk messages. The sender (:func:`send_blocks_chunked`) pipelines them:
+  chunk N+1's device gather + D2H copy is dispatched (``read_pages_async``)
+  before chunk N is packed and sent, so gather, pack and wire overlap and
+  the runner lock releases between chunks. The receiver scatters each chunk
+  with one batched ``write_pages``, commits it incrementally (every prefix
+  of the hash chain is a valid cache state) while holding refcounts so a
+  later chunk's allocations can't evict the chain, and rolls back staging
+  on mid-stream failure or sender death.
+
 Completion notifications resolve per-request futures so the disagg operator
 holding the original request knows when injection is done.
 
@@ -24,7 +38,9 @@ fast path rides the same interface.
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import logging
+import time
 from typing import Any, AsyncIterator
 
 import numpy as np
@@ -37,6 +53,35 @@ from dynamo_tpu.runtime.transport import Transport
 logger = logging.getLogger(__name__)
 
 KV_TRANSFER_ENDPOINT = "kv_transfer"
+
+#: Pages per streamed chunk — the same bounded-lock-hold sizing as
+#: ``device_transfer.DeviceKvTransfer.CHUNK_PAGES``: each chunk's gather
+#: holds the sender's io_lock for one dispatch only, and each chunk is one
+#: compiled pow2 shape, so a long chain costs a handful of programs and the
+#: engines' decode loops interleave with an in-flight transfer.
+CHUNK_PAGES = 64
+
+
+@dataclasses.dataclass
+class _StreamSession:
+    """Receiver-side state of one in-flight v2 chunk stream.
+
+    ``pinned`` holds refcounts on every block of the chain ingested so far
+    (cache hits AND incrementally-committed chunks): a later chunk's
+    allocations must not be able to evict the chain prefix mid-stream. The
+    refcounts drop when the stream ends — on the ``last`` chunk, an abort,
+    an error, or the abandoned-stream sweep.
+    """
+
+    next_seq: int = 0
+    pinned: list[int] = dataclasses.field(default_factory=list)
+    injected: int = 0
+    total_blocks: int = 0
+    #: Pool exhaustion truncated the chain: later chunks are acknowledged
+    #: but not ingested (their parents are missing — committing them would
+    #: publish unreachable blocks).
+    truncated: bool = False
+    t_last: float = dataclasses.field(default_factory=time.monotonic)
 
 
 def pack_block(block_hash: int, parent_hash: int | None, tokens: list[int], k: np.ndarray, v: np.ndarray) -> dict:
@@ -79,10 +124,13 @@ class KvTransferService(AsyncEngine[Any, dict]):
         # request_id -> (pinned, staged, parents, t_monotonic): pages staged
         # by a pull_query, awaiting the matching pull (two-phase protocol).
         self._pending_pulls: dict[str, tuple[list[int], list, list, float]] = {}
+        # request_id -> in-flight v2 chunk stream (wire protocol v2).
+        self._streams: dict[str, _StreamSession] = {}
         self._sweeper: asyncio.Task | None = None
         self.blocks_received = 0
         self.bytes_received = 0
         self.transfer_seconds = 0.0
+        self.scatter_seconds = 0.0
         self.device_path_blocks = 0
 
     def start_sweeper(self, interval: float | None = None) -> "KvTransferService":
@@ -123,6 +171,8 @@ class KvTransferService(AsyncEngine[Any, dict]):
             "device_path_blocks": self.device_path_blocks,
             "bytes": self.bytes_received,
             "seconds": round(self.transfer_seconds, 6),
+            "scatter_s": round(self.scatter_seconds, 6),
+            "streams_in_flight": len(self._streams),
             "gbytes_per_sec": round(gbps, 6),
         }
 
@@ -226,8 +276,6 @@ class KvTransferService(AsyncEngine[Any, dict]):
         self.core.allocator.release(pinned)
 
     def _sweep_pending_pulls(self) -> None:
-        import time
-
         now = time.monotonic()
         for rid in [
             rid for rid, (_p, _s, _pa, t0) in self._pending_pulls.items()
@@ -235,6 +283,104 @@ class KvTransferService(AsyncEngine[Any, dict]):
         ]:
             logger.warning("abandoned pull staging for %s rolled back", rid)
             self._abort_pull(rid)
+        for rid in [
+            rid for rid, sess in self._streams.items()
+            if now - sess.t_last > self.PENDING_PULL_MAX_AGE
+        ]:
+            logger.warning("abandoned chunk stream for %s rolled back", rid)
+            self._abort_stream(rid)
+
+    # -- wire protocol v2: streaming chunk ingestion -----------------------
+
+    def _abort_stream(self, request_id: str) -> None:
+        """Drop a chunk stream's session and its chain refcounts.
+
+        Blocks committed by earlier chunks STAY in the prefix cache — an
+        incremental commit only ever publishes a valid, chain-consistent
+        prefix — but releasing the pins makes them ordinary evictable cache
+        again, so a dead sender reclaims to a clean allocator state.
+        """
+        sess = self._streams.pop(request_id, None)
+        if sess is None:
+            return
+        self.core.allocator.release(sess.pinned)
+
+    async def _ingest_chunk(self, request_id: str, request: dict) -> dict:
+        """One v2 chunk: stage, scatter (one batched ``write_pages``), and
+        commit incrementally, keeping the whole chain pinned until ``last``.
+
+        Any failure rolls the stream back (:meth:`_abort_stream`): the
+        uncommitted staged pages return to the free pool and the response's
+        ``stream_error`` tells the sender to fall back to the monolithic
+        path. Out-of-order or unknown ``seq`` is a protocol error and also
+        aborts — a reconnecting sender restarts at seq 0, which replaces
+        any stale session for the same request id.
+        """
+        seq = int(request.get("seq", 0))
+        last = bool(request.get("last"))
+        blocks = request.get("blocks", [])
+        sess = self._streams.get(request_id)
+        if seq == 0:
+            if sess is not None:
+                self._abort_stream(request_id)
+            sess = _StreamSession()
+            self._streams[request_id] = sess
+        if sess is None or seq != sess.next_seq:
+            self._abort_stream(request_id)
+            return {
+                "request_id": request_id, "seq": seq,
+                "stream_error": f"unexpected seq {seq}"
+                + (f" (want {sess.next_seq})" if sess else " (no session)"),
+            }
+        t0 = time.perf_counter()
+        staged: list[tuple[int, int, Any]] = []
+        try:
+            sess.total_blocks += len(blocks)
+            if not sess.truncated and blocks:
+                pinned, staged = self._stage_chain((blk["hash"], blk) for blk in blocks)
+                sess.pinned.extend(pinned)
+                if len(pinned) + len(staged) < len(blocks):
+                    sess.truncated = True  # pool exhausted: drop the tail
+                if staged:
+                    payloads = [unpack_payload(blk) for _pid, _h, blk in staged]
+                    t_sc = time.perf_counter()
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, self.core.runner.write_pages,
+                        [pid for pid, _h, _b in staged],
+                        [k for k, _ in payloads], [v for _, v in payloads],
+                    )
+                    self.scatter_seconds += time.perf_counter() - t_sc
+                    alloc = self.core.allocator
+                    for pid, h, blk in staged:
+                        # Incremental commit: publish, but KEEP the staging
+                        # refcount as the session's pin (released at stream
+                        # end) so later chunks can't evict the chain prefix.
+                        alloc.commit(pid, h, blk.get("parent"), tuple(blk.get("tokens", ())))
+                        sess.pinned.append(pid)
+                        self.blocks_received += 1
+                    self.bytes_received += sum(k.nbytes + v.nbytes for k, v in payloads)
+                sess.injected += len(pinned) + len(staged)
+            self.transfer_seconds += time.perf_counter() - t0
+        except Exception:
+            self._release_staged(staged)
+            self._abort_stream(request_id)
+            logger.exception(
+                "kv chunk ingestion failed (req=%s seq=%d); stream rolled back",
+                request_id, seq,
+            )
+            return {"request_id": request_id, "seq": seq, "stream_error": "ingestion failed"}
+        sess.next_seq = seq + 1
+        sess.t_last = time.monotonic()
+        summary = {"request_id": request_id, "seq": seq, "injected": sess.injected, "last": last}
+        if last:
+            self._streams.pop(request_id, None)
+            self.core.allocator.release(sess.pinned)
+            summary["total"] = sess.total_blocks
+            summary["stats"] = self.stats()
+            ev = self._completions.get(request_id)
+            if ev is not None:
+                ev.set()
+        return summary
 
     async def _handle_pull_query(self, request_id: str, query: dict) -> dict:
         """Phase 1 of the two-phase device-path pull: report which chain
@@ -361,8 +507,12 @@ class KvTransferService(AsyncEngine[Any, dict]):
     async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
         """Request forms:
 
-        - ``{"request_id", "blocks": [packed blocks...]}`` — packed-bytes
-          stream (DCN fallback);
+        - ``{"request_id", "seq", "blocks", "last"}`` — wire protocol v2:
+          one chunk of a pipelined stream (:meth:`_ingest_chunk`);
+        - ``{"request_id", "stream_abort": true}`` — sender abandoned a v2
+          stream mid-flight; roll back its session;
+        - ``{"request_id", "blocks": [packed blocks...]}`` — v1 monolithic
+          packed-bytes message (last-resort fallback);
         - ``{"request_id", "pull_query": {hashes, parents}}`` — phase 1 of
           the device-path pull (:meth:`_handle_pull_query`);
         - ``{"request_id", "pull": descriptor}`` — phase 2
@@ -370,18 +520,24 @@ class KvTransferService(AsyncEngine[Any, dict]):
         - ``{"request_id", "pull_abort": true}`` — sender abandoned a
           staged pull (falls back to packed bytes); roll back staging.
 
-        Responds with one summary item. The whole chain is staged (allocate +
-        unpack) then written as one batched scatter and committed; a failure
-        anywhere releases the staged pages, so the cache keeps only
-        previously-present blocks — still a valid, chain-consistent prefix.
+        Responds with one summary item per message. On the v1 path the whole
+        chain is staged (allocate + unpack) then written as one batched
+        scatter and committed; a failure anywhere releases the staged pages,
+        so the cache keeps only previously-present blocks — still a valid,
+        chain-consistent prefix.
         """
-        import time
-
         request_id = request.get("request_id", "")
         # Reclaim staging abandoned by dead senders on EVERY interaction,
         # not just pull queries — otherwise packed-bytes-only traffic never
         # frees it.
         self._sweep_pending_pulls()
+        if "seq" in request:
+            yield await self._ingest_chunk(request_id, request)
+            return
+        if request.get("stream_abort"):
+            self._abort_stream(request_id)
+            yield {"request_id": request_id, "aborted": True}
+            return
         if request.get("pull_query") is not None:
             yield await self._handle_pull_query(request_id, request["pull_query"])
             return
@@ -392,8 +548,10 @@ class KvTransferService(AsyncEngine[Any, dict]):
             self._abort_pull(request_id)
             yield {"request_id": request_id, "aborted": True}
             return
-        # Packed-bytes path: supersedes any staged pull for this request.
+        # Packed-bytes path: supersedes any staged pull or stream for this
+        # request.
         self._abort_pull(request_id)
+        self._abort_stream(request_id)
         blocks = request.get("blocks", [])
         injected = 0
         t0 = time.perf_counter()
@@ -443,6 +601,104 @@ async def send_blocks(
     async for item in transport.generate(address, {"request_id": request_id, "blocks": blocks}, context):
         result = item
     return result
+
+
+async def send_blocks_chunked(
+    transport: Transport,
+    address: str,
+    request_id: str,
+    core: EngineCore,
+    block_hashes: list[int],
+    *,
+    chunk_pages: int = CHUNK_PAGES,
+    context: Context | None = None,
+) -> dict:
+    """Pipelined chunked transfer of a committed hash chain (wire v2).
+
+    The chain's pages are shipped in ``chunk_pages`` chunks with the three
+    phases double-buffered: chunk N+1's batched gather + device->host DMA is
+    dispatched (``read_pages_async``, lock held for the dispatch only)
+    BEFORE chunk N is packed and sent, so the D2H copy rides under chunk N's
+    msgpack pack + TCP round trip and the sender's decode loop interleaves
+    between chunks. The receiver scatters and commits each chunk
+    incrementally (:meth:`KvTransferService._ingest_chunk`).
+
+    Returns the receiver's final summary, augmented with ``bytes`` and
+    per-phase wall times ``phases = {gather_s, pack_s, wire_s}`` (phase sums
+    exceed the end-to-end time exactly when the overlap is real — that is
+    the number the kv_wire bench tracks). Raises on a mid-stream failure
+    after telling the receiver to roll back; callers fall back to the v1
+    monolithic path.
+    """
+    context = context or Context()
+    loop = asyncio.get_running_loop()
+    allocator = core.allocator
+    runner = core.runner
+    # Hold the chain's refcounts for the whole stream: the gather of chunk
+    # N+1 is in flight while chunk N is on the wire, and eviction must not
+    # reuse any of these pages until the last chunk is packed.
+    pages = await loop.run_in_executor(None, allocator.match_prefix, block_hashes)
+    phases = {"gather_s": 0.0, "pack_s": 0.0, "wire_s": 0.0}
+    total_bytes = 0
+    streaming = False  # any chunk reached the receiver (it may hold session state)
+    try:
+        if not pages:
+            return {"request_id": request_id, "injected": 0, "total": 0, "phases": phases, "bytes": 0}
+        hashes = list(block_hashes[: len(pages)])
+        parents = [allocator.page_parent_hash(pid) for pid in pages]
+        chunks = [
+            (pages[off : off + chunk_pages], hashes[off : off + chunk_pages],
+             parents[off : off + chunk_pages])
+            for off in range(0, len(pages), chunk_pages)
+        ]
+
+        def _dispatch(pids: list[int]):
+            return time.perf_counter(), runner.read_pages_async(pids)
+
+        t_dispatch, inflight = await loop.run_in_executor(None, _dispatch, chunks[0][0])
+        result: dict = {}
+        for i, (_pids, chunk_hashes, chunk_parents) in enumerate(chunks):
+            payloads = await loop.run_in_executor(None, inflight.wait)
+            phases["gather_s"] += time.perf_counter() - t_dispatch
+            if i + 1 < len(chunks):
+                # Double buffer: next chunk's gather + D2H DMA starts now and
+                # runs under THIS chunk's pack + wire.
+                t_dispatch, inflight = await loop.run_in_executor(None, _dispatch, chunks[i + 1][0])
+            t_pack = time.perf_counter()
+            blocks = await loop.run_in_executor(
+                None,
+                lambda: [
+                    pack_block(chunk_hashes[j], chunk_parents[j], [], k, v)
+                    for j, (k, v) in enumerate(payloads)
+                ],
+            )
+            phases["pack_s"] += time.perf_counter() - t_pack
+            total_bytes += sum(len(b["k"]) + len(b["v"]) for b in blocks)
+            t_wire = time.perf_counter()
+            streaming = True
+            resp = await _round_trip(transport, address, {
+                "request_id": request_id, "seq": i, "blocks": blocks,
+                "last": i == len(chunks) - 1,
+            })
+            phases["wire_s"] += time.perf_counter() - t_wire
+            if resp.get("stream_error"):
+                # The receiver already rolled the stream back.
+                streaming = False
+                raise RuntimeError(f"kv chunk stream rejected: {resp['stream_error']}")
+            result = resp
+        streaming = False
+        result["phases"] = {k: round(v, 6) for k, v in phases.items()}
+        result["bytes"] = total_bytes
+        return result
+    finally:
+        if streaming:
+            # Mid-stream failure on our side (or transport death): best-effort
+            # tell the receiver to roll back its session before we fall back.
+            try:
+                await _round_trip(transport, address, {"request_id": request_id, "stream_abort": True})
+            except Exception:
+                logger.warning("stream abort for %s not delivered", request_id)
+        await loop.run_in_executor(None, allocator.release, pages)
 
 
 def _gather_page_stack(core: EngineCore, page_ids: list[int]):

@@ -372,14 +372,32 @@ class ModelRunner:
         Page ids are padded to a power-of-two bucket so the jitted gather
         compiles for a handful of shapes only.
         """
+        return self.read_pages_async(page_ids).wait()
+
+    @_locked
+    def read_pages_async(self, page_ids: list[int]) -> "InFlightPages":
+        """Dispatch a batched page gather WITHOUT blocking on the result.
+
+        Holds ``io_lock`` only for the gather dispatch + D2H kickoff, then
+        returns an :class:`InFlightPages` handle whose ``wait()`` blocks on
+        the host buffers. The gather output is a fresh device array (not an
+        alias of the cache), so engine steps that donate the cache buffers
+        can run while the copy is in flight — this is what lets a chunked
+        KV transfer overlap chunk N+1's gather with chunk N's pack + wire.
+        Same pow2 bucketing as :meth:`read_pages`: no new compiled shapes.
+        """
         if not page_ids:
-            return []
+            return InFlightPages(None, None, 0)
         n = len(page_ids)
         padded = np.zeros(next_pow2(n), np.int32)
         padded[:n] = page_ids
         k, v = self._gather_pages_fn(self.k_cache, self.v_cache, jnp.asarray(padded))
-        k_host, v_host = np.asarray(k), np.asarray(v)
-        return [(k_host[:, i], v_host[:, i]) for i in range(n)]
+        for buf in (k, v):
+            try:  # start the device->host DMA early (best-effort API)
+                buf.copy_to_host_async()
+            except Exception:
+                pass
+        return InFlightPages(k, v, n)
 
     @_locked
     def write_page(self, page_id: int, k: np.ndarray, v: np.ndarray) -> None:
@@ -691,6 +709,28 @@ class ModelRunner:
 
     def cache_memory_bytes(self) -> int:
         return int(self.k_cache.nbytes + self.v_cache.nbytes)
+
+
+class InFlightPages:
+    """Handle to a dispatched page gather whose device->host copy is in
+    flight (``ModelRunner.read_pages_async``)."""
+
+    def __init__(self, k: jax.Array | None, v: jax.Array | None, n: int) -> None:
+        self._k = k
+        self._v = v
+        self._n = n
+
+    @property
+    def num_pages(self) -> int:
+        return self._n
+
+    def wait(self) -> list[tuple[np.ndarray, np.ndarray]]:
+        """Block until the pages are on host; returns [(k, v), ...] per page
+        ([L, ps, W] each), pow2 padding sliced off."""
+        if self._n == 0:
+            return []
+        k_host, v_host = np.asarray(self._k), np.asarray(self._v)
+        return [(k_host[:, i], v_host[:, i]) for i in range(self._n)]
 
 
 class DeviceTokens:

@@ -43,6 +43,25 @@ def _usage(prompt_tokens: int | None, completion_tokens: int, cached_tokens: int
 
 
 
+def _legacy_top_logprobs(entries: list[dict]) -> list[dict[str, float]]:
+    """BackendOutput.logprobs -> legacy completions ``top_logprobs``: one
+    ``{token_text: logprob}`` dict per position. Distinct token ids can
+    decode to the SAME text (partial-UTF-8 pieces all render as U+FFFD), and
+    a plain dict comprehension silently drops all but one — keep the best
+    logprob under the plain text and suffix the rest with their token id, so
+    every one of the N requested alternatives survives."""
+    out: list[dict[str, float]] = []
+    for e in entries:
+        d: dict[str, float] = {}
+        for t in sorted(e.get("top", []), key=lambda t: t[1], reverse=True):
+            key = t[2] if len(t) > 2 else str(t[0])
+            while key in d:
+                key = f"{key}#{t[0]}"
+            d[key] = t[1]
+        out.append(d)
+    return out
+
+
 def _chat_lp_content(entries: list[dict]) -> list[dict[str, Any]]:
     """BackendOutput.logprobs entries -> OpenAI chat `logprobs.content`."""
     out = []
@@ -133,10 +152,7 @@ class CompletionStream:
                  "logprobs": None if not out.logprobs else {
                      "tokens": [e.get("token", "") for e in out.logprobs],
                      "token_logprobs": [e["logprob"] for e in out.logprobs],
-                     "top_logprobs": [
-                         {(t[2] if len(t) > 2 else str(t[0])): t[1] for t in e.get("top", [])}
-                         for e in out.logprobs
-                     ],
+                     "top_logprobs": _legacy_top_logprobs(out.logprobs),
                  }}
             ],
         }
@@ -217,10 +233,7 @@ async def aggregate_completion(model: str, stream: AsyncIterator[BackendOutput])
              "logprobs": None if not lp_entries else {
                  "tokens": [e.get("token", "") for e in lp_entries],
                  "token_logprobs": [e["logprob"] for e in lp_entries],
-                 "top_logprobs": [
-                     {(t[2] if len(t) > 2 else str(t[0])): t[1] for t in e.get("top", [])}
-                     for e in lp_entries
-                 ],
+                 "top_logprobs": _legacy_top_logprobs(lp_entries),
              }}
         ],
         "usage": _usage(prompt_tokens, completion_tokens, cached),

@@ -790,10 +790,13 @@ def save_params(
         else:
             hf_cfg["model_type"] = "olmoe"
             hf_cfg["architectures"] = ["OlmoeForCausalLM"]
-    if cfg.norm_plus_one:
-        # Gemma's math (GeGLU, (1+w) norms, scaled embeddings) is keyed off
-        # model_type at load — a "llama"-typed save would silently reload
-        # with silu/plain-norm math over Gemma weights.
+    # Gemma's math (GeGLU, (1+w) norms, scaled embeddings) is keyed off
+    # model_type at load — a "llama"-typed save would silently reload with
+    # silu/plain-norm math over Gemma weights. GGUF-sourced Gemma arrives
+    # with norm_plus_one=False (llama.cpp bakes the +1 into the weights) but
+    # still gelu_tanh/embed_scale, so ANY of the three marks the family.
+    gemma_family = cfg.norm_plus_one or cfg.mlp_act == "gelu_tanh" or cfg.embed_scale
+    if gemma_family:
         hf_cfg["model_type"] = "gemma"
         hf_cfg["architectures"] = ["GemmaForCausalLM"]
         hf_cfg["hidden_activation"] = "gelu_pytorch_tanh"
@@ -845,8 +848,19 @@ def save_params(
             a = a[row_perm]
         tensors[name] = np.ascontiguousarray(a)
 
+    # HF Gemma checkpoints store ZERO-CENTERED norm weights (runtime adds
+    # +1). GGUF-sourced params carry the +1 baked in (norm_plus_one=False),
+    # so saving them under model_type=gemma must subtract it back out or the
+    # reload (which re-adds 1) would double-shift every norm.
+    def zero_center(a):
+        a = np.asarray(a)
+        return (a.astype(np.float32) - 1.0).astype(a.dtype)
+
+    shift_norms = gemma_family and not cfg.norm_plus_one
+
     put("model.embed_tokens.weight", params["embed"], False)
-    put("model.norm.weight", params["norm_f"], False)
+    put("model.norm.weight",
+        zero_center(params["norm_f"]) if shift_norms else params["norm_f"], False)
     if not cfg.tie_embeddings and "lm_head" in params:
         put("lm_head.weight", params["lm_head"], True)
     def write_subtree(lp, l0: int, count: int, moe: bool) -> None:
@@ -857,7 +871,10 @@ def save_params(
                     continue
                 if cfg.attn_type == "mla" and leaf in ("wq", "wk", "wv", "wo"):
                     continue
-                put(base + suffixes[0], lp[leaf][li], transpose)
+                arr = lp[leaf][li]
+                if shift_norms and leaf in ("attn_norm", "mlp_norm"):
+                    arr = zero_center(arr)
+                put(base + suffixes[0], arr, transpose)
             if cfg.qk_norm and cfg.attn_type != "mla":
                 put(base + "self_attn.q_norm.weight", lp["q_norm"][li], False)
                 put(base + "self_attn.k_norm.weight", lp["k_norm"][li], False)

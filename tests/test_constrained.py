@@ -41,6 +41,48 @@ def test_json_prefix_machine():
         assert s.mode != "X" and not s.complete(), t
 
 
+def test_string_escapes_match_rfc8259():
+    """STR_ESCAPE admits exactly \\" \\\\ \\/ \\b \\f \\n \\r \\t \\u; \\u
+    consumes exactly 4 hex digits (ADVICE: '\\q' / '\\u12"' used to be
+    accepted, then json.loads rejected the 'guaranteed' output)."""
+    # Every legal escape, including \u with exactly 4 hex digits.
+    for t in ['"\\n"', '"\\""', '"\\\\"', '"\\/"', '"\\b"', '"\\f"',
+              '"\\r"', '"\\t"', '"\\u0041"', '"\\uBEEF"', '"a\\u00e9b"']:
+        assert _complete(t), t
+        json.loads(t)
+    # Prefixes mid-escape stay valid prefixes.
+    for t in ['"\\', '"\\u', '"\\u1', '"\\u12', '"\\u123', '{"k\\u00']:
+        assert _ok(t), t
+    # Illegal escape chars, and \u with a non-hex digit or an early quote.
+    for t in ['"\\q', '"\\x41"', '"\\8"', '"\\uZ', '"\\u12G', '"\\u12"',
+              '"\\u123"', '"\\u"']:
+        assert not _ok(t), t
+
+
+def test_budget_to_close_is_true_upper_bound():
+    """budget_to_close must dominate the force-close walk's step count —
+    including pending \\u hex digits and the ':'+value cost of an open KEY
+    string (it used to say 1 for '{\"k' and the close became unaffordable)."""
+    tok = _CharTok()
+    cache = TokenMaskCache(tok, vocab_size=len(tok.CHARS), eos_ids=(0,))
+    for prefix in ['{"k', '{"k\\', '{"k\\u00', '"v\\u0', '{"a": {"b',
+                   '{"a": [1, "s\\u1', '{"k": "v"']:
+        s = advance_text(MachineState(), prefix)
+        assert s.mode != "X", prefix
+        budget = cache.budget_to_close(s)
+        text, steps = prefix, 0
+        while not s.complete():
+            mask = cache.mask_for(s, force_close=True)
+            tid = int(np.nonzero(mask)[0][0])
+            assert tid != 0, (prefix, text)
+            text += tok.CHARS[tid]
+            s = advance_text(s, tok.CHARS[tid])
+            steps += 1
+            assert steps <= budget, (prefix, text, budget)
+        json.loads(text)
+        assert steps + 1 <= budget, (prefix, steps, budget)  # +1 spare for EOS
+
+
 class _CharTok:
     """1 token = 1 char over a tiny charset (plus an EOS at id 0)."""
 

@@ -21,12 +21,20 @@ async def test_cross_process_wire_measures(tmp_path):
     )
     assert out["wire"] == "tcp_cross_process"
     assert out["iters"] == 3 and len(out["per_iter"]) == 3
+    assert out["chunk_pages"] == 1  # 2 pages -> 2 chunks: the pipeline engages
     # Exact payload geometry: every transfer moved the full chain's bytes —
     # L(2) * ps(16) * kv_heads(2) * hd(16) * 2B, K and V, 2 pages per chain.
     page_bytes = 2 * 16 * 2 * 16 * 2 * 2
     for it in out["per_iter"]:
         assert it["bytes"] == 2 * page_bytes
         assert it["total_s"] > 0
+        # v2 stream reports every pipeline phase per iteration.
+        for phase in ("gather_s", "pack_s", "wire_s", "scatter_s"):
+            assert it[phase] >= 0
+        assert it["gather_s"] + it["pack_s"] + it["wire_s"] > 0
+        # overlap_s = sum(phases) - total_s; it exists (may be ~0 at this
+        # tiny geometry where a chunk's DMA finishes before the wire does).
+        assert "overlap_s" in it
     assert out["cold_gbytes_per_sec"] > 0
     assert out["amortized_gbytes_per_sec"] > 0
     assert out["amortized_wire_only_gbytes_per_sec"] >= out["amortized_gbytes_per_sec"]

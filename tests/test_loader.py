@@ -103,6 +103,53 @@ def test_forward_equivalence_after_load(tmp_path):
     np.testing.assert_allclose(np.asarray(fwd(params)), np.asarray(fwd(loaded)), rtol=1e-6, atol=1e-6)
 
 
+def test_roundtrip_gguf_sourced_gemma(tmp_path):
+    """GGUF-sourced Gemma params arrive with norm_plus_one=False (+1 baked
+    into the norm weights by llama.cpp) but still gelu_tanh + embed_scale.
+    save_params must (a) still stamp model_type=gemma — keyed off ANY of the
+    three family markers, not just norm_plus_one — and (b) zero-center the
+    norms, so the reload (runtime re-adds the +1) computes the same math."""
+    cfg = dataclasses.replace(
+        PRESETS["test-tiny"], mlp_act="gelu_tanh", embed_scale=True,
+        norm_plus_one=False,
+    )
+    params = llama.init_params(cfg, 5)
+    save_params(tmp_path, cfg, params)
+    cfg2, loaded = load_model(tmp_path, name=cfg.name, dtype=cfg.dtype)
+    # The reload takes the HF-convention Gemma shape...
+    assert cfg2.norm_plus_one and cfg2.embed_scale and cfg2.mlp_act == "gelu_tanh"
+    # ...with re-centered norms: loaded + 1 == the baked-in originals.
+    for got, want in [
+        (loaded["norm_f"], params["norm_f"]),
+        (loaded["layers"]["attn_norm"], params["layers"]["attn_norm"]),
+        (loaded["layers"]["mlp_norm"], params["layers"]["mlp_norm"]),
+    ]:
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32) + 1.0, np.asarray(want, np.float32),
+            rtol=0, atol=1e-6,
+        )
+    # Non-norm leaves pass through untouched.
+    np.testing.assert_array_equal(
+        np.asarray(loaded["embed"]), np.asarray(params["embed"]))
+
+    tokens = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    positions = jnp.asarray([[0, 1, 2, 3]], jnp.int32)
+    tables = jnp.asarray([[1, 2]], jnp.int32)
+    slots = positions + 4
+    last = jnp.asarray([3], jnp.int32)
+
+    def fwd(p, c):
+        kc, vc = llama.init_kv_cache(c, num_pages=4, page_size=4)
+        logits, _, _ = llama.forward(
+            p, c, tokens, positions, kc, vc, tables, slots, last,
+            attn_impl="reference",
+        )
+        return np.asarray(logits)
+
+    # Same function either way: baked norms w/o +1 == centered norms w/ +1.
+    np.testing.assert_allclose(fwd(params, cfg), fwd(loaded, cfg2), rtol=2e-5, atol=2e-5)
+
+
 def make_model_dir(tmp_path, cfg=None, seed=7):
     """A complete hermetic HF-style model dir: weights + tokenizer + template."""
     import json

@@ -116,6 +116,42 @@ def test_logprobs_and_plain_requests_share_a_batch():
     assert mixed[1]["lp"] == []
 
 
+def test_legacy_top_logprobs_survive_text_collisions():
+    """Legacy completions `top_logprobs` is keyed by decoded token TEXT:
+    distinct ids whose single-token decode collides (partial-UTF-8 pieces
+    all render as U+FFFD) must not silently drop alternatives — the best
+    logprob keeps the plain key, the rest get id-suffixed keys."""
+    import asyncio
+
+    from dynamo_tpu.frontend.openai_format import (
+        _legacy_top_logprobs,
+        aggregate_completion,
+    )
+    from dynamo_tpu.protocols.common import BackendOutput, FinishReason
+
+    entry = {
+        "id": 7, "token": "�", "logprob": -0.5,
+        "top": [[7, -0.5, "�"], [9, -1.25, "�"], [11, -2.0, "ok"],
+                [13, -3.0, "�"]],
+    }
+    (d,) = _legacy_top_logprobs([entry])
+    assert len(d) == 4  # all N alternatives survive
+    assert d["�"] == -0.5  # best collider keeps the plain key
+    assert d["ok"] == -2.0
+    assert d["�#9"] == -1.25 and d["�#13"] == -3.0
+    # id-keyed fallback (no text element) never collides to begin with.
+    (d2,) = _legacy_top_logprobs([{"top": [[7, -0.5], [9, -1.0]]}])
+    assert d2 == {"7": -0.5, "9": -1.0}
+
+    async def _stream():
+        yield BackendOutput(text="x", cumulative_tokens=1, prompt_tokens=1,
+                            finish_reason=FinishReason.STOP, logprobs=[entry])
+
+    resp = asyncio.run(aggregate_completion("m", _stream()))
+    tops = resp["choices"][0]["logprobs"]["top_logprobs"]
+    assert tops == [d]
+
+
 @pytest.mark.e2e
 async def test_logprobs_served_http():
     """Chat + completions logprobs over the full HTTP stack (OpenAI schema)."""

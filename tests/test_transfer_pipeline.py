@@ -1,0 +1,260 @@
+"""Wire-v2 chunked KV stream: golden parity with the monolithic path,
+mid-stream failure rollback, and decode interleave during a transfer.
+
+The sender (`send_blocks_chunked`) double-buffers: chunk N+1's device gather
++ D2H DMA is dispatched before chunk N is packed and sent; the receiver
+(`KvTransferService._ingest_chunk`) scatters and commits each chunk
+incrementally under session pins. docs/KV_TRANSFER_WIRE_V2.md specifies the
+framing these tests enforce.
+"""
+
+import asyncio
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.disagg.transfer import (
+    KvTransferService,
+    collect_prefill_blocks,
+    pack_block,
+    send_blocks,
+    send_blocks_chunked,
+)
+from dynamo_tpu.engine.allocator import PageAllocator
+from dynamo_tpu.engine.runner import ModelRunner
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import PRESETS
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.transport import InMemoryTransport
+from dynamo_tpu.tokens import compute_block_hashes
+
+CFG = PRESETS["test-tiny"]
+PAGE = 4
+
+
+def _core(num_pages: int = 16) -> SimpleNamespace:
+    params = llama.init_params(CFG, 0)
+    runner = ModelRunner(CFG, params, num_pages=num_pages, page_size=PAGE, max_batch_size=2)
+    return SimpleNamespace(allocator=PageAllocator(num_pages, PAGE), runner=runner)
+
+
+def _commit_chain(core, hashes, seed=0):
+    """Commit a hash chain of random KV pages; returns {hash: (k, v)}."""
+    rng = np.random.default_rng(seed)
+    pids = core.allocator.allocate(len(hashes))
+    parent = None
+    ks, vs = [], []
+    for pid, h in zip(pids, hashes):
+        core.allocator.commit(pid, h, parent)
+        parent = h
+        ks.append(rng.standard_normal((CFG.num_layers, PAGE, CFG.kv_dim)).astype(np.float32))
+        vs.append(rng.standard_normal((CFG.num_layers, PAGE, CFG.kv_dim)).astype(np.float32))
+    core.runner.write_pages(pids, ks, vs)
+    core.allocator.release(pids)
+    return {h: (k, v) for h, k, v in zip(hashes, ks, vs)}
+
+
+def _zero_blocks(hashes):
+    zeros = np.zeros((CFG.num_layers, PAGE, CFG.kv_dim), np.float32)
+    parent = None
+    out = []
+    for h in hashes:
+        out.append(pack_block(h, parent, [], zeros, zeros))
+        parent = h
+    return out
+
+
+async def test_chunked_stream_golden_vs_monolithic():
+    """5 pages at chunk_pages=2 (uneven: 2+2+1 chunks) land byte-identical
+    to the source AND to the v1 collect-then-send path, with the chain
+    linkage intact and no session state or pins left behind."""
+    src = _core()
+    hashes = compute_block_hashes(list(range(5 * PAGE)), PAGE, salt=0)
+    payloads = _commit_chain(src, hashes)
+
+    transport = InMemoryTransport()
+    dst_v2, dst_v1 = _core(), _core()
+    svc_v2, svc_v1 = KvTransferService(dst_v2), KvTransferService(dst_v1)
+    await transport.register_engine("kv_v2", svc_v2)
+    await transport.register_engine("kv_v1", svc_v1)
+
+    out = await send_blocks_chunked(
+        transport, "mem://kv_v2", "r1", src, hashes, chunk_pages=2)
+    assert out["injected"] == 5 and out["total"] == 5 and out["last"]
+    assert out["seq"] == 2  # 3 chunks: the pipeline really ran chunked
+    assert out["bytes"] == sum(k.nbytes + v.nbytes for k, v in payloads.values())
+    assert set(out["phases"]) == {"gather_s", "pack_s", "wire_s"}
+
+    blocks = collect_prefill_blocks(src, hashes)
+    out_v1 = await send_blocks(transport, "mem://kv_v1", "r1", blocks)
+    assert out_v1["injected"] == 5
+
+    for core in (dst_v2, dst_v1):
+        pids = core.allocator.match_prefix(hashes)
+        assert len(pids) == 5  # full chain matchable: linkage committed
+        for pid, h in zip(pids, hashes):
+            k_got, v_got = core.runner.read_page(pid)
+            np.testing.assert_array_equal(k_got, payloads[h][0])
+            np.testing.assert_array_equal(v_got, payloads[h][1])
+        core.allocator.release(pids)
+    # Stream closed cleanly: no session, no leaked pins on either side.
+    assert svc_v2.stats()["streams_in_flight"] == 0
+    again = src.allocator.match_prefix(hashes)
+    assert len(again) == 5
+    src.allocator.release(again)
+
+
+async def test_midstream_sender_death_rolls_back():
+    """A stream whose sender dies after chunk 0 is reclaimed by the sweep:
+    the session's pins drop, the committed prefix stays matchable but
+    becomes ordinary evictable cache (clear_cache reclaims every page)."""
+    dst = _core()
+    svc = KvTransferService(dst)
+    hashes = compute_block_hashes(list(range(4 * PAGE)), PAGE, salt=0)
+    free0 = dst.allocator.num_free()
+
+    async def send(req):
+        async for out in svc.generate(req, Context()):
+            return out
+
+    out = await send({"request_id": "dead", "seq": 0,
+                      "blocks": _zero_blocks(hashes)[:2], "last": False})
+    assert out["injected"] == 2
+    assert svc.stats()["streams_in_flight"] == 1
+    # Session pins hold the chunk: nothing allocatable from those 2 pages.
+    assert dst.allocator.num_free() == free0 - 2
+
+    # Sender dies; the abandoned-stream sweep fires (age threshold 0).
+    svc.PENDING_PULL_MAX_AGE = 0.0
+    await asyncio.sleep(0.01)
+    svc._sweep_pending_pulls()
+    assert svc.stats()["streams_in_flight"] == 0
+    # The committed prefix is still a valid, matchable chain...
+    pids = dst.allocator.match_prefix(hashes[:2])
+    assert len(pids) == 2
+    dst.allocator.release(pids)
+    # ...but unpinned: eviction reclaims it all the way back.
+    dst.allocator.clear_cache()
+    assert dst.allocator.num_free() == free0
+
+
+async def test_out_of_order_seq_is_a_stream_error():
+    """A seq gap means lost chunks: the receiver rolls the stream back and
+    reports stream_error (the sender raises and falls back to v1). A fresh
+    seq-0 for the same request id replaces any stale session."""
+    dst = _core()
+    svc = KvTransferService(dst)
+    hashes = compute_block_hashes(list(range(3 * PAGE)), PAGE, salt=0)
+    blocks = _zero_blocks(hashes)
+
+    async def send(req):
+        async for out in svc.generate(req, Context()):
+            return out
+
+    out = await send({"request_id": "r", "seq": 0, "blocks": blocks[:1], "last": False})
+    assert out["injected"] == 1
+    out = await send({"request_id": "r", "seq": 2, "blocks": blocks[1:2], "last": False})
+    assert "unexpected seq 2" in out["stream_error"]
+    assert svc.stats()["streams_in_flight"] == 0  # rolled back
+    # A chunk for a dead stream is also an error (no session).
+    out = await send({"request_id": "r", "seq": 1, "blocks": blocks[1:2], "last": False})
+    assert "no session" in out["stream_error"]
+    # Reconnect restarts at seq 0 and completes; chunk-0 blocks are hits.
+    out = await send({"request_id": "r", "seq": 0, "blocks": blocks, "last": True})
+    assert out["injected"] == 3 and out["total"] == 3
+    assert svc.stats()["streams_in_flight"] == 0
+    pids = dst.allocator.match_prefix(hashes)
+    assert len(pids) == 3
+    dst.allocator.release(pids)
+
+
+async def test_sender_abort_notifies_receiver():
+    """send_blocks_chunked dying mid-stream best-effort aborts the receiver
+    session before the caller falls back to the monolithic path."""
+    src = _core()
+    hashes = compute_block_hashes(list(range(4 * PAGE)), PAGE, salt=0)
+    _commit_chain(src, hashes)
+    dst = _core()
+    svc = KvTransferService(dst)
+    transport = InMemoryTransport()
+    await transport.register_engine("kv", svc)
+
+    real_pack = pack_block
+    calls = {"n": 0}
+
+    def dying_pack(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] > 2:  # chunk 0 (2 pages) packs fine; chunk 1 dies
+            raise RuntimeError("sender died mid-pack")
+        return real_pack(*a, **kw)
+
+    import dynamo_tpu.disagg.transfer as transfer_mod
+    orig = transfer_mod.pack_block
+    transfer_mod.pack_block = dying_pack
+    try:
+        with pytest.raises(RuntimeError, match="sender died"):
+            await send_blocks_chunked(
+                transport, "mem://kv", "r", src, hashes, chunk_pages=2)
+    finally:
+        transfer_mod.pack_block = orig
+    # The abort frame cleaned the receiver up; no pins, no session.
+    assert svc.stats()["streams_in_flight"] == 0
+    free0 = dst.allocator.num_free()
+    dst.allocator.clear_cache()
+    assert dst.allocator.num_free() >= free0
+    # Sender released its chain refcounts despite the failure.
+    again = src.allocator.match_prefix(hashes)
+    assert len(again) == 4
+    src.allocator.release(again)
+
+
+async def test_decode_steps_interleave_with_inflight_stream():
+    """The sender's io_lock is held per-chunk-dispatch only: a concurrent
+    decode step must get the lock repeatedly WHILE a chunked transfer with a
+    slow receiver is in flight (the v1 path gathered everything under one
+    hold)."""
+    import threading
+    import time as _time
+
+    src = _core(num_pages=32)
+    hashes = compute_block_hashes(list(range(6 * PAGE)), PAGE, salt=0)
+    _commit_chain(src, hashes)
+    dst = _core(num_pages=32)
+    svc = KvTransferService(dst)
+    real_write_pages = dst.runner.write_pages
+
+    def slow_write_pages(*a, **kw):
+        _time.sleep(0.05)  # make each chunk's ingest span measurable
+        return real_write_pages(*a, **kw)
+
+    dst.runner.write_pages = slow_write_pages
+    transport = InMemoryTransport()
+    await transport.register_engine("kv", svc)
+
+    done = threading.Event()
+    acquisitions = 0
+
+    def hammer():
+        nonlocal acquisitions
+        while not done.is_set():
+            if src.runner.io_lock.acquire(timeout=0.01):
+                try:
+                    if not done.is_set():
+                        acquisitions += 1
+                finally:
+                    src.runner.io_lock.release()
+            _time.sleep(0.005)
+
+    t = threading.Thread(target=hammer)
+    t.start()
+    try:
+        out = await send_blocks_chunked(
+            transport, "mem://kv", "r", src, hashes, chunk_pages=1)
+    finally:
+        done.set()
+        t.join()
+    assert out["injected"] == 6
+    assert acquisitions >= 2, (
+        f"io_lock only obtainable {acquisitions}x during a 6-chunk stream"
+    )
