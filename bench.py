@@ -284,6 +284,137 @@ def run_config(preset: str, quant: str, batch: int, isl: int, osl: int,
     }
 
 
+def probe_decode_stall() -> dict:
+    """Long-prefill-during-decode stall probe (the metric ISSUE 2 targets).
+
+    A small decode batch streams tokens; mid-stream a long prompt arrives.
+    Phase-exclusive scheduling (chunk_prefill_tokens=0) runs the whole
+    prefill as one step, freezing every decode for its duration; mixed-step
+    scheduling bounds the freeze at roughly one chunk-step. Both modes run
+    the identical scenario and report:
+
+      max_decode_stall_ms — longest gap between consecutive steps that
+        emitted at least one decode token, over the window where the long
+        prefill is in flight (plus the surrounding steady decode, whose
+        gaps are the per-step floor);
+      itl_p99_ms — p99 inter-token latency across the decode streams.
+
+    Each mode runs the scenario TWICE on the same engine and reports the
+    second pass: the step-bucket lattice (batch, time, and page-table-width
+    buckets) is data-dependent, so the only warm-up that provably compiles
+    every shape the measurement hits is an identical dry run.
+
+    The chunked run's numbers are promoted to stable top-level bench JSON
+    keys; detail.stall_probe carries both runs and the stall ratio.
+    """
+    import jax
+
+    from dynamo_tpu.engine.core import EngineConfig, EngineCore
+    from dynamo_tpu.engine.runner import ModelRunner
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import PRESETS
+    from dynamo_tpu.protocols.common import PreprocessedRequest, SamplingOptions, StopConditions
+
+    preset = os.environ.get("BENCH_STALL_PRESET", "llama-3.2-1b")
+    n_decode = int(os.environ.get("BENCH_STALL_DECODERS", "8"))
+    short_isl = int(os.environ.get("BENCH_STALL_ISL", "128"))
+    osl = int(os.environ.get("BENCH_STALL_OSL", "192"))
+    long_isl = int(os.environ.get("BENCH_STALL_PREFILL_ISL", "3072"))
+    chunk = int(os.environ.get("BENCH_STALL_CHUNK", "512"))
+    cfg = PRESETS[preset]
+    page_size = int(os.environ.get("BENCH_PAGE_SIZE", "128"))
+    num_pages = (n_decode * ((short_isl + osl) // page_size + 2)
+                 + long_isl // page_size + 12)
+    params = llama.init_params(cfg, 0)
+
+    def run(chunk_tokens: int) -> dict:
+        runner = ModelRunner(
+            cfg, params, num_pages=num_pages, page_size=page_size,
+            max_batch_size=n_decode + 2, prefill_bucket=max(long_isl, 64),
+        )
+        core = EngineCore(runner, EngineConfig(
+            num_pages=num_pages, page_size=page_size,
+            max_batch_size=n_decode + 2, max_prefill_tokens=long_isl,
+            max_seq_len=long_isl + osl + 8, enable_prefix_caching=False,
+            decode_steps=1, chunk_prefill_tokens=chunk_tokens,
+        ))
+        rng = np.random.default_rng(1)
+
+        def scenario() -> dict:
+            decoders = []
+            for _ in range(n_decode):
+                decoders.append(core.add_request(PreprocessedRequest(
+                    token_ids=rng.integers(1, cfg.vocab_size - 1, size=short_isl).tolist(),
+                    sampling=SamplingOptions(temperature=0.0),
+                    stop=StopConditions(max_tokens=osl, ignore_eos=True),
+                )))
+            while core.waiting or core.prefilling:
+                core.step()
+            decode_ids = {id(s) for s in decoders}
+            emit_times: list[float] = []
+            per_seq: dict[int, list[float]] = {id(s): [] for s in decoders}
+            injected = False
+            steps = 0
+            while core.has_work:
+                if not injected and steps >= 4:
+                    core.add_request(PreprocessedRequest(
+                        token_ids=rng.integers(1, cfg.vocab_size - 1, size=long_isl).tolist(),
+                        sampling=SamplingOptions(temperature=0.0),
+                        stop=StopConditions(max_tokens=4, ignore_eos=True),
+                    ))
+                    injected = True
+                outputs = core.step()
+                now = time.perf_counter()
+                steps += 1
+                got_decode = False
+                for seq, out in outputs:
+                    if id(seq) in decode_ids and out.token_ids:
+                        got_decode = True
+                        per_seq[id(seq)].append(now)
+                if got_decode:
+                    emit_times.append(now)
+                if all(s.is_finished for s in decoders):
+                    break
+            # Drain the injected long prompt so the next pass starts clean.
+            while core.has_work:
+                core.step()
+            gaps = sorted(b - a for a, b in zip(emit_times, emit_times[1:]))
+            itls = sorted(b - a for ts in per_seq.values()
+                          for a, b in zip(ts, ts[1:]))
+
+            def pct(xs, p):
+                return xs[min(len(xs) - 1, int(p * len(xs)))] if xs else 0.0
+
+            return {
+                "chunk_prefill_tokens": chunk_tokens,
+                "max_decode_stall_ms": round(max(gaps, default=0.0) * 1e3, 2),
+                "decode_step_p50_ms": round(pct(gaps, 0.50) * 1e3, 2),
+                "itl_p50_ms": round(pct(itls, 0.50) * 1e3, 2),
+                "itl_p99_ms": round(pct(itls, 0.99) * 1e3, 2),
+                "mixed_steps": core.mixed_steps,
+                "stall_violations": core.stall_violations,
+                "steps": steps,
+            }
+
+        scenario()  # dry run: compiles every bucket the measured pass hits
+        return scenario()
+
+    out = {
+        "preset": preset, "decoders": n_decode, "short_isl": short_isl,
+        "osl": osl, "long_isl": long_isl, "backend": jax.default_backend(),
+    }
+    chunked = run(chunk)
+    gc.collect()
+    baseline = run(0)
+    gc.collect()
+    out["chunked"] = chunked
+    out["baseline_phase_exclusive"] = baseline
+    out["stall_ratio_baseline_over_chunked"] = round(
+        baseline["max_decode_stall_ms"] / chunked["max_decode_stall_ms"], 2
+    ) if chunked["max_decode_stall_ms"] > 0 else 0.0
+    return out
+
+
 def probe_kv_pull_gbps() -> dict:
     """Device-path KV transfer bandwidth (BASELINE north-star metric).
 
@@ -372,25 +503,32 @@ def main() -> None:
 
     from dynamo_tpu.models.config import PRESETS
 
-    def emit(configs, pull, wire=None):
+    def emit(configs, pull, wire=None, stall=None):
         head = next((c for c in configs if c.get("preset") == "llama-3.2-1b"
                      and "error" not in c), None) or \
             next((c for c in configs if "error" not in c), {})
-        print(json.dumps({
+        doc = {
             "metric": "output_tokens_per_sec_per_chip",
             "value": head.get("tok_per_sec", 0.0),
             "unit": "tok/s",
             "vs_baseline": round(head.get("tok_per_sec", 0.0) / HEADLINE_TARGET, 4),
+            # Stable top-level serving-quality keys (ISSUE 2): from the
+            # chunked run of the long-prefill-during-decode stall probe.
+            "itl_p99_ms": (stall or {}).get("chunked", {}).get("itl_p99_ms", 0.0),
+            "max_decode_stall_ms": (stall or {}).get("chunked", {}).get(
+                "max_decode_stall_ms", 0.0),
             "detail": {
                 "backend": jax.default_backend(),
                 "suite": [c.get("preset") for c in configs],
                 "configs": configs,
+                "stall_probe": stall or {"pending": True},
                 "kv_pull": pull,
                 "kv_wire_cross_process": wire or {"pending": True},
                 "ttft_note": "ttft_idle_* is the drained-engine best case; "
                              "under-load TTFT: bench/results pareto artifacts",
             },
-        }), flush=True)
+        }
+        print(json.dumps(doc), flush=True)
 
     suite = parse_suite()
     configs = []
@@ -420,16 +558,22 @@ def main() -> None:
         # config completed so far.
         emit(configs, {"pending": True})
     try:
+        stall = probe_decode_stall()
+    except Exception as e:
+        stall = {"error": f"{type(e).__name__}: {e}"[:200]}
+    emit(configs, {"pending": True}, stall=stall)
+    gc.collect()
+    try:
         pull = probe_kv_pull_gbps()
     except Exception as e:
         pull = {"error": f"{type(e).__name__}: {e}"[:200]}
-    emit(configs, pull)
+    emit(configs, pull, stall=stall)
     gc.collect()
     try:
         wire = probe_cross_process_wire()
     except Exception as e:
         wire = {"error": f"{type(e).__name__}: {e}"[:200]}
-    emit(configs, pull, wire)
+    emit(configs, pull, wire, stall=stall)
 
 
 if __name__ == "__main__":

@@ -16,8 +16,12 @@ from __future__ import annotations
 import dataclasses
 import logging
 import os
-import tomllib
 from typing import Any, TypeVar
+
+try:  # python >= 3.11
+    import tomllib
+except ModuleNotFoundError:  # 3.10: the vendored API-compatible backport
+    import tomli as tomllib
 
 logger = logging.getLogger(__name__)
 
@@ -115,6 +119,9 @@ class WorkerSettings:
     router_mode: str = "round_robin"
     mesh: str = ""  # '' | 'auto' | 'dp=2,tp=4,...'
     decode_steps: int = 1
+    # Per-step prefill chunk budget while decodes are running (stall-free
+    # mixed steps); 0 restores phase-exclusive prefill-XOR-decode steps.
+    chunk_prefill_tokens: int = 512
 
 
 def load_runtime_settings(**kw) -> RuntimeSettings:

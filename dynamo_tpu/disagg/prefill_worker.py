@@ -6,6 +6,12 @@ its committed pages are then read out and streamed to the requesting decode
 worker's transfer endpoint. The sampled token is discarded — the decode side
 recomputes the sub-page tail locally and samples there, so the transferred
 artifact is pure KV.
+
+The worker claims up to ``max_concurrency`` queue tasks at once. The engine
+chunks each prompt under the mixed-step scheduler (engine/core.py), so
+overlapping tasks interleave their prefill chunks — and overlap one task's
+KV wire transfer with the next task's compute — instead of serializing
+whole prompts head-to-tail.
 """
 
 from __future__ import annotations
@@ -38,11 +44,14 @@ class PrefillWorker:
         service: JaxEngineService,
         *,
         queue_name: str = PREFILL_QUEUE,
+        max_concurrency: int = 2,
     ) -> None:
         self.runtime = runtime
         self.service = service
         self.queue = DistributedQueue(runtime, queue_name)
         self._task: asyncio.Task | None = None
+        self._sem = asyncio.Semaphore(max(1, max_concurrency))
+        self._inflight: set[asyncio.Task] = set()
         self.completed = 0
 
     async def start(self) -> "PrefillWorker":
@@ -53,18 +62,38 @@ class PrefillWorker:
     async def _loop(self) -> None:
         while True:
             try:
-                claimed = await self.queue.claim(timeout=None)
+                await self._sem.acquire()
+                try:
+                    claimed = await self.queue.claim(timeout=None)
+                except BaseException:
+                    self._sem.release()
+                    raise
                 if claimed is None:
+                    self._sem.release()
                     continue
-                key, task = claimed
-                await self._handle(task)
-                await self.queue.delete(key)
-                self.completed += 1
+                t = asyncio.create_task(self._run_one(claimed), name="prefill-task")
+                self._inflight.add(t)
+                t.add_done_callback(self._inflight.discard)
             except asyncio.CancelledError:
                 raise
             except Exception:
-                logger.exception("prefill task failed")
+                logger.exception("prefill claim failed")
                 await asyncio.sleep(0.2)
+
+    async def _run_one(self, claimed: tuple) -> None:
+        key, task = claimed
+        try:
+            await self._handle(task)
+            await self.queue.delete(key)
+            self.completed += 1
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            # Leave the queue entry for its lease to expire and be re-claimed.
+            logger.exception("prefill task failed")
+            await asyncio.sleep(0.2)
+        finally:
+            self._sem.release()
 
     async def _handle(self, task: dict) -> None:
         token_ids = task["token_ids"]
@@ -161,4 +190,7 @@ class PrefillWorker:
         if self._task is not None:
             self._task.cancel()
             self._task = None
+        for t in list(self._inflight):
+            t.cancel()
+        self._inflight.clear()
         await self.queue.close()

@@ -1,23 +1,36 @@
-"""Engine core: continuous-batching step loop.
+"""Engine core: continuous-batching step loop with stall-free mixed steps.
 
-Each ``step()`` is either a *prefill* step (admit waiting requests, compute
-their prompts — minus any prefix-cache hit — in one batched forward) or a
-*decode* step (one token for every running sequence). Both phases run the
-same jitted program at different bucket shapes (see runner.py), so there is
-no separate prefill/decode code path on device.
+A ``step()`` fuses decode and prefill work into ONE runner dispatch: every
+running sequence contributes a 1-token decode row, and waiting/resumed
+prompts are admitted as *chunks* under a per-step token budget
+(``chunk_prefill_tokens``, Sarathi-style stall-free batching) so a long
+prompt never stalls the decode stream — it advances a bounded chunk per
+step instead. A decode row is just the degenerate final chunk (one token
+that samples), so both phases share the same jitted program at different
+bucket shapes (see runner.py); there is no separate prefill/decode code
+path on device. ``chunk_prefill_tokens=0`` restores the legacy
+phase-exclusive behavior (a step is prefill XOR decode) — kept as the
+baseline the bench stall probe compares against. Policy details:
+``docs/SCHEDULER.md``.
 
-Scheduling policy (matching the behavior of the engines the reference wraps,
-vLLM-v0-style):
+Scheduling policy (extending the engines the reference wraps, vLLM-v0-style
+admission + Sarathi-Serve chunking):
 
-- Admission: FIFO from the waiting queue under a prefill token budget and
-  page availability; prefix-cache matches reduce the budget charge.
+- Admission: FIFO from the waiting queue under the prefill token budget and
+  page availability; prefix-cache matches reduce the budget charge. Pages
+  are allocated per chunk, not per prompt, so a prompt bigger than the
+  current free pool admits incrementally instead of head-of-line blocking.
+- Decode first: running sequences' next-token pages are reserved before any
+  chunk is sized, and decode rows ride every mixed dispatch.
 - Preemption: on page exhaustion during decode, the most-recently-arrived
   running sequence is evicted (pages released, tokens kept) and requeued;
-  recomputation re-matches whatever prefix survived in cache.
-- Pages commit to the prefix cache as they fill, emitting KV stored events;
-  eviction emits removed events (allocator.py) — this feeds the KV-aware
-  router's global index natively, replacing the reference's
-  engine->ZMQ->NATS event bridge (SURVEY.md §3 call stack D).
+  mid-prefill sequences are preferred victims over decoding ones.
+  Recomputation re-matches whatever prefix survived in cache and re-chunks.
+- Pages commit to the prefix cache as they fill — chunk by chunk, so a long
+  prompt's early pages are shareable before its prefill finishes — emitting
+  KV stored events; eviction emits removed events (allocator.py). This
+  feeds the KV-aware router's global index natively, replacing the
+  reference's engine->ZMQ->NATS event bridge (SURVEY.md §3 call stack D).
 """
 
 from __future__ import annotations
@@ -69,6 +82,14 @@ class EngineConfig:
     # (vital on remote/tunneled chips); trades up to decode_steps-1 wasted
     # steps per finishing sequence and K-token stream granularity.
     decode_steps: int = 1
+    # Per-step prefill token budget while decodable sequences are running:
+    # prompts are admitted/advanced in chunks of at most this many tokens,
+    # fused with the decode rows in one dispatch, so the longest decode
+    # stall is one chunk-step rather than one whole-prompt prefill.
+    # Distinct from max_prefill_tokens, which still caps a step with no
+    # decodes to coalesce against. 0 disables chunking (legacy
+    # prefill-XOR-decode steps; the bench stall probe's baseline).
+    chunk_prefill_tokens: int = 512
 
 
 class EngineCore:
@@ -90,6 +111,18 @@ class EngineCore:
         self.allocator = PageAllocator(config.num_pages, config.page_size, on_event=on_kv_event)
         self.waiting: deque[Sequence] = deque()
         self.running: list[Sequence] = []
+        # Admitted but mid-prompt: their next chunk is scheduled each step
+        # (arrival order) before new admissions; they are not decodable
+        # until the final chunk samples, at which point they move to
+        # ``running``. Always empty when chunk_prefill_tokens == 0.
+        self.prefilling: list[Sequence] = []
+        # Composition of the latest dispatch + cumulative mixed-step stats —
+        # the observable form of the stall-free invariant (tests, bench
+        # stall probe): with chunking on, a dispatch carrying chunk rows
+        # while decodable sequences exist must also carry their decode rows.
+        self.last_step_info: dict = {}
+        self.mixed_steps = 0
+        self.stall_violations = 0  # prefill-only dispatches that starved decodes
         self._next_seq_id = 0
         self._eos = set(config.eos_token_ids)
         self.num_preemptions = 0
@@ -266,7 +299,9 @@ class EngineCore:
 
     @property
     def has_work(self) -> bool:
-        return bool(self.waiting or self.running or self._inflight is not None)
+        return bool(
+            self.waiting or self.running or self.prefilling or self._inflight is not None
+        )
 
     # -- stepping ----------------------------------------------------------
 
@@ -280,17 +315,22 @@ class EngineCore:
         # pages (deferred-mode safety; no-op when the service already flushed).
         self.flush_offloads()
         cancelled = self._reap_cancelled()
-        if self._inflight is not None and (cancelled or self.waiting):
-            # Composition is about to change (new admissions / cancellations):
-            # drain the pipeline before scheduling anything else.
+        if self._inflight is not None and (cancelled or self.waiting or self.prefilling):
+            # Composition is about to change (new admissions / cancellations /
+            # chunks pending): drain the pipeline before scheduling anything.
             out = cancelled + self._drain_inflight()
             if not self.defer_offloads:
                 self.flush_offloads()
             return out
-        prefill = self._schedule_prefill()
-        if prefill:
-            with annotate("engine.prefill"):
-                out = cancelled + self._run_prefill(prefill)
+        chunks = self._schedule_prefill()
+        fused = self.config.chunk_prefill_tokens > 0
+        if chunks or (fused and self.running and self.prefilling):
+            # Mixed step: decode rows + prefill-chunk rows in one dispatch.
+            # Also taken with zero chunks scheduled (page-starved prefills):
+            # decode must not wait on them. Legacy mode (fused=False) runs
+            # the scheduled whole prompts without decode rows (XOR).
+            with annotate("engine.mixed" if fused else "engine.prefill"):
+                out = cancelled + self._run_mixed(chunks)
         elif self.running:
             with annotate("engine.decode"):
                 out = cancelled + self._run_decode()
@@ -302,7 +342,7 @@ class EngineCore:
 
     def _reap_cancelled(self) -> list[tuple[Sequence, EngineOutput]]:
         out: list[tuple[Sequence, EngineOutput]] = []
-        for q in (self.waiting, self.running):
+        for q in (self.waiting, self.prefilling, self.running):
             for seq in list(q):
                 if seq.context.is_stopped and seq.status is not SeqStatus.FINISHED:
                     self._finish(seq, FinishReason.CANCELLED)
@@ -322,17 +362,63 @@ class EngineCore:
 
     # -- prefill phase -----------------------------------------------------
 
-    def _schedule_prefill(self) -> list[Sequence]:
-        """Admit waiting sequences under the token budget + page availability.
+    def _schedule_prefill(self) -> list[tuple[Sequence, int]]:
+        """Schedule this step's prefill work: ``(sequence, num_tokens)`` chunks.
 
-        A *resumed* (preempted) sequence already carries generated tokens; its
-        "prompt" for this prefill is everything generated so far — the forward
-        recomputes all uncached KV and the sampled token is the legitimate
-        next token of the continuation (no re-emission of old tokens).
+        Continues mid-prompt sequences first (arrival order), then admits
+        from the waiting queue FIFO, all under the per-step token budget:
+        ``chunk_prefill_tokens`` while decodable sequences are running
+        (decode-first — their stall is bounded by one chunk), the full
+        ``max_prefill_tokens`` otherwise. Pages are allocated per chunk, so
+        a prompt larger than the current free pool admits incrementally
+        instead of parking at the queue head. With chunking disabled every
+        scheduled chunk is a whole remaining prompt (legacy admission).
+
+        A *resumed* (preempted) sequence already carries generated tokens;
+        its "prompt" for this prefill is everything generated so far — the
+        forward recomputes all uncached KV and the final chunk's sampled
+        token is the legitimate next token of the continuation (no
+        re-emission of old tokens).
         """
-        batch: list[Sequence] = []
-        budget = self.config.max_prefill_tokens
-        while self.waiting and len(batch) + len(self.running) < self.config.max_batch_size:
+        ps = self.config.page_size
+        chunked = self.config.chunk_prefill_tokens > 0
+        if chunked and self.running:
+            budget = min(self.config.chunk_prefill_tokens, self.config.max_prefill_tokens)
+        else:
+            budget = self.config.max_prefill_tokens
+        chunks: list[tuple[Sequence, int]] = []
+        # Decode first: the running sequences' next-token pages are spoken
+        # for before any chunk is sized against the free pool.
+        reserve = sum(s.pages_needed(ps, 1) for s in self.running) if chunked else 0
+
+        def free_pages() -> int:
+            return max(0, self.allocator.num_free() - reserve)
+
+        # 1) Continue sequences already mid-prompt (arrival order).
+        for seq in self.prefilling:
+            if budget <= 0:
+                break
+            n = min(seq.prompt_remaining, budget)
+            # Cap by pages: slack in already-held pages + the free pool.
+            n = min(n, len(seq.pages) * ps - seq.num_cached + free_pages() * ps)
+            if n <= 0:
+                continue  # page-starved this step; decode still proceeds
+            need = seq.pages_needed(ps, n)
+            if need:
+                try:
+                    seq.pages.extend(self.allocator.allocate(need))
+                except OutOfPagesError:
+                    continue
+            budget -= n
+            chunks.append((seq, n))
+
+        # 2) Admit from the waiting queue (admission appends to
+        # self.prefilling, so the live-sequence cap self-counts).
+        while (
+            self.waiting
+            and budget > 0
+            and len(self.running) + len(self.prefilling) < self.config.max_batch_size
+        ):
             seq = self.waiting[0]
             total = len(seq.tokens)  # prompt + any generated-before-preemption
             matched: list[int] = []
@@ -346,30 +432,36 @@ class EngineCore:
                     # probe only; payload I/O happens after allocation succeeds).
                     onboard_n = self.block_manager.probe_prefix(hashes, len(matched))
                 # Must compute at least the final token's logits.
-                while (len(matched) + onboard_n) * self.config.page_size > total - 1:
+                while (len(matched) + onboard_n) * ps > total - 1:
                     if onboard_n:
                         onboard_n -= 1
                     else:
                         self.allocator.release([matched.pop()])
-            cached_len = (len(matched) + onboard_n) * self.config.page_size
+            cached_len = (len(matched) + onboard_n) * ps
             num_new = total - cached_len
-            if batch and num_new > budget:
-                self.allocator.release(matched)
-                break
-            pages_total = -(-total // self.config.page_size)
+            if chunked:
+                # First chunk: capped by the budget and by what the free
+                # pool can hold. (Onboard pages hold fully *cached* tokens,
+                # so any n >= 1 allocates at least the onboard_n pages.)
+                n = min(num_new, budget)
+                n = min(n, (len(matched) + free_pages()) * ps - cached_len)
+                if n <= 0:
+                    self.allocator.release(matched)
+                    if not chunks and not self.running:
+                        self._note_head_stall(seq, num_new)
+                    break
+            else:
+                n = num_new
+                if chunks and n > budget:
+                    self.allocator.release(matched)
+                    break
+            pages_goal = -(-(cached_len + n) // ps)
             try:
-                new_pages = self.allocator.allocate(pages_total - len(matched))
+                new_pages = self.allocator.allocate(pages_goal - len(matched))
             except OutOfPagesError:
                 self.allocator.release(matched)
-                if not batch and not self.running:
-                    self._head_stall_steps += 1
-                    if self._head_stall_steps % 100 == 1:
-                        logger.warning(
-                            "head-of-queue seq %d cannot allocate %d pages "
-                            "(free %d) with nothing running; stalled %d steps",
-                            seq.seq_id, pages_total - len(matched),
-                            self.allocator.num_free(), self._head_stall_steps,
-                        )
+                if not chunks and not self.running:
+                    self._note_head_stall(seq, num_new)
                 break
             self.waiting.popleft()
             if onboard_n:
@@ -379,8 +471,10 @@ class EngineCore:
                 # probe) just means those tokens get recomputed.
                 onboard = self.block_manager.fetch_prefix(hashes, len(matched), onboard_n)
                 if len(onboard) < onboard_n:
+                    shortfall = onboard_n - len(onboard)
                     onboard_n = len(onboard)
-                    cached_len = (len(matched) + onboard_n) * self.config.page_size
+                    cached_len = (len(matched) + onboard_n) * ps
+                    n += min(shortfall * ps, total - cached_len - n)
                 self.block_manager.onboard(new_pages[: onboard_n], onboard)
                 blocks = seq.block_seq.blocks
                 for i, pid in enumerate(new_pages[:onboard_n]):
@@ -389,36 +483,92 @@ class EngineCore:
             seq.pages = matched + new_pages
             seq.committed_pages = len(matched) + onboard_n
             seq.num_cached = cached_len
+            seq.prefill_chunks = 0
             if seq.status is not SeqStatus.PREEMPTED:
                 seq.num_cached_at_start = cached_len
             seq.status = SeqStatus.RUNNING
-            budget -= num_new
-            batch.append(seq)
-            if budget <= 0:
-                break
-        return batch
+            self.prefilling.append(seq)
+            budget -= n
+            chunks.append((seq, n))
+        if chunks:
+            self._head_stall_steps = 0
+        elif chunked and not self.running and len(self.prefilling) > 1:
+            # Nothing can move: mid-prompt sequences pin every page among
+            # themselves. Preempt the most recently arrived one (its pages
+            # return to the pool / prefix cache) and retry — bounded by the
+            # prefilling count. A sole mid-prompt sequence always fits (its
+            # whole prompt passed the pool check in add_request).
+            self._preempt(self.prefilling[-1])
+            return self._schedule_prefill()
+        return chunks
 
-    def _run_prefill(self, batch: list[Sequence]) -> list[tuple[Sequence, EngineOutput]]:
+    def _note_head_stall(self, seq: Sequence, num_new: int) -> None:
+        self._head_stall_steps += 1
+        if self._head_stall_steps % 100 == 1:
+            logger.warning(
+                "head-of-queue seq %d cannot allocate pages for %d tokens "
+                "(free %d pages) with nothing running; stalled %d steps",
+                seq.seq_id, num_new, self.allocator.num_free(), self._head_stall_steps,
+            )
+
+    def _run_mixed(self, chunks: list[tuple[Sequence, int]]) -> list[tuple[Sequence, EngineOutput]]:
+        """One fused dispatch: a 1-token decode row per running sequence plus
+        an n-token prefill row per scheduled chunk.
+
+        Every row computes ``tokens[num_cached : num_cached + n]``; a decode
+        row is just the degenerate chunk whose span ends at ``len(tokens)``.
+        The runner samples every row; host-side, non-final chunk rows
+        *discard* the sample — their rng fold counter (``num_generated``)
+        does not advance, so the final chunk samples at exactly the fold a
+        whole-prompt prefill would have used (golden parity, greedy and
+        seeded). With chunking disabled this runs the scheduled whole
+        prompts without decode rows — the legacy phase-exclusive step."""
+        fused = self.config.chunk_prefill_tokens > 0
+        out: list[tuple[Sequence, EngineOutput]] = []
+        decode_rows: list[Sequence] = []
+        if fused and self.running:
+            failed = self._ensure_burst_pages(1)
+            if failed is not None:
+                out.append((failed, self._final_output(failed)))
+            decode_rows = list(self.running)
+        self.last_step_info = {
+            "decode_rows": len(decode_rows),
+            "chunk_rows": len(chunks),
+            "chunk_tokens": int(sum(n for _, n in chunks)),
+            "decodable": len(self.running),
+        }
+        if chunks and fused:
+            self.mixed_steps += 1
+        if chunks and self.running and not decode_rows:
+            self.stall_violations += 1  # legacy XOR: this dispatch stalls decodes
+        batch = decode_rows + [s for s, _ in chunks]
+        if not batch:
+            return out
+        ns = [1] * len(decode_rows) + [n for _, n in chunks]
         ps = self.config.page_size
-        t = max(len(s.tokens) - s.num_cached for s in batch)
-        n = max(len(s.pages) for s in batch)
+        t = max(ns)
+        npg = max(len(s.pages) for s in batch)
         b = len(batch)
         tokens = np.zeros((b, t), np.int32)
         positions = np.zeros((b, t), np.int32)
-        block_tables = np.zeros((b, n), np.int32)
+        block_tables = np.zeros((b, npg), np.int32)
         slots = np.zeros((b, t), np.int32)
         last = np.zeros(b, np.int32)
-        for i, s in enumerate(batch):
-            new = s.tokens[s.num_cached :]
-            tokens[i, : len(new)] = new
-            pos = np.arange(s.num_cached, len(s.tokens), dtype=np.int32)
-            positions[i, : len(new)] = pos
+        for i, (s, n) in enumerate(zip(batch, ns)):
+            new = s.tokens[s.num_cached : s.num_cached + n]
+            tokens[i, :n] = new
+            pos = np.arange(s.num_cached, s.num_cached + n, dtype=np.int32)
+            positions[i, :n] = pos
             block_tables[i, : len(s.pages)] = s.pages
             page_arr = np.asarray(s.pages, dtype=np.int32)
-            slots[i, : len(new)] = page_arr[pos // ps] * ps + pos % ps
-            last[i] = len(new) - 1
+            slots[i, :n] = page_arr[pos // ps] * ps + pos % ps
+            last[i] = n - 1
+        # A row samples iff its span reaches the end of its tokens: all
+        # decode rows, and exactly the chunks that finish their prompt.
+        samples = [s.num_cached + n == len(s.tokens) for s, n in zip(batch, ns)]
         sb = self._sampling_batch(batch, tokens, positions, block_tables, slots, last)
-        if any(s.mm_embeds is not None for s in batch):
+        n_dec = len(decode_rows)
+        if any(s.mm_embeds is not None for s in batch[n_dec:]):
             d = next(s.mm_embeds.shape[1] for s in batch if s.mm_embeds is not None)
             m = max(s.mm_embeds.shape[0] for s in batch if s.mm_embeds is not None)
             img_id = self.runner.cfg.image_token_id
@@ -426,8 +576,10 @@ class EngineCore:
             mm = np.zeros((b, m, d), np.float32)
             off = np.full(b, -1, np.int32)  # -1: text row, no substitution
             counts = np.zeros(b, np.int32)
-            for i, s in enumerate(batch):
-                if s.mm_embeds is not None:
+            for i, (s, n) in enumerate(zip(batch, ns)):
+                # Decode rows keep -1 (a sampled image-token id is an
+                # ordinary token there, exactly as in pure decode steps).
+                if s.mm_embeds is not None and i >= n_dec:
                     mm[i, : s.mm_embeds.shape[0]] = s.mm_embeds
                     counts[i] = s.mm_embeds.shape[0]
                     # Placeholders already covered by cached/previous chunks.
@@ -437,48 +589,66 @@ class EngineCore:
                     ))
             sb.mm_embeds, sb.mm_slot_offset, sb.mm_counts = mm, off, counts
         if any(s.mrope is not None for s in batch):
-            # Per-token 3D rope coords for this chunk's columns. Rows without
+            # Per-token 3D rope coords for this step's columns. Rows without
             # mrope (text prompts sharing the batch) use sequential positions
             # on all axes — exactly 1D rope. Indices past the stored prompt
-            # coords (recomputed generated tokens) sit at index + delta.
+            # coords (recomputed generated tokens and decode rows) sit at
+            # index + delta.
             mrope3 = np.broadcast_to(positions[:, None, :], (b, 3, t)).copy()
-            for i, s in enumerate(batch):
+            for i, (s, n) in enumerate(zip(batch, ns)):
                 if s.mrope is None:
                     continue
                 pos3, delta = s.mrope
-                new = len(s.tokens) - s.num_cached
-                idx = np.arange(s.num_cached, len(s.tokens))
+                idx = np.arange(s.num_cached, s.num_cached + n)
                 in_prompt = idx < pos3.shape[1]
                 cols = np.where(
                     in_prompt[None, :], pos3[:, np.minimum(idx, pos3.shape[1] - 1)],
                     (idx + delta)[None, :],
                 )
-                mrope3[i, :, :new] = cols
+                mrope3[i, :, :n] = cols
             sb.mrope_positions = mrope3.astype(np.int32)
-        lp_k = LOGPROBS_TOP_K if any(s.request.sampling.logprobs for s in batch) else 0
+        sb.num_new = np.asarray(ns, np.int32)
+        lp_k = LOGPROBS_TOP_K if any(
+            s.request.sampling.logprobs and smp for s, smp in zip(batch, samples)
+        ) else 0
         sb.logit_mask = self._constraint_masks(batch)
         try:
             stepped = self.runner.step(sb, lp_k=lp_k) if lp_k else self.runner.step(sb)
         except Exception:
-            # Batch seqs were popped from waiting but are not yet in running:
-            # without cleanup here their pages would leak forever.
+            # Chunk seqs live in self.prefilling (and decode rows in
+            # self.running); _finish removes them and releases their pages.
             for s in batch:
                 self._finish(s, FinishReason.ERROR)
             raise
         next_tokens, lp_aux = stepped if lp_k else (stepped, None)
-        outputs: list[tuple[Sequence, EngineOutput]] = []
-        for i, s in enumerate(batch):
-            self._prompt_tokens_total += max(0, s.num_prompt - s.num_cached)
-            s.num_cached = len(s.tokens)
-            s.append_token(int(next_tokens[i]))
-            self._generated_tokens_total += 1
-            self._commit_filled_pages(s)
-            self._release_out_of_window(s)
-            # May finish the sequence (page release) — must follow commit.
-            self._accept_constrained(s, [int(next_tokens[i])])
-            outputs.append(self._emit(s, int(next_tokens[i]), self._lp_entries(s, lp_aux, i)))
-        self.running.extend(s for s in batch if not s.is_finished)
-        return outputs
+        for i, (s, n) in enumerate(zip(batch, ns)):
+            # Prompt-token accounting: only the prompt part of the span
+            # (recomputed generated tokens and decode rows contribute 0).
+            self._prompt_tokens_total += max(0, min(s.num_cached + n, s.num_prompt) - s.num_cached)
+            s.num_cached += n
+            if n > 1 or not samples[i]:
+                s.prefill_chunks += 1
+            if samples[i]:
+                tok = int(next_tokens[i])
+                s.append_token(tok)
+                self._generated_tokens_total += 1
+                self._commit_filled_pages(s)
+                self._release_out_of_window(s)
+                # May finish the sequence (page release) — must follow commit.
+                self._accept_constrained(s, [tok])
+                out.append(self._emit(s, tok, self._lp_entries(s, lp_aux, i)))
+            else:
+                # Non-final chunk: publish its full pages (shareable before
+                # the prefill finishes) and discard the sampled token — the
+                # rng fold counter stays put for the final chunk.
+                self._commit_filled_pages(s)
+                self._release_out_of_window(s)
+        # Chunks whose final span sampled are decodable now.
+        for s, _ in chunks:
+            if s in self.prefilling and s.prompt_remaining <= 1 and not s.is_finished:
+                self.prefilling.remove(s)
+                self.running.append(s)
+        return out
 
     # -- decode phase ------------------------------------------------------
 
@@ -827,7 +997,7 @@ class EngineCore:
         self._inflight = None
         if hasattr(self.runner, "reset_chain"):
             self.runner.reset_chain()
-        for seq in list(self.running) + list(self.waiting):
+        for seq in list(self.running) + list(self.prefilling) + list(self.waiting):
             seq.context.kill()
             self._finish(seq, reason)
         self.pending_offloads = []
@@ -906,8 +1076,12 @@ class EngineCore:
         seq.pages = []
         seq.committed_pages = 0
         seq.num_cached = 0
+        seq.prefill_chunks = 0
         seq.status = SeqStatus.PREEMPTED
-        self.running.remove(seq)
+        if seq in self.running:
+            self.running.remove(seq)
+        if seq in self.prefilling:  # preempted mid-prompt: re-chunks on resume
+            self.prefilling.remove(seq)
         self.waiting.appendleft(seq)
 
     def _finish(self, seq: Sequence, reason: FinishReason) -> None:
@@ -918,6 +1092,8 @@ class EngineCore:
             seq.pages = []
         if seq in self.running:
             self.running.remove(seq)
+        if seq in self.prefilling:
+            self.prefilling.remove(seq)
         if seq in self.waiting:
             self.waiting.remove(seq)
 
@@ -933,7 +1109,7 @@ class EngineCore:
             kv_active_blocks=st.active_pages,
             kv_total_blocks=st.total_pages,
             num_requests_waiting=len(self.waiting),
-            num_requests_running=len(self.running),
+            num_requests_running=len(self.running) + len(self.prefilling),
             request_total_slots=self.config.max_batch_size,
             cache_hit_rate=st.hit_rate,
             prompt_tokens_total=self._prompt_tokens_total,

@@ -140,6 +140,14 @@ class StepBatch:
     mrope_positions: np.ndarray | None = None  # i32[B, 3, T] (mm prefill only)
     # Constrained decoding (sync path only): bool[B, vocab] allowed tokens.
     logit_mask: np.ndarray | None = None
+    # Mixed-step metadata: real token columns per row (decode rows 1,
+    # prefill-chunk rows their chunk length; padding rows 0). Host-side
+    # only — never shipped to device (the kernels derive the same
+    # information from positions/last_token_index: a decode row in a T>1
+    # batch is exact because attention masks per-token positions and its
+    # padding columns write KV to the null page). Consumed by the engine's
+    # step-composition telemetry, tests, and the bench stall probe.
+    num_new: np.ndarray | None = None  # i32[B]
 
     @property
     def batch_size(self) -> int:
@@ -445,6 +453,11 @@ class ModelRunner:
         return -(-bucket // self._dp) * self._dp
 
     def _bucket_time(self, t: int) -> int:
+        # Mixed steps (decode rows fused with prefill chunks) draw T from
+        # the same lattice: T = the longest chunk <= chunk_prefill_tokens,
+        # so chunking adds no buckets beyond what whole-prompt prefill
+        # already compiles (it strictly narrows the range, since the chunk
+        # budget <= max_prefill_tokens).
         if t <= 1:
             return 1
         return min(next_pow2(t), max(self.prefill_bucket * ((t + self.prefill_bucket - 1) // self.prefill_bucket), t))
@@ -504,6 +517,7 @@ class ModelRunner:
                          else pad1(batch.mrope_delta, bp)),
             mrope_positions=mrope3,
             logit_mask=lmask,
+            num_new=None if batch.num_new is None else pad1(batch.num_new, bp),
         )
 
     # -- execution ---------------------------------------------------------
@@ -531,6 +545,14 @@ class ModelRunner:
     @_locked
     def step(self, batch: StepBatch, lp_k: int = 0):
         """Run one forward+sample step; returns sampled token ids i32[B_real].
+
+        Rows may carry different real token counts (``num_new``): a mixed
+        step fuses 1-token decode rows with multi-token prefill-chunk rows
+        in one dispatch. Per-row ``last_token_index`` already makes the
+        logit gather exact for that; a short row's padding columns attend
+        nothing real (per-token position masks) and write KV to the null
+        page, and only rows whose span completes their sequence have their
+        sample accepted by the engine (the rest are discarded host-side).
 
         ``lp_k > 0`` additionally returns a logprobs dict (chosen-token
         logprob + top-``lp_k`` alternatives, OpenAI semantics):

@@ -37,6 +37,9 @@ class Sequence:
     num_cached_at_start: int = 0  # prefix-cache hits at admission (for usage stats)
     pages: list[int] = field(default_factory=list)
     committed_pages: int = 0  # pages already committed to the prefix cache
+    # Forward chunks this (re)prefill has executed (chunked prefill
+    # progress; reset on preemption along with num_cached).
+    prefill_chunks: int = 0
     status: SeqStatus = SeqStatus.WAITING
     finish_reason: FinishReason | None = None
     # Image embeddings [total_image_tokens, D] substituted at placeholder
@@ -70,6 +73,22 @@ class Sequence:
     @property
     def is_finished(self) -> bool:
         return self.status is SeqStatus.FINISHED
+
+    @property
+    def num_computed(self) -> int:
+        """Tokens already through the forward pass. KV writes land in the
+        same dispatch that computes a chunk, so this coincides with
+        ``num_cached``; it exists as the scheduler-facing name — chunked
+        prefill reasons about compute progress, the allocator about KV
+        residency."""
+        return self.num_cached
+
+    @property
+    def prompt_remaining(self) -> int:
+        """Uncomputed tokens of the prompt (or, after preemption, of the
+        prompt + generated recompute). 0 once fully prefilled; a mid-chunk
+        sequence is not decodable until this reaches 0."""
+        return max(0, len(self.tokens) - self.num_cached)
 
     def pages_needed(self, page_size: int, num_tokens_ahead: int = 1) -> int:
         """Extra pages needed to hold KV for the next ``num_tokens_ahead`` tokens."""

@@ -17,6 +17,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import logging
+import os
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -139,6 +140,10 @@ class WorkerSpec:
             decode_steps=int(
                 os.environ.get("DYNAMO_DECODE_STEPS")
                 or os.environ.get("DYN_WORKER_DECODE_STEPS", "1")
+            ),
+            chunk_prefill_tokens=int(
+                os.environ.get("DYNAMO_CHUNK_PREFILL_TOKENS")
+                or os.environ.get("DYN_WORKER_CHUNK_PREFILL_TOKENS", "512")
             ),
         )
         defaults.update(engine_kw)
@@ -364,7 +369,8 @@ async def serve_prefill_worker(runtime: DistributedRuntime, spec: WorkerSpec, *,
     from dynamo_tpu.disagg.prefill_worker import PrefillWorker
 
     service = await build_engine_service(spec, g4_storage=_g4_storage_for(spec, runtime))
-    worker = await PrefillWorker(runtime, service).start()
+    conc = int(os.environ.get("DYN_PREFILL_CONCURRENCY", "2"))
+    worker = await PrefillWorker(runtime, service, max_concurrency=conc).start()
     service.aux.append(worker)
     logger.info("prefill worker up for %s", spec.card.name)
     return service
@@ -800,6 +806,11 @@ def main(argv: list[str] | None = None) -> None:
         "--decode-steps", type=int, default=ws.decode_steps,
         help="fused decode steps per device dispatch",
     )
+    parser.add_argument(
+        "--chunk-prefill-tokens", type=int, default=ws.chunk_prefill_tokens,
+        help="per-step prefill chunk budget fused with decode "
+        "(stall-free mixed steps); 0 = phase-exclusive prefill/decode",
+    )
     parser.add_argument("--num-nodes", type=int, default=1, help="hosts forming one worker's mesh")
     parser.add_argument("--node-rank", type=int, default=0)
     parser.add_argument(
@@ -828,6 +839,10 @@ def main(argv: list[str] | None = None) -> None:
         import os
 
         os.environ["DYN_WORKER_DECODE_STEPS"] = str(args.decode_steps)
+    if args.chunk_prefill_tokens != 512:
+        import os
+
+        os.environ["DYN_WORKER_CHUNK_PREFILL_TOKENS"] = str(args.chunk_prefill_tokens)
     asyncio.run(_amain(args))
 
 

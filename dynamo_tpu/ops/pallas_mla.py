@@ -27,12 +27,25 @@ import functools
 import os
 
 import jax
+
+try:  # jax >= 0.5 exports shard_map at top level
+    _shard_map = jax.shard_map
+except AttributeError:  # 0.4.x: experimental namespace, check_vma spelled check_rep
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def _shard_map(*args, **kw):
+        if "check_vma" in kw:
+            kw["check_rep"] = kw.pop("check_vma")
+        return _exp_shard_map(*args, **kw)
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 LANES = 128
+
+# jax >= 0.4.34 renamed TPUCompilerParams -> CompilerParams; support both.
+_COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
 
 
 def mla_decode_supported(r_kv: int, r_width: int) -> bool:
@@ -216,7 +229,7 @@ def mla_paged_decode(
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((b, n_heads, r_kv), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("arbitrary",)
         ),
         interpret=interpret,
@@ -261,7 +274,7 @@ def mla_paged_decode_sharded(
             ql, qr, cc, rc, bt, pos, scale=scale, interpret=interpret
         )
 
-    return jax.shard_map(
+    return _shard_map(
         body, mesh=mesh,
         in_specs=(q_spec, q_spec, P(), P(), row_spec, row_spec),
         out_specs=q_spec,

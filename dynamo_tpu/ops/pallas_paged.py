@@ -67,6 +67,9 @@ logger = logging.getLogger(__name__)
 NEG_INF = -1e30
 LANES = 128
 
+# jax >= 0.4.34 renamed TPUCompilerParams -> CompilerParams; support both.
+_COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
 # Kernel-fallback observability: a config typo (odd GQA grouping, a page
 # slab width off the 128-lane grid) silently costs ~5x decode throughput if
 # the dispatch drops to the gather formulation. The dispatch runs at jit
@@ -343,7 +346,7 @@ def paged_decode_attention(
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((b, n_heads, width), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("arbitrary",)
         ),
         interpret=interpret,

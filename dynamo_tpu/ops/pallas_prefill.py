@@ -60,6 +60,9 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 LANES = 128
 
+# jax >= 0.4.34 renamed TPUCompilerParams -> CompilerParams; support both.
+_COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+
 
 def _block_tokens(page_size: int, width: int) -> int:
     """KV tokens per compute block, budgeted against scoped VMEM (~16 MB):
@@ -117,7 +120,14 @@ def _prefill_kernel(
     # Causal bound: this query block's last token sits at absolute position
     # start + (qi+1)*tq - 1, so no key block past that is ever needed.
     kend = jnp.clip(start + (qi + 1) * tq, 1, kv_len)
-    num_blocks = pl.cdiv(kend, bk)
+    # Rows whose real span is shorter than the batch's T (mixed steps fuse
+    # 1-token decode rows with chunk rows; their output past the span is
+    # discarded) skip query blocks that hold no real token: the block's
+    # first query sits at start + qi*tq, so past kv_len-1 there is nothing
+    # to compute — and nothing to DMA (each skipped block saves the full
+    # KV walk up to kv_len).
+    has_work = start + qi * tq < kv_len
+    num_blocks = jnp.where(has_work, pl.cdiv(kend, bk), 0)
     # Clamp page lookups to the row's own used range (not just the table
     # width) so sentinel-filled table tails can never be dereferenced.
     last_page = jnp.maximum(kv_len - 1, 0) // page_size
@@ -148,7 +158,11 @@ def _prefill_kernel(
                 v_hbm.at[page], v_buf.at[slot, rows, :], v_sem.at[slot]
             ).wait()
 
-    start_block(0, 0)
+    # The first DMA must not start for a skipped block: its semaphore would
+    # never be waited here and would alias the next grid step's wait.
+    @pl.when(num_blocks > 0)
+    def _():
+        start_block(0, 0)
 
     n_kv, rows, hd = q_ref.shape
     q_all = q_ref[...]  # [n_kv, tq*g, hd] pre-scaled, cache dtype
@@ -210,7 +224,10 @@ def _prefill_kernel(
     final = jax.lax.fori_loop(0, num_blocks, body, init)
     for kv in range(n_kv):
         _, l, acc = final[kv]
-        o_ref[kv] = acc / l
+        # Skipped blocks carry l == 0 (no softmax mass): write zeros, not
+        # 0/0 NaNs — the caller discards these rows either way, but NaNs
+        # must never be produced where a debug check could trip on them.
+        o_ref[kv] = jnp.where(l > 0.0, acc / jnp.maximum(l, 1e-30), 0.0)
 
 
 def prefill_supported(q: jnp.ndarray, k_cache: jnp.ndarray) -> bool:
@@ -235,9 +252,13 @@ def paged_prefill_attention(
     """Prefill-phase (T > 1) paged flash attention; returns [B, T, H, hd].
 
     ``positions`` rows must be contiguous (``positions[b, t] = start_b + t``
-    for real tokens) — true for every engine prefill, chunked or not.
-    Batch-padding rows and T-padding tails produce garbage the caller
-    already discards (their logits are never gathered)."""
+    for real tokens) — true for every engine prefill row, chunked or not,
+    including mid-prompt continuations after a prefix-cache hit (start > 0)
+    and the 1-token decode rows a mixed step fuses in (start = kv_len - 1:
+    exactly one real query). Batch-padding rows and T-padding tails produce
+    zeros/garbage the caller already discards (their logits are never
+    gathered); query blocks wholly past a row's real span are skipped in
+    the kernel, so short rows don't re-walk their KV history."""
     b, t, n_heads, head_dim = q.shape
     num_pages, page_size, width = k_cache.shape
     n_kv = width // head_dim
@@ -288,7 +309,7 @@ def paged_prefill_attention(
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((b, n_kv, t * group, head_dim), jnp.float32),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("arbitrary", "arbitrary")
         ),
         interpret=interpret,

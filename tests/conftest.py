@@ -56,7 +56,8 @@ FAST_MODULES = {
     "test_pull_transfer", "test_router", "test_rope_convention",
     "test_runtime_component", "test_runtime_discovery",
     "test_runtime_transport", "test_sampling", "test_sentencepiece",
-    "test_tokens", "test_tool_calls", "test_tracing_objects",
+    "test_stall_free", "test_tokens", "test_tool_calls",
+    "test_tracing_objects",
 }
 
 

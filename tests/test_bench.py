@@ -43,6 +43,40 @@ def test_decode_step_bytes_geometry():
         assert preset in bench.ANCHOR_TOK_PER_SEC
 
 
+def test_stall_probe_structure(monkeypatch):
+    """probe_decode_stall's contract: stable keys for both scheduling modes
+    plus the ratio, sized down to a CPU-friendly scenario. The 5x acceptance
+    ratio is a TPU bench claim, not asserted here — CPU step times are
+    dominated by dispatch overhead, so only structure and counters are
+    stable."""
+    import bench
+
+    monkeypatch.setenv("BENCH_STALL_PRESET", "test-tiny")
+    monkeypatch.setenv("BENCH_STALL_DECODERS", "2")
+    monkeypatch.setenv("BENCH_STALL_ISL", "8")
+    monkeypatch.setenv("BENCH_STALL_OSL", "8")
+    monkeypatch.setenv("BENCH_STALL_PREFILL_ISL", "48")
+    monkeypatch.setenv("BENCH_STALL_CHUNK", "8")
+    monkeypatch.setenv("BENCH_PAGE_SIZE", "4")
+    out = bench.probe_decode_stall()
+    assert out["preset"] == "test-tiny"
+    for mode in ("chunked", "baseline_phase_exclusive"):
+        run = out[mode]
+        for key in ("chunk_prefill_tokens", "max_decode_stall_ms",
+                    "decode_step_p50_ms", "itl_p50_ms", "itl_p99_ms",
+                    "mixed_steps", "stall_violations", "steps"):
+            assert key in run, f"{mode} missing {key}"
+        assert run["steps"] > 0
+        assert run["max_decode_stall_ms"] >= 0
+    # The modes really did schedule differently.
+    assert out["chunked"]["chunk_prefill_tokens"] == 8
+    assert out["chunked"]["mixed_steps"] > 0
+    assert out["chunked"]["stall_violations"] == 0
+    assert out["baseline_phase_exclusive"]["mixed_steps"] == 0
+    assert out["baseline_phase_exclusive"]["stall_violations"] > 0
+    assert "stall_ratio_baseline_over_chunked" in out
+
+
 def test_synthesizer_prefix_structure():
     cfg = SyntheticConfig(num_requests=32, shared_prefix_len=16, num_groups=3,
                           group_prefix_len=8, unique_len=4, osl_mean=20, seed=7)

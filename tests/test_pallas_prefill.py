@@ -136,6 +136,39 @@ def test_prefill_matches_incremental_decode():
         )
 
 
+def test_mixed_batch_decode_row_among_chunks():
+    """Engine mixed steps pad 1-token decode rows into a T>1 batch: the row's
+    single real token sits at a large start with zero padding after it. The
+    kernel must (a) compute that token exactly (start/kv_len derive from the
+    row's position content, not its width) and (b) early-exit the query
+    blocks past the row's work — zero blocks means the first DMA must not be
+    issued and the unnormalized 0/0 output must be guarded (no NaN)."""
+    import dynamo_tpu.ops.pallas_prefill as pf
+
+    rng = np.random.default_rng(6)
+    orig_bt, orig_tq = pf._block_tokens, pf._tq_for
+    pf._block_tokens = lambda ps, w: 2 * ps  # bk = 16 tokens
+    pf._tq_for = lambda g, t, kv, hd: 8      # q blocks 1,2 of row 0 have no work
+    try:
+        q, k, v, tables, positions = _case(
+            rng, b=3, t=24, n_heads=4, n_kv=2, head_dim=64,
+            page_size=8, pages_per_seq=16, starts=[100, 0, 40],
+        )
+        # Row 0 becomes a decode row: one real token at position 100, zero
+        # padding after it (exactly what the engine's mixed batch builds).
+        positions = positions.at[0, 1:].set(0)
+        scale = 64**-0.5
+        want = np.asarray(paged_attention_reference(q, k, v, tables, positions, scale=scale))
+        got = np.asarray(paged_prefill_attention(q, k, v, tables, positions, scale=scale, interpret=True))
+        assert np.isfinite(got).all()
+        # Decode row: only its real token is consumed by the engine.
+        np.testing.assert_allclose(got[0, :1], want[0, :1], rtol=2e-2, atol=2e-2)
+        # Chunk rows (fresh prefill + mid-prompt continuation): exact in full.
+        np.testing.assert_allclose(got[1:], want[1:], rtol=2e-2, atol=2e-2)
+    finally:
+        pf._block_tokens, pf._tq_for = orig_bt, orig_tq
+
+
 def test_prefill_supported_predicate():
     q = jnp.zeros((2, 8, 32, 64))
     assert prefill_supported(q, jnp.zeros((8, 16, 8 * 64)))
