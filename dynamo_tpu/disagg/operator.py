@@ -15,6 +15,7 @@ injection into the prefix cache replaces RemotePrefillParams entirely.
 from __future__ import annotations
 
 import logging
+import time
 import uuid
 from typing import Any, AsyncIterator
 
@@ -65,23 +66,33 @@ class DisaggDecodeService(AsyncEngine[Any, dict]):
         if go_remote:
             go_remote = self.router.prefill_remote(prefill_len, await self.queue.depth())
         if go_remote:
+            from dynamo_tpu.tracing import Span, trace_of
+
             rid = req.request_id or uuid.uuid4().hex
             done = self.transfer.expect(rid)
-            await self.queue.put(
-                {
-                    "request_id": rid,
-                    "token_ids": list(req.token_ids),
-                    "transfer_address": self.transfer_address,
-                }
-            )
-            try:
-                await asyncio.wait_for(done.wait(), timeout=self.transfer_timeout)
-                self.remote_prefills += 1
-            except asyncio.TimeoutError:
-                logger.warning("remote prefill timed out for %s; prefilling locally", rid)
-                self.local_prefills += 1
-            finally:
-                self.transfer.forget(rid)
+            # The task carries the trace across the queue hop: spans on the
+            # remote prefill worker parent under this wait span, and the
+            # enqueue stamp lets the worker record the queue-wait gap.
+            span = Span("remote_prefill", trace=trace_of(context), request_id=rid, tokens=prefill_len)
+            with span:
+                await self.queue.put(
+                    {
+                        "request_id": rid,
+                        "token_ids": list(req.token_ids),
+                        "transfer_address": self.transfer_address,
+                        "trace": span.context.to_dict(),
+                        "t_enqueue": time.time(),
+                    }
+                )
+                try:
+                    await asyncio.wait_for(done.wait(), timeout=self.transfer_timeout)
+                    self.remote_prefills += 1
+                except asyncio.TimeoutError:
+                    logger.warning("remote prefill timed out for %s; prefilling locally", rid)
+                    span.fields["timeout"] = True
+                    self.local_prefills += 1
+                finally:
+                    self.transfer.forget(rid)
         else:
             self.local_prefills += 1
         async for item in self.engine.generate(req, context):

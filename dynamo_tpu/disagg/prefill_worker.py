@@ -96,6 +96,29 @@ class PrefillWorker:
             self._sem.release()
 
     async def _handle(self, task: dict) -> None:
+        import time
+
+        from dynamo_tpu.tracing import Span, TraceContext, record_span
+
+        token_ids = task["token_ids"]
+        request_id = task["request_id"]
+        # The decode side's remote_prefill span context rides the task dict;
+        # everything this worker records links under it (one trace_id across
+        # both processes). Untraced tasks get local root spans.
+        trace = TraceContext.from_dict(task.get("trace"))
+        t_enq = task.get("t_enqueue")
+        if t_enq is not None:
+            # Wall-clock gap (cross-process; clocks assumed NTP-close): how
+            # long the task sat in the distributed queue before our claim.
+            record_span(
+                "prefill_queue_wait", max(0.0, (time.time() - float(t_enq)) * 1e3),
+                trace=trace, request_id=request_id,
+            )
+        exec_span = Span("prefill_exec", trace=trace, request_id=request_id, tokens=len(token_ids))
+        with exec_span:
+            await self._prefill_and_ship(task, exec_span.context)
+
+    async def _prefill_and_ship(self, task: dict, trace) -> None:
         token_ids = task["token_ids"]
         request_id = task["request_id"]
         page_size = self.service.core.config.page_size
@@ -108,7 +131,7 @@ class PrefillWorker:
             stop=StopConditions(max_tokens=1, ignore_eos=True),
             request_id=request_id,
         )
-        async for _ in self.service.generate(req, Context()):
+        async for _ in self.service.generate(req, Context(request_id=request_id, trace=trace.to_dict())):
             pass
         hashes = compute_block_hashes(token_ids, page_size, salt=salt)
 
@@ -158,7 +181,7 @@ class PrefillWorker:
         try:
             result = await send_blocks_chunked(
                 self.runtime.transport, task["transfer_address"], request_id,
-                self.service.core, hashes,
+                self.service.core, hashes, trace=trace,
             )
         except Exception:
             logger.exception(
@@ -179,7 +202,7 @@ class PrefillWorker:
         if not blocks:
             logger.warning("prefill %s produced no transferable blocks", request_id)
         result = await send_blocks(
-            self.runtime.transport, task["transfer_address"], request_id, blocks
+            self.runtime.transport, task["transfer_address"], request_id, blocks, trace=trace
         )
         logger.info(
             "prefill %s: %d tokens -> %d blocks shipped (%s injected)",

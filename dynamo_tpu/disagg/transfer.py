@@ -47,8 +47,10 @@ import numpy as np
 
 from dynamo_tpu.engine.allocator import OutOfPagesError
 from dynamo_tpu.engine.core import EngineCore
+from dynamo_tpu.observability.metrics import observe_kv_phase
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
 from dynamo_tpu.runtime.transport import Transport
+from dynamo_tpu.tracing import TraceContext, record_span
 
 logger = logging.getLogger(__name__)
 
@@ -349,7 +351,16 @@ class KvTransferService(AsyncEngine[Any, dict]):
                         [pid for pid, _h, _b in staged],
                         [k for k, _ in payloads], [v for _, v in payloads],
                     )
-                    self.scatter_seconds += time.perf_counter() - t_sc
+                    dt_sc = time.perf_counter() - t_sc
+                    self.scatter_seconds += dt_sc
+                    observe_kv_phase("scatter", dt_sc)
+                    # Receiver-side phase span, linked into the sender's
+                    # trace when the chunk carries one.
+                    record_span(
+                        "kv_scatter", dt_sc * 1e3,
+                        trace=TraceContext.from_dict(request.get("trace")),
+                        request_id=request_id, seq=seq, blocks=len(staged),
+                    )
                     alloc = self.core.allocator
                     for pid, h, blk in staged:
                         # Incremental commit: publish, but KEEP the staging
@@ -564,10 +575,19 @@ class KvTransferService(AsyncEngine[Any, dict]):
                 payloads = [unpack_payload(blk) for _pid, _h, blk in staged]
                 # One stacked transfer + one scatter for the whole chain,
                 # instead of a dispatch round-trip per page.
+                t_sc = time.perf_counter()
                 await asyncio.get_running_loop().run_in_executor(
                     None, self.core.runner.write_pages,
                     [pid for pid, _h, _b in staged],
                     [k for k, _ in payloads], [v for _, v in payloads],
+                )
+                dt_sc = time.perf_counter() - t_sc
+                self.scatter_seconds += dt_sc
+                observe_kv_phase("scatter", dt_sc)
+                record_span(
+                    "kv_scatter", dt_sc * 1e3,
+                    trace=TraceContext.from_dict(request.get("trace")),
+                    request_id=request_id, blocks=len(staged), protocol="v1",
                 )
                 self._commit_staged(
                     (pid, h, blk.get("parent"), tuple(blk.get("tokens", ())))
@@ -594,12 +614,20 @@ async def send_blocks(
     blocks: list[dict],
     *,
     context: Context | None = None,
+    trace: TraceContext | None = None,
 ) -> dict:
     """Sender-side: ship packed blocks to a decode worker's transfer endpoint."""
     context = context or Context()
+    msg: dict = {"request_id": request_id, "blocks": blocks}
+    if trace is not None:
+        msg["trace"] = trace.to_dict()
+    t0 = time.perf_counter()
     result: dict = {}
-    async for item in transport.generate(address, {"request_id": request_id, "blocks": blocks}, context):
+    async for item in transport.generate(address, msg, context):
         result = item
+    dt = time.perf_counter() - t0
+    observe_kv_phase("wire", dt)
+    record_span("kv_wire", dt * 1e3, trace=trace, request_id=request_id, blocks=len(blocks), protocol="v1")
     return result
 
 
@@ -612,6 +640,7 @@ async def send_blocks_chunked(
     *,
     chunk_pages: int = CHUNK_PAGES,
     context: Context | None = None,
+    trace: TraceContext | None = None,
 ) -> dict:
     """Pipelined chunked transfer of a committed hash chain (wire v2).
 
@@ -676,10 +705,14 @@ async def send_blocks_chunked(
             total_bytes += sum(len(b["k"]) + len(b["v"]) for b in blocks)
             t_wire = time.perf_counter()
             streaming = True
-            resp = await _round_trip(transport, address, {
+            msg = {
                 "request_id": request_id, "seq": i, "blocks": blocks,
                 "last": i == len(chunks) - 1,
-            })
+            }
+            if trace is not None:
+                # The receiver's scatter spans link under the sender's span.
+                msg["trace"] = trace.to_dict()
+            resp = await _round_trip(transport, address, msg)
             phases["wire_s"] += time.perf_counter() - t_wire
             if resp.get("stream_error"):
                 # The receiver already rolled the stream back.
@@ -689,6 +722,14 @@ async def send_blocks_chunked(
         streaming = False
         result["phases"] = {k: round(v, 6) for k, v in phases.items()}
         result["bytes"] = total_bytes
+        # Sender-side phase telemetry: one span per phase (cumulative over
+        # the stream) + histogram observations for the metrics plane.
+        for phase, secs in (("gather", phases["gather_s"]), ("pack", phases["pack_s"]), ("wire", phases["wire_s"])):
+            observe_kv_phase(phase, secs)
+            record_span(
+                f"kv_{phase}", secs * 1e3, trace=trace,
+                request_id=request_id, chunks=len(chunks), bytes=total_bytes,
+            )
         return result
     finally:
         if streaming:

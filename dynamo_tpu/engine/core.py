@@ -126,6 +126,7 @@ class EngineCore:
         self._next_seq_id = 0
         self._eos = set(config.eos_token_ids)
         self.num_preemptions = 0
+        self.admission_rejections = 0  # requests refused at add_request intake
         # Cumulative counters for the metrics plane.
         self._prompt_tokens_total = 0
         self._generated_tokens_total = 0
@@ -169,31 +170,23 @@ class EngineCore:
         )
         self._next_seq_id += 1
         if not request.token_ids:
-            seq.status = SeqStatus.FINISHED
-            seq.finish_reason = FinishReason.ERROR
-            return seq
+            return self._reject(seq, FinishReason.ERROR)
         max_prompt = self.config.max_seq_len - 1
         if len(request.token_ids) > max_prompt:
-            seq.status = SeqStatus.FINISHED
-            seq.finish_reason = FinishReason.LENGTH
-            return seq
+            return self._reject(seq, FinishReason.LENGTH)
         if request.sampling.json_mode:
             try:
                 seq.constraint = self._make_constraint()
             except ValueError as exc:
                 logger.warning("rejecting json_mode request: %s", exc)
-                seq.status = SeqStatus.FINISHED
-                seq.finish_reason = FinishReason.ERROR
-                return seq
+                return self._reject(seq, FinishReason.ERROR)
         if request.mm_inputs:
             try:
                 seq.mm_embeds = self._decode_mm_inputs(request)
                 seq.mrope = self._mrope_for(request)
             except ValueError as exc:
                 logger.warning("rejecting multimodal request: %s", exc)
-                seq.status = SeqStatus.FINISHED
-                seq.finish_reason = FinishReason.ERROR
-                return seq
+                return self._reject(seq, FinishReason.ERROR)
         # A prompt needing more pages than the pool holds can never be
         # scheduled; admitting it would wedge the FIFO head forever.
         usable_pages = self.config.num_pages - 1  # page 0 is the reserved null page
@@ -203,10 +196,14 @@ class EngineCore:
                 "rejecting request: prompt needs %d pages, pool holds %d",
                 pages_needed, usable_pages,
             )
-            seq.status = SeqStatus.FINISHED
-            seq.finish_reason = FinishReason.ERROR
-            return seq
+            return self._reject(seq, FinishReason.ERROR)
         self.waiting.append(seq)
+        return seq
+
+    def _reject(self, seq: Sequence, reason: FinishReason) -> Sequence:
+        self.admission_rejections += 1
+        seq.status = SeqStatus.FINISHED
+        seq.finish_reason = reason
         return seq
 
     def set_constraint_tokenizer(self, tokenizer) -> None:

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Any, AsyncIterator
 
 from dynamo_tpu.engine.core import EngineCore
@@ -80,7 +81,7 @@ class JaxEngineService(AsyncEngine[Any, dict]):
 
         while True:
             try:
-                _req, _ctx, out_q = self._intake.get_nowait()
+                _req, _ctx, out_q, _t_enq = self._intake.get_nowait()
             except asyncio.QueueEmpty:
                 return
             out_q.put_nowait(EngineOutput(token_ids=[], finish_reason=FinishReason.ERROR))
@@ -95,9 +96,19 @@ class JaxEngineService(AsyncEngine[Any, dict]):
             admitted = False
             while True:
                 try:
-                    request, context, out_q = self._intake.get_nowait()
+                    request, context, out_q, t_enq = self._intake.get_nowait()
                 except asyncio.QueueEmpty:
                     break
+                # Intake-to-admission gap: how long the request sat waiting
+                # for the engine loop (scheduler queue wait on the timeline).
+                from dynamo_tpu.tracing import record_span, trace_of
+
+                record_span(
+                    "engine_queue_wait",
+                    (time.perf_counter() - t_enq) * 1e3,
+                    trace=trace_of(context),
+                    request_id=context.id,
+                )
                 try:
                     seq = self.core.add_request(request, context)
                 except Exception:
@@ -195,7 +206,7 @@ class JaxEngineService(AsyncEngine[Any, dict]):
             return
         await self.start()
         out_q: asyncio.Queue = asyncio.Queue()
-        await self._intake.put((request, context, out_q))
+        await self._intake.put((request, context, out_q, time.perf_counter()))
         self._wake.set()
         if self._closed:
             # close() may have run between the check above and the put: its
@@ -206,10 +217,13 @@ class JaxEngineService(AsyncEngine[Any, dict]):
             out_q.put_nowait(EngineOutput(token_ids=[], finish_reason=FinishReason.ERROR))
             out_q.put_nowait(_SENTINEL)
         finished = False
-        from dynamo_tpu.tracing import Span
+        from dynamo_tpu.tracing import Span, record_span, trace_of
 
         span = Span(
-            "request", request_id=request.request_id, prompt_tokens=len(request.token_ids)
+            "engine_request",
+            trace=trace_of(context),
+            request_id=request.request_id,
+            prompt_tokens=len(request.token_ids),
         )
         span.__enter__()
         tokens_out = 0
@@ -220,6 +234,15 @@ class JaxEngineService(AsyncEngine[Any, dict]):
                 if item is _SENTINEL:
                     finished = True
                     return
+                if tokens_out == 0 and item.token_ids:
+                    # TTFT as seen at the engine boundary: submit -> first
+                    # token out of the step loop. Child of engine_request.
+                    record_span(
+                        "engine_first_token",
+                        (time.perf_counter() - span.t0) * 1e3,
+                        trace=span.context,
+                        request_id=request.request_id,
+                    )
                 tokens_out += len(item.token_ids)
                 saw_finish = saw_finish or item.finish_reason is not None
                 yield item.to_dict()

@@ -7,8 +7,16 @@ Routes (parity: reference `http/service/openai.rs`, `health.rs`,
 - POST /v1/completions
 - GET  /v1/models
 - GET  /health, /live
-- GET  /metrics — Prometheus text
+- GET  /metrics — Prometheus text (frontend registry + federated worker
+  EngineMetrics registries, when a telemetry client is wired)
+- GET  /debug/traces/{request_id} — the assembled distributed timeline for
+  one request (local spans + fan-out to every worker's span ring)
 - POST /clear_kv_blocks — admin: drop prefix caches on all workers
+
+Distributed tracing starts here: an incoming W3C ``traceparent`` header is
+ingested (or a fresh trace minted), a root ``http_request`` span wraps the
+request, and its context rides the per-request ``Context`` through every
+pipeline stage and process hop.
 
 Client disconnects cancel generation: the per-request Context is killed when
 the response write fails or the request is torn down, and that propagates
@@ -19,6 +27,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import sys
 from typing import Any, AsyncIterator, Awaitable, Callable
 
 from aiohttp import web
@@ -50,10 +59,16 @@ class HttpService:
         *,
         metrics: FrontendMetrics | None = None,
         clear_kv_hook: Callable[[], Awaitable[int]] | None = None,
+        telemetry: Any = None,
     ) -> None:
         self.manager = manager
         self.metrics = metrics or FrontendMetrics()
         self.clear_kv_hook = clear_kv_hook
+        # WorkerTelemetryClient (observability/service.py): fans /metrics and
+        # /debug/traces queries out to every live worker. None on frontends
+        # with no runtime wired (unit tests) — both routes degrade to
+        # frontend-local data.
+        self.telemetry = telemetry
         self._runner: web.AppRunner | None = None
         self.app = web.Application()
         self.app.add_routes(
@@ -65,6 +80,7 @@ class HttpService:
                 web.get("/health", self.health),
                 web.get("/live", self.live),
                 web.get("/metrics", self.prometheus),
+                web.get("/debug/traces/{request_id}", self.debug_traces),
                 web.post("/clear_kv_blocks", self.clear_kv_blocks),
                 web.post("/engine/profile", self.engine_profile),
             ]
@@ -183,35 +199,48 @@ class HttpService:
         # OpenAI default: usage only when explicitly requested via stream_options.
         send_usage = bool((body.get("stream_options") or {}).get("include_usage", False))
         ctx = Context(request_id=body.get("request_id"))
+        # Trace ingress: continue the caller's W3C trace or mint a fresh one.
+        # The root span's context rides ctx.trace through every pipeline
+        # stage and process hop (GET /debug/traces/{ctx.id} reassembles it).
+        from dynamo_tpu.tracing import Span, TraceContext
 
-        with self.metrics.tracker(model, kind) as tracker:
-            try:
-                backend_stream = self._backend_stream(entry.pipeline, body, ctx, tracker)
-                if stream_mode:
-                    return await self._stream_response(
-                        request, model, kind, ctx, backend_stream, send_usage,
-                        parse_tools=kind == "chat" and bool(body.get("tools")),
-                    )
-                if kind == "chat":
-                    payload = await aggregate_chat(
-                        model, backend_stream, parse_tools=bool(body.get("tools"))
-                    )
-                else:
-                    payload = await aggregate_completion(model, backend_stream)
-                return web.json_response(payload)
-            except asyncio.CancelledError:
-                ctx.kill()
-                raise
-            except ValueError as exc:  # request-shape errors from the preprocessor
-                tracker.status = "invalid"
-                ctx.kill()
-                return _error(400, str(exc))
-            except Exception:
-                logger.exception("request failed (model=%s)", model)
-                ctx.kill()
-                return _error(500, "internal error", "internal_error")
+        incoming = TraceContext.from_traceparent(request.headers.get("traceparent"))
+        root = Span("http_request", trace=incoming, request_id=ctx.id, model=model, endpoint=kind)
+        ctx.trace = root.context.to_dict()
+        root.__enter__()
+
+        try:
+            with self.metrics.tracker(model, kind) as tracker:
+                try:
+                    backend_stream = self._backend_stream(entry.pipeline, body, ctx, tracker)
+                    if stream_mode:
+                        return await self._stream_response(
+                            request, model, kind, ctx, backend_stream, send_usage,
+                            parse_tools=kind == "chat" and bool(body.get("tools")),
+                        )
+                    if kind == "chat":
+                        payload = await aggregate_chat(
+                            model, backend_stream, parse_tools=bool(body.get("tools"))
+                        )
+                    else:
+                        payload = await aggregate_completion(model, backend_stream)
+                    return web.json_response(payload)
+                except asyncio.CancelledError:
+                    ctx.kill()
+                    raise
+                except ValueError as exc:  # request-shape errors from the preprocessor
+                    tracker.status = "invalid"
+                    ctx.kill()
+                    return _error(400, str(exc))
+                except Exception:
+                    logger.exception("request failed (model=%s)", model)
+                    ctx.kill()
+                    return _error(500, "internal error", "internal_error")
+        finally:
+            root.__exit__(*sys.exc_info())
 
     async def _backend_stream(self, pipeline, body, ctx: Context, tracker) -> AsyncIterator[BackendOutput]:
+        tracker.on_dispatch()
         async for item in pipeline.generate(body, ctx):
             out = item if isinstance(item, BackendOutput) else BackendOutput.from_dict(item)
             tracker.on_token()
@@ -304,7 +333,66 @@ class HttpService:
         return web.json_response({"status": "live"})
 
     async def prometheus(self, request: web.Request) -> web.Response:
-        return web.Response(body=self.metrics.render(), content_type="text/plain")
+        self._sync_router_staleness()
+        parts = [self.metrics.render()]
+        if self.telemetry is not None:
+            from dynamo_tpu.observability.metrics import federate_text
+
+            try:
+                parts.extend(await self.telemetry.collect_metrics_texts())
+            except Exception:
+                logger.exception("worker metrics federation failed; serving frontend registry only")
+            return web.Response(body=federate_text(parts), content_type="text/plain")
+        return web.Response(body=parts[0], content_type="text/plain")
+
+    def _sync_router_staleness(self) -> None:
+        """Fold every model's KvMetricsAggregator view into the staleness
+        gauge (aggregators live in the model entries' aux lists)."""
+        staleness: dict[int, float] = {}
+        for name in self.manager.names():
+            entry = self.manager.get(name)
+            if entry is None:
+                continue
+            for a in entry.aux:
+                fn = getattr(a, "staleness_seconds", None)
+                if fn is not None:
+                    staleness.update(fn())
+        self.metrics.sync_staleness(staleness)
+
+    async def debug_traces(self, request: web.Request) -> web.Response:
+        """The assembled distributed timeline for one request id.
+
+        Union of the frontend-local span ring and every worker's (via the
+        telemetry fan-out), deduped by span_id; a second fan-out by trace_id
+        catches spans a hop recorded under a different request id.
+        """
+        from dynamo_tpu.observability.service import assemble_timeline
+        from dynamo_tpu.tracing import SPANS
+
+        rid = request.match_info["request_id"]
+        spans = SPANS.query(request_id=rid)
+        if self.telemetry is not None:
+            try:
+                spans += await self.telemetry.collect_spans(request_id=rid)
+                for tid in sorted({s.get("trace_id") for s in spans if s.get("trace_id")}):
+                    spans += SPANS.query(trace_id=tid)
+                    spans += await self.telemetry.collect_spans(trace_id=tid)
+            except Exception:
+                logger.exception("trace fan-out failed; serving local spans only")
+        seen: set[str] = set()
+        unique = []
+        for s in spans:
+            sid = s.get("span_id")
+            if sid and sid in seen:
+                continue
+            if sid:
+                seen.add(sid)
+            unique.append(s)
+        if not unique:
+            return web.json_response(
+                {"request_id": rid, "trace_ids": [], "span_count": 0, "spans": []}, status=404
+            )
+        return web.json_response(assemble_timeline(rid, unique))
 
     async def engine_profile(self, request: web.Request) -> web.Response:
         """On-demand device trace: POST {"seconds": 3, "dir": "/tmp/trace"}.

@@ -13,6 +13,7 @@ import time
 from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram, generate_latest
 
 _DURATION_BUCKETS = (0.005, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+_QUEUE_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 5.0)
 _TTFT_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.2, 0.3, 0.5, 0.75, 1.0, 2.0, 5.0, 10.0)
 _ITL_BUCKETS = (0.001, 0.005, 0.01, 0.02, 0.04, 0.06, 0.1, 0.2, 0.5, 1.0)
 _LEN_BUCKETS = (16, 64, 256, 1024, 3000, 8192, 32768, 131072)
@@ -46,6 +47,19 @@ class FrontendMetrics:
             f"{ns}_cached_prompt_tokens_total", "Prompt tokens served from prefix cache", ["model"],
             registry=self.registry,
         )
+        # Accept -> engine-dispatch gap: frontend-side time (parse, model
+        # lookup, preprocessing) before the request enters the pipeline.
+        self.request_queue = Histogram(
+            f"{ns}_request_queue_seconds", "Accept to engine-dispatch gap", ["model"],
+            buckets=_QUEUE_BUCKETS, registry=self.registry,
+        )
+        # Router-side staleness of each worker's last load publish (synced
+        # per scrape from the KvMetricsAggregator when one is wired).
+        self.worker_staleness = Gauge(
+            "dynamo_router_worker_staleness_seconds",
+            "Seconds since the router last saw a worker's ForwardPassMetrics publish",
+            ["worker"], registry=self.registry,
+        )
         # Kernel-fallback visibility: compiled paged-attention programs that
         # dropped to the ~5x-slower XLA gather formulation, by shape
         # signature (ops/pallas_paged.FALLBACK_COUNTS; synced per scrape).
@@ -58,9 +72,20 @@ class FrontendMetrics:
     def render(self) -> bytes:
         from dynamo_tpu.ops.pallas_paged import fallback_snapshot
 
+        # Drop label sets from a previous scrape first: a signature that
+        # left the snapshot (fallback cache reset) must not keep exporting
+        # its last value forever.
+        self.kernel_fallbacks.clear()
         for sig, n in fallback_snapshot().items():
             self.kernel_fallbacks.labels(sig).set(n)
         return generate_latest(self.registry)
+
+    def sync_staleness(self, staleness: dict[int, float]) -> None:
+        """Refresh the per-worker staleness gauge from an aggregator view
+        (clears first so departed workers drop their label sets)."""
+        self.worker_staleness.clear()
+        for wid, age in staleness.items():
+            self.worker_staleness.labels(f"{wid:x}").set(age)
 
     def tracker(self, model: str, endpoint: str) -> "RequestTracker":
         return RequestTracker(self, model, endpoint)
@@ -75,6 +100,7 @@ class RequestTracker:
         self.endpoint = endpoint
         self._start = 0.0
         self._last_token: float | None = None
+        self._dispatched = False
         self.status = "success"
 
     def __enter__(self) -> "RequestTracker":
@@ -88,6 +114,12 @@ class RequestTracker:
         self.m.inflight.labels(self.model).dec()
         self.m.requests.labels(self.model, self.endpoint, self.status).inc()
         self.m.duration.labels(self.model).observe(time.monotonic() - self._start)
+
+    def on_dispatch(self) -> None:
+        """The request is leaving the frontend for the engine pipeline."""
+        if not self._dispatched:
+            self._dispatched = True
+            self.m.request_queue.labels(self.model).observe(time.monotonic() - self._start)
 
     def on_token(self) -> None:
         now = time.monotonic()

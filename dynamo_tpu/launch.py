@@ -305,6 +305,7 @@ async def serve_worker(
     component = runtime.namespace(ns).component(comp)
 
     serve_engine: Any = service
+    transfer = None
     if disagg is not None:
         from dynamo_tpu.disagg.operator import DisaggDecodeService
         from dynamo_tpu.disagg.prefill_worker import PREFILL_QUEUE
@@ -341,12 +342,71 @@ async def serve_worker(
         runtime, ns, comp, instance.lease_id, snapshot, interval=0.5, lease=lease
     ).start()
     service.aux.append(publisher)  # closed with the service by callers that track it
+    await _serve_worker_telemetry(
+        component, service, worker_id=f"{instance.lease_id:x}", lease=lease,
+        transfer=transfer,
+        queue=getattr(serve_engine, "queue", None) if disagg is not None else None,
+        metadata={"model": spec.card.name},
+    )
     card_lease = lease or await runtime.primary_lease()
     await runtime.store.put(
         spec.card.instance_key(instance.lease_id), spec.card.to_bytes(), lease_id=card_lease.id
     )
     logger.info("worker serving %s as instance %x", spec.card.name, instance.lease_id)
     return service
+
+
+async def _serve_worker_telemetry(
+    component,
+    service: JaxEngineService,
+    *,
+    worker_id: str,
+    lease=None,
+    transfer=None,
+    queue=None,
+    metadata: dict | None = None,
+):
+    """Attach the per-worker telemetry plane (ISSUE: observability tentpole).
+
+    Builds the EngineMetrics registry bound to this worker's engine
+    internals, installs it as the process's KV-phase sink, and serves the
+    span-query + metrics-scrape endpoints next to ``generate`` so the
+    frontend can federate. ``DYN_WORKER_HTTP_PORT`` additionally opens the
+    direct debug HTTP surface (0 = pick a free port).
+    """
+    from dynamo_tpu.observability import (
+        DEBUG_TRACES_ENDPOINT,
+        METRICS_SCRAPE_ENDPOINT,
+        EngineMetrics,
+        MetricsScrapeService,
+        SpanQueryService,
+    )
+    from dynamo_tpu.observability.metrics import install
+
+    metrics = EngineMetrics(worker=worker_id).bind_core(service.core)
+    if transfer is not None:
+        metrics.bind_transfer(transfer)
+    if queue is not None:
+        metrics.bind_queue_depth(queue.depth)
+    # Process-global phase sink: with several in-process workers (run_local)
+    # the last one installed attributes the KV phases; multi-process
+    # deployments — the topology disagg targets — are exact.
+    install(metrics)
+    service.engine_metrics = metrics  # reachable for tests / direct scraping
+    await component.endpoint(DEBUG_TRACES_ENDPOINT).serve(
+        SpanQueryService(host=worker_id), metadata=metadata, lease=lease
+    )
+    await component.endpoint(METRICS_SCRAPE_ENDPOINT).serve(
+        MetricsScrapeService(metrics), metadata=metadata, lease=lease
+    )
+    port_spec = os.environ.get("DYN_WORKER_HTTP_PORT")
+    if port_spec is not None:
+        from dynamo_tpu.observability.http import WorkerDebugServer
+
+        debug = WorkerDebugServer(metrics)
+        await debug.start(port=int(port_spec))
+        service.aux.append(debug)
+    return metrics
 
 
 def _g4_storage_for(spec: WorkerSpec, runtime: DistributedRuntime):
@@ -372,6 +432,13 @@ async def serve_prefill_worker(runtime: DistributedRuntime, spec: WorkerSpec, *,
     conc = int(os.environ.get("DYN_PREFILL_CONCURRENCY", "2"))
     worker = await PrefillWorker(runtime, service, max_concurrency=conc).start()
     service.aux.append(worker)
+    ns, comp, _ep = spec.card.endpoint
+    worker_id = f"{lease.id:x}" if lease is not None else f"prefill-{os.getpid()}"
+    await _serve_worker_telemetry(
+        runtime.namespace(ns).component(comp), service,
+        worker_id=worker_id, lease=lease, queue=worker.queue,
+        metadata={"model": spec.card.name, "role": "prefill"},
+    )
     logger.info("prefill worker up for %s", spec.card.name)
     return service
 
@@ -384,9 +451,14 @@ async def serve_frontend(
     router_factory=None,
     clear_kv_hook=None,
 ) -> tuple[HttpService, ModelWatcher, int]:
+    from dynamo_tpu.observability import WorkerTelemetryClient
+
     manager = ModelManager()
     watcher = await ModelWatcher(runtime, manager, router_factory=router_factory).start()
-    service = HttpService(manager, metrics=FrontendMetrics(), clear_kv_hook=clear_kv_hook)
+    service = HttpService(
+        manager, metrics=FrontendMetrics(), clear_kv_hook=clear_kv_hook,
+        telemetry=WorkerTelemetryClient(runtime),
+    )
     actual_port = await service.start(host, port)
     return service, watcher, actual_port
 

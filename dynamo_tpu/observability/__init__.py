@@ -1,0 +1,37 @@
+"""Unified engine telemetry plane.
+
+Two surfaces over the same worker internals:
+
+- :mod:`metrics` — ``EngineMetrics``: the Prometheus registry for engine
+  layers (step composition, page pool, prefill queue, KV transfer), plus
+  text federation so the frontend's ``/metrics`` can serve every worker's
+  registry as one document.
+- :mod:`service` — runtime-transport endpoints (``debug_traces``,
+  ``metrics_scrape``) that make every worker's span ring and registry
+  remotely queryable, the fan-out client, and the timeline assembler behind
+  ``GET /debug/traces/{request_id}``.
+- :mod:`http` — the optional per-worker debug HTTP surface (``/metrics``,
+  ``/debug/traces/{request_id}``) for scraping workers directly.
+"""
+
+from dynamo_tpu.observability.metrics import EngineMetrics, federate_text, observe_kv_phase
+from dynamo_tpu.observability.service import (
+    DEBUG_TRACES_ENDPOINT,
+    METRICS_SCRAPE_ENDPOINT,
+    MetricsScrapeService,
+    SpanQueryService,
+    WorkerTelemetryClient,
+    assemble_timeline,
+)
+
+__all__ = [
+    "EngineMetrics",
+    "federate_text",
+    "observe_kv_phase",
+    "DEBUG_TRACES_ENDPOINT",
+    "METRICS_SCRAPE_ENDPOINT",
+    "MetricsScrapeService",
+    "SpanQueryService",
+    "WorkerTelemetryClient",
+    "assemble_timeline",
+]

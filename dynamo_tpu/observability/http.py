@@ -1,0 +1,61 @@
+"""Per-worker debug HTTP surface: GET /metrics + GET /debug/traces/{id}.
+
+Workers normally expose telemetry only over the runtime transport
+(``observability/service.py``), federated through the frontend. For direct
+Prometheus scraping of a worker — or poking a worker without a frontend —
+launch enables this tiny aiohttp server when ``DYN_WORKER_HTTP_PORT`` is set
+(0 picks a free port; the chosen port is logged).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from aiohttp import web
+
+from dynamo_tpu.observability.metrics import EngineMetrics
+
+logger = logging.getLogger(__name__)
+
+WORKER_HTTP_ENV = "DYN_WORKER_HTTP_PORT"
+
+
+class WorkerDebugServer:
+    def __init__(self, metrics: EngineMetrics) -> None:
+        self.metrics = metrics
+        self._runner: web.AppRunner | None = None
+        self.port: int | None = None
+        self.app = web.Application()
+        self.app.add_routes(
+            [
+                web.get("/metrics", self.prometheus),
+                web.get("/debug/traces/{request_id}", self.traces),
+            ]
+        )
+
+    async def prometheus(self, request: web.Request) -> web.Response:
+        return web.Response(body=await self.metrics.render(), content_type="text/plain")
+
+    async def traces(self, request: web.Request) -> web.Response:
+        from dynamo_tpu.observability.service import assemble_timeline
+        from dynamo_tpu.tracing import SPANS
+
+        rid = request.match_info["request_id"]
+        spans = SPANS.query(request_id=rid)
+        if not spans:
+            spans = SPANS.query(trace_id=rid)  # accept a trace_id too
+        return web.json_response(assemble_timeline(rid, spans))
+
+    async def start(self, host: str = "0.0.0.0", port: int = 0) -> int:
+        self._runner = web.AppRunner(self.app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, host, port)
+        await site.start()
+        self.port = self._runner.addresses[0][1] if self._runner.addresses else port
+        logger.info("worker debug HTTP on %s:%d", host, self.port)
+        return self.port
+
+    async def close(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None

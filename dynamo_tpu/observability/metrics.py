@@ -1,0 +1,218 @@
+"""EngineMetrics: the Prometheus registry for engine-layer observables.
+
+The frontend registry (``frontend/metrics.py``) covers the HTTP edge; this
+one covers what happens *behind* it, per worker process:
+
+- **Step composition** — the fused-dispatch shape of the last engine step
+  (decode rows vs prefill chunk rows/tokens, from ``core.last_step_info``)
+  plus the cumulative mixed-step / stall-violation counts that quantify the
+  stall-free invariant.
+- **Page pool** — utilization, fragmentation (reclaimable-but-cached share
+  of idle pages), prefix-cache hit ratio, preemptions.
+- **Admission** — requests waiting/running, intake rejections, and the
+  disagg prefill queue depth.
+- **KV transfer** — cumulative blocks/bytes and a per-phase duration
+  histogram (``gather|pack|wire|scatter``) fed by the disagg wire path.
+
+Every family carries a ``worker`` label so the frontend can federate many
+workers' registries into one ``/metrics`` document without sample
+collisions. Everything that has a cheap engine-side source of truth is
+synced on scrape (the ``kernel_fallbacks`` idiom) rather than
+double-counted; only the phase histogram is observed at record time.
+``render()`` is async so the prefill queue depth (a discovery-store scan)
+can be polled during the scrape.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Awaitable, Callable
+
+from prometheus_client import CollectorRegistry, Gauge, Histogram, generate_latest
+
+logger = logging.getLogger(__name__)
+
+_PHASE_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+
+#: KV-transfer phases tracked by the wire-path histogram.
+KV_PHASES = ("gather", "pack", "wire", "scatter")
+
+
+class EngineMetrics:
+    """Per-worker engine telemetry registry.
+
+    Bind engine internals after construction (``bind_core`` / ``bind_transfer``
+    / ``bind_queue_depth``); unbound families simply stay at their defaults,
+    so the registry is safe to serve from any worker role.
+    """
+
+    def __init__(self, registry: CollectorRegistry | None = None, *, worker: str = "local") -> None:
+        self.registry = registry or CollectorRegistry()
+        self.worker = worker
+        ns = "dynamo_engine"
+
+        def gauge(name: str, doc: str) -> Gauge:
+            return Gauge(name, doc, ["worker"], registry=self.registry).labels(worker)
+
+        # Step composition: the last fused dispatch's shape. Gauges, not
+        # counters — the interesting signal is the *mix* per step.
+        self.step_decode_rows = gauge(f"{ns}_step_decode_rows", "Decode rows in the last engine step")
+        self.step_chunk_rows = gauge(f"{ns}_step_chunk_rows", "Prefill chunk rows in the last engine step")
+        self.step_chunk_tokens = gauge(f"{ns}_step_chunk_tokens", "Prefill tokens in the last engine step")
+        self.step_decodable = gauge(f"{ns}_step_decodable_seqs", "Sequences decodable at the last step")
+        # Cumulative engine counters, synced from the core on scrape (the
+        # core already counts; a prometheus Counter would double-book).
+        self.mixed_steps = gauge(f"{ns}_mixed_steps_total", "Engine steps that fused prefill chunks with decodes")
+        self.stall_violations = gauge(
+            f"{ns}_stall_violations_total", "Prefill-only dispatches that starved decodable sequences"
+        )
+        self.preemptions = gauge(f"{ns}_preemptions_total", "Sequences preempted (pages reclaimed under pressure)")
+        self.admission_rejections = gauge(f"{ns}_admission_rejections_total", "Requests refused at engine intake")
+        # Page pool.
+        self.pages_total = gauge(f"{ns}_pages_total", "Allocatable KV pages")
+        self.pages_free = gauge(f"{ns}_pages_free", "Pages on the free list")
+        self.pages_cached = gauge(f"{ns}_pages_cached", "Evictable prefix-cache pages (refcount 0)")
+        self.pages_active = gauge(f"{ns}_pages_active", "Pages referenced by live sequences")
+        self.page_utilization = gauge(f"{ns}_page_utilization_ratio", "active_pages / total_pages")
+        self.page_fragmentation = gauge(
+            f"{ns}_page_fragmentation_ratio",
+            "cached / (free + cached): share of idle pages reclaimable only by eviction",
+        )
+        self.cache_hit_ratio = gauge(f"{ns}_prefix_cache_hit_ratio", "Prefix-cache block hit ratio (cumulative)")
+        # Admission / scheduler occupancy.
+        self.requests_waiting = gauge(f"{ns}_requests_waiting", "Admitted requests not yet scheduled")
+        self.requests_running = gauge(f"{ns}_requests_running", "Sequences in prefill or decode")
+        self.prefill_queue_depth = gauge(
+            f"{ns}_prefill_queue_depth", "Unclaimed tasks in the distributed prefill queue"
+        )
+        # KV transfer (disagg prefill -> decode migration).
+        self.kv_blocks = gauge("dynamo_kv_transfer_blocks_total", "KV blocks ingested into the local cache")
+        self.kv_bytes = gauge("dynamo_kv_transfer_bytes_total", "KV bytes received over the transfer path")
+        self.kv_streams = gauge("dynamo_kv_transfer_streams_in_flight", "Open v2 chunk-stream sessions")
+        self._kv_phase = Histogram(
+            "dynamo_kv_transfer_phase_seconds",
+            "Per-phase KV transfer duration (sender gather/pack/wire, receiver scatter)",
+            ["worker", "phase"], buckets=_PHASE_BUCKETS, registry=self.registry,
+        )
+        self._core: Any = None
+        self._transfer: Any = None
+        self._queue_depth_fn: Callable[[], Awaitable[int]] | None = None
+
+    def observe_phase(self, phase: str, seconds: float) -> None:
+        self._kv_phase.labels(self.worker, phase).observe(max(0.0, seconds))
+
+    # -- binding -----------------------------------------------------------
+
+    def bind_core(self, core: Any) -> "EngineMetrics":
+        self._core = core
+        return self
+
+    def bind_transfer(self, transfer: Any) -> "EngineMetrics":
+        self._transfer = transfer
+        return self
+
+    def bind_queue_depth(self, fn: Callable[[], Awaitable[int]]) -> "EngineMetrics":
+        """``fn`` is awaited per scrape (e.g. ``DistributedQueue.depth``)."""
+        self._queue_depth_fn = fn
+        return self
+
+    # -- scrape ------------------------------------------------------------
+
+    def _sync_core(self) -> None:
+        core = self._core
+        if core is None:
+            return
+        info = getattr(core, "last_step_info", None) or {}
+        self.step_decode_rows.set(info.get("decode_rows", 0))
+        self.step_chunk_rows.set(info.get("chunk_rows", 0))
+        self.step_chunk_tokens.set(info.get("chunk_tokens", 0))
+        self.step_decodable.set(info.get("decodable", 0))
+        self.mixed_steps.set(getattr(core, "mixed_steps", 0))
+        self.stall_violations.set(getattr(core, "stall_violations", 0))
+        self.preemptions.set(getattr(core, "num_preemptions", 0))
+        self.admission_rejections.set(getattr(core, "admission_rejections", 0))
+        stats = core.allocator.stats()
+        self.pages_total.set(stats.total_pages)
+        self.pages_free.set(stats.free_pages)
+        self.pages_cached.set(stats.cached_pages)
+        self.pages_active.set(stats.active_pages)
+        self.page_utilization.set(stats.active_pages / stats.total_pages if stats.total_pages else 0.0)
+        idle = stats.free_pages + stats.cached_pages
+        self.page_fragmentation.set(stats.cached_pages / idle if idle else 0.0)
+        self.cache_hit_ratio.set(stats.hit_rate)
+        self.requests_waiting.set(len(getattr(core, "waiting", ())))
+        self.requests_running.set(len(getattr(core, "running", ())) + len(getattr(core, "prefilling", ())))
+
+    def _sync_transfer(self) -> None:
+        if self._transfer is None:
+            return
+        stats = self._transfer.stats()
+        self.kv_blocks.set(stats.get("blocks", 0))
+        self.kv_bytes.set(stats.get("bytes", 0))
+        self.kv_streams.set(stats.get("streams_in_flight", 0))
+
+    async def render(self) -> bytes:
+        self._sync_core()
+        self._sync_transfer()
+        if self._queue_depth_fn is not None:
+            try:
+                self.prefill_queue_depth.set(await self._queue_depth_fn())
+            except Exception:
+                logger.exception("prefill queue depth probe failed")
+        return generate_latest(self.registry)
+
+
+# -- KV-phase observation hook ------------------------------------------------
+#
+# The wire path (disagg/transfer.py) measures phases deep inside free
+# functions; threading a metrics object through every call would couple the
+# transfer protocol to the telemetry plane. Instead the worker installs its
+# EngineMetrics once at bring-up and the transfer code calls
+# observe_kv_phase() — a no-op until something is installed.
+
+_installed: EngineMetrics | None = None
+
+
+def install(metrics: EngineMetrics | None) -> None:
+    global _installed
+    _installed = metrics
+
+
+def installed() -> EngineMetrics | None:
+    return _installed
+
+
+def observe_kv_phase(phase: str, seconds: float) -> None:
+    m = _installed
+    if m is not None:
+        try:
+            m.observe_phase(phase, seconds)
+        except Exception:
+            logger.exception("kv phase observation failed")
+
+
+# -- federation ---------------------------------------------------------------
+
+
+def federate_text(parts: list[bytes]) -> bytes:
+    """Merge rendered Prometheus texts into one legal document.
+
+    Several processes exporting the same metric family each emit their own
+    ``# HELP``/``# TYPE`` headers; Prometheus rejects duplicates, so keep the
+    first header per family and pass every sample line through (sample
+    uniqueness comes from the per-registry ``worker`` label).
+    """
+    seen_headers: set[tuple[str, str]] = set()
+    out: list[str] = []
+    for part in parts:
+        for line in part.decode().splitlines():
+            if line.startswith("# HELP ") or line.startswith("# TYPE "):
+                kind, _, rest = line[2:].partition(" ")
+                name = rest.split(" ", 1)[0]
+                if (kind, name) in seen_headers:
+                    continue
+                seen_headers.add((kind, name))
+            elif not line:
+                continue
+            out.append(line)
+    return ("\n".join(out) + "\n").encode()

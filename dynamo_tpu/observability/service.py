@@ -1,0 +1,162 @@
+"""Worker telemetry endpoints + the frontend-side fan-out client.
+
+Every worker serves two extra runtime endpoints next to ``generate``:
+
+- ``debug_traces`` (:class:`SpanQueryService`) — query the process-local
+  span ring (``tracing.SPANS``) by request or trace id;
+- ``metrics_scrape`` (:class:`MetricsScrapeService`) — render the process's
+  :class:`~dynamo_tpu.observability.metrics.EngineMetrics` registry.
+
+They ride the same discovery + stream transport as serving traffic, so the
+frontend needs no extra connectivity to reach them:
+:class:`WorkerTelemetryClient` scans the ``instances/`` prefix for telemetry
+endpoints and fans a query out to every live worker.
+:func:`assemble_timeline` merges the union of span docs (frontend-local +
+every worker's) into one ordered timeline — the body of
+``GET /debug/traces/{request_id}``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+from typing import Any, AsyncIterator
+
+from dynamo_tpu.runtime.component import INSTANCE_PREFIX, DistributedRuntime, Instance
+from dynamo_tpu.runtime.engine import AsyncEngine, Context
+
+logger = logging.getLogger(__name__)
+
+DEBUG_TRACES_ENDPOINT = "debug_traces"
+METRICS_SCRAPE_ENDPOINT = "metrics_scrape"
+
+_FANOUT_TIMEOUT = 5.0
+
+
+class SpanQueryService(AsyncEngine[Any, dict]):
+    """Answers ``{"request_id"?, "trace_id"?}`` with this process's spans."""
+
+    def __init__(self, *, host: str = "") -> None:
+        self.host = host or f"pid-{os.getpid()}"
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
+        from dynamo_tpu.tracing import SPANS
+
+        request = request or {}
+        spans = SPANS.query(
+            request_id=request.get("request_id"), trace_id=request.get("trace_id")
+        )
+        yield {"host": self.host, "spans": spans}
+
+
+class MetricsScrapeService(AsyncEngine[Any, dict]):
+    """Answers any request with the worker's rendered Prometheus text."""
+
+    def __init__(self, metrics) -> None:
+        self.metrics = metrics
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
+        yield {"text": (await self.metrics.render()).decode()}
+
+
+class WorkerTelemetryClient:
+    """Frontend-side fan-out over every worker's telemetry endpoints.
+
+    Discovery is a prefix scan per query (telemetry is off the request hot
+    path; a live watch would be over-engineering): any instance record whose
+    endpoint name matches is a target. Dead workers drop out with their
+    lease like any other instance.
+    """
+
+    def __init__(self, runtime: DistributedRuntime, *, timeout: float = _FANOUT_TIMEOUT) -> None:
+        self.runtime = runtime
+        self.timeout = timeout
+
+    async def _targets(self, endpoint: str) -> list[Instance]:
+        records = await self.runtime.store.get_prefix(f"{INSTANCE_PREFIX}/")
+        out = []
+        for value in records.values():
+            try:
+                inst = Instance.from_bytes(value)
+            except Exception:
+                continue
+            if inst.endpoint == endpoint:
+                out.append(inst)
+        return out
+
+    async def _ask(self, inst: Instance, request: dict) -> dict | None:
+        async def first() -> dict | None:
+            stream = self.runtime.transport.generate(inst.address, request, Context())
+            try:
+                async for item in stream:
+                    return item
+                return None
+            finally:
+                await stream.aclose()
+
+        try:
+            return await asyncio.wait_for(first(), self.timeout)
+        except Exception:
+            logger.warning("telemetry query to %x failed", inst.instance_id, exc_info=True)
+        return None
+
+    async def collect_spans(self, *, request_id: str | None = None, trace_id: str | None = None) -> list[dict]:
+        """The union of matching span docs across every live worker."""
+        targets = await self._targets(DEBUG_TRACES_ENDPOINT)
+        if not targets:
+            return []
+        results = await asyncio.gather(
+            *(self._ask(t, {"request_id": request_id, "trace_id": trace_id}) for t in targets)
+        )
+        spans: list[dict] = []
+        for inst, res in zip(targets, results):
+            if res is None:
+                continue
+            for s in res.get("spans", []):
+                s.setdefault("host", res.get("host", f"{inst.instance_id:x}"))
+                spans.append(s)
+        return spans
+
+    async def collect_metrics_texts(self) -> list[bytes]:
+        """Every worker's rendered registry (for /metrics federation)."""
+        targets = await self._targets(METRICS_SCRAPE_ENDPOINT)
+        results = await asyncio.gather(*(self._ask(t, {}) for t in targets))
+        return [r["text"].encode() for r in results if r and "text" in r]
+
+
+def assemble_timeline(request_id: str, spans: list[dict]) -> dict:
+    """One ordered timeline from the union of span docs.
+
+    Spans from different processes share a trace_id but not a monotonic
+    clock, so ordering uses the wall-clock ``start_ts``; ``offset_ms`` is
+    relative to the earliest span (queue wait → router decision → prefill →
+    KV phases → first decode step read top to bottom). ``children`` indexes
+    restore the parent/child structure where ids link up.
+    """
+    spans = sorted(spans, key=lambda s: (s.get("start_ts") or 0.0, s.get("duration_ms") or 0.0))
+    t0 = spans[0].get("start_ts", 0.0) if spans else 0.0
+    by_id = {s.get("span_id"): i for i, s in enumerate(spans) if s.get("span_id")}
+    out_spans = []
+    for i, s in enumerate(spans):
+        doc = dict(s)
+        doc["offset_ms"] = round(((s.get("start_ts") or t0) - t0) * 1e3, 3)
+        doc["children"] = [
+            j for j, c in enumerate(spans) if c.get("parent_id") and c["parent_id"] == s.get("span_id")
+        ]
+        doc["root"] = s.get("parent_id") not in by_id or s.get("parent_id") is None
+        out_spans.append(doc)
+    trace_ids = sorted({s["trace_id"] for s in spans if s.get("trace_id")})
+    return {
+        "request_id": request_id,
+        "trace_ids": trace_ids,
+        "span_count": len(out_spans),
+        "duration_ms": round(
+            max(
+                (s["offset_ms"] + (s.get("duration_ms") or 0.0) for s in out_spans),
+                default=0.0,
+            ),
+            3,
+        ),
+        "spans": out_spans,
+    }

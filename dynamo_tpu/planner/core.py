@@ -37,6 +37,13 @@ class WorkerProfile:
     max_concurrent: int = 64
     ttft_curve: list[tuple[float, float]] = field(default_factory=lambda: [(0.0, 0.05), (1.0, 0.5)])
     itl_curve: list[tuple[float, float]] = field(default_factory=lambda: [(0.0, 0.01), (1.0, 0.1)])
+    # Tail-latency surfaces (p95/p99) from the same sweep. Empty by default:
+    # the SLA planner keeps sizing on medians; tails are informational until
+    # an SLO policy consumes them (``ttft_at(..., pct=...)``).
+    ttft_p95_curve: list[tuple[float, float]] = field(default_factory=list)
+    ttft_p99_curve: list[tuple[float, float]] = field(default_factory=list)
+    itl_p95_curve: list[tuple[float, float]] = field(default_factory=list)
+    itl_p99_curve: list[tuple[float, float]] = field(default_factory=list)
 
     @staticmethod
     def _interp(curve: list[tuple[float, float]], x: float) -> float:
@@ -50,11 +57,13 @@ class WorkerProfile:
                 return y0 + (y1 - y0) * (x - x0) / max(x1 - x0, 1e-9)
         return pts[-1][1]
 
-    def ttft_at(self, load_fraction: float) -> float:
-        return self._interp(self.ttft_curve, load_fraction)
+    def ttft_at(self, load_fraction: float, *, pct: int = 50) -> float:
+        curve = {95: self.ttft_p95_curve, 99: self.ttft_p99_curve}.get(pct) or self.ttft_curve
+        return self._interp(curve, load_fraction)
 
-    def itl_at(self, load_fraction: float) -> float:
-        return self._interp(self.itl_curve, load_fraction)
+    def itl_at(self, load_fraction: float, *, pct: int = 50) -> float:
+        curve = {95: self.itl_p95_curve, 99: self.itl_p99_curve}.get(pct) or self.itl_curve
+        return self._interp(curve, load_fraction)
 
     def to_json(self) -> str:
         import json
@@ -68,7 +77,10 @@ class WorkerProfile:
         d = json.loads(text)
         # Absent curves keep the dataclass defaults (an empty curve would
         # interpolate to 0.0 latency and blind the SLA mode).
-        for key in ("ttft_curve", "itl_curve"):
+        for key in (
+            "ttft_curve", "itl_curve",
+            "ttft_p95_curve", "ttft_p99_curve", "itl_p95_curve", "itl_p99_curve",
+        ):
             if key in d:
                 d[key] = [tuple(p) for p in d[key]]
         return cls(**{k: v for k, v in d.items() if k in {f.name for f in dataclasses.fields(cls)}})

@@ -38,6 +38,13 @@ class LevelResult:
     decode_tps: float
     ttft_p50: float
     itl_p50: float
+    # Tail latency: the SLA planner sizes fleets on medians, but tail
+    # percentiles are what SLOs are written against — both ship in the
+    # WorkerProfile JSON.
+    ttft_p95: float = 0.0
+    ttft_p99: float = 0.0
+    itl_p95: float = 0.0
+    itl_p99: float = 0.0
 
 
 async def _run_level(service, *, concurrency: int, isl: int, osl: int, seed: int) -> LevelResult:
@@ -80,6 +87,10 @@ async def _run_level(service, *, concurrency: int, isl: int, osl: int, seed: int
         decode_tps=decode_tokens / wall,
         ttft_p50=float(np.median(ttfts)),
         itl_p50=float(np.median(gaps)) if gaps else 0.0,
+        ttft_p95=float(np.percentile(ttfts, 95)),
+        ttft_p99=float(np.percentile(ttfts, 99)),
+        itl_p95=float(np.percentile(gaps, 95)) if gaps else 0.0,
+        itl_p99=float(np.percentile(gaps, 99)) if gaps else 0.0,
     )
 
 
@@ -107,6 +118,10 @@ async def profile_service(
         max_concurrent=max_c,
         ttft_curve=[(r.concurrency / max_c, r.ttft_p50) for r in out],
         itl_curve=[(r.concurrency / max_c, r.itl_p50) for r in out],
+        ttft_p95_curve=[(r.concurrency / max_c, r.ttft_p95) for r in out],
+        ttft_p99_curve=[(r.concurrency / max_c, r.ttft_p99) for r in out],
+        itl_p95_curve=[(r.concurrency / max_c, r.itl_p95) for r in out],
+        itl_p99_curve=[(r.concurrency / max_c, r.itl_p99) for r in out],
     )
     return profile, out
 

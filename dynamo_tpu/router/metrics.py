@@ -15,6 +15,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import time
 from typing import Callable
 
 from dynamo_tpu.protocols.kv import ForwardPassMetrics
@@ -83,6 +84,7 @@ class KvMetricsAggregator:
         self.runtime = runtime
         self.prefix = f"{METRICS_PREFIX}/{namespace}/{component}/"
         self._metrics: dict[int, ForwardPassMetrics] = {}
+        self._updated: dict[int, float] = {}  # worker_id -> monotonic of last publish seen
         self._task: asyncio.Task | None = None
 
     async def start(self) -> "KvMetricsAggregator":
@@ -96,6 +98,7 @@ class KvMetricsAggregator:
         try:
             wid = int(key[len(self.prefix):], 16)
             self._metrics[wid] = ForwardPassMetrics.from_dict(json.loads(value))
+            self._updated[wid] = time.monotonic()
         except Exception:
             logger.exception("bad metrics record at %s", key)
 
@@ -106,7 +109,9 @@ class KvMetricsAggregator:
                     self._apply(event.key, event.value)
                 elif event.type is WatchEventType.DELETE:
                     try:
-                        self._metrics.pop(int(event.key[len(self.prefix):], 16), None)
+                        wid = int(event.key[len(self.prefix):], 16)
+                        self._metrics.pop(wid, None)
+                        self._updated.pop(wid, None)
                     except ValueError:
                         pass
         except asyncio.CancelledError:
@@ -116,6 +121,14 @@ class KvMetricsAggregator:
 
     def snapshot(self) -> dict[int, ForwardPassMetrics]:
         return dict(self._metrics)
+
+    def staleness_seconds(self) -> dict[int, float]:
+        """Seconds since each worker's last ForwardPassMetrics publish was
+        seen. A worker whose staleness keeps growing past its publish
+        interval is wedged or partitioned — the scheduler is routing on old
+        load data for it (surfaced as a frontend gauge)."""
+        now = time.monotonic()
+        return {wid: max(0.0, now - t) for wid, t in self._updated.items()}
 
     async def close(self) -> None:
         if self._task is not None:

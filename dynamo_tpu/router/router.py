@@ -75,10 +75,17 @@ class KvPushRouter(AsyncEngine[Any, Any]):
         if not worker_ids:
             worker_ids = [i.instance_id for i in await self.client.wait_for_instances(count=1)]
         from dynamo_tpu.tokens import mm_salt_fold
+        from dynamo_tpu.tracing import Span, trace_of
 
-        wid, overlap = self.router.schedule(
-            token_ids, worker_ids, salt_fold=mm_salt_fold(body.get("mm_inputs"))
-        )
+        with Span(
+            "router_decision", trace=trace_of(context), request_id=context.id,
+            candidates=len(worker_ids),
+        ) as span:
+            wid, overlap = self.router.schedule(
+                token_ids, worker_ids, salt_fold=mm_salt_fold(body.get("mm_inputs"))
+            )
+            span.fields["worker"] = f"{wid:x}"
+            span.fields["overlap_blocks"] = overlap
         logger.debug("kv-routed %d tokens -> worker %x (overlap %d blocks)", len(token_ids), wid, overlap)
         async for item in self.client.generate(body, context, instance_id=wid):
             yield item

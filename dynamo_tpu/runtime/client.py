@@ -18,6 +18,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import random
+import sys
 import time
 from typing import Any, AsyncIterator
 
@@ -158,7 +159,23 @@ class Client:
         last_error: Exception | None = None
         for _ in range(attempts):
             inst = self._pick(instance_id)
-            stream = transport.generate(inst.address, request, context)
+            # Traced requests get a per-hop client span; its span_id becomes
+            # the remote side's parent (injected via the hop context's trace,
+            # which the transport forwards on the wire). Untraced internal
+            # traffic pays nothing.
+            span = None
+            hop_ctx = context
+            if context.trace is not None:
+                from dynamo_tpu.tracing import Span, trace_of
+
+                span = Span(
+                    "rpc_client", trace=trace_of(context), request_id=context.id,
+                    endpoint=self.endpoint.path, instance=f"{inst.instance_id:x}",
+                )
+                span.__enter__()
+                hop_ctx = context.child()
+                hop_ctx.trace = span.context.to_dict()
+            stream = transport.generate(inst.address, request, hop_ctx)
             try:
                 try:
                     first = await anext(stream)
@@ -168,6 +185,9 @@ class Client:
                     logger.warning("instance %x failed pre-stream: %s; inhibiting", inst.instance_id, exc)
                     self.inhibit(inst.instance_id)
                     last_error = exc
+                    if span is not None:
+                        span.__exit__(type(exc), exc, None)
+                        span = None
                     continue
                 yield first
                 async for item in stream:
@@ -175,6 +195,13 @@ class Client:
                 return
             finally:
                 await stream.aclose()
+                if span is not None:
+                    # Consumer walk-away (GeneratorExit/cancel) is not a span
+                    # failure; real stream errors mark the span status=error.
+                    et, ev, tb = sys.exc_info()
+                    if et in (GeneratorExit, asyncio.CancelledError, StopAsyncIteration):
+                        et, ev, tb = None, None, None
+                    span.__exit__(et, ev, tb)
         raise last_error if last_error is not None else NoInstancesError(self.endpoint.path)
 
     async def close(self) -> None:

@@ -24,6 +24,9 @@ MAX_FRAME_BYTES = 256 * 1024 * 1024  # hard cap; a corrupt length prefix fails f
 
 class FrameType(str, Enum):
     REQUEST = "req"        # caller -> worker: open a stream {subject, id, p}
+    #                        + optional "trace" = {trace_id, span_id}: the W3C
+    #                        trace context of the calling span, extracted into
+    #                        the worker-side Context (distributed tracing)
     PROLOGUE = "pro"       # worker -> caller: stream accepted (or error detail)
     DATA = "dat"           # worker -> caller: one response item
     ERROR = "err"          # worker -> caller: stream failed; terminal

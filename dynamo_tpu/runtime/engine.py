@@ -37,10 +37,18 @@ class Context:
 
     Contexts form a chain: child contexts (created when a stage issues its own
     downstream request) propagate cancellation downward.
+
+    ``trace`` carries the distributed trace identity (a plain
+    ``{"trace_id", "span_id"}`` dict — serializable form of
+    :class:`dynamo_tpu.tracing.TraceContext`) through every stage: the
+    frontend mints it, operators pass the context (or a child) downstream,
+    and the network transport forwards it on the wire so spans on remote
+    workers link back to the same trace.
     """
 
-    def __init__(self, request_id: str | None = None) -> None:
+    def __init__(self, request_id: str | None = None, *, trace: dict | None = None) -> None:
         self.id: str = request_id or uuid.uuid4().hex
+        self.trace: dict | None = trace
         self._stop = asyncio.Event()
         self._kill = asyncio.Event()
         self._children: list[Context] = []
@@ -75,7 +83,7 @@ class Context:
     # -- chaining ----------------------------------------------------------
 
     def child(self) -> "Context":
-        c = Context(request_id=self.id)
+        c = Context(request_id=self.id, trace=self.trace)
         if self.is_stopped:
             c.stop_generating()
         if self.is_killed:

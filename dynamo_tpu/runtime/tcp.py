@@ -87,7 +87,9 @@ class TcpTransport(Transport):
             write_frame(writer, FrameType.PROLOGUE, ok=False, error=f"no such subject: {subject}")
             await writer.drain()
             return
-        context = Context(request_id=req.fields.get("id"))
+        # The trace context crosses the process boundary here: spans emitted
+        # by the engine behind this subject share the caller's trace_id.
+        context = Context(request_id=req.fields.get("id"), trace=req.fields.get("trace"))
         write_frame(writer, FrameType.PROLOGUE, ok=True)
 
         async def watch_control() -> None:
@@ -158,7 +160,8 @@ class TcpTransport(Transport):
 
         cancel_task = asyncio.create_task(forward_cancel())
         try:
-            write_frame(writer, FrameType.REQUEST, subject=subject, id=context.id, p=request)
+            extra = {"trace": context.trace} if context.trace else {}
+            write_frame(writer, FrameType.REQUEST, subject=subject, id=context.id, p=request, **extra)
             await writer.drain()
             prologue = await read_frame(reader)
             if prologue is None:

@@ -1,0 +1,411 @@
+"""Observability plane: distributed trace propagation, EngineMetrics,
+/debug/traces timeline assembly, /metrics federation, metric-name hygiene.
+
+Covers the ISSUE 3 tentpole end to end: a TraceContext minted at the edge
+rides runtime hops (real TCP), spans from every process land in the ring
+buffer under one trace_id, the frontend assembles them into one timeline,
+and the engine registries federate into the frontend's /metrics render.
+"""
+
+import asyncio
+import pathlib
+import sys
+import time
+from types import SimpleNamespace
+from typing import Any, AsyncIterator
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.observability.metrics import KV_PHASES, EngineMetrics, federate_text
+from dynamo_tpu.observability.service import assemble_timeline
+from dynamo_tpu.runtime.component import DistributedRuntime
+from dynamo_tpu.runtime.discovery import MemoryStore
+from dynamo_tpu.runtime.engine import AsyncEngine, Context, collect
+from dynamo_tpu.runtime.tcp import TcpTransport
+from dynamo_tpu.tracing import SPANS, Span, TraceContext, trace_of
+
+
+# -- trace identity -----------------------------------------------------------
+
+
+def test_traceparent_roundtrip():
+    ctx = TraceContext.new()
+    assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+    parsed = TraceContext.from_traceparent(ctx.to_traceparent())
+    assert parsed == ctx
+    # W3C header from an external tracer.
+    hdr = "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01"
+    parsed = TraceContext.from_traceparent(hdr)
+    assert parsed is not None
+    assert parsed.trace_id == "0af7651916cd43dd8448eb211c80319c"
+    assert parsed.span_id == "b7ad6b7169203331"
+    for bad in (None, "", "garbage", "00-short-span-01"):
+        assert TraceContext.from_traceparent(bad) is None
+    # Dict form survives a msgpack/JSON hop.
+    assert TraceContext.from_dict(ctx.to_dict()) == ctx
+    assert TraceContext.from_dict(None) is None
+    assert TraceContext.from_dict({"other": 1}) is None
+
+
+def test_span_links_under_incoming_context():
+    parent = TraceContext.new()
+    with Span("child_phase", trace=parent, request_id="link-1") as span:
+        pass
+    assert span.trace_id == parent.trace_id
+    assert span.parent_id == parent.span_id
+    assert span.context.trace_id == parent.trace_id
+    assert span.context.span_id == span.span_id
+    recorded = SPANS.query(request_id="link-1")
+    assert recorded and recorded[-1]["parent_id"] == parent.span_id
+
+
+# -- trace propagation over the real TCP transport ----------------------------
+
+
+class _TracingEngine(AsyncEngine[Any, Any]):
+    """Worker-side engine that records a span under the incoming context."""
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[Any]:
+        with Span("engine_side", trace=trace_of(context), request_id=context.id):
+            await asyncio.sleep(0)
+        yield {"ok": True}
+
+
+async def test_trace_propagates_frontend_to_engine_over_tcp():
+    """Frontend runtime -> worker runtime over real TCP sockets: the worker's
+    span must share the root trace_id and link under the rpc_client hop."""
+    store = MemoryStore()
+    rt_worker = DistributedRuntime(store, TcpTransport(host="127.0.0.1"))
+    rt_front = DistributedRuntime(store, TcpTransport(host="127.0.0.1"))
+    try:
+        await rt_worker.namespace("obs").component("backend").endpoint("generate").serve(
+            _TracingEngine()
+        )
+        client = rt_front.namespace("obs").component("backend").endpoint("generate").client()
+        await client.wait_for_instances(count=1, timeout=5)
+
+        rid = "tcp-trace-1"
+        root = Span("http_request", request_id=rid)
+        ctx = Context(request_id=rid, trace=root.context.to_dict())
+        with root:
+            items = await collect(client.generate({}, ctx))
+        assert items == [{"ok": True}]
+
+        spans = {s["name"]: s for s in SPANS.query(request_id=rid)}
+        assert {"http_request", "rpc_client", "engine_side"} <= set(spans)
+        # One trace across the wire...
+        assert spans["rpc_client"]["trace_id"] == root.trace_id
+        assert spans["engine_side"]["trace_id"] == root.trace_id
+        # ...with intact parent/child linkage: root -> rpc hop -> engine.
+        assert spans["rpc_client"]["parent_id"] == root.span_id
+        assert spans["engine_side"]["parent_id"] == spans["rpc_client"]["span_id"]
+        assert spans["engine_side"]["status"] == "ok"
+    finally:
+        await rt_front.close()
+        await rt_worker.close()
+
+
+async def test_untraced_context_stays_untraced_over_tcp():
+    """No trace on the context -> no rpc_client span, engine mints a root."""
+    store = MemoryStore()
+    rt = DistributedRuntime(store, TcpTransport(host="127.0.0.1"))
+    try:
+        await rt.namespace("obs").component("backend").endpoint("gen2").serve(_TracingEngine())
+        client = rt.namespace("obs").component("backend").endpoint("gen2").client()
+        await client.wait_for_instances(count=1, timeout=5)
+        rid = "tcp-untraced-1"
+        await collect(client.generate({}, Context(request_id=rid)))
+        spans = {s["name"]: s for s in SPANS.query(request_id=rid)}
+        assert "rpc_client" not in spans
+        assert spans["engine_side"]["parent_id"] is None
+    finally:
+        await rt.close()
+
+
+# -- EngineMetrics registry ---------------------------------------------------
+
+
+class _FakeCore:
+    last_step_info = {"decode_rows": 3, "chunk_rows": 2, "chunk_tokens": 128, "decodable": 3}
+    mixed_steps = 7
+    stall_violations = 1
+    num_preemptions = 2
+    admission_rejections = 4
+    waiting = ["a"]
+    running = ["b", "c"]
+    prefilling = ["d"]
+    allocator = SimpleNamespace(
+        stats=lambda: SimpleNamespace(
+            total_pages=64, free_pages=16, cached_pages=8, active_pages=40, hit_rate=0.5
+        )
+    )
+
+
+class _FakeTransfer:
+    def stats(self):
+        return {"blocks": 12, "bytes": 4096, "streams_in_flight": 1}
+
+
+EXPECTED_ENGINE_FAMILIES = {
+    "dynamo_engine_step_decode_rows",
+    "dynamo_engine_step_chunk_rows",
+    "dynamo_engine_step_chunk_tokens",
+    "dynamo_engine_step_decodable_seqs",
+    "dynamo_engine_mixed_steps_total",
+    "dynamo_engine_stall_violations_total",
+    "dynamo_engine_preemptions_total",
+    "dynamo_engine_admission_rejections_total",
+    "dynamo_engine_pages_total",
+    "dynamo_engine_pages_free",
+    "dynamo_engine_pages_cached",
+    "dynamo_engine_pages_active",
+    "dynamo_engine_page_utilization_ratio",
+    "dynamo_engine_page_fragmentation_ratio",
+    "dynamo_engine_prefix_cache_hit_ratio",
+    "dynamo_engine_requests_waiting",
+    "dynamo_engine_requests_running",
+    "dynamo_engine_prefill_queue_depth",
+    "dynamo_kv_transfer_blocks_total",
+    "dynamo_kv_transfer_bytes_total",
+    "dynamo_kv_transfer_streams_in_flight",
+    "dynamo_kv_transfer_phase_seconds",
+    # prometheus_client emits the histogram's _created timestamps as their
+    # own gauge family once a labelled child exists.
+    "dynamo_kv_transfer_phase_seconds_created",
+}
+
+
+async def test_engine_metrics_names_labels_and_values():
+    async def depth() -> int:
+        return 5
+
+    m = (
+        EngineMetrics(worker="w1")
+        .bind_core(_FakeCore())
+        .bind_transfer(_FakeTransfer())
+        .bind_queue_depth(depth)
+    )
+    for phase in KV_PHASES:
+        m.observe_phase(phase, 0.01)
+    text = (await m.render()).decode()
+
+    # Family-name snapshot: a rename or drop here is an intentional,
+    # reviewed change (dashboards and the docs inventory depend on these).
+    families = {
+        line.split(" ")[2] for line in text.splitlines() if line.startswith("# TYPE ")
+    }
+    assert families == EXPECTED_ENGINE_FAMILIES
+
+    # Every sample carries the worker label (the federation key).
+    for line in text.splitlines():
+        if line and not line.startswith("#"):
+            assert 'worker="w1"' in line, line
+
+    assert 'dynamo_engine_step_decode_rows{worker="w1"} 3.0' in text
+    assert 'dynamo_engine_step_chunk_tokens{worker="w1"} 128.0' in text
+    assert 'dynamo_engine_mixed_steps_total{worker="w1"} 7.0' in text
+    assert 'dynamo_engine_admission_rejections_total{worker="w1"} 4.0' in text
+    assert 'dynamo_engine_pages_active{worker="w1"} 40.0' in text
+    assert 'dynamo_engine_page_utilization_ratio{worker="w1"} 0.625' in text
+    # fragmentation = cached / (free + cached) = 8 / 24
+    assert 'dynamo_engine_page_fragmentation_ratio{worker="w1"} 0.3333333333333333' in text
+    assert 'dynamo_engine_requests_running{worker="w1"} 3.0' in text
+    assert 'dynamo_engine_prefill_queue_depth{worker="w1"} 5.0' in text
+    assert 'dynamo_kv_transfer_blocks_total{worker="w1"} 12.0' in text
+    for phase in KV_PHASES:
+        assert f'dynamo_kv_transfer_phase_seconds_count{{phase="{phase}",worker="w1"}} 1.0' in text
+
+
+async def test_unbound_engine_metrics_render_safely():
+    text = (await EngineMetrics(worker="idle").render()).decode()
+    assert 'dynamo_engine_pages_total{worker="idle"} 0.0' in text
+
+
+async def test_federate_text_merges_two_workers():
+    parts = [await EngineMetrics(worker="w1").render(), await EngineMetrics(worker="w2").render()]
+    merged = federate_text(parts).decode()
+    # One header per family...
+    assert merged.count("# TYPE dynamo_engine_pages_total gauge") == 1
+    assert merged.count("# HELP dynamo_engine_pages_total") == 1
+    # ...but both workers' samples survive.
+    assert 'dynamo_engine_pages_total{worker="w1"} 0.0' in merged
+    assert 'dynamo_engine_pages_total{worker="w2"} 0.0' in merged
+
+
+def test_metric_names_unique_and_prefixed():
+    """Invokes the tools/ hygiene check (ISSUE 3 satellite: CI wiring)."""
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "tools"))
+    try:
+        import check_metric_names
+    finally:
+        sys.path.pop(0)
+    names = check_metric_names.collect_names()
+    assert sum(len(v) for v in names.values()) > 20
+    assert check_metric_names.check(names) == []
+
+
+# -- timeline assembly --------------------------------------------------------
+
+
+def test_assemble_timeline_orders_and_links():
+    t0 = 1000.0
+    tid = "t" * 32
+    spans = [
+        {"name": "kv_wire", "trace_id": tid, "span_id": "c" * 16, "parent_id": "b" * 16,
+         "start_ts": t0 + 0.020, "duration_ms": 5.0, "status": "ok"},
+        {"name": "http_request", "trace_id": tid, "span_id": "a" * 16, "parent_id": None,
+         "start_ts": t0, "duration_ms": 50.0, "status": "ok"},
+        {"name": "remote_prefill", "trace_id": tid, "span_id": "b" * 16, "parent_id": "a" * 16,
+         "start_ts": t0 + 0.010, "duration_ms": 30.0, "status": "ok"},
+    ]
+    doc = assemble_timeline("req-1", spans)
+    assert doc["trace_ids"] == [tid]
+    assert [s["name"] for s in doc["spans"]] == ["http_request", "remote_prefill", "kv_wire"]
+    assert [s["offset_ms"] for s in doc["spans"]] == [0.0, 10.0, 20.0]
+    root = doc["spans"][0]
+    assert root["root"] is True and root["children"] == [1]
+    assert doc["spans"][1]["children"] == [2]
+    assert doc["duration_ms"] == 50.0
+
+
+async def test_debug_traces_endpoint_assembles_mocked_disagg_hop():
+    """GET /debug/traces/{id}: frontend-local spans + a mocked remote
+    prefill worker's spans merge into one timeline under one trace_id."""
+    from dynamo_tpu.frontend.http import HttpService
+    from dynamo_tpu.frontend.metrics import FrontendMetrics
+    from dynamo_tpu.frontend.model_manager import ModelManager
+
+    rid = "mock-disagg-1"
+    root = Span("http_request", request_id=rid, model="m", endpoint="completions")
+    with root:
+        with Span("router_decision", trace=root.context, request_id=rid):
+            pass
+
+    # The "remote process": span docs as a prefill worker's SpanQueryService
+    # would return them (same trace_id, linked under the frontend root).
+    now = time.time()
+    remote = [
+        {"name": "prefill_exec", "trace_id": root.trace_id, "span_id": "e" * 16,
+         "parent_id": root.span_id, "request_id": rid, "start_ts": now + 0.01,
+         "duration_ms": 20.0, "status": "ok", "host": "prefill-host"},
+        {"name": "kv_wire", "trace_id": root.trace_id, "span_id": "f" * 16,
+         "parent_id": "e" * 16, "request_id": rid, "start_ts": now + 0.02,
+         "duration_ms": 4.0, "status": "ok", "host": "prefill-host"},
+    ]
+
+    class FakeTelemetry:
+        async def collect_spans(self, *, request_id=None, trace_id=None):
+            if request_id is not None:
+                return [dict(s) for s in remote if s["request_id"] == request_id]
+            return [dict(s) for s in remote if s["trace_id"] == trace_id]
+
+        async def collect_metrics_texts(self):
+            return []
+
+    service = HttpService(ModelManager(), metrics=FrontendMetrics(), telemetry=FakeTelemetry())
+    port = await service.start("127.0.0.1", 0)
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{port}/debug/traces/{rid}") as r:
+                assert r.status == 200
+                doc = await r.json()
+            async with s.get(f"http://127.0.0.1:{port}/debug/traces/no-such-request") as r:
+                assert r.status == 404
+    finally:
+        await service.stop()
+
+    assert doc["request_id"] == rid
+    assert doc["trace_ids"] == [root.trace_id]  # one trace across both processes
+    names = [s["name"] for s in doc["spans"]]
+    assert set(names) >= {"http_request", "router_decision", "prefill_exec", "kv_wire"}
+    assert doc["span_count"] == len(names) == len({s["span_id"] for s in doc["spans"]})
+    hosts = {s.get("host") for s in doc["spans"]}
+    assert "prefill-host" in hosts
+    by_name = {s["name"]: s for s in doc["spans"]}
+    assert by_name["http_request"]["root"] is True
+    assert names.index("prefill_exec") < names.index("kv_wire")
+
+
+# -- full-stack disagg timeline + federation (acceptance criterion) -----------
+
+
+@pytest.mark.e2e
+async def test_disagg_request_yields_single_trace_timeline(monkeypatch):
+    """A disaggregated request (remote prefill via the wire path + local
+    decode) produces one /debug/traces timeline: spans from the decode side
+    and the prefill worker under a single trace_id, including the
+    KV-transfer phase spans; /metrics federates the engine registries."""
+    from dynamo_tpu.disagg import device_transfer, prefill_worker
+    from dynamo_tpu.disagg.router import DisaggConfig
+    from dynamo_tpu.launch import run_local
+
+    # Force the chunked TCP wire path (the phase-span source): disable the
+    # same-process device shortcut and the cross-process device pull.
+    monkeypatch.setattr(device_transfer.REGISTRY, "lookup", lambda addr: None)
+
+    async def no_pull(*a, **kw):
+        raise RuntimeError("pull disabled for wire-path test")
+
+    monkeypatch.setattr(prefill_worker, "send_pull_offer", no_pull)
+
+    disagg = DisaggConfig(max_local_prefill_length=24, min_remote_prefill_blocks=1)
+    handles = await run_local(
+        "test-tiny", port=0, num_workers=1, num_prefill_workers=1,
+        disagg=disagg, num_pages=64, max_batch_size=8,
+    )
+    base = f"http://127.0.0.1:{handles['port']}"
+    rid = "disagg-trace-e2e-1"
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {
+                "model": "test-tiny", "prompt": "r" * 48, "max_tokens": 4,
+                "temperature": 0, "request_id": rid,
+            }
+            traceparent = TraceContext.new().to_traceparent()
+            async with s.post(
+                base + "/v1/completions", json=body, headers={"traceparent": traceparent}
+            ) as r:
+                assert r.status == 200, await r.text()
+
+            # The prefill worker's final phase spans land just after the
+            # decode response unblocks — poll the timeline briefly.
+            needed = {"http_request", "remote_prefill", "prefill_exec", "kv_wire", "kv_scatter"}
+            doc = None
+            for _ in range(100):
+                async with s.get(f"{base}/debug/traces/{rid}") as r:
+                    if r.status == 200:
+                        doc = await r.json()
+                        if needed <= {sp["name"] for sp in doc["spans"]}:
+                            break
+                await asyncio.sleep(0.05)
+            assert doc is not None, "no timeline assembled"
+            names = {sp["name"] for sp in doc["spans"]}
+            assert needed <= names, names
+            # Every hop under ONE trace, rooted at the ingested traceparent.
+            assert doc["trace_ids"] == [traceparent.split("-")[1]]
+            assert "engine_queue_wait" in names  # decode-side admission span
+            statuses = {sp["status"] for sp in doc["spans"]}
+            assert statuses == {"ok"}
+
+            # Federation: the frontend /metrics render includes both engine
+            # registries' families with per-worker labels.
+            async with s.get(base + "/metrics") as r:
+                text = await r.text()
+            assert "dynamo_frontend_requests_total" in text
+            assert "dynamo_engine_step_decode_rows" in text
+            assert "dynamo_engine_prefill_queue_depth" in text
+            assert 'dynamo_kv_transfer_phase_seconds_count{phase="wire"' in text
+            assert text.count("# TYPE dynamo_engine_pages_total gauge") == 1
+            workers = {
+                line.split('worker="', 1)[1].split('"', 1)[0]
+                for line in text.splitlines()
+                if line.startswith("dynamo_engine_pages_total{")
+            }
+            assert len(workers) == 2, workers  # decode + prefill registries
+    finally:
+        await handles["http"].stop()
+        await handles["watcher"].close()
+        for svc in handles["services"]:
+            await svc.close()
+        await handles["runtime"].close()

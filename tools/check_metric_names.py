@@ -1,0 +1,71 @@
+"""Metric-name hygiene check: every Prometheus family the project exports
+must be ``dynamo_``-prefixed and globally unique across registries.
+
+The frontend registry (``frontend/metrics.py``) and the per-worker engine
+registry (``observability/metrics.py``) federate into one ``/metrics``
+document; a name collision between them would produce duplicate families
+that Prometheus rejects, and an unprefixed name would escape the project's
+namespace. Run directly (``python tools/check_metric_names.py``) or via the
+test suite (``tests/test_observability.py``).
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def collect_names() -> dict[str, list[str]]:
+    """Family names per registry. Importing here keeps the tool usable
+    before optional deps of unrelated modules are present."""
+    from dynamo_tpu.frontend.metrics import FrontendMetrics
+    from dynamo_tpu.observability.metrics import EngineMetrics
+
+    out: dict[str, list[str]] = {}
+    for label, registry in (
+        ("frontend", FrontendMetrics().registry),
+        ("engine", EngineMetrics(worker="check").registry),
+    ):
+        names: list[str] = []
+        for collector in registry._collector_to_names:  # noqa: SLF001 - no public enumeration API
+            for metric in collector.collect():
+                names.append(metric.name)
+        out[label] = sorted(names)
+    return out
+
+
+def check(names: dict[str, list[str]]) -> list[str]:
+    """Returns a list of violations (empty = clean)."""
+    problems: list[str] = []
+    seen: dict[str, str] = {}
+    for label, family_names in names.items():
+        for name in family_names:
+            if not name.startswith("dynamo_"):
+                problems.append(f"{label}: {name!r} is not dynamo_-prefixed")
+            prev = seen.get(name)
+            if prev is not None and prev != label:
+                problems.append(f"{name!r} exported by both {prev} and {label} registries")
+            seen.setdefault(name, label)
+        if len(set(family_names)) != len(family_names):
+            dupes = sorted({n for n in family_names if family_names.count(n) > 1})
+            problems.append(f"{label}: duplicate families {dupes}")
+    return problems
+
+
+def main() -> int:
+    names = collect_names()
+    problems = check(names)
+    total = sum(len(v) for v in names.values())
+    if problems:
+        for p in problems:
+            print(f"FAIL: {p}", file=sys.stderr)
+        return 1
+    print(f"ok: {total} metric families across {len(names)} registries, all dynamo_-prefixed and unique")
+    return 0
+
+
+if __name__ == "__main__":
+    import pathlib
+
+    # Direct CLI use from a checkout: make the repo importable.
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    sys.exit(main())
