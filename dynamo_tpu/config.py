@@ -124,9 +124,27 @@ class WorkerSettings:
     chunk_prefill_tokens: int = 512
 
 
+@dataclasses.dataclass
+class SloSettings:
+    """Latency targets the deployment is accountable to.
+
+    The north-star metric is goodput *under* these targets (tokens/sec from
+    requests that attained them), not raw throughput. Consumed by the
+    frontend's SLO accountant (``observability/slo.py``) and, via the
+    planner's percentile knob, by scaling decisions.
+    """
+
+    ttft_ms: float = 500.0  # p50 time-to-first-token target (north star)
+    itl_p99_ms: float = 50.0  # per-request p99 inter-token-latency target
+
+
 def load_runtime_settings(**kw) -> RuntimeSettings:
     return load_config(RuntimeSettings(), section="runtime", **kw)
 
 
 def load_worker_settings(**kw) -> WorkerSettings:
     return load_config(WorkerSettings(), section="worker", **kw)
+
+
+def load_slo_settings(**kw) -> SloSettings:
+    return load_config(SloSettings(), section="slo", **kw)

@@ -38,6 +38,7 @@ from __future__ import annotations
 import dataclasses
 import logging
 import threading
+import time
 from collections import deque
 from typing import Callable
 
@@ -46,6 +47,7 @@ import numpy as np
 from dynamo_tpu.engine.allocator import OutOfPagesError, PageAllocator
 from dynamo_tpu.engine.runner import ModelRunner, StepBatch
 from dynamo_tpu.engine.sequence import SeqStatus, Sequence
+from dynamo_tpu.observability.flight import CRASH, STEP, FlightRecorder
 from dynamo_tpu.protocols.common import EngineOutput, FinishReason, PreprocessedRequest
 from dynamo_tpu.protocols.kv import ForwardPassMetrics, KvCacheEvent
 from dynamo_tpu.runtime.engine import Context
@@ -127,6 +129,14 @@ class EngineCore:
         self._eos = set(config.eos_token_ids)
         self.num_preemptions = 0
         self.admission_rejections = 0  # requests refused at add_request intake
+        # Flight recorder: last-N-steps ring for postmortems. The compile
+        # tracker (when the runner has one — mock runners don't) sinks its
+        # first-execution events into the same ring, so a flight dump shows
+        # recompiles interleaved with the steps that triggered them.
+        self.flight = FlightRecorder()
+        _tracker = getattr(runner, "compile_tracker", None)
+        if _tracker is not None:
+            _tracker.bind_sink(self.flight.record)
         # Cumulative counters for the metrics plane.
         self._prompt_tokens_total = 0
         self._generated_tokens_total = 0
@@ -303,9 +313,72 @@ class EngineCore:
     # -- stepping ----------------------------------------------------------
 
     def step(self) -> list[tuple[Sequence, EngineOutput]]:
-        """Advance the engine by one batched forward; returns per-seq deltas."""
+        """Advance the engine by one batched forward; returns per-seq deltas.
+
+        Every step (and any raise out of one) lands a structured record in
+        ``self.flight``: the step's composition is captured per step rather
+        than last-write-wins, and a crash record snapshots the failing step's
+        context before the exception propagates to the service loop (which
+        dumps the ring to JSONL).
+        """
         with self.step_lock:
-            return self._step_locked()
+            prev_info = self.last_step_info
+            tracker = getattr(self.runner, "compile_tracker", None)
+            disp0 = tracker.dispatch_seconds_total if tracker is not None else 0.0
+            t0 = time.perf_counter()
+            try:
+                out = self._step_locked()
+            except Exception as exc:
+                self.flight.record(
+                    CRASH,
+                    error=type(exc).__name__,
+                    detail=str(exc)[:500],
+                    waiting=len(self.waiting),
+                    running=len(self.running),
+                    prefilling=len(self.prefilling),
+                    free_pages=self.allocator.num_free(),
+                    last_step_info=dict(self.last_step_info),
+                )
+                raise
+            wall_ms = (time.perf_counter() - t0) * 1e3
+            info = self.last_step_info
+            fresh = info is not prev_info  # _run_mixed built a new dict
+            if not fresh and not out and not self.running:
+                return out  # idle drain: nothing dispatched, nothing to record
+            if fresh:
+                decode_rows = int(info.get("decode_rows", 0))
+                chunk_rows = int(info.get("chunk_rows", 0))
+                chunk_tokens = int(info.get("chunk_tokens", 0))
+                kind = (
+                    "mixed" if decode_rows and chunk_rows
+                    else ("prefill" if chunk_rows else "decode")
+                )
+            else:
+                decode_rows = len(self.running)
+                chunk_rows = chunk_tokens = 0
+                kind = "decode" if self.running else "drain"
+            dispatch_ms = (
+                (tracker.dispatch_seconds_total - disp0) * 1e3 if tracker is not None else 0.0
+            )
+            self.flight.record(
+                STEP,
+                step_kind=kind,
+                decode_rows=decode_rows,
+                chunk_rows=chunk_rows,
+                chunk_tokens=chunk_tokens,
+                outputs=len(out),
+                waiting=len(self.waiting),
+                running=len(self.running),
+                prefilling=len(self.prefilling),
+                free_pages=self.allocator.num_free(),
+                preemptions=self.num_preemptions,
+                admission_rejections=self.admission_rejections,
+                mixed_steps=self.mixed_steps,
+                stall_violations=self.stall_violations,
+                wall_ms=round(wall_ms, 3),
+                dispatch_ms=round(dispatch_ms, 3),
+            )
+            return out
 
     def _step_locked(self) -> list[tuple[Sequence, EngineOutput]]:
         # Pending offloads must be read before allocate() can evict their

@@ -24,6 +24,7 @@ import numpy as np
 
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.models import llama
+from dynamo_tpu.observability.compile import CompileTracker, timed_dispatch
 from dynamo_tpu.ops.sampling import sample_tokens
 
 logger = logging.getLogger(__name__)
@@ -183,6 +184,11 @@ class ModelRunner:
         # Serializes every cache-donating/reading entry point (see _locked):
         # RLock so a locked method may call another (e.g. device transfer).
         self.io_lock = threading.RLock()
+        # First-execution-per-shape observer over every dispatch site: the
+        # bucket lattice bounds compiled programs, but it is data-dependent —
+        # this is how a production recompile becomes visible (metrics plane
+        # syncs counts(); the engine's flight recorder is its event sink).
+        self.compile_tracker = CompileTracker()
         # Padded page-counts whose gather/scatter kernels are compiled for
         # this runner (device-transfer warm-up bookkeeping — keyed on the
         # runner object itself, so id() reuse after GC can't skip a warm-up).
@@ -561,67 +567,77 @@ class ModelRunner:
         traffic pays nothing."""
         b_real = batch.batch_size
         padded = self._pad(batch)
-        if padded.mm_embeds is not None or padded.logit_mask is not None:
-            if self.mesh is not None:
+        impl = self._select_impl(padded) if self.mesh is not None else self.attn_impl
+        # Everything the jitted programs specialize on, post-padding: this is
+        # the compile cache key XLA sees (shapes + static args + arg presence).
+        dispatch_key = (
+            padded.tokens.shape[0], padded.tokens.shape[1],
+            padded.block_tables.shape[1], padded.history.shape[1],
+            lp_k, impl, self.mesh is not None,
+            padded.mm_embeds is not None, padded.logit_mask is not None,
+        )
+        with timed_dispatch(self.compile_tracker, "step", dispatch_key):
+            if padded.mm_embeds is not None or padded.logit_mask is not None:
+                if self.mesh is not None:
+                    from dynamo_tpu.parallel.sharding import batch_sharding
+
+                    def put(a):
+                        return jax.device_put(a, batch_sharding(self.mesh, a.ndim))
+                else:
+                    put = jnp.asarray
+
+                def opt(a):
+                    return None if a is None else put(a)
+
+                out = self._step_fn(
+                    self.params, self.k_cache, self.v_cache,
+                    put(padded.tokens), put(padded.positions),
+                    put(padded.block_tables), put(padded.slot_mapping),
+                    put(padded.last_token_index), put(padded.temperature),
+                    put(padded.top_k), put(padded.top_p),
+                    put(padded.seeds), put(padded.sample_steps),
+                    put(padded.freq_pen), put(padded.pres_pen),
+                    put(padded.pos_limit), put(padded.history),
+                    put(padded.mrope_delta),
+                    opt(padded.mm_embeds), opt(padded.mm_slot_offset), opt(padded.mm_counts),
+                    opt(padded.mrope_positions), opt(padded.logit_mask),
+                    impl=impl,
+                    lp_k=lp_k,
+                )
+            elif self.mesh is not None:
                 from dynamo_tpu.parallel.sharding import batch_sharding
 
                 def put(a):
                     return jax.device_put(a, batch_sharding(self.mesh, a.ndim))
+
+                out = self._step_fn(
+                    self.params, self.k_cache, self.v_cache,
+                    put(padded.tokens), put(padded.positions),
+                    put(padded.block_tables), put(padded.slot_mapping),
+                    put(padded.last_token_index), put(padded.temperature),
+                    put(padded.top_k), put(padded.top_p),
+                    put(padded.seeds), put(padded.sample_steps),
+                    put(padded.freq_pen), put(padded.pres_pen),
+                    put(padded.pos_limit), put(padded.history),
+                    put(padded.mrope_delta),
+                    impl=impl, lp_k=lp_k,
+                )
             else:
-                put = jnp.asarray
-
-            def opt(a):
-                return None if a is None else put(a)
-
-            out = self._step_fn(
-                self.params, self.k_cache, self.v_cache,
-                put(padded.tokens), put(padded.positions),
-                put(padded.block_tables), put(padded.slot_mapping),
-                put(padded.last_token_index), put(padded.temperature),
-                put(padded.top_k), put(padded.top_p),
-                put(padded.seeds), put(padded.sample_steps),
-                put(padded.freq_pen), put(padded.pres_pen),
-                put(padded.pos_limit), put(padded.history),
-                put(padded.mrope_delta),
-                opt(padded.mm_embeds), opt(padded.mm_slot_offset), opt(padded.mm_counts),
-                opt(padded.mrope_positions), opt(padded.logit_mask),
-                impl=self._select_impl(padded) if self.mesh is not None else self.attn_impl,
-                lp_k=lp_k,
-            )
-        elif self.mesh is not None:
-            from dynamo_tpu.parallel.sharding import batch_sharding
-
-            def put(a):
-                return jax.device_put(a, batch_sharding(self.mesh, a.ndim))
-
-            out = self._step_fn(
-                self.params, self.k_cache, self.v_cache,
-                put(padded.tokens), put(padded.positions),
-                put(padded.block_tables), put(padded.slot_mapping),
-                put(padded.last_token_index), put(padded.temperature),
-                put(padded.top_k), put(padded.top_p),
-                put(padded.seeds), put(padded.sample_steps),
-                put(padded.freq_pen), put(padded.pres_pen),
-                put(padded.pos_limit), put(padded.history),
-                put(padded.mrope_delta),
-                impl=self._select_impl(padded), lp_k=lp_k,
-            )
-        else:
-            b, t = padded.tokens.shape
-            out = self._step_packed_fn(
-                self.params, self.k_cache, self.v_cache, jnp.asarray(_pack(padded)),
-                b=b, t=t, n=padded.block_tables.shape[1], h=padded.history.shape[1],
-                lp_k=lp_k,
-            )
-        if lp_k:
-            next_tokens, self.k_cache, self.v_cache, chosen, top_ids, top_lps = out
-            return np.asarray(next_tokens)[:b_real], {
-                "logprob": np.asarray(chosen)[:b_real],
-                "top_ids": np.asarray(top_ids)[:b_real],
-                "top_lps": np.asarray(top_lps)[:b_real],
-            }
-        next_tokens, self.k_cache, self.v_cache = out
-        return np.asarray(next_tokens)[:b_real]
+                b, t = padded.tokens.shape
+                out = self._step_packed_fn(
+                    self.params, self.k_cache, self.v_cache, jnp.asarray(_pack(padded)),
+                    b=b, t=t, n=padded.block_tables.shape[1], h=padded.history.shape[1],
+                    lp_k=lp_k,
+                )
+            if lp_k:
+                next_tokens, self.k_cache, self.v_cache, chosen, top_ids, top_lps = out
+                return np.asarray(next_tokens)[:b_real], {
+                    "logprob": np.asarray(chosen)[:b_real],
+                    "top_ids": np.asarray(top_ids)[:b_real],
+                    "top_lps": np.asarray(top_lps)[:b_real],
+                }
+            next_tokens, self.k_cache, self.v_cache = out
+            return np.asarray(next_tokens)[:b_real]
 
     @_locked
     def multi_step(self, batch: StepBatch, num_steps: int) -> np.ndarray:
@@ -633,31 +649,37 @@ class ModelRunner:
         assert batch.tokens.shape[1] == 1, "multi_step is decode-only"
         b_real = batch.batch_size
         padded = self._pad(batch)
-        if self.mesh is not None:
-            from dynamo_tpu.parallel.sharding import batch_sharding
+        dispatch_key = (
+            padded.tokens.shape[0], padded.tokens.shape[1],
+            padded.block_tables.shape[1], padded.history.shape[1],
+            num_steps, self.mesh is not None,
+        )
+        with timed_dispatch(self.compile_tracker, "multi_step", dispatch_key):
+            if self.mesh is not None:
+                from dynamo_tpu.parallel.sharding import batch_sharding
 
-            def put(a):
-                return jax.device_put(a, batch_sharding(self.mesh, a.ndim))
+                def put(a):
+                    return jax.device_put(a, batch_sharding(self.mesh, a.ndim))
 
-            toks, self.k_cache, self.v_cache = self._multi_step_fn(
-                self.params, self.k_cache, self.v_cache,
-                put(padded.tokens[:, 0]), put(padded.positions[:, 0]),
-                put(padded.block_tables), put(padded.temperature),
-                put(padded.top_k), put(padded.top_p),
-                put(padded.seeds), put(padded.sample_steps),
-                put(padded.freq_pen), put(padded.pres_pen),
-                put(padded.pos_limit), put(padded.history),
-                put(padded.mrope_delta),
-                num_steps=num_steps,
-            )
-        else:
-            b, t = padded.tokens.shape
-            toks, self.k_cache, self.v_cache = self._multi_step_packed_fn(
-                self.params, self.k_cache, self.v_cache, jnp.asarray(_pack(padded)),
-                b=b, t=t, n=padded.block_tables.shape[1], h=padded.history.shape[1],
-                num_steps=num_steps,
-            )
-        return np.asarray(toks).T[:b_real]  # [B, num_steps]
+                toks, self.k_cache, self.v_cache = self._multi_step_fn(
+                    self.params, self.k_cache, self.v_cache,
+                    put(padded.tokens[:, 0]), put(padded.positions[:, 0]),
+                    put(padded.block_tables), put(padded.temperature),
+                    put(padded.top_k), put(padded.top_p),
+                    put(padded.seeds), put(padded.sample_steps),
+                    put(padded.freq_pen), put(padded.pres_pen),
+                    put(padded.pos_limit), put(padded.history),
+                    put(padded.mrope_delta),
+                    num_steps=num_steps,
+                )
+            else:
+                b, t = padded.tokens.shape
+                toks, self.k_cache, self.v_cache = self._multi_step_packed_fn(
+                    self.params, self.k_cache, self.v_cache, jnp.asarray(_pack(padded)),
+                    b=b, t=t, n=padded.block_tables.shape[1], h=padded.history.shape[1],
+                    num_steps=num_steps,
+                )
+            return np.asarray(toks).T[:b_real]  # [B, num_steps]
 
     @_locked
     def multi_step_async(self, batch: StepBatch, num_steps: int, *, chain: bool = False) -> "DeviceTokens":
@@ -678,19 +700,22 @@ class ModelRunner:
         n = padded.block_tables.shape[1]
         h = padded.history.shape[1]
         packed = jnp.asarray(_pack(padded))
-        if chain:
-            assert self._chain_tokens is not None and self._chain_tokens.shape[0] == b, (
-                "chained burst requires a previous burst with identical padded batch"
-            )
-            toks, self.k_cache, self.v_cache = self._multi_step_chained_fn(
-                self.params, self.k_cache, self.v_cache, packed, self._chain_tokens,
-                b=b, t=t, n=n, h=h, num_steps=num_steps,
-            )
-        else:
-            toks, self.k_cache, self.v_cache = self._multi_step_packed_fn(
-                self.params, self.k_cache, self.v_cache, packed,
-                b=b, t=t, n=n, h=h, num_steps=num_steps,
-            )
+        with timed_dispatch(
+            self.compile_tracker, "multi_step_async", (b, t, n, h, num_steps, chain)
+        ):
+            if chain:
+                assert self._chain_tokens is not None and self._chain_tokens.shape[0] == b, (
+                    "chained burst requires a previous burst with identical padded batch"
+                )
+                toks, self.k_cache, self.v_cache = self._multi_step_chained_fn(
+                    self.params, self.k_cache, self.v_cache, packed, self._chain_tokens,
+                    b=b, t=t, n=n, h=h, num_steps=num_steps,
+                )
+            else:
+                toks, self.k_cache, self.v_cache = self._multi_step_packed_fn(
+                    self.params, self.k_cache, self.v_cache, packed,
+                    b=b, t=t, n=n, h=h, num_steps=num_steps,
+                )
         self._chain_tokens = toks[num_steps - 1]
         try:  # start the device->host DMA early; overlaps the next burst
             toks.copy_to_host_async()

@@ -141,6 +141,13 @@ class JaxEngineService(AsyncEngine[Any, dict]):
                 outputs = await loop.run_in_executor(None, self.core.step)
             except Exception:
                 logger.exception("engine step failed; failing all in-flight streams")
+                flight = getattr(self.core, "flight", None)
+                if flight is not None:
+                    try:
+                        path = flight.dump_jsonl(reason="engine_step_failure")
+                        logger.error("flight recorder dumped to %s", path)
+                    except Exception:
+                        logger.exception("flight recorder dump failed")
                 self._fail_all_streams()
                 continue
             self._route(outputs)

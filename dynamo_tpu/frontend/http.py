@@ -81,6 +81,7 @@ class HttpService:
                 web.get("/live", self.live),
                 web.get("/metrics", self.prometheus),
                 web.get("/debug/traces/{request_id}", self.debug_traces),
+                web.get("/debug/flight/{worker}", self.debug_flight),
                 web.post("/clear_kv_blocks", self.clear_kv_blocks),
                 web.post("/engine/profile", self.engine_profile),
             ]
@@ -393,6 +394,41 @@ class HttpService:
                 {"request_id": rid, "trace_ids": [], "span_count": 0, "spans": []}, status=404
             )
         return web.json_response(assemble_timeline(rid, unique))
+
+    async def debug_flight(self, request: web.Request) -> web.Response:
+        """One worker's engine flight ring (ordered per-step records).
+
+        ``{worker}`` is the engine worker id (``all`` fans out to every
+        worker); ``?last=N`` bounds the tail, ``?kind=step|compile|crash``
+        filters by record kind.
+        """
+        if self.telemetry is None:
+            return web.json_response(
+                {"error": "no worker telemetry wired on this frontend"}, status=404
+            )
+        worker = request.match_info["worker"]
+        last = request.query.get("last")
+        try:
+            rings = await self.telemetry.collect_flight(
+                worker=worker,
+                last=int(last) if last else None,
+                kind=request.query.get("kind"),
+            )
+        except Exception:
+            logger.exception("flight fan-out failed")
+            return web.json_response({"error": "flight fan-out failed"}, status=502)
+        if not rings:
+            return web.json_response(
+                {"error": f"no flight records for worker {worker!r}"}, status=404
+            )
+        return web.json_response(
+            {
+                "worker": worker,
+                "workers": {
+                    wid: {"count": len(recs), "records": recs} for wid, recs in rings.items()
+                },
+            }
+        )
 
     async def engine_profile(self, request: web.Request) -> web.Response:
         """On-demand device trace: POST {"seconds": 3, "dir": "/tmp/trace"}.

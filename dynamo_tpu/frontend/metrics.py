@@ -12,6 +12,8 @@ import time
 
 from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram, generate_latest
 
+from dynamo_tpu.observability.slo import SloAccountant
+
 _DURATION_BUCKETS = (0.005, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
 _QUEUE_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 5.0)
 _TTFT_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.2, 0.3, 0.5, 0.75, 1.0, 2.0, 5.0, 10.0)
@@ -68,6 +70,44 @@ class FrontendMetrics:
             "Compiled paged-attention programs that fell back to the XLA gather formulation",
             ["signature"], registry=self.registry,
         )
+        # SLO-conditioned accounting: the north star is goodput (tokens from
+        # requests that attained the latency targets), not raw throughput.
+        # Source of truth is the SloAccountant; counters/gauges are synced on
+        # scrape so nothing is double-booked.
+        self.slo = SloAccountant()
+        self.output_tokens = Gauge(
+            "dynamo_output_tokens_total",
+            "Output tokens generated across finished requests (SLO-blind)",
+            registry=self.registry,
+        )
+        self.goodput_tokens = Gauge(
+            "dynamo_goodput_tokens_total",
+            "Output tokens from finished requests that attained the SLO "
+            "(TTFT and per-request p99 ITL within slo.ttft_ms / slo.itl_p99_ms)",
+            registry=self.registry,
+        )
+        self.slo_requests = Counter(
+            "dynamo_slo_requests_total",
+            "Finished requests classified against the SLO targets",
+            ["model", "outcome"], registry=self.registry,
+        )
+        self.slo_attainment = Gauge(
+            "dynamo_slo_attainment_ratio",
+            "Fraction of finished requests that attained the SLO (cumulative)",
+            registry=self.registry,
+        )
+        # Streaming P^2 quantiles — no fixed-bucket distortion at the 500 ms
+        # target the way a histogram boundary would introduce.
+        self.ttft_quantile = Gauge(
+            "dynamo_frontend_ttft_quantile_seconds",
+            "Streaming TTFT quantile estimate (P^2, deployment-wide)",
+            ["quantile"], registry=self.registry,
+        )
+        self.itl_quantile = Gauge(
+            "dynamo_frontend_itl_quantile_seconds",
+            "Streaming inter-token-latency quantile estimate (P^2, deployment-wide)",
+            ["quantile"], registry=self.registry,
+        )
 
     def render(self) -> bytes:
         from dynamo_tpu.ops.pallas_paged import fallback_snapshot
@@ -78,6 +118,13 @@ class FrontendMetrics:
         self.kernel_fallbacks.clear()
         for sig, n in fallback_snapshot().items():
             self.kernel_fallbacks.labels(sig).set(n)
+        self.output_tokens.set(self.slo.output_tokens_total)
+        self.goodput_tokens.set(self.slo.goodput_tokens_total)
+        self.slo_attainment.set(self.slo.attainment())
+        for q, v in self.slo.ttft.snapshot().items():
+            self.ttft_quantile.labels(f"p{int(q * 100)}").set(v)
+        for q, v in self.slo.itl.snapshot().items():
+            self.itl_quantile.labels(f"p{int(q * 100)}").set(v)
         return generate_latest(self.registry)
 
     def sync_staleness(self, staleness: dict[int, float]) -> None:
@@ -102,6 +149,12 @@ class RequestTracker:
         self._last_token: float | None = None
         self._dispatched = False
         self.status = "success"
+        # Per-request latency profile for SLO classification at __exit__:
+        # attainment needs this request's own TTFT and ITL-gap tail, not the
+        # deployment aggregates.
+        self._ttft: float | None = None
+        self._gaps: list[float] = []
+        self._tokens = 0
 
     def __enter__(self) -> "RequestTracker":
         self._start = time.monotonic()
@@ -114,6 +167,15 @@ class RequestTracker:
         self.m.inflight.labels(self.model).dec()
         self.m.requests.labels(self.model, self.endpoint, self.status).inc()
         self.m.duration.labels(self.model).observe(time.monotonic() - self._start)
+        if self._ttft is not None:  # token-producing request: classify vs SLO
+            verdict = self.m.slo.account(
+                ttft_s=self._ttft,
+                itl_gaps=self._gaps,
+                output_tokens=self._tokens,
+                ok=self.status == "success",
+            )
+            met = verdict.met and self.status == "success"
+            self.m.slo_requests.labels(self.model, "met" if met else "missed").inc()
 
     def on_dispatch(self) -> None:
         """The request is leaving the frontend for the engine pipeline."""
@@ -124,14 +186,20 @@ class RequestTracker:
     def on_token(self) -> None:
         now = time.monotonic()
         if self._last_token is None:
-            self.m.ttft.labels(self.model).observe(now - self._start)
+            self._ttft = now - self._start
+            self.m.ttft.labels(self.model).observe(self._ttft)
+            self.m.slo.observe_ttft(self._ttft)
         else:
-            self.m.itl.labels(self.model).observe(now - self._last_token)
+            gap = now - self._last_token
+            self.m.itl.labels(self.model).observe(gap)
+            self.m.slo.observe_itl(gap)
+            self._gaps.append(gap)
         self._last_token = now
 
     def on_usage(self, prompt_tokens: int | None, output_tokens: int, cached_tokens: int | None) -> None:
         if prompt_tokens:
             self.m.input_len.labels(self.model).observe(prompt_tokens)
         self.m.output_len.labels(self.model).observe(output_tokens)
+        self._tokens = max(self._tokens, int(output_tokens or 0))
         if cached_tokens:
             self.m.cached_tokens.labels(self.model).inc(cached_tokens)

@@ -376,8 +376,10 @@ async def _serve_worker_telemetry(
     """
     from dynamo_tpu.observability import (
         DEBUG_TRACES_ENDPOINT,
+        FLIGHT_ENDPOINT,
         METRICS_SCRAPE_ENDPOINT,
         EngineMetrics,
+        FlightQueryService,
         MetricsScrapeService,
         SpanQueryService,
     )
@@ -399,11 +401,16 @@ async def _serve_worker_telemetry(
     await component.endpoint(METRICS_SCRAPE_ENDPOINT).serve(
         MetricsScrapeService(metrics), metadata=metadata, lease=lease
     )
+    flight = getattr(service.core, "flight", None)
+    if flight is not None:
+        await component.endpoint(FLIGHT_ENDPOINT).serve(
+            FlightQueryService(flight, worker=worker_id), metadata=metadata, lease=lease
+        )
     port_spec = os.environ.get("DYN_WORKER_HTTP_PORT")
     if port_spec is not None:
         from dynamo_tpu.observability.http import WorkerDebugServer
 
-        debug = WorkerDebugServer(metrics)
+        debug = WorkerDebugServer(metrics, flight=flight)
         await debug.start(port=int(port_spec))
         service.aux.append(debug)
     return metrics

@@ -14,24 +14,36 @@ Two surfaces over the same worker internals:
   ``/debug/traces/{request_id}``) for scraping workers directly.
 """
 
+from dynamo_tpu.observability.compile import CompileTracker, timed_dispatch
+from dynamo_tpu.observability.flight import FlightRecorder
 from dynamo_tpu.observability.metrics import EngineMetrics, federate_text, observe_kv_phase
 from dynamo_tpu.observability.service import (
     DEBUG_TRACES_ENDPOINT,
+    FLIGHT_ENDPOINT,
     METRICS_SCRAPE_ENDPOINT,
+    FlightQueryService,
     MetricsScrapeService,
     SpanQueryService,
     WorkerTelemetryClient,
     assemble_timeline,
 )
+from dynamo_tpu.observability.slo import SloAccountant, StreamingQuantiles
 
 __all__ = [
+    "CompileTracker",
+    "timed_dispatch",
+    "FlightRecorder",
     "EngineMetrics",
     "federate_text",
     "observe_kv_phase",
     "DEBUG_TRACES_ENDPOINT",
+    "FLIGHT_ENDPOINT",
     "METRICS_SCRAPE_ENDPOINT",
+    "FlightQueryService",
     "MetricsScrapeService",
     "SpanQueryService",
     "WorkerTelemetryClient",
     "assemble_timeline",
+    "SloAccountant",
+    "StreamingQuantiles",
 ]

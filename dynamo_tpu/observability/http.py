@@ -21,8 +21,9 @@ WORKER_HTTP_ENV = "DYN_WORKER_HTTP_PORT"
 
 
 class WorkerDebugServer:
-    def __init__(self, metrics: EngineMetrics) -> None:
+    def __init__(self, metrics: EngineMetrics, *, flight=None) -> None:
         self.metrics = metrics
+        self.flight = flight  # this worker's FlightRecorder, if it has one
         self._runner: web.AppRunner | None = None
         self.port: int | None = None
         self.app = web.Application()
@@ -30,6 +31,7 @@ class WorkerDebugServer:
             [
                 web.get("/metrics", self.prometheus),
                 web.get("/debug/traces/{request_id}", self.traces),
+                web.get("/debug/flight", self.flight_dump),
             ]
         )
 
@@ -45,6 +47,15 @@ class WorkerDebugServer:
         if not spans:
             spans = SPANS.query(trace_id=rid)  # accept a trace_id too
         return web.json_response(assemble_timeline(rid, spans))
+
+    async def flight_dump(self, request: web.Request) -> web.Response:
+        if self.flight is None:
+            return web.json_response({"error": "no flight recorder on this worker"}, status=404)
+        last = request.query.get("last")
+        records = self.flight.snapshot(
+            last=int(last) if last else None, kind=request.query.get("kind")
+        )
+        return web.json_response({"records": records, "count": len(records)})
 
     async def start(self, host: str = "0.0.0.0", port: int = 0) -> int:
         self._runner = web.AppRunner(self.app, access_log=None)

@@ -82,6 +82,17 @@ class EngineMetrics:
         # Admission / scheduler occupancy.
         self.requests_waiting = gauge(f"{ns}_requests_waiting", "Admitted requests not yet scheduled")
         self.requests_running = gauge(f"{ns}_requests_running", "Sequences in prefill or decode")
+        # XLA compile observability: first executions per (program, reason),
+        # synced from the runner's CompileTracker on scrape. Labelled gauge
+        # (not Counter) for the same no-double-booking reason as above; the
+        # label set is cleared and re-set per scrape so stale pairs drop out.
+        self._recompiles = Gauge(
+            "dynamo_engine_recompiles_total",
+            "First executions of a padded shape bucket per jitted program "
+            "(reason: new_shape = compiled on the serving path, warm_cache = "
+            "first-seen but fast, e.g. persistent-cache hit)",
+            ["worker", "program", "reason"], registry=self.registry,
+        )
         self.prefill_queue_depth = gauge(
             f"{ns}_prefill_queue_depth", "Unclaimed tasks in the distributed prefill queue"
         )
@@ -142,6 +153,11 @@ class EngineMetrics:
         self.cache_hit_ratio.set(stats.hit_rate)
         self.requests_waiting.set(len(getattr(core, "waiting", ())))
         self.requests_running.set(len(getattr(core, "running", ())) + len(getattr(core, "prefilling", ())))
+        tracker = getattr(getattr(core, "runner", None), "compile_tracker", None)
+        if tracker is not None:
+            self._recompiles.clear()
+            for (program, reason), n in tracker.counts().items():
+                self._recompiles.labels(self.worker, program, reason).set(n)
 
     def _sync_transfer(self) -> None:
         if self._transfer is None:

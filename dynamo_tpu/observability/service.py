@@ -30,6 +30,7 @@ logger = logging.getLogger(__name__)
 
 DEBUG_TRACES_ENDPOINT = "debug_traces"
 METRICS_SCRAPE_ENDPOINT = "metrics_scrape"
+FLIGHT_ENDPOINT = "debug_flight"
 
 _FANOUT_TIMEOUT = 5.0
 
@@ -58,6 +59,28 @@ class MetricsScrapeService(AsyncEngine[Any, dict]):
 
     async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
         yield {"text": (await self.metrics.render()).decode()}
+
+
+class FlightQueryService(AsyncEngine[Any, dict]):
+    """Answers ``{"last"?: N, "kind"?: str}`` with this worker's flight ring.
+
+    ``worker`` is the engine worker id the frontend addresses
+    (``GET /debug/flight/{worker}``) — the client fans out to every flight
+    endpoint and filters on this field, so no instance-id mapping is needed.
+    """
+
+    def __init__(self, flight, *, worker: str = "") -> None:
+        self.flight = flight
+        self.worker = worker or f"pid-{os.getpid()}"
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
+        request = request or {}
+        last = request.get("last")
+        records = self.flight.snapshot(
+            last=int(last) if last is not None else None,
+            kind=request.get("kind"),
+        )
+        yield {"worker": self.worker, "records": records}
 
 
 class WorkerTelemetryClient:
@@ -117,6 +140,28 @@ class WorkerTelemetryClient:
                 s.setdefault("host", res.get("host", f"{inst.instance_id:x}"))
                 spans.append(s)
         return spans
+
+    async def collect_flight(
+        self, *, worker: str | None = None, last: int | None = None, kind: str | None = None
+    ) -> dict[str, list[dict]]:
+        """Flight rings by worker id; ``worker`` filters to one (or ``"all"``/
+        ``None`` for every worker)."""
+        targets = await self._targets(FLIGHT_ENDPOINT)
+        request: dict = {}
+        if last is not None:
+            request["last"] = last
+        if kind is not None:
+            request["kind"] = kind
+        results = await asyncio.gather(*(self._ask(t, request) for t in targets))
+        out: dict[str, list[dict]] = {}
+        for inst, res in zip(targets, results):
+            if res is None:
+                continue
+            wid = str(res.get("worker", f"{inst.instance_id:x}"))
+            if worker not in (None, "all") and wid != worker:
+                continue
+            out[wid] = res.get("records", [])
+        return out
 
     async def collect_metrics_texts(self) -> list[bytes]:
         """Every worker's rendered registry (for /metrics federation)."""

@@ -96,6 +96,12 @@ class PlannerConfig:
     target_utilization: float = 0.7  # load mode: keep fleets at this fraction
     ttft_slo_seconds: float = 0.5  # sla mode
     itl_slo_seconds: float = 0.05
+    # Which latency percentile the SLA mode sizes against: 50 (median
+    # curves), or 95/99 (the profiler's tail curves, when present — an SLO
+    # stated on the tail needs tail-aware sizing; median curves hide the
+    # saturation knee). Falls back to the median curve when the requested
+    # tail curve wasn't profiled.
+    slo_percentile: int = 50
     scale_down_headroom: float = 0.3  # hysteresis: only shrink below (target - headroom)
     interval_seconds: float = 10.0
     # Load model: "linear" (ramps), "seasonal" (repeating peaks; falls back
@@ -148,8 +154,15 @@ class Planner:
         decode_tps = self._decode_pred.predict()
 
         if c.mode == "sla":
-            decode = self._smallest_meeting_slo(decode_tps, p.decode_tokens_per_sec, p.itl_at, c.itl_slo_seconds, c.max_workers)
-            prefill = self._smallest_meeting_slo(prefill_tps, p.prefill_tokens_per_sec, p.ttft_at, c.ttft_slo_seconds, c.max_prefill_workers)
+            pct = c.slo_percentile
+            decode = self._smallest_meeting_slo(
+                decode_tps, p.decode_tokens_per_sec,
+                lambda f: p.itl_at(f, pct=pct), c.itl_slo_seconds, c.max_workers,
+            )
+            prefill = self._smallest_meeting_slo(
+                prefill_tps, p.prefill_tokens_per_sec,
+                lambda f: p.ttft_at(f, pct=pct), c.ttft_slo_seconds, c.max_prefill_workers,
+            )
         else:
             decode = -(-decode_tps // max(p.decode_tokens_per_sec * c.target_utilization, 1e-6))
             prefill = -(-prefill_tps // max(p.prefill_tokens_per_sec * c.target_utilization, 1e-6))

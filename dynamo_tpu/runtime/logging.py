@@ -51,6 +51,30 @@ class JsonlFormatter(logging.Formatter):
         return json.dumps(doc, default=str)
 
 
+class TraceContextFilter(logging.Filter):
+    """Stamp the active span's trace identity onto every log record.
+
+    Any log line emitted while a :class:`~dynamo_tpu.tracing.Span` is open in
+    the current task/thread gains ``trace_id``/``span_id`` fields (flattened
+    into JSONL output), so engine log lines correlate with
+    ``GET /debug/traces/{id}`` timelines without grepping timestamps.
+    Records that already carry a ``trace_id`` (spans log their own) keep it.
+    """
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        if not hasattr(record, "trace_id"):
+            try:
+                from dynamo_tpu.tracing import current_span
+
+                span = current_span()
+            except Exception:
+                span = None
+            if span is not None:
+                record.trace_id = span.trace_id
+                record.span_id = span.span_id
+        return True
+
+
 class TextFormatter(logging.Formatter):
     def __init__(self, *, ansi: bool = True, local_tz: bool = False) -> None:
         super().__init__("%(asctime)s %(levelname)s %(name)s %(message)s")
@@ -88,6 +112,7 @@ def setup_logging(
     level = (level or env.get("DYN_LOG_LEVEL", "INFO")).upper()
 
     handler = logging.StreamHandler(stream or sys.stderr)
+    handler.addFilter(TraceContextFilter())
     if jsonl:
         handler.setFormatter(JsonlFormatter(local_tz=local_tz))
     else:

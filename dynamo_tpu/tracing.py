@@ -28,6 +28,7 @@ Two complementary planes, mirroring the reference's tracing stack
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import logging
 import os
 import re
@@ -235,6 +236,21 @@ def _buffer_capacity() -> int:
 #: The per-process span ring every finished Span records into.
 SPANS = SpanBuffer(_buffer_capacity())
 
+#: The span currently open in this task/thread (contextvar: async-safe).
+_CURRENT_SPAN: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
+    "dynamo_current_span", default=None
+)
+
+
+def current_span() -> "Span | None":
+    """The innermost open Span in the current task/thread, if any.
+
+    Lets unrelated code — notably ``runtime/logging.py``'s log-record filter —
+    stamp trace_id/span_id onto whatever happens inside a span without the
+    span being threaded through call signatures.
+    """
+    return _CURRENT_SPAN.get()
+
 
 class Span:
     """One timed phase of one request, logged as structured JSONL.
@@ -257,6 +273,7 @@ class Span:
     __slots__ = (
         "name", "fields", "t0", "t_wall",
         "trace_id", "span_id", "parent_id", "status", "error_type",
+        "_cv_token",
     )
 
     def __init__(self, name: str, *, trace: TraceContext | None = None, **fields: Any) -> None:
@@ -273,6 +290,7 @@ class Span:
         self.error_type: str | None = None
         self.t0 = 0.0
         self.t_wall = 0.0
+        self._cv_token: contextvars.Token | None = None
 
     @property
     def context(self) -> TraceContext:
@@ -282,9 +300,18 @@ class Span:
     def __enter__(self) -> "Span":
         self.t0 = time.perf_counter()
         self.t_wall = time.time()
+        self._cv_token = _CURRENT_SPAN.set(self)
         return self
 
     def __exit__(self, exc_type, _exc, _tb) -> None:
+        if self._cv_token is not None:
+            try:
+                _CURRENT_SPAN.reset(self._cv_token)
+            except ValueError:
+                # Exited in a different context than entered (the engine
+                # service holds spans open across awaits); just clear.
+                _CURRENT_SPAN.set(None)
+            self._cv_token = None
         ms = (time.perf_counter() - self.t0) * 1e3
         if exc_type is not None:
             self.status = "error"

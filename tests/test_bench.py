@@ -77,6 +77,31 @@ def test_stall_probe_structure(monkeypatch):
     assert "stall_ratio_baseline_over_chunked" in out
 
 
+def test_bench_doc_goodput_keys():
+    """build_doc's top-level contract (ISSUE 4): the SLO-conditioned goodput
+    headline keys are stable, sourced from the headline (llama-3.2-1b)
+    config, and default to 0.0 when the suite produced nothing usable."""
+    import bench
+
+    configs = [
+        {"preset": "test-tiny", "tok_per_sec": 5.0,
+         "slo_ttft_attainment": 1.0, "goodput_tokens_per_s_at_slo": 5.0},
+        {"preset": "llama-3.2-1b", "tok_per_sec": 100.0, "slo_ttft_ms": 500.0,
+         "slo_ttft_attainment": 0.9, "goodput_tokens_per_s_at_slo": 90.0},
+    ]
+    doc = bench.build_doc(configs, pull={"skipped": True})
+    assert doc["goodput_tokens_per_s_at_slo"] == 90.0  # headline, not first
+    assert doc["slo_ttft_attainment"] == 0.9
+    assert doc["value"] == 100.0
+    assert doc["itl_p99_ms"] == 0.0  # stall probe absent: stable default
+    # An all-errors suite still emits the full key set.
+    empty = bench.build_doc([{"preset": "x", "error": "boom"}], pull={})
+    for key in ("value", "goodput_tokens_per_s_at_slo", "slo_ttft_attainment",
+                "itl_p99_ms", "max_decode_stall_ms"):
+        assert key in empty
+        assert empty[key] == 0.0
+
+
 def test_synthesizer_prefix_structure():
     cfg = SyntheticConfig(num_requests=32, shared_prefix_len=16, num_groups=3,
                           group_prefix_len=8, unique_len=4, osl_mean=20, seed=7)

@@ -140,6 +140,11 @@ class _FakeCore:
             total_pages=64, free_pages=16, cached_pages=8, active_pages=40, hit_rate=0.5
         )
     )
+    runner = SimpleNamespace(
+        compile_tracker=SimpleNamespace(
+            counts=lambda: {("step", "new_shape"): 2, ("multi_step", "warm_cache"): 1}
+        )
+    )
 
 
 class _FakeTransfer:
@@ -165,6 +170,7 @@ EXPECTED_ENGINE_FAMILIES = {
     "dynamo_engine_prefix_cache_hit_ratio",
     "dynamo_engine_requests_waiting",
     "dynamo_engine_requests_running",
+    "dynamo_engine_recompiles_total",
     "dynamo_engine_prefill_queue_depth",
     "dynamo_kv_transfer_blocks_total",
     "dynamo_kv_transfer_bytes_total",
@@ -212,6 +218,9 @@ async def test_engine_metrics_names_labels_and_values():
     assert 'dynamo_engine_page_fragmentation_ratio{worker="w1"} 0.3333333333333333' in text
     assert 'dynamo_engine_requests_running{worker="w1"} 3.0' in text
     assert 'dynamo_engine_prefill_queue_depth{worker="w1"} 5.0' in text
+    # Recompile counts synced from the runner's CompileTracker.
+    assert 'dynamo_engine_recompiles_total{program="step",reason="new_shape",worker="w1"} 2.0' in text
+    assert 'dynamo_engine_recompiles_total{program="multi_step",reason="warm_cache",worker="w1"} 1.0' in text
     assert 'dynamo_kv_transfer_blocks_total{worker="w1"} 12.0' in text
     for phase in KV_PHASES:
         assert f'dynamo_kv_transfer_phase_seconds_count{{phase="{phase}",worker="w1"}} 1.0' in text
@@ -243,6 +252,11 @@ def test_metric_names_unique_and_prefixed():
     names = check_metric_names.collect_names()
     assert sum(len(v) for v in names.values()) > 20
     assert check_metric_names.check(names) == []
+    # The extended hygiene pass: non-empty HELP text and no name registered
+    # with conflicting label sets across registries (ISSUE 4 satellite).
+    families = check_metric_names.collect_families()
+    assert check_metric_names.check_families(families) == []
+    assert all(f["help"] for fams in families.values() for f in fams)
 
 
 # -- timeline assembly --------------------------------------------------------
@@ -388,13 +402,81 @@ async def test_disagg_request_yields_single_trace_timeline(monkeypatch):
             statuses = {sp["status"] for sp in doc["spans"]}
             assert statuses == {"ok"}
 
+            # Flight recorder (ISSUE 4): force a mixed step — hold one
+            # stream in decode while a second short prompt (below the local
+            # prefill threshold) is admitted, so its chunk rows fuse with
+            # the live decode rows in one dispatch.
+            async with s.post(
+                base + "/v1/completions",
+                json={"model": "test-tiny", "prompt": "s" * 8, "max_tokens": 48,
+                      "temperature": 0, "stream": True},
+            ) as r1:
+                assert r1.status == 200
+                await r1.content.readany()  # first chunk: decode is live
+                async with s.post(
+                    base + "/v1/completions",
+                    json={"model": "test-tiny", "prompt": "t" * 12, "max_tokens": 4,
+                          "temperature": 0},
+                ) as r2:
+                    assert r2.status == 200, await r2.text()
+                async for _ in r1.content:  # drain the stream to completion
+                    pass
+
+            flight_doc = None
+            records: list[dict] = []
+            for _ in range(100):
+                async with s.get(base + "/debug/flight/all") as r:
+                    if r.status == 200:
+                        flight_doc = await r.json()
+                        records = [
+                            rec
+                            for w in flight_doc["workers"].values()
+                            for rec in w["records"]
+                        ]
+                        if any(rec["kind"] == "compile" for rec in records) and any(
+                            rec.get("step_kind") == "mixed" for rec in records
+                        ):
+                            break
+                await asyncio.sleep(0.05)
+            assert flight_doc is not None, "no flight rings collected"
+            kinds = {rec["kind"] for rec in records}
+            assert "step" in kinds and "compile" in kinds, kinds
+            assert any(rec.get("step_kind") == "mixed" for rec in records), (
+                sorted({rec.get("step_kind") for rec in records if rec["kind"] == "step"})
+            )
+            # Records are ordered (monotonic seq) within each worker's ring,
+            # and step records carry the per-step composition fields.
+            for w in flight_doc["workers"].values():
+                seqs = [rec["seq"] for rec in w["records"]]
+                assert seqs == sorted(seqs)
+            step_rec = next(rec for rec in records if rec["kind"] == "step")
+            for key in ("decode_rows", "chunk_tokens", "free_pages", "wall_ms", "preemptions"):
+                assert key in step_rec, step_rec
+            compile_rec = next(rec for rec in records if rec["kind"] == "compile")
+            assert compile_rec["program"] and compile_rec["reason"] in ("new_shape", "warm_cache")
+            # Single-worker addressing: {worker} narrows the fan-out.
+            one = next(iter(flight_doc["workers"]))
+            async with s.get(f"{base}/debug/flight/{one}?last=5&kind=step") as r:
+                assert r.status == 200
+                narrowed = await r.json()
+            assert set(narrowed["workers"]) == {one}
+            assert len(narrowed["workers"][one]["records"]) <= 5
+            assert all(
+                rec["kind"] == "step" for rec in narrowed["workers"][one]["records"]
+            )
+
             # Federation: the frontend /metrics render includes both engine
-            # registries' families with per-worker labels.
+            # registries' families with per-worker labels, plus the
+            # SLO-conditioned goodput accounting (ISSUE 4).
             async with s.get(base + "/metrics") as r:
                 text = await r.text()
             assert "dynamo_frontend_requests_total" in text
             assert "dynamo_engine_step_decode_rows" in text
             assert "dynamo_engine_prefill_queue_depth" in text
+            assert "dynamo_goodput_tokens_total" in text
+            assert "dynamo_output_tokens_total" in text
+            assert "dynamo_engine_recompiles_total" in text
+            assert "dynamo_frontend_ttft_quantile_seconds" in text
             assert 'dynamo_kv_transfer_phase_seconds_count{phase="wire"' in text
             assert text.count("# TYPE dynamo_engine_pages_total gauge") == 1
             workers = {

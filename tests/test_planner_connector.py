@@ -70,6 +70,53 @@ def test_make_predictor_selection():
         make_predictor("prophet")
 
 
+def test_predictor_observe_predict_roundtrip():
+    """Every registered predictor converges on a steady-state stream: after a
+    constant-rate window, predict() returns that rate (the load model must
+    not distort the easy case, whatever its shape machinery)."""
+    from dynamo_tpu.planner.predictor import PREDICTORS, make_predictor
+
+    for name in PREDICTORS:
+        p = make_predictor(name)
+        assert p.predict() == 0.0, f"{name}: cold predictor must predict 0"
+        for _ in range(16):
+            p.observe(100.0)
+        assert p.predict() == pytest.approx(100.0), name
+
+
+def test_planner_slo_percentile_changes_decision():
+    """The SLA mode's slo_percentile knob (ISSUE 4): with divergent
+    median/p99 ITL surfaces, sizing against p99 buys more workers than
+    sizing against the median, and an absent tail curve falls back to the
+    median curve unchanged."""
+    from dynamo_tpu.planner.core import Planner, PlannerConfig, WorkerProfile
+    from dynamo_tpu.protocols.kv import ForwardPassMetrics
+
+    # Median ITL stays comfortably under the SLO at any load; p99 blows
+    # through it past 30% load (the saturation knee medians hide).
+    profile = WorkerProfile(
+        decode_tokens_per_sec=100.0, prefill_tokens_per_sec=1e9,
+        itl_curve=[(0.0, 0.01), (1.0, 0.02)],
+        itl_p99_curve=[(0.0, 0.01), (0.3, 0.02), (1.0, 1.0)],
+    )
+
+    def decide(pct, prof=profile):
+        cfg = PlannerConfig(mode="sla", predictor="constant", slo_percentile=pct,
+                            itl_slo_seconds=0.05, min_workers=1, max_workers=8)
+        planner = Planner(cfg, prof)
+        planner.observe({1: ForwardPassMetrics(worker_id=1, generated_tokens_total=300)}, 1.0)
+        return planner.decide(disaggregated=False)
+
+    median = decide(50)
+    tail = decide(99)
+    assert median.decode_workers == 3, median  # 300 tok/s / 100 per worker
+    assert tail.decode_workers > median.decode_workers, (tail, median)
+    # No profiled p99 curve: pct=99 degrades to the median sizing.
+    flat = WorkerProfile(decode_tokens_per_sec=100.0, prefill_tokens_per_sec=1e9,
+                         itl_curve=[(0.0, 0.01), (1.0, 0.02)])
+    assert decide(99, flat).decode_workers == median.decode_workers
+
+
 def test_planner_scales_up_ahead_of_repeating_peak():
     """Planner with predictor='seasonal' raises the decode fleet one tick
     BEFORE the recurring peak; 'linear' at the same trough does not."""

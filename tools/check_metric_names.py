@@ -1,12 +1,16 @@
 """Metric-name hygiene check: every Prometheus family the project exports
-must be ``dynamo_``-prefixed and globally unique across registries.
+must be ``dynamo_``-prefixed, globally unique across registries, carry
+non-empty HELP text, and never reuse a name with a different label set.
 
 The frontend registry (``frontend/metrics.py``) and the per-worker engine
 registry (``observability/metrics.py``) federate into one ``/metrics``
-document; a name collision between them would produce duplicate families
-that Prometheus rejects, and an unprefixed name would escape the project's
-namespace. Run directly (``python tools/check_metric_names.py``) or via the
-test suite (``tests/test_observability.py``).
+document (there is no separate router registry — the router-prefixed family
+lives in the frontend's); a name collision between them would produce
+duplicate families that Prometheus rejects, an unprefixed name would escape
+the project's namespace, and a same-name/different-labels family would make
+federated samples unjoinable. Run directly
+(``python tools/check_metric_names.py``) or via the test suite
+(``tests/test_observability.py``).
 """
 
 from __future__ import annotations
@@ -14,27 +18,45 @@ from __future__ import annotations
 import sys
 
 
-def collect_names() -> dict[str, list[str]]:
-    """Family names per registry. Importing here keeps the tool usable
-    before optional deps of unrelated modules are present."""
+def collect_families() -> dict[str, list[dict]]:
+    """Family descriptors per registry: name, HELP text, label names.
+
+    Importing here keeps the tool usable before optional deps of unrelated
+    modules are present.
+    """
     from dynamo_tpu.frontend.metrics import FrontendMetrics
     from dynamo_tpu.observability.metrics import EngineMetrics
 
-    out: dict[str, list[str]] = {}
+    out: dict[str, list[dict]] = {}
     for label, registry in (
         ("frontend", FrontendMetrics().registry),
         ("engine", EngineMetrics(worker="check").registry),
     ):
-        names: list[str] = []
+        families: list[dict] = []
         for collector in registry._collector_to_names:  # noqa: SLF001 - no public enumeration API
+            labels = tuple(getattr(collector, "_labelnames", ()) or ())
             for metric in collector.collect():
-                names.append(metric.name)
-        out[label] = sorted(names)
+                families.append(
+                    {
+                        "name": metric.name,
+                        "help": (metric.documentation or "").strip(),
+                        "labels": labels,
+                    }
+                )
+        out[label] = sorted(families, key=lambda f: f["name"])
     return out
 
 
+def collect_names() -> dict[str, list[str]]:
+    """Family names per registry (the name-only view of collect_families)."""
+    return {
+        label: [f["name"] for f in families]
+        for label, families in collect_families().items()
+    }
+
+
 def check(names: dict[str, list[str]]) -> list[str]:
-    """Returns a list of violations (empty = clean)."""
+    """Name-level violations: prefix, cross-registry uniqueness, dupes."""
     problems: list[str] = []
     seen: dict[str, str] = {}
     for label, family_names in names.items():
@@ -51,15 +73,39 @@ def check(names: dict[str, list[str]]) -> list[str]:
     return problems
 
 
+def check_families(families: dict[str, list[dict]]) -> list[str]:
+    """All violations: the name checks plus non-empty HELP and consistent
+    label sets for any name seen more than once across registries."""
+    problems = check(
+        {label: [f["name"] for f in fams] for label, fams in families.items()}
+    )
+    label_sets: dict[str, tuple[str, tuple]] = {}
+    for label, fams in families.items():
+        for f in fams:
+            if not f["help"]:
+                problems.append(f"{label}: {f['name']!r} has empty HELP text")
+            prev = label_sets.get(f["name"])
+            if prev is not None and prev[1] != f["labels"]:
+                problems.append(
+                    f"{f['name']!r} registered with conflicting label sets: "
+                    f"{prev[1]} ({prev[0]}) vs {f['labels']} ({label})"
+                )
+            label_sets.setdefault(f["name"], (label, f["labels"]))
+    return problems
+
+
 def main() -> int:
-    names = collect_names()
-    problems = check(names)
-    total = sum(len(v) for v in names.values())
+    families = collect_families()
+    problems = check_families(families)
+    total = sum(len(v) for v in families.values())
     if problems:
         for p in problems:
             print(f"FAIL: {p}", file=sys.stderr)
         return 1
-    print(f"ok: {total} metric families across {len(names)} registries, all dynamo_-prefixed and unique")
+    print(
+        f"ok: {total} metric families across {len(families)} registries — "
+        "dynamo_-prefixed, unique, HELP'd, label-consistent"
+    )
     return 0
 
 
