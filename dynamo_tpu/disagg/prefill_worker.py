@@ -30,6 +30,7 @@ from dynamo_tpu.engine.service import JaxEngineService
 from dynamo_tpu.protocols.common import PreprocessedRequest, SamplingOptions, StopConditions
 from dynamo_tpu.runtime.component import DistributedRuntime
 from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.faults import FAULTS
 from dynamo_tpu.tokens import compute_block_hashes
 
 logger = logging.getLogger(__name__)
@@ -89,8 +90,13 @@ class PrefillWorker:
         except asyncio.CancelledError:
             raise
         except Exception:
-            # Leave the queue entry for its lease to expire and be re-claimed.
-            logger.exception("prefill task failed")
+            # Release the claim so a *peer* reclaims the task immediately —
+            # leaving it for our lease to expire would stall it a full TTL.
+            logger.exception("prefill task failed; releasing claim for a peer to retry")
+            try:
+                await self.queue.release(key)
+            except Exception:
+                logger.exception("claim release failed; lease expiry will reclaim %s", key)
             await asyncio.sleep(0.2)
         finally:
             self._sem.release()
@@ -116,6 +122,8 @@ class PrefillWorker:
             )
         exec_span = Span("prefill_exec", trace=trace, request_id=request_id, tokens=len(token_ids))
         with exec_span:
+            if FAULTS.armed:
+                FAULTS.fire("prefill.exec")
             await self._prefill_and_ship(task, exec_span.context)
 
     async def _prefill_and_ship(self, task: dict, trace) -> None:
@@ -208,6 +216,17 @@ class PrefillWorker:
             "prefill %s: %d tokens -> %d blocks shipped (%s injected)",
             request_id, len(token_ids), len(blocks), result.get("injected"),
         )
+
+    async def drain(self, timeout: float = 30.0) -> bool:
+        """Stop claiming new tasks and wait for in-flight prefills to finish
+        (under ``timeout``). Returns True if everything completed."""
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        if self._inflight:
+            _done, pending = await asyncio.wait(list(self._inflight), timeout=timeout)
+            return not pending
+        return True
 
     async def close(self) -> None:
         if self._task is not None:

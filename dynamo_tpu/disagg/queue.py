@@ -36,6 +36,10 @@ class DistributedQueue:
         self._uid = uuid.uuid4().hex[:8]
         self._wake = asyncio.Event()
         self._watch_task: asyncio.Task | None = None
+        #: Tasks this consumer claimed that some consumer had already
+        #: delivered before (peer crash -> claim-lease expiry, or an explicit
+        #: :meth:`release`). The redelivery count behind at-least-once.
+        self.requeues = 0
 
     @property
     def task_prefix(self) -> str:
@@ -43,6 +47,9 @@ class DistributedQueue:
 
     def _claim_key(self, task_key: str) -> str:
         return f"queue/{self.name}/claim/{task_key.rsplit('/', 1)[-1]}"
+
+    def _delivered_key(self, task_key: str) -> str:
+        return f"queue/{self.name}/delivered/{task_key.rsplit('/', 1)[-1]}"
 
     # -- producer ----------------------------------------------------------
 
@@ -61,6 +68,14 @@ class DistributedQueue:
         """Ack: remove a completed task (and its claim record)."""
         await self.runtime.store.delete(task_key)
         await self.runtime.store.delete(self._claim_key(task_key))
+        await self.runtime.store.delete(self._delivered_key(task_key))
+
+    async def release(self, task_key: str) -> None:
+        """Give a claimed task back without acking: the claim record is
+        dropped so a peer can reclaim *immediately*, instead of waiting out
+        this process's lease TTL. Use on execution failure."""
+        await self.runtime.store.delete(self._claim_key(task_key))
+        self._wake.set()
 
     # -- consumer ----------------------------------------------------------
 
@@ -96,7 +111,14 @@ class DistributedQueue:
                     value = await self.runtime.store.get(key)
                     if value is None:
                         await self.runtime.store.delete(self._claim_key(key))
+                        await self.runtime.store.delete(self._delivered_key(key))
                         continue
+                    # Unleased delivery marker: if it already exists, another
+                    # consumer delivered this task before us — a redelivery
+                    # (its claim expired or it released the task).
+                    if not await self.runtime.store.put_if_absent(self._delivered_key(key), b"1"):
+                        self.requeues += 1
+                        logger.warning("task %s redelivered (previous consumer failed)", key)
                     return key, json.loads(value)
             self._wake.clear()
             remaining = None if deadline is None else deadline - asyncio.get_event_loop().time()

@@ -41,6 +41,7 @@ import asyncio
 import dataclasses
 import logging
 import time
+import zlib
 from typing import Any, AsyncIterator
 
 import numpy as np
@@ -49,6 +50,7 @@ from dynamo_tpu.engine.allocator import OutOfPagesError
 from dynamo_tpu.engine.core import EngineCore
 from dynamo_tpu.observability.metrics import observe_kv_phase
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.runtime.faults import FAULTS, corrupt_bytes
 from dynamo_tpu.runtime.transport import Transport
 from dynamo_tpu.tracing import TraceContext, record_span
 
@@ -87,15 +89,29 @@ class _StreamSession:
 
 
 def pack_block(block_hash: int, parent_hash: int | None, tokens: list[int], k: np.ndarray, v: np.ndarray) -> dict:
+    kb = np.ascontiguousarray(k).tobytes()
+    vb = np.ascontiguousarray(v).tobytes()
     return {
         "hash": block_hash,
         "parent": parent_hash,
         "tokens": list(tokens),
-        "k": np.ascontiguousarray(k).tobytes(),
-        "v": np.ascontiguousarray(v).tobytes(),
+        "k": kb,
+        "v": vb,
         "shape": list(k.shape),
         "dtype": str(k.dtype),
+        # End-to-end payload integrity: verified receiver-side before the
+        # scatter (msgpack/TCP don't checksum application payloads for us).
+        "crc": zlib.crc32(vb, zlib.crc32(kb)),
     }
+
+
+def block_crc_ok(blk: dict) -> bool:
+    """Verify a packed block's crc32. Blocks without one (older senders)
+    pass — the check is opt-in by wire format, not a protocol break."""
+    crc = blk.get("crc")
+    if crc is None:
+        return True
+    return zlib.crc32(blk["v"], zlib.crc32(blk["k"])) == crc
 
 
 def unpack_payload(msg: dict) -> tuple[np.ndarray, np.ndarray]:
@@ -134,6 +150,8 @@ class KvTransferService(AsyncEngine[Any, dict]):
         self.transfer_seconds = 0.0
         self.scatter_seconds = 0.0
         self.device_path_blocks = 0
+        self.crc_failures = 0
+        self.rollbacks = 0
 
     def start_sweeper(self, interval: float | None = None) -> "KvTransferService":
         """Run :meth:`_sweep_pending_pulls` on a timer, so staging abandoned
@@ -176,6 +194,8 @@ class KvTransferService(AsyncEngine[Any, dict]):
             "scatter_s": round(self.scatter_seconds, 6),
             "streams_in_flight": len(self._streams),
             "gbytes_per_sec": round(gbps, 6),
+            "crc_failures": self.crc_failures,
+            "rollbacks": self.rollbacks,
         }
 
     # -- staging (shared by the TCP and device ingestion paths) ------------
@@ -305,6 +325,7 @@ class KvTransferService(AsyncEngine[Any, dict]):
         sess = self._streams.pop(request_id, None)
         if sess is None:
             return
+        self.rollbacks += 1
         self.core.allocator.release(sess.pinned)
 
     async def _ingest_chunk(self, request_id: str, request: dict) -> dict:
@@ -318,13 +339,20 @@ class KvTransferService(AsyncEngine[Any, dict]):
         aborts — a reconnecting sender restarts at seq 0, which replaces
         any stale session for the same request id.
         """
+        if FAULTS.armed:
+            FAULTS.fire("kv.chunk.recv")
         seq = int(request.get("seq", 0))
         last = bool(request.get("last"))
         blocks = request.get("blocks", [])
         sess = self._streams.get(request_id)
         if seq == 0:
             if sess is not None:
-                self._abort_stream(request_id)
+                if sess.next_seq == 0 and not sess.pinned:
+                    # crc-retry of the very first chunk: the session never
+                    # ingested anything, so replacing it is not a rollback.
+                    self._streams.pop(request_id, None)
+                else:
+                    self._abort_stream(request_id)
             sess = _StreamSession()
             self._streams[request_id] = sess
         if sess is None or seq != sess.next_seq:
@@ -334,6 +362,17 @@ class KvTransferService(AsyncEngine[Any, dict]):
                 "stream_error": f"unexpected seq {seq}"
                 + (f" (want {sess.next_seq})" if sess else " (no session)"),
             }
+        bad = sum(1 for blk in blocks if not block_crc_ok(blk))
+        if bad:
+            # Corruption is retryable, not fatal: the session is untouched
+            # (next_seq unchanged) so the sender can re-send this exact seq.
+            self.crc_failures += bad
+            sess.t_last = time.monotonic()
+            logger.warning(
+                "kv chunk crc mismatch (req=%s seq=%d, %d/%d blocks); asking sender to retry",
+                request_id, seq, bad, len(blocks),
+            )
+            return {"request_id": request_id, "seq": seq, "crc_error": True, "bad_blocks": bad}
         t0 = time.perf_counter()
         staged: list[tuple[int, int, Any]] = []
         try:
@@ -564,6 +603,17 @@ class KvTransferService(AsyncEngine[Any, dict]):
         self._abort_pull(request_id)
         self._abort_stream(request_id)
         blocks = request.get("blocks", [])
+        first_bad = next((i for i, blk in enumerate(blocks) if not block_crc_ok(blk)), None)
+        if first_bad is not None:
+            # v1 has no per-chunk retry protocol: truncate at the first
+            # corrupt block (every prefix of the hash chain is a valid cache
+            # state; committing past a gap would publish unreachable blocks).
+            self.crc_failures += 1
+            logger.warning(
+                "v1 kv payload crc mismatch at block %d/%d (req=%s); chain truncated",
+                first_bad, len(blocks), request_id,
+            )
+            blocks = blocks[:first_bad]
         injected = 0
         t0 = time.perf_counter()
         pinned: list[int] = []
@@ -669,6 +719,7 @@ async def send_blocks_chunked(
     pages = await loop.run_in_executor(None, allocator.match_prefix, block_hashes)
     phases = {"gather_s": 0.0, "pack_s": 0.0, "wire_s": 0.0}
     total_bytes = 0
+    crc_retries = 0
     streaming = False  # any chunk reached the receiver (it may hold session state)
     try:
         if not pages:
@@ -703,16 +754,36 @@ async def send_blocks_chunked(
             )
             phases["pack_s"] += time.perf_counter() - t_pack
             total_bytes += sum(len(b["k"]) + len(b["v"]) for b in blocks)
+            wire_blocks = blocks
+            if FAULTS.armed:
+                if FAULTS.fire("kv.chunk.send") == "corrupt" and wire_blocks:
+                    corrupted = dict(wire_blocks[0])
+                    corrupted["k"] = corrupt_bytes(corrupted["k"])
+                    wire_blocks = [corrupted, *wire_blocks[1:]]
             t_wire = time.perf_counter()
             streaming = True
             msg = {
-                "request_id": request_id, "seq": i, "blocks": blocks,
+                "request_id": request_id, "seq": i, "blocks": wire_blocks,
                 "last": i == len(chunks) - 1,
             }
             if trace is not None:
                 # The receiver's scatter spans link under the sender's span.
                 msg["trace"] = trace.to_dict()
             resp = await _round_trip(transport, address, msg)
+            if resp.get("crc_error"):
+                # The receiver rejected the chunk but kept the session at
+                # this seq: one transfer-level retry with freshly-packed
+                # blocks (the clean copies, whatever got mangled in flight)
+                # before giving up on the stream.
+                logger.warning(
+                    "kv chunk %d of %s failed crc at receiver; retrying once",
+                    i, request_id,
+                )
+                crc_retries += 1
+                msg["blocks"] = blocks
+                resp = await _round_trip(transport, address, msg)
+                if resp.get("crc_error"):
+                    raise RuntimeError(f"kv chunk {i} failed crc after retry")
             phases["wire_s"] += time.perf_counter() - t_wire
             if resp.get("stream_error"):
                 # The receiver already rolled the stream back.
@@ -722,6 +793,7 @@ async def send_blocks_chunked(
         streaming = False
         result["phases"] = {k: round(v, 6) for k, v in phases.items()}
         result["bytes"] = total_bytes
+        result["crc_retries"] = crc_retries
         # Sender-side phase telemetry: one span per phase (cumulative over
         # the stream) + histogram observations for the metrics plane.
         for phase, secs in (("gather", phases["gather_s"]), ("pack", phases["pack_s"]), ("wire", phases["wire_s"])):

@@ -23,6 +23,7 @@ from dynamo_tpu.engine.sequence import Sequence
 from dynamo_tpu.protocols.common import EngineOutput, PreprocessedRequest
 from dynamo_tpu.protocols.kv import ForwardPassMetrics
 from dynamo_tpu.runtime.engine import AsyncEngine, Context
+from dynamo_tpu.runtime.faults import FAULTS
 
 logger = logging.getLogger(__name__)
 
@@ -41,6 +42,7 @@ class JaxEngineService(AsyncEngine[Any, dict]):
         self._loop_task: asyncio.Task | None = None
         self._wake = asyncio.Event()
         self._closed = False
+        self._draining = False
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -79,13 +81,34 @@ class JaxEngineService(AsyncEngine[Any, dict]):
         """Fail requests queued but never admitted by the (now dead) loop."""
         from dynamo_tpu.protocols.common import FinishReason
 
+        drained = 0
         while True:
             try:
                 _req, _ctx, out_q, _t_enq = self._intake.get_nowait()
             except asyncio.QueueEmpty:
-                return
+                break
             out_q.put_nowait(EngineOutput(token_ids=[], finish_reason=FinishReason.ERROR))
             out_q.put_nowait(_SENTINEL)
+            drained += 1
+        if drained:
+            flight = getattr(self.core, "flight", None)
+            if flight is not None:
+                from dynamo_tpu.observability.flight import CRASH
+
+                flight.record(CRASH, where="intake_drain", drained=drained)
+
+    async def drain(self, timeout: float = 30.0) -> bool:
+        """Stop admitting new requests and wait for in-flight ones to finish.
+
+        Returns True if everything finished before the deadline. The engine
+        loop keeps stepping throughout — draining stops *admission*, not
+        progress on work already admitted.
+        """
+        self._draining = True
+        deadline = time.monotonic() + timeout
+        while (self._streams or not self._intake.empty()) and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        return not self._streams and self._intake.empty()
 
     # -- engine loop -------------------------------------------------------
 
@@ -138,12 +161,21 @@ class JaxEngineService(AsyncEngine[Any, dict]):
             # (If this task is cancelled mid-step, the executor thread keeps
             # running — close() serializes against it via core.step_lock.)
             try:
+                if FAULTS.armed:
+                    FAULTS.fire("engine.step")
                 outputs = await loop.run_in_executor(None, self.core.step)
-            except Exception:
+            except Exception as exc:
                 logger.exception("engine step failed; failing all in-flight streams")
                 flight = getattr(self.core, "flight", None)
                 if flight is not None:
                     try:
+                        from dynamo_tpu.observability.flight import CRASH
+
+                        flight.record(
+                            CRASH, where="engine_loop",
+                            error=type(exc).__name__, detail=str(exc)[:500],
+                            streams=len(self._streams),
+                        )
                         path = flight.dump_jsonl(reason="engine_step_failure")
                         logger.error("flight recorder dumped to %s", path)
                     except Exception:
@@ -192,6 +224,10 @@ class JaxEngineService(AsyncEngine[Any, dict]):
             # A dead engine must refuse loudly (the stream error feeds the
             # client's inhibit list), not queue into a loop that never runs.
             raise RuntimeError("engine service is closed")
+        if self._draining:
+            # Draining refuses the same way: the client breaker routes the
+            # request to a replica while in-flight streams finish here.
+            raise RuntimeError("engine service is draining")
         if request.annotations.get("embed"):
             # Embedding requests bypass the scheduler: the cache-free encoder
             # shares nothing with the paged decode state (runner.embed). The

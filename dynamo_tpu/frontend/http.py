@@ -42,7 +42,7 @@ from dynamo_tpu.frontend.openai_format import (
     aggregate_completion,
     sse_encode,
 )
-from dynamo_tpu.protocols.common import BackendOutput
+from dynamo_tpu.protocols.common import BackendOutput, FinishReason
 from dynamo_tpu.runtime.engine import Context
 
 logger = logging.getLogger(__name__)
@@ -50,6 +50,17 @@ logger = logging.getLogger(__name__)
 
 def _error(status: int, message: str, etype: str = "invalid_request_error") -> web.Response:
     return web.json_response({"error": {"message": message, "type": etype}}, status=status)
+
+
+#: The structured SSE event a client sees when the engine dies mid-stream —
+#: OpenAI error shape, no traceback, followed by [DONE] and a clean close.
+_ENGINE_ERROR_EVENT = {
+    "error": {
+        "message": "the engine failed while generating this response",
+        "type": "engine_error",
+        "code": "mid_stream_failure",
+    }
+}
 
 
 class HttpService:
@@ -218,6 +229,7 @@ class HttpService:
                         return await self._stream_response(
                             request, model, kind, ctx, backend_stream, send_usage,
                             parse_tools=kind == "chat" and bool(body.get("tools")),
+                            tracker=tracker,
                         )
                     if kind == "chat":
                         payload = await aggregate_chat(
@@ -225,6 +237,14 @@ class HttpService:
                         )
                     else:
                         payload = await aggregate_completion(model, backend_stream)
+                    choices = payload.get("choices") or []
+                    if choices and choices[0].get("finish_reason") == "error":
+                        # Engine died under the aggregation: headers aren't
+                        # out yet, so a real HTTP error is still possible.
+                        tracker.status = "error"
+                        return _error(
+                            502, "the engine failed while generating this response", "engine_error"
+                        )
                     return web.json_response(payload)
                 except asyncio.CancelledError:
                     ctx.kill()
@@ -252,7 +272,7 @@ class HttpService:
     async def _stream_response(
         self, request: web.Request, model: str, kind: str, ctx: Context,
         backend_stream: AsyncIterator[BackendOutput], send_usage: bool,
-        *, parse_tools: bool = False,
+        *, parse_tools: bool = False, tracker=None,
     ) -> web.StreamResponse:
         resp = web.StreamResponse(
             headers={
@@ -272,6 +292,13 @@ class HttpService:
             if kind == "chat":
                 await resp.write(sse_encode(fmt.first()))
             async for out in backend_stream:
+                if out.finish_reason is FinishReason.ERROR and not out.token_ids:
+                    # Mid-stream engine death: emit a structured OpenAI-style
+                    # error event (never a traceback) and end the stream.
+                    if tracker is not None:
+                        tracker.status = "error"
+                    await resp.write(sse_encode(_ENGINE_ERROR_EVENT))
+                    break
                 if jail is None:
                     await resp.write(sse_encode(fmt.delta(out)))
                     continue
@@ -300,8 +327,10 @@ class HttpService:
             # the SSE stream with an error event instead of a silent cut.
             logger.exception("stream failed mid-flight (model=%s)", model)
             ctx.kill()
+            if tracker is not None:
+                tracker.status = "error"
             try:
-                await resp.write(sse_encode({"error": {"message": "internal error", "type": "internal_error"}}))
+                await resp.write(sse_encode(_ENGINE_ERROR_EVENT))
                 await resp.write(SSE_DONE)
             except (ConnectionResetError, OSError):
                 pass

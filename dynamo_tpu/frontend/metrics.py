@@ -96,6 +96,24 @@ class FrontendMetrics:
             "Fraction of finished requests that attained the SLO (cumulative)",
             registry=self.registry,
         )
+        # Client-plane health: watch-loop restarts/staleness and per-instance
+        # circuit-breaker state, synced per scrape from every live runtime
+        # client in this process (runtime/client.py snapshots).
+        self.client_watch_restarts = Gauge(
+            "dynamo_client_watch_restarts_total",
+            "Instance-watch reconnects per endpoint (a restart means the discovery watch died and was resubscribed)",
+            ["endpoint"], registry=self.registry,
+        )
+        self.client_watch_staleness = Gauge(
+            "dynamo_client_watch_staleness_seconds",
+            "Seconds the endpoint's instance watch has been down (0 while healthy)",
+            ["endpoint"], registry=self.registry,
+        )
+        self.client_breaker_state = Gauge(
+            "dynamo_client_breaker_state",
+            "Per-instance circuit breaker state (0 closed / 1 half-open / 2 open)",
+            ["endpoint", "instance"], registry=self.registry,
+        )
         # Streaming P^2 quantiles — no fixed-bucket distortion at the 500 ms
         # target the way a histogram boundary would introduce.
         self.ttft_quantile = Gauge(
@@ -111,6 +129,7 @@ class FrontendMetrics:
 
     def render(self) -> bytes:
         from dynamo_tpu.ops.pallas_paged import fallback_snapshot
+        from dynamo_tpu.runtime.client import breaker_snapshot, watch_snapshot
 
         # Drop label sets from a previous scrape first: a signature that
         # left the snapshot (fallback cache reset) must not keep exporting
@@ -118,6 +137,14 @@ class FrontendMetrics:
         self.kernel_fallbacks.clear()
         for sig, n in fallback_snapshot().items():
             self.kernel_fallbacks.labels(sig).set(n)
+        self.client_watch_restarts.clear()
+        self.client_watch_staleness.clear()
+        self.client_breaker_state.clear()
+        for path, view in watch_snapshot().items():
+            self.client_watch_restarts.labels(path).set(view["restarts"])
+            self.client_watch_staleness.labels(path).set(view["staleness"])
+        for (path, instance), state in breaker_snapshot().items():
+            self.client_breaker_state.labels(path, instance).set(state)
         self.output_tokens.set(self.slo.output_tokens_total)
         self.goodput_tokens.set(self.slo.goodput_tokens_total)
         self.slo_attainment.set(self.slo.attainment())

@@ -327,11 +327,16 @@ async def serve_worker(
         serve_engine = DisaggDecodeService(
             service, transfer, DistributedQueue(runtime, PREFILL_QUEUE), disagg_router, t_inst.address
         )
+        service.disagg_operator = serve_engine  # remote/local prefill counters
         service.aux.append(disagg_router)
 
     instance = await component.endpoint(ep).serve(serve_engine, metadata={"model": spec.card.name}, lease=lease)
     await component.endpoint(KV_EVENTS_ENDPOINT).serve(broadcaster, metadata={"model": spec.card.name}, lease=lease)
     service.core.config.worker_id = instance.lease_id  # same object as spec.engine_config
+    # Graceful drain needs both: re-publish the record with draining=True,
+    # then revoke the lease once in-flight work finishes (drain_worker).
+    service.instance = instance
+    service.serve_lease = lease
 
     def snapshot():
         m = service.metrics()
@@ -389,7 +394,7 @@ async def _serve_worker_telemetry(
     if transfer is not None:
         metrics.bind_transfer(transfer)
     if queue is not None:
-        metrics.bind_queue_depth(queue.depth)
+        metrics.bind_queue(queue)
     # Process-global phase sink: with several in-process workers (run_local)
     # the last one installed attributes the KV phases; multi-process
     # deployments — the topology disagg targets — are exact.
@@ -439,6 +444,8 @@ async def serve_prefill_worker(runtime: DistributedRuntime, spec: WorkerSpec, *,
     conc = int(os.environ.get("DYN_PREFILL_CONCURRENCY", "2"))
     worker = await PrefillWorker(runtime, service, max_concurrency=conc).start()
     service.aux.append(worker)
+    service.prefill_worker = worker  # drain_worker stops claiming before closing
+    service.serve_lease = lease
     ns, comp, _ep = spec.card.endpoint
     worker_id = f"{lease.id:x}" if lease is not None else f"prefill-{os.getpid()}"
     await _serve_worker_telemetry(
@@ -448,6 +455,53 @@ async def serve_prefill_worker(runtime: DistributedRuntime, spec: WorkerSpec, *,
     )
     logger.info("prefill worker up for %s", spec.card.name)
     return service
+
+
+async def drain_worker(
+    runtime: DistributedRuntime, service: JaxEngineService, *, timeout: float | None = None
+) -> bool:
+    """Graceful worker shutdown: announce draining, finish in-flight work
+    under a deadline, revoke the lease, close.
+
+    Order matters: (1) the instance record is re-published with
+    ``metadata.draining=True`` so clients stop routing new requests here
+    while the record (and in-flight streams) stay alive; (2) the engine
+    finishes admitted requests (and a prefill worker its claimed tasks)
+    under ``timeout`` (``DYN_DRAIN_TIMEOUT_S``, default 30); (3) the lease
+    is revoked, cascade-deleting every record this worker published; (4) the
+    service closes. Returns True when everything finished in time.
+    """
+    import dataclasses
+
+    if timeout is None:
+        timeout = float(os.environ.get("DYN_DRAIN_TIMEOUT_S", "30"))
+    instance = getattr(service, "instance", None)
+    lease = getattr(service, "serve_lease", None)
+    if lease is None:
+        lease = await runtime.primary_lease()
+    if instance is not None:
+        draining = dataclasses.replace(
+            instance, metadata={**instance.metadata, "draining": True}
+        )
+        try:
+            await runtime.store.put(instance.key, draining.to_bytes(), lease_id=lease.id)
+        except Exception:
+            logger.exception("drain announcement failed; clients will retry against us")
+    done = True
+    worker = getattr(service, "prefill_worker", None)
+    if worker is not None:
+        done = await worker.drain(timeout)
+    if hasattr(service, "drain"):
+        done = await service.drain(timeout) and done
+    if not done:
+        logger.warning("drain deadline (%.1fs) hit with work still in flight", timeout)
+    try:
+        await lease.revoke()
+    except Exception:
+        logger.exception("lease revoke during drain failed (expiry will clean up)")
+    await service.close()
+    logger.info("worker drained and closed (clean=%s)", done)
+    return done
 
 
 async def serve_frontend(
@@ -598,6 +652,7 @@ async def run_role(args: argparse.Namespace) -> None:
 
         disagg = DisaggConfig(max_local_prefill_length=args.disagg_threshold)
 
+    service = None  # engine-bearing roles get SIGTERM -> drain_worker below
     if args.role == "frontend":
         _, _, port = await serve_frontend(runtime, host=args.host, port=args.http_port)
         logger.info("frontend ready on port %d", port)
@@ -607,14 +662,14 @@ async def run_role(args: argparse.Namespace) -> None:
         spec.mesh_plan = _parse_mesh(args.mesh)
         spec.mock = args.mock
         spec.quantize = args.quantize
-        await serve_worker(runtime, spec, disagg=disagg)
+        service = await serve_worker(runtime, spec, disagg=disagg)
         logger.info("worker ready")
     elif args.role == "prefill":
         spec = make_worker_spec(args.model, num_pages=args.num_pages, max_batch_size=args.max_batch_size)
         spec.mesh_plan = _parse_mesh(args.mesh)
         spec.mock = args.mock
         spec.quantize = args.quantize
-        await serve_prefill_worker(runtime, spec)
+        service = await serve_prefill_worker(runtime, spec)
         logger.info("prefill worker ready")
     elif args.role == "encode":
         from dynamo_tpu.encode import VISION_PRESETS, serve_encode_worker
@@ -642,8 +697,30 @@ async def run_role(args: argparse.Namespace) -> None:
         logger.info("store-only process")
     else:
         raise SystemExit(f"unknown role {args.role!r}")
+    stop = asyncio.Event()
+    if service is not None:
+        import signal
+
+        async def _drain_then_stop() -> None:
+            try:
+                await drain_worker(runtime, service)
+            except Exception:
+                logger.exception("drain on SIGTERM failed")
+            finally:
+                stop.set()
+
+        def _on_sigterm() -> None:
+            logger.info("SIGTERM received: draining before exit")
+            asyncio.ensure_future(_drain_then_stop())
+
+        try:
+            asyncio.get_running_loop().add_signal_handler(signal.SIGTERM, _on_sigterm)
+        except (NotImplementedError, RuntimeError):
+            # Non-Unix loops (or nested-loop shims) don't support signal
+            # handlers; the role then relies on lease expiry for cleanup.
+            logger.debug("SIGTERM handler unavailable; drain-on-terminate disabled")
     print(f"READY role={args.role}", flush=True)
-    await asyncio.Event().wait()
+    await stop.wait()
 
 
 async def _amain(args: argparse.Namespace) -> None:

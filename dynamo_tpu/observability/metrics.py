@@ -96,10 +96,23 @@ class EngineMetrics:
         self.prefill_queue_depth = gauge(
             f"{ns}_prefill_queue_depth", "Unclaimed tasks in the distributed prefill queue"
         )
+        self.prefill_requeues = gauge(
+            f"{ns}_prefill_requeues_total",
+            "Prefill tasks this worker claimed that a failed peer had already been delivered "
+            "(requeue-to-peer via claim release or claim-lease expiry)",
+        )
         # KV transfer (disagg prefill -> decode migration).
         self.kv_blocks = gauge("dynamo_kv_transfer_blocks_total", "KV blocks ingested into the local cache")
         self.kv_bytes = gauge("dynamo_kv_transfer_bytes_total", "KV bytes received over the transfer path")
         self.kv_streams = gauge("dynamo_kv_transfer_streams_in_flight", "Open v2 chunk-stream sessions")
+        self.kv_crc_failures = gauge(
+            "dynamo_kv_transfer_crc_failures_total",
+            "KV wire payloads that failed the receiver-side crc32 check",
+        )
+        self.kv_rollbacks = gauge(
+            "dynamo_kv_transfer_rollbacks_total",
+            "v2 chunk-stream sessions rolled back (sender death, protocol error, unrecovered corruption)",
+        )
         self._kv_phase = Histogram(
             "dynamo_kv_transfer_phase_seconds",
             "Per-phase KV transfer duration (sender gather/pack/wire, receiver scatter)",
@@ -108,6 +121,7 @@ class EngineMetrics:
         self._core: Any = None
         self._transfer: Any = None
         self._queue_depth_fn: Callable[[], Awaitable[int]] | None = None
+        self._queue: Any = None
 
     def observe_phase(self, phase: str, seconds: float) -> None:
         self._kv_phase.labels(self.worker, phase).observe(max(0.0, seconds))
@@ -125,6 +139,13 @@ class EngineMetrics:
     def bind_queue_depth(self, fn: Callable[[], Awaitable[int]]) -> "EngineMetrics":
         """``fn`` is awaited per scrape (e.g. ``DistributedQueue.depth``)."""
         self._queue_depth_fn = fn
+        return self
+
+    def bind_queue(self, queue: Any) -> "EngineMetrics":
+        """Bind a ``DistributedQueue``: depth is polled per scrape and the
+        redelivery (requeue) counter is synced per scrape."""
+        self._queue = queue
+        self._queue_depth_fn = queue.depth
         return self
 
     # -- scrape ------------------------------------------------------------
@@ -166,10 +187,14 @@ class EngineMetrics:
         self.kv_blocks.set(stats.get("blocks", 0))
         self.kv_bytes.set(stats.get("bytes", 0))
         self.kv_streams.set(stats.get("streams_in_flight", 0))
+        self.kv_crc_failures.set(stats.get("crc_failures", 0))
+        self.kv_rollbacks.set(stats.get("rollbacks", 0))
 
     async def render(self) -> bytes:
         self._sync_core()
         self._sync_transfer()
+        if self._queue is not None:
+            self.prefill_requeues.set(getattr(self._queue, "requeues", 0))
         if self._queue_depth_fn is not None:
             try:
                 self.prefill_queue_depth.set(await self._queue_depth_fn())

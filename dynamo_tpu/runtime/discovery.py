@@ -25,6 +25,8 @@ from dataclasses import dataclass
 from enum import Enum
 from typing import AsyncIterator
 
+from dynamo_tpu.runtime.faults import FAULTS
+
 
 class WatchEventType(Enum):
     PUT = "put"
@@ -219,6 +221,8 @@ class MemoryStore(KeyValueStore):
             return Lease(id=lid, ttl=ttl, store=self)
 
     async def keep_alive(self, lease_id: int) -> None:
+        if FAULTS.armed:
+            FAULTS.fire("lease.keepalive")
         async with self._lock:
             await self._sweep_expired()
             if lease_id not in self._leases:
@@ -237,9 +241,14 @@ class MemoryStore(KeyValueStore):
             self._watchers.append((prefix, queue))
         try:
             for k, v in snapshot:
+                if FAULTS.armed:
+                    FAULTS.fire("store.watch")
                 yield WatchEvent(WatchEventType.PUT, k, v)
             while True:
-                yield await queue.get()
+                event = await queue.get()
+                if FAULTS.armed:
+                    FAULTS.fire("store.watch")
+                yield event
         finally:
             self._watchers.remove((prefix, queue))
 

@@ -31,6 +31,7 @@ from dynamo_tpu.runtime.discovery import (
     WatchEvent,
     WatchEventType,
 )
+from dynamo_tpu.runtime.faults import FAULTS
 
 logger = logging.getLogger(__name__)
 
@@ -190,6 +191,8 @@ class StoreClient(KeyValueStore):
             self._pending.clear()
 
     async def _call(self, op: str, **fields: Any) -> Any:
+        if FAULTS.armed:
+            FAULTS.fire("store.op")
         async with self._lock:
             await self._ensure()
             rid = next(self._rid)
@@ -236,6 +239,8 @@ class StoreClient(KeyValueStore):
                 frame = await read_frame(reader)
                 if frame is None:
                     raise ConnectionError("watch stream closed")
+                if FAULTS.armed:
+                    FAULTS.fire("store.watch")
                 p = frame.payload
                 yield WatchEvent(WatchEventType(p["type"]), p["key"], p.get("value"))
         finally:

@@ -24,6 +24,7 @@ from urllib.parse import urlparse
 
 from dynamo_tpu.runtime.codec import Frame, FrameType, read_frame, write_frame
 from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineError
+from dynamo_tpu.runtime.faults import FAULTS
 from dynamo_tpu.runtime.transport import NoSuchSubjectError, Transport
 
 logger = logging.getLogger(__name__)
@@ -139,6 +140,8 @@ class TcpTransport(Transport):
         if url.scheme != "tcp":
             raise ValueError(f"not a tcp address: {address}")
         subject = url.path.lstrip("/")
+        if FAULTS.armed:
+            FAULTS.fire("tcp.connect")
         reader, writer = await asyncio.open_connection(url.hostname, url.port)
 
         async def forward_cancel() -> None:
@@ -161,6 +164,8 @@ class TcpTransport(Transport):
         cancel_task = asyncio.create_task(forward_cancel())
         try:
             extra = {"trace": context.trace} if context.trace else {}
+            if FAULTS.armed:
+                FAULTS.fire("tcp.write")
             write_frame(writer, FrameType.REQUEST, subject=subject, id=context.id, p=request, **extra)
             await writer.drain()
             prologue = await read_frame(reader)
@@ -174,6 +179,8 @@ class TcpTransport(Transport):
                     raise NoSuchSubjectError(err)
                 raise EngineError(err)
             while True:
+                if FAULTS.armed:
+                    FAULTS.fire("tcp.read")
                 frame = await read_frame(reader)
                 if frame is None:
                     if context.is_killed or context.is_stopped:

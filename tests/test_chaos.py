@@ -1,0 +1,696 @@
+"""Chaos suite: deterministic fault injection + end-to-end failure recovery.
+
+Every scenario arms the process-wide fault plane (`runtime/faults.py`) and
+asserts the *recovery* behavior, not just the failure: watch loops
+reconnect, circuit breakers open and route around, corrupt KV chunks are
+retried, failed prefill tasks requeue to a peer, and a mid-stream engine
+death surfaces as a structured SSE error — never a traceback.
+docs/ROBUSTNESS.md documents the grammar and semantics;
+tools/check_fault_points.py fails this suite if any registered fault point
+is never armed here.
+"""
+
+import asyncio
+import json
+import pathlib
+import sys
+
+import aiohttp
+import numpy as np
+import pytest
+
+from dynamo_tpu.runtime.faults import (
+    FAULT_POINTS,
+    FAULTS,
+    CrashFault,
+    DropFault,
+    FaultRegistry,
+    corrupt_bytes,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _fault_hygiene():
+    """Every test starts and ends with the fault plane disarmed — a leaked
+    plan would fail unrelated tests in ways that are miserable to debug."""
+    FAULTS.disarm()
+    yield
+    FAULTS.disarm()
+
+
+# -- the plane itself --------------------------------------------------------
+
+
+def test_fault_grammar():
+    reg = FaultRegistry()
+    reg.arm("tcp.connect:drop@2,engine.step:crash@3+,kv.chunk.send:corrupt@0.5,store.op:delay")
+    assert reg.armed
+    assert set(reg.counts()) == {"tcp.connect", "engine.step", "kv.chunk.send", "store.op"}
+    # @2: only the second call fires.
+    assert reg.fire("tcp.connect") is None
+    with pytest.raises(DropFault):
+        reg.fire("tcp.connect")
+    assert reg.fire("tcp.connect") is None
+    assert reg.fired("tcp.connect") == 1
+    # @3+: every call from the third.
+    assert reg.fire("engine.step") is None
+    assert reg.fire("engine.step") is None
+    for _ in range(3):
+        with pytest.raises(CrashFault):
+            reg.fire("engine.step")
+    # Unarmed point: never fires.
+    assert reg.fire("lease.keepalive") is None
+    reg.disarm()
+    assert not reg.armed and reg.fire("tcp.connect") is None
+
+
+def test_fault_grammar_rejects_garbage():
+    reg = FaultRegistry()
+    with pytest.raises(ValueError, match="unknown fault point"):
+        reg.arm("tcp.conncet:drop")  # typo fails loudly at arm time
+    with pytest.raises(ValueError, match="unknown fault action"):
+        reg.arm("tcp.connect:explode")
+    with pytest.raises(ValueError, match="probability"):
+        reg.arm("tcp.connect:drop@1.5")
+    with pytest.raises(ValueError, match="1-based"):
+        reg.arm("tcp.connect:drop@0")
+
+
+def test_probabilistic_fault_is_deterministic_per_seed():
+    def firing_pattern(seed):
+        reg = FaultRegistry()
+        reg.arm("tcp.read:drop@0.3", seed=seed)
+        out = []
+        for _ in range(50):
+            try:
+                reg.fire("tcp.read")
+                out.append(0)
+            except DropFault:
+                out.append(1)
+        return out
+
+    a, b = firing_pattern(7), firing_pattern(7)
+    assert a == b and 0 < sum(a) < 50  # same seed, same sequence; actually fires
+    assert firing_pattern(8) != a  # different seed, different sequence
+
+
+def test_corrupt_bytes_flips_and_preserves_length():
+    buf = b"\x00\x01\x02"
+    assert corrupt_bytes(buf) == b"\xff\x01\x02"
+    assert corrupt_bytes(b"") == b""
+
+
+async def test_unarmed_plane_is_one_attribute_check(monkeypatch):
+    """DYN_FAULTS unset -> FAULTS.armed is False and no call site ever
+    reaches fire(): a request flows through TCP transport, store watch, and
+    the engine loop with fire() booby-trapped."""
+    from dynamo_tpu.mocker import build_mock_service
+    from dynamo_tpu.protocols.common import PreprocessedRequest, SamplingOptions, StopConditions
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.discovery import MemoryStore
+    from dynamo_tpu.runtime.engine import Context, collect
+    from dynamo_tpu.runtime.tcp import TcpTransport
+
+    assert FAULTS.armed is False
+
+    def boom(point):
+        raise AssertionError(f"fire({point!r}) called while disarmed")
+
+    monkeypatch.setattr(FAULTS, "fire", boom)
+    svc = await build_mock_service()
+    try:
+        req = PreprocessedRequest(
+            token_ids=[1, 2, 3], sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=3),
+        )
+        outs = [o async for o in svc.generate(req.to_dict(), Context())]
+        assert outs[-1]["finish_reason"] == "length"
+    finally:
+        await svc.close()
+    rt = DistributedRuntime(MemoryStore(), TcpTransport())
+    try:
+        ep = rt.namespace("ns").component("c").endpoint("e")
+        await ep.serve(_Tagged("w"))
+        client = ep.client()
+        await client.wait_for_instances(count=1, timeout=5)
+        items = await collect(client.generate({"q": 1}))
+        assert items[0]["tag"] == "w"
+    finally:
+        await rt.close()
+
+
+def test_fault_point_coverage():
+    """Invokes the tools/ coverage gate (every registered point armed here)."""
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "tools"))
+    try:
+        import check_fault_points
+    finally:
+        sys.path.pop(0)
+    assert check_fault_points.registered_points() == sorted(FAULT_POINTS)
+    assert check_fault_points.uncovered_points() == []
+    assert check_fault_points.main() == 0
+    # A point absent from a hypothetical suite is reported.
+    assert check_fault_points.uncovered_points("nothing armed") == sorted(FAULT_POINTS)
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+
+def test_breaker_state_machine():
+    from dynamo_tpu.runtime.client import (
+        BREAKER_CLOSED,
+        BREAKER_HALF_OPEN,
+        BREAKER_OPEN,
+        CircuitBreaker,
+    )
+
+    b = CircuitBreaker(threshold=2, open_seconds=1.0)
+    assert b.state == BREAKER_CLOSED and b.allow(100.0)
+    b.record_failure(100.0)
+    assert b.state == BREAKER_CLOSED  # below threshold: still routable
+    b.record_failure(100.1)
+    assert b.state == BREAKER_OPEN and not b.allow(100.5)
+    assert b.allow(101.2)  # open window elapsed: probe admissible
+    b.begin_attempt(101.2)
+    assert b.state == BREAKER_HALF_OPEN
+    assert not b.allow(101.3)  # one probe at a time
+    b.record_failure(101.3)  # probe failed: reopen from now
+    assert b.state == BREAKER_OPEN and not b.allow(102.0) and b.allow(102.4)
+    b.begin_attempt(102.4)
+    b.record_success()
+    assert b.state == BREAKER_CLOSED and b.failures == 0 and b.allow(102.4)
+    # Interleaved success resets the consecutive-failure count.
+    b.record_failure(103.0)
+    b.record_success()
+    b.record_failure(103.1)
+    assert b.state == BREAKER_CLOSED
+
+
+class _Tagged:
+    """Minimal AsyncEngine for routing tests."""
+
+    def __init__(self, tag):
+        self.tag = tag
+        self.calls = 0
+
+    async def generate(self, request, context):
+        self.calls += 1
+        yield {"tag": self.tag, "echo": request}
+
+
+async def test_direct_mode_no_instances_error_carries_context():
+    from dynamo_tpu.runtime.client import NoInstancesError
+    from dynamo_tpu.runtime.component import DistributedRuntime
+
+    rt = DistributedRuntime.detached()
+    try:
+        ep = rt.namespace("ns").component("c").endpoint("e")
+        inst = await ep.serve(_Tagged("w"))
+        client = ep.client(router_mode="direct")
+        await client.wait_for_instances(count=1, timeout=5)
+        with pytest.raises(NoInstancesError) as exc_info:
+            async for _ in client.generate({}, instance_id=0xDEAD):
+                pass
+        assert exc_info.value.endpoint_path == ep.path
+        assert exc_info.value.known_instances == 1
+        # Direct mode respects the breaker: enough recorded failures make
+        # even a live pinned instance unroutable.
+        for _ in range(client._breaker_threshold):
+            client.inhibit(inst.instance_id)
+        with pytest.raises(NoInstancesError, match="breaker open"):
+            client._pick(inst.instance_id)
+    finally:
+        await rt.close()
+
+
+async def test_draining_instance_is_ineligible():
+    """A worker announcing metadata.draining=True stops receiving new
+    requests while its record (and in-flight streams) stay alive."""
+    import dataclasses
+
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.discovery import MemoryStore
+
+    store = MemoryStore()
+    rt1 = DistributedRuntime(store)
+    rt2 = DistributedRuntime(store, rt1.transport)
+    try:
+        e1, e2 = _Tagged("a"), _Tagged("b")
+        i1 = await rt1.namespace("ns").component("c").endpoint("e").serve(e1)
+        await rt2.namespace("ns").component("c").endpoint("e").serve(e2)
+        client = rt1.namespace("ns").component("c").endpoint("e").client()
+        await client.wait_for_instances(count=2, timeout=5)
+        draining = dataclasses.replace(i1, metadata={**i1.metadata, "draining": True})
+        await store.put(i1.key, draining.to_bytes(), lease_id=i1.instance_id)
+        from conftest import wait_for
+
+        assert await wait_for(
+            lambda: bool(client._instances.get(i1.instance_id, i1).metadata.get("draining"))
+        )
+        for _ in range(6):
+            async for item in client.generate({}):
+                assert item["tag"] == "b"
+    finally:
+        await rt1.close()
+        await rt2.close()
+
+
+# -- watch-loop resilience ---------------------------------------------------
+
+
+async def test_watch_loop_restarts_after_store_watch_death():
+    """satellite (a): a dying instance watch reconnects (counted + warned)
+    instead of leaving the client frozen on a stale table forever."""
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.discovery import MemoryStore
+
+    store = MemoryStore()
+    rt = DistributedRuntime(store)
+    try:
+        ep = rt.namespace("ns").component("c").endpoint("e")
+        await ep.serve(_Tagged("a"))
+        FAULTS.arm("store.watch:crash@1")  # kills the first event delivery
+        client = ep.client()
+        await client.start()
+        from conftest import wait_for
+
+        assert await wait_for(lambda: client.watch_restarts >= 1, timeout=10)
+        # The restarted watch is live: a new instance becomes visible.
+        rt2 = DistributedRuntime(store, rt.transport)
+        await rt2.namespace("ns").component("c").endpoint("e").serve(_Tagged("b"))
+        assert await wait_for(lambda: len(client.instances()) == 2, timeout=10)
+        assert client.watch_staleness() == 0.0  # healthy again
+        from dynamo_tpu.runtime.client import watch_snapshot
+
+        assert watch_snapshot()[ep.path]["restarts"] >= 1
+        await rt2.close()
+    finally:
+        await rt.close()
+
+
+# -- store / lease / tcp drills ---------------------------------------------
+
+
+async def test_store_op_fault_drill():
+    from dynamo_tpu.runtime.store_server import StoreClient, StoreServer
+
+    server = await StoreServer(host="127.0.0.1", port=0).start()
+    client = StoreClient("127.0.0.1", server.port)
+    try:
+        await client.put("k", b"v")
+        FAULTS.arm("store.op:drop@1")
+        with pytest.raises(ConnectionError):
+            await client.get("k")
+        assert await client.get("k") == b"v"  # next op unaffected
+        assert FAULTS.fired("store.op") == 1
+    finally:
+        await client.close()
+        await server.close()
+
+
+async def test_lease_keepalive_fault_drill():
+    from dynamo_tpu.runtime.discovery import MemoryStore
+
+    store = MemoryStore()
+    lease = await store.create_lease(5.0)
+    FAULTS.arm("lease.keepalive:drop@1")
+    with pytest.raises(ConnectionError):
+        await store.keep_alive(lease.id)
+    await store.keep_alive(lease.id)  # refresh path recovers
+
+
+async def test_tcp_faults_are_retried_transparently():
+    """Caller-side connect/write/read drops are absorbed by the client's
+    cross-replica retry (here: same instance, second attempt) — the request
+    still completes and the breaker stays below threshold."""
+    from dynamo_tpu.runtime.client import BREAKER_CLOSED
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.discovery import MemoryStore
+    from dynamo_tpu.runtime.engine import collect
+    from dynamo_tpu.runtime.tcp import TcpTransport
+
+    rt = DistributedRuntime(MemoryStore(), TcpTransport())
+    try:
+        ep = rt.namespace("ns").component("c").endpoint("e")
+        engine = _Tagged("w")
+        inst = await ep.serve(engine)
+        for point in ("tcp.connect", "tcp.write", "tcp.read"):
+            client = ep.client()
+            await client.wait_for_instances(count=1, timeout=5)
+            FAULTS.arm(f"{point}:drop@1")
+            items = await collect(client.generate({"p": point}))
+            assert items[0]["tag"] == "w", point
+            assert FAULTS.fired(point) == 1, point
+            assert client.breaker_states()[inst.instance_id] == BREAKER_CLOSED
+            FAULTS.disarm()
+    finally:
+        await rt.close()
+
+
+# -- engine service ----------------------------------------------------------
+
+
+def _req(max_tokens=5):
+    from dynamo_tpu.protocols.common import PreprocessedRequest, SamplingOptions, StopConditions
+
+    return PreprocessedRequest(
+        token_ids=[1, 2, 3], sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=max_tokens),
+    ).to_dict()
+
+
+async def test_engine_step_crash_fails_streams_and_recovers():
+    """An injected step crash fails in-flight streams with a terminal error
+    item, records the crash in the flight ring, and the loop keeps serving."""
+    from dynamo_tpu.mocker import build_mock_service
+    from dynamo_tpu.observability.flight import CRASH
+    from dynamo_tpu.runtime.engine import Context
+
+    svc = await build_mock_service()
+    try:
+        FAULTS.arm("engine.step:crash@1")
+        outs = [o async for o in svc.generate(_req(), Context())]
+        assert outs[-1]["finish_reason"] == "error"
+        crashes = svc.core.flight.snapshot(kind=CRASH)
+        assert any(c.get("where") == "engine_loop" and c.get("error") == "CrashFault" for c in crashes)
+        # The fault is spent (@1): the very next request completes normally.
+        outs = [o async for o in svc.generate(_req(), Context())]
+        assert outs[-1]["finish_reason"] == "length"
+        assert sum(len(o["token_ids"]) for o in outs) == 5
+    finally:
+        await svc.close()
+
+
+async def test_intake_drain_on_dead_loop_fails_queued_requests():
+    """satellite (c): a request queued at intake but never admitted gets a
+    terminal error item (not a hang) and the flight ring records the drain."""
+    import time as time_mod
+
+    from dynamo_tpu.engine.service import _SENTINEL, JaxEngineService
+    from dynamo_tpu.mocker import build_mock_core
+    from dynamo_tpu.observability.flight import CRASH
+    from dynamo_tpu.protocols.common import FinishReason
+    from dynamo_tpu.runtime.engine import Context
+
+    svc = JaxEngineService(build_mock_core())  # loop never started: dead engine
+    out_q = asyncio.Queue()
+    svc._intake.put_nowait((_req(), Context(), out_q, time_mod.perf_counter()))
+    await svc.close()
+    item = out_q.get_nowait()
+    assert item.finish_reason is FinishReason.ERROR
+    assert out_q.get_nowait() is _SENTINEL  # consumer unblocks, no hang
+    crashes = svc.core.flight.snapshot(kind=CRASH)
+    assert any(c.get("where") == "intake_drain" and c.get("drained") == 1 for c in crashes)
+
+
+async def test_engine_drain_finishes_inflight_then_refuses():
+    from dynamo_tpu.mocker import build_mock_service
+    from dynamo_tpu.runtime.engine import Context
+
+    svc = await build_mock_service()
+    try:
+        stream_task = asyncio.create_task(
+            _collect_tokens(svc, _req(max_tokens=20))
+        )
+        await asyncio.sleep(0.05)  # let it get admitted
+        drained = await svc.drain(timeout=30.0)
+        assert drained is True
+        assert len(await stream_task) == 20  # in-flight work finished intact
+        with pytest.raises(RuntimeError, match="draining"):
+            async for _ in svc.generate(_req(), Context()):
+                pass
+    finally:
+        await svc.close()
+
+
+async def _collect_tokens(svc, req):
+    from dynamo_tpu.runtime.engine import Context
+
+    return [t async for o in svc.generate(req, Context()) for t in o["token_ids"]]
+
+
+# -- KV wire integrity -------------------------------------------------------
+
+
+async def test_kv_chunk_send_corruption_detected_and_retried():
+    """kv.chunk.send:corrupt@1 mangles the first wire chunk; the receiver's
+    crc check rejects it without touching session state, the sender retries
+    that chunk once from its clean copy, and the stream completes
+    byte-identical with zero rollbacks."""
+    from dynamo_tpu.disagg.transfer import KvTransferService, send_blocks_chunked
+    from dynamo_tpu.runtime.transport import InMemoryTransport
+    from dynamo_tpu.tokens import compute_block_hashes
+    from tests.test_transfer_pipeline import PAGE, _commit_chain, _core
+
+    src, dst = _core(), _core()
+    hashes = compute_block_hashes(list(range(5 * PAGE)), PAGE, salt=0)
+    payloads = _commit_chain(src, hashes)
+    transport = InMemoryTransport()
+    svc = KvTransferService(dst)
+    await transport.register_engine("kv", svc)
+
+    FAULTS.arm("kv.chunk.send:corrupt@1")
+    out = await send_blocks_chunked(transport, "mem://kv", "r1", src, hashes, chunk_pages=2)
+    assert out["injected"] == 5 and out["crc_retries"] == 1
+    assert svc.crc_failures == 1 and svc.rollbacks == 0
+    pids = dst.allocator.match_prefix(hashes)
+    assert len(pids) == 5
+    for pid, h in zip(pids, hashes):
+        k_got, v_got = dst.runner.read_page(pid)
+        np.testing.assert_array_equal(k_got, payloads[h][0])
+        np.testing.assert_array_equal(v_got, payloads[h][1])
+    dst.allocator.release(pids)
+
+
+async def test_kv_chunk_recv_drop_rolls_back_stream():
+    """A receiver-side failure mid-stream rolls the session back: pins are
+    released and the decode worker is left with at most a valid, evictable
+    chain prefix — never a pinned or inconsistent partial transfer."""
+    from dynamo_tpu.disagg.transfer import KvTransferService, send_blocks_chunked
+    from dynamo_tpu.runtime.transport import InMemoryTransport
+    from dynamo_tpu.tokens import compute_block_hashes
+    from tests.test_transfer_pipeline import PAGE, _commit_chain, _core
+
+    src, dst = _core(), _core()
+    hashes = compute_block_hashes(list(range(5 * PAGE)), PAGE, salt=0)
+    _commit_chain(src, hashes)
+    transport = InMemoryTransport()
+    svc = KvTransferService(dst)
+    await transport.register_engine("kv", svc)
+
+    FAULTS.arm("kv.chunk.recv:drop@2")  # chunk 1 lands, chunk 2 dies
+    with pytest.raises(Exception):
+        await send_blocks_chunked(transport, "mem://kv", "r1", src, hashes, chunk_pages=2)
+    assert svc.rollbacks == 1
+    # Rollback drops the session and its pins. The chain-consistent prefix
+    # the first chunk already committed stays as ordinary evictable cache
+    # (it is valid KV), but the full chain never materializes and nothing
+    # is left pinned.
+    committed = dst.allocator.match_prefix(hashes)
+    assert len(committed) < 5
+    dst.allocator.release(committed)
+    assert svc.stats()["streams_in_flight"] == 0
+
+
+async def test_v1_crc_mismatch_truncates_chain():
+    """The monolithic (v1) path has no retry channel: a corrupt block
+    truncates the chain at the first bad block, keeping every committed
+    prefix valid."""
+    from dynamo_tpu.disagg.transfer import KvTransferService, send_blocks
+    from dynamo_tpu.runtime.transport import InMemoryTransport
+    from dynamo_tpu.tokens import compute_block_hashes
+    from tests.test_transfer_pipeline import PAGE, _core, _zero_blocks
+
+    dst = _core()
+    svc = KvTransferService(dst)
+    transport = InMemoryTransport()
+    await transport.register_engine("kv", svc)
+    hashes = compute_block_hashes(list(range(3 * PAGE)), PAGE, salt=0)
+    blocks = _zero_blocks(hashes)
+    blocks[1]["k"] = corrupt_bytes(blocks[1]["k"])
+    out = await send_blocks(transport, "mem://kv", "r1", blocks)
+    assert out["injected"] == 1  # blocks after (and including) the bad one dropped
+    assert svc.crc_failures == 1
+    assert len(dst.allocator.match_prefix(hashes)) == 1
+
+
+# -- prefill queue redelivery ------------------------------------------------
+
+
+async def test_queue_release_counts_requeue_on_peer():
+    from dynamo_tpu.disagg.queue import DistributedQueue
+    from dynamo_tpu.runtime.component import DistributedRuntime
+
+    rt = DistributedRuntime.detached()
+    try:
+        q1 = DistributedQueue(rt, "t")
+        await q1.put({"job": "a"})
+        key, _ = await q1.claim(timeout=2)
+        assert q1.requeues == 0  # first delivery is not a requeue
+        await q1.release(key)
+        rt2 = DistributedRuntime(rt.store, rt.transport)
+        q2 = DistributedQueue(rt2, "t")
+        rekey, item = await q2.claim(timeout=2)
+        assert rekey == key and item["job"] == "a"
+        assert q2.requeues == 1  # the peer knows it got a redelivery
+        await q2.delete(rekey)
+        # Ack cleans the delivered marker: a fresh task under the same name
+        # is not miscounted.
+        await q1.put({"job": "b"})
+        k2, _ = await q1.claim(timeout=2)
+        assert q1.requeues == 0
+        await q1.delete(k2)
+        await rt2.close()
+    finally:
+        await rt.close()
+
+
+async def test_queue_lease_expiry_counts_requeue():
+    from dynamo_tpu.disagg.queue import DistributedQueue
+    from dynamo_tpu.runtime.component import DistributedRuntime
+
+    rt = DistributedRuntime.detached()
+    try:
+        producer = DistributedQueue(rt, "t")
+        await producer.put({"job": "a"})
+        claimant_rt = DistributedRuntime(rt.store, rt.transport, lease_ttl=0.3)
+        cq = DistributedQueue(claimant_rt, "t")
+        await cq.claim(timeout=2)
+        claimant_rt._keepalive_task.cancel()  # claimant dies
+        await asyncio.sleep(0.8)
+        reclaimed = await producer.claim(timeout=5)
+        assert reclaimed is not None and reclaimed[1]["job"] == "a"
+        assert producer.requeues == 1
+    finally:
+        await rt.close()
+
+
+@pytest.mark.e2e
+async def test_prefill_crash_requeues_to_peer_before_local_fallback():
+    """prefill.exec:crash@1 kills the first worker's attempt; the claim is
+    released, a peer reclaims and completes it, and the decode side never
+    falls back to local prefill."""
+    from dynamo_tpu.disagg.router import DisaggConfig
+    from dynamo_tpu.launch import run_local
+
+    disagg = DisaggConfig(max_local_prefill_length=24, min_remote_prefill_blocks=1)
+    handles = await run_local(
+        "test-tiny", port=0, num_workers=1, num_prefill_workers=2,
+        disagg=disagg, num_pages=64, max_batch_size=8,
+    )
+    base = f"http://127.0.0.1:{handles['port']}"
+    try:
+        FAULTS.arm("prefill.exec:crash@1")
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "test-tiny", "prompt": "r" * 48, "max_tokens": 4, "temperature": 0}
+            async with s.post(base + "/v1/completions", json=body) as r:
+                assert r.status == 200, await r.text()
+                out = await r.json()
+        assert out["usage"]["prompt_tokens_details"]["cached_tokens"] >= 32
+        assert FAULTS.fired("prefill.exec") == 1
+        operator = handles["services"][0].disagg_operator
+        assert operator.remote_prefills == 1 and operator.local_prefills == 0
+        workers = [
+            svc.prefill_worker for svc in handles["services"]
+            if getattr(svc, "prefill_worker", None) is not None
+        ]
+        assert len(workers) == 2
+        assert sum(w.queue.requeues for w in workers) == 1  # peer saw a redelivery
+        assert sum(w.completed for w in workers) == 1
+    finally:
+        FAULTS.disarm()
+        from tests.conftest import stop_stack
+
+        await stop_stack(handles)
+
+
+# -- end-to-end: mid-stream death, breaker, drain ----------------------------
+
+
+@pytest.mark.e2e
+async def test_midstream_crash_sse_error_breaker_and_failover(monkeypatch):
+    """The flagship scenario: an engine dies mid-SSE-stream -> the client
+    gets a structured OpenAI-style error event (no traceback) and [DONE];
+    then that worker's engine is killed outright -> its breaker opens and
+    follow-up requests succeed on the surviving replica."""
+    monkeypatch.setenv("DYN_CLIENT_BREAKER_THRESHOLD", "1")
+    from tests.conftest import start_stack, stop_stack
+
+    handles, base = await start_stack(num_workers=2)
+    try:
+        async with aiohttp.ClientSession() as s:
+            # Warm up: both replicas serve.
+            body = {"model": "test-tiny", "prompt": "warm", "max_tokens": 2, "temperature": 0}
+            async with s.post(base + "/v1/completions", json=body) as r:
+                assert r.status == 200
+
+            FAULTS.arm("engine.step:crash@3")
+            stream_body = {
+                "model": "test-tiny", "prompt": "stream me", "max_tokens": 16,
+                "temperature": 0, "stream": True,
+            }
+            events, done = [], False
+            async with s.post(base + "/v1/completions", json=stream_body) as r:
+                assert r.status == 200  # headers were already out: stays 200
+                raw = await r.text()
+            for line in raw.splitlines():
+                if not line.startswith("data: "):
+                    continue
+                payload = line[len("data: "):]
+                if payload == "[DONE]":
+                    done = True
+                    break
+                events.append(json.loads(payload))
+            assert done  # the stream closed cleanly, not mid-frame
+            errors = [e for e in events if "error" in e]
+            assert len(errors) == 1
+            assert errors[0]["error"]["code"] == "mid_stream_failure"
+            assert errors[0]["error"]["type"] == "engine_error"
+            assert "Traceback" not in raw and "CrashFault" not in raw
+            FAULTS.disarm()
+
+            # Kill one worker's engine outright: requests that land on it
+            # fail pre-stream, its breaker opens (threshold 1), and every
+            # follow-up completes on the surviving replica.
+            await handles["services"][0].close()
+            for _ in range(4):
+                async with s.post(base + "/v1/completions", json=body) as r:
+                    assert r.status == 200, await r.text()
+
+            from dynamo_tpu.runtime.client import BREAKER_OPEN, breaker_snapshot
+
+            assert BREAKER_OPEN in breaker_snapshot().values()
+            async with s.get(base + "/metrics") as r:
+                metrics_text = await r.text()
+            assert "dynamo_client_breaker_state" in metrics_text
+    finally:
+        FAULTS.disarm()
+        await stop_stack(handles)
+
+
+@pytest.mark.e2e
+async def test_drain_worker_hands_off_to_replica():
+    """drain_worker: the drained worker's record goes away (draining ->
+    lease revoked), new requests land on the replica, the service refuses
+    late arrivals."""
+    from dynamo_tpu.launch import drain_worker
+    from tests.conftest import start_stack, stop_stack
+
+    handles, base = await start_stack(num_workers=2)
+    try:
+        victim = handles["services"][0]
+        instance_key = victim.instance.key
+        done = await drain_worker(handles["runtime"], victim, timeout=10.0)
+        assert done is True
+        assert victim._draining and victim._closed
+        store = handles["runtime"].store
+        assert await store.get(instance_key) is None  # lease revoked: record gone
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "test-tiny", "prompt": "after drain", "max_tokens": 2, "temperature": 0}
+            for _ in range(3):
+                async with s.post(base + "/v1/completions", json=body) as r:
+                    assert r.status == 200, await r.text()
+    finally:
+        await stop_stack(handles)

@@ -175,6 +175,9 @@ EXPECTED_ENGINE_FAMILIES = {
     "dynamo_kv_transfer_blocks_total",
     "dynamo_kv_transfer_bytes_total",
     "dynamo_kv_transfer_streams_in_flight",
+    "dynamo_kv_transfer_crc_failures_total",
+    "dynamo_kv_transfer_rollbacks_total",
+    "dynamo_engine_prefill_requeues_total",
     "dynamo_kv_transfer_phase_seconds",
     # prometheus_client emits the histogram's _created timestamps as their
     # own gauge family once a labelled child exists.

@@ -1,0 +1,54 @@
+"""Fault-point coverage check: every injection point registered in
+``dynamo_tpu.runtime.faults.FAULT_POINTS`` must be armed at least once in
+``tests/test_chaos.py``.
+
+The fault plane is only as trustworthy as its exercise: a point that is
+threaded through production code but never armed in the chaos suite is dead
+instrumentation — its failure-handling path has never run, which is exactly
+the bug class the plane exists to kill. This tool greps the chaos suite's
+source for each registered point name (the names are unusual enough —
+``kv.chunk.recv``, ``lease.keepalive`` — that a plain substring match is
+reliable) and fails listing any absentees. Run directly
+(``python tools/check_fault_points.py``) or via the test suite
+(``tests/test_chaos.py::test_fault_point_coverage``).
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+CHAOS_SUITE = pathlib.Path(__file__).resolve().parent.parent / "tests" / "test_chaos.py"
+
+
+def registered_points() -> list[str]:
+    from dynamo_tpu.runtime.faults import FAULT_POINTS
+
+    return sorted(FAULT_POINTS)
+
+
+def uncovered_points(source: str | None = None) -> list[str]:
+    """Registered fault points that never appear in the chaos suite."""
+    if source is None:
+        source = CHAOS_SUITE.read_text()
+    return [point for point in registered_points() if point not in source]
+
+
+def main() -> int:
+    if not CHAOS_SUITE.exists():
+        print(f"FAIL: chaos suite missing at {CHAOS_SUITE}", file=sys.stderr)
+        return 1
+    missing = uncovered_points()
+    if missing:
+        for point in missing:
+            print(f"FAIL: fault point {point!r} is never armed in {CHAOS_SUITE.name}", file=sys.stderr)
+        return 1
+    n = len(registered_points())
+    print(f"ok: all {n} registered fault points are exercised by {CHAOS_SUITE.name}")
+    return 0
+
+
+if __name__ == "__main__":
+    # Direct CLI use from a checkout: make the repo importable.
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    sys.exit(main())
