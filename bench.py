@@ -426,6 +426,115 @@ def probe_decode_stall() -> dict:
     return out
 
 
+def probe_spec_decode() -> dict:
+    """Speculative-decoding probe: lossless n-gram drafting vs plain decode.
+
+    Runs the identical repetitive-prompt decode scenario twice — spec_k=0
+    (plain mixed steps) and spec_k=K (draft + batched verify) — and reports
+    per-mode decode throughput plus the drafter's acceptance rate from the
+    engine's own counters. Prompts tile a short token pattern so the
+    prompt-lookup drafter has structure to match (the regime speculative
+    decoding targets; uniform-random text pins acceptance near zero and
+    the probe would only measure verify overhead).
+
+    Like the stall probe, each mode runs the scenario twice on one engine
+    and reports the second pass: the verify dispatch adds a (verify_width,
+    lp_k) axis to the step-bucket lattice, so only an identical dry run
+    provably compiles every shape the measurement hits.
+
+    Top-level bench JSON promotes ``spec_accept_rate`` (accepted/proposed
+    draft tokens, measured pass) and ``spec_decode_speedup`` (spec tok/s
+    over baseline tok/s; >1 means drafting paid for its verify overhead).
+    """
+    import jax
+
+    from dynamo_tpu.engine.core import EngineConfig, EngineCore
+    from dynamo_tpu.engine.runner import ModelRunner
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import PRESETS
+    from dynamo_tpu.protocols.common import PreprocessedRequest, SamplingOptions, StopConditions
+
+    preset = os.environ.get("BENCH_SPEC_PRESET", "llama-3.2-1b")
+    spec_k = int(os.environ.get("BENCH_SPEC_K", "4"))
+    batch = int(os.environ.get("BENCH_SPEC_BATCH", "8"))
+    isl = int(os.environ.get("BENCH_SPEC_ISL", "128"))
+    osl = int(os.environ.get("BENCH_SPEC_OSL", "128"))
+    chunk = int(os.environ.get("BENCH_SPEC_CHUNK", "512"))
+    cfg = PRESETS[preset]
+    page_size = int(os.environ.get("BENCH_PAGE_SIZE", "128"))
+    num_pages = batch * ((isl + osl) // page_size + 2) + 8
+    params = llama.init_params(cfg, 0)
+    rng = np.random.default_rng(2)
+    pattern = rng.integers(1, cfg.vocab_size - 1, size=16).tolist()
+    prompts = []
+    for i in range(batch):
+        # Rotate the shared pattern per request so rows aren't identical
+        # but every prompt is still periodic (drafter-matchable).
+        rot = pattern[i % len(pattern):] + pattern[:i % len(pattern)]
+        prompts.append((rot * (isl // len(rot) + 1))[:isl])
+
+    def run(k: int) -> dict:
+        runner = ModelRunner(
+            cfg, params, num_pages=num_pages, page_size=page_size,
+            max_batch_size=batch, prefill_bucket=max(isl, 64),
+        )
+        core = EngineCore(runner, EngineConfig(
+            num_pages=num_pages, page_size=page_size, max_batch_size=batch,
+            max_prefill_tokens=isl * batch, max_seq_len=isl + osl + 8,
+            enable_prefix_caching=False, chunk_prefill_tokens=chunk,
+            spec_k=k,
+        ))
+
+        def scenario() -> dict:
+            for prompt in prompts:
+                core.add_request(PreprocessedRequest(
+                    token_ids=prompt,
+                    sampling=SamplingOptions(temperature=0.0),
+                    stop=StopConditions(max_tokens=osl, ignore_eos=True),
+                ))
+            while core.waiting or core.prefilling:
+                core.step()
+            p0, a0 = core.spec_tokens_proposed, core.spec_tokens_accepted
+            t0 = time.perf_counter()
+            generated = 0
+            steps = 0
+            while core.has_work:
+                outputs = core.step()
+                generated += sum(len(o.token_ids) for _, o in outputs)
+                steps += 1
+            elapsed = time.perf_counter() - t0
+            proposed = core.spec_tokens_proposed - p0
+            accepted = core.spec_tokens_accepted - a0
+            return {
+                "spec_k": k,
+                "tok_per_sec": round(generated / elapsed, 1) if elapsed > 0 else 0.0,
+                "decode_tokens": generated,
+                "decode_steps": steps,
+                "spec_tokens_proposed": proposed,
+                "spec_tokens_accepted": accepted,
+                "spec_accept_rate": round(accepted / proposed, 4) if proposed else 0.0,
+            }
+
+        scenario()  # dry run: compiles every bucket the measured pass hits
+        return scenario()
+
+    out = {
+        "preset": preset, "batch": batch, "isl": isl, "osl": osl,
+        "backend": jax.default_backend(),
+    }
+    spec = run(spec_k)
+    gc.collect()
+    baseline = run(0)
+    gc.collect()
+    out["spec"] = spec
+    out["baseline"] = baseline
+    out["spec_accept_rate"] = spec["spec_accept_rate"]
+    out["spec_decode_speedup"] = round(
+        spec["tok_per_sec"] / baseline["tok_per_sec"], 4
+    ) if baseline["tok_per_sec"] > 0 else 0.0
+    return out
+
+
 def probe_kv_pull_gbps() -> dict:
     """Device-path KV transfer bandwidth (BASELINE north-star metric).
 
@@ -509,7 +618,7 @@ def probe_cross_process_wire() -> dict:
     )
 
 
-def build_doc(configs, pull, wire=None, stall=None) -> dict:
+def build_doc(configs, pull, wire=None, stall=None, spec=None) -> dict:
     """The bench JSON document (one stdout line per emit).
 
     Module-level (not a closure) so its top-level key contract — the stable
@@ -535,11 +644,17 @@ def build_doc(configs, pull, wire=None, stall=None) -> dict:
         # goodput at p50 TTFT <= 500 ms, so BENCH_*.json tracks it directly.
         "goodput_tokens_per_s_at_slo": head.get("goodput_tokens_per_s_at_slo", 0.0),
         "slo_ttft_attainment": head.get("slo_ttft_attainment", 0.0),
+        # Speculative decoding headline keys (ISSUE 6): acceptance rate and
+        # spec-over-baseline decode speedup from the spec probe's measured
+        # pass (repetitive-prompt scenario, see probe_spec_decode).
+        "spec_accept_rate": (spec or {}).get("spec_accept_rate", 0.0),
+        "spec_decode_speedup": (spec or {}).get("spec_decode_speedup", 0.0),
         "detail": {
             "backend": jax.default_backend(),
             "suite": [c.get("preset") for c in configs],
             "configs": configs,
             "stall_probe": stall or {"pending": True},
+            "spec_probe": spec or {"pending": True},
             "kv_pull": pull,
             "kv_wire_cross_process": wire or {"pending": True},
             "ttft_note": "ttft_idle_* is the drained-engine best case; "
@@ -551,8 +666,8 @@ def build_doc(configs, pull, wire=None, stall=None) -> dict:
 def main() -> None:
     from dynamo_tpu.models.config import PRESETS
 
-    def emit(configs, pull, wire=None, stall=None):
-        print(json.dumps(build_doc(configs, pull, wire, stall)), flush=True)
+    def emit(configs, pull, wire=None, stall=None, spec=None):
+        print(json.dumps(build_doc(configs, pull, wire, stall, spec)), flush=True)
 
     suite = parse_suite()
     configs = []
@@ -588,16 +703,22 @@ def main() -> None:
     emit(configs, {"pending": True}, stall=stall)
     gc.collect()
     try:
+        spec = probe_spec_decode()
+    except Exception as e:
+        spec = {"error": f"{type(e).__name__}: {e}"[:200]}
+    emit(configs, {"pending": True}, stall=stall, spec=spec)
+    gc.collect()
+    try:
         pull = probe_kv_pull_gbps()
     except Exception as e:
         pull = {"error": f"{type(e).__name__}: {e}"[:200]}
-    emit(configs, pull, stall=stall)
+    emit(configs, pull, stall=stall, spec=spec)
     gc.collect()
     try:
         wire = probe_cross_process_wire()
     except Exception as e:
         wire = {"error": f"{type(e).__name__}: {e}"[:200]}
-    emit(configs, pull, wire, stall=stall)
+    emit(configs, pull, wire, stall=stall, spec=spec)
 
 
 if __name__ == "__main__":

@@ -122,6 +122,12 @@ class WorkerSettings:
     # Per-step prefill chunk budget while decodes are running (stall-free
     # mixed steps); 0 restores phase-exclusive prefill-XOR-decode steps.
     chunk_prefill_tokens: int = 512
+    # Speculative decoding draft length (n-gram self-drafting, lossless);
+    # 0 disables. See docs/SCHEDULER.md "Speculative steps".
+    spec_k: int = 0
+    # KV-cache storage dtype: 'bf16' (default) or 'fp8' (float8_e4m3fn,
+    # halves KV HBM; attention upcasts to the query dtype at the matmul).
+    kv_cache_dtype: str = "bf16"
 
 
 @dataclasses.dataclass

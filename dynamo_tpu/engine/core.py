@@ -92,6 +92,14 @@ class EngineConfig:
     # decodes to coalesce against. 0 disables chunking (legacy
     # prefill-XOR-decode steps; the bench stall probe's baseline).
     chunk_prefill_tokens: int = 512
+    # Speculative decoding (DYN_SPEC_K): max draft tokens per decode row per
+    # step, verified in one multi-token dispatch. 0 = off. Lossless: output
+    # streams are bit-identical to spec_k=0 (greedy and seeded) — the
+    # drafter only changes how many forwards the same tokens cost. Draft
+    # tokens are charged against chunk_prefill_tokens and the decode-first
+    # page reserve grows to cover spec_k+1 slots, so speculation composes
+    # with chunked prefill, admission, and preemption (docs/SCHEDULER.md).
+    spec_k: int = 0
 
 
 class EngineCore:
@@ -129,6 +137,16 @@ class EngineCore:
         self._eos = set(config.eos_token_ids)
         self.num_preemptions = 0
         self.admission_rejections = 0  # requests refused at add_request intake
+        # Speculative decoding: cumulative drafting/verify counters (metrics
+        # plane syncs them; acceptance rate = accepted / proposed).
+        self.spec_tokens_proposed = 0
+        self.spec_tokens_accepted = 0
+        self.spec_steps = 0
+        self._proposer = None
+        if config.spec_k > 0:
+            from dynamo_tpu.engine.spec import build_proposer
+
+            self._proposer = build_proposer()
         # Flight recorder: last-N-steps ring for postmortems. The compile
         # tracker (when the runner has one — mock runners don't) sinks its
         # first-execution events into the same ring, so a flight dump shows
@@ -349,6 +367,8 @@ class EngineCore:
                 decode_rows = int(info.get("decode_rows", 0))
                 chunk_rows = int(info.get("chunk_rows", 0))
                 chunk_tokens = int(info.get("chunk_tokens", 0))
+                spec_drafted = int(info.get("spec_drafted", 0))
+                spec_accepted = int(info.get("spec_accepted", 0))
                 kind = (
                     "mixed" if decode_rows and chunk_rows
                     else ("prefill" if chunk_rows else "decode")
@@ -356,6 +376,7 @@ class EngineCore:
             else:
                 decode_rows = len(self.running)
                 chunk_rows = chunk_tokens = 0
+                spec_drafted = spec_accepted = 0
                 kind = "decode" if self.running else "drain"
             dispatch_ms = (
                 (tracker.dispatch_seconds_total - disp0) * 1e3 if tracker is not None else 0.0
@@ -375,6 +396,11 @@ class EngineCore:
                 admission_rejections=self.admission_rejections,
                 mixed_steps=self.mixed_steps,
                 stall_violations=self.stall_violations,
+                spec_drafted=spec_drafted,
+                spec_accepted=spec_accepted,
+                spec_accept_rate=(
+                    round(spec_accepted / spec_drafted, 4) if spec_drafted else 0.0
+                ),
                 wall_ms=round(wall_ms, 3),
                 dispatch_ms=round(dispatch_ms, 3),
             )
@@ -394,11 +420,16 @@ class EngineCore:
             return out
         chunks = self._schedule_prefill()
         fused = self.config.chunk_prefill_tokens > 0
-        if chunks or (fused and self.running and self.prefilling):
+        if chunks or (fused and self.running and self.prefilling) or (
+            self._spec_active() and self.running
+        ):
             # Mixed step: decode rows + prefill-chunk rows in one dispatch.
             # Also taken with zero chunks scheduled (page-starved prefills):
             # decode must not wait on them. Legacy mode (fused=False) runs
-            # the scheduled whole prompts without decode rows (XOR).
+            # the scheduled whole prompts without decode rows (XOR). With
+            # speculation on, pure-decode steps route here too: the verify
+            # dispatch supersedes the burst/pipelined decode paths (drafts
+            # already amortize the per-step host round trip).
             with annotate("engine.mixed" if fused else "engine.prefill"):
                 out = cancelled + self._run_mixed(chunks)
         elif self.running:
@@ -458,8 +489,16 @@ class EngineCore:
             budget = self.config.max_prefill_tokens
         chunks: list[tuple[Sequence, int]] = []
         # Decode first: the running sequences' next-token pages are spoken
-        # for before any chunk is sized against the free pool.
-        reserve = sum(s.pages_needed(ps, 1) for s in self.running) if chunked else 0
+        # for before any chunk is sized against the free pool. Speculation
+        # widens the reserve to spec_k+1 slots per sequence — a chunk must
+        # never get pages a verify row needs this step (draft allocation is
+        # opportunistic and drops drafts rather than preempting, so without
+        # the reserve a full pool would silently disable speculation).
+        ahead = 1 + (self.config.spec_k if self._spec_active() else 0)
+        reserve = sum(
+            s.pages_needed(ps, min(ahead, s.remaining_tokens(self.config.max_seq_len)))
+            for s in self.running
+        ) if chunked else 0
 
         def free_pages() -> int:
             return max(0, self.allocator.num_free() - reserve)
@@ -581,6 +620,87 @@ class EngineCore:
                 seq.seq_id, num_new, self.allocator.num_free(), self._head_stall_steps,
             )
 
+    # -- speculative decoding ----------------------------------------------
+
+    def _spec_active(self) -> bool:
+        """Speculation runs only with a proposer AND a runner that has the
+        verify dispatch (mock/timing runners don't; spec_k is then inert)."""
+        return (
+            self.config.spec_k > 0
+            and self._proposer is not None
+            and hasattr(self.runner, "spec_step")
+        )
+
+    def _propose_drafts(
+        self, decode_rows: list[Sequence], chunks: list[tuple[Sequence, int]]
+    ) -> list[list[int]]:
+        """Per decode row, up to spec_k draft tokens for this step's verify.
+
+        Drafts are charged against the mixed step's chunk budget (whatever
+        the scheduled chunks left of it) — a draft token costs the same
+        forward FLOPs/bytes as a prefill-chunk token, so letting drafts
+        bypass the budget would reintroduce exactly the decode stalls the
+        budget bounds. Page extension is opportunistic: on exhaustion the
+        row's drafts are dropped rather than preempting anyone (speculation
+        is a throughput hint, never worth evicting real work).
+
+        Rows with repetition penalties or a decoding constraint never
+        draft: both sample from state that evolves per accepted token
+        (history counts, grammar machine), which the per-column verify
+        sample cannot replay. Their single-token column stays exact.
+        """
+        k = self.config.spec_k
+        budget = None
+        if self.config.chunk_prefill_tokens > 0:
+            budget = max(
+                0,
+                min(self.config.chunk_prefill_tokens, self.config.max_prefill_tokens)
+                - sum(n for _, n in chunks),
+            )
+        drafts: list[list[int]] = []
+        for s in decode_rows:
+            # remaining - 1: the verify step emits at most len(draft) + 1
+            # tokens, which must never overrun max_tokens / the context
+            # window (this is also what keeps every speculative KV write
+            # inside the row's position_limit).
+            cap = min(k, s.remaining_tokens(self.config.max_seq_len) - 1)
+            if budget is not None:
+                cap = min(cap, budget)
+            sp = s.request.sampling
+            if cap <= 0 or sp.frequency_penalty or sp.presence_penalty or s.constraint is not None:
+                drafts.append([])
+                continue
+            d = [int(tok) for tok in self._proposer.propose(s.tokens, cap)]
+            if d:
+                need = s.pages_needed(self.config.page_size, 1 + len(d))
+                if need:
+                    try:
+                        s.pages.extend(self.allocator.allocate(need))
+                    except OutOfPagesError:
+                        d = []
+            if budget is not None:
+                budget -= len(d)
+            self.spec_tokens_proposed += len(d)
+            drafts.append(d)
+        return drafts
+
+    def _lp_cols(self, seq: Sequence, lp_aux, i: int, toks: list[int]) -> list[dict] | None:
+        """Logprobs entries from the verify dispatch's per-column aux arrays
+        ([B, V] / [B, V, k]): one entry per emitted token, column j of row i.
+        Chunk rows pass a single token (their column 0)."""
+        enc = seq.request.sampling.logprobs
+        if not enc or lp_aux is None:
+            return None
+        alts = min(enc - 1, lp_aux["top_ids"].shape[-1])
+        entries = []
+        for j, tok in enumerate(toks):
+            top = [
+                [int(t), float(lp)]
+                for t, lp in zip(lp_aux["top_ids"][i, j][:alts], lp_aux["top_lps"][i, j][:alts])
+            ]
+            entries.append({"id": int(tok), "logprob": float(lp_aux["logprob"][i, j]), "top": top})
+        return entries
+
     def _run_mixed(self, chunks: list[tuple[Sequence, int]]) -> list[tuple[Sequence, EngineOutput]]:
         """One fused dispatch: a 1-token decode row per running sequence plus
         an n-token prefill row per scheduled chunk.
@@ -594,13 +714,23 @@ class EngineCore:
         seeded). With chunking disabled this runs the scheduled whole
         prompts without decode rows — the legacy phase-exclusive step."""
         fused = self.config.chunk_prefill_tokens > 0
+        spec = self._spec_active()
         out: list[tuple[Sequence, EngineOutput]] = []
         decode_rows: list[Sequence] = []
-        if fused and self.running:
+        if (fused or (spec and not chunks)) and self.running:
             failed = self._ensure_burst_pages(1)
             if failed is not None:
                 out.append((failed, self._final_output(failed)))
             decode_rows = list(self.running)
+        # Speculative drafts per decode row (empty lists when spec is off).
+        # Must run after _ensure_burst_pages: preemption there invalidates
+        # the row list. A decode row with drafts becomes a verify row — its
+        # span is [input token, draft_1..draft_k] at consecutive positions.
+        drafts: list[list[int]] = (
+            self._propose_drafts(decode_rows, chunks) if spec and decode_rows
+            else [[] for _ in decode_rows]
+        )
+        use_spec = spec and bool(decode_rows)
         self.last_step_info = {
             "decode_rows": len(decode_rows),
             "chunk_rows": len(chunks),
@@ -614,7 +744,8 @@ class EngineCore:
         batch = decode_rows + [s for s, _ in chunks]
         if not batch:
             return out
-        ns = [1] * len(decode_rows) + [n for _, n in chunks]
+        n_dec = len(decode_rows)
+        ns = [1 + len(d) for d in drafts] + [n for _, n in chunks]
         ps = self.config.page_size
         t = max(ns)
         npg = max(len(s.pages) for s in batch)
@@ -625,7 +756,13 @@ class EngineCore:
         slots = np.zeros((b, t), np.int32)
         last = np.zeros(b, np.int32)
         for i, (s, n) in enumerate(zip(batch, ns)):
-            new = s.tokens[s.num_cached : s.num_cached + n]
+            if i < n_dec and n > 1:
+                # Verify row: the committed next input token + its drafts.
+                # Drafts are NOT in s.tokens — they only join the sequence
+                # (and its hash chain) if verification accepts them.
+                new = [s.tokens[s.num_cached]] + drafts[i]
+            else:
+                new = s.tokens[s.num_cached : s.num_cached + n]
             tokens[i, :n] = new
             pos = np.arange(s.num_cached, s.num_cached + n, dtype=np.int32)
             positions[i, :n] = pos
@@ -635,9 +772,11 @@ class EngineCore:
             last[i] = n - 1
         # A row samples iff its span reaches the end of its tokens: all
         # decode rows, and exactly the chunks that finish their prompt.
-        samples = [s.num_cached + n == len(s.tokens) for s, n in zip(batch, ns)]
+        samples = [
+            i < n_dec or s.num_cached + n == len(s.tokens)
+            for i, (s, n) in enumerate(zip(batch, ns))
+        ]
         sb = self._sampling_batch(batch, tokens, positions, block_tables, slots, last)
-        n_dec = len(decode_rows)
         if any(s.mm_embeds is not None for s in batch[n_dec:]):
             d = next(s.mm_embeds.shape[1] for s in batch if s.mm_embeds is not None)
             m = max(s.mm_embeds.shape[0] for s in batch if s.mm_embeds is not None)
@@ -682,16 +821,71 @@ class EngineCore:
             s.request.sampling.logprobs and smp for s, smp in zip(batch, samples)
         ) else 0
         sb.logit_mask = self._constraint_masks(batch)
+        targets = None
         try:
-            stepped = self.runner.step(sb, lp_k=lp_k) if lp_k else self.runner.step(sb)
+            if use_spec:
+                # Verify dispatch: decode rows score every candidate column,
+                # chunk rows only their last (start n-1) — so chunk sampling
+                # stays bit-identical to the non-speculative step program.
+                sb.spec_start = np.asarray(
+                    [0] * n_dec + [n - 1 for _, n in chunks], np.int32
+                )
+                v = self.config.spec_k + 1
+                stepped = (
+                    self.runner.spec_step(sb, v, lp_k=lp_k) if lp_k
+                    else self.runner.spec_step(sb, v)
+                )
+                targets, lp_aux = stepped if lp_k else (stepped, None)
+                next_tokens = targets[:, 0]
+            else:
+                stepped = self.runner.step(sb, lp_k=lp_k) if lp_k else self.runner.step(sb)
+                next_tokens, lp_aux = stepped if lp_k else (stepped, None)
         except Exception:
             # Chunk seqs live in self.prefilling (and decode rows in
             # self.running); _finish removes them and releases their pages.
             for s in batch:
                 self._finish(s, FinishReason.ERROR)
             raise
-        next_tokens, lp_aux = stepped if lp_k else (stepped, None)
+        spec_accepted = 0
         for i, (s, n) in enumerate(zip(batch, ns)):
+            if use_spec and i < n_dec:
+                # Verify row: accept the longest draft prefix the target
+                # tokens replay exactly, plus the bonus token after it.
+                # targets[i, j] is the token the non-speculative engine
+                # would sample after j accepted tokens (fold counter
+                # num_generated + j), so once targets[i, j] != draft[j]
+                # the later columns were scored on a context the real
+                # stream never reaches and are discarded.
+                draft = drafts[i]
+                emitted = [int(next_tokens[i])]
+                while len(emitted) <= len(draft) and emitted[-1] == draft[len(emitted) - 1]:
+                    emitted.append(int(targets[i, len(emitted)]))
+                accepted: list[int] = []
+                for tok in emitted:
+                    s.num_cached += 1
+                    s.append_token(tok)
+                    self._generated_tokens_total += 1
+                    accepted.append(tok)
+                    if s.check_stop(self._eos, self.config.max_seq_len) is not None:
+                        break  # overshoot past EOS/length is discarded
+                spec_accepted += max(0, len(accepted) - 1)
+                # Roll back speculative pages the accepted span didn't
+                # reach: they were freshly allocated this step (commit
+                # never passes num_cached), so release returns them to the
+                # free pool immediately.
+                if not s.is_finished:
+                    keep = s.num_cached // ps + 1
+                    if len(s.pages) > keep:
+                        extra = [p for p in s.pages[keep:] if p != 0]
+                        del s.pages[keep:]
+                        if extra:
+                            self.allocator.release(extra)
+                self._commit_filled_pages(s)
+                self._release_out_of_window(s)
+                # May finish the sequence (page release) — must follow commit.
+                self._accept_constrained(s, accepted)
+                out.append(self._emit_many(s, accepted, self._lp_cols(s, lp_aux, i, accepted)))
+                continue
             # Prompt-token accounting: only the prompt part of the span
             # (recomputed generated tokens and decode rows contribute 0).
             self._prompt_tokens_total += max(0, min(s.num_cached + n, s.num_prompt) - s.num_cached)
@@ -706,13 +900,24 @@ class EngineCore:
                 self._release_out_of_window(s)
                 # May finish the sequence (page release) — must follow commit.
                 self._accept_constrained(s, [tok])
-                out.append(self._emit(s, tok, self._lp_entries(s, lp_aux, i)))
+                lp = (self._lp_cols(s, lp_aux, i, [tok]) if use_spec
+                      else self._lp_entries(s, lp_aux, i))
+                out.append(self._emit(s, tok, lp))
             else:
                 # Non-final chunk: publish its full pages (shareable before
                 # the prefill finishes) and discard the sampled token — the
                 # rng fold counter stays put for the final chunk.
                 self._commit_filled_pages(s)
                 self._release_out_of_window(s)
+        if use_spec:
+            drafted = sum(len(d) for d in drafts)
+            self.spec_steps += 1
+            self.spec_tokens_accepted += spec_accepted
+            self.last_step_info["spec_drafted"] = drafted
+            self.last_step_info["spec_accepted"] = spec_accepted
+            self.last_step_info["spec_accept_rate"] = (
+                round(spec_accepted / drafted, 4) if drafted else 0.0
+            )
         # Chunks whose final span sampled are decodable now.
         for s, _ in chunks:
             if s in self.prefilling and s.prompt_remaining <= 1 and not s.is_finished:

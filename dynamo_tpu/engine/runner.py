@@ -149,6 +149,11 @@ class StepBatch:
     # padding columns write KV to the null page). Consumed by the engine's
     # step-composition telemetry, tests, and the bench stall probe.
     num_new: np.ndarray | None = None  # i32[B]
+    # Speculative verify (spec_step only): first column each row scores
+    # logits at. Decode rows verify every real column (start 0); prefill
+    # chunk rows score only their last column (start n-1), which keeps the
+    # chunk rows' sampling bit-identical to the non-speculative step.
+    spec_start: np.ndarray | None = None  # i32[B]
 
     @property
     def batch_size(self) -> int:
@@ -255,6 +260,70 @@ class ModelRunner:
             return _step(params, k_cache, v_cache, *args, impl=self.attn_impl, lp_k=lp_k)
 
         self._step_packed_fn = _step_packed
+
+        @functools.partial(jax.jit, static_argnames=("impl", "lp_k"), donate_argnums=(1, 2))
+        def _spec_step(params, k_cache, v_cache, tokens, positions, block_tables, slot_mapping,
+                       verify_indices, temperature, top_k, top_p, seeds, sample_steps,
+                       freq_pen, pres_pen, history, mrope_delta=None,
+                       mm_embeds=None, mm_slot_offset=None, mm_counts=None,
+                       mrope_positions=None, logit_mask=None, *, impl, lp_k=0):
+            """Speculative verify: one forward scoring V candidate positions
+            per row, then a target sample at every one of them.
+
+            ``verify_indices`` i32[B, V] names the token columns to score.
+            Losslessness hinges on two properties of the flat [B*V] sampling
+            below: (1) every op in ``sample_tokens`` is row-independent, so
+            flat row b*V+j computes exactly what a non-speculative step with
+            row b's params would; (2) the rng key for column j folds in
+            ``sample_steps + j`` — the fold counter the non-speculative
+            engine would have reached after accepting j tokens. Acceptance
+            on the host is then plain prefix comparison ("exact replay"):
+            with counter-based deterministic sampling the Leviathan
+            rejection-sampling correction degenerates to equality, because
+            the target "draw" at each position is itself reproducible.
+            """
+            b, v = verify_indices.shape
+            mm_kw = {}
+            if mm_embeds is not None:
+                mm_kw = dict(mm_embeds=mm_embeds, mm_slot_offset=mm_slot_offset, mm_counts=mm_counts)
+            if self.cfg.mrope_section:
+                mm_kw["mrope_positions"] = (
+                    mrope_positions if mrope_positions is not None
+                    else _delta_mrope(positions, mrope_delta)
+                )
+            logits, k_cache, v_cache = self._forward(
+                params, self.cfg, tokens, positions, k_cache, v_cache,
+                block_tables, slot_mapping, verify_indices[:, 0],
+                attn_impl=impl, mesh=self.mesh,
+                logit_indices=verify_indices, contiguous_positions=False,
+                **mm_kw,
+            )  # f32[B, V, vocab]
+            flat = logits.reshape(b * v, logits.shape[-1])
+            cnt = (sample_steps[:, None] + jnp.arange(v, dtype=sample_steps.dtype)).reshape(-1)
+            keys = jax.vmap(lambda s, c: jax.random.fold_in(jax.random.PRNGKey(s), c))(
+                jnp.repeat(seeds, v), cnt
+            )
+            sample_logits = flat
+            if logit_mask is not None:
+                from dynamo_tpu.ops.attention import NEG_INF
+
+                sample_logits = jnp.where(jnp.repeat(logit_mask, v, axis=0), flat, NEG_INF)
+            targets = sample_tokens(
+                sample_logits, keys,
+                jnp.repeat(temperature, v), jnp.repeat(top_k, v), jnp.repeat(top_p, v),
+                history=jnp.repeat(history, v, axis=0),
+                frequency_penalty=jnp.repeat(freq_pen, v),
+                presence_penalty=jnp.repeat(pres_pen, v),
+            )
+            if lp_k:
+                from dynamo_tpu.ops.sampling import token_logprobs
+
+                chosen, top_ids, top_lps = token_logprobs(flat, targets, lp_k)
+                return (targets.reshape(b, v), k_cache, v_cache, chosen.reshape(b, v),
+                        top_ids.reshape(b, v, lp_k), top_lps.reshape(b, v, lp_k))
+            return targets.reshape(b, v), k_cache, v_cache
+
+        self._spec_step_fn = _spec_step
 
         @functools.partial(jax.jit, static_argnames=("num_steps",), donate_argnums=(1, 2))
         def _multi_step(params, k_cache, v_cache, tokens, positions, block_tables,
@@ -524,6 +593,7 @@ class ModelRunner:
             mrope_positions=mrope3,
             logit_mask=lmask,
             num_new=None if batch.num_new is None else pad1(batch.num_new, bp),
+            spec_start=None if batch.spec_start is None else pad1(batch.spec_start, bp),
         )
 
     # -- execution ---------------------------------------------------------
@@ -638,6 +708,73 @@ class ModelRunner:
                 }
             next_tokens, self.k_cache, self.v_cache = out
             return np.asarray(next_tokens)[:b_real]
+
+    @_locked
+    def spec_step(self, batch: StepBatch, verify_width: int, lp_k: int = 0):
+        """Speculative verify dispatch: returns target tokens i32[B_real, V].
+
+        ``batch`` is a mixed StepBatch whose decode rows carry draft tokens
+        as extra real columns (``num_new`` = 1 + draft length) and whose
+        ``spec_start`` names each row's first verify column (0 for decode
+        rows — they score every column — and n-1 for prefill-chunk rows,
+        which score only their last column exactly like :meth:`step`).
+        Verify columns beyond a row's real span clamp to its last column;
+        the engine discards those duplicates host-side.
+
+        ``verify_width`` (V = spec_k + 1) is a static program dimension —
+        keep it constant per engine so speculation adds exactly one
+        compiled program per (B, T, N) bucket. Column j of the result is
+        the token the non-speculative engine would sample after accepting
+        j draft tokens (rng fold ``sample_steps + j``); with ``lp_k`` the
+        logprobs dict carries per-column arrays [B, V] / [B, V, k].
+        """
+        b_real = batch.batch_size
+        padded = self._pad(batch)
+        bp = padded.tokens.shape[0]
+        start = padded.spec_start if padded.spec_start is not None else np.zeros(bp, np.int32)
+        vi = np.minimum(
+            start[:, None] + np.arange(verify_width, dtype=np.int32)[None, :],
+            padded.last_token_index[:, None],
+        ).astype(np.int32)
+        impl = self._select_impl(padded) if self.mesh is not None else self.attn_impl
+        dispatch_key = (
+            bp, padded.tokens.shape[1], padded.block_tables.shape[1],
+            padded.history.shape[1], verify_width, lp_k, impl, self.mesh is not None,
+            padded.mm_embeds is not None, padded.logit_mask is not None,
+        )
+        with timed_dispatch(self.compile_tracker, "spec_step", dispatch_key):
+            if self.mesh is not None:
+                from dynamo_tpu.parallel.sharding import batch_sharding
+
+                def put(a):
+                    return jax.device_put(a, batch_sharding(self.mesh, a.ndim))
+            else:
+                put = jnp.asarray
+
+            def opt(a):
+                return None if a is None else put(a)
+
+            out = self._spec_step_fn(
+                self.params, self.k_cache, self.v_cache,
+                put(padded.tokens), put(padded.positions),
+                put(padded.block_tables), put(padded.slot_mapping),
+                put(vi), put(padded.temperature), put(padded.top_k), put(padded.top_p),
+                put(padded.seeds), put(padded.sample_steps),
+                put(padded.freq_pen), put(padded.pres_pen), put(padded.history),
+                put(padded.mrope_delta),
+                opt(padded.mm_embeds), opt(padded.mm_slot_offset), opt(padded.mm_counts),
+                opt(padded.mrope_positions), opt(padded.logit_mask),
+                impl=impl, lp_k=lp_k,
+            )
+        if lp_k:
+            targets, self.k_cache, self.v_cache, chosen, top_ids, top_lps = out
+            return np.asarray(targets)[:b_real], {
+                "logprob": np.asarray(chosen)[:b_real],
+                "top_ids": np.asarray(top_ids)[:b_real],
+                "top_lps": np.asarray(top_lps)[:b_real],
+            }
+        targets, self.k_cache, self.v_cache = out
+        return np.asarray(targets)[:b_real]
 
     @_locked
     def multi_step(self, batch: StepBatch, num_steps: int) -> np.ndarray:

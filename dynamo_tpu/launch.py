@@ -145,9 +145,35 @@ class WorkerSpec:
                 os.environ.get("DYNAMO_CHUNK_PREFILL_TOKENS")
                 or os.environ.get("DYN_WORKER_CHUNK_PREFILL_TOKENS", "512")
             ),
+            spec_k=int(
+                os.environ.get("DYN_SPEC_K")
+                or os.environ.get("DYN_WORKER_SPEC_K", "0")
+            ),
         )
         defaults.update(engine_kw)
         return EngineConfig(**defaults)
+
+
+def _kv_cache_dtype():
+    """Resolve DYN_KV_CACHE_DTYPE / DYN_WORKER_KV_CACHE_DTYPE to a jnp dtype.
+
+    'bf16' (or unset) -> None: the runner keeps its model-dtype default.
+    'fp8' -> float8_e4m3fn storage; every attention path upcasts fp8 KV to
+    the query dtype at the matmul, so this only changes cache HBM footprint.
+    """
+    import os
+
+    name = (
+        os.environ.get("DYN_KV_CACHE_DTYPE")
+        or os.environ.get("DYN_WORKER_KV_CACHE_DTYPE", "")
+    ).strip().lower()
+    if name in ("", "bf16", "bfloat16"):
+        return None
+    if name in ("fp8", "float8_e4m3fn", "fp8_e4m3"):
+        import jax.numpy as jnp
+
+        return jnp.float8_e4m3fn
+    raise ValueError(f"unsupported kv cache dtype: {name!r} (want bf16 or fp8)")
 
 
 def _parse_mesh(spec: str | None):
@@ -246,6 +272,7 @@ async def build_engine_service(spec: WorkerSpec, *, on_kv_event=None, g4_storage
             max_batch_size=spec.engine_config.max_batch_size,
             attn_impl=spec.attn_impl,
             mesh=mesh,
+            cache_dtype=_kv_cache_dtype(),
         )
 
     runner = await asyncio.get_running_loop().run_in_executor(None, _build)
@@ -967,6 +994,16 @@ def main(argv: list[str] | None = None) -> None:
         help="per-step prefill chunk budget fused with decode "
         "(stall-free mixed steps); 0 = phase-exclusive prefill/decode",
     )
+    parser.add_argument(
+        "--spec-k", type=int, default=ws.spec_k,
+        help="speculative decoding draft length (lossless n-gram "
+        "self-drafting fused into mixed steps); 0 = off",
+    )
+    parser.add_argument(
+        "--kv-cache-dtype", default=ws.kv_cache_dtype, choices=["bf16", "fp8"],
+        help="KV-cache storage dtype; fp8 halves KV HBM (attention upcasts "
+        "at the matmul)",
+    )
     parser.add_argument("--num-nodes", type=int, default=1, help="hosts forming one worker's mesh")
     parser.add_argument("--node-rank", type=int, default=0)
     parser.add_argument(
@@ -999,6 +1036,14 @@ def main(argv: list[str] | None = None) -> None:
         import os
 
         os.environ["DYN_WORKER_CHUNK_PREFILL_TOKENS"] = str(args.chunk_prefill_tokens)
+    if args.spec_k != 0:
+        import os
+
+        os.environ["DYN_WORKER_SPEC_K"] = str(args.spec_k)
+    if args.kv_cache_dtype != "bf16":
+        import os
+
+        os.environ["DYN_WORKER_KV_CACHE_DTYPE"] = args.kv_cache_dtype
     asyncio.run(_amain(args))
 
 

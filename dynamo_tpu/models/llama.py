@@ -277,6 +277,8 @@ def forward(
     mm_slot_offset: jnp.ndarray | None = None,  # i32[B] placeholders already cached; -1 = text row
     mm_counts: jnp.ndarray | None = None,  # i32[B] embedding rows provided per row
     mrope_positions: jnp.ndarray | None = None,  # i32[B, 3, T] Qwen2-VL 3D rope coords
+    logit_indices: jnp.ndarray | None = None,  # i32[B, V] token columns to score (spec verify)
+    contiguous_positions: bool = True,  # False: route attention via gappy-safe paths
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One forward step. Returns (logits f32[B, vocab], k_cache, v_cache).
 
@@ -294,6 +296,15 @@ def forward(
     ``mm_slot_offset`` counts placeholders in already-cached chunks, so
     chunked prefill and prefix-cache resumption stay exact (the multimodal
     prefill handoff, reference `examples/multimodal/`).
+
+    ``logit_indices`` switches the head to multi-position scoring for
+    speculative verify: instead of one logits row per sequence at
+    ``last_token_index``, score the V token columns named per row and
+    return f32[B, V, vocab]. ``contiguous_positions=False`` additionally
+    tells the paged-attention dispatch not to assume per-row contiguous
+    position runs — verify rows from the n-gram drafter *are* contiguous,
+    but the proposer interface admits draft layouts that are not, and the
+    prefill kernel would silently mis-attend on a gappy row.
     """
     b, t = tokens.shape
     nl, npages, ps = k_cache.shape[0], k_cache.shape[1], k_cache.shape[2]
@@ -414,6 +425,7 @@ def forward(
                     attn = paged_attention(
                         q, k_full, v_full, tables_l, positions,
                         impl=attn_impl, sliding_window=cfg.sliding_window,
+                        contiguous_positions=contiguous_positions,
                     )
                 elif attn_impl == "pallas" and mesh is not None:
                     # Explicit tp/dp layout around the kernel: GSPMD would
@@ -424,9 +436,11 @@ def forward(
                     attn = paged_attention_sharded(
                         q, k_full, v_full, tables_l, positions,
                         mesh=mesh, impl=attn_impl,
+                        contiguous_positions=contiguous_positions,
                     )
                 else:
-                    attn = paged_attention(q, k_full, v_full, tables_l, positions, impl=attn_impl)
+                    attn = paged_attention(q, k_full, v_full, tables_l, positions, impl=attn_impl,
+                                           contiguous_positions=contiguous_positions)
             x = x + _qmm(attn.reshape(b, t, cfg.q_dim), lp["wo"])
             h2 = rms_norm(x, lp["mlp_norm"], eps=cfg.rms_eps, plus_one=cfg.norm_plus_one)
             mlp = _mlp_moe(lp, h2, cfg, mesh) if moe_layer else _mlp_dense(lp, h2, cfg.mlp_act)
@@ -451,11 +465,18 @@ def forward(
     v_out = v_out.reshape(v_cache.shape)
 
     x = rms_norm(x, params["norm_f"], eps=cfg.rms_eps, plus_one=cfg.norm_plus_one)
-    last = jnp.take_along_axis(x, last_token_index[:, None, None], axis=1)[:, 0]  # [B, D]
     # bf16 operands, f32 accumulate: no f32 materialization of the (huge)
     # embedding matrix per step; quantized lm_head goes through the shared
     # scale-after-dot helper.
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    if logit_indices is not None:
+        # Speculative verify: score every candidate position in one head
+        # matmul — V is small (spec_k + 1), so this stays cheap relative to
+        # the layer stack it amortizes.
+        sel = jnp.take_along_axis(x, logit_indices[:, :, None], axis=1)  # [B, V, D]
+        logits = _qmm(sel, head, preferred_element_type=jnp.float32)  # [B, V, vocab]
+        return logits, k_out, v_out
+    last = jnp.take_along_axis(x, last_token_index[:, None, None], axis=1)[:, 0]  # [B, D]
     logits = _qmm(last, head, preferred_element_type=jnp.float32)  # [B, vocab]
     return logits, k_out, v_out
 

@@ -68,6 +68,12 @@ class EngineMetrics:
         )
         self.preemptions = gauge(f"{ns}_preemptions_total", "Sequences preempted (pages reclaimed under pressure)")
         self.admission_rejections = gauge(f"{ns}_admission_rejections_total", "Requests refused at engine intake")
+        self.spec_tokens_proposed = gauge(
+            f"{ns}_spec_tokens_proposed_total", "Draft tokens proposed by the speculative decoder"
+        )
+        self.spec_tokens_accepted = gauge(
+            f"{ns}_spec_tokens_accepted_total", "Draft tokens verified and emitted by the speculative decoder"
+        )
         # Page pool.
         self.pages_total = gauge(f"{ns}_pages_total", "Allocatable KV pages")
         self.pages_free = gauge(f"{ns}_pages_free", "Pages on the free list")
@@ -163,6 +169,8 @@ class EngineMetrics:
         self.stall_violations.set(getattr(core, "stall_violations", 0))
         self.preemptions.set(getattr(core, "num_preemptions", 0))
         self.admission_rejections.set(getattr(core, "admission_rejections", 0))
+        self.spec_tokens_proposed.set(getattr(core, "spec_tokens_proposed", 0))
+        self.spec_tokens_accepted.set(getattr(core, "spec_tokens_accepted", 0))
         stats = core.allocator.stats()
         self.pages_total.set(stats.total_pages)
         self.pages_free.set(stats.free_pages)

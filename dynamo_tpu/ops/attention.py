@@ -209,6 +209,7 @@ def paged_attention_sharded(
     mesh,
     scale: float | None = None,
     impl: str | None = None,
+    contiguous_positions: bool = True,
 ) -> jnp.ndarray:
     """Paged attention under a device mesh: tp shards heads, dp the batch.
 
@@ -237,7 +238,8 @@ def paged_attention_sharded(
     row_spec = P(batch_axis, None)
 
     def body(q, kc, vc, bt, pos):
-        return paged_attention(q, kc, vc, bt, pos, scale=scale, impl=impl)
+        return paged_attention(q, kc, vc, bt, pos, scale=scale, impl=impl,
+                               contiguous_positions=contiguous_positions)
 
     return _shard_map(
         body, mesh=mesh,

@@ -77,6 +77,42 @@ def test_stall_probe_structure(monkeypatch):
     assert "stall_ratio_baseline_over_chunked" in out
 
 
+def test_spec_probe_structure(monkeypatch):
+    """probe_spec_decode's contract: stable keys for both modes plus the
+    headline acceptance rate and speedup, sized down to CPU. The >1 speedup
+    is a TPU bench claim — on CPU a verify dispatch costs more than the
+    decode it replaces — so only structure, losslessness-adjacent token
+    counts, and a positive acceptance rate are asserted."""
+    import bench
+
+    monkeypatch.setenv("BENCH_SPEC_PRESET", "test-tiny")
+    monkeypatch.setenv("BENCH_SPEC_K", "4")
+    monkeypatch.setenv("BENCH_SPEC_BATCH", "2")
+    monkeypatch.setenv("BENCH_SPEC_ISL", "32")
+    monkeypatch.setenv("BENCH_SPEC_OSL", "16")
+    monkeypatch.setenv("BENCH_SPEC_CHUNK", "16")
+    monkeypatch.setenv("BENCH_PAGE_SIZE", "4")
+    out = bench.probe_spec_decode()
+    assert out["preset"] == "test-tiny"
+    for mode in ("spec", "baseline"):
+        run = out[mode]
+        for key in ("spec_k", "tok_per_sec", "decode_tokens", "decode_steps",
+                    "spec_tokens_proposed", "spec_tokens_accepted",
+                    "spec_accept_rate"):
+            assert key in run, f"{mode} missing {key}"
+        assert run["decode_steps"] > 0
+    # Identical scenario in both modes: losslessness means identical totals.
+    assert out["spec"]["decode_tokens"] == out["baseline"]["decode_tokens"]
+    assert out["baseline"]["spec_tokens_proposed"] == 0
+    # Repetitive prompts: the drafter must engage and land some tokens.
+    assert out["spec"]["spec_tokens_proposed"] > 0
+    assert out["spec"]["spec_accept_rate"] > 0
+    # Accepted drafts shrink the step count for the same token total.
+    assert out["spec"]["decode_steps"] < out["baseline"]["decode_steps"]
+    assert out["spec_accept_rate"] == out["spec"]["spec_accept_rate"]
+    assert "spec_decode_speedup" in out
+
+
 def test_bench_doc_goodput_keys():
     """build_doc's top-level contract (ISSUE 4): the SLO-conditioned goodput
     headline keys are stable, sourced from the headline (llama-3.2-1b)
@@ -94,10 +130,16 @@ def test_bench_doc_goodput_keys():
     assert doc["slo_ttft_attainment"] == 0.9
     assert doc["value"] == 100.0
     assert doc["itl_p99_ms"] == 0.0  # stall probe absent: stable default
+    assert doc["spec_accept_rate"] == 0.0  # spec probe absent: stable default
+    spec = {"spec_accept_rate": 0.6, "spec_decode_speedup": 1.8}
+    doc2 = bench.build_doc(configs, pull={}, spec=spec)
+    assert doc2["spec_accept_rate"] == 0.6
+    assert doc2["spec_decode_speedup"] == 1.8
     # An all-errors suite still emits the full key set.
     empty = bench.build_doc([{"preset": "x", "error": "boom"}], pull={})
     for key in ("value", "goodput_tokens_per_s_at_slo", "slo_ttft_attainment",
-                "itl_p99_ms", "max_decode_stall_ms"):
+                "itl_p99_ms", "max_decode_stall_ms", "spec_accept_rate",
+                "spec_decode_speedup"):
         assert key in empty
         assert empty[key] == 0.0
 

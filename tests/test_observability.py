@@ -132,6 +132,8 @@ class _FakeCore:
     stall_violations = 1
     num_preemptions = 2
     admission_rejections = 4
+    spec_tokens_proposed = 20
+    spec_tokens_accepted = 9
     waiting = ["a"]
     running = ["b", "c"]
     prefilling = ["d"]
@@ -161,6 +163,8 @@ EXPECTED_ENGINE_FAMILIES = {
     "dynamo_engine_stall_violations_total",
     "dynamo_engine_preemptions_total",
     "dynamo_engine_admission_rejections_total",
+    "dynamo_engine_spec_tokens_proposed_total",
+    "dynamo_engine_spec_tokens_accepted_total",
     "dynamo_engine_pages_total",
     "dynamo_engine_pages_free",
     "dynamo_engine_pages_cached",
@@ -215,6 +219,8 @@ async def test_engine_metrics_names_labels_and_values():
     assert 'dynamo_engine_step_chunk_tokens{worker="w1"} 128.0' in text
     assert 'dynamo_engine_mixed_steps_total{worker="w1"} 7.0' in text
     assert 'dynamo_engine_admission_rejections_total{worker="w1"} 4.0' in text
+    assert 'dynamo_engine_spec_tokens_proposed_total{worker="w1"} 20.0' in text
+    assert 'dynamo_engine_spec_tokens_accepted_total{worker="w1"} 9.0' in text
     assert 'dynamo_engine_pages_active{worker="w1"} 40.0' in text
     assert 'dynamo_engine_page_utilization_ratio{worker="w1"} 0.625' in text
     # fragmentation = cached / (free + cached) = 8 / 24

@@ -55,11 +55,17 @@ def build_core(batch: int, isl: int, osl: int):
     return core, cfg, params
 
 
-def op_breakdown(trace_dir: str) -> list[tuple[str, float]]:
-    """Aggregate device-op microseconds from the trace's trace.json.gz."""
+def op_breakdown(trace_dir: str) -> tuple[list[tuple[str, float]], float, int]:
+    """Aggregate device-op microseconds from the trace's trace.json.gz.
+
+    Returns ``(per_op_totals_sorted, total_us, num_device_cores)``. The
+    per-op totals and ``total_us`` are SUMMED over every device core pid,
+    so busy-fraction math must divide by ``num_device_cores`` — an 8-core
+    trace's op time can legitimately be 8x the wall window.
+    """
     paths = glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True)
     if not paths:
-        return []
+        return [], 0.0, 0
     with gzip.open(sorted(paths)[-1], "rt") as f:
         doc = json.load(f)
     events = doc.get("traceEvents", [])
@@ -82,7 +88,8 @@ def op_breakdown(trace_dir: str) -> list[tuple[str, float]]:
         if e.get("ph") == "X" and e.get("pid") in device_pids:
             name = e.get("name", "?")
             totals[name] = totals.get(name, 0.0) + float(e.get("dur", 0.0))
-    return sorted(totals.items(), key=lambda kv: -kv[1])[:25]
+    ordered = sorted(totals.items(), key=lambda kv: -kv[1])
+    return ordered, sum(totals.values()), len(device_pids)
 
 
 def main() -> None:
@@ -117,16 +124,20 @@ def main() -> None:
     tok_per_sec = generated / elapsed
     step_bytes = bench_mod.decode_step_bytes(params, cfg, batch, isl, osl, page)
     roofline = bench_mod.roofline_tok_per_sec(step_bytes, batch)
-    ops = op_breakdown(trace_dir)
-    device_us = sum(us for _, us in ops)
+    ops, device_us, num_cores = op_breakdown(trace_dir)
+    # device_us sums op time over every device core pid; per-core busy time
+    # is that total divided by the core count (the old code skipped the
+    # divide and reported fractions like 3.06 on multi-core traces).
+    busy = device_us / (num_cores * elapsed * 1e6) if num_cores else 0.0
     summary = {
         "tok_per_sec_window": round(tok_per_sec, 1),
         "vs_roofline": round(tok_per_sec / roofline, 4),
         "window_seconds": round(elapsed, 3),
         "decode_tokens": generated,
         "device_op_us_total": round(device_us, 0),
+        "device_cores": num_cores,
         "wall_us": round(elapsed * 1e6, 0),
-        "device_busy_fraction": round(device_us / (elapsed * 1e6), 4),
+        "device_busy_fraction": round(busy, 4),
         "top_ops_us": [[n, round(us, 0)] for n, us in ops[:15]],
         "trace_dir": trace_dir,
     }
