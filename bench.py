@@ -535,6 +535,101 @@ def probe_spec_decode() -> dict:
     return out
 
 
+def probe_decode_kernel() -> dict:
+    """Raw split-K paged-decode kernel microbench (ISSUE 7).
+
+    Times ``paged_decode_attention`` alone — no engine, no weights — over a
+    batch x context grid. Per cell it reports achieved HBM read bandwidth:
+    modeled KV bytes (the kernel streams every whole page in each row's
+    window, K and V) over measured wall time, a floor on real traffic just
+    like the suite's utilization number. The best cell is promoted to the
+    stable top-level keys ``decode_kernel_gbps`` / ``decode_roofline_frac``
+    (fraction of BENCH_SPEC_HBM_GBPS).
+
+    On non-TPU backends the kernel runs in interpret mode with a tiny
+    default grid: the key contract still holds but the bandwidth numbers
+    are emulation artifacts, not measurements.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.ops.pallas_paged import (
+        decode_kernel_supported,
+        paged_decode_attention,
+    )
+
+    interpret = jax.default_backend() != "tpu"
+
+    def ints(name: str, default: str) -> list[int]:
+        return [int(x) for x in os.environ.get(name, default).split(",") if x]
+
+    batches = ints("BENCH_DK_BATCHES", "1,2" if interpret else "1,8,32")
+    contexts = ints("BENCH_DK_CONTEXTS", "128" if interpret else "1024,4096,16384")
+    page_size = int(os.environ.get("BENCH_DK_PAGE_SIZE", "16" if interpret else "128"))
+    n_heads = int(os.environ.get("BENCH_DK_HEADS", "8" if interpret else "32"))
+    n_kv = int(os.environ.get("BENCH_DK_KV", "2" if interpret else "8"))
+    head_dim = int(os.environ.get("BENCH_DK_HEAD_DIM", "64" if interpret else "128"))
+    iters = int(os.environ.get("BENCH_DK_ITERS", "2" if interpret else "32"))
+    width = n_kv * head_dim
+    itemsize = 2  # bf16 cache
+    out: dict = {
+        "backend": jax.default_backend(), "interpret": interpret,
+        "page_size": page_size, "n_heads": n_heads, "n_kv_heads": n_kv,
+        "head_dim": head_dim, "iters": iters,
+    }
+    if not decode_kernel_supported(n_heads, head_dim, width, 1, interpret=interpret):
+        out.update(error="decode kernel unsupported for this geometry",
+                   grid=[], decode_kernel_gbps=0.0, decode_roofline_frac=0.0)
+        return out
+
+    rng = np.random.default_rng(0)
+    grid: list[dict] = []
+    best = 0.0
+    scale = head_dim ** -0.5
+    for batch in batches:
+        for ctx in contexts:
+            pages = -(-ctx // page_size)
+            num_pages = batch * pages + 1  # page 0 is the null page
+            k_cache = jnp.asarray(
+                rng.standard_normal((num_pages, page_size, width)), jnp.bfloat16)
+            v_cache = jnp.asarray(
+                rng.standard_normal((num_pages, page_size, width)), jnp.bfloat16)
+            tables = jnp.arange(1, num_pages, dtype=jnp.int32).reshape(batch, pages)
+            q = jnp.asarray(
+                rng.standard_normal((batch, 1, n_heads, head_dim)), jnp.float32)
+            positions = jnp.full((batch, 1), ctx - 1, jnp.int32)
+            # compile (and, per shape bucket, the only pass interpret gets)
+            paged_decode_attention(
+                q, k_cache, v_cache, tables, positions,
+                scale=scale, interpret=interpret,
+            ).block_until_ready()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                res = paged_decode_attention(
+                    q, k_cache, v_cache, tables, positions,
+                    scale=scale, interpret=interpret,
+                )
+            res.block_until_ready()
+            dt = time.perf_counter() - t0
+            kv_bytes = 2 * batch * pages * page_size * width * itemsize
+            gbps = kv_bytes * iters / dt / 1e9 if dt > 0 else 0.0
+            best = max(best, gbps)
+            grid.append({
+                "batch": batch, "context": ctx,
+                "kv_bytes_per_call": kv_bytes,
+                "us_per_call": round(dt / iters * 1e6, 1),
+                "gbytes_per_sec": round(gbps, 6),
+                "roofline_frac": round(gbps / SPEC_HBM_GBPS, 4),
+            })
+            gc.collect()
+    out.update(
+        grid=grid,
+        decode_kernel_gbps=round(best, 6),
+        decode_roofline_frac=round(best / SPEC_HBM_GBPS, 6),
+    )
+    return out
+
+
 def probe_kv_pull_gbps() -> dict:
     """Device-path KV transfer bandwidth (BASELINE north-star metric).
 
@@ -618,7 +713,8 @@ def probe_cross_process_wire() -> dict:
     )
 
 
-def build_doc(configs, pull, wire=None, stall=None, spec=None) -> dict:
+def build_doc(configs, pull, wire=None, stall=None, spec=None,
+              decode_kernel=None) -> dict:
     """The bench JSON document (one stdout line per emit).
 
     Module-level (not a closure) so its top-level key contract — the stable
@@ -649,12 +745,18 @@ def build_doc(configs, pull, wire=None, stall=None, spec=None) -> dict:
         # pass (repetitive-prompt scenario, see probe_spec_decode).
         "spec_accept_rate": (spec or {}).get("spec_accept_rate", 0.0),
         "spec_decode_speedup": (spec or {}).get("spec_decode_speedup", 0.0),
+        # Decode-kernel headline keys (ISSUE 7): best achieved HBM bandwidth
+        # of the raw split-K paged-decode kernel and its roofline fraction
+        # (see probe_decode_kernel; meaningless off-TPU but always present).
+        "decode_kernel_gbps": (decode_kernel or {}).get("decode_kernel_gbps", 0.0),
+        "decode_roofline_frac": (decode_kernel or {}).get("decode_roofline_frac", 0.0),
         "detail": {
             "backend": jax.default_backend(),
             "suite": [c.get("preset") for c in configs],
             "configs": configs,
             "stall_probe": stall or {"pending": True},
             "spec_probe": spec or {"pending": True},
+            "decode_kernel_probe": decode_kernel or {"pending": True},
             "kv_pull": pull,
             "kv_wire_cross_process": wire or {"pending": True},
             "ttft_note": "ttft_idle_* is the drained-engine best case; "
@@ -666,8 +768,9 @@ def build_doc(configs, pull, wire=None, stall=None, spec=None) -> dict:
 def main() -> None:
     from dynamo_tpu.models.config import PRESETS
 
-    def emit(configs, pull, wire=None, stall=None, spec=None):
-        print(json.dumps(build_doc(configs, pull, wire, stall, spec)), flush=True)
+    def emit(configs, pull, wire=None, stall=None, spec=None, dk=None):
+        print(json.dumps(build_doc(configs, pull, wire, stall, spec, dk)),
+              flush=True)
 
     suite = parse_suite()
     configs = []
@@ -709,16 +812,22 @@ def main() -> None:
     emit(configs, {"pending": True}, stall=stall, spec=spec)
     gc.collect()
     try:
+        dk = probe_decode_kernel()
+    except Exception as e:
+        dk = {"error": f"{type(e).__name__}: {e}"[:200]}
+    emit(configs, {"pending": True}, stall=stall, spec=spec, dk=dk)
+    gc.collect()
+    try:
         pull = probe_kv_pull_gbps()
     except Exception as e:
         pull = {"error": f"{type(e).__name__}: {e}"[:200]}
-    emit(configs, pull, stall=stall, spec=spec)
+    emit(configs, pull, stall=stall, spec=spec, dk=dk)
     gc.collect()
     try:
         wire = probe_cross_process_wire()
     except Exception as e:
         wire = {"error": f"{type(e).__name__}: {e}"[:200]}
-    emit(configs, pull, wire, stall=stall, spec=spec)
+    emit(configs, pull, wire, stall=stall, spec=spec, dk=dk)
 
 
 if __name__ == "__main__":

@@ -142,6 +142,11 @@ class EngineCore:
         self.spec_tokens_proposed = 0
         self.spec_tokens_accepted = 0
         self.spec_steps = 0
+        # Attention dispatch-path accounting: steps by (phase, path) —
+        # phase in {decode, verify, prefill}, path in {pallas, fallback,
+        # ring} (runner._attn_dispatch). A serving config silently riding
+        # the ~5x-slower gather formulation shows up here and at /metrics.
+        self.attn_dispatch_counts: dict[tuple[str, str], int] = {}
         self._proposer = None
         if config.spec_k > 0:
             from dynamo_tpu.engine.spec import build_proposer
@@ -381,6 +386,14 @@ class EngineCore:
             dispatch_ms = (
                 (tracker.dispatch_seconds_total - disp0) * 1e3 if tracker is not None else 0.0
             )
+            # Consume (don't just read) the runner's dispatch label: a step
+            # that only drains in-flight results must not re-count the
+            # previous dispatch.
+            attn = getattr(self.runner, "last_attn_dispatch", None)
+            if attn is not None:
+                self.runner.last_attn_dispatch = None
+                self.attn_dispatch_counts[attn] = self.attn_dispatch_counts.get(attn, 0) + 1
+            attn_phase, attn_path = attn if attn else ("", "")
             self.flight.record(
                 STEP,
                 step_kind=kind,
@@ -403,6 +416,8 @@ class EngineCore:
                 ),
                 wall_ms=round(wall_ms, 3),
                 dispatch_ms=round(dispatch_ms, 3),
+                attn_phase=attn_phase,
+                attn_path=attn_path,
             )
             return out
 

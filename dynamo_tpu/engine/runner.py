@@ -198,6 +198,10 @@ class ModelRunner:
         # this runner (device-transfer warm-up bookkeeping — keyed on the
         # runner object itself, so id() reuse after GC can't skip a warm-up).
         self._devxfer_warm: set[int] = set()
+        # (phase, path) of the most recent dispatch — "decode"/"verify"/
+        # "prefill" x "pallas"/"fallback"/"ring". The engine copies this
+        # into its STEP flight records and dispatch-path counters.
+        self.last_attn_dispatch: tuple[str, str] | None = None
         self.k_cache, self.v_cache = llama.init_kv_cache(cfg, num_pages, page_size, dtype=cache_dtype)
         self._dp = 1
         if mesh is not None:
@@ -618,6 +622,41 @@ class ModelRunner:
             return "ring"
         return self.attn_impl
 
+    def _attn_dispatch(self, padded: StepBatch, impl: str | None, *, verify: bool = False) -> tuple[str, str]:
+        """(phase, path) the attention layer will take for this dispatch.
+
+        A host-side mirror of the models/* routing predicates (pure shape
+        math — no tracing), so every engine step can record whether its
+        attention ran on a Pallas kernel ("pallas"), the XLA gather
+        formulation ("fallback"), or the sequence-parallel ring path
+        ("ring") without touching the jitted program."""
+        t = int(padded.tokens.shape[1])
+        phase = "verify" if (verify and t > 1) else ("decode" if t == 1 else "prefill")
+        if impl == "ring":
+            return phase, "ring"
+        if impl != "pallas" or self.cfg.sliding_window > 0:
+            return phase, "fallback"
+        from dynamo_tpu.ops.pallas_paged import interpret_mode
+
+        interp = interpret_mode()
+        t_q = t if phase == "verify" else 1  # prefill kernel tiles T freely
+        if self.cfg.attn_type == "mla":
+            from dynamo_tpu.ops.pallas_mla import mla_decode_supported
+
+            # MLA prefill DOES ride the multi-query kernel (T <= row cap).
+            ok = mla_decode_supported(
+                self.k_cache.shape[-1], self.v_cache.shape[-1],
+                t if t > 1 else 1, self.cfg.num_heads, interpret=interp,
+            )
+        else:
+            from dynamo_tpu.ops.pallas_paged import decode_kernel_supported
+
+            ok = decode_kernel_supported(
+                self.cfg.num_heads, self.cfg.head_dim, self.k_cache.shape[-1],
+                t_q, interpret=interp if phase != "prefill" else False,
+            )
+        return phase, "pallas" if ok else "fallback"
+
     @_locked
     def step(self, batch: StepBatch, lp_k: int = 0):
         """Run one forward+sample step; returns sampled token ids i32[B_real].
@@ -638,6 +677,7 @@ class ModelRunner:
         b_real = batch.batch_size
         padded = self._pad(batch)
         impl = self._select_impl(padded) if self.mesh is not None else self.attn_impl
+        self.last_attn_dispatch = self._attn_dispatch(padded, impl)
         # Everything the jitted programs specialize on, post-padding: this is
         # the compile cache key XLA sees (shapes + static args + arg presence).
         dispatch_key = (
@@ -737,6 +777,7 @@ class ModelRunner:
             padded.last_token_index[:, None],
         ).astype(np.int32)
         impl = self._select_impl(padded) if self.mesh is not None else self.attn_impl
+        self.last_attn_dispatch = self._attn_dispatch(padded, impl, verify=True)
         dispatch_key = (
             bp, padded.tokens.shape[1], padded.block_tables.shape[1],
             padded.history.shape[1], verify_width, lp_k, impl, self.mesh is not None,
@@ -786,6 +827,7 @@ class ModelRunner:
         assert batch.tokens.shape[1] == 1, "multi_step is decode-only"
         b_real = batch.batch_size
         padded = self._pad(batch)
+        self.last_attn_dispatch = self._attn_dispatch(padded, self.attn_impl)
         dispatch_key = (
             padded.tokens.shape[0], padded.tokens.shape[1],
             padded.block_tables.shape[1], padded.history.shape[1],
@@ -833,6 +875,7 @@ class ModelRunner:
         assert batch.tokens.shape[1] == 1, "multi_step is decode-only"
         b_real = batch.batch_size
         padded = self._pad(batch)
+        self.last_attn_dispatch = self._attn_dispatch(padded, self.attn_impl)
         b, t = padded.tokens.shape
         n = padded.block_tables.shape[1]
         h = padded.history.shape[1]

@@ -387,6 +387,7 @@ def forward(
                     ring=ring, mesh=mesh,
                     ring_positions=ring_pos if ring else None,
                     impl=attn_impl,
+                    contiguous_positions=contiguous_positions,
                 )
                 x = x + attn_out
                 h2 = rms_norm(x, lp["mlp_norm"], eps=cfg.rms_eps, plus_one=cfg.norm_plus_one)

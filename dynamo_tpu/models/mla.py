@@ -100,7 +100,8 @@ def mla_attention(
     ring: bool = False,  # sequence-parallel ring over mesh's sp axis
     mesh=None,  # required when ring
     ring_positions: jnp.ndarray | None = None,  # [B, T] padding-hidden positions
-    impl: str | None = None,  # "pallas" enables the MLA decode kernel (T==1)
+    impl: str | None = None,  # "pallas" enables the MLA decode/verify kernel
+    contiguous_positions: bool = True,  # False: gappy rows (speculative verify)
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One MLA layer: returns (attn_out [B,T,D], c_cache, r_cache).
 
@@ -171,7 +172,7 @@ def mla_attention(
         from dynamo_tpu.ops.attention import default_impl
 
         impl = default_impl()
-    if t == 1 and impl == "pallas":
+    if impl == "pallas":
         from dynamo_tpu.ops.pallas_mla import (
             interpret_mode,
             mla_decode_supported,
@@ -179,25 +180,42 @@ def mla_attention(
             mla_paged_decode_sharded,
         )
 
-        if mla_decode_supported(r_kv, r_width):
+        # The multi-query kernel's per-row causal mask is exact for ANY
+        # position layout (T = 1 decode, gappy speculative-verify rows,
+        # contiguous prefill windows) — the only gates are geometry and the
+        # VMEM row cap on T.
+        if mla_decode_supported(
+            r_kv, r_width, t, n_heads, interpret=interpret_mode()
+        ):
             scale = (dn + dr) ** -0.5 * attn_mscale
-            q_rope_k = q_rope[:, 0]
+            q_rope_k = q_rope  # [B, T, H, dr]
             if r_width != dr:  # match the lane-padded rope stream
-                q_rope_k = jnp.pad(q_rope_k, ((0, 0), (0, 0), (0, r_width - dr)))
+                q_rope_k = jnp.pad(
+                    q_rope_k, ((0, 0), (0, 0), (0, 0), (0, r_width - dr))
+                )
             if mesh is None:
                 out_lat = mla_paged_decode(
-                    q_lat[:, 0], q_rope_k, c_cache, r_cache,
+                    q_lat, q_rope_k, c_cache, r_cache,
                     block_tables, positions,
                     scale=scale, interpret=interpret_mode(),
-                )[:, None]  # [B, 1, H, r_kv]
+                )  # [B, T, H, r_kv]
             else:
                 out_lat = mla_paged_decode_sharded(
-                    q_lat[:, 0], q_rope_k, c_cache, r_cache,
+                    q_lat, q_rope_k, c_cache, r_cache,
                     block_tables, positions,
                     mesh=mesh, scale=scale, interpret=interpret_mode(),
-                )[:, None]
+                )
             out = jnp.einsum("bthr,rhv->bthv", out_lat.astype(h.dtype), lp["w_uv"])
             return _qmm(out.reshape(b, t, n_heads * dv), lp["wo_mla"]), c_cache, r_cache
+        if t == 1 or not contiguous_positions:
+            # Decode/verify falling off the kernel is the ~5x downgrade
+            # worth alerting on; a T-over-cap contiguous prefill is not
+            # (no MLA prefill kernel exists to fall back FROM).
+            from dynamo_tpu.ops.pallas_paged import _record_fallback
+
+            _record_fallback(
+                "mla_decode" if t == 1 else "mla_verify", q, c_cache
+            )
 
     # -- gather this batch's pages and attend ------------------------------
     pages_per_seq = block_tables.shape[1]

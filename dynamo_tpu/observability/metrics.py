@@ -99,6 +99,15 @@ class EngineMetrics:
             "first-seen but fast, e.g. persistent-cache hit)",
             ["worker", "program", "reason"], registry=self.registry,
         )
+        # Attention dispatch path per engine step, synced from the core's
+        # cumulative counts on scrape. Same clear-then-set idiom as
+        # recompiles so stale (phase, path) pairs drop out.
+        self._attn_dispatch = Gauge(
+            "dynamo_engine_attn_dispatch_steps_total",
+            "Engine steps by attention phase (decode/verify/prefill) and "
+            "dispatch path (pallas kernel, reference fallback, ring)",
+            ["worker", "phase", "path"], registry=self.registry,
+        )
         self.prefill_queue_depth = gauge(
             f"{ns}_prefill_queue_depth", "Unclaimed tasks in the distributed prefill queue"
         )
@@ -187,6 +196,11 @@ class EngineMetrics:
             self._recompiles.clear()
             for (program, reason), n in tracker.counts().items():
                 self._recompiles.labels(self.worker, program, reason).set(n)
+        dispatch = getattr(core, "attn_dispatch_counts", None)
+        if dispatch is not None:
+            self._attn_dispatch.clear()
+            for (phase, path), n in dispatch.items():
+                self._attn_dispatch.labels(self.worker, phase, path).set(n)
 
     def _sync_transfer(self) -> None:
         if self._transfer is None:

@@ -25,8 +25,11 @@ Two implementations:
   the gathered K/V ``[B, S, n_kv, hd]`` per layer; fine for CPU CI and small
   contexts, memory-bound for long ones.
 - a Pallas TPU kernel (``dynamo_tpu.ops.pallas_paged``) that streams pages
-  from HBM into VMEM with double buffering and never materializes the gather
-  (selected automatically on TPU backends; see that module).
+  from HBM into VMEM through an N-deep DMA ring and never materializes the
+  gather. The kernel runs T = 1 decode, gappy T > 1 speculative-verify rows
+  (multi-query block-diagonal form), and split-K sequence partitioning for
+  low-batch long-context decode (selected automatically on TPU backends;
+  see that module and ``docs/KERNELS.md``).
 
 Reference capability being replaced: the paged-attention kernels inside vLLM /
 TRT-LLM that the reference wraps (SURVEY.md §2 row 30, §7 hard part (a)).
@@ -170,7 +173,10 @@ def paged_attention(
     per-row positions — speculative verify, sliding window — MUST pass
     False: the T > 1 Pallas prefill kernel derives its causal mask and KV
     lengths from row start/end only and silently computes wrong attention
-    on gappy layouts (it is bypassed when False)."""
+    on gappy layouts. False routes T > 1 to the multi-query decode kernel
+    instead, whose per-row causal mask is exact for any layout (reference
+    formulation only when the shape is outside kernel support — counted
+    under the ``verify`` fallback phase)."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if impl is None:

@@ -7,14 +7,20 @@ holds just ``r_kv + dr`` lanes per token (`models/mla.py`). The XLA gather
 formulation materializes the gathered latents and reads them three times
 per step (gather write, score einsum, output einsum): measured 0.21x of
 the HBM roofline on v5e at DeepSeek-V3 MLA geometry (BENCH r04). This
-kernel streams each page from HBM exactly once — double-buffered DMA,
-online softmax, accumulation in latent space — the same structure as the
-GQA decode kernel (`pallas_paged.py`), with two differences:
+kernel streams each page from HBM exactly once — an N-deep DMA ring,
+online softmax, accumulation in latent space — the same split-K,
+multi-query structure as the GQA decode kernel (`pallas_paged.py`, whose
+helpers it shares; see ``docs/KERNELS.md``), with two differences:
 
 - TWO key streams per block: scores are ``q_lat @ c^T + q_rope @ r^T``
-  (the rope part is a narrow 64-lane contraction riding the same DMA wave).
+  (the rope part is a narrow 128-lane contraction riding the same DMA wave).
 - The value IS the latent: ``acc += p @ c`` — no separate V stream at all,
   so HBM traffic per token is r_kv + dr bytes where GQA pays 2 * H_kv * hd.
+
+Because MLA is already MQA, multi-query verify rows need no block-diagonal
+staging: T_q query tokens per sequence are a plain ``[T_q * n_heads, r_kv]``
+row stack, each row masked to its own token's causal horizon — speculative
+verify batches run on this kernel instead of the gather formulation.
 
 Reference counterpart: none — the reference outsources kernels to
 vLLM/TRT-LLM (SURVEY.md §2 row 30); this is the TPU-native equivalent of
@@ -24,7 +30,6 @@ their MLA/MQA decode kernels (flash-MLA class).
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 
@@ -41,6 +46,15 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dynamo_tpu.ops.pallas_paged import (  # shared kernel helpers
+    _auto_num_splits,
+    _dma_depth,
+    _lse_combine,
+    _max_verify_t,
+    _pages_per_block,
+    interpret_mode,  # noqa: F401  (re-exported: models/mla.py imports it here)
+)
+
 NEG_INF = -1e30
 LANES = 128
 
@@ -48,26 +62,40 @@ LANES = 128
 _COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
 
 
-def mla_decode_supported(r_kv: int, r_width: int) -> bool:
+def mla_decode_supported(
+    r_kv: int,
+    r_width: int,
+    t_q: int = 1,
+    n_heads: int = 1,
+    *,
+    interpret: bool = False,
+) -> bool:
     """Geometry the kernel handles: both streams lane-aligned (the rope
     stream is pre-padded to a 128-lane tile by ``mla_cache_widths`` —
-    Mosaic cannot DMA sub-tile HBM slices)."""
-    return r_kv % LANES == 0 and r_width % LANES == 0
+    Mosaic cannot DMA sub-tile HBM slices). Interpret mode (CPU tests /
+    dryruns) relaxes only the lane alignment. ``t_q`` > 1 (multi-query
+    verify rows) is capped by the VMEM row budget."""
+    if not interpret and (r_kv % LANES != 0 or r_width % LANES != 0):
+        return False
+    return t_q <= _max_verify_t(max(1, n_heads), r_kv + r_width)
 
 
 def _mla_decode_kernel(
     # scalar prefetch (SMEM)
-    lengths_ref,  # i32[B]
+    lengths_ref,  # i32[B] per-sequence walk length (max row position + 1)
     tables_ref,  # i32[B * pages_per_seq]
+    qpos_ref,  # i32[B * t_q] absolute position of each query token
     # blocked operands
-    q_lat_ref,  # [n_heads, r_kv]  pre-scaled, cache dtype
-    q_rope_ref,  # [n_heads, dr]
+    q_lat_ref,  # [t_q * n_heads, r_kv]  pre-scaled, cache dtype
+    q_rope_ref,  # [t_q * n_heads, r_width]
     c_hbm,  # [P, page_size, r_kv] in HBM/ANY
-    r_hbm,  # [P, page_size, dr]
-    o_ref,  # f32[n_heads, r_kv]
+    r_hbm,  # [P, page_size, r_width]
+    acc_ref,  # f32[t_q * n_heads, r_kv] — this (b, split)'s partial
+    m_ref,  # f32[t_q * n_heads, LANES]
+    l_ref,  # f32[t_q * n_heads, LANES]
     # scratch
-    c_buf,  # [2, block_tokens, r_kv] VMEM
-    r_buf,  # [2, block_tokens, dr] VMEM
+    c_buf,  # [dma_depth, block_tokens, r_kv] VMEM ring
+    r_buf,  # [dma_depth, block_tokens, r_width]
     c_sem,
     r_sem,
     *,
@@ -75,17 +103,27 @@ def _mla_decode_kernel(
     pages_per_seq: int,
     pages_per_block: int,
     page_size: int,
+    blocks_per_split: int,
+    t_q: int,
+    n_heads: int,
+    dma_depth: int,
 ):
     b = pl.program_id(0)
+    sp = pl.program_id(1)
     bk = pages_per_block * page_size
-    length = lengths_ref[b]
-    num_blocks = pl.cdiv(length, bk)
 
     def blocks_of(bb):
         return pl.cdiv(jnp.maximum(lengths_ref[bb], 1), bk)
 
-    start_parity = (
-        jax.lax.fori_loop(0, b, lambda bb, acc: acc + blocks_of(bb), jnp.int32(0)) % 2
+    nb_total = blocks_of(b)
+    # Static split boundaries (see pallas_paged._decode_kernel): a row's
+    # accumulation order never depends on other rows' runtime lengths.
+    first = sp * blocks_per_split
+    nb_here = jnp.clip(nb_total - first, 0, blocks_per_split)
+
+    g0 = (
+        jax.lax.fori_loop(0, b, lambda bb, acc: acc + blocks_of(bb), jnp.int32(0))
+        + jnp.minimum(first, nb_total)
     )
 
     def page_index(bb, ii, j):
@@ -115,34 +153,50 @@ def _mla_decode_kernel(
                 r_hbm.at[page], r_buf.at[slot, rows, :], r_sem.at[slot]
             ).wait()
 
-    def next_indices(ii):
-        advance = ii + 1 >= num_blocks
-        nb = jnp.where(advance, b + 1, b)
+    def next_block(bb, ii):
+        advance = ii + 1 >= blocks_of(jnp.minimum(bb, batch - 1))
+        nb = jnp.where(advance, bb + 1, bb)
         ni = jnp.where(advance, 0, ii + 1)
-        is_last_overall = jnp.logical_and(nb >= batch, advance)
-        return jnp.minimum(nb, batch - 1), ni, is_last_overall
+        return nb, ni
 
-    @pl.when(b == 0)
+    def start_ahead(slot, bb, ii):
+        @pl.when(bb < batch)
+        def _():
+            start_block(slot, bb, ii)
+
+    @pl.when(jnp.logical_and(b == 0, sp == 0))
     def _():
-        start_block(0, 0, 0)
+        bb, ii = jnp.int32(0), jnp.int32(0)
+        for g in range(dma_depth - 1):
+            start_ahead(g % dma_depth, bb, ii)
+            bb, ii = next_block(bb, ii)
 
-    n_heads, r_kv = q_lat_ref.shape
+    r_rows, r_kv = q_lat_ref.shape
     q_lat = q_lat_ref[...]
     q_rope = q_rope_ref[...]
 
+    # Row r scores query token r // n_heads against that token's own
+    # causal horizon (multi-query verify rows; t_q == 1 reduces to the
+    # plain decode mask).
+    row_t = jax.lax.broadcasted_iota(jnp.int32, (r_rows, 1), 0) // n_heads
+    qpos = jnp.zeros((r_rows, 1), jnp.int32)
+    for tt in range(t_q):
+        qpos = jnp.where(row_t == tt, qpos_ref[b * t_q + tt], qpos)
+
     def body(i, carry):
         m, l, acc = carry
-        cur = (start_parity + i) % 2
-        nb, ni, is_last = next_indices(i)
+        ii = first + i
+        g = g0 + i
+        slot = g % dma_depth
+        bb, nxt = b, ii
+        for _ in range(dma_depth - 1):
+            bb, nxt = next_block(bb, nxt)
+        start_ahead((g + dma_depth - 1) % dma_depth, bb, nxt)
 
-        @pl.when(jnp.logical_not(is_last))
-        def _():
-            start_block(1 - cur, nb, ni)
+        wait_block(slot, b, ii)
 
-        wait_block(cur, b, i)
-
-        c = c_buf[cur]  # [bk, r_kv] cache dtype
-        r = r_buf[cur]  # [bk, dr]
+        c = c_buf[slot]  # [bk, r_kv] cache dtype
+        r = r_buf[slot]  # [bk, r_width]
         if c.dtype.itemsize < 2:  # fp8 cache: DMA at 1 B/elem, matmul in bf16
             c = c.astype(jnp.bfloat16)
             r = r.astype(jnp.bfloat16)
@@ -152,55 +206,74 @@ def _mla_decode_kernel(
             q_lat, c, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) + jax.lax.dot_general(
             q_rope, r, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # f32[H, bk]
-        kpos = i * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(kpos < length, s, NEG_INF)
+        )  # f32[R, bk]
+        kpos = ii * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = kpos <= qpos
+        s = jnp.where(mask, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
+        # Explicit p mask: an all-masked block (possible under per-row
+        # horizons) has s == m_new == NEG_INF and exp(0) would corrupt l.
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m - m_new)
         l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
         # The value IS the latent stream.
         acc_new = alpha * acc + jax.lax.dot_general(
             p.astype(c.dtype), c, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # f32[H, r_kv]
+        )  # f32[R, r_kv]
         return m_new, l_new, acc_new
 
-    m0 = jnp.full((n_heads, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((n_heads, 1), jnp.float32)
-    acc0 = jnp.zeros((n_heads, r_kv), jnp.float32)
-    _, l, acc = jax.lax.fori_loop(0, num_blocks, body, (m0, l0, acc0))
-    o_ref[...] = acc / l
+    m0 = jnp.full((r_rows, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((r_rows, 1), jnp.float32)
+    acc0 = jnp.zeros((r_rows, r_kv), jnp.float32)
+    m_fin, l_fin, acc_fin = jax.lax.fori_loop(0, nb_here, body, (m0, l0, acc0))
+    acc_ref[...] = acc_fin
+    m_ref[...] = jnp.broadcast_to(m_fin, (r_rows, LANES))
+    l_ref[...] = jnp.broadcast_to(l_fin, (r_rows, LANES))
 
 
-@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+@functools.partial(jax.jit, static_argnames=("scale", "interpret", "num_splits"))
 def mla_paged_decode(
-    q_lat: jnp.ndarray,  # [B, n_heads, r_kv] absorbed queries (NOT scaled)
-    q_rope: jnp.ndarray,  # [B, n_heads, dr] rope queries (NOT scaled)
+    q_lat: jnp.ndarray,  # [B, T, n_heads, r_kv] or [B, n_heads, r_kv] (T = 1)
+    q_rope: jnp.ndarray,  # [B, T, n_heads, r_width] or [B, n_heads, r_width]
     c_cache: jnp.ndarray,  # [P, page_size, r_kv] latent pages
-    r_cache: jnp.ndarray,  # [P, page_size, dr] rope-key pages
+    r_cache: jnp.ndarray,  # [P, page_size, r_width] rope-key pages
     block_tables: jnp.ndarray,  # i32[B, pages_per_seq]
-    positions: jnp.ndarray,  # i32[B, 1] decode-token position
+    positions: jnp.ndarray,  # i32[B, T] absolute position of each query token
     *,
     scale: float,
     interpret: bool = False,
+    num_splits: int = 0,  # 0 = auto (DYN_DECODE_SPLITS override)
 ) -> jnp.ndarray:
-    """Paged MLA decode; returns latent-space output f32[B, n_heads, r_kv]
-    (callers apply the absorbed W_uv up-projection)."""
-    from dynamo_tpu.ops.pallas_paged import _pages_per_block
-
-    b, n_heads, r_kv = q_lat.shape
+    """Paged MLA decode/verify; returns latent-space output
+    f32[B, T, n_heads, r_kv] (3D in, 3D out for the T = 1 decode shape;
+    callers apply the absorbed W_uv up-projection). Positions may be gappy
+    per row — causality is per query token."""
+    squeeze = q_lat.ndim == 3
+    if squeeze:
+        q_lat = q_lat[:, None]
+        q_rope = q_rope[:, None]
+    b, t_q, n_heads, r_kv = q_lat.shape
     num_pages, page_size, _ = c_cache.shape
     pages_per_seq = block_tables.shape[1]
-    dr = r_cache.shape[2]
-    ppb = _pages_per_block(pages_per_seq, page_size, r_kv + dr, c_cache.dtype.itemsize)
+    r_width = r_cache.shape[2]
+    depth = _dma_depth()
+    ppb = _pages_per_block(
+        pages_per_seq, page_size, r_kv + r_width, c_cache.dtype.itemsize, depth
+    )
     bk = ppb * page_size
+    max_blocks = -(-(pages_per_seq * page_size) // bk)
+    splits = num_splits if num_splits > 0 else _auto_num_splits(b, max_blocks)
+    splits = max(1, min(splits, max_blocks))
+    bps = -(-max_blocks // splits)
 
-    lengths = positions[:, 0] + 1
+    # Walk covers the row's farthest token; rows mask their own horizon.
+    lengths = jnp.max(positions, axis=1) + 1
 
     q_dtype = c_cache.dtype if c_cache.dtype.itemsize >= 2 else jnp.bfloat16
-    q_lat_s = (q_lat.astype(jnp.float32) * scale).astype(q_dtype)
-    q_rope_s = (q_rope.astype(jnp.float32) * scale).astype(q_dtype)
+    r_rows = t_q * n_heads
+    q_lat_s = (q_lat.astype(jnp.float32) * scale).astype(q_dtype).reshape(b, r_rows, r_kv)
+    q_rope_s = (q_rope.astype(jnp.float32) * scale).astype(q_dtype).reshape(b, r_rows, r_width)
 
     kernel = functools.partial(
         _mla_decode_kernel,
@@ -208,45 +281,58 @@ def mla_paged_decode(
         pages_per_seq=pages_per_seq,
         pages_per_block=ppb,
         page_size=page_size,
+        blocks_per_split=bps,
+        t_q=t_q,
+        n_heads=n_heads,
+        dma_depth=depth,
     )
-    out = pl.pallas_call(
+    acc_spec = pl.BlockSpec((None, None, r_rows, r_kv), lambda bb, ss, *_: (bb, ss, 0, 0))
+    ml_spec = pl.BlockSpec((None, None, r_rows, LANES), lambda bb, ss, *_: (bb, ss, 0, 0))
+    acc, m, l = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
-            grid=(b,),
+            num_scalar_prefetch=3,
+            grid=(b, splits),
             in_specs=[
-                pl.BlockSpec((None, n_heads, r_kv), lambda bb, *_: (bb, 0, 0)),
-                pl.BlockSpec((None, n_heads, dr), lambda bb, *_: (bb, 0, 0)),
+                pl.BlockSpec((None, r_rows, r_kv), lambda bb, ss, *_: (bb, 0, 0)),
+                pl.BlockSpec((None, r_rows, r_width), lambda bb, ss, *_: (bb, 0, 0)),
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pl.ANY),
             ],
-            out_specs=pl.BlockSpec((None, n_heads, r_kv), lambda bb, *_: (bb, 0, 0)),
+            out_specs=[acc_spec, ml_spec, ml_spec],
             scratch_shapes=[
-                pltpu.VMEM((2, bk, r_kv), c_cache.dtype),
-                pltpu.VMEM((2, bk, dr), r_cache.dtype),
-                pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.VMEM((depth, bk, r_kv), c_cache.dtype),
+                pltpu.VMEM((depth, bk, r_width), r_cache.dtype),
+                pltpu.SemaphoreType.DMA((depth,)),
+                pltpu.SemaphoreType.DMA((depth,)),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((b, n_heads, r_kv), jnp.float32),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, splits, r_rows, r_kv), jnp.float32),
+            jax.ShapeDtypeStruct((b, splits, r_rows, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((b, splits, r_rows, LANES), jnp.float32),
+        ],
         compiler_params=_COMPILER_PARAMS(
-            dimension_semantics=("arbitrary",)
+            dimension_semantics=("arbitrary", "arbitrary")
         ),
         interpret=interpret,
     )(
         lengths,
         block_tables.reshape(-1),
+        positions.reshape(-1),
         q_lat_s,
         q_rope_s,
         c_cache,
         r_cache,
     )
-    return out
+    out = _lse_combine(acc, m[..., 0], l[..., 0])  # [B, R, r_kv]
+    out = out.reshape(b, t_q, n_heads, r_kv)
+    return out[:, 0] if squeeze else out
 
 
 def mla_paged_decode_sharded(
-    q_lat: jnp.ndarray,  # [B, n_heads, r_kv]
-    q_rope: jnp.ndarray,  # [B, n_heads, r_width]
+    q_lat: jnp.ndarray,  # [B, T, n_heads, r_kv] or [B, n_heads, r_kv]
+    q_rope: jnp.ndarray,
     c_cache: jnp.ndarray,
     r_cache: jnp.ndarray,
     block_tables: jnp.ndarray,
@@ -255,6 +341,7 @@ def mla_paged_decode_sharded(
     mesh,
     scale: float,
     interpret: bool = False,
+    num_splits: int = 0,
 ) -> jnp.ndarray:
     """MLA decode kernel under a device mesh: tp shards the QUERY heads,
     dp the batch; the latent/rope caches are replicated (MQA — every head
@@ -266,12 +353,16 @@ def mla_paged_decode_sharded(
 
     batch_axis = "dp" if "dp" in mesh.axis_names else None
     tp_axis = "tp" if "tp" in mesh.axis_names else None
-    q_spec = P(batch_axis, tp_axis, None)
+    if q_lat.ndim == 4:  # multi-query verify rows: heads on axis 2
+        q_spec = P(batch_axis, None, tp_axis, None)
+    else:
+        q_spec = P(batch_axis, tp_axis, None)
     row_spec = P(batch_axis, None)
 
     def body(ql, qr, cc, rc, bt, pos):
         return mla_paged_decode(
-            ql, qr, cc, rc, bt, pos, scale=scale, interpret=interpret
+            ql, qr, cc, rc, bt, pos, scale=scale, interpret=interpret,
+            num_splits=num_splits,
         )
 
     return _shard_map(
@@ -280,6 +371,3 @@ def mla_paged_decode_sharded(
         out_specs=q_spec,
         check_vma=False,  # pallas out_shape carries no vma metadata
     )(q_lat, q_rope, c_cache, r_cache, block_tables, positions)
-
-
-from dynamo_tpu.ops.pallas_paged import interpret_mode  # noqa: E402  (shared flag)

@@ -1,8 +1,8 @@
-"""Pallas TPU paged-attention decode kernel.
+"""Pallas TPU paged-attention decode kernel (split-K, multi-query).
 
 The HBM-bandwidth-bound hot loop of serving: for each decoding sequence,
 attention must read that sequence's entire paged KV history once. This
-kernel streams KV pages HBM -> VMEM with double-buffered async DMA and
+kernel streams KV pages HBM -> VMEM with an N-deep ring of async DMAs and
 computes online-softmax attention on the fly — the gathered K/V is never
 materialized (the XLA reference formulation in ``ops/attention.py`` builds
 a [B, S, n_kv, hd] gather per layer per step, which at batch 32 / 1k-token
@@ -21,27 +21,42 @@ Design (fresh, built around the engine's page-major cache layout):
   every serving config (8 x 64, 8 x 128, ...), satisfying Mosaic's DMA
   alignment even at head_dim 64 (Llama-3.2-1B) where a head-major layout
   cannot be sliced.
-- Grid is ``(batch,)``; all KV heads of a sequence are processed together.
-  GQA is one **block-diagonal matmul**: queries are staged as
-  ``[n_heads, n_kv * head_dim]`` with head h's values in its own KV head's
-  column strip, so ``scores = q_bd @ kv_slab.T`` yields every head's logits
-  against its own KV head in a single MXU contraction (the off-strip
-  products are computed and discarded — MXU cycles are free in a
-  DMA-bound kernel). The weighted-value product accumulates the full
-  ``[n_heads, n_kv * head_dim]`` strip; the caller extracts each head's
-  diagonal strip with one fused XLA gather at the end.
-- Per grid step, a ``fori_loop`` walks the sequence's page-blocks
-  (``pages_per_block`` pages per iteration) carrying the online-softmax
-  state (m, l, acc) — no scratch accumulators. The DMA pipeline is
-  double-buffered **across grid steps**: while block i of sequence b is
-  being reduced, the next block (possibly sequence b+1's first) is in
-  flight. Buffer parity is a pure function of the global block index (a
-  prefix count over earlier sequences), so there is no mutable cross-step
+- **Multi-query rows** (speculative verify): the kernel accepts T_q >= 1
+  query tokens per sequence, staged as ``[T_q * n_heads, W]`` block-diagonal
+  strips. Causality is a per-ROW mask ``kpos <= position[b, t]`` — exact
+  for gappy verify layouts, and for T_q = 1 it reduces bit-for-bit to the
+  plain decode mask (``kpos < length``). A K+1-wide verify row therefore
+  attends exactly as K+1 sequential decodes would, on the same DMA-
+  pipelined path instead of the ~5x-slower XLA gather formulation.
+- GQA is one **block-diagonal matmul**: row (t, h) carries head h's query
+  in its own KV head's column strip, so ``scores = q_bd @ kv_slab.T``
+  yields every (token, head) pair's logits against its KV head in a single
+  MXU contraction (off-strip products are computed and discarded — MXU
+  cycles are free in a DMA-bound kernel). The weighted-value product
+  accumulates the full ``[T_q * n_heads, W]`` strip; the caller extracts
+  each head's diagonal strip with one fused XLA gather at the end.
+- **Split-K grid** ``(batch, num_splits)`` (Flash-Decoding style): each
+  split walks its static slice of the sequence's page-block list carrying
+  partial online-softmax state (m, l, acc) and writes per-split outputs;
+  a small log-sum-exp combine (:func:`_lse_combine`) merges them. Split
+  boundaries are functions of STATIC shapes only (pages bucket, page
+  size, block size) — never of runtime lengths — so the per-row float
+  accumulation order is identical whether a row is scored as a T_q = 1
+  decode or inside a T_q = K+1 verify batch. ``num_splits`` is auto-chosen
+  from batch x context (``DYN_DECODE_SPLITS`` overrides) so low-batch
+  long-context decode keeps multiple DMA streams in flight instead of one
+  sequential block walk per sequence.
+- The DMA pipeline is an N-deep ring (``DYN_DECODE_DMA_DEPTH``, default
+  4) **across grid steps**: while block g is being reduced, blocks
+  g+1..g+depth-1 (possibly a later split's or sequence's) are in flight.
+  Ring slot is a pure function of the global block index (a prefix count
+  over earlier sequences and splits), so there is no mutable cross-step
   state and the kernel is interpret-mode exact.
 
 Replaces the role of vLLM's paged-attention CUDA kernel in the reference
 stack (SURVEY.md §2 row 30, §7 hard part (a); `lib/llm/src/kernels/` is the
-reference's only first-party kernel code).
+reference's only first-party kernel code). See ``docs/KERNELS.md`` for the
+full design note.
 
 Tests: ``tests/test_pallas_paged.py`` (interpret mode on CPU vs the
 reference formulation); ``tests_tpu/test_on_device.py`` (Mosaic-compiled
@@ -76,7 +91,9 @@ _COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerPa
 # trace time, so each entry counts *compiled programs* that fell back (one
 # per shape signature — exactly the "once per config" the operator needs),
 # warns on first occurrence, and is exported by the frontend /metrics
-# endpoint (frontend/metrics.py:FrontendMetrics.render).
+# endpoint (frontend/metrics.py:FrontendMetrics.render). Phases: ``decode``
+# (T == 1), ``verify`` (T > 1 gappy rows — speculative verify), ``prefill``
+# (T > 1 contiguous), ``sliding_window``, ``mla_decode``/``mla_verify``.
 FALLBACK_COUNTS: dict[str, int] = {}
 _fallback_lock = threading.Lock()
 _warned_signatures: set[str] = set()
@@ -113,62 +130,151 @@ def interpret_mode() -> bool:
     return os.environ.get("DYNAMO_PALLAS_INTERPRET", "") == "1"
 
 
+def _dma_depth() -> int:
+    """Ring depth of the KV DMA pipeline (slots per stream).
+
+    Depth 2 is the classic double buffer; deeper rings keep more page
+    blocks in flight across split/sequence boundaries, hiding the issue
+    latency of short tail blocks. ``DYN_DECODE_DMA_DEPTH`` overrides
+    (min 2). Resolved at trace time — a static program parameter."""
+    try:
+        depth = int(os.environ.get("DYN_DECODE_DMA_DEPTH", "4"))
+    except ValueError:
+        depth = 4
+    return max(2, depth)
+
+
+def _max_verify_t(n_heads: int, width: int) -> int:
+    """Largest T_q the multi-query kernel accepts per row.
+
+    The staged queries, accumulator, and m/l state all scale with
+    ``R = T_q * n_heads`` rows of ``width`` lanes in VMEM; past this cap a
+    verify batch (e.g. a mixed step whose prefill chunks widened T to the
+    chunk size) falls back to the gather formulation — recorded under the
+    ``verify`` phase. ``DYN_VERIFY_T_MAX`` overrides the default of 32."""
+    try:
+        cap = int(os.environ.get("DYN_VERIFY_T_MAX", "32"))
+    except ValueError:
+        cap = 32
+    # q (2B) + acc (4B f32) rows must fit a ~4 MiB slice of scoped VMEM.
+    vmem_cap = (4 * 2**20) // max(1, n_heads * width * 6)
+    return max(1, min(cap, vmem_cap))
+
+
+def _auto_num_splits(batch: int, max_blocks: int) -> int:
+    """Split-K factor: sequence-axis parallelism for the grid.
+
+    At batch >= 8 the batch grid dimension already keeps the DMA engines
+    busy; below that, split the block walk so low-batch long-context decode
+    exposes ~8 concurrent walks (Flash-Decoding's regime). Clamped to the
+    static block count — an all-empty split is wasted grid real estate.
+    ``DYN_DECODE_SPLITS`` overrides (resolved at trace time)."""
+    env = os.environ.get("DYN_DECODE_SPLITS", "")
+    if env:
+        try:
+            return max(1, min(int(env), max_blocks))
+        except ValueError:
+            pass
+    if batch >= 8:
+        return 1
+    return max(1, min(max_blocks, 8 // max(1, batch)))
+
+
 def _pages_per_block(
-    pages_per_seq: int, page_size: int, width: int | None = None, itemsize: int = 2
+    pages_per_seq: int,
+    page_size: int,
+    width: int | None = None,
+    itemsize: int = 2,
+    dma_depth: int = 2,
 ) -> int:
     """Pages per compute block: target ~1024 tokens per block, capped by the
     kernel's scoped-VMEM budget.
 
     Deep blocks amortize the fori_loop/online-softmax overhead and batch
     more DMA issues per wait (measured +45% decode throughput vs 2-page
-    blocks at serving shapes). But the double-buffered K+V tiles
-    (2 slots x 2 streams x bk x width) live in scoped VMEM with a hard
-    ~16 MiB limit — wide slabs (e.g. 16 kv-heads x 128 = 2048 lanes) blow
-    it at the 1024-token target (observed: OLMoE decode failing AOT
+    blocks at serving shapes). But the ring-buffered K+V tiles
+    (dma_depth slots x 2 streams x bk x width) live in scoped VMEM with a
+    hard ~16 MiB limit — wide slabs (e.g. 16 kv-heads x 128 = 2048 lanes)
+    blow it at the 1024-token target (observed: OLMoE decode failing AOT
     compile with "scoped vmem ... exceeded"), so when ``width`` is given
-    the block shrinks to keep the tiles within an 8 MiB budget. No
+    the block shrinks to keep the tiles within an 8 MiB budget (deeper
+    rings trade block depth for pipeline depth at constant VMEM). No
     divisibility requirement — the tail block clamps its page indices and
     masks by length."""
     target = max(1, 1024 // page_size)
     if width is not None:
         budget = 8 * 2**20
-        max_tokens = max(page_size, budget // (4 * width * itemsize))
+        max_tokens = max(page_size, budget // (2 * dma_depth * width * itemsize))
         target = min(target, max(1, max_tokens // page_size))
     return max(1, min(pages_per_seq, target))
 
 
+def _lse_combine(acc: jnp.ndarray, m: jnp.ndarray, l: jnp.ndarray) -> jnp.ndarray:
+    """Merge per-split online-softmax partials along the split axis.
+
+    ``acc`` f32[B, S, R, W] (unnormalized weighted values), ``m``/``l``
+    f32[B, S, R] (running max / normalizer). Returns f32[B, R, W].
+
+    An empty split carries (m=NEG_INF, l=0, acc=0): its rescale factor
+    ``exp(NEG_INF - M)`` underflows to exactly 0.0, so it contributes
+    nothing — and with a single split the combine is exactly ``acc / l``
+    (alpha = exp(0) = 1 and the singleton sums are identity), keeping the
+    non-split decode path bit-identical."""
+    m_max = jnp.max(m, axis=1, keepdims=True)  # [B, 1, R]
+    alpha = jnp.exp(m - m_max)  # [B, S, R]
+    denom = jnp.sum(alpha * l, axis=1)  # [B, R]
+    num = jnp.sum(acc * alpha[..., None], axis=1)  # [B, R, W]
+    return num / denom[..., None]
+
+
 def _decode_kernel(
     # scalar prefetch (SMEM, shared by all grid steps)
-    lengths_ref,  # i32[B]
+    lengths_ref,  # i32[B] per-sequence walk length (max row position + 1)
     tables_ref,  # i32[B * pages_per_seq]
+    qpos_ref,  # i32[B * t_q] absolute position of each query token
     # blocked operands
-    q_ref,  # f32[n_heads, W] block-diagonal queries, W = n_kv * head_dim
+    q_ref,  # [t_q * n_heads, W] block-diagonal queries, W = n_kv * head_dim
     k_hbm,  # [P, page_size, W] in HBM/ANY (page-major, heads flattened)
     v_hbm,
-    o_ref,  # f32[n_heads, W] — full strip; caller extracts diagonals
+    acc_ref,  # f32[t_q * n_heads, W] — this (b, split)'s partial strip
+    m_ref,  # f32[t_q * n_heads, LANES] — running max (broadcast on lanes)
+    l_ref,  # f32[t_q * n_heads, LANES] — running normalizer
     # scratch
-    k_buf,  # [2, block_tokens, W] VMEM
+    k_buf,  # [dma_depth, block_tokens, W] VMEM ring
     v_buf,
-    k_sem,  # DMA sems [2]
+    k_sem,  # DMA sems [dma_depth]
     v_sem,
     *,
     batch: int,
     pages_per_seq: int,
     pages_per_block: int,
     page_size: int,
+    blocks_per_split: int,
+    t_q: int,
+    n_heads: int,
+    dma_depth: int,
 ):
     b = pl.program_id(0)
+    sp = pl.program_id(1)
     bk = pages_per_block * page_size  # tokens per compute block
-    length = lengths_ref[b]
-    num_blocks = pl.cdiv(length, bk)
 
     def blocks_of(bb):
         return pl.cdiv(jnp.maximum(lengths_ref[bb], 1), bk)
 
-    # Double-buffer parity is a pure function of the global block index (no
-    # mutable cross-step state): count the blocks of earlier sequences.
-    start_parity = (
-        jax.lax.fori_loop(0, b, lambda bb, acc: acc + blocks_of(bb), jnp.int32(0)) % 2
+    nb_total = blocks_of(b)
+    # Split sp walks block-in-sequence indices [first, first + nb_here).
+    # Boundaries derive from the STATIC blocks_per_split, so a row's
+    # accumulation order never depends on other rows' runtime lengths.
+    first = sp * blocks_per_split
+    nb_here = jnp.clip(nb_total - first, 0, blocks_per_split)
+
+    # Ring slot is a pure function of the global block index (no mutable
+    # cross-step state): blocks of earlier sequences plus earlier splits
+    # of this one. Splits partition each sequence's walk, so the global
+    # order is plain (sequence, block-in-sequence) lexicographic.
+    g0 = (
+        jax.lax.fori_loop(0, b, lambda bb, acc: acc + blocks_of(bb), jnp.int32(0))
+        + jnp.minimum(first, nb_total)
     )
 
     def page_index(bb, ii, j):
@@ -176,7 +282,7 @@ def _decode_kernel(
         # clamp to the row's own used range (not just the table width) so
         # the DMA never dereferences entries the engine didn't fill —
         # sentinel-filled tables (-1 tails) are safe, not just zero-filled
-        # ones. Clamped tokens are masked out by the length check.
+        # ones. Clamped tokens are masked out by the position check.
         last = jnp.maximum(lengths_ref[bb] - 1, 0) // page_size
         idx = jnp.minimum(ii * pages_per_block + j, last)
         return tables_ref[bb * pages_per_seq + idx]
@@ -203,165 +309,242 @@ def _decode_kernel(
                 v_hbm.at[page], v_buf.at[slot, rows, :], v_sem.at[slot]
             ).wait()
 
-    def next_indices(ii):
-        """Global-order successor of block (b, ii): next block of this
-        sequence, else the next sequence's block 0 (clamped at grid end)."""
-        advance = ii + 1 >= num_blocks
-        nb = jnp.where(advance, b + 1, b)
+    def next_block(bb, ii):
+        """Global-order successor of block (bb, ii): the sequence's next
+        block, else the next sequence's block 0. bb may walk past the last
+        sequence — start_ahead guards on bb < batch before dereferencing."""
+        advance = ii + 1 >= blocks_of(jnp.minimum(bb, batch - 1))
+        nb = jnp.where(advance, bb + 1, bb)
         ni = jnp.where(advance, 0, ii + 1)
-        is_last_overall = jnp.logical_and(nb >= batch, advance)
-        return jnp.minimum(nb, batch - 1), ni, is_last_overall
+        return nb, ni
 
-    # First grid step primes its own first block; every other step's block 0
-    # was prefetched by its predecessor.
-    @pl.when(b == 0)
+    def start_ahead(slot, bb, ii):
+        @pl.when(bb < batch)
+        def _():
+            start_block(slot, bb, ii)
+
+    # The very first grid step primes ring slots 0..depth-2; every later
+    # block is started depth-1 blocks ahead of its consumption by the body
+    # that consumes block g - depth + 1 (empty splits consume no global
+    # indices, so the lookahead chain passes through them untouched).
+    @pl.when(jnp.logical_and(b == 0, sp == 0))
     def _():
-        start_block(0, 0, 0)
+        bb, ii = jnp.int32(0), jnp.int32(0)
+        for g in range(dma_depth - 1):
+            start_ahead(g % dma_depth, bb, ii)
+            bb, ii = next_block(bb, ii)
 
-    n_heads, width = q_ref.shape
+    r_rows, width = q_ref.shape
     # Keep matmul operands in the cache dtype (bf16): the MXU multiplies
     # bf16 natively with f32 accumulation — an f32 formulation costs multiple
     # MXU passes AND a whole-block VPU astype per K/V block, which measured
     # ~3x slower than HBM DMA on v5e (the kernel must stay DMA-bound).
-    q_bd = q_ref[...]  # [H, W] block-diagonal, pre-scaled, cache dtype
+    q_bd = q_ref[...]  # [R, W] block-diagonal, pre-scaled, cache dtype
+
+    # Row r scores query token r // n_heads: its causal horizon is that
+    # token's own absolute position (per-row mask — exact for gappy verify
+    # layouts; for t_q == 1 identical to the plain kpos < length mask).
+    row_t = jax.lax.broadcasted_iota(jnp.int32, (r_rows, 1), 0) // n_heads
+    qpos = jnp.zeros((r_rows, 1), jnp.int32)
+    for tt in range(t_q):
+        qpos = jnp.where(row_t == tt, qpos_ref[b * t_q + tt], qpos)
 
     def body(i, carry):
         m, l, acc = carry
-        cur = (start_parity + i) % 2
-        nb, ni, is_last = next_indices(i)
+        ii = first + i  # block-in-sequence index
+        g = g0 + i  # global block index
+        slot = g % dma_depth
+        # Start the block depth-1 ahead in the global walk; its ring slot's
+        # previous occupant (block g - 1) was consumed last iteration.
+        bb, nxt = b, ii
+        for _ in range(dma_depth - 1):
+            bb, nxt = next_block(bb, nxt)
+        start_ahead((g + dma_depth - 1) % dma_depth, bb, nxt)
 
-        @pl.when(jnp.logical_not(is_last))
-        def _():
-            start_block(1 - cur, nb, ni)
+        wait_block(slot, b, ii)
 
-        wait_block(cur, b, i)
-
-        k = k_buf[cur]  # [bk, W] cache dtype
-        v = v_buf[cur]
+        k = k_buf[slot]  # [bk, W] cache dtype
+        v = v_buf[slot]
         if k.dtype.itemsize < 2:  # fp8 cache: DMA at 1 B/elem, matmul in bf16
             k = k.astype(jnp.bfloat16)
             v = v.astype(jnp.bfloat16)
-        # Block-diagonal q: head h only overlaps its own KV head's strip, so
-        # this one contraction is every head's logits against its KV head.
+        # Block-diagonal q: row (t, h) only overlaps head h's KV strip, so
+        # this one contraction is every (token, head)'s logits.
         s = jax.lax.dot_general(
             q_bd, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # f32[H, bk]
-        kpos = i * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(kpos < length, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))  # [H, 1]
-        p = jnp.exp(s - m_new)
+        )  # f32[R, bk]
+        kpos = ii * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = kpos <= qpos  # per-row causal horizon
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))  # [R, 1]
+        # Mask p explicitly: in an all-masked block s == m_new == NEG_INF
+        # and exp(s - m_new) would be 1, corrupting l/acc. Where any real
+        # key exists, where() selects exactly what exp(NEG_INF - m_new)
+        # underflows to (0.0) — bit-identical to the unmasked formulation.
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m - m_new)
         l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = alpha * acc + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )  # f32[H, W]; head h's answer lives in its own KV head's strip
+        )  # f32[R, W]; row (t, h)'s answer lives in head h's strip
         return m_new, l_new, acc_new
 
-    m0 = jnp.full((n_heads, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((n_heads, 1), jnp.float32)
-    acc0 = jnp.zeros((n_heads, width), jnp.float32)
-    _, l, acc = jax.lax.fori_loop(0, num_blocks, body, (m0, l0, acc0))
-    o_ref[...] = acc / l
+    m0 = jnp.full((r_rows, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((r_rows, 1), jnp.float32)
+    acc0 = jnp.zeros((r_rows, width), jnp.float32)
+    m_fin, l_fin, acc_fin = jax.lax.fori_loop(0, nb_here, body, (m0, l0, acc0))
+    # Unnormalized partials out; the host-side _lse_combine merges splits.
+    # An empty split writes (NEG_INF, 0, 0) — annihilated by the combine.
+    acc_ref[...] = acc_fin
+    m_ref[...] = jnp.broadcast_to(m_fin, (r_rows, LANES))
+    l_ref[...] = jnp.broadcast_to(l_fin, (r_rows, LANES))
 
 
-def decode_supported(q: jnp.ndarray, k_cache: jnp.ndarray) -> bool:
-    """Shapes this kernel handles on hardware: even GQA grouping and a
-    128-lane-aligned page slab width (n_kv * head_dim).
+def decode_kernel_supported(
+    n_heads: int,
+    head_dim: int,
+    width: int,
+    t_q: int = 1,
+    *,
+    interpret: bool = False,
+) -> bool:
+    """Pure-shape form of :func:`decode_supported` (no arrays needed —
+    the engine's dispatch-path telemetry calls this from host code).
 
-    ``k_cache`` is the engine's flat page-major layout ``[P, page_size, W]``
-    with ``W = n_kv * head_dim`` (``models/llama.py:init_kv_cache``)."""
-    n_heads, head_dim = q.shape[-2], q.shape[-1]
-    width = k_cache.shape[2]
+    Hardware requires even GQA grouping and a 128-lane-aligned page slab
+    width; interpret mode (CPU tests / dryruns) relaxes only the lane
+    alignment — Mosaic's DMA constraint, which the interpreter doesn't
+    have. ``t_q`` > 1 (multi-query verify rows) is additionally capped by
+    the VMEM row budget (:func:`_max_verify_t`)."""
     if width % head_dim != 0:
         return False
     n_kv = width // head_dim
-    return n_heads % n_kv == 0 and width % LANES == 0
+    if n_heads % n_kv != 0:
+        return False
+    if not interpret and width % LANES != 0:
+        return False
+    return t_q <= _max_verify_t(n_heads, width)
 
 
-@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def decode_supported(q: jnp.ndarray, k_cache: jnp.ndarray, *, interpret: bool = False) -> bool:
+    """Shapes the decode/verify kernel handles for ``q [B, T, H, hd]``
+    against the engine's flat page-major cache ``[P, page_size, W]`` with
+    ``W = n_kv * head_dim`` (``models/llama.py:init_kv_cache``)."""
+    n_heads, head_dim = q.shape[-2], q.shape[-1]
+    t_q = q.shape[1] if q.ndim == 4 else 1
+    return decode_kernel_supported(
+        n_heads, head_dim, k_cache.shape[2], t_q, interpret=interpret
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret", "num_splits"))
 def paged_decode_attention(
-    q: jnp.ndarray,  # [B, 1, n_heads, head_dim]
+    q: jnp.ndarray,  # [B, T_q, n_heads, head_dim] (T_q = 1 decode, K+1 verify)
     k_cache: jnp.ndarray,  # [P, page_size, n_kv * head_dim] (page-major, flat)
     v_cache: jnp.ndarray,
     block_tables: jnp.ndarray,  # i32[B, pages_per_seq]
-    positions: jnp.ndarray,  # i32[B, 1] absolute position of the decode token
+    positions: jnp.ndarray,  # i32[B, T_q] absolute position of each query token
     *,
     scale: float,
     interpret: bool = False,
+    num_splits: int = 0,  # 0 = auto (_auto_num_splits / DYN_DECODE_SPLITS)
 ) -> jnp.ndarray:
-    """Decode-phase (T == 1) paged attention; returns [B, 1, n_heads, hd].
+    """Decode/verify paged attention; returns [B, T_q, n_heads, hd].
 
-    Cache layout matches the engine exactly ([P, ps, W] flat slabs), so the
-    layer-stacked cache can be passed as-is with per-layer offset tables."""
-    b, t, n_heads, head_dim = q.shape
-    assert t == 1, "decode kernel is T == 1 only"
+    Positions may be gappy per row (speculative verify batches, padding
+    columns) — causality is per query token. Cache layout matches the
+    engine exactly ([P, ps, W] flat slabs), so the layer-stacked cache can
+    be passed as-is with per-layer offset tables."""
+    b, t_q, n_heads, head_dim = q.shape
     num_pages, page_size, width = k_cache.shape
     n_kv = width // head_dim
     group = n_heads // n_kv
     pages_per_seq = block_tables.shape[1]
-    ppb = _pages_per_block(pages_per_seq, page_size, width, k_cache.dtype.itemsize)
+    depth = _dma_depth()
+    ppb = _pages_per_block(pages_per_seq, page_size, width, k_cache.dtype.itemsize, depth)
     bk = ppb * page_size
+    # Static upper bound on a sequence's block walk — split boundaries must
+    # NOT depend on runtime lengths (bit-parity between T_q = 1 and verify).
+    max_blocks = -(-(pages_per_seq * page_size) // bk)
+    splits = num_splits if num_splits > 0 else _auto_num_splits(b, max_blocks)
+    splits = max(1, min(splits, max_blocks))
+    bps = -(-max_blocks // splits)
 
     kf, vf = k_cache, v_cache
 
-    lengths = positions[:, 0] + 1  # history + the token being decoded
+    # Walk length covers the row's farthest query token (max, not last:
+    # padding columns carry position 0); rows mask their own horizon.
+    lengths = jnp.max(positions, axis=1) + 1
 
-    # Block-diagonal query staging: head kv*G+g occupies lane strip
-    # [kv*hd, (kv+1)*hd). One einsum against eye(n_kv); XLA fuses it.
-    # Scale in f32, then store in the cache dtype so the kernel's matmuls
-    # run at native MXU bf16 rate.
-    q3 = q[:, 0].astype(jnp.float32) * scale  # [B, H, hd]
+    # Block-diagonal query staging: row t * n_heads + (kv * G + g) occupies
+    # lane strip [kv*hd, (kv+1)*hd). One einsum against eye(n_kv); XLA
+    # fuses it. Scale in f32, then store in the cache dtype so the kernel's
+    # matmuls run at native MXU bf16 rate.
+    q5 = q.astype(jnp.float32) * scale  # [B, T, H, hd]
     eye = jnp.eye(n_kv, dtype=jnp.float32)
     # Queries never drop below bf16 (an fp8 cache quantizes K/V storage, not
     # the live queries).
     q_dtype = k_cache.dtype if k_cache.dtype.itemsize >= 2 else jnp.bfloat16
+    r_rows = t_q * n_heads
     q_bd = jnp.einsum(
-        "bkgd,kK->bkgKd", q3.reshape(b, n_kv, group, head_dim), eye
-    ).reshape(b, n_heads, width).astype(q_dtype)
+        "btkgd,kK->btkgKd", q5.reshape(b, t_q, n_kv, group, head_dim), eye
+    ).reshape(b, r_rows, width).astype(q_dtype)
 
-    spec = pl.BlockSpec((None, n_heads, width), lambda bb, *_: (bb, 0, 0))
+    q_spec = pl.BlockSpec((None, r_rows, width), lambda bb, ss, *_: (bb, 0, 0))
+    acc_spec = pl.BlockSpec((None, None, r_rows, width), lambda bb, ss, *_: (bb, ss, 0, 0))
+    ml_spec = pl.BlockSpec((None, None, r_rows, LANES), lambda bb, ss, *_: (bb, ss, 0, 0))
     kernel = functools.partial(
         _decode_kernel,
         batch=b,
         pages_per_seq=pages_per_seq,
         pages_per_block=ppb,
         page_size=page_size,
+        blocks_per_split=bps,
+        t_q=t_q,
+        n_heads=n_heads,
+        dma_depth=depth,
     )
-    out = pl.pallas_call(
+    acc, m, l = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,  # lengths, flat block table
-            grid=(b,),
+            num_scalar_prefetch=3,  # lengths, flat block table, query positions
+            grid=(b, splits),
             in_specs=[
-                spec,
+                q_spec,
                 pl.BlockSpec(memory_space=pl.ANY),
                 pl.BlockSpec(memory_space=pl.ANY),
             ],
-            out_specs=spec,
+            out_specs=[acc_spec, ml_spec, ml_spec],
             scratch_shapes=[
-                pltpu.VMEM((2, bk, width), k_cache.dtype),
-                pltpu.VMEM((2, bk, width), v_cache.dtype),
-                pltpu.SemaphoreType.DMA((2,)),
-                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.VMEM((depth, bk, width), k_cache.dtype),
+                pltpu.VMEM((depth, bk, width), v_cache.dtype),
+                pltpu.SemaphoreType.DMA((depth,)),
+                pltpu.SemaphoreType.DMA((depth,)),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((b, n_heads, width), jnp.float32),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, splits, r_rows, width), jnp.float32),
+            jax.ShapeDtypeStruct((b, splits, r_rows, LANES), jnp.float32),
+            jax.ShapeDtypeStruct((b, splits, r_rows, LANES), jnp.float32),
+        ],
         compiler_params=_COMPILER_PARAMS(
-            dimension_semantics=("arbitrary",)
+            dimension_semantics=("arbitrary", "arbitrary")
         ),
         interpret=interpret,
     )(
         lengths,
         block_tables.reshape(-1),
+        positions.reshape(-1),
         q_bd,
         kf,
         vf,
     )
-    # Extract each head's diagonal strip: head kv*G+g reads lanes
+    out = _lse_combine(acc, m[..., 0], l[..., 0])  # [B, R, W]
+    # Extract each row's diagonal strip: row (t, kv*G+g) reads lanes
     # [kv*hd, (kv+1)*hd). Fused einsum against the same eye.
-    o5 = out.reshape(b, n_kv, group, n_kv, head_dim)
-    o = jnp.einsum("bkgKd,kK->bkgd", o5, eye)
-    return o.reshape(b, 1, n_heads, head_dim).astype(q.dtype)
+    o6 = out.reshape(b, t_q, n_kv, group, n_kv, head_dim)
+    o = jnp.einsum("btkgKd,kK->btkgd", o6, eye)
+    return o.reshape(b, t_q, n_heads, head_dim).astype(q.dtype)
 
 
 def paged_attention_pallas(
@@ -375,16 +558,21 @@ def paged_attention_pallas(
     contiguous_positions: bool = True,
 ) -> jnp.ndarray:
     """TPU dispatch: decode kernel for T == 1, prefill flash kernel for
-    T > 1, XLA gather formulation as the (counted, warned) fallback.
+    contiguous T > 1, the same decode kernel in multi-query form for gappy
+    T > 1 (speculative verify), XLA gather formulation as the (counted,
+    warned) fallback.
 
     The prefill kernel requires per-row contiguous positions
     (``positions[b, t] = start_b + t``) — true for every engine prefill,
-    chunked or not. A T > 1 caller with gappy per-token positions (e.g. a
-    speculative-verify batch) must pass ``contiguous_positions=False`` to
-    get the exact reference formulation instead. When ``positions`` is a
-    concrete array (outside jit) the contract is verified for real; under
-    tracing the declaration is trusted — it is static routing, a traced
-    check would force compiling both kernels behind a cond."""
+    chunked or not. A T > 1 caller with gappy per-token positions (a
+    speculative-verify batch) must pass ``contiguous_positions=False``:
+    that routes to the multi-query decode kernel, whose per-row causal
+    mask is exact for any position layout (and to the reference
+    formulation only when the shape is outside the kernel's support).
+    When ``positions`` is a concrete array (outside jit) the contiguity
+    contract is verified for real; under tracing the declaration is
+    trusted — it is static routing, a traced check would force compiling
+    both kernels behind a cond."""
     if q.shape[1] > 1 and contiguous_positions and not isinstance(
         jnp.asarray(positions), jax.core.Tracer
     ):
@@ -411,19 +599,30 @@ def paged_attention_pallas(
             )
     interpret = interpret_mode()
     if q.shape[1] == 1:
-        if decode_supported(q, k_cache):
+        if decode_supported(q, k_cache, interpret=interpret):
             return paged_decode_attention(
                 q, k_cache, v_cache, block_tables, positions, scale=scale,
                 interpret=interpret,
             )
         _record_fallback("decode", q, k_cache)
+    elif not contiguous_positions:
+        # Speculative verify: gappy per-row positions, T = K+1 (or the
+        # chunk width in a mixed step). The multi-query kernel's per-row
+        # mask makes it exact here — the batched verify that used to pay
+        # gather-path cost runs on the DMA-pipelined kernel.
+        if decode_supported(q, k_cache, interpret=interpret):
+            return paged_decode_attention(
+                q, k_cache, v_cache, block_tables, positions, scale=scale,
+                interpret=interpret,
+            )
+        _record_fallback("verify", q, k_cache)
     else:
         from dynamo_tpu.ops.pallas_prefill import (
             paged_prefill_attention,
             prefill_supported,
         )
 
-        if contiguous_positions and prefill_supported(q, k_cache):
+        if prefill_supported(q, k_cache):
             return paged_prefill_attention(
                 q, k_cache, v_cache, block_tables, positions, scale=scale,
                 interpret=interpret,

@@ -232,10 +232,12 @@ def _prefill_kernel(
 
 def prefill_supported(q: jnp.ndarray, k_cache: jnp.ndarray) -> bool:
     """Same geometry contract as the decode kernel (shared predicate): even
-    GQA grouping and a 128-lane-aligned page slab width."""
-    from dynamo_tpu.ops.pallas_paged import decode_supported
+    GQA grouping and a 128-lane-aligned page slab width. The decode
+    kernel's multi-query T cap does NOT apply — this kernel tiles the
+    query axis, so chunk width is unbounded."""
+    from dynamo_tpu.ops.pallas_paged import decode_kernel_supported
 
-    return decode_supported(q, k_cache)
+    return decode_kernel_supported(q.shape[-2], q.shape[-1], k_cache.shape[2])
 
 
 @functools.partial(jax.jit, static_argnames=("scale", "interpret"))

@@ -113,6 +113,37 @@ def test_spec_probe_structure(monkeypatch):
     assert "spec_decode_speedup" in out
 
 
+def test_decode_kernel_probe_structure(monkeypatch):
+    """probe_decode_kernel's contract (ISSUE 7): stable headline keys plus a
+    per-cell grid, sized down to a CPU/interpret-friendly geometry. The
+    bandwidth values are emulation artifacts off-TPU, so only structure and
+    positivity are asserted."""
+    import bench
+
+    monkeypatch.setenv("BENCH_DK_BATCHES", "1,2")
+    monkeypatch.setenv("BENCH_DK_CONTEXTS", "24,40")
+    monkeypatch.setenv("BENCH_DK_PAGE_SIZE", "8")
+    monkeypatch.setenv("BENCH_DK_HEADS", "4")
+    monkeypatch.setenv("BENCH_DK_KV", "2")
+    monkeypatch.setenv("BENCH_DK_HEAD_DIM", "16")
+    monkeypatch.setenv("BENCH_DK_ITERS", "1")
+    out = bench.probe_decode_kernel()
+    assert out["interpret"] is True  # CPU-pinned suite
+    assert "error" not in out
+    assert len(out["grid"]) == 4  # 2 batches x 2 contexts
+    for cell in out["grid"]:
+        for key in ("batch", "context", "kv_bytes_per_call", "us_per_call",
+                    "gbytes_per_sec", "roofline_frac"):
+            assert key in cell, f"grid cell missing {key}"
+        # KV read model: K and V, whole pages, bf16.
+        pages = -(-cell["context"] // 8)
+        assert cell["kv_bytes_per_call"] == 2 * cell["batch"] * pages * 8 * 32 * 2
+        assert cell["gbytes_per_sec"] > 0
+    assert out["decode_kernel_gbps"] == max(
+        c["gbytes_per_sec"] for c in out["grid"])
+    assert out["decode_roofline_frac"] > 0
+
+
 def test_bench_doc_goodput_keys():
     """build_doc's top-level contract (ISSUE 4): the SLO-conditioned goodput
     headline keys are stable, sourced from the headline (llama-3.2-1b)
@@ -135,11 +166,18 @@ def test_bench_doc_goodput_keys():
     doc2 = bench.build_doc(configs, pull={}, spec=spec)
     assert doc2["spec_accept_rate"] == 0.6
     assert doc2["spec_decode_speedup"] == 1.8
+    assert doc2["decode_kernel_gbps"] == 0.0  # probe absent: stable default
+    dk = {"decode_kernel_gbps": 700.5, "decode_roofline_frac": 0.8553}
+    doc3 = bench.build_doc(configs, pull={}, decode_kernel=dk)
+    assert doc3["decode_kernel_gbps"] == 700.5
+    assert doc3["decode_roofline_frac"] == 0.8553
+    assert doc3["detail"]["decode_kernel_probe"] == dk
     # An all-errors suite still emits the full key set.
     empty = bench.build_doc([{"preset": "x", "error": "boom"}], pull={})
     for key in ("value", "goodput_tokens_per_s_at_slo", "slo_ttft_attainment",
                 "itl_p99_ms", "max_decode_stall_ms", "spec_accept_rate",
-                "spec_decode_speedup"):
+                "spec_decode_speedup", "decode_kernel_gbps",
+                "decode_roofline_frac"):
         assert key in empty
         assert empty[key] == 0.0
 

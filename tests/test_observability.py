@@ -134,6 +134,7 @@ class _FakeCore:
     admission_rejections = 4
     spec_tokens_proposed = 20
     spec_tokens_accepted = 9
+    attn_dispatch_counts = {("decode", "pallas"): 5, ("verify", "fallback"): 1}
     waiting = ["a"]
     running = ["b", "c"]
     prefilling = ["d"]
@@ -158,6 +159,7 @@ EXPECTED_ENGINE_FAMILIES = {
     "dynamo_engine_step_decode_rows",
     "dynamo_engine_step_chunk_rows",
     "dynamo_engine_step_chunk_tokens",
+    "dynamo_engine_attn_dispatch_steps_total",
     "dynamo_engine_step_decodable_seqs",
     "dynamo_engine_mixed_steps_total",
     "dynamo_engine_stall_violations_total",
@@ -230,6 +232,9 @@ async def test_engine_metrics_names_labels_and_values():
     # Recompile counts synced from the runner's CompileTracker.
     assert 'dynamo_engine_recompiles_total{program="step",reason="new_shape",worker="w1"} 2.0' in text
     assert 'dynamo_engine_recompiles_total{program="multi_step",reason="warm_cache",worker="w1"} 1.0' in text
+    # Attention dispatch path synced from the core's per-step counts.
+    assert 'dynamo_engine_attn_dispatch_steps_total{path="pallas",phase="decode",worker="w1"} 5.0' in text
+    assert 'dynamo_engine_attn_dispatch_steps_total{path="fallback",phase="verify",worker="w1"} 1.0' in text
     assert 'dynamo_kv_transfer_blocks_total{worker="w1"} 12.0' in text
     for phase in KV_PHASES:
         assert f'dynamo_kv_transfer_phase_seconds_count{{phase="{phase}",worker="w1"}} 1.0' in text

@@ -161,3 +161,200 @@ def test_forward_dispatches_to_kernel(monkeypatch):
         attn_impl="pallas",
     )
     assert hits
+
+
+def _pin_small_blocks(monkeypatch):
+    """Force 1-page compute blocks so a handful of pages spans many blocks
+    (split-K boundaries become exercisable at test sizes)."""
+    import dynamo_tpu.ops.pallas_paged as pp
+
+    monkeypatch.setattr(pp, "_pages_per_block", lambda pps, ps, *a: 1)
+
+
+@pytest.mark.parametrize("num_splits", [2, 4, 8])
+def test_split_k_matches_reference_ragged(monkeypatch, num_splits):
+    """Split-K partials + LSE combine vs reference across ragged lengths:
+    a length shorter than one split's slice, lengths that leave tail splits
+    completely empty, and length <= page_size."""
+    _pin_small_blocks(monkeypatch)  # bk = page_size = 16; 8 pages -> 8 blocks
+    rng = np.random.default_rng(7)
+    q, k, v, tables, positions = _random_case(
+        rng, b=4, n_heads=8, n_kv=2, head_dim=64, page_size=16,
+        pages_per_seq=8, max_len=128,
+    )
+    # length 11 (single block — every later split empty), 101, 128 (full),
+    # 16 (== page_size exactly).
+    positions = jnp.asarray([[10], [100], [127], [15]], jnp.int32)
+    scale = 0.125
+    want = paged_attention_reference(q, k, v, tables, positions, scale=scale)
+    got = paged_decode_attention(
+        q, k, v, tables, positions, scale=scale, interpret=True,
+        num_splits=num_splits,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_split_k_fp8_cache_through_combine(monkeypatch):
+    """fp8 cache values must survive the per-split partials and the f32
+    LSE combine (upcast happens inside each split's block loop)."""
+    _pin_small_blocks(monkeypatch)
+    rng = np.random.default_rng(11)
+    q, k, v, tables, positions = _random_case(
+        rng, b=2, n_heads=8, n_kv=2, head_dim=64, page_size=16,
+        pages_per_seq=6, max_len=96,
+    )
+    positions = jnp.asarray([[95], [40]], jnp.int32)
+    k8 = k.astype(jnp.float8_e4m3fn)
+    v8 = v.astype(jnp.float8_e4m3fn)
+    scale = 0.125
+    want = paged_attention_reference(q, k, v, tables, positions, scale=scale)
+    got = paged_decode_attention(
+        q, k8, v8, tables, positions, scale=scale, interpret=True, num_splits=3,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=0.15, rtol=0.15)
+
+
+def test_split_k_single_split_matches_unsplit():
+    """num_splits=1 must be bitwise identical to the auto-chosen grid at
+    batch >= 8 (the combine degenerates to acc / l exactly)."""
+    rng = np.random.default_rng(13)
+    q, k, v, tables, positions = _random_case(
+        rng, b=8, n_heads=4, n_kv=2, head_dim=64, page_size=16,
+        pages_per_seq=4, max_len=64,
+    )
+    scale = 0.125
+    a = paged_decode_attention(q, k, v, tables, positions, scale=scale,
+                               interpret=True, num_splits=1)
+    b_ = paged_decode_attention(q, k, v, tables, positions, scale=scale,
+                                interpret=True)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+
+
+def test_multi_query_verify_rows_match_reference():
+    """T_q > 1 gappy rows (speculative verify layout): per-row causal mask
+    vs the reference's key_pos <= positions mask, including a padding row
+    whose trailing columns carry position 0."""
+    rng = np.random.default_rng(17)
+    b, t_q, n_heads, n_kv, head_dim = 3, 4, 8, 2, 64
+    page_size, pages_per_seq = 16, 4
+    width = n_kv * head_dim
+    num_pages = b * pages_per_seq + 1
+    k = jnp.asarray(rng.standard_normal((num_pages, page_size, width)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((num_pages, page_size, width)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((b, t_q, n_heads, head_dim)), jnp.float32)
+    tables = jnp.asarray(
+        1 + rng.permutation(num_pages - 1)[: b * pages_per_seq].reshape(b, pages_per_seq),
+        jnp.int32,
+    )
+    # Row 0: contiguous verify window; row 1: decode token + padding zeros
+    # (mixed spec batch); row 2: full-width window ending at the last slot.
+    positions = jnp.asarray(
+        [[37, 38, 39, 40], [12, 0, 0, 0], [60, 61, 62, 63]], jnp.int32
+    )
+    scale = head_dim**-0.5
+    want = paged_attention_reference(q, k, v, tables, positions, scale=scale)
+    got = paged_decode_attention(q, k, v, tables, positions, scale=scale, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_multi_query_bitwise_matches_per_position_decode(monkeypatch):
+    """Losslessness invariant: a T_q = K+1 verify row must score token t
+    EXACTLY as a T_q = 1 decode of token t would (same block partition,
+    same split count -> same accumulation order; the extra masked blocks a
+    longer row walks contribute exact zeros)."""
+    _pin_small_blocks(monkeypatch)
+    rng = np.random.default_rng(19)
+    b, t_q = 2, 3
+    q, k, v, tables, _ = _random_case(
+        rng, b=b, n_heads=8, n_kv=2, head_dim=64, page_size=16,
+        pages_per_seq=6, max_len=96,
+    )
+    q = jnp.asarray(rng.standard_normal((b, t_q, 8, 64)), jnp.float32)
+    positions = jnp.asarray([[50, 51, 52], [7, 8, 9]], jnp.int32)
+    scale = 0.125
+    multi = paged_decode_attention(
+        q, k, v, tables, positions, scale=scale, interpret=True, num_splits=2,
+    )
+    for t in range(t_q):
+        single = paged_decode_attention(
+            q[:, t : t + 1], k, v, tables, positions[:, t : t + 1],
+            scale=scale, interpret=True, num_splits=2,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(multi[:, t : t + 1]), np.asarray(single)
+        )
+
+
+def test_verify_dispatch_reaches_kernel_no_fallback(monkeypatch):
+    """paged_attention_pallas with contiguous_positions=False and a
+    supported shape must use the multi-query kernel and record no
+    fallback (the spec-verify fast path)."""
+    import dynamo_tpu.ops.pallas_paged as pp
+
+    monkeypatch.setenv("DYNAMO_PALLAS_INTERPRET", "1")
+    rng = np.random.default_rng(23)
+    b, t_q = 2, 3
+    q, k, v, tables, _ = _random_case(
+        rng, b=b, n_heads=8, n_kv=2, head_dim=64, page_size=16,
+        pages_per_seq=4, max_len=64,
+    )
+    q = jnp.asarray(rng.standard_normal((b, t_q, 8, 64)), jnp.float32)
+    positions = jnp.asarray([[20, 22, 23], [5, 6, 8]], jnp.int32)  # gappy
+    before = pp.fallback_snapshot()
+    got = pp.paged_attention_pallas(
+        q, k, v, tables, positions, scale=0.125, contiguous_positions=False,
+    )
+    after = pp.fallback_snapshot()
+    assert not [s for s in after if s.startswith("verify") and after[s] != before.get(s, 0)]
+    want = paged_attention_reference(q, k, v, tables, positions, scale=0.125)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_verify_fallback_recorded_for_unsupported_t(monkeypatch):
+    """A verify batch wider than the VMEM row cap must fall back and be
+    counted under the distinct 'verify' phase (not 'prefill')."""
+    import dynamo_tpu.ops.pallas_paged as pp
+
+    monkeypatch.setenv("DYNAMO_PALLAS_INTERPRET", "1")
+    monkeypatch.setenv("DYN_VERIFY_T_MAX", "2")
+    rng = np.random.default_rng(29)
+    b, t_q = 1, 3
+    q, k, v, tables, _ = _random_case(
+        rng, b=b, n_heads=8, n_kv=2, head_dim=64, page_size=16,
+        pages_per_seq=4, max_len=64,
+    )
+    q = jnp.asarray(rng.standard_normal((b, t_q, 8, 64)), jnp.float32)
+    positions = jnp.asarray([[10, 12, 13]], jnp.int32)
+    before = pp.fallback_snapshot()
+    got = pp.paged_attention_pallas(
+        q, k, v, tables, positions, scale=0.125, contiguous_positions=False,
+    )
+    after = pp.fallback_snapshot()
+    verify_keys = [s for s in after if s.startswith("verify:")
+                   and after[s] > before.get(s, 0)]
+    assert verify_keys
+    want = paged_attention_reference(q, k, v, tables, positions, scale=0.125)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_dma_ring_depth_env(monkeypatch):
+    """Deeper DMA rings must not change results (slot assignment is a pure
+    function of the global block index)."""
+    rng = np.random.default_rng(31)
+    q, k, v, tables, positions = _random_case(
+        rng, b=3, n_heads=8, n_kv=2, head_dim=64, page_size=16,
+        pages_per_seq=8, max_len=128,
+    )
+    scale = 0.125
+    want = paged_attention_reference(q, k, v, tables, positions, scale=scale)
+    for depth in ("2", "3", "6"):
+        monkeypatch.setenv("DYN_DECODE_DMA_DEPTH", depth)
+        # The ring depth is resolved at trace time; identical shapes would
+        # otherwise reuse the previous depth's compiled program.
+        paged_decode_attention.clear_cache()
+        got = paged_decode_attention(
+            q, k, v, tables, positions, scale=scale, interpret=True,
+            num_splits=2,
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)

@@ -182,9 +182,11 @@ def test_gappy_positions_rejected_outside_jit(monkeypatch):
     import dynamo_tpu.ops.pallas_prefill as pf
     from dynamo_tpu.ops.pallas_paged import paged_attention_pallas
 
-    # The guard fires before kernel selection; route the post-guard calls to
-    # the reference formulation so this runs on CPU.
+    # The guard fires before kernel selection; route the post-guard prefill
+    # calls to the reference formulation so this runs on CPU. The declared-
+    # gappy call now reaches the multi-query decode kernel — interpret it.
     monkeypatch.setattr(pf, "prefill_supported", lambda *a: False)
+    monkeypatch.setenv("DYNAMO_PALLAS_INTERPRET", "1")
 
     b, t, n_heads, head_dim, page_size = 1, 4, 4, 64, 4
     q = jnp.zeros((b, t, n_heads, head_dim), jnp.float32)
