@@ -700,17 +700,27 @@ def probe_kv_pull_gbps() -> dict:
 def probe_cross_process_wire() -> dict:
     """The packed-bytes TCP wire between the chip process and a separate
     CPU-mesh OS process: the DCN-path prefill->decode number the in-process
-    gather can't stand in for (VERDICT r4 item 3a)."""
+    gather can't stand in for (VERDICT r4 item 3a).
+
+    Runs the wire-v3 stream-count x chunk-size sweep (ISSUE 8): entry 0 of
+    BENCH_WIRE_STREAMS is the v2 single-stream baseline the headline
+    ``speedup_vs_v2`` is measured against."""
     import asyncio
 
-    from dynamo_tpu.bench.kv_wire import measure_cross_process
+    from dynamo_tpu.bench.kv_wire import sweep_cross_process
 
     pages = int(os.environ.get("BENCH_WIRE_PAGES", "8"))
     iters = int(os.environ.get("BENCH_WIRE_ITERS", "5"))
-    chunk = int(os.environ.get("BENCH_WIRE_CHUNK", "0")) or None  # 0 = auto
-    return asyncio.run(
-        measure_cross_process(pages_per_chain=pages, iters=iters, chunk_pages=chunk)
+    chunks = tuple(
+        int(c) for c in os.environ.get("BENCH_WIRE_CHUNK", "0").split(",")
+    )  # 0 = auto (pages/4)
+    stream_counts = tuple(
+        int(s) for s in os.environ.get("BENCH_WIRE_STREAMS", "0,1,2,4,8").split(",")
     )
+    return asyncio.run(sweep_cross_process(
+        pages_per_chain=pages, iters=iters,
+        stream_counts=stream_counts, chunk_pages_list=chunks,
+    ))
 
 
 def build_doc(configs, pull, wire=None, stall=None, spec=None,
@@ -750,6 +760,11 @@ def build_doc(configs, pull, wire=None, stall=None, spec=None,
         # (see probe_decode_kernel; meaningless off-TPU but always present).
         "decode_kernel_gbps": (decode_kernel or {}).get("decode_kernel_gbps", 0.0),
         "decode_roofline_frac": (decode_kernel or {}).get("decode_roofline_frac", 0.0),
+        # KV-wire headline keys (ISSUE 8): best amortized cross-process wire
+        # bandwidth from the stream-count x chunk-size sweep and its overlap
+        # fraction (see probe_cross_process_wire / bench/kv_wire.py).
+        "kv_wire_gbps": (wire or {}).get("kv_wire_gbps", 0.0),
+        "kv_wire_overlap_frac": (wire or {}).get("kv_wire_overlap_frac", 0.0),
         "detail": {
             "backend": jax.default_backend(),
             "suite": [c.get("preset") for c in configs],

@@ -1,0 +1,67 @@
+#!/bin/bash
+# Round-6 campaign: wire v3 (striped multi-stream KV transfer) vs the r05
+# agg-vs-disagg baseline. Sequential: the chip fits one engine config at a
+# time. Phases 1-2 run anywhere (loopback TCP + CPU mocker fleet); phase 3
+# needs a chip.
+set -x
+cd "$(dirname "$0")/.."
+mkdir -p bench/results
+export DYNAMO_MOE_DISPATCH=  # not MoE configs; keep defaults
+
+# 1. Loopback KV-wire sweep: streams x chunk grid over two real OS
+#    processes, real TCP. Headline keys kv_wire_gbps / speedup_vs_v2 are
+#    the acceptance numbers for the striped wire.
+timeout 3600 env JAX_PLATFORMS=cpu \
+  BENCH_WIRE_STREAMS="${BENCH_WIRE_STREAMS:-0,1,2,4,8}" \
+  BENCH_WIRE_CHUNK="${BENCH_WIRE_CHUNK:-0}" \
+  BENCH_WIRE_PAGES="${BENCH_WIRE_PAGES:-8}" \
+  BENCH_WIRE_ITERS="${BENCH_WIRE_ITERS:-4}" \
+  python - <<'EOF' \
+  > bench/results/kv_wire_sweep_r06.json \
+  2> bench/results/kv_wire_sweep_r06.log
+import json
+import bench
+print(json.dumps(bench.probe_cross_process_wire(), indent=1))
+EOF
+
+# 2. Mocker-fleet agg vs disagg (multi-worker shape, CPU platform), wire v3
+#    on the decode<-prefill ship path.
+timeout 1800 python - <<'EOF' \
+  > bench/results/pareto_agg_vs_disagg_mock_r06.json \
+  2> bench/results/pareto_agg_vs_disagg_mock_r06.log
+import jax
+jax.config.update("jax_platforms", "cpu")
+from dynamo_tpu.bench.__main__ import main
+main([
+    "--model", "test-tiny", "--mock", "--topologies", "agg,disagg",
+    "--levels", "1,8,32", "--num-requests", "64", "--workers", "2",
+    "--prefill-workers", "2", "--disagg-threshold", "64",
+    "--shared-prefix", "64", "--group-prefix", "64", "--unique-len", "64",
+    "--osl", "48", "--num-pages", "4096", "--max-batch-size", "32",
+])
+EOF
+
+# 3. Agg vs disagg on the 1B, same chip, real dual-engine path with the
+#    striped host fallback engaged (chip-only; skipped when no TPU).
+if python -c "import jax; assert jax.devices()[0].platform == 'tpu'" 2>/dev/null; then
+  timeout 5400 python -m dynamo_tpu.bench \
+    --model llama-3.2-1b --topologies agg,disagg \
+    --levels 1,8,32 --num-requests 64 --workers 1 --prefill-workers 1 \
+    --disagg-threshold 256 \
+    --shared-prefix 512 --groups 4 --group-prefix 384 --unique-len 256 --osl 150 \
+    --num-pages 512 --max-batch-size 32 --page-size 128 --max-seq-len 1536 \
+    --max-prefill-tokens 4096 --decode-steps 8 \
+    > bench/results/pareto_agg_vs_disagg_1b_r06.json \
+    2> bench/results/pareto_agg_vs_disagg_1b_r06.log
+else
+  echo "no TPU: skipping phase 3 (see bench/results/R06_NOTES.md)"
+fi
+
+# A killed/failed phase leaves an empty or unparseable artifact: rename it
+# .failed so nothing downstream mistakes a dead run for a result.
+for f in bench/results/kv_wire_sweep_r06.json bench/results/pareto_*_r06.json; do
+  [ -e "$f" ] || continue
+  python -c "import json,sys; json.load(open(sys.argv[1]))" "$f" 2>/dev/null \
+    || { mv "$f" "$f.failed"; echo "FAILED ARTIFACT: $f"; }
+done
+echo CAMPAIGN-DONE

@@ -6,10 +6,16 @@ that runs anywhere (`disagg/transfer.py`), unlike the PJRT transfer engine
 second, CPU-mesh receiver process on the same host:
 
   sender (this process, real TPU): prefill commits page chains ->
-  `send_blocks_chunked` (wire v2: per-chunk device gather dispatched async,
-  D2H DMA overlapping the previous chunk's msgpack pack + TCP send) ->
-  receiver (child OS process, CPU): per chunk unpack -> allocate ->
-  write_pages -> incremental commit -> summary response.
+  `send_blocks_chunked` (wire v3: chunks striped round-robin over
+  DYN_KV_WIRE_STREAMS duplex TCP connections, raw blob frames, deferred
+  acks; ``streams=0`` pins the single-stream msgpack v2 baseline) ->
+  receiver (child OS process, CPU): per chunk crc-verify -> reassemble in
+  seq order -> allocate -> write_pages -> incremental commit -> ack.
+
+``sweep_cross_process`` runs a stream-count x chunk-size grid (one receiver
+child per combo) and reports the headline ``kv_wire_gbps`` /
+``kv_wire_overlap_frac`` / ``speedup_vs_v2`` keys that bench.py promotes to
+the stable top level of the bench document.
 
 Each iteration ships a DISTINCT hash chain (a repeat would dedup against
 the receiver's prefix cache and measure nothing). Iteration 0 is reported
@@ -97,11 +103,17 @@ async def measure_cross_process(
     page_size: int = PAGE_SIZE,
     child_cmd: list[str] | None = None,
     chunk_pages: int | None = None,
+    streams: int | None = None,
+    _core=None,
+    _seed: int = 0,
 ) -> dict:
     """Parent side. Spawns the CPU receiver child, ships ``iters`` distinct
-    chains over the chunked v2 stream (``send_blocks_chunked``: gather, pack
-    and wire pipelined), returns the labeled measurement dict. Per-iter
-    phase sums exceeding ``total_s`` is the direct overlap signal."""
+    chains over the chunked stream (``send_blocks_chunked``: gather, pack
+    and wire pipelined; v3 striped over ``streams`` duplex connections,
+    ``streams=0`` pins the v2 single-stream baseline), returns the labeled
+    measurement dict. Per-iter phase sums exceeding ``total_s`` is the
+    direct overlap signal. ``_core``/``_seed`` let sweep_cross_process reuse
+    one compiled parent core across combos with distinct chains each."""
     import subprocess
     import sys
 
@@ -152,22 +164,27 @@ async def measure_cross_process(
             name="kv-wire-child-drain",
         ).start()
 
-        core = _build_core(cfg, pages_per_chain * iters + 4, page_size, chain_tokens)
+        core = _core or _build_core(cfg, pages_per_chain * iters + 4, page_size, chain_tokens)
         transport = TcpTransport(host="127.0.0.1")
         # >= 4 chunks per chain by default, so the double buffer has room to
         # overlap (one chunk can't pipeline with itself).
         chunk = chunk_pages or max(1, pages_per_chain // 4)
         try:
-            rng = np.random.default_rng(0)
+            rng = np.random.default_rng(_seed)
             per_iter = []
+            protocol = "v2"
+            n_streams = 0
             for i in range(iters):
                 tokens = rng.integers(1, cfg.vocab_size - 1, size=chain_tokens).tolist()
-                hashes = _prefill_chain(core, tokens, f"wire-{i}")
+                hashes = _prefill_chain(core, tokens, f"wire-{_seed}-{i}")
                 t0 = time.perf_counter()
                 resp = await send_blocks_chunked(
-                    transport, kv_addr, f"wire-{i}", core, hashes, chunk_pages=chunk,
+                    transport, kv_addr, f"wire-{_seed}-{i}", core, hashes,
+                    chunk_pages=chunk, streams=streams,
                 )
                 t1 = time.perf_counter()
+                protocol = resp.get("protocol", "v2")
+                n_streams = resp.get("streams", 0)
                 if resp.get("injected") != len(hashes):
                     raise RuntimeError(f"iter {i}: injected {resp.get('injected')} != {len(hashes)}")
                 ph = resp["phases"]
@@ -187,19 +204,26 @@ async def measure_cross_process(
                 p["scatter_s"] = round(p.pop("scatter_s_cum") - prev, 6)
                 prev += p["scatter_s"]
             amortized = per_iter[1:] or per_iter
+            phase_sum = sum(
+                p["gather_s"] + p["pack_s"] + p["wire_s"] for p in amortized)
+            overlap_s = sum(p["overlap_s"] for p in amortized)
             return {
                 "wire": "tcp_cross_process",
                 "receiver": "separate OS process, cpu mesh",
                 "definition": (
                     "cold = iter 0 (both sides' compiles + connection setup); "
-                    "amortized = mean of the rest. Chunked v2 stream "
-                    f"({chunk} pages/chunk, double-buffered): gather_s = device "
-                    "gather -> host DMA span (crosses the tunnel link when the "
-                    "chip is axon-remote), pack_s = msgpack framing, wire_s = "
-                    "TCP + receiver ingest, scatter_s = receiver write_pages. "
-                    "Phases overlap, so sum of phases > total_s measures the "
-                    "pipeline win directly (overlap_s)"
+                    f"amortized = mean of the rest. Chunked {protocol} stream "
+                    f"({chunk} pages/chunk, {n_streams or 1} stream(s)): "
+                    "gather_s = device gather -> host DMA span (crosses the "
+                    "tunnel link when the chip is axon-remote), pack_s = "
+                    "framing (v3: zero-copy blob views; v2: msgpack), wire_s "
+                    "= per-stream-attributed TCP + receiver ingest wall time, "
+                    "scatter_s = receiver write_pages. Phases overlap, so sum "
+                    "of phases > total_s measures the pipeline win directly "
+                    "(overlap_s; overlap_frac = overlap_s / sum of phases)"
                 ),
+                "protocol": protocol,
+                "streams": n_streams,
                 "chain_mb": round(per_iter[0]["bytes"] / 1e6, 1),
                 "iters": iters,
                 "chunk_pages": chunk,
@@ -211,8 +235,10 @@ async def measure_cross_process(
                 "amortized_wire_only_gbytes_per_sec": round(
                     sum(p["bytes"] for p in amortized)
                     / max(sum(p["wire_s"] for p in amortized), 1e-9) / 1e9, 6),
-                "amortized_overlap_s": round(
-                    sum(p["overlap_s"] for p in amortized) / max(len(amortized), 1), 4),
+                "amortized_overlap_s": round(overlap_s / max(len(amortized), 1), 4),
+                "overlap_frac": round(
+                    min(1.0, max(0.0, overlap_s / phase_sum)) if phase_sum > 0 else 0.0,
+                    4),
                 "per_iter": per_iter,
             }
         finally:
@@ -223,6 +249,85 @@ async def measure_cross_process(
             proc.wait(timeout=20)
         except Exception:
             proc.kill()
+
+
+async def sweep_cross_process(
+    *,
+    pages_per_chain: int = 8,
+    iters: int = 5,
+    cfg: ModelConfig | None = None,
+    page_size: int = PAGE_SIZE,
+    child_cmd: list[str] | None = None,
+    stream_counts: tuple[int, ...] = (0, 1, 2, 4, 8),
+    chunk_pages_list: tuple[int, ...] = (0,),
+) -> dict:
+    """Stream-count x chunk-size grid over the cross-process wire.
+
+    One receiver child per combo (fresh page pool, no prefix-cache dedup);
+    the PARENT core — whose jit compiles dominate probe setup on hardware —
+    is built once and reused, with a distinct chain seed per combo.
+
+    ``stream_counts`` entry 0 is the v2 single-stream msgpack baseline; the
+    headline ``speedup_vs_v2`` compares the best striped combo against the
+    v2 run *at the same chunk size* (the acceptance comparison). Headline
+    keys:
+
+    - ``kv_wire_gbps``: best amortized end-to-end GB/s across the grid;
+    - ``kv_wire_overlap_frac``: overlap fraction of that best combo
+      (sum-of-phases time hidden by pipelining, 0..1);
+    - ``speedup_vs_v2``: best-combo GB/s over same-chunk v2 GB/s.
+    """
+    cfg = cfg or wire_config()
+    chunks = tuple(c or max(1, pages_per_chain // 4) for c in chunk_pages_list)
+    chain_tokens = pages_per_chain * page_size
+    core = _build_core(cfg, pages_per_chain * iters + 4, page_size, chain_tokens)
+    combos = []
+    seed = 0
+    for chunk in chunks:
+        for streams in stream_counts:
+            seed += 1
+            out = await measure_cross_process(
+                pages_per_chain=pages_per_chain, iters=iters, cfg=cfg,
+                page_size=page_size, child_cmd=child_cmd, chunk_pages=chunk,
+                streams=streams, _core=core, _seed=seed,
+            )
+            combos.append({
+                "streams_requested": streams,
+                "streams": out["streams"],
+                "protocol": out["protocol"],
+                "chunk_pages": out["chunk_pages"],
+                "chain_mb": out["chain_mb"],
+                "amortized_gbytes_per_sec": out["amortized_gbytes_per_sec"],
+                "amortized_wire_only_gbytes_per_sec":
+                    out["amortized_wire_only_gbytes_per_sec"],
+                "cold_gbytes_per_sec": out["cold_gbytes_per_sec"],
+                "overlap_frac": out["overlap_frac"],
+                "amortized_overlap_s": out["amortized_overlap_s"],
+            })
+    best = max(combos, key=lambda c: c["amortized_gbytes_per_sec"])
+    v2_same_chunk = next(
+        (c for c in combos
+         if c["protocol"] == "v2" and c["chunk_pages"] == best["chunk_pages"]),
+        None,
+    )
+    speedup = 0.0
+    if v2_same_chunk and v2_same_chunk["amortized_gbytes_per_sec"] > 0:
+        speedup = round(
+            best["amortized_gbytes_per_sec"]
+            / v2_same_chunk["amortized_gbytes_per_sec"], 3)
+    return {
+        "wire": "tcp_cross_process_sweep",
+        "grid": {"stream_counts": list(stream_counts), "chunk_pages": list(chunks)},
+        "iters": iters,
+        "pages_per_chain": pages_per_chain,
+        "chain_mb": combos[0]["chain_mb"],
+        "kv_wire_gbps": best["amortized_gbytes_per_sec"],
+        "kv_wire_overlap_frac": best["overlap_frac"],
+        "speedup_vs_v2": speedup,
+        "best": best,
+        "v2_baseline": v2_same_chunk,
+        "sweep": combos,
+    }
 
 
 def child_main(argv: list[str]) -> None:

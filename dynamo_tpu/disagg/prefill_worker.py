@@ -7,17 +7,22 @@ worker's transfer endpoint. The sampled token is discarded — the decode side
 recomputes the sub-page tail locally and samples there, so the transferred
 artifact is pure KV.
 
-The worker claims up to ``max_concurrency`` queue tasks at once. The engine
-chunks each prompt under the mixed-step scheduler (engine/core.py), so
-overlapping tasks interleave their prefill chunks — and overlap one task's
-KV wire transfer with the next task's compute — instead of serializing
-whole prompts head-to-tail.
+The worker claims up to ``max_concurrency`` queue tasks at once, but that
+bound applies to the *compute* phase only: the moment a task's local prefill
+generation completes, its compute slot is released and the KV ship continues
+under a separate ``ship_concurrency`` bound (``DYN_PREFILL_SHIP_CONCURRENCY``,
+default ``2 * max_concurrency``). Ship-of-request-A therefore overlaps
+prefill-of-request-B even when ``max_concurrency`` is 1 — the wire rides
+under the next prompt's compute instead of serializing behind it. The engine
+additionally chunks each prompt under the mixed-step scheduler
+(engine/core.py), so overlapping tasks interleave their prefill chunks.
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
+import os
 
 from dynamo_tpu.disagg.queue import DistributedQueue
 from dynamo_tpu.disagg.transfer import (
@@ -46,12 +51,27 @@ class PrefillWorker:
         *,
         queue_name: str = PREFILL_QUEUE,
         max_concurrency: int = 2,
+        ship_concurrency: int | None = None,
     ) -> None:
         self.runtime = runtime
         self.service = service
         self.queue = DistributedQueue(runtime, queue_name)
         self._task: asyncio.Task | None = None
+        # Compute-phase bound: held from claim until the local prefill
+        # generation finishes (NOT until the ship completes — see _run_one).
         self._sem = asyncio.Semaphore(max(1, max_concurrency))
+        if ship_concurrency is None:
+            try:
+                ship_concurrency = int(
+                    os.environ.get("DYN_PREFILL_SHIP_CONCURRENCY", "")
+                    or 2 * max(1, max_concurrency)
+                )
+            except ValueError:
+                ship_concurrency = 2 * max(1, max_concurrency)
+        # Ship-phase bound: caps in-flight KV transfers (each striped ship
+        # holds host buffers for ~streams chunks) without tying up a compute
+        # slot while bytes are on the wire.
+        self._ship_sem = asyncio.Semaphore(max(1, ship_concurrency))
         self._inflight: set[asyncio.Task] = set()
         self.completed = 0
 
@@ -83,8 +103,20 @@ class PrefillWorker:
 
     async def _run_one(self, claimed: tuple) -> None:
         key, task = claimed
+        # The compute slot frees as soon as the prefill generation is done
+        # (callback invoked inside _prefill_and_ship) so the NEXT task's
+        # prefill runs under THIS task's ship; the finally is the backstop
+        # for failures before that point. Idempotent by construction.
+        released = False
+
+        def release_compute() -> None:
+            nonlocal released
+            if not released:
+                released = True
+                self._sem.release()
+
         try:
-            await self._handle(task)
+            await self._handle(task, release_compute)
             await self.queue.delete(key)
             self.completed += 1
         except asyncio.CancelledError:
@@ -99,9 +131,9 @@ class PrefillWorker:
                 logger.exception("claim release failed; lease expiry will reclaim %s", key)
             await asyncio.sleep(0.2)
         finally:
-            self._sem.release()
+            release_compute()
 
-    async def _handle(self, task: dict) -> None:
+    async def _handle(self, task: dict, release_compute=lambda: None) -> None:
         import time
 
         from dynamo_tpu.tracing import Span, TraceContext, record_span
@@ -124,9 +156,9 @@ class PrefillWorker:
         with exec_span:
             if FAULTS.armed:
                 FAULTS.fire("prefill.exec")
-            await self._prefill_and_ship(task, exec_span.context)
+            await self._prefill_and_ship(task, exec_span.context, release_compute)
 
-    async def _prefill_and_ship(self, task: dict, trace) -> None:
+    async def _prefill_and_ship(self, task: dict, trace, release_compute=lambda: None) -> None:
         token_ids = task["token_ids"]
         request_id = task["request_id"]
         page_size = self.service.core.config.page_size
@@ -141,7 +173,16 @@ class PrefillWorker:
         )
         async for _ in self.service.generate(req, Context(request_id=request_id, trace=trace.to_dict())):
             pass
+        # Compute done: free the slot so the next claimed task prefills while
+        # this one's KV goes out under the ship bound.
+        release_compute()
         hashes = compute_block_hashes(token_ids, page_size, salt=salt)
+        async with self._ship_sem:
+            await self._ship(task, trace, hashes)
+
+    async def _ship(self, task: dict, trace, hashes: list[int]) -> None:
+        token_ids = task["token_ids"]
+        request_id = task["request_id"]
 
         # Co-located decode worker with matching cache geometry: move the
         # pages over the device path (gather -> device_put -> scatter; ICI
@@ -183,9 +224,10 @@ class PrefillWorker:
             )
             return
 
-        # Chunked TCP stream (wire v2): gather, pack and wire pipelined per
-        # chunk, runner lock released between chunks. The monolithic v1
-        # collect-then-send below is the last-resort fallback.
+        # Chunked TCP stream (wire v3 striped when the transport has a duplex
+        # data plane, v2 single-stream otherwise): gather, pack and wire
+        # pipelined per chunk, runner lock released between chunks. The
+        # monolithic v1 collect-then-send below is the last-resort fallback.
         try:
             result = await send_blocks_chunked(
                 self.runtime.transport, task["transfer_address"], request_id,
@@ -199,8 +241,9 @@ class PrefillWorker:
             if result.get("total", 0) == 0:
                 logger.warning("prefill %s produced no transferable blocks", request_id)
             logger.info(
-                "prefill %s: %d tokens -> %s blocks streamed in chunks (%s injected, phases %s)",
+                "prefill %s: %d tokens -> %s blocks streamed via wire %s x%s (%s injected, phases %s)",
                 request_id, len(token_ids), result.get("total"),
+                result.get("protocol", "v2"), result.get("streams", 1),
                 result.get("injected"), result.get("phases"),
             )
             return

@@ -133,6 +133,34 @@ class EngineMetrics:
             "Per-phase KV transfer duration (sender gather/pack/wire, receiver scatter)",
             ["worker", "phase"], buckets=_PHASE_BUCKETS, registry=self.registry,
         )
+        # KV wire v3 (striped duplex data plane).
+        self.kv_wire_streams = gauge(
+            "dynamo_kv_wire_streams",
+            "Open striped KV data-plane connections (wire v3 stripes) on this worker",
+        )
+        self.kv_wire_sessions = gauge(
+            "dynamo_kv_wire_inflight_sessions",
+            "KV transfer sessions currently in flight on this worker (v2 + v3)",
+        )
+        self.kv_wire_staged = gauge(
+            "dynamo_kv_wire_staged_bytes",
+            "Host bytes held in out-of-order reassembly staging across sessions "
+            "(bounded by DYN_KV_WIRE_INFLIGHT)",
+        )
+        # Which path served each transfer: device_colocated / device_pull /
+        # host_striped / host_chunked / host_monolithic. Clear-then-set
+        # labelled gauges synced from the service's cumulative counters.
+        self._kv_path_bytes = Gauge(
+            "dynamo_kv_wire_path_bytes_total",
+            "KV bytes ingested per transfer path (device-pull vs host-striped "
+            "vs host-chunked fallback ladder)",
+            ["worker", "path"], registry=self.registry,
+        )
+        self._kv_path_transfers = Gauge(
+            "dynamo_kv_wire_path_transfers_total",
+            "Completed KV transfers per transfer path",
+            ["worker", "path"], registry=self.registry,
+        )
         self._core: Any = None
         self._transfer: Any = None
         self._queue_depth_fn: Callable[[], Awaitable[int]] | None = None
@@ -211,6 +239,16 @@ class EngineMetrics:
         self.kv_streams.set(stats.get("streams_in_flight", 0))
         self.kv_crc_failures.set(stats.get("crc_failures", 0))
         self.kv_rollbacks.set(stats.get("rollbacks", 0))
+        self.kv_wire_streams.set(stats.get("wire_conns", 0))
+        self.kv_wire_sessions.set(stats.get("streams_in_flight", 0))
+        self.kv_wire_staged.set(stats.get("staged_bytes", 0))
+        paths = stats.get("paths")
+        if paths is not None:
+            self._kv_path_bytes.clear()
+            self._kv_path_transfers.clear()
+            for path, d in paths.items():
+                self._kv_path_bytes.labels(self.worker, path).set(d.get("bytes", 0))
+                self._kv_path_transfers.labels(self.worker, path).set(d.get("transfers", 0))
 
     async def render(self) -> bytes:
         self._sync_core()

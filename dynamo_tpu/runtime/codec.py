@@ -8,6 +8,18 @@ headers small and introspectable, payload opaque.
 
 msgpack (not JSON) keeps the per-token hot path cheap; the payload may carry
 raw bytes (e.g. serialized arrays) with no base64 overhead.
+
+Blob frames (wire v3): bulk payloads (KV page bytes) don't belong inside
+msgpack — packing them copies every byte once on each side and the unpacker
+materialises one more copy. A blob frame keeps the msgpack body as a small
+*head* and appends the payload as raw bytes after it:
+
+    [4-byte length | BLOB_FLAG][msgpack head incl. "blob"=body_len][raw body]
+
+The high bit of the length prefix marks the frame as a blob frame; it is
+free because ``MAX_FRAME_BYTES`` < 2**31. ``write_blob_frame`` writes the
+payload buffers (memoryviews) straight to the socket — no intermediate
+concatenation — and ``read_frame`` surfaces the body as ``fields["blob"]``.
 """
 
 from __future__ import annotations
@@ -15,11 +27,12 @@ from __future__ import annotations
 import asyncio
 from dataclasses import dataclass
 from enum import Enum
-from typing import Any
+from typing import Any, Sequence
 
 import msgpack
 
 MAX_FRAME_BYTES = 256 * 1024 * 1024  # hard cap; a corrupt length prefix fails fast
+BLOB_FLAG = 0x8000_0000  # high bit of the length prefix marks a blob frame
 
 
 class FrameType(str, Enum):
@@ -59,20 +72,61 @@ def decode_body(body: bytes) -> Frame:
 
 
 async def read_frame(reader: asyncio.StreamReader) -> Frame | None:
-    """Read one frame; None on clean EOF."""
+    """Read one frame; None on clean EOF.
+
+    Blob frames come back as a normal :class:`Frame` with the raw body bytes
+    under ``fields["blob"]`` (replacing the head's declared body length).
+    """
     try:
         header = await reader.readexactly(4)
     except (asyncio.IncompleteReadError, ConnectionResetError):
         return None
-    length = int.from_bytes(header, "big")
+    prefix = int.from_bytes(header, "big")
+    is_blob = bool(prefix & BLOB_FLAG)
+    length = prefix & ~BLOB_FLAG
     if length > MAX_FRAME_BYTES:
         raise ValueError(f"frame length {length} exceeds cap")
     try:
         body = await reader.readexactly(length)
     except (asyncio.IncompleteReadError, ConnectionResetError):
         return None
-    return decode_body(body)
+    frame = decode_body(body)
+    if is_blob:
+        blob_len = frame.fields.get("blob")
+        if not isinstance(blob_len, int) or blob_len < 0 or blob_len > MAX_FRAME_BYTES:
+            raise ValueError(f"blob frame with bad body length: {blob_len!r}")
+        try:
+            blob = await reader.readexactly(blob_len)
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            return None
+        frame.fields["blob"] = blob
+    return frame
 
 
 def write_frame(writer: asyncio.StreamWriter, ftype: FrameType, **fields: Any) -> None:
     writer.write(encode_frame(ftype, **fields))
+
+
+def write_blob_frame(
+    writer: asyncio.StreamWriter,
+    ftype: FrameType,
+    buffers: Sequence[Any],
+    **fields: Any,
+) -> int:
+    """Write ``[prefix|BLOB_FLAG][head][buffers...]`` without concatenating.
+
+    ``buffers`` is a sequence of bytes-like objects (memoryviews of KV pages);
+    each is handed to the socket writer as-is, so the only copies are the
+    kernel ones. Returns the body byte count.
+    """
+    body_len = sum(len(b) for b in buffers)
+    if body_len > MAX_FRAME_BYTES:
+        raise ValueError(f"blob body too large: {body_len} bytes")
+    head = msgpack.packb({"t": ftype.value, **fields, "blob": body_len}, use_bin_type=True)
+    if len(head) > MAX_FRAME_BYTES:
+        raise ValueError(f"frame head too large: {len(head)} bytes")
+    writer.write((len(head) | BLOB_FLAG).to_bytes(4, "big"))
+    writer.write(head)
+    for buf in buffers:
+        writer.write(buf)
+    return body_len

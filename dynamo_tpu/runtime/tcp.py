@@ -22,10 +22,20 @@ import logging
 from typing import Any, AsyncIterator
 from urllib.parse import urlparse
 
-from dynamo_tpu.runtime.codec import Frame, FrameType, read_frame, write_frame
+from dynamo_tpu.runtime.codec import (
+    Frame,
+    FrameType,
+    read_frame,
+    write_blob_frame,
+    write_frame,
+)
 from dynamo_tpu.runtime.engine import AsyncEngine, Context, EngineError
 from dynamo_tpu.runtime.faults import FAULTS
-from dynamo_tpu.runtime.transport import NoSuchSubjectError, Transport
+from dynamo_tpu.runtime.transport import (
+    DuplexUnsupportedError,
+    NoSuchSubjectError,
+    Transport,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -91,6 +101,17 @@ class TcpTransport(Transport):
         # The trace context crosses the process boundary here: spans emitted
         # by the engine behind this subject share the caller's trace_id.
         context = Context(request_id=req.fields.get("id"), trace=req.fields.get("trace"))
+        if req.fields.get("duplex"):
+            duplex_fn = getattr(engine, "duplex", None)
+            if duplex_fn is None:
+                write_frame(writer, FrameType.PROLOGUE, ok=False,
+                            error=f"subject has no duplex data plane: {subject}")
+                await writer.drain()
+                return
+            write_frame(writer, FrameType.PROLOGUE, ok=True)
+            await writer.drain()
+            await self._serve_duplex(duplex_fn, req, reader, writer, context)
+            return
         write_frame(writer, FrameType.PROLOGUE, ok=True)
 
         async def watch_control() -> None:
@@ -133,7 +154,110 @@ class TcpTransport(Transport):
             if aclose is not None:
                 await aclose()
 
+    async def _serve_duplex(
+        self,
+        duplex_fn: Any,
+        req: Frame,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        context: Context,
+    ) -> None:
+        """Serve one duplex stream: inbound DATA/blob frames are pumped into
+        an async iterator handed to ``engine.duplex(request, inbound, ctx)``;
+        each dict the engine yields goes back as a DATA frame. Connection
+        teardown (either direction) kills the stream, same as ``generate``."""
+        inbound: asyncio.Queue[dict[str, Any] | None] = asyncio.Queue()
+
+        async def pump() -> None:
+            while True:
+                frame = await read_frame(reader)
+                if frame is None or frame.type is FrameType.KILL:
+                    context.kill()
+                    await inbound.put(None)
+                    return
+                if frame.type is FrameType.COMPLETE:
+                    await inbound.put(None)
+                    return
+                if frame.type is FrameType.DATA:
+                    await inbound.put(frame.fields)
+                elif frame.type is FrameType.STOP:
+                    context.stop_generating()
+
+        async def messages() -> AsyncIterator[dict[str, Any]]:
+            while True:
+                item = await inbound.get()
+                if item is None:
+                    return
+                yield item
+
+        pump_task = asyncio.create_task(pump())
+        stream = duplex_fn(req.payload, messages(), context)
+        try:
+            async for item in stream:
+                if context.is_killed:
+                    break
+                write_frame(writer, FrameType.DATA, p=item)
+                await writer.drain()
+            if not context.is_killed:
+                write_frame(writer, FrameType.COMPLETE)
+                await writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            context.kill()
+        except Exception as exc:
+            logger.exception("duplex stream failed (subject=%s)", req.fields.get("subject"))
+            context.kill()
+            try:
+                write_frame(writer, FrameType.ERROR, error=f"{type(exc).__name__}: {exc}")
+                await writer.drain()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+        finally:
+            pump_task.cancel()
+            aclose = getattr(stream, "aclose", None)
+            if aclose is not None:
+                await aclose()
+
     # -- caller side -------------------------------------------------------
+
+    async def open_duplex(self, address: str, request: Any, context: Context) -> "TcpDuplexStream":
+        """Open a persistent duplex stream to ``tcp://host:port/subject``.
+
+        Unlike ``generate`` (one request, a stream of responses), a duplex
+        stream lets the caller keep sending frames — including raw blob
+        frames — over one connection, with responses interleaved. This is the
+        KV wire v3 data plane: one connection per stripe, no per-chunk
+        connection setup.
+        """
+        url = urlparse(address)
+        if url.scheme != "tcp":
+            raise ValueError(f"not a tcp address: {address}")
+        subject = url.path.lstrip("/")
+        if FAULTS.armed:
+            FAULTS.fire("tcp.connect")
+        reader, writer = await asyncio.open_connection(url.hostname, url.port)
+        try:
+            extra = {"trace": context.trace} if context.trace else {}
+            if FAULTS.armed:
+                FAULTS.fire("tcp.write")
+            write_frame(writer, FrameType.REQUEST, subject=subject, id=context.id,
+                        duplex=True, p=request, **extra)
+            await writer.drain()
+            prologue = await read_frame(reader)
+            if prologue is None:
+                raise EngineError("connection closed before prologue")
+            if prologue.type is not FrameType.PROLOGUE:
+                raise EngineError(f"expected prologue, got {prologue.type}")
+            if not prologue.fields.get("ok", False):
+                err = prologue.fields.get("error", "rejected")
+                if "no such subject" in err:
+                    raise NoSuchSubjectError(err)
+                if "no duplex data plane" in err:
+                    raise DuplexUnsupportedError(err)
+                raise EngineError(err)
+        except BaseException:
+            writer.close()
+            raise
+        return TcpDuplexStream(reader, writer)
 
     async def generate(self, address: str, request: Any, context: Context) -> AsyncIterator[Any]:
         url = urlparse(address)
@@ -207,3 +331,44 @@ class TcpTransport(Transport):
             self._server = None
         for task in list(self._conn_tasks):
             task.cancel()
+
+
+class TcpDuplexStream:
+    """Caller half of a duplex stream: ``send`` frames (optionally with raw
+    blob buffers), ``recv`` the engine's responses, ``close`` when done."""
+
+    def __init__(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        self._reader = reader
+        self._writer = writer
+
+    async def send(self, fields: dict[str, Any], blobs: list[Any] | None = None) -> None:
+        if FAULTS.armed:
+            FAULTS.fire("tcp.write")
+        if blobs:
+            write_blob_frame(self._writer, FrameType.DATA, blobs, **fields)
+        else:
+            write_frame(self._writer, FrameType.DATA, **fields)
+        await self._writer.drain()
+
+    async def recv(self) -> dict[str, Any] | None:
+        """One response payload dict; None when the engine side completed."""
+        if FAULTS.armed:
+            FAULTS.fire("tcp.read")
+        frame = await read_frame(self._reader)
+        if frame is None or frame.type is FrameType.COMPLETE:
+            return None
+        if frame.type is FrameType.ERROR:
+            raise EngineError(frame.fields.get("error", "remote engine failed"))
+        return frame.payload
+
+    async def close(self) -> None:
+        try:
+            write_frame(self._writer, FrameType.COMPLETE)
+            await self._writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        self._writer.close()
+        try:
+            await self._writer.wait_closed()
+        except (ConnectionResetError, BrokenPipeError):
+            pass

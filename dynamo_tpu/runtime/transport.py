@@ -33,6 +33,27 @@ class NoSuchSubjectError(KeyError):
     """The target instance does not serve this subject (stale discovery, dead worker)."""
 
 
+class DuplexUnsupportedError(EngineError):
+    """The transport or remote subject has no duplex data plane (wire v3)."""
+
+
+class DuplexStream(abc.ABC):
+    """Caller half of a persistent bidirectional stream (wire v3 data plane).
+
+    ``send`` pushes one message — a small fields dict plus optional raw blob
+    buffers carried outside msgpack — and ``recv`` returns the engine's next
+    response dict (None once the engine side completes)."""
+
+    @abc.abstractmethod
+    async def send(self, fields: dict[str, Any], blobs: list[Any] | None = None) -> None: ...
+
+    @abc.abstractmethod
+    async def recv(self) -> dict[str, Any] | None: ...
+
+    @abc.abstractmethod
+    async def close(self) -> None: ...
+
+
 class Transport(abc.ABC):
     """Binds engines to subjects (worker side) and opens streams (caller side)."""
 
@@ -51,6 +72,13 @@ class Transport(abc.ABC):
     def address_of(self, subject: str) -> str:
         """The externally-dialable address for a locally-registered subject."""
         ...
+
+    async def open_duplex(self, address: str, request: Any, context: Context) -> DuplexStream:
+        """Open a duplex stream to an engine exposing a ``duplex`` method.
+
+        Default: unsupported — callers fall back to the request/response
+        plane (e.g. KV wire v3 striping falls back to chunked v2)."""
+        raise DuplexUnsupportedError(f"{type(self).__name__} has no duplex data plane")
 
     async def close(self) -> None:  # pragma: no cover - default no-op
         pass
@@ -110,6 +138,75 @@ class InMemoryTransport(Transport):
             if aclose is not None:
                 await aclose()
 
+    async def open_duplex(self, address: str, request: Any, context: Context) -> DuplexStream:
+        subject = address.removeprefix("mem://")
+        engine = self._engines.get(subject)
+        if engine is None:
+            raise NoSuchSubjectError(subject)
+        duplex_fn = getattr(engine, "duplex", None)
+        if duplex_fn is None:
+            raise DuplexUnsupportedError(f"subject has no duplex data plane: {subject}")
+        remote_ctx = context.child()
+        stream = _InMemoryDuplexStream(self._roundtrip, remote_ctx)
+        engine_stream = duplex_fn(self._roundtrip(request), stream._inbound_iter(), remote_ctx)
+
+        async def drive() -> None:
+            try:
+                async for item in engine_stream:
+                    await stream._outbound.put(stream._roundtrip(item))
+                await stream._outbound.put(None)
+            except Exception as exc:
+                await stream._outbound.put(
+                    EngineError(f"{type(exc).__name__}: {exc}"))
+            finally:
+                aclose = getattr(engine_stream, "aclose", None)
+                if aclose is not None:
+                    await aclose()
+
+        stream._task = asyncio.create_task(drive())
+        return stream
+
+
+class _InMemoryDuplexStream(DuplexStream):
+    """In-process duplex with network-faithful serialization: fields round-trip
+    through msgpack with the blob carried as one bytes field (the wire carries
+    it as a raw body; bytes-equivalence is what matters to the receiver)."""
+
+    def __init__(self, roundtrip: Any, remote_ctx: Context) -> None:
+        self._roundtrip = roundtrip
+        self._remote_ctx = remote_ctx
+        self._inbound: asyncio.Queue[dict[str, Any] | None] = asyncio.Queue()
+        self._outbound: asyncio.Queue[Any] = asyncio.Queue()
+        self._task: asyncio.Task | None = None
+
+    async def _inbound_iter(self) -> AsyncIterator[dict[str, Any]]:
+        while True:
+            item = await self._inbound.get()
+            if item is None:
+                return
+            yield item
+
+    async def send(self, fields: dict[str, Any], blobs: list[Any] | None = None) -> None:
+        msg = dict(fields)
+        if blobs:
+            msg["blob"] = b"".join(bytes(b) for b in blobs)
+        await self._inbound.put(self._roundtrip(msg))
+
+    async def recv(self) -> dict[str, Any] | None:
+        item = await self._outbound.get()
+        if isinstance(item, EngineError):
+            raise item
+        return item
+
+    async def close(self) -> None:
+        await self._inbound.put(None)
+        if self._task is not None:
+            try:
+                await asyncio.wait_for(asyncio.shield(self._task), timeout=5.0)
+            except Exception:
+                self._remote_ctx.kill()
+                self._task.cancel()
+
 
 class _EchoEngine(AsyncEngine[Any, Any]):
     """Diagnostic engine: streams the request back once (used in tests/smoke)."""
@@ -120,6 +217,8 @@ class _EchoEngine(AsyncEngine[Any, Any]):
 
 __all__ = [
     "Transport",
+    "DuplexStream",
+    "DuplexUnsupportedError",
     "InMemoryTransport",
     "NoSuchSubjectError",
     "EngineError",

@@ -172,12 +172,21 @@ def test_bench_doc_goodput_keys():
     assert doc3["decode_kernel_gbps"] == 700.5
     assert doc3["decode_roofline_frac"] == 0.8553
     assert doc3["detail"]["decode_kernel_probe"] == dk
+    assert doc3["kv_wire_gbps"] == 0.0  # wire sweep absent: stable default
+    # KV-wire headline keys (ISSUE 8) surface from the sweep dict.
+    wire = {"kv_wire_gbps": 2.375, "kv_wire_overlap_frac": 0.41,
+            "speedup_vs_v2": 6.2, "sweep": []}
+    doc4 = bench.build_doc(configs, pull={}, wire=wire)
+    assert doc4["kv_wire_gbps"] == 2.375
+    assert doc4["kv_wire_overlap_frac"] == 0.41
+    assert doc4["detail"]["kv_wire_cross_process"] == wire
     # An all-errors suite still emits the full key set.
     empty = bench.build_doc([{"preset": "x", "error": "boom"}], pull={})
     for key in ("value", "goodput_tokens_per_s_at_slo", "slo_ttft_attainment",
                 "itl_p99_ms", "max_decode_stall_ms", "spec_accept_rate",
                 "spec_decode_speedup", "decode_kernel_gbps",
-                "decode_roofline_frac"):
+                "decode_roofline_frac", "kv_wire_gbps",
+                "kv_wire_overlap_frac"):
         assert key in empty
         assert empty[key] == 0.0
 

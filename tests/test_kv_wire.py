@@ -1,0 +1,462 @@
+"""Wire v3 (striped multi-stream KV transfer): byte-exactness vs v2,
+out-of-order reassembly, per-stripe crc retry, rollback drills, staging
+budget, and the blob frame codec.
+
+The v2 contract these tests hold v3 to (docs/KV_TRANSFER_WIRE_V2.md): every
+committed prefix is a valid cache state, a crc failure retries the same seq
+before anything rolls back, and a dead stream leaves no pins and no session.
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.disagg.transfer import (
+    KvTransferService,
+    block_crc_ok,
+    blob_to_blocks,
+    default_chunk_pages,
+    default_wire_streams,
+    pack_chunk_blob,
+    send_blocks_chunked,
+    unpack_payload,
+)
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.faults import FAULTS
+from dynamo_tpu.runtime.transport import (
+    DuplexUnsupportedError,
+    InMemoryTransport,
+)
+from dynamo_tpu.tokens import compute_block_hashes
+from tests.test_transfer_pipeline import CFG, PAGE, _commit_chain, _core
+
+
+@pytest.fixture(autouse=True)
+def _fault_hygiene():
+    FAULTS.disarm()
+    yield
+    FAULTS.disarm()
+
+
+def _assert_chain_bytes(core, hashes, payloads):
+    pids = core.allocator.match_prefix(hashes)
+    assert len(pids) == len(hashes)
+    for pid, h in zip(pids, hashes):
+        k_got, v_got = core.runner.read_page(pid)
+        np.testing.assert_array_equal(k_got, payloads[h][0])
+        np.testing.assert_array_equal(v_got, payloads[h][1])
+    core.allocator.release(pids)
+
+
+def _chunk_msgs(hashes, payloads, chunk_pages=2):
+    """Build v3 chunk messages (meta head + joined blob) for driving the
+    receiver's duplex plane directly."""
+    parents = [None, *hashes[:-1]]
+    msgs = []
+    n = -(-len(hashes) // chunk_pages)
+    for i in range(n):
+        sl = slice(i * chunk_pages, (i + 1) * chunk_pages)
+        meta, bufs, _ = pack_chunk_blob(
+            hashes[sl], parents[sl], [payloads[h] for h in hashes[sl]])
+        msgs.append(({"seq": i, "blocks": meta, "last": i == n - 1}, bufs))
+    return msgs
+
+
+def _open_req(request_id, *, sid="sid-1", stripe=0, stripes=2, total_chunks=2):
+    return {"request_id": request_id, "stream_open": True, "sid": sid,
+            "stripe": stripe, "stripes": stripes, "total_chunks": total_chunks}
+
+
+# -- end-to-end: striped sender against the real service ---------------------
+
+
+async def test_striped_byte_exact_with_v2():
+    """The same chain shipped striped (v3) and single-stream (v2) lands
+    byte-identical on both receivers, with chain linkage intact and no
+    session state, staging bytes, or stripe connections left behind."""
+    src = _core(num_pages=32)
+    hashes = compute_block_hashes(list(range(6 * PAGE)), PAGE, salt=0)
+    payloads = _commit_chain(src, hashes)
+
+    transport = InMemoryTransport()
+    dst_v3, dst_v2 = _core(num_pages=32), _core(num_pages=32)
+    svc_v3, svc_v2 = KvTransferService(dst_v3), KvTransferService(dst_v2)
+    await transport.register_engine("kv_v3", svc_v3)
+    await transport.register_engine("kv_v2", svc_v2)
+
+    out = await send_blocks_chunked(
+        transport, "mem://kv_v3", "r1", src, hashes, chunk_pages=2, streams=3)
+    assert out["protocol"] == "v3" and out["streams"] == 3
+    assert out["injected"] == 6 and out["total"] == 6 and out["last"]
+    assert out["bytes"] == sum(k.nbytes + v.nbytes for k, v in payloads.values())
+    assert set(out["phases"]) == {"gather_s", "pack_s", "wire_s"}
+
+    out_v2 = await send_blocks_chunked(
+        transport, "mem://kv_v2", "r1", src, hashes, chunk_pages=2, streams=0)
+    assert "protocol" not in out_v2  # legacy path taken
+    assert out_v2["injected"] == 6
+    assert out["bytes"] == out_v2["bytes"]  # identical payload accounting
+
+    for core in (dst_v3, dst_v2):
+        _assert_chain_bytes(core, hashes, payloads)
+    stats = svc_v3.stats()
+    assert stats["streams_in_flight"] == 0
+    assert stats["wire_conns"] == 0
+    assert stats["staged_bytes"] == 0
+    assert stats["paths"]["host_striped"]["transfers"] == 1
+    assert stats["paths"]["host_striped"]["bytes"] == out["bytes"]
+    assert svc_v2.stats()["paths"]["host_chunked"]["transfers"] == 1
+    # Sender released its chain refcounts both times.
+    again = src.allocator.match_prefix(hashes)
+    assert len(again) == 6
+    src.allocator.release(again)
+
+
+async def test_striped_phase_accounting_is_wall_time():
+    """wire_s/pack_s on the striped path are busy-interval unions across
+    stripes — per-stream-attributed wall time, never a sum over concurrent
+    streams — so no phase can exceed the end-to-end elapsed time."""
+    src = _core(num_pages=32)
+    hashes = compute_block_hashes(list(range(8 * PAGE)), PAGE, salt=0)
+    _commit_chain(src, hashes)
+    transport = InMemoryTransport()
+    svc = KvTransferService(_core(num_pages=32))
+    await transport.register_engine("kv", svc)
+
+    t0 = time.perf_counter()
+    out = await send_blocks_chunked(
+        transport, "mem://kv", "r", src, hashes, chunk_pages=1, streams=4)
+    elapsed = time.perf_counter() - t0
+    assert out["streams"] == 4
+    eps = 0.05  # clock skew headroom, generous for CI
+    for phase, secs in out["phases"].items():
+        assert secs <= elapsed + eps, (
+            f"{phase}={secs} exceeds elapsed {elapsed}: summed across stripes?")
+
+
+async def test_striped_single_stripe_corrupt_retries_before_rollback():
+    """kv.chunk.send:corrupt@1 mangles one stripe's chunk; the receiver's
+    crc check rejects it without touching the session, THAT stripe retries
+    its seq with the clean buffers, and the stream completes byte-exact with
+    zero rollbacks — v2's retry-before-rollback contract, per stripe."""
+    src = _core(num_pages=32)
+    hashes = compute_block_hashes(list(range(6 * PAGE)), PAGE, salt=0)
+    payloads = _commit_chain(src, hashes)
+    transport = InMemoryTransport()
+    dst = _core(num_pages=32)
+    svc = KvTransferService(dst)
+    await transport.register_engine("kv", svc)
+
+    FAULTS.arm("kv.chunk.send:corrupt@1")
+    out = await send_blocks_chunked(
+        transport, "mem://kv", "r", src, hashes, chunk_pages=2, streams=3)
+    assert out["protocol"] == "v3"
+    assert out["injected"] == 6 and out["crc_retries"] == 1
+    assert svc.crc_failures == 1 and svc.rollbacks == 0
+    _assert_chain_bytes(dst, hashes, payloads)
+
+
+async def test_striped_stripe_loss_rolls_back_and_sender_raises():
+    """A receiver-side failure on one stripe rolls the whole session back:
+    the sender raises (its caller falls back to v1), pins drop, and the
+    decode worker keeps at most a valid evictable prefix."""
+    src = _core(num_pages=32)
+    hashes = compute_block_hashes(list(range(6 * PAGE)), PAGE, salt=0)
+    _commit_chain(src, hashes)
+    transport = InMemoryTransport()
+    dst = _core(num_pages=32)
+    svc = KvTransferService(dst)
+    await transport.register_engine("kv", svc)
+
+    FAULTS.arm("kv.chunk.recv:drop@2")  # one stripe's arrival dies
+    with pytest.raises(Exception):
+        await send_blocks_chunked(
+            transport, "mem://kv", "r", src, hashes, chunk_pages=2, streams=3)
+    assert svc.rollbacks == 1
+    committed = dst.allocator.match_prefix(hashes)
+    assert len(committed) < 6
+    dst.allocator.release(committed)
+    stats = svc.stats()
+    assert stats["streams_in_flight"] == 0
+    assert stats["staged_bytes"] == 0
+    assert stats["wire_conns"] == 0
+    # Nothing left pinned: eviction can reclaim everything.
+    free0 = dst.allocator.num_free()
+    dst.allocator.clear_cache()
+    assert dst.allocator.num_free() >= free0
+
+
+# -- receiver duplex plane driven directly ------------------------------------
+
+
+async def test_out_of_order_reassembly_commits_in_seq_order():
+    """Chunks arriving out of order stage and commit strictly in seq order:
+    the ahead-of-cursor stripe's ack is deferred until its chunk commits,
+    and the final ack carries the stream summary."""
+    dst = _core(num_pages=32)
+    svc = KvTransferService(dst)
+    transport = InMemoryTransport()
+    await transport.register_engine("kv", svc)
+    hashes = compute_block_hashes(list(range(4 * PAGE)), PAGE, salt=0)
+    src = _core(num_pages=32)
+    payloads = _commit_chain(src, hashes)
+    msgs = _chunk_msgs(hashes, payloads, chunk_pages=2)
+
+    st0 = await transport.open_duplex("mem://kv", _open_req("r", stripe=0), Context())
+    st1 = await transport.open_duplex("mem://kv", _open_req("r", stripe=1), Context())
+    try:
+        # Stripe 1 delivers the LAST chunk first: it stages, no ack yet.
+        fields, bufs = msgs[1]
+        await st1.send({"request_id": "r", **fields}, blobs=bufs)
+        ack1_task = asyncio.create_task(st1.recv())
+        await asyncio.sleep(0.05)
+        assert not ack1_task.done()  # deferred: seq 1 can't commit before 0
+        assert svc.stats()["staged_bytes"] > 0
+        # Stripe 0 delivers the cursor chunk: both commit, in order.
+        fields, bufs = msgs[0]
+        await st0.send({"request_id": "r", **fields}, blobs=bufs)
+        ack0 = await asyncio.wait_for(st0.recv(), timeout=5)
+        ack1 = await asyncio.wait_for(ack1_task, timeout=5)
+        assert ack0["seq"] == 0 and not ack0.get("last")
+        assert ack1["seq"] == 1 and ack1["last"]
+        assert ack1["total"] == 4 and ack1["injected"] == 4
+    finally:
+        await st0.close()
+        await st1.close()
+    assert svc.stats()["staged_bytes"] == 0
+    assert svc.stats()["streams_in_flight"] == 0
+    _assert_chain_bytes(dst, hashes, payloads)
+
+
+async def test_staging_budget_parks_ahead_chunks_without_deadlock():
+    """An out-of-order chunk larger than the staging budget parks at
+    admission instead of staging; it is re-admitted budget-free once the
+    commit cursor reaches its seq. In-order chunks always pass."""
+    dst = _core(num_pages=32)
+    svc = KvTransferService(dst)
+    svc._staging_budget = 1  # no out-of-order chunk ever fits
+    transport = InMemoryTransport()
+    await transport.register_engine("kv", svc)
+    hashes = compute_block_hashes(list(range(4 * PAGE)), PAGE, salt=0)
+    src = _core(num_pages=32)
+    payloads = _commit_chain(src, hashes)
+    msgs = _chunk_msgs(hashes, payloads, chunk_pages=2)
+
+    st0 = await transport.open_duplex("mem://kv", _open_req("r", stripe=0), Context())
+    st1 = await transport.open_duplex("mem://kv", _open_req("r", stripe=1), Context())
+    try:
+        fields, bufs = msgs[1]
+        await st1.send({"request_id": "r", **fields}, blobs=bufs)
+        ack1_task = asyncio.create_task(st1.recv())
+        await asyncio.sleep(0.05)
+        assert not ack1_task.done()
+        assert svc.stats()["staged_bytes"] == 0  # parked BEFORE staging
+        fields, bufs = msgs[0]
+        await st0.send({"request_id": "r", **fields}, blobs=bufs)
+        ack0 = await asyncio.wait_for(st0.recv(), timeout=5)
+        ack1 = await asyncio.wait_for(ack1_task, timeout=5)
+        assert ack0["seq"] == 0 and ack1["last"]
+    finally:
+        await st0.close()
+        await st1.close()
+    _assert_chain_bytes(dst, hashes, payloads)
+
+
+async def test_all_stripes_closing_mid_stream_rolls_back():
+    """The sender dying (every stripe connection dropping) with the session
+    incomplete triggers an immediate full rollback — pins released, session
+    gone — without waiting for the abandoned-stream sweep."""
+    dst = _core(num_pages=32)
+    svc = KvTransferService(dst)
+    transport = InMemoryTransport()
+    await transport.register_engine("kv", svc)
+    hashes = compute_block_hashes(list(range(4 * PAGE)), PAGE, salt=0)
+    src = _core(num_pages=32)
+    payloads = _commit_chain(src, hashes)
+    msgs = _chunk_msgs(hashes, payloads, chunk_pages=2)
+    free0 = dst.allocator.num_free()
+
+    st0 = await transport.open_duplex("mem://kv", _open_req("r", stripe=0), Context())
+    st1 = await transport.open_duplex("mem://kv", _open_req("r", stripe=1), Context())
+    fields, bufs = msgs[0]
+    await st0.send({"request_id": "r", **fields}, blobs=bufs)
+    ack0 = await asyncio.wait_for(st0.recv(), timeout=5)
+    assert ack0["injected"] == 2
+    assert svc.stats()["streams_in_flight"] == 1
+    # Sender dies: both stripes close without the last chunk.
+    await st0.close()
+    await st1.close()
+    assert svc.rollbacks == 1
+    assert svc.stats()["streams_in_flight"] == 0
+    # Committed prefix stays valid but unpinned: fully reclaimable.
+    pids = dst.allocator.match_prefix(hashes[:2])
+    assert len(pids) == 2
+    dst.allocator.release(pids)
+    dst.allocator.clear_cache()
+    assert dst.allocator.num_free() == free0
+
+
+async def test_new_sid_replaces_stale_session():
+    """A fresh attempt (new sid) for the same request id replaces a stale
+    session, rolling it back iff it had ingested anything — the v2 seq-0
+    replacement rule carried over to v3."""
+    dst = _core(num_pages=32)
+    svc = KvTransferService(dst)
+    transport = InMemoryTransport()
+    await transport.register_engine("kv", svc)
+    hashes = compute_block_hashes(list(range(4 * PAGE)), PAGE, salt=0)
+    src = _core(num_pages=32)
+    payloads = _commit_chain(src, hashes)
+    msgs = _chunk_msgs(hashes, payloads, chunk_pages=2)
+
+    # Attempt 1 ingests chunk 0 then stalls (sender hung, stream not closed).
+    st_old = await transport.open_duplex(
+        "mem://kv", _open_req("r", sid="attempt-1", stripes=1), Context())
+    fields, bufs = msgs[0]
+    await st_old.send({"request_id": "r", **fields}, blobs=bufs)
+    await asyncio.wait_for(st_old.recv(), timeout=5)
+    assert svc.stats()["streams_in_flight"] == 1
+
+    # Attempt 2 (new sid) replaces it: the stale session rolls back first.
+    # (Attach runs when the engine generator first advances — give the
+    # event loop a beat before asserting.)
+    st_new = await transport.open_duplex(
+        "mem://kv", _open_req("r", sid="attempt-2", stripes=1), Context())
+    await asyncio.sleep(0.05)
+    assert svc.rollbacks == 1
+    try:
+        for fields, bufs in msgs:
+            await st_new.send({"request_id": "r", **fields}, blobs=bufs)
+            ack = await asyncio.wait_for(st_new.recv(), timeout=5)
+            assert "stream_error" not in ack
+        assert ack["last"] and ack["injected"] == 4
+    finally:
+        await st_new.close()
+        await st_old.close()
+    _assert_chain_bytes(dst, hashes, payloads)
+
+
+# -- blob frame codec ---------------------------------------------------------
+
+
+def test_blob_codec_roundtrip_and_crc():
+    rng = np.random.default_rng(0)
+    shape = (CFG.num_layers, PAGE, CFG.kv_dim)
+    payloads = [
+        (rng.standard_normal(shape).astype(np.float32),
+         rng.standard_normal(shape).astype(np.float32))
+        for _ in range(3)
+    ]
+    hashes = [11, 22, 33]
+    parents = [None, 11, 22]
+    meta, bufs, nbytes = pack_chunk_blob(hashes, parents, payloads)
+    assert nbytes == sum(k.nbytes + v.nbytes for k, v in payloads)
+    assert sum(b.nbytes for b in bufs) == nbytes
+    # The wire carries the buffers as one concatenated body.
+    blocks = blob_to_blocks(meta, b"".join(bytes(b) for b in bufs))
+    assert [b["hash"] for b in blocks] == hashes
+    assert [b["parent"] for b in blocks] == parents
+    for blk, (k, v) in zip(blocks, payloads):
+        assert block_crc_ok(blk)
+        k_got, v_got = unpack_payload(blk)
+        np.testing.assert_array_equal(k_got, k)
+        np.testing.assert_array_equal(v_got, v)
+    # A flipped payload byte fails that block's crc (and only that block's).
+    body = bytearray(b"".join(bytes(b) for b in bufs))
+    body[0] ^= 0xFF
+    tampered = blob_to_blocks(meta, bytes(body))
+    assert not block_crc_ok(tampered[0])
+    assert block_crc_ok(tampered[1]) and block_crc_ok(tampered[2])
+    # A truncated body is a framing error, not a silent short chunk.
+    with pytest.raises(ValueError, match="blob length mismatch"):
+        blob_to_blocks(meta, bytes(body[:-1]))
+
+
+def test_blob_codec_handles_extension_dtypes():
+    """bfloat16 (no buffer-protocol format char) must round-trip: the real
+    cache dtype on hardware is bf16."""
+    import ml_dtypes
+
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    k = np.arange(32, dtype=np.float32).astype(bf16).reshape(2, 4, 4)
+    v = (np.arange(32, dtype=np.float32) * 2).astype(bf16).reshape(2, 4, 4)
+    meta, bufs, nbytes = pack_chunk_blob([7], [None], [(k, v)])
+    assert nbytes == k.nbytes + v.nbytes
+    assert meta[0]["dtype"] == str(bf16)
+    [blk] = blob_to_blocks(meta, b"".join(bytes(b) for b in bufs))
+    assert block_crc_ok(blk)
+    k_got, v_got = unpack_payload(blk)
+    assert k_got.dtype == bf16
+    np.testing.assert_array_equal(k_got, k)
+    np.testing.assert_array_equal(v_got, v)
+
+
+# -- config + fallback --------------------------------------------------------
+
+
+def test_wire_env_knobs(monkeypatch):
+    monkeypatch.delenv("DYN_KV_CHUNK_PAGES", raising=False)
+    monkeypatch.delenv("DYN_KV_WIRE_STREAMS", raising=False)
+    assert default_chunk_pages() == 64
+    assert default_wire_streams() == 4
+    monkeypatch.setenv("DYN_KV_CHUNK_PAGES", "16")
+    monkeypatch.setenv("DYN_KV_WIRE_STREAMS", "8")
+    assert default_chunk_pages() == 16
+    assert default_wire_streams() == 8
+    monkeypatch.setenv("DYN_KV_CHUNK_PAGES", "garbage")
+    monkeypatch.setenv("DYN_KV_WIRE_STREAMS", "-3")
+    assert default_chunk_pages() == 64  # unparseable -> default
+    assert default_wire_streams() == 0  # clamped: negatives pin v2
+
+
+async def test_duplex_unsupported_falls_back_to_v2(monkeypatch):
+    """A transport without a duplex plane serves the same transfer over the
+    v2 single-stream protocol — silently, before any stream state exists."""
+    src = _core(num_pages=32)
+    hashes = compute_block_hashes(list(range(4 * PAGE)), PAGE, salt=0)
+    payloads = _commit_chain(src, hashes)
+    transport = InMemoryTransport()
+    dst = _core(num_pages=32)
+    svc = KvTransferService(dst)
+    await transport.register_engine("kv", svc)
+
+    async def no_duplex(address, request, context):
+        raise DuplexUnsupportedError("no duplex for test")
+
+    monkeypatch.setattr(transport, "open_duplex", no_duplex)
+    out = await send_blocks_chunked(
+        transport, "mem://kv", "r", src, hashes, chunk_pages=2, streams=4)
+    assert "protocol" not in out  # v2 loop served it
+    assert out["injected"] == 4
+    assert svc.stats()["paths"]["host_chunked"]["transfers"] == 1
+    _assert_chain_bytes(dst, hashes, payloads)
+
+
+@pytest.mark.e2e
+async def test_striped_over_real_tcp():
+    """Wire v3 over real sockets: blob frames, striped connections, byte
+    exactness, and clean teardown on the TcpTransport duplex plane."""
+    from dynamo_tpu.runtime.tcp import TcpTransport
+
+    src = _core(num_pages=32)
+    hashes = compute_block_hashes(list(range(6 * PAGE)), PAGE, salt=0)
+    payloads = _commit_chain(src, hashes)
+    dst = _core(num_pages=32)
+    svc = KvTransferService(dst)
+    server = TcpTransport(host="127.0.0.1")
+    client = TcpTransport(host="127.0.0.1")
+    try:
+        await server.register_engine("kv", svc)
+        addr = server.address_of("kv")
+        out = await send_blocks_chunked(
+            client, addr, "r", src, hashes, chunk_pages=2, streams=3)
+        assert out["protocol"] == "v3" and out["streams"] == 3
+        assert out["injected"] == 6
+        _assert_chain_bytes(dst, hashes, payloads)
+        assert svc.stats()["wire_conns"] == 0
+        assert svc.stats()["paths"]["host_striped"]["transfers"] == 1
+    finally:
+        await client.close()
+        await server.close()

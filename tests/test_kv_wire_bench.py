@@ -6,7 +6,11 @@ import sys
 
 import pytest
 
-from dynamo_tpu.bench.kv_wire import measure_cross_process, wire_config
+from dynamo_tpu.bench.kv_wire import (
+    measure_cross_process,
+    sweep_cross_process,
+    wire_config,
+)
 
 
 @pytest.mark.e2e
@@ -22,13 +26,16 @@ async def test_cross_process_wire_measures(tmp_path):
     assert out["wire"] == "tcp_cross_process"
     assert out["iters"] == 3 and len(out["per_iter"]) == 3
     assert out["chunk_pages"] == 1  # 2 pages -> 2 chunks: the pipeline engages
+    # Default wire is v3 striped (2 chunks cap the stripes at 2).
+    assert out["protocol"] == "v3"
+    assert out["streams"] == 2
     # Exact payload geometry: every transfer moved the full chain's bytes —
     # L(2) * ps(16) * kv_heads(2) * hd(16) * 2B, K and V, 2 pages per chain.
     page_bytes = 2 * 16 * 2 * 16 * 2 * 2
     for it in out["per_iter"]:
         assert it["bytes"] == 2 * page_bytes
         assert it["total_s"] > 0
-        # v2 stream reports every pipeline phase per iteration.
+        # The stream reports every pipeline phase per iteration.
         for phase in ("gather_s", "pack_s", "wire_s", "scatter_s"):
             assert it[phase] >= 0
         assert it["gather_s"] + it["pack_s"] + it["wire_s"] > 0
@@ -38,3 +45,45 @@ async def test_cross_process_wire_measures(tmp_path):
     assert out["cold_gbytes_per_sec"] > 0
     assert out["amortized_gbytes_per_sec"] > 0
     assert out["amortized_wire_only_gbytes_per_sec"] >= out["amortized_gbytes_per_sec"]
+    assert 0.0 <= out["overlap_frac"] <= 1.0
+
+
+@pytest.mark.e2e
+async def test_cross_process_wire_streams_zero_pins_v2(tmp_path):
+    cfg = wire_config(num_layers=2, num_kv_heads=2, head_dim=16)
+    out = await measure_cross_process(
+        pages_per_chain=2, iters=2, cfg=cfg, page_size=16, streams=0,
+        child_cmd=[
+            sys.executable, "-m", "dynamo_tpu.bench.kv_wire",
+            "2", "2", "16", "16", str(2 * 2 + 4), str(2 * 16),
+        ],
+    )
+    assert out["protocol"] == "v2"
+    assert out["streams"] == 0
+    assert out["amortized_gbytes_per_sec"] > 0
+
+
+@pytest.mark.e2e
+@pytest.mark.slow
+async def test_cross_process_wire_sweep(tmp_path):
+    """The grid probe's contract: one combo per (streams, chunk) cell, the v2
+    baseline present, and the headline keys bench.py promotes to the stable
+    top level. Speedup magnitude is a real-geometry claim (bench/results),
+    not asserted at this tiny size."""
+    cfg = wire_config(num_layers=2, num_kv_heads=2, head_dim=16)
+    out = await sweep_cross_process(
+        pages_per_chain=2, iters=2, cfg=cfg, page_size=16,
+        stream_counts=(0, 2), chunk_pages_list=(1,),
+        child_cmd=[
+            sys.executable, "-m", "dynamo_tpu.bench.kv_wire",
+            "2", "2", "16", "16", str(2 * 2 + 4), str(2 * 16),
+        ],
+    )
+    assert out["wire"] == "tcp_cross_process_sweep"
+    assert len(out["sweep"]) == 2
+    protos = {c["protocol"] for c in out["sweep"]}
+    assert protos == {"v2", "v3"}
+    assert out["v2_baseline"] is not None
+    assert out["kv_wire_gbps"] > 0
+    assert 0.0 <= out["kv_wire_overlap_frac"] <= 1.0
+    assert out["speedup_vs_v2"] > 0

@@ -152,7 +152,14 @@ class _FakeCore:
 
 class _FakeTransfer:
     def stats(self):
-        return {"blocks": 12, "bytes": 4096, "streams_in_flight": 1}
+        return {
+            "blocks": 12, "bytes": 4096, "streams_in_flight": 1,
+            "wire_conns": 4, "staged_bytes": 2048,
+            "paths": {
+                "host_striped": {"transfers": 3, "bytes": 3072},
+                "device_pull": {"transfers": 1, "bytes": 1024},
+            },
+        }
 
 
 EXPECTED_ENGINE_FAMILIES = {
@@ -183,6 +190,11 @@ EXPECTED_ENGINE_FAMILIES = {
     "dynamo_kv_transfer_streams_in_flight",
     "dynamo_kv_transfer_crc_failures_total",
     "dynamo_kv_transfer_rollbacks_total",
+    "dynamo_kv_wire_streams",
+    "dynamo_kv_wire_inflight_sessions",
+    "dynamo_kv_wire_staged_bytes",
+    "dynamo_kv_wire_path_bytes_total",
+    "dynamo_kv_wire_path_transfers_total",
     "dynamo_engine_prefill_requeues_total",
     "dynamo_kv_transfer_phase_seconds",
     # prometheus_client emits the histogram's _created timestamps as their
@@ -236,6 +248,12 @@ async def test_engine_metrics_names_labels_and_values():
     assert 'dynamo_engine_attn_dispatch_steps_total{path="pallas",phase="decode",worker="w1"} 5.0' in text
     assert 'dynamo_engine_attn_dispatch_steps_total{path="fallback",phase="verify",worker="w1"} 1.0' in text
     assert 'dynamo_kv_transfer_blocks_total{worker="w1"} 12.0' in text
+    # Wire v3 surface: stripe connections, staging, and per-path attribution.
+    assert 'dynamo_kv_wire_streams{worker="w1"} 4.0' in text
+    assert 'dynamo_kv_wire_inflight_sessions{worker="w1"} 1.0' in text
+    assert 'dynamo_kv_wire_staged_bytes{worker="w1"} 2048.0' in text
+    assert 'dynamo_kv_wire_path_bytes_total{path="host_striped",worker="w1"} 3072.0' in text
+    assert 'dynamo_kv_wire_path_transfers_total{path="device_pull",worker="w1"} 1.0' in text
     for phase in KV_PHASES:
         assert f'dynamo_kv_transfer_phase_seconds_count{{phase="{phase}",worker="w1"}} 1.0' in text
 

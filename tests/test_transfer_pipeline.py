@@ -194,8 +194,11 @@ async def test_sender_abort_notifies_receiver():
     transfer_mod.pack_block = dying_pack
     try:
         with pytest.raises(RuntimeError, match="sender died"):
+            # streams=0 pins the legacy v2 protocol: this test exercises the
+            # v2 sender's pack path specifically (v3 packs via
+            # pack_chunk_blob; its abort drill lives in test_kv_wire.py).
             await send_blocks_chunked(
-                transport, "mem://kv", "r", src, hashes, chunk_pages=2)
+                transport, "mem://kv", "r", src, hashes, chunk_pages=2, streams=0)
     finally:
         transfer_mod.pack_block = orig
     # The abort frame cleaned the receiver up; no pins, no session.
