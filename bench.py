@@ -723,8 +723,140 @@ def probe_cross_process_wire() -> dict:
     ))
 
 
+def probe_slo_sched() -> dict:
+    """SLO admission-control probe (ISSUE 9): EDF + tenant quotas vs FIFO.
+
+    A mixed-tenant burst on the mock-timed engine (MockRunner realtime:
+    scheduling is the production EngineCore, latency is the simulated
+    timing model, so the probe isolates *policy*): a heavy tenant submits
+    a burst of long prompts first, then latency-sensitive light requests
+    arrive behind them. FIFO intake serves the heavy burst head-of-line
+    and the light requests blow their TTFT budget; the SLO plane (EDF over
+    predicted TTFT + a token-bucket quota on the heavy tenant, heavy
+    requests at priority tier 1) admits the light requests first.
+
+    Both modes run the identical scenario and report goodput *under* the
+    TTFT budget (tokens from requests whose TTFT met it, per second).
+    Top-level bench JSON promotes:
+
+      slo_sched_goodput_gain — EDF-mode goodput over FIFO-mode goodput
+        (>1 means the plane converted the same capacity into more
+        SLO-attaining tokens);
+      slo_sched_ttft_p99_ms — p99 TTFT of the tier-0 (light) requests
+        under the SLO plane.
+    """
+    from dynamo_tpu.engine.core import EngineConfig, EngineCore
+    from dynamo_tpu.mocker import MockRunner
+    from dynamo_tpu.protocols.common import PreprocessedRequest, SamplingOptions, StopConditions
+    from dynamo_tpu.sched import (
+        AdmissionConfig, AdmissionController, TenantQuota, TenantRegistry, TtftPredictor,
+    )
+
+    n_heavy = int(os.environ.get("BENCH_SLOSCHED_HEAVY", "4"))
+    heavy_isl = int(os.environ.get("BENCH_SLOSCHED_HEAVY_ISL", "2048"))
+    n_light = int(os.environ.get("BENCH_SLOSCHED_LIGHT", "16"))
+    light_isl = int(os.environ.get("BENCH_SLOSCHED_LIGHT_ISL", "128"))
+    osl = int(os.environ.get("BENCH_SLOSCHED_OSL", "32"))
+    ttft_slo_ms = float(os.environ.get("BENCH_SLOSCHED_TTFT_MS", "250"))
+    chunk = int(os.environ.get("BENCH_SLOSCHED_CHUNK", "512"))
+    page_size = 16
+    num_pages = (n_heavy * (heavy_isl + osl) + n_light * (light_isl + osl)) // page_size + 64
+    rng = np.random.default_rng(7)
+    heavy_prompts = [rng.integers(1, 31999, size=heavy_isl).tolist() for _ in range(n_heavy)]
+    light_prompts = [rng.integers(1, 31999, size=light_isl).tolist() for _ in range(n_light)]
+
+    def run(slo_on: bool) -> dict:
+        cfg = EngineConfig(
+            num_pages=num_pages, page_size=page_size,
+            max_batch_size=n_heavy + n_light, max_prefill_tokens=heavy_isl,
+            max_seq_len=heavy_isl + osl + 8, enable_prefix_caching=False,
+            chunk_prefill_tokens=chunk,
+        )
+        runner = MockRunner(num_pages=num_pages, page_size=page_size, realtime=True)
+        admission = None
+        if slo_on:
+            tenants = TenantRegistry()
+            # Rate-limit the heavy tenant: the first long prompt borrows the
+            # whole bucket, the rest pace in at the refill rate.
+            tenants.configure("heavy", TenantQuota(
+                rate_tokens_per_s=4 * heavy_isl, burst_tokens=heavy_isl,
+            ))
+            admission = AdmissionController(
+                AdmissionConfig(ttft_budget_s=ttft_slo_ms / 1e3),
+                predictor=TtftPredictor(),
+                tenants=tenants,
+            )
+        core = EngineCore(runner, cfg, admission=admission)
+        # Heavy burst first (the FIFO head-of-line scenario), lights behind.
+        submit: dict[int, float] = {}
+        tier0: set[int] = set()
+        t0 = time.perf_counter()
+        for prompt in heavy_prompts:
+            seq = core.add_request(PreprocessedRequest(
+                token_ids=prompt, sampling=SamplingOptions(temperature=0.0),
+                stop=StopConditions(max_tokens=osl, ignore_eos=True),
+                tenant_id="heavy", priority=1,
+            ))
+            submit[seq.seq_id] = time.perf_counter()
+        for prompt in light_prompts:
+            seq = core.add_request(PreprocessedRequest(
+                token_ids=prompt, sampling=SamplingOptions(temperature=0.0),
+                stop=StopConditions(max_tokens=osl, ignore_eos=True),
+            ))
+            submit[seq.seq_id] = time.perf_counter()
+            tier0.add(seq.seq_id)
+        first_tok: dict[int, float] = {}
+        done_tokens: dict[int, int] = {}
+        while core.has_work:
+            for seq, out in core.step():
+                now = time.perf_counter()
+                if out.token_ids and seq.seq_id not in first_tok:
+                    first_tok[seq.seq_id] = now
+                done_tokens[seq.seq_id] = out.cumulative_tokens
+        elapsed = time.perf_counter() - t0
+        ttfts = {
+            sid: first_tok[sid] - submit[sid] for sid in first_tok
+        }
+        met = {sid for sid, t in ttfts.items() if t * 1e3 <= ttft_slo_ms}
+        goodput = sum(done_tokens.get(sid, 0) for sid in met) / elapsed if elapsed > 0 else 0.0
+        light_ttfts = sorted(t for sid, t in ttfts.items() if sid in tier0)
+
+        def pct(xs, p):
+            return xs[min(len(xs) - 1, int(p * len(xs)))] if xs else 0.0
+
+        return {
+            "mode": "slo_sched" if slo_on else "fifo",
+            "elapsed_s": round(elapsed, 3),
+            "requests_met_ttft": len(met),
+            "requests_total": len(submit),
+            "goodput_tokens_per_s": round(goodput, 1),
+            "light_ttft_p50_ms": round(pct(light_ttfts, 0.50) * 1e3, 2),
+            "light_ttft_p99_ms": round(pct(light_ttfts, 0.99) * 1e3, 2),
+            "deadline_misses": admission.deadline_misses if admission else 0,
+            "throttle_events": admission.throttle_events if admission else 0,
+            "tenant_throttled": dict(admission.tenants.throttled) if admission else {},
+        }
+
+    fifo = run(False)
+    gc.collect()
+    edf = run(True)
+    gc.collect()
+    return {
+        "ttft_slo_ms": ttft_slo_ms,
+        "heavy": {"n": n_heavy, "isl": heavy_isl},
+        "light": {"n": n_light, "isl": light_isl},
+        "osl": osl,
+        "fifo": fifo,
+        "slo_sched": edf,
+        "slo_sched_goodput_gain": round(
+            edf["goodput_tokens_per_s"] / fifo["goodput_tokens_per_s"], 4
+        ) if fifo["goodput_tokens_per_s"] > 0 else 0.0,
+        "slo_sched_ttft_p99_ms": edf["light_ttft_p99_ms"],
+    }
+
+
 def build_doc(configs, pull, wire=None, stall=None, spec=None,
-              decode_kernel=None) -> dict:
+              decode_kernel=None, slo_sched=None) -> dict:
     """The bench JSON document (one stdout line per emit).
 
     Module-level (not a closure) so its top-level key contract — the stable
@@ -765,6 +897,11 @@ def build_doc(configs, pull, wire=None, stall=None, spec=None,
         # fraction (see probe_cross_process_wire / bench/kv_wire.py).
         "kv_wire_gbps": (wire or {}).get("kv_wire_gbps", 0.0),
         "kv_wire_overlap_frac": (wire or {}).get("kv_wire_overlap_frac", 0.0),
+        # SLO admission-control headline keys (ISSUE 9): EDF+quota goodput
+        # over FIFO goodput under the TTFT budget, and the light-tier TTFT
+        # tail under the SLO plane (see probe_slo_sched).
+        "slo_sched_goodput_gain": (slo_sched or {}).get("slo_sched_goodput_gain", 0.0),
+        "slo_sched_ttft_p99_ms": (slo_sched or {}).get("slo_sched_ttft_p99_ms", 0.0),
         "detail": {
             "backend": jax.default_backend(),
             "suite": [c.get("preset") for c in configs],
@@ -772,6 +909,7 @@ def build_doc(configs, pull, wire=None, stall=None, spec=None,
             "stall_probe": stall or {"pending": True},
             "spec_probe": spec or {"pending": True},
             "decode_kernel_probe": decode_kernel or {"pending": True},
+            "slo_sched_probe": slo_sched or {"pending": True},
             "kv_pull": pull,
             "kv_wire_cross_process": wire or {"pending": True},
             "ttft_note": "ttft_idle_* is the drained-engine best case; "
@@ -783,8 +921,8 @@ def build_doc(configs, pull, wire=None, stall=None, spec=None,
 def main() -> None:
     from dynamo_tpu.models.config import PRESETS
 
-    def emit(configs, pull, wire=None, stall=None, spec=None, dk=None):
-        print(json.dumps(build_doc(configs, pull, wire, stall, spec, dk)),
+    def emit(configs, pull, wire=None, stall=None, spec=None, dk=None, ss=None):
+        print(json.dumps(build_doc(configs, pull, wire, stall, spec, dk, ss)),
               flush=True)
 
     suite = parse_suite()
@@ -833,16 +971,22 @@ def main() -> None:
     emit(configs, {"pending": True}, stall=stall, spec=spec, dk=dk)
     gc.collect()
     try:
+        ss = probe_slo_sched()
+    except Exception as e:
+        ss = {"error": f"{type(e).__name__}: {e}"[:200]}
+    emit(configs, {"pending": True}, stall=stall, spec=spec, dk=dk, ss=ss)
+    gc.collect()
+    try:
         pull = probe_kv_pull_gbps()
     except Exception as e:
         pull = {"error": f"{type(e).__name__}: {e}"[:200]}
-    emit(configs, pull, stall=stall, spec=spec, dk=dk)
+    emit(configs, pull, stall=stall, spec=spec, dk=dk, ss=ss)
     gc.collect()
     try:
         wire = probe_cross_process_wire()
     except Exception as e:
         wire = {"error": f"{type(e).__name__}: {e}"[:200]}
-    emit(configs, pull, wire, stall=stall, spec=spec, dk=dk)
+    emit(configs, pull, wire, stall=stall, spec=spec, dk=dk, ss=ss)
 
 
 if __name__ == "__main__":

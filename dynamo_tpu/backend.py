@@ -130,6 +130,7 @@ class Backend(Operator):
                     prompt_tokens=out.prompt_tokens,
                     cached_tokens=out.cached_tokens,
                     logprobs=lp,
+                    admission_wait_ms=out.admission_wait_ms,
                 )
                 return  # Operator.generate closes the stream -> engine cancels
             final = out.finish_reason is not None
@@ -144,6 +145,7 @@ class Backend(Operator):
                     prompt_tokens=out.prompt_tokens,
                     cached_tokens=out.cached_tokens,
                     logprobs=lp,
+                    admission_wait_ms=out.admission_wait_ms,
                 )
             if final:
                 return

@@ -144,6 +144,45 @@ class SloSettings:
     itl_p99_ms: float = 50.0  # per-request p99 inter-token-latency target
 
 
+@dataclasses.dataclass
+class SloSchedSettings:
+    """Admission-control plane knobs (``dynamo_tpu/sched``).
+
+    The master toggle is the bare ``DYN_SLO_SCHED`` flag (not part of this
+    section); these tune the plane once it is on. Env: ``DYN_SLO_SCHED_*``,
+    TOML: ``[slo_sched]``.
+    """
+
+    ttft_budget_ms: float = 500.0  # tier-0 EDF deadline budget
+    tier_stretch: float = 2.0  # deadline budget multiplier per priority tier
+    # Path to a profiler-produced WorkerProfile JSON; empty = the predictor
+    # runs on its online-corrected fallback and the router skips the
+    # attainment term unless a profile is wired in code.
+    profile: str = ""
+    attainment_weight: float = 1.0  # router cost weight for predicted attainment
+    # ITL-driven chunk-budget controller (shrinks chunk_prefill_tokens when
+    # the live decode-step tail nears the ITL budget; see SloSettings).
+    chunk_floor_tokens: int = 64
+    chunk_shrink_at: float = 0.9
+    chunk_relax_at: float = 0.5
+    chunk_cooldown_steps: int = 8
+
+
+@dataclasses.dataclass
+class TenantSettings:
+    """Default per-tenant admission quota (``dynamo_tpu/sched/tenants``).
+
+    Zeros mean unlimited. Env: ``DYN_TENANT_*``, TOML: ``[tenant]``.
+    """
+
+    rate_tokens_per_s: float = 0.0  # token-bucket refill rate (prompt tokens)
+    burst_tokens: float = 0.0  # bucket capacity; 0 -> 2s of rate
+    max_inflight_tokens: int = 0  # cap on a tenant's live prompt tokens
+    # JSON object of per-tenant overrides keyed by tenant id, e.g.
+    # '{"heavy": {"rate_tokens_per_s": 1000, "max_inflight_tokens": 4096}}'.
+    quotas: str = ""
+
+
 def load_runtime_settings(**kw) -> RuntimeSettings:
     return load_config(RuntimeSettings(), section="runtime", **kw)
 
@@ -154,3 +193,11 @@ def load_worker_settings(**kw) -> WorkerSettings:
 
 def load_slo_settings(**kw) -> SloSettings:
     return load_config(SloSettings(), section="slo", **kw)
+
+
+def load_slo_sched_settings(**kw) -> SloSchedSettings:
+    return load_config(SloSchedSettings(), section="slo_sched", **kw)
+
+
+def load_tenant_settings(**kw) -> TenantSettings:
+    return load_config(TenantSettings(), section="tenant", **kw)

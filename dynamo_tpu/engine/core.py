@@ -51,6 +51,7 @@ from dynamo_tpu.observability.flight import CRASH, STEP, FlightRecorder
 from dynamo_tpu.protocols.common import EngineOutput, FinishReason, PreprocessedRequest
 from dynamo_tpu.protocols.kv import ForwardPassMetrics, KvCacheEvent
 from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.runtime.faults import FAULTS, DropFault
 from dynamo_tpu.tokens import DEFAULT_SALT
 from dynamo_tpu.tracing import annotate
 
@@ -100,6 +101,11 @@ class EngineConfig:
     # page reserve grows to cover spec_k+1 slots, so speculation composes
     # with chunked prefill, admission, and preemption (docs/SCHEDULER.md).
     spec_k: int = 0
+    # SLO-native admission control (DYN_SLO_SCHED, dynamo_tpu/sched):
+    # EDF-over-predicted-TTFT ordering of the waiting queue, per-tenant
+    # quotas, and an ITL-driven chunk-budget controller. Off by default —
+    # FIFO intake is then bit-identical to the pre-sched scheduler.
+    slo_sched: bool = False
 
 
 class EngineCore:
@@ -112,6 +118,8 @@ class EngineCore:
         *,
         on_kv_event: Callable[[KvCacheEvent], None] | None = None,
         block_manager=None,  # dynamo_tpu.blocks.KvBlockManager (G2/G3 tiers)
+        admission=None,  # sched.AdmissionController (overrides the env build)
+        chunk_controller=None,  # sched.ChunkBudgetController (same)
     ) -> None:
         if runner.num_pages != config.num_pages or runner.page_size != config.page_size:
             raise ValueError("runner and engine config disagree on cache geometry")
@@ -137,6 +145,20 @@ class EngineCore:
         self._eos = set(config.eos_token_ids)
         self.num_preemptions = 0
         self.admission_rejections = 0  # requests refused at add_request intake
+        # SLO admission-control plane (None => legacy FIFO intake; the
+        # explicit kwargs let tests/bench inject configured controllers
+        # without touching the environment).
+        self.admission = admission
+        self.chunk_controller = chunk_controller
+        if config.slo_sched:
+            from dynamo_tpu.sched import build_admission_controller, build_chunk_controller
+
+            if self.admission is None:
+                self.admission = build_admission_controller()
+            if self.chunk_controller is None and config.chunk_prefill_tokens > 0:
+                self.chunk_controller = build_chunk_controller(config.chunk_prefill_tokens)
+        # Last _schedule_prefill's admission outcome (flight STEP record).
+        self.last_admission = {"admitted": 0, "deferred": 0, "deadline_slack_ms": 0.0}
         # Speculative decoding: cumulative drafting/verify counters (metrics
         # plane syncs them; acceptance rate = accepted / proposed).
         self.spec_tokens_proposed = 0
@@ -394,6 +416,10 @@ class EngineCore:
                 self.runner.last_attn_dispatch = None
                 self.attn_dispatch_counts[attn] = self.attn_dispatch_counts.get(attn, 0) + 1
             attn_phase, attn_path = attn if attn else ("", "")
+            # Feed the chunk-budget controller only steps that carried decode
+            # rows: their wall time is the ITL a running request observed.
+            if self.chunk_controller is not None and decode_rows:
+                self.chunk_controller.observe(wall_ms)
             self.flight.record(
                 STEP,
                 step_kind=kind,
@@ -418,6 +444,9 @@ class EngineCore:
                 dispatch_ms=round(dispatch_ms, 3),
                 attn_phase=attn_phase,
                 attn_path=attn_path,
+                admitted=int(self.last_admission.get("admitted", 0)),
+                deferred=int(self.last_admission.get("deferred", 0)),
+                deadline_slack_ms=self.last_admission.get("deadline_slack_ms", 0.0),
             )
             return out
 
@@ -478,6 +507,15 @@ class EngineCore:
 
     # -- prefill phase -----------------------------------------------------
 
+    def chunk_budget_tokens(self) -> int:
+        """The live per-step prefill chunk budget: the ITL-driven controller's
+        current value when the SLO plane runs one, else the static config.
+        Never 0 when the config is nonzero (the controller floors at
+        ``chunk_floor_tokens``), so chunked-vs-legacy mode never flips."""
+        if self.chunk_controller is not None:
+            return self.chunk_controller.budget()
+        return self.config.chunk_prefill_tokens
+
     def _schedule_prefill(self) -> list[tuple[Sequence, int]]:
         """Schedule this step's prefill work: ``(sequence, num_tokens)`` chunks.
 
@@ -497,9 +535,10 @@ class EngineCore:
         re-emission of old tokens).
         """
         ps = self.config.page_size
-        chunked = self.config.chunk_prefill_tokens > 0
+        chunk_budget = self.chunk_budget_tokens()
+        chunked = chunk_budget > 0
         if chunked and self.running:
-            budget = min(self.config.chunk_prefill_tokens, self.config.max_prefill_tokens)
+            budget = min(chunk_budget, self.config.max_prefill_tokens)
         else:
             budget = self.config.max_prefill_tokens
         chunks: list[tuple[Sequence, int]] = []
@@ -538,12 +577,35 @@ class EngineCore:
 
         # 2) Admit from the waiting queue (admission appends to
         # self.prefilling, so the live-sequence cap self-counts).
+        # With the SLO plane attached, prepare() reorders the queue EDF
+        # (least slack first) and returns how many head entries clear their
+        # tenant quotas this step; without it the deque is untouched (FIFO,
+        # bit-identical to the pre-sched scheduler).
+        admissible: int | None = None
+        if self.admission is not None and self.waiting:
+            admissible = self.admission.prepare(
+                self.waiting,
+                running=len(self.running) + len(self.prefilling),
+                slots=self.config.max_batch_size,
+            )
+        n_admitted = 0
         while (
             self.waiting
             and budget > 0
+            and (admissible is None or n_admitted < admissible)
             and len(self.running) + len(self.prefilling) < self.config.max_batch_size
         ):
             seq = self.waiting[0]
+            if FAULTS.armed:
+                try:
+                    if FAULTS.fire("sched.admit") == "delay":
+                        break  # deferred; retried next step
+                except DropFault:
+                    # Leave the seq in waiting but kill its context: next
+                    # step's _reap_cancelled emits CANCELLED, so the client
+                    # stream terminates instead of hanging outside all queues.
+                    seq.context.kill()
+                    break
             total = len(seq.tokens)  # prompt + any generated-before-preemption
             matched: list[int] = []
             onboard_n = 0  # tier blocks to onboard (payloads fetched post-alloc)
@@ -588,6 +650,10 @@ class EngineCore:
                     self._note_head_stall(seq, num_new)
                 break
             self.waiting.popleft()
+            seq.admitted_time = time.monotonic()
+            n_admitted += 1
+            if self.admission is not None:
+                self.admission.on_admit(seq, seq.admitted_time)
             if onboard_n:
                 # Pages exist now: fetch tier payloads, copy them in, and
                 # commit — they re-enter the G1 prefix cache and re-announce
@@ -624,6 +690,13 @@ class EngineCore:
             # whole prompt passed the pool check in add_request).
             self._preempt(self.prefilling[-1])
             return self._schedule_prefill()
+        self.last_admission = {
+            "admitted": n_admitted,
+            "deferred": len(self.waiting),
+            "deadline_slack_ms": (
+                round(self.admission.last_slack_ms, 3) if self.admission is not None else 0.0
+            ),
+        }
         return chunks
 
     def _note_head_stall(self, seq: Sequence, num_new: int) -> None:
@@ -666,10 +739,11 @@ class EngineCore:
         """
         k = self.config.spec_k
         budget = None
-        if self.config.chunk_prefill_tokens > 0:
+        chunk_budget = self.chunk_budget_tokens()
+        if chunk_budget > 0:
             budget = max(
                 0,
-                min(self.config.chunk_prefill_tokens, self.config.max_prefill_tokens)
+                min(chunk_budget, self.config.max_prefill_tokens)
                 - sum(n for _, n in chunks),
             )
         drafts: list[list[int]] = []
@@ -1299,6 +1373,15 @@ class EngineCore:
         reason = seq.check_stop(self._eos, self.config.max_seq_len)
         if reason is not None and not seq.is_finished:
             self._finish(seq, reason)
+        # First delta for this sequence: attach the admission wait (frontend
+        # RequestTracker observes it once) and close the predictor's loop
+        # with the actual TTFT.
+        wait_ms = None
+        if seq.admitted_time is not None and not seq.admission_reported:
+            seq.admission_reported = True
+            wait_ms = max(0.0, (seq.admitted_time - seq.arrival_time) * 1e3)
+            if self.admission is not None and tokens:
+                self.admission.on_first_token(seq, time.monotonic())
         out = EngineOutput(
             token_ids=tokens,
             finish_reason=seq.finish_reason,
@@ -1306,6 +1389,7 @@ class EngineCore:
             prompt_tokens=seq.num_prompt if seq.finish_reason else None,
             cached_tokens=seq.num_cached_at_start if seq.finish_reason else None,
             logprobs=logprobs[: len(tokens)] if logprobs else None,
+            admission_wait_ms=round(wait_ms, 3) if wait_ms is not None else None,
         )
         return seq, out
 
@@ -1377,6 +1461,8 @@ class EngineCore:
     def _finish(self, seq: Sequence, reason: FinishReason) -> None:
         seq.status = SeqStatus.FINISHED
         seq.finish_reason = reason
+        if self.admission is not None:
+            self.admission.on_finish(seq)
         if seq.pages:
             self.allocator.release([p for p in seq.pages if p != 0])
             seq.pages = []

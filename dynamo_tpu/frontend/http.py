@@ -210,6 +210,13 @@ class HttpService:
         stream_mode = bool(body.get("stream", False))
         # OpenAI default: usage only when explicitly requested via stream_options.
         send_usage = bool((body.get("stream_options") or {}).get("include_usage", False))
+        # Multi-tenant admission (dynamo_tpu/sched): tenant identity rides a
+        # header (an API gateway stamps it; clients can't be trusted to);
+        # priority is a plain body field. The preprocessor carries both into
+        # PreprocessedRequest.
+        tenant = request.headers.get("x-dynamo-tenant")
+        if tenant:
+            body["tenant_id"] = tenant
         ctx = Context(request_id=body.get("request_id"))
         # Trace ingress: continue the caller's W3C trace or mint a fresh one.
         # The root span's context rides ctx.trace through every pipeline
@@ -265,6 +272,8 @@ class HttpService:
         async for item in pipeline.generate(body, ctx):
             out = item if isinstance(item, BackendOutput) else BackendOutput.from_dict(item)
             tracker.on_token()
+            if out.admission_wait_ms is not None:
+                tracker.on_admission_wait(out.admission_wait_ms / 1e3)
             if out.finish_reason is not None:
                 tracker.on_usage(out.prompt_tokens, out.cumulative_tokens, out.cached_tokens)
             yield out

@@ -55,6 +55,16 @@ class FrontendMetrics:
             f"{ns}_request_queue_seconds", "Accept to engine-dispatch gap", ["model"],
             buckets=_QUEUE_BUCKETS, registry=self.registry,
         )
+        # Engine-side admission wait (add_request to first scheduling) —
+        # distinct from request_queue, which ends when the request *enters*
+        # the pipeline. This is where EDF deferral and tenant throttling
+        # show up; reported once per request via the first delta's
+        # admission_wait_ms.
+        self.admission_wait = Histogram(
+            f"{ns}_admission_wait_seconds",
+            "Engine admission wait (add_request to first scheduling)", ["model"],
+            buckets=_QUEUE_BUCKETS, registry=self.registry,
+        )
         # Router-side staleness of each worker's last load publish (synced
         # per scrape from the KvMetricsAggregator when one is wired).
         self.worker_staleness = Gauge(
@@ -182,6 +192,7 @@ class RequestTracker:
         self._ttft: float | None = None
         self._gaps: list[float] = []
         self._tokens = 0
+        self._admission_reported = False
 
     def __enter__(self) -> "RequestTracker":
         self._start = time.monotonic()
@@ -209,6 +220,12 @@ class RequestTracker:
         if not self._dispatched:
             self._dispatched = True
             self.m.request_queue.labels(self.model).observe(time.monotonic() - self._start)
+
+    def on_admission_wait(self, seconds: float) -> None:
+        """Engine admission wait from the first delta (once per request)."""
+        if not self._admission_reported:
+            self._admission_reported = True
+            self.m.admission_wait.labels(self.model).observe(max(0.0, seconds))
 
     def on_token(self) -> None:
         now = time.monotonic()

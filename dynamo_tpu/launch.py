@@ -21,6 +21,7 @@ import os
 from dataclasses import dataclass, field
 from typing import Any
 
+from dynamo_tpu.config import env_flag
 from dynamo_tpu.engine.core import EngineConfig, EngineCore
 from dynamo_tpu.engine.runner import ModelRunner
 from dynamo_tpu.engine.service import JaxEngineService
@@ -149,6 +150,7 @@ class WorkerSpec:
                 os.environ.get("DYN_SPEC_K")
                 or os.environ.get("DYN_WORKER_SPEC_K", "0")
             ),
+            slo_sched=env_flag(os.environ, "DYN_SLO_SCHED"),
         )
         defaults.update(engine_kw)
         return EngineConfig(**defaults)

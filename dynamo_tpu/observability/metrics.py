@@ -88,6 +88,34 @@ class EngineMetrics:
         # Admission / scheduler occupancy.
         self.requests_waiting = gauge(f"{ns}_requests_waiting", "Admitted requests not yet scheduled")
         self.requests_running = gauge(f"{ns}_requests_running", "Sequences in prefill or decode")
+        # SLO admission-control plane (dynamo_tpu/sched). Per-tier queue
+        # depth and per-tenant throttle counts are labelled clear-then-set
+        # gauges (label sets change as tenants come and go); the rest sync
+        # from the controller's cumulative counters on scrape.
+        self._admission_queue_depth = Gauge(
+            "dynamo_engine_admission_queue_depth",
+            "Waiting requests per priority tier in the engine admission queue "
+            "(tier 0 = most latency-sensitive; all waiting under tier 0 when "
+            "the SLO plane is off)",
+            ["worker", "tier"], registry=self.registry,
+        )
+        self.deadline_misses = gauge(
+            f"{ns}_deadline_misses_total",
+            "Requests admitted after their EDF deadline (arrival + stretched "
+            "TTFT budget) had already passed",
+        )
+        self._tenant_throttled = Gauge(
+            "dynamo_tenant_throttled_total",
+            "Admission deferrals charged to a tenant's quota (token bucket "
+            "empty or in-flight token cap reached)",
+            ["worker", "tenant"], registry=self.registry,
+        )
+        self.chunk_budget_tokens = gauge(
+            f"{ns}_chunk_budget_tokens",
+            "Live per-step prefill chunk budget (the ITL-driven controller's "
+            "current value; the static chunk_prefill_tokens config when the "
+            "SLO plane is off)",
+        )
         # XLA compile observability: first executions per (program, reason),
         # synced from the runner's CompileTracker on scrape. Labelled gauge
         # (not Counter) for the same no-double-booking reason as above; the
@@ -219,6 +247,23 @@ class EngineMetrics:
         self.cache_hit_ratio.set(stats.hit_rate)
         self.requests_waiting.set(len(getattr(core, "waiting", ())))
         self.requests_running.set(len(getattr(core, "running", ())) + len(getattr(core, "prefilling", ())))
+        adm = getattr(core, "admission", None)
+        self._admission_queue_depth.clear()
+        if adm is not None:
+            for tier, n in adm.queue_depth_by_tier(core.waiting).items():
+                self._admission_queue_depth.labels(self.worker, str(tier)).set(n)
+            self.deadline_misses.set(adm.deadline_misses)
+            self._tenant_throttled.clear()
+            for tenant, n in adm.tenants.throttled.items():
+                self._tenant_throttled.labels(self.worker, tenant).set(n)
+        else:
+            self._admission_queue_depth.labels(self.worker, "0").set(
+                len(getattr(core, "waiting", ()))
+            )
+            self.deadline_misses.set(0)
+        cb = getattr(core, "chunk_budget_tokens", None)
+        if callable(cb):
+            self.chunk_budget_tokens.set(cb())
         tracker = getattr(getattr(core, "runner", None), "compile_tracker", None)
         if tracker is not None:
             self._recompiles.clear()

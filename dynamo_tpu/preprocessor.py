@@ -229,6 +229,11 @@ class OpenAIPreprocessor(Operator):
             stop=extract_stop(body, default_max_tokens=self.default_max_tokens),
             model=body.get("model"),
             request_id=body.get("request_id") or uuid.uuid4().hex,
+            # Multi-tenant admission (dynamo_tpu/sched): tenant_id is stamped
+            # into the body from the x-dynamo-tenant header by the frontend;
+            # priority is client-settable (higher tier = relaxed deadline).
+            tenant_id=(body.get("tenant_id") or None),
+            priority=int(body.get("priority") or 0),
         )
         annotations = body.get("nvext", {}).get("annotations") or []
         if "formatted_prompt" in annotations:

@@ -77,6 +77,12 @@ class PreprocessedRequest:
     annotations: dict[str, Any] = field(default_factory=dict)
     # Multimodal embeddings handle (filled by encode workers; see models/vision).
     mm_inputs: dict[str, Any] | None = None
+    # Multi-tenant admission control (dynamo_tpu/sched): tenant identity from
+    # the frontend's x-dynamo-tenant header (None = the shared default
+    # tenant) and priority tier (0 = most latency-sensitive; each higher tier
+    # stretches the EDF deadline budget — relaxed, never starved).
+    tenant_id: str | None = None
+    priority: int = 0
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -87,6 +93,8 @@ class PreprocessedRequest:
             "request_id": self.request_id,
             "annotations": self.annotations,
             "mm_inputs": self.mm_inputs,
+            "tenant_id": self.tenant_id,
+            "priority": self.priority,
         }
 
     @classmethod
@@ -99,6 +107,8 @@ class PreprocessedRequest:
             request_id=d.get("request_id"),
             annotations=d.get("annotations", {}) or {},
             mm_inputs=d.get("mm_inputs"),
+            tenant_id=d.get("tenant_id"),
+            priority=int(d.get("priority") or 0),
         )
 
 
@@ -116,6 +126,9 @@ class BackendOutput:
     # Per generated token: {"id", "token", "bytes", "logprob",
     # "top": [[id, lp, token], ...]} (wire order: id, logprob, token).
     logprobs: list[dict] | None = None
+    # Engine admission wait (add_request -> first scheduling), reported once
+    # on the request's first delta; None on later deltas.
+    admission_wait_ms: float | None = None
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -127,6 +140,7 @@ class BackendOutput:
             "cached_tokens": self.cached_tokens,
             "embedding": self.embedding,
             "logprobs": self.logprobs,
+            "admission_wait_ms": self.admission_wait_ms,
         }
 
     @classmethod
@@ -141,6 +155,7 @@ class BackendOutput:
             cached_tokens=d.get("cached_tokens"),
             embedding=d.get("embedding"),
             logprobs=d.get("logprobs"),
+            admission_wait_ms=d.get("admission_wait_ms"),
         )
 
 
@@ -158,6 +173,9 @@ class EngineOutput:
     # Per token in token_ids: {"id", "logprob", "top": [[id, lp], ...]};
     # None when the request didn't ask (SamplingOptions.logprobs == 0).
     logprobs: list[dict] | None = None
+    # Engine admission wait (add_request -> first scheduling), attached to
+    # the sequence's first delta only (frontend RequestTracker observes it).
+    admission_wait_ms: float | None = None
 
     def to_dict(self) -> dict[str, Any]:
         return {
@@ -168,6 +186,7 @@ class EngineOutput:
             "cached_tokens": self.cached_tokens,
             "embedding": self.embedding,
             "logprobs": self.logprobs,
+            "admission_wait_ms": self.admission_wait_ms,
         }
 
     @classmethod
@@ -181,4 +200,5 @@ class EngineOutput:
             cached_tokens=d.get("cached_tokens"),
             embedding=d.get("embedding"),
             logprobs=d.get("logprobs"),
+            admission_wait_ms=d.get("admission_wait_ms"),
         )

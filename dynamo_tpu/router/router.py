@@ -55,8 +55,9 @@ class KvRouter:
         hashes = compute_block_hashes(token_ids, self.block_size, salt=base ^ salt_fold)
         overlaps = self.indexer.find_matches(hashes)
         metrics = self.aggregator.snapshot() if self.aggregator else {}
+        stale = self.aggregator.staleness_seconds() if self.aggregator else None
         num_blocks = max(len(hashes), 1)
-        wid = self.scheduler.schedule(num_blocks, overlaps, metrics, worker_ids)
+        wid = self.scheduler.schedule(num_blocks, overlaps, metrics, worker_ids, staleness=stale)
         return wid, overlaps.scores.get(wid, 0)
 
 
@@ -106,6 +107,14 @@ async def build_kv_router(
     events_ep = runtime.namespace(namespace).component(component).endpoint(KV_EVENTS_ENDPOINT)
     subscriber = await KvEventSubscriber(events_ep, indexer).start()
     aggregator = await KvMetricsAggregator(runtime, namespace, component).start()
+    if scheduler_config is None:
+        # Default config picks up the SLO attainment term from the
+        # environment (no-op unless DYN_SLO_SCHED is on); an explicit
+        # config is the caller's to arm.
+        from dynamo_tpu.sched import configure_attainment
+
+        scheduler_config = SchedulerConfig()
+        configure_attainment(scheduler_config)
     scheduler = KvScheduler(scheduler_config)
     router = KvRouter(indexer, scheduler, aggregator, block_size=block_size, salt=salt)
     client = runtime.namespace(namespace).component(component).endpoint(endpoint).client(router_mode="direct")

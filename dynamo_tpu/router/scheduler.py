@@ -29,6 +29,14 @@ class SchedulerConfig:
     overlap_weight: float = 1.0
     temperature: float = 0.0  # 0 => argmin cost
     seed: int | None = None
+    # Attainment-aware term (dynamo_tpu/sched, DYN_SLO_SCHED): penalize
+    # workers whose predicted TTFT at their current load eats into (or
+    # blows past) the TTFT budget. 0 disables; ``profile`` must be a
+    # planner.core.WorkerProfile for the term to engage (no profile, no
+    # prediction — the base cost already spreads load).
+    attainment_weight: float = 0.0
+    ttft_slo_s: float = 0.5
+    profile: object | None = None  # planner.core.WorkerProfile
 
 
 # (worker_id -> cost) -> chosen worker id
@@ -47,8 +55,11 @@ class KvScheduler:
         overlaps: OverlapScores,
         metrics: Mapping[int, ForwardPassMetrics],
         worker_ids: list[int],
+        *,
+        staleness: Mapping[int, float] | None = None,
     ) -> dict[int, float]:
         total = max(num_request_blocks, 1)
+        cfg = self.config
         out: dict[int, float] = {}
         for wid in worker_ids:
             overlap = min(overlaps.scores.get(wid, 0), num_request_blocks)
@@ -56,7 +67,24 @@ class KvScheduler:
             m = metrics.get(wid)
             usage = m.cache_usage if m else 0.0
             waiting = (m.num_requests_waiting / max(m.request_total_slots, 1)) if m else 0.0
-            out[wid] = self.config.overlap_weight * (new_blocks / total) + usage + waiting
+            cost = cfg.overlap_weight * (new_blocks / total) + usage + waiting
+            if cfg.attainment_weight > 0 and cfg.profile is not None:
+                # Predicted TTFT from the profiler surface at this worker's
+                # reported load; stale metrics inflate the prediction (a
+                # worker we haven't heard from is *assumed* busier, not
+                # idler). ratio < 1 nudges toward slack; the extra
+                # max(0, ratio-1) hinge makes predicted SLO misses hurt
+                # twice — attainment, not raw latency, is the objective.
+                load = (
+                    (m.num_requests_running + m.num_requests_waiting)
+                    / max(m.request_total_slots, 1)
+                ) if m else 0.0
+                pred = cfg.profile.ttft_at(min(load, 1.0), pct=99)
+                if staleness:
+                    pred *= 1.0 + min(staleness.get(wid, 0.0), 10.0)
+                ratio = pred / max(cfg.ttft_slo_s, 1e-9)
+                cost += cfg.attainment_weight * (ratio + max(0.0, ratio - 1.0))
+            out[wid] = cost
         return out
 
     def select(self, costs: dict[int, float]) -> int:
@@ -82,5 +110,9 @@ class KvScheduler:
         overlaps: OverlapScores,
         metrics: Mapping[int, ForwardPassMetrics],
         worker_ids: list[int],
+        *,
+        staleness: Mapping[int, float] | None = None,
     ) -> int:
-        return self.select(self.costs(num_request_blocks, overlaps, metrics, worker_ids))
+        return self.select(
+            self.costs(num_request_blocks, overlaps, metrics, worker_ids, staleness=staleness)
+        )

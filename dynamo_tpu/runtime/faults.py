@@ -56,6 +56,7 @@ FAULT_POINTS: dict[str, str] = {
     "kv.chunk.send": "disagg/transfer.py — sender side of one v2 KV chunk",
     "kv.chunk.recv": "disagg/transfer.py — receiver ingest of one KV chunk",
     "prefill.exec": "disagg/prefill_worker.py — execution of one claimed prefill task",
+    "sched.admit": "engine/core.py — admission of one waiting request into prefill (SLO sched seam)",
 }
 
 _ACTIONS = ("drop", "crash", "corrupt", "delay")

@@ -1,0 +1,149 @@
+"""EDF admission control over predicted TTFT.
+
+Replaces the engine's FIFO intake (behind ``DYN_SLO_SCHED``): each step,
+``prepare()`` reorders the waiting queue by *deadline slack* —
+
+    slack = (arrival + budget * stretch^tier) - (now + predicted_ttft)
+
+— least slack first, and gates the head at the tenant quotas. Throttled
+requests sink behind admissible ones but keep their EDF order among
+themselves, so a released quota resumes in deadline order, and a stretched
+tier's deadline still arrives eventually: priority tiers relax, they never
+starve (batch-tier aging is the anti-starvation mechanism, Llumnix-style
+priority isolation without a separate queue per tier).
+
+The controller is policy only — it never allocates pages or touches runner
+state. The engine's budget/page logic runs unchanged on the reordered
+queue, which is what keeps ``DYN_SLO_SCHED=0`` bit-identical to the legacy
+scheduler: with no controller attached the queue is never reordered.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass
+
+from dynamo_tpu.sched.predictor import TtftPredictor
+from dynamo_tpu.sched.tenants import DEFAULT_TENANT, TenantRegistry
+
+
+@dataclass
+class AdmissionConfig:
+    ttft_budget_s: float = 0.5  # tier-0 deadline budget (the TTFT SLO)
+    tier_stretch: float = 2.0  # deadline budget multiplier per priority tier
+    max_tier: int = 3  # priorities clamp into [0, max_tier]
+
+
+class AdmissionController:
+    """EDF-over-predicted-TTFT ordering + tenant quota gating."""
+
+    def __init__(
+        self,
+        config: AdmissionConfig | None = None,
+        *,
+        predictor: TtftPredictor | None = None,
+        tenants: TenantRegistry | None = None,
+        clock=time.monotonic,
+    ) -> None:
+        self.config = config or AdmissionConfig()
+        self.predictor = predictor or TtftPredictor()
+        self.tenants = tenants or TenantRegistry()
+        self._clock = clock
+        # seq_id -> (tenant, charged_tokens): live quota charges.
+        self._charges: dict[int, tuple[str, int]] = {}
+        self.deadline_misses = 0  # admitted after their deadline had passed
+        self.admitted_total = 0
+        self.throttle_events = 0
+        self.last_slack_ms = 0.0  # min slack across waiting at the last prepare
+
+    # -- identity ----------------------------------------------------------
+
+    def tenant_of(self, seq) -> str:
+        return getattr(seq.request, "tenant_id", None) or DEFAULT_TENANT
+
+    def tier_of(self, seq) -> int:
+        prio = int(getattr(seq.request, "priority", 0) or 0)
+        return min(max(prio, 0), self.config.max_tier)
+
+    def deadline(self, seq) -> float:
+        budget = self.config.ttft_budget_s * self.config.tier_stretch ** self.tier_of(seq)
+        return seq.arrival_time + budget
+
+    # -- scheduling --------------------------------------------------------
+
+    def prepare(self, waiting: deque, *, running: int, slots: int, now: float | None = None) -> int:
+        """Reorder ``waiting`` in place (EDF slack order, quota-throttled
+        requests last) and return how many head entries are admissible under
+        the tenant quotas right now."""
+        if not waiting:
+            self.last_slack_ms = 0.0
+            return 0
+        now = self._clock() if now is None else now
+        scored = []
+        for seq in waiting:
+            pred = self.predictor.predict(
+                queued_tokens=seq.prompt_remaining, running=running, slots=slots
+            )
+            seq.predicted_ttft_s = pred
+            slack = self.deadline(seq) - (now + pred)
+            scored.append((slack, seq.arrival_time, seq.seq_id, seq))
+        scored.sort(key=lambda t: (t[0], t[1], t[2]))
+        self.last_slack_ms = scored[0][0] * 1e3
+        admissible: list = []
+        deferred: list = []
+        planned_tokens: dict[str, float] = {}
+        planned_inflight: dict[str, int] = {}
+        for _, _, _, seq in scored:
+            tenant = self.tenant_of(seq)
+            tokens = len(seq.tokens)
+            if self.tenants.would_admit(
+                tenant,
+                tokens,
+                planned_tokens=planned_tokens.get(tenant, 0.0),
+                planned_inflight=planned_inflight.get(tenant, 0),
+            ):
+                planned_tokens[tenant] = planned_tokens.get(tenant, 0.0) + tokens
+                planned_inflight[tenant] = planned_inflight.get(tenant, 0) + tokens
+                admissible.append(seq)
+            else:
+                self.tenants.note_throttled(tenant)
+                self.throttle_events += 1
+                deferred.append(seq)
+        waiting.clear()
+        waiting.extend(admissible)
+        waiting.extend(deferred)
+        return len(admissible)
+
+    # -- lifecycle hooks (engine calls these) ------------------------------
+
+    def on_admit(self, seq, now: float | None = None) -> None:
+        if seq.seq_id in self._charges:
+            return  # preempted resume: quota already charged
+        now = self._clock() if now is None else now
+        tenant = self.tenant_of(seq)
+        tokens = len(seq.tokens)
+        self.tenants.on_admit(tenant, tokens)
+        self._charges[seq.seq_id] = (tenant, tokens)
+        self.admitted_total += 1
+        if now > self.deadline(seq):
+            self.deadline_misses += 1
+
+    def on_finish(self, seq) -> None:
+        charge = self._charges.pop(seq.seq_id, None)
+        if charge is not None:
+            self.tenants.on_finish(*charge)
+
+    def on_first_token(self, seq, now: float | None = None) -> None:
+        """Close the prediction loop with the observed TTFT."""
+        now = self._clock() if now is None else now
+        self.predictor.observe(seq.predicted_ttft_s, now - seq.arrival_time)
+
+    # -- introspection -----------------------------------------------------
+
+    def queue_depth_by_tier(self, waiting) -> dict[int, int]:
+        depth: dict[int, int] = {}
+        for seq in waiting:
+            tier = self.tier_of(seq)
+            depth[tier] = depth.get(tier, 0) + 1
+        return depth

@@ -144,6 +144,45 @@ def test_decode_kernel_probe_structure(monkeypatch):
     assert out["decode_roofline_frac"] > 0
 
 
+def test_slo_sched_probe_structure(monkeypatch):
+    """probe_slo_sched's contract (ISSUE 9): identical mixed-tenant scenario
+    under FIFO and under the SLO plane, stable keys for both modes, and the
+    headline gain. Sized down but with the head-of-line blocking still
+    decisive (two ~100 ms heavy prefills ahead of eight light requests on a
+    150 ms TTFT budget: FIFO serves the first heavy in budget but blows it
+    for every light), so EDF must beat FIFO on goodput even on CPU."""
+    import bench
+
+    monkeypatch.setenv("BENCH_SLOSCHED_HEAVY", "2")
+    monkeypatch.setenv("BENCH_SLOSCHED_HEAVY_ISL", "2048")
+    monkeypatch.setenv("BENCH_SLOSCHED_LIGHT", "8")
+    monkeypatch.setenv("BENCH_SLOSCHED_LIGHT_ISL", "64")
+    monkeypatch.setenv("BENCH_SLOSCHED_OSL", "8")
+    monkeypatch.setenv("BENCH_SLOSCHED_TTFT_MS", "150")
+    monkeypatch.setenv("BENCH_SLOSCHED_CHUNK", "256")
+    out = bench.probe_slo_sched()
+    assert out["ttft_slo_ms"] == 150.0
+    assert out["heavy"] == {"n": 2, "isl": 2048}
+    assert out["light"] == {"n": 8, "isl": 64}
+    for mode in ("fifo", "slo_sched"):
+        run = out[mode]
+        for key in ("mode", "elapsed_s", "requests_met_ttft", "requests_total",
+                    "goodput_tokens_per_s", "light_ttft_p50_ms",
+                    "light_ttft_p99_ms", "deadline_misses", "throttle_events",
+                    "tenant_throttled"):
+            assert key in run, f"{mode} missing {key}"
+        assert run["requests_total"] == 10
+    # FIFO never consults the plane; the SLO run throttles the heavy tenant.
+    assert out["fifo"]["throttle_events"] == 0
+    assert out["slo_sched"]["throttle_events"] > 0
+    assert out["slo_sched"]["tenant_throttled"].get("heavy", 0) > 0
+    # The headline: same capacity, more SLO-attaining tokens, lights fast.
+    assert out["slo_sched_goodput_gain"] > 1.0
+    assert out["slo_sched"]["requests_met_ttft"] > out["fifo"]["requests_met_ttft"]
+    assert 0 < out["slo_sched_ttft_p99_ms"] <= 150.0
+    assert out["slo_sched_ttft_p99_ms"] == out["slo_sched"]["light_ttft_p99_ms"]
+
+
 def test_bench_doc_goodput_keys():
     """build_doc's top-level contract (ISSUE 4): the SLO-conditioned goodput
     headline keys are stable, sourced from the headline (llama-3.2-1b)
@@ -180,13 +219,21 @@ def test_bench_doc_goodput_keys():
     assert doc4["kv_wire_gbps"] == 2.375
     assert doc4["kv_wire_overlap_frac"] == 0.41
     assert doc4["detail"]["kv_wire_cross_process"] == wire
+    assert doc4["slo_sched_goodput_gain"] == 0.0  # probe absent: stable default
+    # SLO admission headline keys (ISSUE 9) surface from the probe dict.
+    ss = {"slo_sched_goodput_gain": 5.4869, "slo_sched_ttft_p99_ms": 105.31}
+    doc5 = bench.build_doc(configs, pull={}, slo_sched=ss)
+    assert doc5["slo_sched_goodput_gain"] == 5.4869
+    assert doc5["slo_sched_ttft_p99_ms"] == 105.31
+    assert doc5["detail"]["slo_sched_probe"] == ss
     # An all-errors suite still emits the full key set.
     empty = bench.build_doc([{"preset": "x", "error": "boom"}], pull={})
     for key in ("value", "goodput_tokens_per_s_at_slo", "slo_ttft_attainment",
                 "itl_p99_ms", "max_decode_stall_ms", "spec_accept_rate",
                 "spec_decode_speedup", "decode_kernel_gbps",
                 "decode_roofline_frac", "kv_wire_gbps",
-                "kv_wire_overlap_frac"):
+                "kv_wire_overlap_frac", "slo_sched_goodput_gain",
+                "slo_sched_ttft_p99_ms"):
         assert key in empty
         assert empty[key] == 0.0
 

@@ -383,6 +383,70 @@ async def test_engine_step_crash_fails_streams_and_recovers():
         await svc.close()
 
 
+def test_sched_admit_fault_drill():
+    """satellite (c, ISSUE 9): a drop injected at the admission seam
+    (``sched.admit``) cancels exactly the request being admitted — its
+    stream terminates with CANCELLED instead of hanging outside every
+    queue — and the next step's admission proceeds normally."""
+    from dynamo_tpu.mocker import build_mock_core
+    from dynamo_tpu.protocols.common import (
+        FinishReason, PreprocessedRequest, SamplingOptions, StopConditions,
+    )
+
+    core = build_mock_core(realtime=False)
+
+    def req():
+        return PreprocessedRequest(
+            token_ids=[1, 2, 3, 4], sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=4, ignore_eos=True),
+        )
+
+    FAULTS.arm("sched.admit:drop@1")
+    a = core.add_request(req())
+    b = core.add_request(req())
+    results: dict[int, object] = {}
+    for _ in range(64):
+        if not core.has_work:
+            break
+        for seq, out in core.step():
+            if out.finish_reason is not None:
+                results[seq.seq_id] = out.finish_reason
+    assert not core.has_work
+    assert FAULTS.fired("sched.admit") == 1
+    # The head request at the faulted admission was killed and reaped...
+    assert results[a.seq_id] is FinishReason.CANCELLED
+    assert a.finish_reason is FinishReason.CANCELLED
+    # ...while the second request rode the recovered admission path.
+    assert results[b.seq_id] is FinishReason.LENGTH
+    assert b.num_generated == 4
+
+
+def test_sched_admit_delay_defers_without_loss():
+    """``sched.admit:delay`` only postpones admission: every request still
+    completes (the deferred head is retried on the next step)."""
+    from dynamo_tpu.mocker import build_mock_core
+    from dynamo_tpu.protocols.common import (
+        FinishReason, PreprocessedRequest, SamplingOptions, StopConditions,
+    )
+
+    core = build_mock_core(realtime=False)
+    FAULTS.arm("sched.admit:delay@1")
+    seqs = [
+        core.add_request(PreprocessedRequest(
+            token_ids=[5, 6, 7], sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=3, ignore_eos=True),
+        ))
+        for _ in range(2)
+    ]
+    for _ in range(64):
+        if not core.has_work:
+            break
+        core.step()
+    assert not core.has_work
+    assert FAULTS.fired("sched.admit") == 1
+    assert all(s.finish_reason is FinishReason.LENGTH for s in seqs)
+
+
 async def test_intake_drain_on_dead_loop_fails_queued_requests():
     """satellite (c): a request queued at intake but never admitted gets a
     terminal error item (not a hang) and the flight ring records the drain."""

@@ -196,6 +196,10 @@ EXPECTED_ENGINE_FAMILIES = {
     "dynamo_kv_wire_path_bytes_total",
     "dynamo_kv_wire_path_transfers_total",
     "dynamo_engine_prefill_requeues_total",
+    "dynamo_engine_admission_queue_depth",
+    "dynamo_engine_deadline_misses_total",
+    "dynamo_tenant_throttled_total",
+    "dynamo_engine_chunk_budget_tokens",
     "dynamo_kv_transfer_phase_seconds",
     # prometheus_client emits the histogram's _created timestamps as their
     # own gauge family once a labelled child exists.

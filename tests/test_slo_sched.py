@@ -1,0 +1,479 @@
+"""SLO admission-control plane (ISSUE 9): EDF ordering, tenant quotas,
+chunk-budget hysteresis, predictor fallback, and the router's attainment
+term — plus the engine seams (flight fields, admission_wait_ms, and the
+bit-identical FIFO guarantee when the plane is off)."""
+
+import asyncio
+from collections import deque
+
+import pytest
+
+from dynamo_tpu.engine.sequence import Sequence
+from dynamo_tpu.protocols.common import (
+    FinishReason,
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.sched import (
+    AdmissionConfig,
+    AdmissionController,
+    ChunkBudgetController,
+    TenantQuota,
+    TenantRegistry,
+    TtftPredictor,
+)
+
+
+def _req(tokens, *, tenant=None, priority=0, max_tokens=4):
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+        tenant_id=tenant,
+        priority=priority,
+    )
+
+
+def _seq(seq_id, n_tokens, *, arrival, tenant=None, priority=0):
+    seq = Sequence.from_request(
+        seq_id, _req(range(1, n_tokens + 1), tenant=tenant, priority=priority),
+        Context(), page_size=16, salt=0,
+    )
+    seq.arrival_time = arrival
+    return seq
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+# -- EDF ordering -------------------------------------------------------------
+
+
+def test_edf_reorders_by_slack_not_arrival():
+    """A tier-1 request that arrived FIRST sinks behind a later tier-0
+    request: its stretched deadline gives it more slack. FIFO would never
+    produce this order."""
+    clk = _Clock()
+    ctl = AdmissionController(
+        AdmissionConfig(ttft_budget_s=0.5, tier_stretch=2.0),
+        predictor=TtftPredictor(), tenants=TenantRegistry(clock=clk), clock=clk,
+    )
+    relaxed = _seq(0, 200, arrival=0.0, priority=1)  # deadline 0 + 0.5*2 = 1.0
+    urgent = _seq(1, 20, arrival=0.1, priority=0)  # deadline 0.1 + 0.5 = 0.6
+    waiting = deque([relaxed, urgent])  # arrival order
+    admissible = ctl.prepare(waiting, running=0, slots=8)
+    assert admissible == 2  # no quotas: everything is admissible
+    assert [s.seq_id for s in waiting] == [1, 0]
+    # prepare stamped each sequence's prediction for the feedback loop.
+    assert all(s.predicted_ttft_s is not None and s.predicted_ttft_s > 0 for s in waiting)
+    # last_slack_ms reflects the tightest (head) request.
+    assert ctl.last_slack_ms == pytest.approx(
+        (0.6 - urgent.predicted_ttft_s) * 1e3, rel=1e-6
+    )
+
+
+def test_edf_equal_slack_tie_breaks_on_arrival():
+    clk = _Clock()
+    ctl = AdmissionController(
+        AdmissionConfig(ttft_budget_s=0.5, tier_stretch=2.0),
+        predictor=TtftPredictor(), tenants=TenantRegistry(clock=clk), clock=clk,
+    )
+    # Same prompt (same prediction) and same 1.0 s deadline via different
+    # tiers: tier-0 arriving at 0.5 vs tier-1 arriving at 0.0. Equal slack,
+    # so the earlier arrival goes first.
+    a = _seq(5, 32, arrival=0.5, priority=0)
+    b = _seq(3, 32, arrival=0.0, priority=1)
+    waiting = deque([a, b])
+    ctl.prepare(waiting, running=0, slots=8)
+    assert [s.seq_id for s in waiting] == [3, 5]
+
+
+def test_tier_clamps_into_range():
+    ctl = AdmissionController(predictor=TtftPredictor(), tenants=TenantRegistry())
+    assert ctl.tier_of(_seq(0, 4, arrival=0.0, priority=-3)) == 0
+    assert ctl.tier_of(_seq(1, 4, arrival=0.0, priority=99)) == ctl.config.max_tier
+
+
+# -- tenant quotas ------------------------------------------------------------
+
+
+def test_token_bucket_throttles_heavy_tenant_not_light():
+    """Starvation protection: a heavy tenant flooding 10x its rate gets
+    throttled (its requests sink behind every admissible one); the light
+    tenant's requests are untouched. Borrow semantics admit the first
+    oversized request instead of wedging."""
+    clk = _Clock()
+    reg = TenantRegistry(clock=clk)
+    reg.configure("heavy", TenantQuota(rate_tokens_per_s=100.0, burst_tokens=100.0))
+    ctl = AdmissionController(
+        AdmissionConfig(ttft_budget_s=0.5), predictor=TtftPredictor(),
+        tenants=reg, clock=clk,
+    )
+    heavies = [_seq(i, 100, arrival=i * 1e-3, tenant="heavy", priority=1) for i in range(10)]
+    lights = [_seq(100 + i, 10, arrival=0.02 + i * 1e-3) for i in range(4)]
+    waiting = deque(heavies + lights)
+    admissible = ctl.prepare(waiting, running=0, slots=32)
+    head = list(waiting)[:admissible]
+    # One heavy request fits the (full) bucket; the other nine are throttled
+    # behind every light request.
+    assert admissible == 5
+    assert sum(1 for s in head if s.request.tenant_id == "heavy") == 1
+    assert sum(1 for s in head if s.request.tenant_id is None) == 4
+    assert reg.throttled["heavy"] == 9
+    assert "default" not in reg.throttled
+    # Charge the admitted head like the engine would.
+    for s in head:
+        ctl.on_admit(s, clk())
+    # Bucket is drained: nothing heavy clears the gate...
+    rest = deque([s for s in heavies if s.seq_id not in {x.seq_id for x in head}])
+    assert ctl.prepare(rest, running=5, slots=32) == 0
+    # ...until the bucket refills (1 s at 100 tok/s = one 100-token prompt).
+    clk.t += 1.0
+    assert ctl.prepare(rest, running=5, slots=32) == 1
+    # Deferred requests kept their EDF order (arrival, here).
+    assert [s.seq_id for s in rest] == sorted(s.seq_id for s in rest)
+
+
+def test_inflight_cap_never_wedges_an_idle_tenant():
+    clk = _Clock()
+    reg = TenantRegistry(clock=clk)
+    reg.configure("t", TenantQuota(max_inflight_tokens=50))
+    # Nothing in flight: even an oversized request is admissible (the cap
+    # throttles concurrency, it must not deadlock the tenant outright).
+    assert reg.would_admit("t", 80)
+    reg.on_admit("t", 80)
+    assert reg.inflight("t") == 80
+    assert not reg.would_admit("t", 10)  # live + 10 > 50
+    reg.on_finish("t", 80)
+    assert reg.inflight("t") == 0
+    assert reg.would_admit("t", 10)
+
+
+def test_admission_charges_once_across_preemption():
+    clk = _Clock()
+    reg = TenantRegistry(clock=clk)
+    reg.configure("t", TenantQuota(rate_tokens_per_s=100.0, burst_tokens=100.0))
+    ctl = AdmissionController(predictor=TtftPredictor(), tenants=reg, clock=clk)
+    seq = _seq(1, 60, arrival=0.0, tenant="t")
+    ctl.on_admit(seq, 0.0)
+    level_after = reg._bucket_level("t", reg.quota("t"))
+    ctl.on_admit(seq, 0.0)  # preempted resume: must not double-charge
+    assert reg._bucket_level("t", reg.quota("t")) == pytest.approx(level_after)
+    assert reg.inflight("t") == 60
+    ctl.on_finish(seq)
+    assert reg.inflight("t") == 0
+    ctl.on_finish(seq)  # idempotent
+    assert reg.inflight("t") == 0
+
+
+def test_tenant_registry_from_settings_json_overrides():
+    from dynamo_tpu.config import TenantSettings
+
+    reg = TenantRegistry.from_settings(TenantSettings(
+        rate_tokens_per_s=10.0,
+        quotas='{"heavy": {"rate_tokens_per_s": 1000, "burst_tokens": 500}}',
+    ))
+    assert reg.quota("anyone").rate_tokens_per_s == 10.0
+    assert reg.quota("heavy").rate_tokens_per_s == 1000.0
+    assert reg.quota("heavy").capacity == 500.0
+
+
+# -- chunk-budget controller --------------------------------------------------
+
+
+def test_chunk_controller_shrinks_relaxes_with_hysteresis():
+    ctl = ChunkBudgetController(
+        512, itl_budget_ms=50.0, floor_tokens=64,
+        shrink_at=0.9, relax_at=0.5, cooldown_steps=2, window=16, min_samples=4,
+    )
+    assert ctl.budget() == 512
+    # Tail at/over 0.9 * 50 ms: shrink (halve) once min_samples accumulate.
+    for _ in range(4):
+        ctl.observe(60.0)
+    assert ctl.budget() == 256 and ctl.shrinks == 1
+    # Post-change cooldown: the very next hot samples do not trigger a
+    # second shrink until it has passed and fresh samples accumulate.
+    ctl.observe(60.0)
+    ctl.observe(60.0)
+    assert ctl.budget() == 256
+    for _ in range(4):
+        ctl.observe(60.0)
+    assert ctl.budget() == 128 and ctl.shrinks == 2
+    # Keep shrinking under sustained pressure; never below the floor.
+    for _ in range(40):
+        ctl.observe(60.0)
+    assert ctl.budget() == 64
+    # Dead band (between relax_at and shrink_at): hold.
+    for _ in range(20):
+        ctl.observe(30.0)
+    assert ctl.budget() == 64 and ctl.relaxes == 0
+    # Slack (<= 0.5 * 50 ms): relax back up, capped at base.
+    for _ in range(60):
+        ctl.observe(10.0)
+    assert ctl.budget() == 512 and ctl.relaxes == 3
+    for _ in range(20):
+        ctl.observe(10.0)
+    assert ctl.budget() == 512  # never exceeds base
+
+
+def test_chunk_controller_rejects_unchunked_base():
+    with pytest.raises(ValueError):
+        ChunkBudgetController(0)
+
+
+# -- predictor ----------------------------------------------------------------
+
+
+def test_predictor_fallback_monotone_and_online_corrected():
+    p = TtftPredictor()  # no profile: pure service-time fallback
+    small = p.predict(queued_tokens=100, running=0, slots=8)
+    big = p.predict(queued_tokens=10000, running=0, slots=8)
+    assert 0 < small < big  # monotone in queued work
+    assert small == pytest.approx(100 / 20000.0)
+    # Observed TTFT consistently 2x the prediction: the bias converges up
+    # and later predictions inflate accordingly.
+    for _ in range(50):
+        p.observe(small, 2 * small)
+    assert 1.5 < p.bias < 2.1
+    assert p.predict(queued_tokens=100, running=0, slots=8) == pytest.approx(
+        p.bias * 100 / 20000.0
+    )
+    # Clamps: one absurd observation cannot invert the queue order.
+    p2 = TtftPredictor()
+    p2.observe(0.001, 1000.0)  # raw ratio 1e6, clamped to 8 pre-EWMA
+    assert p2.bias <= 1.0 + 0.2 * 8.0
+    p2.observe(None, 1.0)  # no prediction recorded: ignored
+    p2.observe(0.0, 1.0)
+    assert p2.observations == 1
+
+
+def test_predictor_uses_profile_surface():
+    class Prof:
+        prefill_tokens_per_sec = 10000.0
+
+        def ttft_at(self, load, pct=99):
+            return 0.1 + 0.4 * load
+
+    p = TtftPredictor(Prof())
+    idle = p.predict(queued_tokens=1000, running=0, slots=10)
+    busy = p.predict(queued_tokens=1000, running=10, slots=10)
+    assert idle == pytest.approx(0.1 + 1000 / 10000.0)
+    assert busy == pytest.approx(0.5 + 1000 / 10000.0)
+
+
+# -- router attainment term ---------------------------------------------------
+
+
+def test_router_attainment_breaks_tie_toward_slack_worker():
+    from dynamo_tpu.protocols.kv import ForwardPassMetrics
+    from dynamo_tpu.router.indexer import OverlapScores
+    from dynamo_tpu.router.scheduler import KvScheduler, SchedulerConfig
+
+    class Prof:
+        prefill_tokens_per_sec = 10000.0
+
+        def ttft_at(self, load, pct=99):
+            return 0.1 + 0.8 * load  # blows the 0.5 s budget above ~50% load
+
+    def metrics(running):
+        return ForwardPassMetrics(
+            kv_active_blocks=1, kv_total_blocks=2, num_requests_waiting=0,
+            num_requests_running=running, request_total_slots=8,
+        )
+
+    m = {1: metrics(8), 2: metrics(0)}  # equal base cost, unequal load
+    base = KvScheduler(SchedulerConfig())
+    costs = base.costs(4, OverlapScores(scores={}), m, [1, 2])
+    assert costs[1] == pytest.approx(costs[2])
+    assert base.select(costs) == 1  # argmin tie-break: lowest id
+    armed = KvScheduler(SchedulerConfig(
+        attainment_weight=1.0, ttft_slo_s=0.5, profile=Prof(),
+    ))
+    costs = armed.costs(4, OverlapScores(scores={}), m, [1, 2])
+    assert costs[2] < costs[1]
+    assert armed.select(costs) == 2
+    # The hinge makes a predicted MISS hurt twice: worker 1 predicts 0.9 s
+    # against a 0.5 s budget -> ratio + (ratio - 1).
+    assert costs[1] - costs[2] == pytest.approx((0.9 / 0.5 + 0.9 / 0.5 - 1.0) - 0.1 / 0.5)
+    # Staleness inflates the prediction: a quiet worker we have not heard
+    # from loses its advantage.
+    stale = armed.costs(4, OverlapScores(scores={}), m, [1, 2], staleness={2: 10.0})
+    assert stale[2] > stale[1]
+
+
+def test_configure_attainment_is_gated_on_master_toggle(monkeypatch):
+    from dynamo_tpu.router.scheduler import SchedulerConfig
+    from dynamo_tpu.sched import configure_attainment
+
+    cfg = SchedulerConfig()
+    monkeypatch.delenv("DYN_SLO_SCHED", raising=False)
+    configure_attainment(cfg)
+    assert cfg.attainment_weight == 0.0  # off: untouched
+    monkeypatch.setenv("DYN_SLO_SCHED", "1")
+    monkeypatch.setenv("DYN_SLO_SCHED_ATTAINMENT_WEIGHT", "2.5")
+    monkeypatch.setenv("DYN_SLO_SCHED_TTFT_BUDGET_MS", "300")
+    configure_attainment(cfg)
+    assert cfg.attainment_weight == 2.5
+    assert cfg.ttft_slo_s == pytest.approx(0.3)
+
+
+# -- engine integration -------------------------------------------------------
+
+
+def _mock_core(admission=None, **cfg_kw):
+    from dynamo_tpu.engine.core import EngineConfig, EngineCore
+    from dynamo_tpu.mocker import MockRunner
+
+    kw = dict(
+        num_pages=256, page_size=16, max_batch_size=8,
+        max_prefill_tokens=4096, max_seq_len=8192,
+        enable_prefix_caching=False, chunk_prefill_tokens=64,
+    )
+    kw.update(cfg_kw)
+    cfg = EngineConfig(**kw)
+    runner = MockRunner(num_pages=cfg.num_pages, page_size=cfg.page_size, realtime=False)
+    return EngineCore(runner, cfg, admission=admission)
+
+
+def test_engine_edf_serves_urgent_tier_before_relaxed_burst():
+    """End to end on the real scheduler: a relaxed (tier-1) long prompt
+    submitted FIRST is overtaken by a tier-0 short prompt; with the plane
+    off the same scenario is strictly FIFO."""
+
+    def scenario(admission):
+        # max_prefill_tokens=512 so the 2048-token prompt spans several
+        # steps — otherwise one step prefills both and order is invisible.
+        core = _mock_core(admission=admission, max_prefill_tokens=512)
+        heavy = core.add_request(_req(range(1, 2049), tenant="heavy", priority=1))
+        light = core.add_request(_req(range(1, 33)))
+        first = {}
+        for step in range(400):
+            if not core.has_work:
+                break
+            for seq, out in core.step():
+                if out.token_ids and seq.seq_id not in first:
+                    first[seq.seq_id] = step
+        assert not core.has_work
+        return heavy, light, first
+
+    # tier_stretch=10 gives the tier-1 prompt enough deadline slack that
+    # its larger predicted TTFT cannot win it the tighter slack anyway.
+    heavy, light, first = scenario(AdmissionController(
+        AdmissionConfig(ttft_budget_s=0.05, tier_stretch=10.0),
+        predictor=TtftPredictor(), tenants=TenantRegistry(),
+    ))
+    assert first[light.seq_id] < first[heavy.seq_id]
+    assert heavy.finish_reason is FinishReason.LENGTH  # relaxed, not starved
+    heavy, light, first = scenario(None)  # FIFO: submission order wins
+    assert first[heavy.seq_id] < first[light.seq_id]
+
+
+def test_engine_flight_records_admission_fields_and_wait():
+    from dynamo_tpu.observability.flight import STEP
+
+    ctl = AdmissionController(predictor=TtftPredictor(), tenants=TenantRegistry())
+    core = _mock_core(admission=ctl)
+    core.add_request(_req(range(1, 100)))
+    core.add_request(_req(range(1, 10)))
+    waits = []
+    for _ in range(200):
+        if not core.has_work:
+            break
+        for seq, out in core.step():
+            if out.admission_wait_ms is not None:
+                waits.append((seq.seq_id, out.admission_wait_ms))
+    steps = core.flight.snapshot(kind=STEP)
+    assert steps, "no STEP records"
+    for rec in steps:
+        assert "admitted" in rec and "deferred" in rec and "deadline_slack_ms" in rec
+    assert sum(r["admitted"] for r in steps) == 2
+    # admission_wait_ms rides exactly the first delta of each request.
+    assert sorted(sid for sid, _ in waits) == [0, 1]
+    assert all(w >= 0 for _, w in waits)
+    assert ctl.admitted_total == 2
+    # Finished sequences released their quota charges.
+    assert ctl.tenants.inflight("default") == 0
+    assert not ctl._charges
+    # The observed TTFTs closed the predictor's correction loop.
+    assert ctl.predictor.observations == 2
+
+
+def test_slo_sched_off_is_fifo_and_records_zeroes():
+    """DYN_SLO_SCHED off: no controller is attached, the waiting queue is
+    never reordered, chunk budget is the static config, and the new flight
+    fields stay at their zero defaults."""
+    from dynamo_tpu.observability.flight import STEP
+
+    core = _mock_core()
+    assert core.admission is None and core.chunk_controller is None
+    assert core.chunk_budget_tokens() == 64
+    a = core.add_request(_req(range(1, 50)))
+    b = core.add_request(_req(range(1, 50)))
+    assert [s.seq_id for s in core.waiting] == [a.seq_id, b.seq_id]
+    first = {}
+    saw_wait = []
+    for step in range(200):
+        if not core.has_work:
+            break
+        for seq, out in core.step():
+            if out.token_ids and seq.seq_id not in first:
+                first[seq.seq_id] = step
+            saw_wait.append(out.admission_wait_ms)
+    assert first[a.seq_id] <= first[b.seq_id]  # FIFO
+    steps = core.flight.snapshot(kind=STEP)
+    assert all(r["deadline_slack_ms"] == 0.0 for r in steps)
+    # admission_wait_ms still reports (it is a measurement, not policy).
+    assert any(w is not None for w in saw_wait)
+
+
+def test_engine_builds_controllers_from_env(monkeypatch):
+    monkeypatch.setenv("DYN_SLO_SCHED", "1")
+    monkeypatch.setenv("DYN_SLO_SCHED_TTFT_BUDGET_MS", "200")
+    monkeypatch.setenv("DYN_TENANT_RATE_TOKENS_PER_S", "123")
+    core = _mock_core(slo_sched=True)
+    assert core.admission is not None
+    assert core.admission.config.ttft_budget_s == pytest.approx(0.2)
+    assert core.admission.tenants.default_quota.rate_tokens_per_s == 123.0
+    assert core.chunk_controller is not None
+    assert core.chunk_controller.base == 64
+
+
+def test_tenant_and_priority_cross_the_wire():
+    req = _req(range(1, 5), tenant="acme", priority=2)
+    d = req.to_dict()
+    assert d["tenant_id"] == "acme" and d["priority"] == 2
+    back = PreprocessedRequest.from_dict(d)
+    assert back.tenant_id == "acme" and back.priority == 2
+    # Legacy payloads (no fields) default clean.
+    legacy = {k: v for k, v in d.items() if k not in ("tenant_id", "priority")}
+    back = PreprocessedRequest.from_dict(legacy)
+    assert back.tenant_id is None and back.priority == 0
+
+
+def test_engine_metrics_export_admission_families():
+    from dynamo_tpu.observability.metrics import EngineMetrics
+
+    ctl = AdmissionController(predictor=TtftPredictor(), tenants=TenantRegistry())
+    ctl.tenants.note_throttled("acme")
+    core = _mock_core(admission=ctl)
+    core.add_request(_req(range(1, 40), priority=1))
+    m = EngineMetrics(worker="w0").bind_core(core)
+    text = asyncio.run(m.render()).decode()
+    assert 'dynamo_engine_admission_queue_depth{tier="1",worker="w0"} 1.0' in text
+    assert 'dynamo_engine_deadline_misses_total{worker="w0"} 0.0' in text
+    assert 'dynamo_tenant_throttled_total{tenant="acme",worker="w0"} 1.0' in text
+    assert 'dynamo_engine_chunk_budget_tokens{worker="w0"} 64.0' in text
+    # Plane off: tier-0 depth mirrors the waiting queue, families still export.
+    core2 = _mock_core()
+    core2.add_request(_req(range(1, 10)))
+    m2 = EngineMetrics(worker="w1").bind_core(core2)
+    text2 = asyncio.run(m2.render()).decode()
+    assert 'dynamo_engine_admission_queue_depth{tier="0",worker="w1"} 1.0' in text2
+    assert 'dynamo_engine_chunk_budget_tokens{worker="w1"} 64.0' in text2
