@@ -582,12 +582,18 @@ class EngineCore:
         # tenant quotas this step; without it the deque is untouched (FIFO,
         # bit-identical to the pre-sched scheduler).
         admissible: int | None = None
+        quota_deferred = 0
         if self.admission is not None and self.waiting:
             admissible = self.admission.prepare(
                 self.waiting,
                 running=len(self.running) + len(self.prefilling),
                 slots=self.config.max_batch_size,
             )
+            # Admission-plane deferrals only: waiting entries the quota gate
+            # held back at prepare time. Entries later skipped for pages /
+            # prefill budget / batch slots are resource-limited, not deferred
+            # by the controller, and don't belong in this count.
+            quota_deferred = len(self.waiting) - admissible
         n_admitted = 0
         while (
             self.waiting
@@ -692,7 +698,7 @@ class EngineCore:
             return self._schedule_prefill()
         self.last_admission = {
             "admitted": n_admitted,
-            "deferred": len(self.waiting),
+            "deferred": quota_deferred,
             "deadline_slack_ms": (
                 round(self.admission.last_slack_ms, 3) if self.admission is not None else 0.0
             ),

@@ -55,11 +55,13 @@ class Sequence:
     first_token_time: float | None = None
     # SLO admission plane (dynamo_tpu/sched): when the scheduler admitted
     # this sequence into prefill (re-admission after preemption overwrites),
-    # whether the admission wait has been reported downstream, and the TTFT
-    # the predictor estimated at the last EDF ordering.
+    # whether the admission wait has been reported downstream, and the
+    # remaining TTFT the predictor estimated at the last EDF ordering (with
+    # the timestamp of that estimate — the observation's time origin).
     admitted_time: float | None = None
     admission_reported: bool = False
     predicted_ttft_s: float | None = None
+    predicted_at: float | None = None
 
     @classmethod
     def from_request(cls, seq_id: int, request: PreprocessedRequest, context: Context, *, page_size: int, salt: int) -> "Sequence":

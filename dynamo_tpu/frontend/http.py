@@ -217,6 +217,11 @@ class HttpService:
         tenant = request.headers.get("x-dynamo-tenant")
         if tenant:
             body["tenant_id"] = tenant
+        else:
+            # No gateway header: drop any client-supplied identity so a
+            # client can't impersonate another tenant's quota (or hop to an
+            # unconfigured tenant to dodge its own throttling).
+            body.pop("tenant_id", None)
         ctx = Context(request_id=body.get("request_id"))
         # Trace ingress: continue the caller's W3C trace or mint a fresh one.
         # The root span's context rides ctx.trace through every pipeline

@@ -86,6 +86,7 @@ class AdmissionController:
                 queued_tokens=seq.prompt_remaining, running=running, slots=slots
             )
             seq.predicted_ttft_s = pred
+            seq.predicted_at = now
             slack = self.deadline(seq) - (now + pred)
             scored.append((slack, seq.arrival_time, seq.seq_id, seq))
         scored.sort(key=lambda t: (t[0], t[1], t[2]))
@@ -95,6 +96,14 @@ class AdmissionController:
         planned_tokens: dict[str, float] = {}
         planned_inflight: dict[str, int] = {}
         for _, _, _, seq in scored:
+            if seq.seq_id in self._charges:
+                # Preempted resume: charged at first admission, refunded only
+                # at on_finish — the quota already accounts for the resources
+                # it holds. Re-gating would count the request against itself
+                # (a tenant whose sole live request exceeds its in-flight cap
+                # could never resume: wedged forever).
+                admissible.append(seq)
+                continue
             tenant = self.tenant_of(seq)
             tokens = len(seq.tokens)
             if self.tenants.would_admit(
@@ -135,9 +144,16 @@ class AdmissionController:
             self.tenants.on_finish(*charge)
 
     def on_first_token(self, seq, now: float | None = None) -> None:
-        """Close the prediction loop with the observed TTFT."""
+        """Close the prediction loop with the observed TTFT.
+
+        ``predicted_ttft_s`` is the *remaining* TTFT estimated at the last
+        ``prepare()``, so the observation must share that time origin —
+        measuring from arrival would fold already-elapsed queue wait into
+        the ratio and inflate the bias under load.
+        """
         now = self._clock() if now is None else now
-        self.predictor.observe(seq.predicted_ttft_s, now - seq.arrival_time)
+        origin = seq.predicted_at if seq.predicted_at is not None else seq.arrival_time
+        self.predictor.observe(seq.predicted_ttft_s, now - origin)
 
     # -- introspection -----------------------------------------------------
 

@@ -121,6 +121,41 @@ async def test_completions_endpoint():
         await stop_stack(handles)
 
 
+async def test_client_supplied_tenant_id_never_passes_through(monkeypatch):
+    """Tenant identity rides the x-dynamo-tenant header (a gateway stamps
+    it); a tenant_id in the request body is client-controlled and must be
+    dropped, or clients could impersonate another tenant's quota — and the
+    header must win over any body value when both are present."""
+    from dynamo_tpu.preprocessor import OpenAIPreprocessor
+
+    seen = []
+    orig = OpenAIPreprocessor.preprocess
+
+    def spy(self, body, **kw):
+        req = orig(self, body, **kw)
+        seen.append(req.tenant_id)
+        return req
+
+    monkeypatch.setattr(OpenAIPreprocessor, "preprocess", spy)
+    handles, base = await start_stack()
+    try:
+        async with aiohttp.ClientSession() as s:
+            body = {
+                "model": "test-tiny", "prompt": "a", "max_tokens": 1,
+                "temperature": 0, "tenant_id": "victim",
+            }
+            async with s.post(base + "/v1/completions", json=body) as r:
+                assert r.status == 200
+            async with s.post(
+                base + "/v1/completions", json=body,
+                headers={"x-dynamo-tenant": "acme"},
+            ) as r:
+                assert r.status == 200
+        assert seen == [None, "acme"]
+    finally:
+        await stop_stack(handles)
+
+
 async def test_error_paths():
     handles, base = await start_stack()
     try:

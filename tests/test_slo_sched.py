@@ -173,6 +173,55 @@ def test_admission_charges_once_across_preemption():
     assert reg.inflight("t") == 0
 
 
+def test_preempted_resume_bypasses_quota_gate():
+    """A preempted sequence's tokens are still charged (refunded only at
+    on_finish), so prepare() must not re-gate it through would_admit — its
+    own in-flight charge would count against it and, with an in-flight cap
+    under 2x the prompt, wedge the request in waiting forever."""
+    clk = _Clock()
+    reg = TenantRegistry(clock=clk)
+    reg.configure("t", TenantQuota(rate_tokens_per_s=10.0, burst_tokens=60.0,
+                                   max_inflight_tokens=80))
+    ctl = AdmissionController(predictor=TtftPredictor(), tenants=reg, clock=clk)
+    seq = _seq(1, 60, arrival=0.0, tenant="t")
+    ctl.on_admit(seq, 0.0)  # first admission: 60 tokens charged + bucket drained
+    # Preempted back into waiting: live=60, live+60 > 80 and the bucket is
+    # empty, yet the resume must be admissible (it holds what it charged).
+    waiting = deque([seq])
+    assert ctl.prepare(waiting, running=0, slots=8) == 1
+    assert "t" not in reg.throttled
+    # A *fresh* request from the same tenant still hits the gate.
+    fresh = _seq(2, 60, arrival=0.0, tenant="t")
+    waiting = deque([seq, fresh])
+    assert ctl.prepare(waiting, running=0, slots=8) == 1
+    assert [s.seq_id for s in waiting] == [1, 2]
+
+
+def test_observe_uses_prediction_time_origin_not_arrival():
+    """predicted_ttft_s is the *remaining* TTFT estimated at the last
+    prepare(); the observation must share that time origin — measuring from
+    arrival would fold already-elapsed queue wait into the ratio and inflate
+    the predictor bias under load."""
+    seen = []
+
+    class _Rec(TtftPredictor):
+        def observe(self, predicted_s, actual_s):
+            seen.append((predicted_s, actual_s))
+            super().observe(predicted_s, actual_s)
+
+    clk = _Clock()
+    ctl = AdmissionController(predictor=_Rec(), tenants=TenantRegistry(clock=clk), clock=clk)
+    seq = _seq(1, 40, arrival=0.0)
+    clk.t = 5.0  # 5 s of queue wait before the first EDF ordering
+    ctl.prepare(deque([seq]), running=0, slots=8)
+    assert seq.predicted_at == 5.0
+    clk.t = 5.4
+    ctl.on_first_token(seq)
+    ((pred, actual),) = seen
+    assert pred == seq.predicted_ttft_s
+    assert actual == pytest.approx(0.4)  # not 5.4: same origin as the prediction
+
+
 def test_tenant_registry_from_settings_json_overrides():
     from dynamo_tpu.config import TenantSettings
 
