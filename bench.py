@@ -855,8 +855,91 @@ def probe_slo_sched() -> dict:
     }
 
 
+def probe_engine_overlap() -> dict:
+    """Overlapped-execution probe (ISSUE 10): DYN_OVERLAP off vs on.
+
+    Identical decode-heavy work on the mock-timed engine (MockRunner
+    realtime with a nonzero d2h latency — the blocking device->host result
+    copy the overlapped loop exists to hide). The synchronous loop pays
+    compute + d2h per token; the depth-1 pipeline dispatches step N+1 with
+    device-chained input tokens before harvesting step N, so per-token wall
+    collapses toward max(compute, d2h). Both modes run the same scenario and
+    the probe asserts the token streams are identical. Top-level bench JSON
+    promotes:
+
+      engine_overlap_itl_gain — sync-mode mean ITL over overlap-mode mean
+        ITL (>1 means overlap shortened the decode critical path);
+      device_idle_frac — fraction of overlap-mode wall time the simulated
+        device spent idle (strictly below the sync mode's).
+    """
+    from dynamo_tpu.engine.core import EngineConfig, EngineCore
+    from dynamo_tpu.mocker import MockRunner
+    from dynamo_tpu.protocols.common import PreprocessedRequest, SamplingOptions, StopConditions
+
+    decoders = int(os.environ.get("BENCH_OVERLAP_DECODERS", "4"))
+    isl = int(os.environ.get("BENCH_OVERLAP_ISL", "32"))
+    osl = int(os.environ.get("BENCH_OVERLAP_OSL", "64"))
+    decode_us = float(os.environ.get("BENCH_OVERLAP_DECODE_US", "2000"))
+    d2h_us = float(os.environ.get("BENCH_OVERLAP_D2H_US", "1500"))
+    page_size = 16
+    num_pages = decoders * (isl + osl) // page_size + 32
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(1, 31999, size=isl).tolist() for _ in range(decoders)]
+
+    def run(overlap_on: bool) -> tuple[dict, dict[int, list[int]]]:
+        cfg = EngineConfig(
+            num_pages=num_pages, page_size=page_size, max_batch_size=decoders,
+            max_prefill_tokens=isl, max_seq_len=isl + osl + 8,
+            enable_prefix_caching=False, chunk_prefill_tokens=0,
+            overlap=overlap_on,
+        )
+        runner = MockRunner(
+            num_pages=num_pages, page_size=page_size, realtime=True,
+            decode_us_base=decode_us, d2h_us=d2h_us,
+        )
+        core = EngineCore(runner, cfg)
+        for prompt in prompts:
+            core.add_request(PreprocessedRequest(
+                token_ids=prompt, sampling=SamplingOptions(temperature=0.0),
+                stop=StopConditions(max_tokens=osl, ignore_eos=True),
+            ))
+        tokens: dict[int, list[int]] = {}
+        t0 = time.perf_counter()
+        while core.has_work:
+            for seq, out in core.step():
+                tokens.setdefault(seq.seq_id, []).extend(out.token_ids)
+        elapsed = time.perf_counter() - t0
+        idle_frac = max(0.0, 1.0 - runner.busy_us / (elapsed * 1e6)) if elapsed > 0 else 0.0
+        return {
+            "mode": "overlap" if overlap_on else "sync",
+            "elapsed_s": round(elapsed, 4),
+            "itl_mean_ms": round(elapsed * 1e3 / osl, 3),
+            "device_idle_frac": round(idle_frac, 4),
+            "overlap_steps": dict(core.overlap_step_counts),
+            "mean_gap_ms": round(
+                core.step_gap_ms_sum / core.step_gap_ms_count, 3
+            ) if core.step_gap_ms_count else 0.0,
+        }, tokens
+
+    sync, sync_tokens = run(False)
+    gc.collect()
+    overlap, overlap_tokens = run(True)
+    gc.collect()
+    return {
+        "decoders": decoders, "isl": isl, "osl": osl,
+        "decode_us": decode_us, "d2h_us": d2h_us,
+        "sync": sync,
+        "overlap": overlap,
+        "bit_identical": sync_tokens == overlap_tokens,
+        "engine_overlap_itl_gain": round(
+            sync["itl_mean_ms"] / overlap["itl_mean_ms"], 4
+        ) if overlap["itl_mean_ms"] > 0 else 0.0,
+        "device_idle_frac": overlap["device_idle_frac"],
+    }
+
+
 def build_doc(configs, pull, wire=None, stall=None, spec=None,
-              decode_kernel=None, slo_sched=None) -> dict:
+              decode_kernel=None, slo_sched=None, overlap=None) -> dict:
     """The bench JSON document (one stdout line per emit).
 
     Module-level (not a closure) so its top-level key contract — the stable
@@ -902,6 +985,11 @@ def build_doc(configs, pull, wire=None, stall=None, spec=None,
         # tail under the SLO plane (see probe_slo_sched).
         "slo_sched_goodput_gain": (slo_sched or {}).get("slo_sched_goodput_gain", 0.0),
         "slo_sched_ttft_p99_ms": (slo_sched or {}).get("slo_sched_ttft_p99_ms", 0.0),
+        # Overlapped-execution headline keys (ISSUE 10): sync-over-overlap
+        # mean ITL ratio and the overlapped mode's device-idle fraction on
+        # identical decode-heavy work (see probe_engine_overlap).
+        "engine_overlap_itl_gain": (overlap or {}).get("engine_overlap_itl_gain", 0.0),
+        "device_idle_frac": (overlap or {}).get("device_idle_frac", 0.0),
         "detail": {
             "backend": jax.default_backend(),
             "suite": [c.get("preset") for c in configs],
@@ -910,6 +998,7 @@ def build_doc(configs, pull, wire=None, stall=None, spec=None,
             "spec_probe": spec or {"pending": True},
             "decode_kernel_probe": decode_kernel or {"pending": True},
             "slo_sched_probe": slo_sched or {"pending": True},
+            "engine_overlap_probe": overlap or {"pending": True},
             "kv_pull": pull,
             "kv_wire_cross_process": wire or {"pending": True},
             "ttft_note": "ttft_idle_* is the drained-engine best case; "
@@ -921,8 +1010,9 @@ def build_doc(configs, pull, wire=None, stall=None, spec=None,
 def main() -> None:
     from dynamo_tpu.models.config import PRESETS
 
-    def emit(configs, pull, wire=None, stall=None, spec=None, dk=None, ss=None):
-        print(json.dumps(build_doc(configs, pull, wire, stall, spec, dk, ss)),
+    def emit(configs, pull, wire=None, stall=None, spec=None, dk=None, ss=None,
+             ov=None):
+        print(json.dumps(build_doc(configs, pull, wire, stall, spec, dk, ss, ov)),
               flush=True)
 
     suite = parse_suite()
@@ -977,16 +1067,22 @@ def main() -> None:
     emit(configs, {"pending": True}, stall=stall, spec=spec, dk=dk, ss=ss)
     gc.collect()
     try:
+        ov = probe_engine_overlap()
+    except Exception as e:
+        ov = {"error": f"{type(e).__name__}: {e}"[:200]}
+    emit(configs, {"pending": True}, stall=stall, spec=spec, dk=dk, ss=ss, ov=ov)
+    gc.collect()
+    try:
         pull = probe_kv_pull_gbps()
     except Exception as e:
         pull = {"error": f"{type(e).__name__}: {e}"[:200]}
-    emit(configs, pull, stall=stall, spec=spec, dk=dk, ss=ss)
+    emit(configs, pull, stall=stall, spec=spec, dk=dk, ss=ss, ov=ov)
     gc.collect()
     try:
         wire = probe_cross_process_wire()
     except Exception as e:
         wire = {"error": f"{type(e).__name__}: {e}"[:200]}
-    emit(configs, pull, wire, stall=stall, spec=spec, dk=dk, ss=ss)
+    emit(configs, pull, wire, stall=stall, spec=spec, dk=dk, ss=ss, ov=ov)
 
 
 if __name__ == "__main__":

@@ -100,12 +100,17 @@ class KvBlockManager:
         """Write-through one committed G1 page into G2 (no-op if present)."""
         self.offload_batch([(block_hash, page_id)])
 
-    def offload_batch(self, items: list[tuple[int, int]], *, read_pages=None) -> None:
+    def offload_batch(self, items: list[tuple[int, int]], *, read_pages=None,
+                      read_pages_async=None) -> None:
         """Write-through many (block_hash, page_id) pairs at once.
 
         With ``read_pages`` (``list[page_id] -> list[Payload]``) the device
         reads collapse into one batched gather + one device->host transfer;
-        otherwise falls back to per-page reads.
+        otherwise falls back to per-page reads. ``read_pages_async``
+        (``list[page_id] -> handle`` with ``wait() -> list[Payload]``) is
+        preferred over both: the gather is dispatched and its device->host
+        DMA kicked off immediately, and this thread only blocks at the tier
+        puts — the copy overlaps whatever the engine does in between.
         """
         todo: list[tuple[int, int]] = []
         seen: set[int] = set()
@@ -121,7 +126,9 @@ class KvBlockManager:
             todo.append((block_hash, page_id))
         if not todo:
             return
-        if read_pages is not None:
+        if read_pages_async is not None:
+            payloads = read_pages_async([p for _, p in todo]).wait()
+        elif read_pages is not None:
             payloads = read_pages([p for _, p in todo])
         else:
             payloads = [self._read_page(p) for _, p in todo]

@@ -125,6 +125,10 @@ class WorkerSettings:
     # Speculative decoding draft length (n-gram self-drafting, lossless);
     # 0 disables. See docs/SCHEDULER.md "Speculative steps".
     spec_k: int = 0
+    # Overlapped execution: depth-1 decode pipeline with device-resident
+    # token feedback (bare DYN_OVERLAP also arms it). Output streams stay
+    # bit-identical to off. See docs/SCHEDULER.md "Overlapped execution".
+    overlap: bool = False
     # KV-cache storage dtype: 'bf16' (default) or 'fp8' (float8_e4m3fn,
     # halves KV HBM; attention upcasts to the query dtype at the matmul).
     kv_cache_dtype: str = "bf16"

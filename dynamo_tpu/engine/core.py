@@ -106,6 +106,16 @@ class EngineConfig:
     # quotas, and an ITL-driven chunk-budget controller. Off by default —
     # FIFO intake is then bit-identical to the pre-sched scheduler.
     slo_sched: bool = False
+    # Overlapped execution (DYN_OVERLAP): pure-decode steps run a depth-1
+    # pipeline — step N+1 is dispatched with its input tokens chained from
+    # N's device-resident samples before N's tokens reach the host, so the
+    # chip never idles on the per-step host round-trip. Stops are evaluated
+    # one step late; a late-detected stop cancels the in-flight row (its
+    # token is discarded, its pages released — output streams stay
+    # bit-identical to overlap=False). Any composition change (admission,
+    # chunk, preemption, cancellation, spec verify) inserts a barrier and
+    # falls back to the synchronous path for that step. docs/SCHEDULER.md.
+    overlap: bool = False
 
 
 class EngineCore:
@@ -197,8 +207,18 @@ class EngineCore:
         self.step_lock = threading.RLock()
         self._head_stall_steps = 0
         # Pipelined decode: the burst in flight on device, not yet consumed.
-        # (batch snapshot, DeviceTokens handle, burst length)
+        # (batch snapshot, DeviceTokens/DeviceStepTokens handle, burst length)
         self._inflight: tuple[list[Sequence], object, int] | None = None
+        # Overlapped execution accounting (config.overlap): per-step mode —
+        # "overlapped" when the step dispatched a chained lookahead while
+        # harvesting the previous one, "barrier" otherwise — plus the host
+        # gap between consecutive dispatches (device-idle observability).
+        self._overlap_mode: str | None = None
+        self.overlap_step_counts: dict[str, int] = {"overlapped": 0, "barrier": 0}
+        self._prev_step_end: float | None = None
+        self.step_gap_ms_sum = 0.0
+        self.step_gap_ms_count = 0
+        self.step_gap_ms_last = 0.0
         # Constrained decoding (response_format json_object): the mask cache
         # needs token TEXT, so a tokenizer (or factory) must be installed
         # before json_mode requests are admitted.
@@ -371,6 +391,13 @@ class EngineCore:
             tracker = getattr(self.runner, "compile_tracker", None)
             disp0 = tracker.dispatch_seconds_total if tracker is not None else 0.0
             t0 = time.perf_counter()
+            # Host gap since the previous step returned: the window where the
+            # device has nothing newly dispatched (detok/stop/route/schedule
+            # time). The overlapped loop exists to hide exactly this.
+            gap_ms = (
+                (t0 - self._prev_step_end) * 1e3 if self._prev_step_end is not None else 0.0
+            )
+            self._overlap_mode = None
             try:
                 out = self._step_locked()
             except Exception as exc:
@@ -389,7 +416,17 @@ class EngineCore:
             info = self.last_step_info
             fresh = info is not prev_info  # _run_mixed built a new dict
             if not fresh and not out and not self.running:
+                self._prev_step_end = time.perf_counter()
                 return out  # idle drain: nothing dispatched, nothing to record
+            overlap_mode = ""
+            if self.config.overlap:
+                overlap_mode = self._overlap_mode or "barrier"
+                self.overlap_step_counts[overlap_mode] = (
+                    self.overlap_step_counts.get(overlap_mode, 0) + 1
+                )
+            self.step_gap_ms_sum += gap_ms
+            self.step_gap_ms_count += 1
+            self.step_gap_ms_last = gap_ms
             if fresh:
                 decode_rows = int(info.get("decode_rows", 0))
                 chunk_rows = int(info.get("chunk_rows", 0))
@@ -447,7 +484,10 @@ class EngineCore:
                 admitted=int(self.last_admission.get("admitted", 0)),
                 deferred=int(self.last_admission.get("deferred", 0)),
                 deadline_slack_ms=self.last_admission.get("deadline_slack_ms", 0.0),
+                gap_ms=round(gap_ms, 3),
+                overlap_mode=overlap_mode,
             )
+            self._prev_step_end = time.perf_counter()
             return out
 
     def _step_locked(self) -> list[tuple[Sequence, EngineOutput]]:
@@ -1040,11 +1080,25 @@ class EngineCore:
             s.request.sampling.frequency_penalty or s.request.sampling.presence_penalty
             for s in self.running
         )
+        constrained = any(s.constraint is not None for s in self.running)
+        # Overlapped execution (DYN_OVERLAP): a single decode step runs the
+        # depth-1 pipeline — harvest step N while step N+1 computes, its
+        # input tokens chained device-side. Logprobs ride along (the aux
+        # arrays travel on the same handle); constraints need a fresh host
+        # mask per token and penalties fresh history, so both barrier.
+        if (
+            self.config.overlap
+            and k == 1
+            and not penalized
+            and not constrained
+            and hasattr(self.runner, "step_async")
+            and getattr(self.runner, "mesh", None) is None
+        ):
+            return self._run_decode_overlapped()
         # Logprobs ride the single-step sync path: the fused burst's scan
         # doesn't surface per-step logits, and mixing would stall the
         # pipeline anyway (same trade as penalties).
-        if any(s.request.sampling.logprobs or s.constraint is not None
-               for s in self.running):
+        if constrained or any(s.request.sampling.logprobs for s in self.running):
             # (constraints additionally need a fresh mask per token)
             if self._inflight is not None:
                 return self._drain_inflight()
@@ -1245,6 +1299,97 @@ class EngineCore:
                 out.append((failed2, self._final_output(failed2)))
         return out
 
+    def _run_decode_overlapped(self) -> list[tuple[Sequence, EngineOutput]]:
+        """Depth-1 overlapped decode at decode_steps == 1 (DYN_OVERLAP).
+
+        The single-step analogue of :meth:`_run_decode_pipelined`: step N+1
+        is dispatched with its input tokens gathered in-graph from step N's
+        device-resident samples, *then* N's tokens are harvested — the host
+        round-trip overlaps the next step's compute. Stops are detected one
+        step late; the in-flight row of a stopped sequence is cancelled at
+        harvest (token discarded, pages already released by ``_finish``), so
+        the emitted stream is bit-identical to the synchronous loop. The
+        chained write lands at position ``num_cached + 1``, which the
+        ``remaining_tokens > 1`` gate keeps strictly below ``position_limit``
+        — no live page is ever written past a finish line. Unlike the fused
+        burst, logprob aux arrays ride the handle, so logprobs requests
+        overlap too.
+        """
+        lp_k = LOGPROBS_TOP_K if any(
+            s.request.sampling.logprobs for s in self.running
+        ) else 0
+        if self._inflight is None:
+            failed = self._ensure_burst_pages(1)
+            if failed is not None:
+                return [(failed, self._final_output(failed))]
+            if not self.running:
+                return []
+            batch = list(self.running)
+            self.runner.reset_chain()
+            try:
+                dev = self.runner.step_async(self._decode_step_batch(batch), lp_k=lp_k)
+            except Exception:
+                for s in batch:
+                    self._finish(s, FinishReason.ERROR)
+                raise
+            self._inflight = (batch, dev, 1)
+            return []  # pipeline fill: outputs arrive next step
+
+        batch, dev, _kprev = self._inflight
+        if not hasattr(dev, "result"):
+            # A fused-burst handle (decode_steps collapsed to 1 near the
+            # finish line): commit it synchronously before overlapping.
+            return self._drain_inflight()
+        same = len(batch) == len(self.running) and all(
+            a is b for a, b in zip(batch, self.running)
+        )
+        if same:
+            # A sequence finishing inside the in-flight step changes the
+            # composition; chaining past it would also write at a position
+            # its remaining-tokens page cap cannot cover.
+            same = all(s.remaining_tokens(self.config.max_seq_len) > 1 for s in batch)
+        dispatched = False
+        if same:
+            # Don't fail the sole sequence yet: the step in flight may hold
+            # its legitimate finish (EOS/length) — commit that first below.
+            failed = self._ensure_burst_pages(2, fail_sole=False)
+            # _ensure_burst_pages may have preempted or failed someone: re-check.
+            same = failed is None and len(batch) == len(self.running) and all(
+                a is b for a, b in zip(batch, self.running)
+            )
+            if same and self.runner.can_chain(len(batch)):
+                try:
+                    dev2 = self.runner.step_async(
+                        self._decode_step_batch(batch, offset=1), lp_k=lp_k, chain=True
+                    )
+                except Exception:
+                    for s in batch:
+                        self._finish(s, FinishReason.ERROR)
+                    raise
+                self._inflight = (batch, dev2, 1)
+                dispatched = True
+                self._overlap_mode = "overlapped"
+        if not dispatched:
+            self._inflight = None
+            self.runner.reset_chain()
+        next_tokens, lp_aux = dev.result()
+        out = self._process_burst_tokens(batch, next_tokens, lp_aux)
+        # A sole sequence that couldn't extend and wasn't finished by the
+        # in-flight step has truly outgrown the cache — fail it now.
+        if not dispatched and self.running:
+            failed2 = self._ensure_burst_pages(1)
+            if failed2 is not None:
+                out.append((failed2, self._final_output(failed2)))
+        return out
+
+    @staticmethod
+    def _fetch_inflight(dev) -> tuple:
+        """Harvest any in-flight handle: ``DeviceStepTokens`` (overlapped
+        single step — carries logprob aux) or ``DeviceTokens`` (fused burst)."""
+        if hasattr(dev, "result"):
+            return dev.result()
+        return dev.fetch(), None
+
     def _drain_inflight(self) -> list[tuple[Sequence, EngineOutput]]:
         """Consume the in-flight burst without dispatching another."""
         if self._inflight is None:
@@ -1253,7 +1398,8 @@ class EngineCore:
         self._inflight = None
         if hasattr(self.runner, "reset_chain"):
             self.runner.reset_chain()
-        return self._process_burst_tokens(batch, dev.fetch())
+        next_tokens, lp_aux = self._fetch_inflight(dev)
+        return self._process_burst_tokens(batch, next_tokens, lp_aux)
 
     # -- shared helpers ----------------------------------------------------
 
@@ -1354,7 +1500,11 @@ class EngineCore:
                 self.pending_offloads = []
                 return
             items, self.pending_offloads = self.pending_offloads, []
-            self.block_manager.offload_batch(items, read_pages=getattr(self.runner, "read_pages", None))
+            self.block_manager.offload_batch(
+                items,
+                read_pages=getattr(self.runner, "read_pages", None),
+                read_pages_async=getattr(self.runner, "read_pages_async", None),
+            )
 
     def abort_all(self, reason: FinishReason = FinishReason.ERROR) -> None:
         """Finish every in-flight sequence (releasing its pages) — used when

@@ -265,6 +265,18 @@ class ModelRunner:
 
         self._step_packed_fn = _step_packed
 
+        @functools.partial(jax.jit, static_argnames=("b", "t", "n", "h", "lp_k"), donate_argnums=(1, 2))
+        def _step_chained(params, k_cache, v_cache, packed, chain_tokens, *, b, t, n, h, lp_k=0):
+            """Chained single decode step: input tokens come from the previous
+            step's device-resident samples instead of the host (the overlapped
+            engine loop dispatches step N+1 before fetching step N's tokens —
+            see step_async)."""
+            args = list(_unpack(packed, b, t, n, h))
+            args[0] = chain_tokens[:, None]  # tokens i32[B, 1]
+            return _step(params, k_cache, v_cache, *args, impl=self.attn_impl, lp_k=lp_k)
+
+        self._step_chained_fn = _step_chained
+
         @functools.partial(jax.jit, static_argnames=("impl", "lp_k"), donate_argnums=(1, 2))
         def _spec_step(params, k_cache, v_cache, tokens, positions, block_tables, slot_mapping,
                        verify_indices, temperature, top_k, top_p, seeds, sample_steps,
@@ -903,6 +915,59 @@ class ModelRunner:
             pass
         return DeviceTokens(toks, b_real)
 
+    @_locked
+    def step_async(self, batch: StepBatch, lp_k: int = 0, *, chain: bool = False) -> "DeviceStepTokens":
+        """Dispatch ONE decode step without blocking on its result.
+
+        The overlapped engine loop (``DYN_OVERLAP=1``) uses this to run a
+        depth-1 pipeline at decode_steps == 1: the sampled tokens stay
+        device-resident (``self._chain_tokens``), so the next step can be
+        dispatched with ``chain=True`` — its input token per row is gathered
+        from that buffer in-graph — before this step's tokens ever reach the
+        host. Returns a :class:`DeviceStepTokens` handle whose ``result()``
+        blocks on the already-started device->host copy.
+
+        Decode-only (T == 1), non-mesh, no multimodal embeds / logit masks
+        (those route through the sync :meth:`step`); ``lp_k`` rides along —
+        the aux logprob arrays are fetched with the tokens.
+        """
+        assert batch.tokens.shape[1] == 1, "step_async is decode-only"
+        assert self.mesh is None, "step_async is single-chip only"
+        b_real = batch.batch_size
+        padded = self._pad(batch)
+        self.last_attn_dispatch = self._attn_dispatch(padded, self.attn_impl)
+        b, t = padded.tokens.shape
+        n = padded.block_tables.shape[1]
+        h = padded.history.shape[1]
+        packed = jnp.asarray(_pack(padded))
+        with timed_dispatch(self.compile_tracker, "step_async", (b, t, n, h, lp_k, chain)):
+            if chain:
+                assert self._chain_tokens is not None and self._chain_tokens.shape[0] == b, (
+                    "chained step requires a previous step with identical padded batch"
+                )
+                out = self._step_chained_fn(
+                    self.params, self.k_cache, self.v_cache, packed, self._chain_tokens,
+                    b=b, t=t, n=n, h=h, lp_k=lp_k,
+                )
+            else:
+                out = self._step_packed_fn(
+                    self.params, self.k_cache, self.v_cache, packed,
+                    b=b, t=t, n=n, h=h, lp_k=lp_k,
+                )
+        if lp_k:
+            toks, self.k_cache, self.v_cache, chosen, top_ids, top_lps = out
+            aux = (chosen, top_ids, top_lps)
+        else:
+            toks, self.k_cache, self.v_cache = out
+            aux = None
+        self._chain_tokens = toks
+        for buf in (toks, *(aux or ())):
+            try:  # start the device->host DMA early; overlaps the next step
+                buf.copy_to_host_async()
+            except Exception:
+                pass
+        return DeviceStepTokens(toks, aux, b_real)
+
     def embed(self, token_lists: list[list[int]]) -> np.ndarray:
         """Sentence embeddings for N token sequences; returns f32[N, D].
 
@@ -970,3 +1035,28 @@ class DeviceTokens:
     def fetch(self) -> np.ndarray:
         """Block until the tokens are on host; returns i32[B_real, num_steps]."""
         return np.asarray(self._toks).T[: self._b_real]
+
+
+class DeviceStepTokens:
+    """Handle to a single dispatched decode step's sampled tokens (and
+    optional logprob aux arrays), device-resident (``ModelRunner.step_async``).
+
+    Distinguished from :class:`DeviceTokens` by exposing ``result()`` instead
+    of ``fetch()`` — the engine's harvest helper dispatches on that."""
+
+    def __init__(self, toks: jax.Array, aux, b_real: int) -> None:
+        self._toks = toks
+        self._aux = aux  # (chosen, top_ids, top_lps) or None
+        self._b_real = b_real
+
+    def result(self) -> tuple[np.ndarray, dict | None]:
+        """Block until on host; returns (tokens i32[B_real, 1], lp_aux|None)."""
+        toks = np.asarray(self._toks)[: self._b_real, None]
+        if self._aux is None:
+            return toks, None
+        chosen, top_ids, top_lps = self._aux
+        return toks, {
+            "logprob": np.asarray(chosen)[: self._b_real],
+            "top_ids": np.asarray(top_ids)[: self._b_real],
+            "top_lps": np.asarray(top_lps)[: self._b_real],
+        }

@@ -151,6 +151,10 @@ class WorkerSpec:
                 or os.environ.get("DYN_WORKER_SPEC_K", "0")
             ),
             slo_sched=env_flag(os.environ, "DYN_SLO_SCHED"),
+            overlap=(
+                env_flag(os.environ, "DYN_OVERLAP")
+                or env_flag(os.environ, "DYN_WORKER_OVERLAP")
+            ),
         )
         defaults.update(engine_kw)
         return EngineConfig(**defaults)
@@ -1006,6 +1010,12 @@ def main(argv: list[str] | None = None) -> None:
         help="KV-cache storage dtype; fp8 halves KV HBM (attention upcasts "
         "at the matmul)",
     )
+    parser.add_argument(
+        "--overlap", action="store_true", default=ws.overlap,
+        help="overlapped execution: depth-1 decode pipeline with device-"
+        "resident token feedback (DYN_OVERLAP); output streams stay "
+        "bit-identical to off",
+    )
     parser.add_argument("--num-nodes", type=int, default=1, help="hosts forming one worker's mesh")
     parser.add_argument("--node-rank", type=int, default=0)
     parser.add_argument(
@@ -1046,6 +1056,10 @@ def main(argv: list[str] | None = None) -> None:
         import os
 
         os.environ["DYN_WORKER_KV_CACHE_DTYPE"] = args.kv_cache_dtype
+    if args.overlap:
+        import os
+
+        os.environ["DYN_WORKER_OVERLAP"] = "1"
     asyncio.run(_amain(args))
 
 

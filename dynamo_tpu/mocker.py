@@ -38,6 +38,7 @@ class MockRunner:
         decode_us_per_seq: float = 100.0,
         seed: int = 0,
         realtime: bool = True,
+        d2h_us: float = 0.0,
     ) -> None:
         self.num_pages = num_pages
         self.page_size = page_size
@@ -47,7 +48,17 @@ class MockRunner:
         self.decode_us_per_seq = decode_us_per_seq
         self.seed = seed
         self.realtime = realtime
+        # Device->host result-transfer latency per step: the synchronous loop
+        # pays it inline (step() blocks on compute + copy); the overlapped
+        # loop (step_async) pays it only at harvest, where it hides under the
+        # next step's compute. 0 keeps legacy timing for existing tests.
+        self.d2h_us = d2h_us
         self.simulated_us = 0.0
+        # Device-busy accounting for the overlap bench probe: cumulative
+        # compute time vs. wall elapsed gives device_idle_frac.
+        self.busy_us = 0.0
+        self._busy_until = 0.0  # wall timestamp the simulated device frees up
+        self._chain_host: np.ndarray | None = None  # last step_async samples
         self._layers, self._kv, self._hd = 1, 1, 8  # page payload shape stub
 
     def _sleep_us(self, us: float) -> None:
@@ -61,28 +72,68 @@ class MockRunner:
             np.int32
         )
 
+    def _lp_aux(self, toks: np.ndarray, lp_k: int) -> dict:
+        # Synthetic but schema-complete logprobs (mock fleets exercise
+        # the full API surface): chosen "probability" 0.5, alternatives
+        # decaying deterministically.
+        b = toks.shape[0]
+        lps = np.full(b, np.log(0.5), np.float32)
+        top_ids = (toks[:, None] + np.arange(lp_k)[None, :]) % self.vocab_size
+        top_lps = np.log(0.5) - 0.5 * np.arange(1, lp_k + 1, dtype=np.float32)
+        top_lps = np.broadcast_to(top_lps, (b, lp_k)).copy()
+        top_lps[:, 0] = np.log(0.5)
+        top_ids[:, 0] = toks
+        return {"logprob": lps, "top_ids": top_ids.astype(np.int32), "top_lps": top_lps}
+
     def step(self, batch: StepBatch, lp_k: int = 0):
         b, t = batch.tokens.shape
         if t > 1:  # prefill
             new_tokens = int((batch.last_token_index + 1).sum())
+            self.busy_us += self.prefill_us_per_token * new_tokens
             self._sleep_us(self.prefill_us_per_token * new_tokens)
         else:
-            self._sleep_us(self.decode_us_base + self.decode_us_per_seq * b)
+            compute = self.decode_us_base + self.decode_us_per_seq * b
+            self.busy_us += compute
+            # The synchronous loop blocks on compute AND the result copy.
+            self._sleep_us(compute + self.d2h_us)
         last_tok = batch.tokens[np.arange(b), batch.last_token_index]
         last_pos = batch.positions[np.arange(b), batch.last_token_index]
         toks = self._tokens_for(last_pos, last_tok)
         if lp_k:
-            # Synthetic but schema-complete logprobs (mock fleets exercise
-            # the full API surface): chosen "probability" 0.5, alternatives
-            # decaying deterministically.
-            lps = np.full(b, np.log(0.5), np.float32)
-            top_ids = (toks[:, None] + np.arange(lp_k)[None, :]) % self.vocab_size
-            top_lps = np.log(0.5) - 0.5 * np.arange(1, lp_k + 1, dtype=np.float32)
-            top_lps = np.broadcast_to(top_lps, (b, lp_k)).copy()
-            top_lps[:, 0] = np.log(0.5)
-            top_ids[:, 0] = toks
-            return toks, {"logprob": lps, "top_ids": top_ids.astype(np.int32), "top_lps": top_lps}
+            return toks, self._lp_aux(toks, lp_k)
         return toks
+
+    def step_async(self, batch: StepBatch, lp_k: int = 0, *, chain: bool = False):
+        """Mock of ModelRunner.step_async: returns a handle whose ``result()``
+        blocks until the simulated device finishes this step's compute plus
+        the d2h copy. Dispatch itself never blocks — consecutive chained
+        dispatches queue on ``_busy_until``, so wall time per token in the
+        overlapped loop is ~max(compute, d2h) instead of compute + d2h."""
+        b = batch.tokens.shape[0]
+        compute = self.decode_us_base + self.decode_us_per_seq * b
+        self.busy_us += compute
+        self.simulated_us += compute + self.d2h_us
+        now = time.monotonic()
+        start = max(now, self._busy_until)
+        self._busy_until = start + compute / 1e6
+        ready_at = self._busy_until + self.d2h_us / 1e6
+        if chain:
+            assert self._chain_host is not None and self._chain_host.shape[0] == b, (
+                "chained step requires a previous step with identical batch"
+            )
+            tok = self._chain_host
+        else:
+            tok = batch.tokens[:, 0]
+        toks = self._tokens_for(batch.positions[:, 0], tok)
+        self._chain_host = toks
+        aux = self._lp_aux(toks, lp_k) if lp_k else None
+        return MockStepTokens(self, toks, aux, ready_at)
+
+    def can_chain(self, batch_size: int) -> bool:
+        return self._chain_host is not None and self._chain_host.shape[0] == batch_size
+
+    def reset_chain(self) -> None:
+        self._chain_host = None
 
     def multi_step(self, batch: StepBatch, num_steps: int) -> np.ndarray:
         b = batch.tokens.shape[0]
@@ -112,6 +163,23 @@ class MockRunner:
 
     def cache_memory_bytes(self) -> int:
         return 0
+
+
+class MockStepTokens:
+    """Handle to a MockRunner.step_async dispatch (mirrors DeviceStepTokens)."""
+
+    def __init__(self, runner: MockRunner, toks: np.ndarray, aux, ready_at: float) -> None:
+        self._runner = runner
+        self._toks = toks
+        self._aux = aux
+        self._ready_at = ready_at
+
+    def result(self):
+        if self._runner.realtime:
+            wait = self._ready_at - time.monotonic()
+            if wait > 0:
+                time.sleep(wait)
+        return self._toks[:, None], self._aux
 
 
 def build_mock_core(

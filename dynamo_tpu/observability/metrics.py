@@ -136,6 +136,28 @@ class EngineMetrics:
             "dispatch path (pallas kernel, reference fallback, ring)",
             ["worker", "phase", "path"], registry=self.registry,
         )
+        # Overlapped execution (DYN_OVERLAP): device-idle observability.
+        # gap_ms is the host window between a step returning and the next
+        # dispatch — the time the overlapped loop exists to hide.
+        self.step_gap_ms_last = gauge(
+            f"{ns}_step_gap_ms",
+            "Host gap (ms) between the previous engine step completing and "
+            "the latest step's dispatch (detok/stop/schedule time the device "
+            "sits idle unless the overlapped loop hides it)",
+        )
+        self.step_gap_ms_mean = gauge(
+            f"{ns}_step_gap_ms_mean",
+            "Mean host gap (ms) between consecutive engine steps (cumulative)",
+        )
+        self._overlap_steps = Gauge(
+            "dynamo_engine_overlap_steps_total",
+            "Engine steps by overlapped-execution mode while DYN_OVERLAP is "
+            "armed: 'overlapped' = a chained lookahead step was dispatched "
+            "before harvesting the previous one, 'barrier' = the step fell "
+            "back to the synchronous path (composition change, fill, spec, "
+            "constraints, penalties)",
+            ["worker", "mode"], registry=self.registry,
+        )
         self.prefill_queue_depth = gauge(
             f"{ns}_prefill_queue_depth", "Unclaimed tasks in the distributed prefill queue"
         )
@@ -274,6 +296,16 @@ class EngineMetrics:
             self._attn_dispatch.clear()
             for (phase, path), n in dispatch.items():
                 self._attn_dispatch.labels(self.worker, phase, path).set(n)
+        self.step_gap_ms_last.set(getattr(core, "step_gap_ms_last", 0.0))
+        gap_n = getattr(core, "step_gap_ms_count", 0)
+        self.step_gap_ms_mean.set(
+            getattr(core, "step_gap_ms_sum", 0.0) / gap_n if gap_n else 0.0
+        )
+        overlap_counts = getattr(core, "overlap_step_counts", None)
+        if overlap_counts is not None:
+            self._overlap_steps.clear()
+            for mode, n in overlap_counts.items():
+                self._overlap_steps.labels(self.worker, mode).set(n)
 
     def _sync_transfer(self) -> None:
         if self._transfer is None:

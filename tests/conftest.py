@@ -89,6 +89,33 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
         )
 
 
+# Every jit-compiled executable maps JIT code pages that stay mapped for
+# the life of the LoadedExecutable. Across the full suite that accumulates
+# to ~65k VMAs and trips vm.max_map_count, at which point XLA's next mmap
+# fails and executable deserialization segfaults. Drop the accumulated
+# executables between modules once the map count gets close; the persistent
+# on-disk compile cache makes the re-loads cheap (deserialize, not compile).
+_MAP_COUNT_CLEAR_THRESHOLD = 40_000
+
+
+def _vma_count() -> int:
+    try:
+        with open("/proc/self/maps", "rb") as f:
+            return sum(1 for _ in f)
+    except OSError:  # non-Linux: no /proc, no max_map_count to trip
+        return 0
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _bound_jit_executable_maps():
+    yield
+    if _vma_count() > _MAP_COUNT_CLEAR_THRESHOLD:
+        import gc
+
+        jax.clear_caches()
+        gc.collect()
+
+
 def pytest_pyfunc_call(pyfuncitem):
     """Run ``async def`` tests with asyncio (no pytest-asyncio in this image)."""
     fn = pyfuncitem.obj

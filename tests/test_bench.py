@@ -183,6 +183,36 @@ def test_slo_sched_probe_structure(monkeypatch):
     assert out["slo_sched_ttft_p99_ms"] == out["slo_sched"]["light_ttft_p99_ms"]
 
 
+def test_overlap_probe_structure(monkeypatch):
+    """probe_engine_overlap's contract (ISSUE 10): the same decode-heavy
+    scenario under the synchronous loop and under the depth-1 overlapped
+    pipeline, bit-identical streams, and the two headline numbers. Sized
+    down, but with d2h latency comparable to compute so hiding it is
+    decisive even on a loaded CI host."""
+    import bench
+
+    monkeypatch.setenv("BENCH_OVERLAP_DECODERS", "2")
+    monkeypatch.setenv("BENCH_OVERLAP_ISL", "16")
+    monkeypatch.setenv("BENCH_OVERLAP_OSL", "24")
+    monkeypatch.setenv("BENCH_OVERLAP_DECODE_US", "1500")
+    monkeypatch.setenv("BENCH_OVERLAP_D2H_US", "1200")
+    out = bench.probe_engine_overlap()
+    assert out["decoders"] == 2 and out["osl"] == 24
+    for mode in ("sync", "overlap"):
+        run = out[mode]
+        for key in ("mode", "elapsed_s", "itl_mean_ms", "device_idle_frac",
+                    "overlap_steps", "mean_gap_ms"):
+            assert key in run, f"{mode} missing {key}"
+    assert out["sync"]["mode"] == "sync"
+    assert out["sync"]["overlap_steps"] == {"overlapped": 0, "barrier": 0}
+    assert out["overlap"]["overlap_steps"]["overlapped"] > 0
+    # The acceptance bar: same tokens, device idles strictly less, ITL gain.
+    assert out["bit_identical"] is True
+    assert out["overlap"]["device_idle_frac"] < out["sync"]["device_idle_frac"]
+    assert out["device_idle_frac"] == out["overlap"]["device_idle_frac"]
+    assert out["engine_overlap_itl_gain"] > 1.0
+
+
 def test_bench_doc_goodput_keys():
     """build_doc's top-level contract (ISSUE 4): the SLO-conditioned goodput
     headline keys are stable, sourced from the headline (llama-3.2-1b)
@@ -226,6 +256,14 @@ def test_bench_doc_goodput_keys():
     assert doc5["slo_sched_goodput_gain"] == 5.4869
     assert doc5["slo_sched_ttft_p99_ms"] == 105.31
     assert doc5["detail"]["slo_sched_probe"] == ss
+    assert doc5["engine_overlap_itl_gain"] == 0.0  # probe absent: stable default
+    # Overlapped-execution headline keys (ISSUE 10) surface from the probe.
+    ov = {"engine_overlap_itl_gain": 1.7523, "device_idle_frac": 0.0508,
+          "bit_identical": True}
+    doc6 = bench.build_doc(configs, pull={}, overlap=ov)
+    assert doc6["engine_overlap_itl_gain"] == 1.7523
+    assert doc6["device_idle_frac"] == 0.0508
+    assert doc6["detail"]["engine_overlap_probe"] == ov
     # An all-errors suite still emits the full key set.
     empty = bench.build_doc([{"preset": "x", "error": "boom"}], pull={})
     for key in ("value", "goodput_tokens_per_s_at_slo", "slo_ttft_attainment",
@@ -233,7 +271,8 @@ def test_bench_doc_goodput_keys():
                 "spec_decode_speedup", "decode_kernel_gbps",
                 "decode_roofline_frac", "kv_wire_gbps",
                 "kv_wire_overlap_frac", "slo_sched_goodput_gain",
-                "slo_sched_ttft_p99_ms"):
+                "slo_sched_ttft_p99_ms", "engine_overlap_itl_gain",
+                "device_idle_frac"):
         assert key in empty
         assert empty[key] == 0.0
 

@@ -135,6 +135,10 @@ class _FakeCore:
     spec_tokens_proposed = 20
     spec_tokens_accepted = 9
     attn_dispatch_counts = {("decode", "pallas"): 5, ("verify", "fallback"): 1}
+    step_gap_ms_last = 0.75
+    step_gap_ms_sum = 10.0
+    step_gap_ms_count = 8
+    overlap_step_counts = {"overlapped": 6, "barrier": 2}
     waiting = ["a"]
     running = ["b", "c"]
     prefilling = ["d"]
@@ -196,6 +200,9 @@ EXPECTED_ENGINE_FAMILIES = {
     "dynamo_kv_wire_path_bytes_total",
     "dynamo_kv_wire_path_transfers_total",
     "dynamo_engine_prefill_requeues_total",
+    "dynamo_engine_step_gap_ms",
+    "dynamo_engine_step_gap_ms_mean",
+    "dynamo_engine_overlap_steps_total",
     "dynamo_engine_admission_queue_depth",
     "dynamo_engine_deadline_misses_total",
     "dynamo_tenant_throttled_total",
@@ -239,6 +246,10 @@ async def test_engine_metrics_names_labels_and_values():
     assert 'dynamo_engine_admission_rejections_total{worker="w1"} 4.0' in text
     assert 'dynamo_engine_spec_tokens_proposed_total{worker="w1"} 20.0' in text
     assert 'dynamo_engine_spec_tokens_accepted_total{worker="w1"} 9.0' in text
+    assert 'dynamo_engine_step_gap_ms{worker="w1"} 0.75' in text
+    assert 'dynamo_engine_step_gap_ms_mean{worker="w1"} 1.25' in text
+    assert 'dynamo_engine_overlap_steps_total{mode="overlapped",worker="w1"} 6.0' in text
+    assert 'dynamo_engine_overlap_steps_total{mode="barrier",worker="w1"} 2.0' in text
     assert 'dynamo_engine_pages_active{worker="w1"} 40.0' in text
     assert 'dynamo_engine_page_utilization_ratio{worker="w1"} 0.625' in text
     # fragmentation = cached / (free + cached) = 8 / 24
@@ -293,6 +304,23 @@ def test_metric_names_unique_and_prefixed():
     families = check_metric_names.collect_families()
     assert check_metric_names.check_families(families) == []
     assert all(f["help"] for fams in families.values() for f in fams)
+
+
+def test_env_knobs_documented():
+    """Invokes the tools/ env-knob gate (ISSUE 10 satellite: every DYN_*
+    knob the source reads appears in a docs env table, and every documented
+    knob still exists)."""
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "tools"))
+    try:
+        import check_env_knobs
+    finally:
+        sys.path.pop(0)
+    source, prefixes = check_env_knobs.source_knobs()
+    generated = check_env_knobs.generated_knobs()
+    documented = check_env_knobs.documented_knobs()
+    assert "DYN_OVERLAP" in source and "DYN_WORKER_OVERLAP" in generated
+    assert len(source | generated) > 40
+    assert check_env_knobs.check(source, generated, prefixes, documented) == []
 
 
 # -- timeline assembly --------------------------------------------------------

@@ -1,0 +1,435 @@
+"""Overlapped execution pipeline (ISSUE 10).
+
+The contract under test: with ``overlap=True`` (DYN_OVERLAP) the engine
+emits *bit-identical* token streams AND logprobs to ``overlap=False`` —
+greedy and seeded, with chunked prefill interleaving, across late-detected
+stops — because the depth-1 pipeline only changes WHEN tokens cross the
+device->host boundary, never what was sampled: the chained step's input
+tokens are the same values the host would have shipped, its rng fold
+counter advances exactly as the synchronous loop's would, and a stop
+detected one step late cancels the in-flight row (token discarded, pages
+released) instead of emitting it. Also covered: spec_k>0 barrier fallback,
+the offload-batch async gather routing, and the launch-side DYN_OVERLAP
+resolution.
+"""
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine.core import EngineConfig, EngineCore
+from dynamo_tpu.engine.runner import ModelRunner
+from dynamo_tpu.models import llama
+from dynamo_tpu.models.config import PRESETS
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+
+PAGE = 4
+_PARAMS = {}
+_RUNNERS = {}
+
+
+def params_for(preset):
+    if preset not in _PARAMS:
+        _PARAMS[preset] = llama.init_params(PRESETS[preset], 0)
+    return _PARAMS[preset]
+
+
+def make_core(preset="test-tiny", *, overlap=False, chunk=16, num_pages=96,
+              max_batch=8, max_seq_len=256, eos=(), **cfg_kw):
+    # One runner per preset, shared across tests and across the sync/overlap
+    # runs of each parity pair: the jit caches live on the runner, so every
+    # graph compiles once per preset for the whole module — and the parity
+    # runs exercising the SAME compiled graphs is exactly the claim under
+    # test (overlap changes when results move, not what is computed). A
+    # fresh EngineCore re-owns the page pool; stale KV in recycled pages is
+    # rewritten by prefill before anything attends to it.
+    if preset not in _RUNNERS:
+        _RUNNERS[preset] = ModelRunner(
+            PRESETS[preset], params_for(preset), num_pages=num_pages,
+            page_size=PAGE, max_batch_size=max_batch, prefill_bucket=16,
+            attn_impl="reference",
+        )
+    return EngineCore(_RUNNERS[preset], EngineConfig(
+        num_pages=num_pages, page_size=PAGE, max_batch_size=max_batch,
+        max_seq_len=max_seq_len, chunk_prefill_tokens=chunk, overlap=overlap,
+        eos_token_ids=tuple(eos), **cfg_kw,
+    ))
+
+
+def run_all(core, reqs, max_steps=400):
+    """Drive to completion; returns ({seq_id: tokens}, {seq_id: logprobs})."""
+    tokens, lps = {}, {}
+    for req in reqs:
+        seq = core.add_request(req)
+        tokens[seq.seq_id] = []
+        lps[seq.seq_id] = []
+    steps = 0
+    while core.has_work and steps < max_steps:
+        for seq, out in core.step():
+            tokens[seq.seq_id].extend(out.token_ids)
+            if out.logprobs:
+                lps[seq.seq_id].extend(out.logprobs)
+        steps += 1
+    assert not core.has_work, "engine did not drain"
+    return tokens, lps
+
+
+def _requests(vocab):
+    """Greedy + seeded + logprobs + chunked prefill riding the same engine."""
+    return [
+        PreprocessedRequest(
+            token_ids=[5, 7, 5, 7, 5, 7, 9, 11],
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=14, ignore_eos=True),
+        ),
+        # Long prompt: its chunked prefill forces pipeline barriers while
+        # the first request decodes — the overlap path must re-fill after.
+        PreprocessedRequest(
+            token_ids=[i % (vocab - 2) + 1 for i in range(26)],
+            sampling=SamplingOptions(temperature=0.8, seed=42, logprobs=3),
+            stop=StopConditions(max_tokens=10, ignore_eos=True),
+        ),
+        PreprocessedRequest(
+            token_ids=[3, 3, 3, 3, 2, 1],
+            sampling=SamplingOptions(temperature=0.7, seed=7),
+            stop=StopConditions(max_tokens=10, ignore_eos=True),
+        ),
+    ]
+
+
+# -- bit parity --------------------------------------------------------------
+
+
+@pytest.mark.parametrize("preset", ["test-tiny", "test-tiny-mla"])
+def test_overlap_is_bit_identical(preset):
+    vocab = PRESETS[preset].vocab_size
+    base_tok, base_lp = run_all(make_core(preset), _requests(vocab))
+    core = make_core(preset, overlap=True)
+    over_tok, over_lp = run_all(core, _requests(vocab))
+    assert over_tok == base_tok
+    assert over_lp == base_lp
+    assert core.overlap_step_counts["overlapped"] > 0  # the path engaged
+    assert core.allocator.stats().active_pages == 0
+
+
+def test_overlap_bit_identical_with_staggered_admission():
+    """A request admitted mid-decode forces a drain barrier; the re-filled
+    pipeline must keep every stream bit-identical."""
+    vocab = PRESETS["test-tiny"].vocab_size
+
+    def run(overlap):
+        core = make_core(overlap=overlap)
+        reqs = _requests(vocab)
+        tokens = {}
+        for req in reqs[:2]:
+            seq = core.add_request(req)
+            tokens[seq.seq_id] = []
+        late_added = False
+        steps = 0
+        while core.has_work and steps < 400:
+            if steps == 6 and not late_added:
+                seq = core.add_request(reqs[2])
+                tokens[seq.seq_id] = []
+                late_added = True
+            for seq, out in core.step():
+                tokens[seq.seq_id].extend(out.token_ids)
+            steps += 1
+        assert not core.has_work
+        return tokens, core
+
+    base, _ = run(False)
+    over, core = run(True)
+    assert over == base
+    assert core.overlap_step_counts["overlapped"] > 0
+    assert core.allocator.stats().active_pages == 0
+
+
+# -- late-stop cancellation --------------------------------------------------
+
+
+_STREAM_CACHE = {}
+
+
+def _greedy_stream(preset="test-tiny", n=16):
+    """The model's deterministic greedy continuation of a fixed prompt."""
+    if (preset, n) not in _STREAM_CACHE:
+        toks, _ = run_all(make_core(preset), [PreprocessedRequest(
+            token_ids=[5, 7, 5, 7, 9, 11],
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=n, ignore_eos=True),
+        )])
+        _STREAM_CACHE[(preset, n)] = toks[0]
+    return _STREAM_CACHE[(preset, n)]
+
+
+def test_late_stop_cancels_inflight_row_no_leak_no_overrun():
+    """A stop token detected one step behind the pipeline: the in-flight
+    chained step has already computed the over-run token — it must never be
+    emitted, and the rollback must release every page."""
+    stream = _greedy_stream()
+    # First token whose FIRST occurrence is a few steps in: the pipeline has
+    # chained by then, so the stop is detected with a step in flight.
+    stop_tok = next(t for i, t in enumerate(stream) if stream.index(t) == i and i >= 4)
+    req = lambda: PreprocessedRequest(  # noqa: E731
+        token_ids=[5, 7, 5, 7, 9, 11],
+        sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=16, ignore_eos=True,
+                            stop_token_ids=[stop_tok]),
+    )
+    base_tok, _ = run_all(make_core(), [req()])
+    core = make_core(overlap=True)
+    over_tok, _ = run_all(core, [req()])
+    assert over_tok == base_tok
+    assert over_tok[0][-1] == stop_tok
+    expected = stream[: stream.index(stop_tok) + 1]
+    assert over_tok[0] == expected  # never the over-run token
+    assert core.overlap_step_counts["overlapped"] > 0
+    assert core.allocator.stats().active_pages == 0  # rollback leaked nothing
+
+
+def test_late_eos_stop_parity_and_page_accounting():
+    """Same cancellation via the EOS path, with other sequences surviving
+    the barrier: their streams must continue bit-identically after the
+    stopped row's rollback (rng-fold continuity across the drain)."""
+    stream = _greedy_stream()
+    eos = next(t for i, t in enumerate(stream) if stream.index(t) == i and i >= 3)
+    eos_at = stream.index(eos)
+    reqs = lambda: [  # noqa: E731
+        PreprocessedRequest(
+            token_ids=[5, 7, 5, 7, 9, 11],
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=20),
+        ),
+        PreprocessedRequest(
+            token_ids=[3, 3, 3, 3, 2, 1],
+            sampling=SamplingOptions(temperature=0.7, seed=7),
+            stop=StopConditions(max_tokens=16, ignore_eos=True),
+        ),
+    ]
+    base_tok, _ = run_all(make_core(eos=[eos]), reqs())
+    core = make_core(overlap=True, eos=[eos])
+    over_tok, _ = run_all(core, reqs())
+    assert over_tok == base_tok
+    assert over_tok[0][-1] == eos and len(over_tok[0]) == eos_at + 1
+    assert len(over_tok[1]) == 16  # survivor ran to its own limit
+    assert core.allocator.stats().active_pages == 0
+
+
+# -- rng-fold discipline -----------------------------------------------------
+
+
+def test_chained_dispatch_fold_counter_matches_sync(monkeypatch):
+    """The chained step dispatches with ``sample_steps + 1`` — exactly the
+    fold counter the synchronous loop would use after harvesting the
+    in-flight token. Fold advances once per emitted token, never per
+    dispatch."""
+    core = make_core(overlap=True, chunk=0)
+    calls = []
+    orig = core.runner.step_async
+
+    def spy(batch, lp_k=0, *, chain=False):
+        calls.append((bool(chain), int(np.asarray(batch.sample_steps)[0])))
+        return orig(batch, lp_k=lp_k, chain=chain)
+
+    monkeypatch.setattr(core.runner, "step_async", spy)
+    seq = core.add_request(PreprocessedRequest(
+        token_ids=[1, 2, 3, 4],
+        sampling=SamplingOptions(temperature=0.9, seed=11),
+        stop=StopConditions(max_tokens=12, ignore_eos=True),
+    ))
+    emitted = 0
+    steps = 0
+    while core.has_work and steps < 100:
+        before = len(calls)
+        outs = core.step()
+        for chained, fold in calls[before:]:
+            # Non-chained dispatch samples token number `emitted`; a chained
+            # one samples token `emitted + 1` (the in-flight token between
+            # them is harvested only afterwards).
+            assert fold == emitted + (1 if chained else 0)
+        emitted += sum(len(o.token_ids) for _, o in outs)
+        steps += 1
+    assert emitted == 12
+    assert seq.num_generated == 12
+    assert any(chained for chained, _ in calls)  # the pipeline actually chained
+
+
+# -- composition barriers ----------------------------------------------------
+
+
+def test_spec_k_takes_barrier_priority_over_overlap():
+    """overlap + spec_k: the verify dispatch supersedes the overlapped loop
+    (drafts already amortize the round trip) — streams stay bit-identical
+    to the plain baseline and no chained step is ever dispatched."""
+    reqs = lambda: [PreprocessedRequest(  # noqa: E731 - periodic prompt drafts well
+        token_ids=[5, 7, 5, 7, 5, 7, 9, 11],
+        sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=12, ignore_eos=True),
+    )]
+    base_tok, _ = run_all(make_core(), reqs())
+    core = make_core(overlap=True, spec_k=3)
+    spec_tok, _ = run_all(core, reqs())
+    assert spec_tok == base_tok
+    assert core.spec_tokens_proposed > 0  # speculation engaged
+    assert core.overlap_step_counts["overlapped"] == 0  # overlap stood down
+
+
+def test_penalized_sampling_barriers():
+    """Repetition penalties need fresh host history per step: those batches
+    must take the synchronous path, bit-identically."""
+    req = lambda: PreprocessedRequest(  # noqa: E731
+        token_ids=[5, 7, 5, 7, 9, 11],
+        sampling=SamplingOptions(temperature=0.8, seed=3, frequency_penalty=0.5),
+        stop=StopConditions(max_tokens=12, ignore_eos=True),
+    )
+    base_tok, _ = run_all(make_core(), [req()])
+    core = make_core(overlap=True)
+    over_tok, _ = run_all(core, [req()])
+    assert over_tok == base_tok
+    assert core.overlap_step_counts["overlapped"] == 0
+
+
+def test_overlap_off_never_touches_async_path(monkeypatch):
+    """DYN_OVERLAP=0 must be bit-identical to today's loop structurally:
+    step_async is never called."""
+    core = make_core(overlap=False)
+
+    def boom(*a, **k):
+        raise AssertionError("step_async called with overlap off")
+
+    monkeypatch.setattr(core.runner, "step_async", boom)
+    toks, _ = run_all(core, [PreprocessedRequest(
+        token_ids=[5, 7, 5, 7, 9, 11],
+        sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=6, ignore_eos=True),
+    )])
+    assert len(toks[next(iter(toks))]) == 6
+
+
+# -- mock runner parity (the bench probe's engine) ---------------------------
+
+
+def test_mock_runner_overlap_parity():
+    from dynamo_tpu.mocker import MockRunner
+
+    def run(overlap):
+        runner = MockRunner(num_pages=128, page_size=16, realtime=False, d2h_us=500.0)
+        core = EngineCore(runner, EngineConfig(
+            num_pages=128, page_size=16, max_batch_size=8, max_seq_len=512,
+            chunk_prefill_tokens=64, overlap=overlap, enable_prefix_caching=False,
+        ))
+        reqs = [
+            PreprocessedRequest(
+                token_ids=list(range(1, 33)),
+                sampling=SamplingOptions(temperature=0.0),
+                stop=StopConditions(max_tokens=24, ignore_eos=True),
+            )
+            for _ in range(3)
+        ]
+        tokens, _ = run_all(core, reqs)
+        return tokens, core
+
+    base, _ = run(False)
+    over, core = run(True)
+    assert over == base
+    assert core.overlap_step_counts["overlapped"] > 0
+    assert core.allocator.stats().active_pages == 0
+
+
+# -- offload batching (satellite) --------------------------------------------
+
+
+def test_offload_batch_prefers_async_gather():
+    """KvBlockManager.offload_batch routes through read_pages_async when
+    provided: one dispatched gather per batch, waited only at the tier puts."""
+    from dynamo_tpu.blocks.manager import BlockManagerConfig, KvBlockManager
+
+    reads = {"async_batches": [], "sync_batches": [], "per_page": 0}
+
+    class Handle:
+        def __init__(self, pages):
+            self._pages = pages
+
+        def wait(self):
+            return [(np.zeros((1, 4, 8), np.float32),) * 2 for _ in self._pages]
+
+    def read_pages_async(pages):
+        reads["async_batches"].append(list(pages))
+        return Handle(pages)
+
+    def read_pages(pages):
+        reads["sync_batches"].append(list(pages))
+        return Handle(pages).wait()
+
+    def read_page(pid):
+        reads["per_page"] += 1
+        return np.zeros((1, 4, 8), np.float32), np.zeros((1, 4, 8), np.float32)
+
+    mgr = KvBlockManager(
+        BlockManagerConfig(g2_capacity_blocks=16, null_storage=True),
+        read_page=read_page, write_page=lambda *a: None,
+    )
+    mgr.offload_batch(
+        [(100, 1), (101, 2), (102, 3), (100, 1)],  # one dup
+        read_pages=read_pages, read_pages_async=read_pages_async,
+    )
+    assert reads["async_batches"] == [[1, 2, 3]]  # one batched gather, deduped
+    assert reads["sync_batches"] == [] and reads["per_page"] == 0
+    assert mgr.offloaded == 3
+
+
+def test_core_flush_offloads_uses_runner_async_gather(monkeypatch):
+    """The engine's flush routes deferred offloads through the runner's
+    batched async gather — one dispatch per flush, not one per page."""
+    core = make_core()
+    calls = []
+    orig = core.runner.read_pages_async
+
+    def spy(pages):
+        calls.append(list(pages))
+        return orig(pages)
+
+    monkeypatch.setattr(core.runner, "read_pages_async", spy)
+    from dynamo_tpu.blocks.manager import BlockManagerConfig, KvBlockManager
+
+    core.block_manager = KvBlockManager(
+        BlockManagerConfig(g2_capacity_blocks=64, null_storage=True),
+        read_page=core.runner.read_page, write_page=core.runner.write_page,
+    )
+    run_all(core, [PreprocessedRequest(
+        token_ids=list(range(1, 18)),
+        sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=8, ignore_eos=True),
+    )])
+    assert calls, "flush_offloads never used the async gather"
+    assert core.block_manager.offloaded == sum(len(c) for c in calls)
+
+
+# -- launch / config resolution ----------------------------------------------
+
+
+def test_launch_resolves_dyn_overlap(monkeypatch):
+    from dynamo_tpu.launch import WorkerSpec
+    from dynamo_tpu.model_card import ModelDeploymentCard
+
+    card = ModelDeploymentCard(
+        name="test-tiny", context_length=256, kv_page_size=PAGE, eos_token_ids=[2],
+    )
+    monkeypatch.delenv("DYN_OVERLAP", raising=False)
+    monkeypatch.delenv("DYN_WORKER_OVERLAP", raising=False)
+    assert WorkerSpec._engine_cfg(card, {}).overlap is False
+    monkeypatch.setenv("DYN_OVERLAP", "1")
+    assert WorkerSpec._engine_cfg(card, {}).overlap is True
+    monkeypatch.delenv("DYN_OVERLAP")
+    monkeypatch.setenv("DYN_WORKER_OVERLAP", "true")
+    assert WorkerSpec._engine_cfg(card, {}).overlap is True
+
+
+def test_worker_settings_overlap_field(monkeypatch):
+    from dynamo_tpu.config import load_worker_settings
+
+    assert load_worker_settings(env={}).overlap is False
+    assert load_worker_settings(env={"DYN_WORKER_OVERLAP": "1"}).overlap is True
