@@ -921,9 +921,74 @@ def probe_engine_overlap() -> dict:
             ) if core.step_gap_ms_count else 0.0,
         }, tokens
 
+    # Mixed-traffic variant (ISSUE 11): staggered admission + chunked
+    # prefill at ISL-3000 scale — the workload where PR 10's pipeline
+    # barriered on nearly every step. The chained mixed path must keep the
+    # pipeline hot (overlap_chained_frac is the fraction of armed steps
+    # that dispatched a chained lookahead) while every stream stays
+    # bit-identical to the synchronous engine.
+    m_decoders = int(os.environ.get("BENCH_OVERLAP_MIXED_DECODERS", "4"))
+    m_isl = int(os.environ.get("BENCH_OVERLAP_MIXED_ISL", "3000"))
+    m_osl = int(os.environ.get("BENCH_OVERLAP_MIXED_OSL", "32"))
+    m_chunk = int(os.environ.get("BENCH_OVERLAP_MIXED_CHUNK", "512"))
+    m_stagger = int(os.environ.get("BENCH_OVERLAP_MIXED_STAGGER", "3"))
+    m_pages = m_decoders * (m_isl + m_osl) // page_size + 64
+    m_prompts = [
+        rng.integers(1, 31999, size=m_isl + 37 * i).tolist()
+        for i in range(m_decoders)
+    ]
+
+    def run_mixed(overlap_on: bool) -> tuple[dict, dict[int, list[int]]]:
+        cfg = EngineConfig(
+            num_pages=m_pages, page_size=page_size, max_batch_size=m_decoders,
+            max_prefill_tokens=max(m_chunk, m_isl), max_seq_len=m_isl + m_osl + 64,
+            enable_prefix_caching=False, chunk_prefill_tokens=m_chunk,
+            overlap=overlap_on,
+        )
+        runner = MockRunner(
+            num_pages=m_pages, page_size=page_size, realtime=True,
+            decode_us_base=decode_us, d2h_us=d2h_us,
+        )
+        core = EngineCore(runner, cfg)
+        reqs = [PreprocessedRequest(
+            token_ids=p, sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=m_osl, ignore_eos=True),
+        ) for p in m_prompts]
+        tokens: dict[int, list[int]] = {}
+        admitted = 0
+        steps = 0
+        t0 = time.perf_counter()
+        while core.has_work or admitted < len(reqs):
+            # Staggered arrivals: a new long prompt lands every few steps,
+            # so admission + chunked prefill continuously interleave with
+            # the earlier requests' decodes.
+            if admitted < len(reqs) and steps >= admitted * m_stagger:
+                core.add_request(reqs[admitted])
+                admitted += 1
+            for seq, out in core.step():
+                tokens.setdefault(seq.seq_id, []).extend(out.token_ids)
+            steps += 1
+        elapsed = time.perf_counter() - t0
+        counts = dict(core.overlap_step_counts)
+        armed = sum(counts.values())
+        return {
+            "mode": "overlap" if overlap_on else "sync",
+            "elapsed_s": round(elapsed, 4),
+            "itl_mean_ms": round(elapsed * 1e3 / m_osl, 3),
+            "overlap_steps": counts,
+            "barrier_reasons": dict(core.overlap_barrier_counts),
+            "overlap_chained_frac": round(
+                counts.get("overlapped", 0) / armed, 4
+            ) if armed else 0.0,
+        }, tokens
+
     sync, sync_tokens = run(False)
     gc.collect()
     overlap, overlap_tokens = run(True)
+    gc.collect()
+    m_sync, m_sync_tokens = run_mixed(False)
+    gc.collect()
+    m_overlap, m_overlap_tokens = run_mixed(True)
     gc.collect()
     return {
         "decoders": decoders, "isl": isl, "osl": osl,
@@ -935,6 +1000,17 @@ def probe_engine_overlap() -> dict:
             sync["itl_mean_ms"] / overlap["itl_mean_ms"], 4
         ) if overlap["itl_mean_ms"] > 0 else 0.0,
         "device_idle_frac": overlap["device_idle_frac"],
+        "mixed": {
+            "decoders": m_decoders, "isl": m_isl, "osl": m_osl,
+            "chunk": m_chunk, "stagger_steps": m_stagger,
+            "sync": m_sync,
+            "overlap": m_overlap,
+            "bit_identical": m_sync_tokens == m_overlap_tokens,
+        },
+        "overlap_chained_frac": m_overlap["overlap_chained_frac"],
+        "engine_overlap_mixed_itl_gain": round(
+            m_sync["itl_mean_ms"] / m_overlap["itl_mean_ms"], 4
+        ) if m_overlap["itl_mean_ms"] > 0 else 0.0,
     }
 
 
@@ -990,6 +1066,13 @@ def build_doc(configs, pull, wire=None, stall=None, spec=None,
         # identical decode-heavy work (see probe_engine_overlap).
         "engine_overlap_itl_gain": (overlap or {}).get("engine_overlap_itl_gain", 0.0),
         "device_idle_frac": (overlap or {}).get("device_idle_frac", 0.0),
+        # Always-on overlap headline keys (ISSUE 11): fraction of armed
+        # steps that dispatched a chained lookahead on the mixed-traffic
+        # workload (staggered ISL-3000 admission + chunked prefill riding
+        # live decodes), and the sync-over-overlap mean ITL ratio there.
+        "overlap_chained_frac": (overlap or {}).get("overlap_chained_frac", 0.0),
+        "engine_overlap_mixed_itl_gain": (overlap or {}).get(
+            "engine_overlap_mixed_itl_gain", 0.0),
         "detail": {
             "backend": jax.default_backend(),
             "suite": [c.get("preset") for c in configs],

@@ -106,16 +106,50 @@ class EngineConfig:
     # quotas, and an ITL-driven chunk-budget controller. Off by default —
     # FIFO intake is then bit-identical to the pre-sched scheduler.
     slo_sched: bool = False
-    # Overlapped execution (DYN_OVERLAP): pure-decode steps run a depth-1
-    # pipeline — step N+1 is dispatched with its input tokens chained from
-    # N's device-resident samples before N's tokens reach the host, so the
-    # chip never idles on the per-step host round-trip. Stops are evaluated
-    # one step late; a late-detected stop cancels the in-flight row (its
-    # token is discarded, its pages released — output streams stay
-    # bit-identical to overlap=False). Any composition change (admission,
-    # chunk, preemption, cancellation, spec verify) inserts a barrier and
-    # falls back to the synchronous path for that step. docs/SCHEDULER.md.
+    # Overlapped execution (DYN_OVERLAP): a depth-1 pipeline — step N+1 is
+    # dispatched with its decode rows' input tokens chained from N's
+    # device-resident samples before N's tokens reach the host, so the chip
+    # never idles on the per-step host round-trip. Mixed steps overlap too:
+    # prefill chunk rows feed from host (their tokens are known), decode
+    # rows chain; penalty history and the pos_limit write clamp are applied
+    # in-graph, so penalized rows and budget-final tokens are not barriers.
+    # Stops are evaluated one step late; a late-detected stop cancels the
+    # in-flight row (its token is discarded, its pages released — output
+    # streams stay bit-identical to overlap=False). Only composition the
+    # graph cannot absorb barriers to the synchronous path: cancellation,
+    # constrained decode, multimodal prefill, decode_steps>1, and a verify
+    # step whose acceptance the next dispatch depends on (harvested first,
+    # then chained out of). Reasons are counted in overlap_barrier_counts
+    # and flight STEP records. docs/SCHEDULER.md.
     overlap: bool = False
+    # Allow speculative verify dispatches to participate in the overlapped
+    # pipeline (DYN_OVERLAP_SPEC): verify steps chain their base token from
+    # the previous dispatch and their accepted tokens stay device-resident
+    # to feed the next one. Off forces a barrier on every spec step (the
+    # pre-PR-11 behavior); output streams are identical either way.
+    overlap_spec: bool = True
+
+
+@dataclasses.dataclass
+class _InflightStep:
+    """A dispatched-but-unharvested device step.
+
+    kind "burst" is the pipelined multi-step decode (decode_steps > 1);
+    "step" is a plain (possibly mixed prefill+decode) single step; "spec"
+    is a speculative verify. ns/samples/drafts snapshot the composition the
+    harvest needs to apply the results — sequence state may have moved on
+    (preemption, cancellation) by the time the tokens land, so apply skips
+    any row whose sequence is no longer RUNNING."""
+
+    batch: list
+    handle: object
+    kind: str = "burst"
+    k: int = 1  # burst length (kind == "burst")
+    ns: list | None = None  # real token columns per row (step/spec)
+    n_dec: int = 0  # leading decode rows (the rest are prefill chunks)
+    samples: list | None = None  # per-row: does the engine accept a sample?
+    drafts: list | None = None  # per-decode-row draft tokens (spec)
+    v: int = 1  # verify width (spec)
 
 
 class EngineCore:
@@ -206,15 +240,35 @@ class EngineCore:
         # scheduler queues and page lists have no other cross-thread guard.
         self.step_lock = threading.RLock()
         self._head_stall_steps = 0
-        # Pipelined decode: the burst in flight on device, not yet consumed.
-        # (batch snapshot, DeviceTokens/DeviceStepTokens handle, burst length)
-        self._inflight: tuple[list[Sequence], object, int] | None = None
+        # The dispatch in flight on device, not yet consumed (pipelined
+        # bursts and the overlapped lookahead alike).
+        self._inflight: _InflightStep | None = None
+        # Effective-state advance for sequences with a dispatch in flight:
+        # seq_id -> (cached_delta, emit_delta). cached_delta = new KV slots
+        # the in-flight step writes for the row; emit_delta = 1 iff the row
+        # samples a token the host has not seen yet. The scheduler and the
+        # lookahead builder reason at num_cached + cached_delta /
+        # num_generated + emit_delta so in-flight work is never
+        # double-scheduled. Cleared whenever the in-flight step is consumed.
+        self._inflight_adv: dict[int, tuple[int, int]] = {}
+        # seq_id -> flat index into the runner's device-resident sample
+        # buffer from the *latest async dispatch* (plain step: row i; spec
+        # verify: row*verify_width + accepted_col, filled at harvest). A
+        # chained dispatch sources these rows' input tokens in-graph.
+        self._chain_map: dict[int, int] = {}
         # Overlapped execution accounting (config.overlap): per-step mode —
         # "overlapped" when the step dispatched a chained lookahead while
         # harvesting the previous one, "barrier" otherwise — plus the host
         # gap between consecutive dispatches (device-idle observability).
         self._overlap_mode: str | None = None
         self.overlap_step_counts: dict[str, int] = {"overlapped": 0, "barrier": 0}
+        # Why each barrier step barriered (first reason wins within a step):
+        # cumulative reason -> count, mirrored to the metrics plane as
+        # dynamo_engine_overlap_barrier_total{reason}.
+        self.overlap_barrier_counts: dict[str, int] = {}
+        self._overlap_barrier_reason: str | None = None
+        # Rows that were in flight when a dispatch crashed (CRASH record).
+        self._aborted_inflight = 0
         self._prev_step_end: float | None = None
         self.step_gap_ms_sum = 0.0
         self.step_gap_ms_count = 0
@@ -398,9 +452,14 @@ class EngineCore:
                 (t0 - self._prev_step_end) * 1e3 if self._prev_step_end is not None else 0.0
             )
             self._overlap_mode = None
+            self._overlap_barrier_reason = None
+            self._aborted_inflight = 0
             try:
                 out = self._step_locked()
             except Exception as exc:
+                inflight_rows = self._aborted_inflight or (
+                    len(self._inflight.batch) if self._inflight is not None else 0
+                )
                 self.flight.record(
                     CRASH,
                     error=type(exc).__name__,
@@ -409,6 +468,7 @@ class EngineCore:
                     running=len(self.running),
                     prefilling=len(self.prefilling),
                     free_pages=self.allocator.num_free(),
+                    inflight_rows=inflight_rows,
                     last_step_info=dict(self.last_step_info),
                 )
                 raise
@@ -419,11 +479,17 @@ class EngineCore:
                 self._prev_step_end = time.perf_counter()
                 return out  # idle drain: nothing dispatched, nothing to record
             overlap_mode = ""
+            barrier_reason = ""
             if self.config.overlap:
                 overlap_mode = self._overlap_mode or "barrier"
                 self.overlap_step_counts[overlap_mode] = (
                     self.overlap_step_counts.get(overlap_mode, 0) + 1
                 )
+                if overlap_mode == "barrier":
+                    barrier_reason = self._overlap_barrier_reason or "idle"
+                    self.overlap_barrier_counts[barrier_reason] = (
+                        self.overlap_barrier_counts.get(barrier_reason, 0) + 1
+                    )
             self.step_gap_ms_sum += gap_ms
             self.step_gap_ms_count += 1
             self.step_gap_ms_last = gap_ms
@@ -486,6 +552,8 @@ class EngineCore:
                 deadline_slack_ms=self.last_admission.get("deadline_slack_ms", 0.0),
                 gap_ms=round(gap_ms, 3),
                 overlap_mode=overlap_mode,
+                barrier_reason=barrier_reason,
+                chained_rows=int(info.get("chained_rows", 0)) if fresh else 0,
             )
             self._prev_step_end = time.perf_counter()
             return out
@@ -495,14 +563,50 @@ class EngineCore:
         # pages (deferred-mode safety; no-op when the service already flushed).
         self.flush_offloads()
         cancelled = self._reap_cancelled()
-        if self._inflight is not None and (cancelled or self.waiting or self.prefilling):
-            # Composition is about to change (new admissions / cancellations /
-            # chunks pending): drain the pipeline before scheduling anything.
+        if self._inflight is not None and (
+            cancelled
+            or (
+                (not self.config.overlap or self._inflight.kind == "burst")
+                and (self.waiting or self.prefilling)
+            )
+        ):
+            # Composition is about to change. Pipelined bursts (overlap off,
+            # or decode_steps>1 with overlap armed) drain on any
+            # admission/chunk pressure; the chained pipeline drains only on
+            # cancellation — reaping released the cancelled rows' pages, so
+            # the in-flight step's writes for them are stale and nothing new
+            # may be composed on top of it.
+            if cancelled:
+                self._note_barrier("cancel")
             out = cancelled + self._drain_inflight()
             if not self.defer_offloads:
                 self.flush_offloads()
             return out
         chunks = self._schedule_prefill()
+        overlap_ok, reason = self._overlap_route(chunks)
+        if overlap_ok:
+            with annotate("engine.overlap"):
+                out = cancelled + self._run_mixed_overlapped(chunks)
+            if not self.defer_offloads:
+                self.flush_offloads()
+            return out
+        if reason is not None:
+            self._note_barrier(reason)
+        if (
+            self.config.overlap
+            and self._inflight is not None
+            and self._inflight.kind != "burst"
+        ):
+            # Barrier with work in flight: commit it before any synchronous
+            # dispatch. Chunks scheduled above keep their pages and are
+            # re-scheduled (idempotently) next step. (A burst-kind handle
+            # belongs to the multi-step burst pipeline, which harvests and
+            # re-dispatches it itself in _run_decode — admission pressure
+            # for it already drained above.)
+            out = cancelled + self._drain_inflight()
+            if not self.defer_offloads:
+                self.flush_offloads()
+            return out
         fused = self.config.chunk_prefill_tokens > 0
         if chunks or (fused and self.running and self.prefilling) or (
             self._spec_active() and self.running
@@ -544,6 +648,83 @@ class EngineCore:
                         )
                     )
         return out
+
+    # -- overlapped pipeline routing ---------------------------------------
+
+    def _note_barrier(self, reason: str) -> None:
+        """Record why this step barriered (first reason wins)."""
+        if self._overlap_barrier_reason is None:
+            self._overlap_barrier_reason = reason
+
+    def _adv(self, s: Sequence) -> tuple[int, int]:
+        """(cached_delta, emit_delta) the in-flight dispatch owes ``s``."""
+        return self._inflight_adv.get(s.seq_id, (0, 0))
+
+    def _eff_cached(self, s: Sequence) -> int:
+        """num_cached once the in-flight step lands."""
+        return s.num_cached + self._adv(s)[0]
+
+    def _eff_remaining(self, s: Sequence) -> int:
+        """remaining_tokens at effective state, WITHOUT the live-row floor:
+        <= 0 means the sequence reaches its finish line inside the in-flight
+        step (it is excluded from the lookahead, and the late stop check at
+        harvest finishes it). Matches Sequence.remaining_tokens for rows
+        with nothing in flight."""
+        de = self._adv(s)[1]
+        return min(
+            s.request.stop.max_tokens - (s.num_generated + de),
+            self.config.max_seq_len - (len(s.tokens) + de),
+        )
+
+    def _overlap_route(self, chunks) -> tuple[bool, str | None]:
+        """Decide whether this step runs the chained pipeline.
+
+        Returns (use_overlap, barrier_reason). reason is None when overlap
+        is simply off/idle; otherwise it names the composition the graph
+        cannot absorb. Penalties, logprobs, page-budget-final tokens,
+        admission, and mixed prefill+decode are deliberately NOT here —
+        they are all chained in-graph now."""
+        cfg = self.config
+        if not cfg.overlap:
+            return False, None
+        if not hasattr(self.runner, "step_async"):
+            return False, "runner"
+        if cfg.decode_steps > 1:
+            # Multi-step bursts keep their own pipelined path (the burst
+            # already amortizes the round trip the lookahead would hide).
+            return False, "multistep"
+        if chunks and cfg.chunk_prefill_tokens <= 0:
+            # Legacy XOR mode: whole-prompt prefill steps carry no decode
+            # rows, so there is nothing to chain.
+            return False, "prefill"
+        rows = (
+            self.running
+            + [s for s, _ in chunks]
+            + [s for s in self.prefilling if self._adv(s)[1]]
+        )
+        if not rows:
+            # Nothing schedulable. If a step is in flight its rows are all
+            # finishing — let the driver harvest it; otherwise idle.
+            return (self._inflight is not None), None
+        if any(s.constraint is not None for s in rows):
+            return False, "constraint"
+        if self._spec_active() and not (
+            self.config.overlap_spec
+            and hasattr(self.runner, "spec_step_async")
+        ):
+            # Speculation is on but cannot chain (knob off or runner has no
+            # async verify): stand down entirely — barrier to the sync
+            # verify path rather than silently dropping drafts (the
+            # pre-ISSUE-11 behavior).
+            return False, "spec"
+        if any(s.mm_embeds is not None for s in rows) or any(
+            s.mrope is not None for s, _ in chunks
+        ):
+            # mm embeds ride an explicit (unpacked) argument; mrope *prefill*
+            # needs explicit 3-axis positions. mrope decode rows are fine —
+            # their position delta rides the packed buffer.
+            return False, "mm"
+        return True, None
 
     # -- prefill phase -----------------------------------------------------
 
@@ -590,7 +771,9 @@ class EngineCore:
         # the reserve a full pool would silently disable speculation).
         ahead = 1 + (self.config.spec_k if self._spec_active() else 0)
         reserve = sum(
-            s.pages_needed(ps, min(ahead, s.remaining_tokens(self.config.max_seq_len)))
+            s.pages_needed(
+                ps, self._adv(s)[0] + max(0, min(ahead, self._eff_remaining(s)))
+            )
             for s in self.running
         ) if chunked else 0
 
@@ -601,12 +784,15 @@ class EngineCore:
         for seq in self.prefilling:
             if budget <= 0:
                 break
-            n = min(seq.prompt_remaining, budget)
+            # A chunk already in flight counts as computed (overlap): the
+            # next chunk starts where the in-flight one will leave off.
+            dc = self._adv(seq)[0]
+            n = min(seq.prompt_remaining - dc, budget)
             # Cap by pages: slack in already-held pages + the free pool.
-            n = min(n, len(seq.pages) * ps - seq.num_cached + free_pages() * ps)
+            n = min(n, len(seq.pages) * ps - (seq.num_cached + dc) + free_pages() * ps)
             if n <= 0:
                 continue  # page-starved this step; decode still proceeds
-            need = seq.pages_needed(ps, n)
+            need = seq.pages_needed(ps, dc + n)
             if need:
                 try:
                     seq.pages.extend(self.allocator.allocate(need))
@@ -728,12 +914,20 @@ class EngineCore:
             chunks.append((seq, n))
         if chunks:
             self._head_stall_steps = 0
-        elif chunked and not self.running and len(self.prefilling) > 1:
+        elif (
+            chunked
+            and not self.running
+            and len(self.prefilling) > 1
+            and self._inflight is None
+        ):
             # Nothing can move: mid-prompt sequences pin every page among
             # themselves. Preempt the most recently arrived one (its pages
             # return to the pool / prefix cache) and retry — bounded by the
             # prefilling count. A sole mid-prompt sequence always fits (its
-            # whole prompt passed the pool check in add_request).
+            # whole prompt passed the pool check in add_request). With a
+            # step in flight, emptiness is progress (the in-flight chunks
+            # land next step), not deadlock — never preempt a row whose
+            # chunk is mid-air.
             self._preempt(self.prefilling[-1])
             return self._schedule_prefill()
         self.last_admission = {
@@ -797,17 +991,24 @@ class EngineCore:
             # remaining - 1: the verify step emits at most len(draft) + 1
             # tokens, which must never overrun max_tokens / the context
             # window (this is also what keeps every speculative KV write
-            # inside the row's position_limit).
-            cap = min(k, s.remaining_tokens(self.config.max_seq_len) - 1)
+            # inside the row's position_limit). Effective state: a chained
+            # row's in-flight token already counts against the budget.
+            dc, de = self._adv(s)
+            cap = min(k, self._eff_remaining(s) - 1)
             if budget is not None:
                 cap = min(cap, budget)
             sp = s.request.sampling
             if cap <= 0 or sp.frequency_penalty or sp.presence_penalty or s.constraint is not None:
                 drafts.append([])
                 continue
-            d = [int(tok) for tok in self._proposer.propose(s.tokens, cap)]
+            # Chained rows (de=1): the host context is stale by the in-flight
+            # token. Propose one extra and drop the head — the proposer's
+            # first continuation guesses the in-flight token itself; the rest
+            # align with the draft positions after it. Any mismatch is caught
+            # (losslessly) by the exact-replay verify.
+            d = [int(tok) for tok in self._proposer.propose(s.tokens, cap + de)][de:]
             if d:
-                need = s.pages_needed(self.config.page_size, 1 + len(d))
+                need = s.pages_needed(self.config.page_size, dc + 1 + len(d))
                 if need:
                     try:
                         s.pages.extend(self.allocator.allocate(need))
@@ -981,8 +1182,46 @@ class EngineCore:
             for s in batch:
                 self._finish(s, FinishReason.ERROR)
             raise
+        rec = _InflightStep(
+            batch, None, kind="spec" if use_spec else "step",
+            ns=ns, n_dec=n_dec, samples=samples, drafts=drafts,
+            v=(self.config.spec_k + 1 if use_spec else 1),
+        )
+        return out + self._apply_mixed_results(rec, next_tokens, targets, lp_aux)
+
+    def _apply_mixed_results(
+        self,
+        rec: _InflightStep,
+        next_tokens,
+        targets,
+        lp_aux,
+        *,
+        chain_out: bool = False,
+    ) -> list[tuple[Sequence, EngineOutput]]:
+        """Apply a (possibly mixed / speculative) step's sampled tokens.
+
+        Shared by the synchronous path and the overlapped harvest. Rows
+        whose sequence left RUNNING while the step was in flight
+        (cancelled, preempted) are skipped — their samples are discarded,
+        exactly like burst overshoot. With ``chain_out`` (spec harvest in
+        the overlapped pipeline) each surviving row's last accepted token
+        is recorded in ``_chain_map`` as a flat index into the runner's
+        device-resident ``[B*V]`` targets buffer, so the next dispatch can
+        chain from it without the token ever leaving the device; plain
+        dispatches record their map at dispatch time instead. When called
+        from the overlapped harvest, ``last_step_info`` is the *current*
+        step's dict — a harvest step's spec fields therefore describe the
+        previous dispatch's acceptance, which is when it becomes known."""
+        batch, ns, n_dec = rec.batch, rec.ns, rec.n_dec
+        drafts, samples = rec.drafts, rec.samples
+        use_spec = rec.kind == "spec"
+        ps = self.config.page_size
+        out: list[tuple[Sequence, EngineOutput]] = []
         spec_accepted = 0
         for i, (s, n) in enumerate(zip(batch, ns)):
+            if s.status is not SeqStatus.RUNNING:
+                self._chain_map.pop(s.seq_id, None)
+                continue
             if use_spec and i < n_dec:
                 # Verify row: accept the longest draft prefix the target
                 # tokens replay exactly, plus the bonus token after it.
@@ -1004,6 +1243,10 @@ class EngineCore:
                     if s.check_stop(self._eos, self.config.max_seq_len) is not None:
                         break  # overshoot past EOS/length is discarded
                 spec_accepted += max(0, len(accepted) - 1)
+                if chain_out and not s.is_finished:
+                    # accepted[-1] == targets[i, len(accepted) - 1]: its flat
+                    # index feeds the next dispatch's chained column 0.
+                    self._chain_map[s.seq_id] = i * rec.v + len(accepted) - 1
                 # Roll back speculative pages the accepted span didn't
                 # reach: they were freshly allocated this step (commit
                 # never passes num_cached), so release returns them to the
@@ -1035,6 +1278,8 @@ class EngineCore:
                 self._release_out_of_window(s)
                 # May finish the sequence (page release) — must follow commit.
                 self._accept_constrained(s, [tok])
+                if chain_out and not s.is_finished:
+                    self._chain_map[s.seq_id] = i * rec.v  # its column 0
                 lp = (self._lp_cols(s, lp_aux, i, [tok]) if use_spec
                       else self._lp_entries(s, lp_aux, i))
                 out.append(self._emit(s, tok, lp))
@@ -1054,10 +1299,265 @@ class EngineCore:
                 round(spec_accepted / drafted, 4) if drafted else 0.0
             )
         # Chunks whose final span sampled are decodable now.
-        for s, _ in chunks:
+        for s in batch[n_dec:]:
             if s in self.prefilling and s.prompt_remaining <= 1 and not s.is_finished:
                 self.prefilling.remove(s)
                 self.running.append(s)
+        return out
+
+    # -- overlapped mixed pipeline -----------------------------------------
+
+    def _ensure_lookahead_pages(self, rows: list[Sequence]) -> Sequence | None:
+        """Give every lookahead decode row pages covering its chained write
+        (position ``eff_cached``); preempt on exhaustion. Rows preempted by
+        an earlier row's allocation are dropped from ``rows`` in place (the
+        driver re-filters afterwards for victims already behind the cursor).
+        A sole row that cannot fit is returned *unfinished* — the step in
+        flight may hold its legitimate finish."""
+        ps = self.config.page_size
+        i = 0
+        while i < len(rows):
+            s = rows[i]
+            if s.status is not SeqStatus.RUNNING:
+                rows.pop(i)
+                continue
+            need = s.pages_needed(ps, self._adv(s)[0] + 1)
+            if need:
+                try:
+                    s.pages.extend(self.allocator.allocate(need))
+                except OutOfPagesError:
+                    victim = self.running[-1] if self.running else s
+                    if victim is s and len(self.running) <= 1:
+                        return s
+                    self._preempt(victim)
+                    continue  # retry same index (rows may shrink behind us)
+            i += 1
+        return None
+
+    def _abort_pipeline(self, batch: list[Sequence]) -> None:
+        """A dispatch crashed mid-pipeline: fail its rows AND whatever was
+        still in flight (rows finishing inside the in-flight step live only
+        there), then reset the chain state so a recovering caller starts
+        from a clean pipeline. No pages leak — ``_finish`` releases each
+        sequence's pages exactly once."""
+        failed: dict[int, Sequence] = {id(s): s for s in batch}
+        if self._inflight is not None:
+            self._aborted_inflight = len(self._inflight.batch)
+            for s in self._inflight.batch:
+                failed.setdefault(id(s), s)
+            self._inflight = None
+        self._inflight_adv = {}
+        self._chain_map = {}
+        if hasattr(self.runner, "reset_chain"):
+            self.runner.reset_chain()
+        for s in failed.values():
+            if s.status is not SeqStatus.FINISHED:
+                self._finish(s, FinishReason.ERROR)
+
+    def _run_mixed_overlapped(
+        self, chunks: list[tuple[Sequence, int]]
+    ) -> list[tuple[Sequence, EngineOutput]]:
+        """Depth-1 overlapped pipeline over *mixed* steps (DYN_OVERLAP).
+
+        Generalizes PR 10's pure-decode chaining: step N+1 is composed at
+        the sequences' *effective* state (``_inflight_adv``) and dispatched
+        before step N's tokens reach the host. Decode rows whose input
+        token is still in flight gather it in-graph from the previous
+        dispatch's device buffer (``_chain_map``); prefill chunk rows feed
+        from host as always (their tokens are known). Penalty history is
+        restored in-graph for chained rows and the pos_limit mask clamps
+        any would-be overrun write, so penalized rows and budget-final
+        tokens need no barrier. Rows that finish *inside* the in-flight
+        step are excluded from the lookahead (their finish is detected at
+        harvest, one step late — streams stay bit-identical to
+        overlap=False). A speculative verify in flight is harvested first —
+        its acceptance decides every position after it — and the next
+        dispatch chains out of its device-resident targets buffer, so even
+        then tokens never round-trip through the host.
+        """
+        fused = self.config.chunk_prefill_tokens > 0
+        out: list[tuple[Sequence, EngineOutput]] = []
+        info = self.last_step_info = {
+            "decode_rows": 0,
+            "chunk_rows": len(chunks),
+            "chunk_tokens": int(sum(n for _, n in chunks)),
+            "decodable": len(self.running),
+            "chained_rows": 0,
+        }
+        inf = self._inflight
+        if inf is not None and inf.kind == "burst":
+            # decode_steps config flipped mid-run: commit the legacy burst.
+            self._note_barrier("multistep")
+            out += self._drain_inflight()
+            inf = None
+        if inf is not None and inf.kind == "spec":
+            # A verify's acceptance decides every position that follows —
+            # nothing can be composed until it lands. Harvest first; the
+            # accepted tokens stay device-resident (flat targets buffer)
+            # and the dispatch below chains out of them via _chain_map.
+            self._note_barrier("spec")
+            out += self._harvest_inflight()
+            inf = None
+        # Decode candidates at effective state: running rows still short of
+        # their finish line, plus rows whose *final* prompt chunk is in
+        # flight (decodable the moment it lands — the chained dispatch
+        # consumes their sample device-side). Rows finishing inside the
+        # in-flight step are excluded: a chained write would have no legal
+        # position; the late stop check at harvest ends them.
+        decode_rows = [
+            s for s in self.running
+            if s.status is SeqStatus.RUNNING and self._eff_remaining(s) >= 1
+        ] + [
+            s for s in self.prefilling
+            if self._adv(s)[1] and self._eff_remaining(s) >= 1
+        ]
+        if not decode_rows and not chunks:
+            # Everything live is finishing inside the in-flight step (or
+            # the schedule is page-starved): commit it and rebuild the
+            # pipeline next step.
+            if inf is not None:
+                self._note_barrier("drain")
+                out += self._drain_inflight()
+            return out
+        failed = self._ensure_lookahead_pages(decode_rows)
+        if failed is not None:
+            # The sole candidate can't extend: the in-flight step may hold
+            # its legitimate finish — commit that first, then re-check.
+            self._note_barrier("pages")
+            out += self._drain_inflight()
+            if failed.status is SeqStatus.RUNNING:
+                f2 = self._ensure_burst_pages(1)
+                if f2 is not None:
+                    out.append((f2, self._final_output(f2)))
+            return out
+        # _ensure_lookahead_pages may have preempted rows already behind
+        # its cursor; drop them (their recompute is scheduled from waiting).
+        decode_rows = [s for s in decode_rows if s.status is SeqStatus.RUNNING]
+        spec = (
+            self._spec_active()
+            and self.config.overlap_spec
+            and hasattr(self.runner, "spec_step_async")
+        )
+        drafts = (
+            self._propose_drafts(decode_rows, chunks) if spec and decode_rows
+            else [[] for _ in decode_rows]
+        )
+        if any(s.mrope is not None for s in decode_rows):
+            # mrope decode rows chain fine (their position delta rides the
+            # packed buffer) but the verify program wants explicit 3-axis
+            # positions; drop the drafts — losslessly — rather than barrier.
+            drafts = [[] for _ in decode_rows]
+        # All-empty drafts degrade to a plain chained step (bit-identical
+        # per the PR 6 contract) — which, unlike a verify, the *next* step
+        # can overlap on top of.
+        use_spec = spec and any(drafts)
+        batch = decode_rows + [s for s, _ in chunks]
+        if not batch:
+            if inf is not None:
+                self._note_barrier("drain")
+                out += self._drain_inflight()
+            return out
+        n_dec = len(decode_rows)
+        info["decode_rows"] = n_dec
+        if chunks and fused:
+            self.mixed_steps += 1
+        ns = [1 + len(d) for d in drafts] + [n for _, n in chunks]
+        ps = self.config.page_size
+        t = max(ns)
+        npg = max(len(s.pages) for s in batch)
+        b = len(batch)
+        tokens = np.zeros((b, t), np.int32)
+        positions = np.zeros((b, t), np.int32)
+        block_tables = np.zeros((b, npg), np.int32)
+        slots = np.zeros((b, t), np.int32)
+        last = np.zeros(b, np.int32)
+        chain_src = np.full(b, -1, np.int32)
+        samples = [False] * b
+        for i, (s, n) in enumerate(zip(batch, ns)):
+            ec = self._eff_cached(s)
+            if i < n_dec:
+                src = self._chain_map.get(s.seq_id, -1)
+                if src >= 0:
+                    chain_src[i] = src  # column 0 gathered in-graph
+                else:
+                    # Host knows the input token (nothing in flight for this
+                    # row — an IndexError here would mean the chain map lost
+                    # an in-flight row, never silence it).
+                    tokens[i, 0] = s.tokens[ec]
+                if n > 1:
+                    tokens[i, 1:n] = drafts[i]
+                samples[i] = True
+            else:
+                tokens[i, :n] = s.tokens[ec : ec + n]
+                samples[i] = ec + n == len(s.tokens)
+            pos = np.arange(ec, ec + n, dtype=np.int32)
+            positions[i, :n] = pos
+            block_tables[i, : len(s.pages)] = s.pages
+            page_arr = np.asarray(s.pages, dtype=np.int32)
+            slots[i, :n] = page_arr[pos // ps] * ps + pos % ps
+            last[i] = n - 1
+        info["chained_rows"] = chained = int((chain_src >= 0).sum())
+        sb = self._sampling_batch(batch, tokens, positions, block_tables, slots, last)
+        sb.num_new = np.asarray(ns, np.int32)
+        lp_k = LOGPROBS_TOP_K if any(
+            s.request.sampling.logprobs and smp for s, smp in zip(batch, samples)
+        ) else 0
+        try:
+            if use_spec:
+                sb.spec_start = np.asarray(
+                    [0] * n_dec + [n - 1 for _, n in chunks], np.int32
+                )
+                v = self.config.spec_k + 1
+                dev = self.runner.spec_step_async(
+                    sb, v, lp_k=lp_k, chain_src=chain_src if chained else None
+                )
+                new_inf = _InflightStep(
+                    batch, dev, kind="spec", ns=ns, n_dec=n_dec,
+                    samples=samples, drafts=drafts, v=v,
+                )
+            else:
+                dev = self.runner.step_async(
+                    sb, lp_k=lp_k, chain=chained > 0,
+                    chain_src=chain_src if chained else None,
+                )
+                new_inf = _InflightStep(
+                    batch, dev, kind="step", ns=ns, n_dec=n_dec,
+                    samples=samples, drafts=drafts,
+                )
+        except Exception:
+            self._abort_pipeline(batch)
+            raise
+        if inf is not None:
+            self._overlap_mode = "overlapped"
+        else:
+            self._note_barrier("fill")
+        # The new dispatch's chain map: a verify's is only known at its
+        # harvest (acceptance decides the column); a plain step's is its
+        # emitting rows. Installed *before* the harvest below so late
+        # finishes prune their (now meaningless) entries.
+        if use_spec:
+            self._chain_map = {}
+        else:
+            self._chain_map = {
+                s.seq_id: i
+                for i, (s, smp) in enumerate(zip(batch, samples)) if smp
+            }
+        if inf is not None:
+            out += self._harvest_inflight()
+        self._inflight = new_inf
+        if use_spec:
+            # Verify decode rows advance 1..k+1 tokens — unknowable until
+            # harvest, which is why the next step harvests first. Only the
+            # chunk rows' advance is certain.
+            self._inflight_adv = {
+                s.seq_id: (n, 1 if smp else 0)
+                for s, n, smp in zip(batch[n_dec:], ns[n_dec:], samples[n_dec:])
+            }
+        else:
+            self._inflight_adv = {
+                s.seq_id: (n, 1 if smp else 0)
+                for s, n, smp in zip(batch, ns, samples)
+            }
         return out
 
     # -- decode phase ------------------------------------------------------
@@ -1081,20 +1581,10 @@ class EngineCore:
             for s in self.running
         )
         constrained = any(s.constraint is not None for s in self.running)
-        # Overlapped execution (DYN_OVERLAP): a single decode step runs the
-        # depth-1 pipeline — harvest step N while step N+1 computes, its
-        # input tokens chained device-side. Logprobs ride along (the aux
-        # arrays travel on the same handle); constraints need a fresh host
-        # mask per token and penalties fresh history, so both barrier.
-        if (
-            self.config.overlap
-            and k == 1
-            and not penalized
-            and not constrained
-            and hasattr(self.runner, "step_async")
-            and getattr(self.runner, "mesh", None) is None
-        ):
-            return self._run_decode_overlapped()
+        # Overlapped execution (DYN_OVERLAP) never reaches this method:
+        # _step_locked routes overlappable compositions to
+        # _run_mixed_overlapped and drains the pipeline before any barrier
+        # falls through to the synchronous paths below.
         # Logprobs ride the single-step sync path: the fused burst's scan
         # doesn't surface per-step logits, and mixing would stall the
         # pipeline anyway (same trade as penalties).
@@ -1254,10 +1744,11 @@ class EngineCore:
                 for s in batch:
                     self._finish(s, FinishReason.ERROR)
                 raise
-            self._inflight = (batch, dev, k)
+            self._inflight = _InflightStep(batch, dev, kind="burst", k=k)
             return []  # pipeline fill: outputs arrive next step
 
-        batch, dev, kprev = self._inflight
+        inflight = self._inflight
+        batch, dev, kprev = inflight.batch, inflight.handle, inflight.k
         same = len(batch) == len(self.running) and all(
             a is b for a, b in zip(batch, self.running)
         )
@@ -1285,7 +1776,7 @@ class EngineCore:
                     for s in batch:
                         self._finish(s, FinishReason.ERROR)
                     raise
-                self._inflight = (batch, dev2, k)
+                self._inflight = _InflightStep(batch, dev2, kind="burst", k=k)
                 dispatched = True
         if not dispatched:
             self._inflight = None
@@ -1293,89 +1784,6 @@ class EngineCore:
         out = self._process_burst_tokens(batch, dev.fetch())
         # A sole sequence that couldn't extend and wasn't finished by the
         # burst has truly outgrown the cache — fail it now (sync behavior).
-        if not dispatched and self.running:
-            failed2 = self._ensure_burst_pages(1)
-            if failed2 is not None:
-                out.append((failed2, self._final_output(failed2)))
-        return out
-
-    def _run_decode_overlapped(self) -> list[tuple[Sequence, EngineOutput]]:
-        """Depth-1 overlapped decode at decode_steps == 1 (DYN_OVERLAP).
-
-        The single-step analogue of :meth:`_run_decode_pipelined`: step N+1
-        is dispatched with its input tokens gathered in-graph from step N's
-        device-resident samples, *then* N's tokens are harvested — the host
-        round-trip overlaps the next step's compute. Stops are detected one
-        step late; the in-flight row of a stopped sequence is cancelled at
-        harvest (token discarded, pages already released by ``_finish``), so
-        the emitted stream is bit-identical to the synchronous loop. The
-        chained write lands at position ``num_cached + 1``, which the
-        ``remaining_tokens > 1`` gate keeps strictly below ``position_limit``
-        — no live page is ever written past a finish line. Unlike the fused
-        burst, logprob aux arrays ride the handle, so logprobs requests
-        overlap too.
-        """
-        lp_k = LOGPROBS_TOP_K if any(
-            s.request.sampling.logprobs for s in self.running
-        ) else 0
-        if self._inflight is None:
-            failed = self._ensure_burst_pages(1)
-            if failed is not None:
-                return [(failed, self._final_output(failed))]
-            if not self.running:
-                return []
-            batch = list(self.running)
-            self.runner.reset_chain()
-            try:
-                dev = self.runner.step_async(self._decode_step_batch(batch), lp_k=lp_k)
-            except Exception:
-                for s in batch:
-                    self._finish(s, FinishReason.ERROR)
-                raise
-            self._inflight = (batch, dev, 1)
-            return []  # pipeline fill: outputs arrive next step
-
-        batch, dev, _kprev = self._inflight
-        if not hasattr(dev, "result"):
-            # A fused-burst handle (decode_steps collapsed to 1 near the
-            # finish line): commit it synchronously before overlapping.
-            return self._drain_inflight()
-        same = len(batch) == len(self.running) and all(
-            a is b for a, b in zip(batch, self.running)
-        )
-        if same:
-            # A sequence finishing inside the in-flight step changes the
-            # composition; chaining past it would also write at a position
-            # its remaining-tokens page cap cannot cover.
-            same = all(s.remaining_tokens(self.config.max_seq_len) > 1 for s in batch)
-        dispatched = False
-        if same:
-            # Don't fail the sole sequence yet: the step in flight may hold
-            # its legitimate finish (EOS/length) — commit that first below.
-            failed = self._ensure_burst_pages(2, fail_sole=False)
-            # _ensure_burst_pages may have preempted or failed someone: re-check.
-            same = failed is None and len(batch) == len(self.running) and all(
-                a is b for a, b in zip(batch, self.running)
-            )
-            if same and self.runner.can_chain(len(batch)):
-                try:
-                    dev2 = self.runner.step_async(
-                        self._decode_step_batch(batch, offset=1), lp_k=lp_k, chain=True
-                    )
-                except Exception:
-                    for s in batch:
-                        self._finish(s, FinishReason.ERROR)
-                    raise
-                self._inflight = (batch, dev2, 1)
-                dispatched = True
-                self._overlap_mode = "overlapped"
-        if not dispatched:
-            self._inflight = None
-            self.runner.reset_chain()
-        next_tokens, lp_aux = dev.result()
-        out = self._process_burst_tokens(batch, next_tokens, lp_aux)
-        # A sole sequence that couldn't extend and wasn't finished by the
-        # in-flight step has truly outgrown the cache — fail it now.
         if not dispatched and self.running:
             failed2 = self._ensure_burst_pages(1)
             if failed2 is not None:
@@ -1390,16 +1798,33 @@ class EngineCore:
             return dev.result()
         return dev.fetch(), None
 
-    def _drain_inflight(self) -> list[tuple[Sequence, EngineOutput]]:
-        """Consume the in-flight burst without dispatching another."""
-        if self._inflight is None:
+    def _harvest_inflight(self) -> list[tuple[Sequence, EngineOutput]]:
+        """Consume the in-flight step, keeping the runner's device-resident
+        sample buffer alive — a dispatch composed on top of this harvest may
+        chain out of it (spec chain-out). Clears the effective-state advance:
+        the host has caught up."""
+        inf = self._inflight
+        if inf is None:
             return []
-        batch, dev, _k = self._inflight
         self._inflight = None
+        self._inflight_adv = {}
+        if inf.kind == "burst":
+            next_tokens, lp_aux = self._fetch_inflight(inf.handle)
+            return self._process_burst_tokens(inf.batch, next_tokens, lp_aux)
+        res, lp_aux = inf.handle.result()
+        if inf.kind == "spec":
+            return self._apply_mixed_results(inf, res[:, 0], res, lp_aux, chain_out=True)
+        return self._apply_mixed_results(inf, res[:, 0], None, lp_aux)
+
+    def _drain_inflight(self) -> list[tuple[Sequence, EngineOutput]]:
+        """Consume the in-flight step without composing on top of it: apply
+        its results, then reset the chain state (the device buffer is dead
+        until the pipeline refills)."""
+        out = self._harvest_inflight()
+        self._chain_map = {}
         if hasattr(self.runner, "reset_chain"):
             self.runner.reset_chain()
-        next_tokens, lp_aux = self._fetch_inflight(dev)
-        return self._process_burst_tokens(batch, next_tokens, lp_aux)
+        return out
 
     # -- shared helpers ----------------------------------------------------
 
@@ -1420,7 +1845,11 @@ class EngineCore:
             top_k[i] = sp.top_k
             top_p[i] = sp.top_p
             seeds[i] = np.uint32((sp.seed if sp.seed is not None else s.seq_id * 0x9E3779B9 + 1) & 0xFFFFFFFF)
-            steps[i] = s.num_generated
+            # Effective fold counter: a chained row's in-flight token has
+            # already consumed fold num_generated (the in-graph history
+            # write restores that token at index steps-1; sync paths see
+            # an empty advance map, so this stays num_generated there).
+            steps[i] = s.num_generated + self._adv(s)[1]
             freq[i] = sp.frequency_penalty
             pres[i] = sp.presence_penalty
             limits[i] = s.position_limit(self.config.max_seq_len)
@@ -1515,6 +1944,8 @@ class EngineCore:
 
     def _abort_all_locked(self, reason: FinishReason) -> None:
         self._inflight = None
+        self._inflight_adv = {}
+        self._chain_map = {}
         if hasattr(self.runner, "reset_chain"):
             self.runner.reset_chain()
         for seq in list(self.running) + list(self.prefilling) + list(self.waiting):
@@ -1608,6 +2039,11 @@ class EngineCore:
         seq.num_cached = 0
         seq.prefill_chunks = 0
         seq.status = SeqStatus.PREEMPTED
+        # Any in-flight advance is void: on re-admission the sequence
+        # restarts from num_cached=0, so stale effective-state would
+        # overshoot the prompt.
+        self._inflight_adv.pop(seq.seq_id, None)
+        self._chain_map.pop(seq.seq_id, None)
         if seq in self.running:
             self.running.remove(seq)
         if seq in self.prefilling:  # preempted mid-prompt: re-chunks on resume

@@ -111,6 +111,33 @@ def _unpack(packed: jnp.ndarray, b: int, t: int, n: int, h: int):
     )
 
 
+def _apply_chain(tokens, history, sample_steps, chain_buf, chain_src):
+    """Per-row device-resident token sourcing for a chained dispatch.
+
+    ``chain_src`` i32[B] holds, per row, a flat index into ``chain_buf`` (the
+    previous dispatch's device-resident samples — [Bp] for plain steps,
+    [Bp*V] row-major for spec verifies) or -1 for host-fed rows. Chained
+    rows' column-0 input token is gathered in-graph; host-fed rows (prefill
+    chunks, fresh admissions) keep their host token untouched.
+
+    The gathered token is also appended to the penalty ``history`` at index
+    ``sample_steps - 1``: a chained row's host history is stale by exactly
+    the one in-flight token it is chaining, and that token IS the gathered
+    value, so the write restores bit-identical penalty state. For host-fed
+    rows the write re-stores the value already there (a no-op), which keeps
+    the program branch-free.
+    """
+    src = jnp.clip(chain_src, 0, chain_buf.shape[0] - 1)
+    gathered = chain_buf[src]
+    chained = chain_src >= 0
+    tokens = tokens.at[:, 0].set(jnp.where(chained, gathered, tokens[:, 0]))
+    idx = jnp.clip(sample_steps - 1, 0, history.shape[1] - 1)
+    cur = jnp.take_along_axis(history, idx[:, None], axis=1)[:, 0]
+    upd = jnp.where(chained, gathered, cur)
+    history = jax.vmap(lambda hrow, w, t_: hrow.at[w].set(t_))(history, idx, upd)
+    return tokens, history
+
+
 @dataclasses.dataclass
 class StepBatch:
     """Host-side arrays describing one engine step (pre-padding)."""
@@ -220,7 +247,14 @@ class ModelRunner:
                   freq_pen, pres_pen, pos_limit, history, mrope_delta=None,
                   mm_embeds=None, mm_slot_offset=None, mm_counts=None,
                   mrope_positions=None, logit_mask=None, *, impl, lp_k=0):
-            del pos_limit  # single/prefill steps never write past the finish line
+            # In-graph finish-line clamp: any column at/past a row's absolute
+            # position limit writes KV to the reserved null page 0 instead of
+            # a live slot. Host scheduling never dispatches such a column for
+            # a live row (and pad rows carry limit 0 with slot 0 already), so
+            # this is a no-op for today's callers — it is the guarantee that
+            # lets the overlapped engine keep budget-clamped rows in a
+            # chained dispatch instead of draining the pipeline.
+            slot_mapping = jnp.where(positions < pos_limit[:, None], slot_mapping, 0)
             # mm_* None on text batches; jit specializes once per presence
             # pattern, so the text program carries no multimodal cost.
             mm_kw = {}
@@ -266,16 +300,38 @@ class ModelRunner:
         self._step_packed_fn = _step_packed
 
         @functools.partial(jax.jit, static_argnames=("b", "t", "n", "h", "lp_k"), donate_argnums=(1, 2))
-        def _step_chained(params, k_cache, v_cache, packed, chain_tokens, *, b, t, n, h, lp_k=0):
-            """Chained single decode step: input tokens come from the previous
-            step's device-resident samples instead of the host (the overlapped
+        def _step_chained(params, k_cache, v_cache, packed, chain_buf, chain_src, *, b, t, n, h, lp_k=0):
+            """Chained (possibly mixed) step: each row's column-0 input token
+            is sourced per ``chain_src`` from the previous dispatch's
+            device-resident samples instead of the host (the overlapped
             engine loop dispatches step N+1 before fetching step N's tokens —
-            see step_async)."""
+            see step_async). Rows with ``chain_src < 0`` (prefill chunks,
+            fresh admissions) feed from host as usual."""
             args = list(_unpack(packed, b, t, n, h))
-            args[0] = chain_tokens[:, None]  # tokens i32[B, 1]
+            # args: 0=tokens, 9=sample_steps, 13=history (see _pack order).
+            args[0], args[13] = _apply_chain(args[0], args[13], args[9], chain_buf, chain_src)
             return _step(params, k_cache, v_cache, *args, impl=self.attn_impl, lp_k=lp_k)
 
         self._step_chained_fn = _step_chained
+
+        @functools.partial(jax.jit, static_argnames=("impl", "lp_k"), donate_argnums=(1, 2))
+        def _step_chained_explicit(params, k_cache, v_cache, chain_buf, chain_src,
+                                   tokens, positions, block_tables, slot_mapping,
+                                   last_idx, temperature, top_k, top_p, seeds,
+                                   sample_steps, freq_pen, pres_pen, pos_limit,
+                                   history, mrope_delta=None, *, impl, lp_k=0):
+            """Explicit-args chained step for mesh runners (the packed buffer
+            cannot be row-sharded; mesh steps ship per-array like the sync
+            mesh path, plus the two chain arrays)."""
+            tokens, history = _apply_chain(tokens, history, sample_steps, chain_buf, chain_src)
+            return _step(
+                params, k_cache, v_cache, tokens, positions, block_tables,
+                slot_mapping, last_idx, temperature, top_k, top_p, seeds,
+                sample_steps, freq_pen, pres_pen, pos_limit, history, mrope_delta,
+                impl=impl, lp_k=lp_k,
+            )
+
+        self._step_chained_explicit_fn = _step_chained_explicit
 
         @functools.partial(jax.jit, static_argnames=("impl", "lp_k"), donate_argnums=(1, 2))
         def _spec_step(params, k_cache, v_cache, tokens, positions, block_tables, slot_mapping,
@@ -340,6 +396,28 @@ class ModelRunner:
             return targets.reshape(b, v), k_cache, v_cache
 
         self._spec_step_fn = _spec_step
+
+        @functools.partial(jax.jit, static_argnames=("impl", "lp_k"), donate_argnums=(1, 2))
+        def _spec_step_chained(params, k_cache, v_cache, chain_buf, chain_src,
+                               tokens, positions, block_tables, slot_mapping,
+                               verify_indices, temperature, top_k, top_p, seeds,
+                               sample_steps, freq_pen, pres_pen, history,
+                               mrope_delta=None, *, impl, lp_k=0):
+            """Chained speculative verify: decode rows' column-0 (bonus/base)
+            token gathers from the previous dispatch's device-resident
+            samples; draft columns 1..K and prefill-chunk rows feed from host
+            (drafts are host-proposed, chunk tokens are prompt text). The
+            same losslessness argument as _spec_step applies unchanged — the
+            gathered token equals the token the host would have shipped."""
+            tokens, history = _apply_chain(tokens, history, sample_steps, chain_buf, chain_src)
+            return _spec_step(
+                params, k_cache, v_cache, tokens, positions, block_tables,
+                slot_mapping, verify_indices, temperature, top_k, top_p, seeds,
+                sample_steps, freq_pen, pres_pen, history, mrope_delta,
+                impl=impl, lp_k=lp_k,
+            )
+
+        self._spec_step_chained_fn = _spec_step_chained
 
         @functools.partial(jax.jit, static_argnames=("num_steps",), donate_argnums=(1, 2))
         def _multi_step(params, k_cache, v_cache, tokens, positions, block_tables,
@@ -915,45 +993,102 @@ class ModelRunner:
             pass
         return DeviceTokens(toks, b_real)
 
+    def _chain_src_padded(self, chain_src, b_real: int, bp: int) -> np.ndarray:
+        """Pad a per-row chain source vector to the batch bucket (-1 = host).
+
+        ``chain_src=None`` with chaining requested means the legacy
+        whole-batch form: row i chains from flat index i of the previous
+        dispatch's buffer."""
+        src = np.full(bp, -1, np.int32)
+        if chain_src is None:
+            src[:b_real] = np.arange(b_real, dtype=np.int32)
+        else:
+            src[:b_real] = np.asarray(chain_src, np.int32)
+        mx = int(src.max())
+        assert mx < 0 or (
+            self._chain_tokens is not None and mx < self._chain_tokens.shape[0]
+        ), "chain_src points past the device-resident sample buffer"
+        return src
+
     @_locked
-    def step_async(self, batch: StepBatch, lp_k: int = 0, *, chain: bool = False) -> "DeviceStepTokens":
-        """Dispatch ONE decode step without blocking on its result.
+    def step_async(self, batch: StepBatch, lp_k: int = 0, *, chain: bool = False,
+                   chain_src: np.ndarray | None = None) -> "DeviceStepTokens":
+        """Dispatch ONE (possibly mixed prefill+decode) step without blocking
+        on its result.
 
         The overlapped engine loop (``DYN_OVERLAP=1``) uses this to run a
         depth-1 pipeline at decode_steps == 1: the sampled tokens stay
-        device-resident (``self._chain_tokens``), so the next step can be
-        dispatched with ``chain=True`` — its input token per row is gathered
-        from that buffer in-graph — before this step's tokens ever reach the
-        host. Returns a :class:`DeviceStepTokens` handle whose ``result()``
-        blocks on the already-started device->host copy.
+        device-resident (``self._chain_tokens``, kept flat i32[Bp]), so the
+        next step can be dispatched with ``chain=True`` — each row's input
+        token gathered in-graph per ``chain_src`` — before this step's
+        tokens ever reach the host. ``chain_src`` i32[B_real] names, per
+        row, a flat index into the previous dispatch's buffer (plain step:
+        its row index; spec verify: row*V + accepted-column) or -1 to feed
+        that row from host (prefill chunks, fresh admissions). Rows may
+        carry multiple real token columns exactly like :meth:`step` — only
+        column 0 is ever chained, which is where mixed decode rows keep
+        their single real token. Returns a :class:`DeviceStepTokens` handle
+        whose ``result()`` blocks on the already-started device->host copy.
 
-        Decode-only (T == 1), non-mesh, no multimodal embeds / logit masks
-        (those route through the sync :meth:`step`); ``lp_k`` rides along —
-        the aux logprob arrays are fetched with the tokens.
+        No multimodal embeds / logit masks (those route through the sync
+        :meth:`step`); ``lp_k`` rides along — the aux logprob arrays are
+        fetched with the tokens.
         """
-        assert batch.tokens.shape[1] == 1, "step_async is decode-only"
-        assert self.mesh is None, "step_async is single-chip only"
+        assert batch.mm_embeds is None and batch.logit_mask is None, (
+            "step_async does not take multimodal/constrained batches"
+        )
         b_real = batch.batch_size
         padded = self._pad(batch)
-        self.last_attn_dispatch = self._attn_dispatch(padded, self.attn_impl)
+        impl = self._select_impl(padded) if self.mesh is not None else self.attn_impl
+        self.last_attn_dispatch = self._attn_dispatch(padded, impl)
         b, t = padded.tokens.shape
         n = padded.block_tables.shape[1]
         h = padded.history.shape[1]
-        packed = jnp.asarray(_pack(padded))
-        with timed_dispatch(self.compile_tracker, "step_async", (b, t, n, h, lp_k, chain)):
-            if chain:
-                assert self._chain_tokens is not None and self._chain_tokens.shape[0] == b, (
-                    "chained step requires a previous step with identical padded batch"
+        src = self._chain_src_padded(chain_src, b_real, b) if chain else None
+        with timed_dispatch(
+            self.compile_tracker, "step_async",
+            (b, t, n, h, lp_k, chain, impl, self.mesh is not None),
+        ):
+            if self.mesh is not None:
+                from dynamo_tpu.parallel.sharding import batch_sharding
+
+                def put(a):
+                    return jax.device_put(a, batch_sharding(self.mesh, a.ndim))
+
+                explicit = (
+                    put(padded.tokens), put(padded.positions),
+                    put(padded.block_tables), put(padded.slot_mapping),
+                    put(padded.last_token_index), put(padded.temperature),
+                    put(padded.top_k), put(padded.top_p),
+                    put(padded.seeds), put(padded.sample_steps),
+                    put(padded.freq_pen), put(padded.pres_pen),
+                    put(padded.pos_limit), put(padded.history),
+                    put(padded.mrope_delta),
                 )
-                out = self._step_chained_fn(
-                    self.params, self.k_cache, self.v_cache, packed, self._chain_tokens,
-                    b=b, t=t, n=n, h=h, lp_k=lp_k,
-                )
+                if chain:
+                    out = self._step_chained_explicit_fn(
+                        self.params, self.k_cache, self.v_cache,
+                        self._chain_tokens, put(src), *explicit,
+                        impl=impl, lp_k=lp_k,
+                    )
+                else:
+                    out = self._step_fn(
+                        self.params, self.k_cache, self.v_cache, *explicit,
+                        impl=impl, lp_k=lp_k,
+                    )
             else:
-                out = self._step_packed_fn(
-                    self.params, self.k_cache, self.v_cache, packed,
-                    b=b, t=t, n=n, h=h, lp_k=lp_k,
-                )
+                packed = jnp.asarray(_pack(padded))
+                if chain:
+                    out = self._step_chained_fn(
+                        self.params, self.k_cache, self.v_cache, packed,
+                        self._chain_tokens, jnp.asarray(src),
+                        b=b, t=t, n=n, h=h, lp_k=lp_k,
+                    )
+                else:
+                    out = self._step_packed_fn(
+                        self.params, self.k_cache, self.v_cache, packed,
+                        b=b, t=t, n=n, h=h, lp_k=lp_k,
+                    )
         if lp_k:
             toks, self.k_cache, self.v_cache, chosen, top_ids, top_lps = out
             aux = (chosen, top_ids, top_lps)
@@ -967,6 +1102,82 @@ class ModelRunner:
             except Exception:
                 pass
         return DeviceStepTokens(toks, aux, b_real)
+
+    @_locked
+    def spec_step_async(self, batch: StepBatch, verify_width: int, lp_k: int = 0, *,
+                        chain_src: np.ndarray | None = None) -> "DeviceSpecTokens":
+        """Dispatch a speculative verify without blocking on its result.
+
+        Same batch contract as :meth:`spec_step`. With ``chain_src`` (see
+        :meth:`step_async`) the decode rows' column-0 base token gathers
+        in-graph from the previous dispatch's device-resident samples, so a
+        verify can itself be the pipeline's one-step lookahead after a plain
+        chained step (a plain step emits exactly one token per row, so the
+        verify's positions are host-predictable even before that token
+        lands). The verify's own targets become the new chain buffer, flat
+        i32[Bp*V] row-major — the engine chains the NEXT dispatch from flat
+        index row*V + (accepted columns - 1) once acceptance is known.
+        """
+        assert batch.mm_embeds is None and batch.logit_mask is None, (
+            "spec_step_async does not take multimodal/constrained batches"
+        )
+        b_real = batch.batch_size
+        padded = self._pad(batch)
+        bp = padded.tokens.shape[0]
+        start = padded.spec_start if padded.spec_start is not None else np.zeros(bp, np.int32)
+        vi = np.minimum(
+            start[:, None] + np.arange(verify_width, dtype=np.int32)[None, :],
+            padded.last_token_index[:, None],
+        ).astype(np.int32)
+        impl = self._select_impl(padded) if self.mesh is not None else self.attn_impl
+        self.last_attn_dispatch = self._attn_dispatch(padded, impl, verify=True)
+        chain = chain_src is not None
+        src = self._chain_src_padded(chain_src, b_real, bp) if chain else None
+        with timed_dispatch(
+            self.compile_tracker, "spec_step_async",
+            (bp, padded.tokens.shape[1], padded.block_tables.shape[1],
+             padded.history.shape[1], verify_width, lp_k, chain, impl,
+             self.mesh is not None),
+        ):
+            if self.mesh is not None:
+                from dynamo_tpu.parallel.sharding import batch_sharding
+
+                def put(a):
+                    return jax.device_put(a, batch_sharding(self.mesh, a.ndim))
+            else:
+                put = jnp.asarray
+            explicit = (
+                put(padded.tokens), put(padded.positions),
+                put(padded.block_tables), put(padded.slot_mapping),
+                put(vi), put(padded.temperature), put(padded.top_k), put(padded.top_p),
+                put(padded.seeds), put(padded.sample_steps),
+                put(padded.freq_pen), put(padded.pres_pen), put(padded.history),
+                put(padded.mrope_delta),
+            )
+            if chain:
+                out = self._spec_step_chained_fn(
+                    self.params, self.k_cache, self.v_cache,
+                    self._chain_tokens, put(src), *explicit,
+                    impl=impl, lp_k=lp_k,
+                )
+            else:
+                out = self._spec_step_fn(
+                    self.params, self.k_cache, self.v_cache, *explicit,
+                    impl=impl, lp_k=lp_k,
+                )
+        if lp_k:
+            targets, self.k_cache, self.v_cache, chosen, top_ids, top_lps = out
+            aux = (chosen, top_ids, top_lps)
+        else:
+            targets, self.k_cache, self.v_cache = out
+            aux = None
+        self._chain_tokens = targets.reshape(-1)  # flat [Bp*V] chain buffer
+        for buf in (targets, *(aux or ())):
+            try:  # start the device->host DMA early; overlaps the next step
+                buf.copy_to_host_async()
+            except Exception:
+                pass
+        return DeviceSpecTokens(targets, aux, b_real)
 
     def embed(self, token_lists: list[list[int]]) -> np.ndarray:
         """Sentence embeddings for N token sequences; returns f32[N, D].
@@ -995,6 +1206,13 @@ class ModelRunner:
             self._chain_tokens is not None
             and self._chain_tokens.shape[0] == self._bucket_batch(batch_size)
         )
+
+    def chain_len(self) -> int:
+        """Flat length of the device-resident sample buffer (0 = no buffer).
+
+        The engine validates its per-row ``chain_src`` indices against this
+        before dispatching a chained step."""
+        return 0 if self._chain_tokens is None else int(self._chain_tokens.shape[0])
 
     def reset_chain(self) -> None:
         self._chain_tokens = None
@@ -1056,6 +1274,29 @@ class DeviceStepTokens:
             return toks, None
         chosen, top_ids, top_lps = self._aux
         return toks, {
+            "logprob": np.asarray(chosen)[: self._b_real],
+            "top_ids": np.asarray(top_ids)[: self._b_real],
+            "top_lps": np.asarray(top_lps)[: self._b_real],
+        }
+
+
+class DeviceSpecTokens:
+    """Handle to a dispatched speculative verify's target tokens (and
+    optional logprob aux), device-resident (``ModelRunner.spec_step_async``)."""
+
+    def __init__(self, targets: jax.Array, aux, b_real: int) -> None:
+        self._targets = targets  # [Bp, V]
+        self._aux = aux
+        self._b_real = b_real
+
+    def result(self) -> tuple[np.ndarray, dict | None]:
+        """Block until on host; returns (targets i32[B_real, V], lp_aux|None)
+        — the same values :meth:`ModelRunner.spec_step` returns."""
+        targets = np.asarray(self._targets)[: self._b_real]
+        if self._aux is None:
+            return targets, None
+        chosen, top_ids, top_lps = self._aux
+        return targets, {
             "logprob": np.asarray(chosen)[: self._b_real],
             "top_ids": np.asarray(top_ids)[: self._b_real],
             "top_lps": np.asarray(top_lps)[: self._b_real],

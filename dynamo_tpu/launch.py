@@ -155,6 +155,10 @@ class WorkerSpec:
                 env_flag(os.environ, "DYN_OVERLAP")
                 or env_flag(os.environ, "DYN_WORKER_OVERLAP")
             ),
+            overlap_spec=(
+                env_flag(os.environ, "DYN_OVERLAP_SPEC", default=True)
+                and env_flag(os.environ, "DYN_WORKER_OVERLAP_SPEC", default=True)
+            ),
         )
         defaults.update(engine_kw)
         return EngineConfig(**defaults)

@@ -103,34 +103,120 @@ class MockRunner:
             return toks, self._lp_aux(toks, lp_k)
         return toks
 
-    def step_async(self, batch: StepBatch, lp_k: int = 0, *, chain: bool = False):
+    def _mixed_compute_us(self, batch: StepBatch) -> float:
+        """Timing for a (possibly mixed) step: every row pays the decode
+        per-seq cost, extra real columns (prefill-chunk tokens) pay the
+        per-token prefill cost on top."""
+        b, t = batch.tokens.shape
+        if batch.num_new is not None:
+            total_new = int(np.asarray(batch.num_new).sum())
+        else:
+            total_new = int((batch.last_token_index + 1).sum()) if t > 1 else b
+        return (
+            self.decode_us_base
+            + self.decode_us_per_seq * b
+            + self.prefill_us_per_token * max(0, total_new - b)
+        )
+
+    def _chain_col0(self, batch: StepBatch, chain: bool, chain_src) -> np.ndarray:
+        """Column-0 input token per row, with per-row chain sourcing from the
+        flat host-side sample buffer (mirrors runner._apply_chain)."""
+        tok0 = batch.tokens[:, 0].copy()
+        if not chain:
+            return tok0
+        assert self._chain_host is not None, "chained step requires a previous async step"
+        b = tok0.shape[0]
+        src = np.arange(b, dtype=np.int32) if chain_src is None else np.asarray(chain_src, np.int32)
+        sel = src >= 0
+        assert not sel.any() or int(src.max()) < self._chain_host.shape[0], (
+            "chain_src points past the sample buffer"
+        )
+        tok0[sel] = self._chain_host[src[sel]]
+        return tok0
+
+    def step_async(self, batch: StepBatch, lp_k: int = 0, *, chain: bool = False,
+                   chain_src=None):
         """Mock of ModelRunner.step_async: returns a handle whose ``result()``
         blocks until the simulated device finishes this step's compute plus
         the d2h copy. Dispatch itself never blocks — consecutive chained
         dispatches queue on ``_busy_until``, so wall time per token in the
-        overlapped loop is ~max(compute, d2h) instead of compute + d2h."""
+        overlapped loop is ~max(compute, d2h) instead of compute + d2h.
+        Mixed batches (T > 1) and per-row ``chain_src`` sourcing mirror the
+        real runner's contract."""
         b = batch.tokens.shape[0]
-        compute = self.decode_us_base + self.decode_us_per_seq * b
+        compute = self._mixed_compute_us(batch)
         self.busy_us += compute
         self.simulated_us += compute + self.d2h_us
         now = time.monotonic()
         start = max(now, self._busy_until)
         self._busy_until = start + compute / 1e6
         ready_at = self._busy_until + self.d2h_us / 1e6
-        if chain:
-            assert self._chain_host is not None and self._chain_host.shape[0] == b, (
-                "chained step requires a previous step with identical batch"
-            )
-            tok = self._chain_host
-        else:
-            tok = batch.tokens[:, 0]
-        toks = self._tokens_for(batch.positions[:, 0], tok)
+        tokens = batch.tokens.copy()
+        tokens[:, 0] = self._chain_col0(batch, chain, chain_src)
+        last_tok = tokens[np.arange(b), batch.last_token_index]
+        last_pos = batch.positions[np.arange(b), batch.last_token_index]
+        toks = self._tokens_for(last_pos, last_tok)
         self._chain_host = toks
         aux = self._lp_aux(toks, lp_k) if lp_k else None
         return MockStepTokens(self, toks, aux, ready_at)
 
+    def _spec_targets(self, batch: StepBatch, verify_width: int,
+                      tokens: np.ndarray) -> np.ndarray:
+        """Exact-replay verify targets: column j's target is the token the
+        sequential mock would generate from column j's input at its position
+        (clamped to the row's last real column, like the device kernel)."""
+        b = batch.tokens.shape[0]
+        start = (batch.spec_start if batch.spec_start is not None
+                 else np.zeros(b, np.int32))
+        vi = np.minimum(
+            start[:, None] + np.arange(verify_width, dtype=np.int32)[None, :],
+            batch.last_token_index[:, None],
+        )
+        rows = np.arange(b)[:, None]
+        return self._tokens_for(batch.positions[rows, vi], tokens[rows, vi])
+
+    def spec_step(self, batch: StepBatch, verify_width: int, lp_k: int = 0):
+        """Mock speculative verify (spec_k support for mock fleets)."""
+        compute = self._mixed_compute_us(batch)
+        self.busy_us += compute
+        self._sleep_us(compute + self.d2h_us)
+        targets = self._spec_targets(batch, verify_width, batch.tokens)
+        if lp_k:
+            return targets, self._spec_lp_aux(targets, lp_k)
+        return targets
+
+    def _spec_lp_aux(self, targets: np.ndarray, lp_k: int) -> dict:
+        base = self._lp_aux(targets[:, 0], lp_k)
+        aux = {
+            "logprob": np.broadcast_to(base["logprob"][:, None], targets.shape).copy(),
+            "top_ids": np.broadcast_to(base["top_ids"][:, None, :], (*targets.shape, lp_k)).copy(),
+            "top_lps": np.broadcast_to(base["top_lps"][:, None, :], (*targets.shape, lp_k)).copy(),
+        }
+        aux["top_ids"][..., 0] = targets
+        return aux
+
+    def spec_step_async(self, batch: StepBatch, verify_width: int, lp_k: int = 0, *,
+                        chain_src=None):
+        """Mock of ModelRunner.spec_step_async: verify as the pipeline's
+        lookahead; targets become the flat chain buffer [B*V]."""
+        compute = self._mixed_compute_us(batch)
+        self.busy_us += compute
+        self.simulated_us += compute + self.d2h_us
+        start = max(time.monotonic(), self._busy_until)
+        self._busy_until = start + compute / 1e6
+        ready_at = self._busy_until + self.d2h_us / 1e6
+        tokens = batch.tokens.copy()
+        tokens[:, 0] = self._chain_col0(batch, chain_src is not None, chain_src)
+        targets = self._spec_targets(batch, verify_width, tokens)
+        self._chain_host = targets.reshape(-1)
+        aux = self._spec_lp_aux(targets, lp_k) if lp_k else None
+        return MockSpecTokens(self, targets, aux, ready_at)
+
     def can_chain(self, batch_size: int) -> bool:
         return self._chain_host is not None and self._chain_host.shape[0] == batch_size
+
+    def chain_len(self) -> int:
+        return 0 if self._chain_host is None else int(self._chain_host.shape[0])
 
     def reset_chain(self) -> None:
         self._chain_host = None
@@ -180,6 +266,24 @@ class MockStepTokens:
             if wait > 0:
                 time.sleep(wait)
         return self._toks[:, None], self._aux
+
+
+class MockSpecTokens:
+    """Handle to a MockRunner.spec_step_async dispatch (mirrors
+    DeviceSpecTokens)."""
+
+    def __init__(self, runner: MockRunner, targets: np.ndarray, aux, ready_at: float) -> None:
+        self._runner = runner
+        self._targets = targets
+        self._aux = aux
+        self._ready_at = ready_at
+
+    def result(self):
+        if self._runner.realtime:
+            wait = self._ready_at - time.monotonic()
+            if wait > 0:
+                time.sleep(wait)
+        return self._targets, self._aux
 
 
 def build_mock_core(

@@ -158,6 +158,16 @@ class EngineMetrics:
             "constraints, penalties)",
             ["worker", "mode"], registry=self.registry,
         )
+        self._overlap_barriers = Gauge(
+            "dynamo_engine_overlap_barrier_total",
+            "Overlap barrier steps by the condition that forced them: "
+            "'cancel'/'drain' (in-flight state invalidated), 'spec' (verify "
+            "harvest or DYN_OVERLAP_SPEC off), 'prefill' (whole-prompt XOR "
+            "mode), 'constraint'/'mm'/'multistep'/'runner' (composition the "
+            "graph cannot absorb), 'pages' (lookahead page reservation "
+            "failed), 'fill'/'idle' (nothing to chain)",
+            ["worker", "reason"], registry=self.registry,
+        )
         self.prefill_queue_depth = gauge(
             f"{ns}_prefill_queue_depth", "Unclaimed tasks in the distributed prefill queue"
         )
@@ -306,6 +316,11 @@ class EngineMetrics:
             self._overlap_steps.clear()
             for mode, n in overlap_counts.items():
                 self._overlap_steps.labels(self.worker, mode).set(n)
+        barrier_counts = getattr(core, "overlap_barrier_counts", None)
+        if barrier_counts is not None:
+            self._overlap_barriers.clear()
+            for reason, n in barrier_counts.items():
+                self._overlap_barriers.labels(self.worker, reason).set(n)
 
     def _sync_transfer(self) -> None:
         if self._transfer is None:

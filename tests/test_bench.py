@@ -196,6 +196,10 @@ def test_overlap_probe_structure(monkeypatch):
     monkeypatch.setenv("BENCH_OVERLAP_OSL", "24")
     monkeypatch.setenv("BENCH_OVERLAP_DECODE_US", "1500")
     monkeypatch.setenv("BENCH_OVERLAP_D2H_US", "1200")
+    monkeypatch.setenv("BENCH_OVERLAP_MIXED_DECODERS", "3")
+    monkeypatch.setenv("BENCH_OVERLAP_MIXED_ISL", "96")
+    monkeypatch.setenv("BENCH_OVERLAP_MIXED_OSL", "16")
+    monkeypatch.setenv("BENCH_OVERLAP_MIXED_CHUNK", "32")
     out = bench.probe_engine_overlap()
     assert out["decoders"] == 2 and out["osl"] == 24
     for mode in ("sync", "overlap"):
@@ -211,6 +215,19 @@ def test_overlap_probe_structure(monkeypatch):
     assert out["overlap"]["device_idle_frac"] < out["sync"]["device_idle_frac"]
     assert out["device_idle_frac"] == out["overlap"]["device_idle_frac"]
     assert out["engine_overlap_itl_gain"] > 1.0
+    # Mixed-traffic variant (ISSUE 11): staggered admission + chunked
+    # prefill must ride the chained pipeline, not barrier it away.
+    mixed = out["mixed"]
+    assert mixed["bit_identical"] is True
+    assert mixed["sync"]["overlap_steps"] == {"overlapped": 0, "barrier": 0}
+    mo = mixed["overlap"]
+    for key in ("mode", "elapsed_s", "itl_mean_ms", "overlap_steps",
+                "barrier_reasons", "overlap_chained_frac"):
+        assert key in mo, f"mixed overlap missing {key}"
+    assert mo["overlap_steps"]["overlapped"] > 0
+    assert out["overlap_chained_frac"] == mo["overlap_chained_frac"]
+    assert out["overlap_chained_frac"] >= 0.9  # the ISSUE 11 acceptance bar
+    assert out["engine_overlap_mixed_itl_gain"] > 0.0
 
 
 def test_bench_doc_goodput_keys():
@@ -259,10 +276,15 @@ def test_bench_doc_goodput_keys():
     assert doc5["engine_overlap_itl_gain"] == 0.0  # probe absent: stable default
     # Overlapped-execution headline keys (ISSUE 10) surface from the probe.
     ov = {"engine_overlap_itl_gain": 1.7523, "device_idle_frac": 0.0508,
-          "bit_identical": True}
+          "bit_identical": True, "overlap_chained_frac": 0.9412,
+          "engine_overlap_mixed_itl_gain": 1.31}
     doc6 = bench.build_doc(configs, pull={}, overlap=ov)
     assert doc6["engine_overlap_itl_gain"] == 1.7523
     assert doc6["device_idle_frac"] == 0.0508
+    # Always-on overlap headline keys (ISSUE 11) surface from the probe.
+    assert doc6["overlap_chained_frac"] == 0.9412
+    assert doc6["engine_overlap_mixed_itl_gain"] == 1.31
+    assert doc5["overlap_chained_frac"] == 0.0  # probe absent: stable default
     assert doc6["detail"]["engine_overlap_probe"] == ov
     # An all-errors suite still emits the full key set.
     empty = bench.build_doc([{"preset": "x", "error": "boom"}], pull={})

@@ -383,6 +383,73 @@ async def test_engine_step_crash_fails_streams_and_recovers():
         await svc.close()
 
 
+def test_engine_step_crash_with_lookahead_inflight_drains_cleanly():
+    """satellite (ISSUE 11): a crash inside ``engine.step`` while a chained
+    lookahead is in flight must drain the whole pipeline — the in-flight
+    handle is aborted (its rows counted in the CRASH flight record), every
+    page returns to the allocator, and the engine serves fresh work on the
+    next request."""
+    from dynamo_tpu.engine.core import EngineConfig
+    from dynamo_tpu.mocker import build_mock_core
+    from dynamo_tpu.observability.flight import CRASH
+    from dynamo_tpu.protocols.common import (
+        FinishReason, PreprocessedRequest, SamplingOptions, StopConditions,
+    )
+
+    core = build_mock_core(EngineConfig(
+        num_pages=128, page_size=16, max_batch_size=8, max_seq_len=512,
+        chunk_prefill_tokens=64, overlap=True, enable_prefix_caching=False,
+    ), realtime=False)
+
+    def req():
+        return PreprocessedRequest(
+            token_ids=list(range(1, 25)), sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=16, ignore_eos=True),
+        )
+
+    seqs = [core.add_request(req()) for _ in range(3)]
+    for _ in range(6):  # prime the pipeline: fill step, then chained steps
+        core.step()
+        if core._inflight is not None and core.overlap_step_counts.get("overlapped"):
+            break
+    assert core._inflight is not None, "no lookahead in flight"
+    inflight_rows = len(core._inflight.batch)
+
+    orig = core.runner.step_async
+
+    def boom(*a, **k):
+        raise CrashFault("engine.step")
+
+    core.runner.step_async = boom
+    try:
+        with pytest.raises(CrashFault):
+            core.step()
+    finally:
+        core.runner.step_async = orig
+
+    # The abort drained the in-flight handle and the freshly built batch:
+    # nothing queued, nothing leaked, the crash record counts the rows.
+    crashes = core.flight.snapshot(kind=CRASH)
+    assert crashes, "step crash left no flight record"
+    rec = crashes[-1]
+    assert rec["error"] == "CrashFault"
+    assert rec["inflight_rows"] >= inflight_rows > 0
+    assert core._inflight is None
+    assert not core.has_work
+    assert core.allocator.stats().active_pages == 0  # no leaked pages
+    assert all(s.finish_reason is FinishReason.ERROR for s in seqs)
+
+    # Recovery: the very next request completes normally.
+    fresh = core.add_request(req())
+    for _ in range(64):
+        if not core.has_work:
+            break
+        core.step()
+    assert fresh.finish_reason is FinishReason.LENGTH
+    assert fresh.num_generated == 16
+    assert core.allocator.stats().active_pages == 0
+
+
 def test_sched_admit_fault_drill():
     """satellite (c, ISSUE 9): a drop injected at the admission seam
     (``sched.admit``) cancels exactly the request being admitted — its

@@ -139,6 +139,7 @@ class _FakeCore:
     step_gap_ms_sum = 10.0
     step_gap_ms_count = 8
     overlap_step_counts = {"overlapped": 6, "barrier": 2}
+    overlap_barrier_counts = {"spec": 1, "drain": 1}
     waiting = ["a"]
     running = ["b", "c"]
     prefilling = ["d"]
@@ -203,6 +204,7 @@ EXPECTED_ENGINE_FAMILIES = {
     "dynamo_engine_step_gap_ms",
     "dynamo_engine_step_gap_ms_mean",
     "dynamo_engine_overlap_steps_total",
+    "dynamo_engine_overlap_barrier_total",
     "dynamo_engine_admission_queue_depth",
     "dynamo_engine_deadline_misses_total",
     "dynamo_tenant_throttled_total",
@@ -250,6 +252,8 @@ async def test_engine_metrics_names_labels_and_values():
     assert 'dynamo_engine_step_gap_ms_mean{worker="w1"} 1.25' in text
     assert 'dynamo_engine_overlap_steps_total{mode="overlapped",worker="w1"} 6.0' in text
     assert 'dynamo_engine_overlap_steps_total{mode="barrier",worker="w1"} 2.0' in text
+    assert 'dynamo_engine_overlap_barrier_total{reason="spec",worker="w1"} 1.0' in text
+    assert 'dynamo_engine_overlap_barrier_total{reason="drain",worker="w1"} 1.0' in text
     assert 'dynamo_engine_pages_active{worker="w1"} 40.0' in text
     assert 'dynamo_engine_page_utilization_ratio{worker="w1"} 0.625' in text
     # fragmentation = cached / (free + cached) = 8 / 24

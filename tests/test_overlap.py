@@ -1,4 +1,4 @@
-"""Overlapped execution pipeline (ISSUE 10).
+"""Overlapped execution pipeline (ISSUE 10, generalized by ISSUE 11).
 
 The contract under test: with ``overlap=True`` (DYN_OVERLAP) the engine
 emits *bit-identical* token streams AND logprobs to ``overlap=False`` —
@@ -8,8 +8,14 @@ device->host boundary, never what was sampled: the chained step's input
 tokens are the same values the host would have shipped, its rng fold
 counter advances exactly as the synchronous loop's would, and a stop
 detected one step late cancels the in-flight row (token discarded, pages
-released) instead of emitting it. Also covered: spec_k>0 barrier fallback,
-the offload-batch async gather routing, and the launch-side DYN_OVERLAP
+released) instead of emitting it.
+
+ISSUE 11 erased the hot barriers, so the parity net now also pins the
+newly chained compositions: mixed prefill+decode steps, penalized rows
+(history written in-graph), ``spec_k>0`` (verify chain-out), and
+budget-clamped final tokens (in-graph pos_limit mask instead of a host
+drain). Also covered: barrier-reason accounting, the offload-batch async
+gather routing, and the launch-side DYN_OVERLAP / DYN_OVERLAP_SPEC
 resolution.
 """
 
@@ -145,6 +151,17 @@ def test_overlap_bit_identical_with_staggered_admission():
     assert over == base
     assert core.overlap_step_counts["overlapped"] > 0
     assert core.allocator.stats().active_pages == 0
+    # Barrier-reason observability (ISSUE 11): every armed STEP record
+    # names its pipeline mode; barrier steps carry the condition that
+    # forced them, and the engine aggregates the same per-reason counts.
+    from dynamo_tpu.observability.flight import STEP
+
+    steps = [r for r in core.flight.snapshot(kind=STEP) if r.get("overlap_mode")]
+    assert steps, "no armed STEP records"
+    barriers = [r for r in steps if r["overlap_mode"] == "barrier"]
+    assert all(r.get("barrier_reason") for r in barriers)
+    assert all("chained_rows" in r for r in steps)
+    assert sum(core.overlap_barrier_counts.values()) == len(barriers)
 
 
 # -- late-stop cancellation --------------------------------------------------
@@ -230,9 +247,9 @@ def test_chained_dispatch_fold_counter_matches_sync(monkeypatch):
     calls = []
     orig = core.runner.step_async
 
-    def spy(batch, lp_k=0, *, chain=False):
+    def spy(batch, lp_k=0, *, chain=False, chain_src=None):
         calls.append((bool(chain), int(np.asarray(batch.sample_steps)[0])))
-        return orig(batch, lp_k=lp_k, chain=chain)
+        return orig(batch, lp_k=lp_k, chain=chain, chain_src=chain_src)
 
     monkeypatch.setattr(core.runner, "step_async", spy)
     seq = core.add_request(PreprocessedRequest(
@@ -257,39 +274,159 @@ def test_chained_dispatch_fold_counter_matches_sync(monkeypatch):
     assert any(chained for chained, _ in calls)  # the pipeline actually chained
 
 
-# -- composition barriers ----------------------------------------------------
+# -- newly chained compositions (ISSUE 11) -----------------------------------
 
 
-def test_spec_k_takes_barrier_priority_over_overlap():
-    """overlap + spec_k: the verify dispatch supersedes the overlapped loop
-    (drafts already amortize the round trip) — streams stay bit-identical
-    to the plain baseline and no chained step is ever dispatched."""
+@pytest.mark.parametrize("preset", ["test-tiny", "test-tiny-mla"])
+def test_mixed_prefill_decode_interleave_chains(preset):
+    """A long prompt admitted mid-decode: its chunked prefill rides the same
+    overlapped steps as the decoding rows (per-row token sourcing), with
+    every stream bit-identical and no 'prefill' barriers taken."""
+    vocab = PRESETS[preset].vocab_size
+
+    def run(overlap):
+        core = make_core(preset, overlap=overlap, chunk=8)
+        reqs = _requests(vocab)
+        tokens, lps = {}, {}
+        for req in reqs[:1] + reqs[2:]:
+            seq = core.add_request(req)
+            tokens[seq.seq_id] = []
+            lps[seq.seq_id] = []
+        steps = 0
+        late_added = False
+        while core.has_work and steps < 400:
+            if steps == 3 and not late_added:
+                seq = core.add_request(reqs[1])  # 26-token prompt: 4 chunks
+                tokens[seq.seq_id] = []
+                lps[seq.seq_id] = []
+                late_added = True
+            for seq, out in core.step():
+                tokens[seq.seq_id].extend(out.token_ids)
+                if out.logprobs:
+                    lps[seq.seq_id].extend(out.logprobs)
+            steps += 1
+        assert not core.has_work
+        return tokens, lps, core
+
+    base_tok, base_lp, _ = run(False)
+    over_tok, over_lp, core = run(True)
+    assert over_tok == base_tok
+    assert over_lp == base_lp
+    counts = core.overlap_step_counts
+    assert counts["overlapped"] > counts.get("barrier", 0)
+    assert "prefill" not in core.overlap_barrier_counts  # chunks chained
+    assert core.allocator.stats().active_pages == 0
+
+
+def test_spec_k_chains_with_overlap():
+    """overlap + spec_k compose: the verify's accepted tokens stay device
+    resident and feed the next dispatch — bit-identical to the plain
+    baseline with both speculation and chaining engaged."""
     reqs = lambda: [PreprocessedRequest(  # noqa: E731 - periodic prompt drafts well
+        token_ids=[5, 7, 5, 7, 5, 7, 9, 11],
+        sampling=SamplingOptions(temperature=0.0, logprobs=2),
+        stop=StopConditions(max_tokens=12, ignore_eos=True),
+    )]
+    base_tok, base_lp = run_all(make_core(), reqs())
+    core = make_core(overlap=True, spec_k=3)
+    spec_tok, spec_lp = run_all(core, reqs())
+    assert spec_tok == base_tok
+    assert spec_lp == base_lp
+    assert core.spec_tokens_proposed > 0  # speculation engaged
+    assert core.overlap_step_counts["overlapped"] > 0  # and still pipelined
+
+
+def test_overlap_spec_off_barriers_to_sync_verify():
+    """DYN_OVERLAP_SPEC=0: speculation must not be silently dropped — the
+    engine barriers to the synchronous verify path (reason 'spec') and
+    stays bit-identical."""
+    reqs = lambda: [PreprocessedRequest(  # noqa: E731
         token_ids=[5, 7, 5, 7, 5, 7, 9, 11],
         sampling=SamplingOptions(temperature=0.0),
         stop=StopConditions(max_tokens=12, ignore_eos=True),
     )]
     base_tok, _ = run_all(make_core(), reqs())
-    core = make_core(overlap=True, spec_k=3)
+    core = make_core(overlap=True, spec_k=3, overlap_spec=False)
     spec_tok, _ = run_all(core, reqs())
     assert spec_tok == base_tok
-    assert core.spec_tokens_proposed > 0  # speculation engaged
+    assert core.spec_tokens_proposed > 0  # speculation still engaged
     assert core.overlap_step_counts["overlapped"] == 0  # overlap stood down
+    assert core.overlap_barrier_counts.get("spec", 0) > 0
 
 
-def test_penalized_sampling_barriers():
-    """Repetition penalties need fresh host history per step: those batches
-    must take the synchronous path, bit-identically."""
+def test_penalized_sampling_chains():
+    """Penalized rows no longer barrier: the chained token's history count
+    is written in-graph, so presence/frequency/repetition penalties see
+    the same history the synchronous loop would."""
     req = lambda: PreprocessedRequest(  # noqa: E731
         token_ids=[5, 7, 5, 7, 9, 11],
-        sampling=SamplingOptions(temperature=0.8, seed=3, frequency_penalty=0.5),
+        sampling=SamplingOptions(
+            temperature=0.8, seed=3, frequency_penalty=0.5,
+            presence_penalty=0.3, logprobs=2,
+        ),
         stop=StopConditions(max_tokens=12, ignore_eos=True),
     )
-    base_tok, _ = run_all(make_core(), [req()])
+    base_tok, base_lp = run_all(make_core(), [req()])
     core = make_core(overlap=True)
-    over_tok, _ = run_all(core, [req()])
+    over_tok, over_lp = run_all(core, [req()])
+    assert over_tok == base_tok
+    assert over_lp == base_lp
+    assert core.overlap_step_counts["overlapped"] > 0  # penalties chained
+
+
+@pytest.mark.parametrize("preset", ["test-tiny", "test-tiny-mla"])
+def test_budget_clamped_final_token_chains(preset):
+    """Rows one token from max_tokens used to force a drain (the chained
+    write could overrun the page/pos budget); the in-graph pos_limit mask
+    clamps it instead. A short row finishing mid-pipeline must not barrier
+    the surviving rows or corrupt their streams."""
+    vocab = PRESETS[preset].vocab_size
+    reqs = lambda: [  # noqa: E731
+        PreprocessedRequest(
+            token_ids=[5, 7, 5, 7, 9, 11],
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=3, ignore_eos=True),  # ends in-pipe
+        ),
+        PreprocessedRequest(
+            token_ids=[i % (vocab - 2) + 1 for i in range(9)],
+            sampling=SamplingOptions(temperature=0.7, seed=13, logprobs=2),
+            stop=StopConditions(max_tokens=14, ignore_eos=True),
+        ),
+    ]
+    base_tok, base_lp = run_all(make_core(preset), reqs())
+    core = make_core(preset, overlap=True)
+    over_tok, over_lp = run_all(core, reqs())
+    assert over_tok == base_tok
+    assert over_lp == base_lp
+    assert [len(t) for t in over_tok.values()] == [3, 14]  # exact budgets
+    assert core.overlap_step_counts["overlapped"] > 0
+    assert core.allocator.stats().active_pages == 0
+
+
+def test_multistep_burst_keeps_its_own_pipeline_under_overlap():
+    """overlap + decode_steps>1: the fused burst already amortizes the
+    round trip, so the chained loop stands down (reason 'multistep') and
+    the burst pipeline keeps its own in-flight handle — bit-identically,
+    admission drains included."""
+    reqs = lambda: [  # noqa: E731
+        PreprocessedRequest(
+            token_ids=[5, 7, 5, 7, 9, 11],
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=12, ignore_eos=True),
+        ),
+        PreprocessedRequest(
+            token_ids=[3, 3, 3, 3, 2, 1],
+            sampling=SamplingOptions(temperature=0.7, seed=7),
+            stop=StopConditions(max_tokens=11, ignore_eos=True),
+        ),
+    ]
+    base_tok, _ = run_all(make_core(decode_steps=4), reqs())
+    core = make_core(overlap=True, decode_steps=4)
+    over_tok, _ = run_all(core, reqs())
     assert over_tok == base_tok
     assert core.overlap_step_counts["overlapped"] == 0
+    assert core.overlap_barrier_counts.get("multistep", 0) > 0
+    assert core.allocator.stats().active_pages == 0
 
 
 def test_overlap_off_never_touches_async_path(monkeypatch):
@@ -420,12 +557,17 @@ def test_launch_resolves_dyn_overlap(monkeypatch):
     )
     monkeypatch.delenv("DYN_OVERLAP", raising=False)
     monkeypatch.delenv("DYN_WORKER_OVERLAP", raising=False)
+    monkeypatch.delenv("DYN_OVERLAP_SPEC", raising=False)
+    monkeypatch.delenv("DYN_WORKER_OVERLAP_SPEC", raising=False)
     assert WorkerSpec._engine_cfg(card, {}).overlap is False
+    assert WorkerSpec._engine_cfg(card, {}).overlap_spec is True  # default on
     monkeypatch.setenv("DYN_OVERLAP", "1")
     assert WorkerSpec._engine_cfg(card, {}).overlap is True
     monkeypatch.delenv("DYN_OVERLAP")
     monkeypatch.setenv("DYN_WORKER_OVERLAP", "true")
     assert WorkerSpec._engine_cfg(card, {}).overlap is True
+    monkeypatch.setenv("DYN_OVERLAP_SPEC", "0")
+    assert WorkerSpec._engine_cfg(card, {}).overlap_spec is False
 
 
 def test_worker_settings_overlap_field(monkeypatch):
@@ -433,3 +575,7 @@ def test_worker_settings_overlap_field(monkeypatch):
 
     assert load_worker_settings(env={}).overlap is False
     assert load_worker_settings(env={"DYN_WORKER_OVERLAP": "1"}).overlap is True
+    assert load_worker_settings(env={}).overlap_spec is True
+    assert load_worker_settings(
+        env={"DYN_WORKER_OVERLAP_SPEC": "0"}
+    ).overlap_spec is False

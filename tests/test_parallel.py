@@ -63,6 +63,51 @@ def test_sharded_engine_matches_single_device():
         assert outputs[i] == greedy_reference(p, 5), f"sharded mismatch for prompt {i}"
 
 
+@pytest.mark.tpu_8
+def test_sharded_engine_overlap_bit_identical():
+    """The chained pipeline on a mesh runner (ISSUE 11 tentpole e —
+    multi-chip is where dispatch latency hurts most): overlapped execution
+    over dp×tp sharding stays token-identical to the synchronous sharded
+    engine AND to the single-device greedy reference, chunked prefill and
+    seeded sampling included."""
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest, SamplingOptions, StopConditions,
+    )
+
+    mesh = make_mesh(MeshPlan(dp=4, tp=2), jax.devices())
+    runner = ModelRunner(
+        CFG, PARAMS, num_pages=64, page_size=PAGE, max_batch_size=8,
+        prefill_bucket=16, attn_impl="reference", mesh=mesh,
+    )
+
+    def reqs():
+        return [
+            greedy_request([1, 2, 3, 4, 5], max_tokens=6, ignore_eos=True),
+            greedy_request([9, 8, 7], max_tokens=8, ignore_eos=True),
+            PreprocessedRequest(
+                token_ids=[2, 4, 6, 8, 10, 12, 3, 5, 7, 9, 11, 13, 2, 4, 6, 8, 1, 2],
+                sampling=SamplingOptions(temperature=0.7, seed=21),
+                stop=StopConditions(max_tokens=8, ignore_eos=True),
+            ),
+        ]
+
+    def run(overlap):
+        core = EngineCore(runner, EngineConfig(
+            num_pages=64, page_size=PAGE, max_batch_size=8, max_seq_len=128,
+            chunk_prefill_tokens=8, overlap=overlap,
+        ))
+        for r in reqs():
+            core.add_request(r)
+        return run_to_completion(core), core
+
+    base, _ = run(False)
+    over, core = run(True)
+    assert over == base
+    assert core.overlap_step_counts["overlapped"] > 0  # the mesh path chained
+    assert core.allocator.stats().active_pages == 0
+    assert base[0] == greedy_reference([1, 2, 3, 4, 5], 6)
+
+
 def test_mrope_forward_sharded_matches_single_device():
     """Qwen2-VL M-RoPE shards like everything else: the same 3D-rope
     forward under a dp*tp mesh reproduces the single-device logits (the
