@@ -1014,8 +1014,168 @@ def probe_engine_overlap() -> dict:
     }
 
 
+def probe_prefix_reuse() -> dict:
+    """Cache-aware serving probe (ISSUE 12): KV-tier reuse on vs off.
+
+    A prefix-heavy workload from the synthesizer (shared system prompt +
+    per-group few-shot prefixes + unique tails) replayed open-loop at fixed
+    QPS on the mock-timed engine. The warm pass runs one prefix-covering
+    request per group and write-through offloads their committed pages into
+    a G2 host tier whose reads carry a simulated per-block latency; the G1
+    prefix cache is then cleared, so every replay hit must come back
+    through async tier onboarding (DYN_ASYNC_ONBOARD path: background
+    fetch + batched write_pages landing, overlapped with other rows'
+    prefill/decode compute). The reuse-off pass replays the identical
+    arrival schedule with prefix caching disabled. Top-level bench JSON
+    promotes:
+
+      prefix_reuse_ttft_gain — reuse-off TTFT p50 over reuse-on TTFT p50
+        at the same fixed QPS (>1 means tier reuse shortened time to first
+        token);
+      prefix_onboard_overlap_frac — fraction of engine steps with an
+        onboarding session in flight that still dispatched fresh work
+        (tier fetch demonstrably overlapped with compute, not stalled).
+    """
+    from dynamo_tpu.bench.synthesizer import SyntheticConfig, synthesize
+    from dynamo_tpu.blocks import BlockManagerConfig, KvBlockManager
+    from dynamo_tpu.blocks.storage import HostStorage
+    from dynamo_tpu.engine.core import EngineConfig, EngineCore
+    from dynamo_tpu.mocker import MockRunner
+    from dynamo_tpu.protocols.common import PreprocessedRequest, SamplingOptions, StopConditions
+
+    groups = int(os.environ.get("BENCH_PREFIXREUSE_GROUPS", "4"))
+    n_requests = int(os.environ.get("BENCH_PREFIXREUSE_REQUESTS", "16"))
+    shared_isl = int(os.environ.get("BENCH_PREFIXREUSE_SHARED_ISL", "512"))
+    group_isl = int(os.environ.get("BENCH_PREFIXREUSE_GROUP_ISL", "256"))
+    unique_isl = int(os.environ.get("BENCH_PREFIXREUSE_UNIQUE_ISL", "64"))
+    osl = int(os.environ.get("BENCH_PREFIXREUSE_OSL", "16"))
+    qps = float(os.environ.get("BENCH_PREFIXREUSE_QPS", "40"))
+    chunk = int(os.environ.get("BENCH_PREFIXREUSE_CHUNK", "256"))
+    fetch_us = float(os.environ.get("BENCH_PREFIXREUSE_FETCH_US", "100"))
+    page_size = 16
+    isl = shared_isl + group_isl + unique_isl
+    num_pages = n_requests * ((isl + osl) // page_size + 2) + 64
+
+    workload = synthesize(SyntheticConfig(
+        num_requests=n_requests, shared_prefix_len=shared_isl,
+        num_groups=groups, group_prefix_len=group_isl, unique_len=unique_isl,
+        osl_mean=osl, osl_cv=0.0, vocab=31999, seed=5,
+    ))
+    prefix_len = (shared_isl + group_isl) // page_size * page_size
+    warm_prompts = {}  # group -> prefix-only prompt (page-aligned)
+    for req in workload:
+        warm_prompts.setdefault(req.group, req.token_ids[:prefix_len])
+
+    class SlowHostStorage(HostStorage):
+        """G2 payload reads pay a simulated tier latency — the window the
+        async onboarding session exists to hide under compute."""
+
+        def read(self, block_hash):
+            payload = super().read(block_hash)
+            if payload is not None and fetch_us > 0:
+                time.sleep(fetch_us / 1e6)
+            return payload
+
+        def exists(self, block_hash):  # membership probes stay cheap
+            return block_hash in self._data
+
+    def run(reuse_on: bool) -> dict:
+        cfg = EngineConfig(
+            num_pages=num_pages, page_size=page_size,
+            max_batch_size=n_requests, max_prefill_tokens=isl,
+            max_seq_len=isl + osl + 8, chunk_prefill_tokens=chunk,
+            enable_prefix_caching=reuse_on, async_onboard=reuse_on,
+        )
+        runner = MockRunner(num_pages=num_pages, page_size=page_size, realtime=True)
+        bm = None
+        if reuse_on:
+            bm = KvBlockManager(
+                BlockManagerConfig(g2_capacity_blocks=4096),
+                read_page=runner.read_page, write_page=runner.write_page,
+                write_pages=runner.write_pages, g2_storage=SlowHostStorage(),
+            )
+        core = EngineCore(runner, cfg, block_manager=bm)
+        if reuse_on:
+            # Warm pass: commit each group's shared prefix and write it
+            # through to G2, then drop G1 — replay reuse must onboard.
+            for prompt in warm_prompts.values():
+                core.add_request(PreprocessedRequest(
+                    token_ids=prompt, sampling=SamplingOptions(temperature=0.0),
+                    stop=StopConditions(max_tokens=2, ignore_eos=True),
+                ))
+            while core.has_work:
+                core.step()
+                core.flush_offloads()
+            core.allocator.clear_cache()
+        submit: dict[int, float] = {}
+        first: dict[int, float] = {}
+        arrivals = [i / qps for i in range(len(workload))]
+        i = 0
+        t0 = time.perf_counter()
+        while core.has_work or i < len(workload):
+            now = time.perf_counter() - t0
+            while i < len(workload) and now >= arrivals[i]:
+                seq = core.add_request(PreprocessedRequest(
+                    token_ids=workload[i].token_ids,
+                    sampling=SamplingOptions(temperature=0.0),
+                    stop=StopConditions(max_tokens=workload[i].max_tokens,
+                                        ignore_eos=True),
+                ))
+                submit[seq.seq_id] = time.perf_counter()
+                i += 1
+            if not core.has_work:
+                if i < len(workload):  # open-loop: idle until next arrival
+                    time.sleep(max(0.0, arrivals[i] - (time.perf_counter() - t0)))
+                continue
+            for seq, out in core.step():
+                if out.token_ids and seq.seq_id not in first:
+                    first[seq.seq_id] = time.perf_counter()
+            core.flush_offloads()
+        elapsed = time.perf_counter() - t0
+        ttfts = sorted(first[sid] - submit[sid] for sid in first)
+
+        def pct(xs, p):
+            return xs[min(len(xs) - 1, int(p * len(xs)))] if xs else 0.0
+
+        ob_steps = core.onboard_overlap_steps + core.onboard_stall_steps
+        return {
+            "mode": "reuse" if reuse_on else "cold",
+            "elapsed_s": round(elapsed, 3),
+            "ttft_p50_ms": round(pct(ttfts, 0.50) * 1e3, 2),
+            "ttft_p99_ms": round(pct(ttfts, 0.99) * 1e3, 2),
+            "onboard_sessions": core.onboard_sessions,
+            "onboard_pages_by_tier": dict(core.onboard_page_counts),
+            "onboard_shortfall_pages": core.onboard_shortfall_pages,
+            "onboard_overlap_steps": core.onboard_overlap_steps,
+            "onboard_stall_steps": core.onboard_stall_steps,
+            "onboard_overlap_frac": round(
+                core.onboard_overlap_steps / ob_steps, 4) if ob_steps else 0.0,
+            "onboard_wait_ms_mean": round(
+                core.onboard_wait_ms_sum / core.onboard_wait_count, 3
+            ) if core.onboard_wait_count else 0.0,
+            "cached_frac_last": core.last_admission.get("cached_frac", 0.0),
+        }
+
+    cold = run(False)
+    gc.collect()
+    reuse = run(True)
+    gc.collect()
+    return {
+        "groups": groups, "requests": n_requests, "qps": qps,
+        "isl": {"shared": shared_isl, "group": group_isl, "unique": unique_isl},
+        "osl": osl, "fetch_us_per_block": fetch_us,
+        "cold": cold,
+        "reuse": reuse,
+        "prefix_reuse_ttft_gain": round(
+            cold["ttft_p50_ms"] / reuse["ttft_p50_ms"], 4
+        ) if reuse["ttft_p50_ms"] > 0 else 0.0,
+        "prefix_onboard_overlap_frac": reuse["onboard_overlap_frac"],
+    }
+
+
 def build_doc(configs, pull, wire=None, stall=None, spec=None,
-              decode_kernel=None, slo_sched=None, overlap=None) -> dict:
+              decode_kernel=None, slo_sched=None, overlap=None,
+              prefix_reuse=None) -> dict:
     """The bench JSON document (one stdout line per emit).
 
     Module-level (not a closure) so its top-level key contract — the stable
@@ -1073,6 +1233,14 @@ def build_doc(configs, pull, wire=None, stall=None, spec=None,
         "overlap_chained_frac": (overlap or {}).get("overlap_chained_frac", 0.0),
         "engine_overlap_mixed_itl_gain": (overlap or {}).get(
             "engine_overlap_mixed_itl_gain", 0.0),
+        # Cache-aware serving headline keys (ISSUE 12): cold-over-reuse TTFT
+        # p50 at fixed QPS on the prefix-heavy workload, and the fraction of
+        # onboarding-pending steps that still dispatched fresh work (tier
+        # fetch overlapped with compute; see probe_prefix_reuse).
+        "prefix_reuse_ttft_gain": (prefix_reuse or {}).get(
+            "prefix_reuse_ttft_gain", 0.0),
+        "prefix_onboard_overlap_frac": (prefix_reuse or {}).get(
+            "prefix_onboard_overlap_frac", 0.0),
         "detail": {
             "backend": jax.default_backend(),
             "suite": [c.get("preset") for c in configs],
@@ -1082,6 +1250,7 @@ def build_doc(configs, pull, wire=None, stall=None, spec=None,
             "decode_kernel_probe": decode_kernel or {"pending": True},
             "slo_sched_probe": slo_sched or {"pending": True},
             "engine_overlap_probe": overlap or {"pending": True},
+            "prefix_reuse_probe": prefix_reuse or {"pending": True},
             "kv_pull": pull,
             "kv_wire_cross_process": wire or {"pending": True},
             "ttft_note": "ttft_idle_* is the drained-engine best case; "
@@ -1094,8 +1263,9 @@ def main() -> None:
     from dynamo_tpu.models.config import PRESETS
 
     def emit(configs, pull, wire=None, stall=None, spec=None, dk=None, ss=None,
-             ov=None):
-        print(json.dumps(build_doc(configs, pull, wire, stall, spec, dk, ss, ov)),
+             ov=None, pr=None):
+        print(json.dumps(build_doc(configs, pull, wire, stall, spec, dk, ss, ov,
+                                   pr)),
               flush=True)
 
     suite = parse_suite()
@@ -1156,16 +1326,23 @@ def main() -> None:
     emit(configs, {"pending": True}, stall=stall, spec=spec, dk=dk, ss=ss, ov=ov)
     gc.collect()
     try:
+        pr = probe_prefix_reuse()
+    except Exception as e:
+        pr = {"error": f"{type(e).__name__}: {e}"[:200]}
+    emit(configs, {"pending": True}, stall=stall, spec=spec, dk=dk, ss=ss, ov=ov,
+         pr=pr)
+    gc.collect()
+    try:
         pull = probe_kv_pull_gbps()
     except Exception as e:
         pull = {"error": f"{type(e).__name__}: {e}"[:200]}
-    emit(configs, pull, stall=stall, spec=spec, dk=dk, ss=ss, ov=ov)
+    emit(configs, pull, stall=stall, spec=spec, dk=dk, ss=ss, ov=ov, pr=pr)
     gc.collect()
     try:
         wire = probe_cross_process_wire()
     except Exception as e:
         wire = {"error": f"{type(e).__name__}: {e}"[:200]}
-    emit(configs, pull, wire, stall=stall, spec=spec, dk=dk, ss=ss, ov=ov)
+    emit(configs, pull, wire, stall=stall, spec=spec, dk=dk, ss=ss, ov=ov, pr=pr)
 
 
 if __name__ == "__main__":

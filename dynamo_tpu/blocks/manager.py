@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import logging
 import pathlib
+import threading
 from dataclasses import dataclass
 from typing import Callable
 
@@ -32,6 +33,7 @@ logger = logging.getLogger(__name__)
 
 ReadPage = Callable[[int], Payload]
 WritePage = Callable[[int, np.ndarray, np.ndarray], None]
+WritePages = Callable[[list, list, list], None]
 
 
 @dataclass
@@ -51,11 +53,20 @@ class KvBlockManager:
         *,
         read_page: ReadPage,
         write_page: WritePage,
+        write_pages: WritePages | None = None,
+        g2_storage=None,
         g4_storage=None,
     ) -> None:
         self.config = config
         self._read_page = read_page
         self._write_page = write_page
+        self._write_pages = write_pages
+        # Tier metadata + storage ops are guarded per block: the async
+        # onboarding session fetches payloads from a background thread while
+        # the engine thread offloads freshly committed pages into the same
+        # pools. Per-block granularity keeps a slow G3/G4 read from gating
+        # flush_offloads (and thus the next engine step) for a whole fetch.
+        self._lock = threading.RLock()
 
         # G4: deployment-wide remote tier (object store). Pass a
         # `storage.RemoteStorage` (launch wires it from the runtime store);
@@ -85,7 +96,8 @@ class KvBlockManager:
             elif self.g4 is not None:  # no disk tier: spill host -> remote
                 self.g4.put(block_hash, payload)
 
-        g2_storage = NullStorage() if config.null_storage else HostStorage()
+        if g2_storage is None:
+            g2_storage = NullStorage() if config.null_storage else HostStorage()
         self.g2 = TierPool("g2", g2_storage, config.g2_capacity_blocks, on_evict=cascade)
         self.offloaded = 0
         self.onboarded = 0
@@ -114,16 +126,17 @@ class KvBlockManager:
         """
         todo: list[tuple[int, int]] = []
         seen: set[int] = set()
-        for block_hash, page_id in items:
-            # Dedup against LOCAL membership only: a shared G4's full
-            # __contains__ does a remote round-trip per probe, which would
-            # gate flush_offloads (and thus the next engine step) on store
-            # latency for every freshly committed block. Re-offloading a
-            # block a peer already persisted is harmless.
-            if block_hash in seen or any(tier.has_local(block_hash) for tier in self._tiers):
-                continue
-            seen.add(block_hash)
-            todo.append((block_hash, page_id))
+        with self._lock:
+            for block_hash, page_id in items:
+                # Dedup against LOCAL membership only: a shared G4's full
+                # __contains__ does a remote round-trip per probe, which would
+                # gate flush_offloads (and thus the next engine step) on store
+                # latency for every freshly committed block. Re-offloading a
+                # block a peer already persisted is harmless.
+                if block_hash in seen or any(tier.has_local(block_hash) for tier in self._tiers):
+                    continue
+                seen.add(block_hash)
+                todo.append((block_hash, page_id))
         if not todo:
             return
         if read_pages_async is not None:
@@ -133,63 +146,99 @@ class KvBlockManager:
         else:
             payloads = [self._read_page(p) for _, p in todo]
         for (block_hash, _), payload in zip(todo, payloads):
-            self.g2.put(block_hash, payload)
+            with self._lock:
+                self.g2.put(block_hash, payload)
             self.offloaded += 1
 
     # -- onboard path ------------------------------------------------------
 
     def lookup(self, block_hash: int) -> Payload | None:
         """G2 first, then G3, then G4 (a deeper hit promotes back into G2)."""
+        with self._lock:
+            return self._lookup_tiered(block_hash)[0]
+
+    def _lookup_tiered(self, block_hash: int) -> tuple[Payload | None, str]:
         payload = self.g2.get(block_hash)
         if payload is not None:
-            return payload
-        for tier in (self.g3, self.g4):
+            return payload, "g2"
+        for name, tier in (("g3", self.g3), ("g4", self.g4)):
             if tier is None:
                 continue
             payload = tier.get(block_hash)
             if payload is not None:
                 self.g2.put(block_hash, payload)
-                return payload
-        return None
+                return payload, name
+        return None, ""
 
-    def probe_prefix(self, block_hashes: list[int], start: int) -> int:
+    def probe_prefix(self, block_hashes: list[int], start: int, *, local_only: bool = False) -> int:
         """How many consecutive blocks from ``start`` the tiers hold.
 
         Membership-only — no payload I/O. Admission uses this to budget and
         allocate pages first; payloads are fetched only once pages exist
         (otherwise each failed admission attempt would re-read from disk).
+        ``local_only`` skips a shared G4's remote fall-through probes —
+        the residual-cost *estimate* must not gate EDF prepare() on store
+        round-trips (it may undercount peers' blocks; pricing, not policy).
         """
         n = 0
-        for h in block_hashes[start:]:
-            if n >= self.config.onboard_limit:
-                break
-            if any(h in tier for tier in self._tiers):
-                n += 1
-            else:
-                break
+        with self._lock:
+            for h in block_hashes[start:]:
+                if n >= self.config.onboard_limit:
+                    break
+                if any(
+                    tier.has_local(h) if local_only else h in tier
+                    for tier in self._tiers
+                ):
+                    n += 1
+                else:
+                    break
         return n
 
     def fetch_prefix(self, block_hashes: list[int], start: int, count: int) -> list[Payload]:
         """Read up to ``count`` consecutive payloads; may return fewer if a
         block was evicted (or its payload lost) since the probe."""
-        out: list[Payload] = []
+        return self.fetch_prefix_tiered(block_hashes, start, count)[0]
+
+    def fetch_prefix_tiered(
+        self, block_hashes: list[int], start: int, count: int
+    ) -> tuple[list[Payload], list[str]]:
+        """``fetch_prefix`` plus the tier each payload came from.
+
+        The async onboarding session runs this off the engine thread; the
+        per-block lock in ``_lookup_tiered`` is what makes that safe against
+        concurrent offloads. Tier names feed the per-tier onboard metrics."""
+        payloads: list[Payload] = []
+        tiers: list[str] = []
         for h in block_hashes[start : start + count]:
-            payload = self.lookup(h)
+            with self._lock:
+                payload, tier = self._lookup_tiered(h)
             if payload is None:
                 break
-            out.append(payload)
-        return out
+            payloads.append(payload)
+            tiers.append(tier)
+        return payloads, tiers
 
     def onboard(self, page_ids: list[int], payloads: list[Payload]) -> None:
-        """Copy payloads host->device into the given (freshly-allocated) pages."""
-        for pid, (k, v) in zip(page_ids, payloads):
-            self._write_page(pid, k, v)
+        """Copy payloads host->device into the given (freshly-allocated) pages.
+
+        With a batched writer wired (``ModelRunner.write_pages``) N pages cost
+        one transfer + one scatter dispatch; the per-page path is the fallback
+        for runners without it."""
+        if not payloads:
+            return
+        if self._write_pages is not None and len(payloads) > 1:
+            pids = list(page_ids[: len(payloads)])
+            self._write_pages(pids, [k for k, _ in payloads], [v for _, v in payloads])
+        else:
+            for pid, (k, v) in zip(page_ids, payloads):
+                self._write_page(pid, k, v)
         self.onboarded += len(payloads)
 
     # -- admin -------------------------------------------------------------
 
     def clear(self) -> int:
-        return sum(tier.clear() for tier in self._tiers)
+        with self._lock:
+            return sum(tier.clear() for tier in self._tiers)
 
     def stats(self) -> dict:
         out = {"g2": self.g2.stats().__dict__, "offloaded": self.offloaded, "onboarded": self.onboarded}

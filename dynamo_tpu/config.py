@@ -191,6 +191,24 @@ class TenantSettings:
     quotas: str = ""
 
 
+@dataclasses.dataclass
+class CacheAwareSettings:
+    """Cache-aware serving knobs (residual-cost admission + router term).
+
+    The master toggle is the bare ``DYN_CACHE_AWARE`` flag (not part of
+    this section); these tune the plane once it is on. Env:
+    ``DYN_CACHE_AWARE_*``, TOML: ``[cache_aware]``.
+    """
+
+    weight: float = 1.0  # router cost weight for predicted residual prefill
+    # Prefill throughput assumed when converting residual tokens into
+    # seconds of predicted TTFT contribution for the router cost.
+    rate_tokens_per_s: float = 20000.0
+    # Router skips the cache term for a worker whose KV-event feed is
+    # staler than this — a stale index must not skew placement.
+    max_staleness_s: float = 10.0
+
+
 def load_runtime_settings(**kw) -> RuntimeSettings:
     return load_config(RuntimeSettings(), section="runtime", **kw)
 
@@ -209,3 +227,7 @@ def load_slo_sched_settings(**kw) -> SloSchedSettings:
 
 def load_tenant_settings(**kw) -> TenantSettings:
     return load_config(TenantSettings(), section="tenant", **kw)
+
+
+def load_cache_aware_settings(**kw) -> CacheAwareSettings:
+    return load_config(CacheAwareSettings(), section="cache_aware", **kw)

@@ -133,6 +133,20 @@ class PageAllocator:
             self._hits += 1
         return matched
 
+    def peek_prefix(self, block_hashes: Sequence[int]) -> int:
+        """Longest cached prefix length WITHOUT acquiring.
+
+        No refcount, MRU, or hit/miss effects: the admission plane's
+        residual-cost estimate runs this over every waiting sequence each
+        prepare(), and pricing must not perturb eviction order or pin pages
+        the request may never be admitted to use."""
+        n = 0
+        for h in block_hashes:
+            if h not in self._cached:
+                break
+            n += 1
+        return n
+
     def acquire(self, page_id: int) -> None:
         """Add a reference to an already-allocated page (e.g. fork/beam)."""
         info = self._pages[page_id]

@@ -128,6 +128,23 @@ class EngineConfig:
     # to feed the next one. Off forces a barrier on every spec step (the
     # pre-PR-11 behavior); output streams are identical either way.
     overlap_spec: bool = True
+    # Pipelined tier onboarding (DYN_ASYNC_ONBOARD; DYN_CACHE_AWARE also
+    # arms it): admission no longer blocks on G2/G3/G4 payload reads — a
+    # background session fetches them and they land through the batched
+    # write_pages scatter while other rows (and later the same row's own
+    # chunks) compute. The scheduler treats the pending pages like an
+    # in-flight chunk: num_cached advances only when the session lands; a
+    # fetch shortfall degrades to recompute exactly like the synchronous
+    # path. Off keeps onboarding synchronous inside _schedule_prefill.
+    async_onboard: bool = False
+    # Cache-aware scheduling (DYN_CACHE_AWARE): the admission plane prices a
+    # request by its *residual* (uncached) prefill tokens — resident G1
+    # match plus capacity-tier probe — so EDF slack ranks a mostly-cached
+    # long prompt ahead of a cold short one and tenant buckets charge only
+    # the tokens that will actually be computed. Policy-only: off is
+    # bit-identical to full-cost pricing. (The router's residual-prefill
+    # cost term is armed by the same knob via sched.configure_cache_aware.)
+    cache_aware: bool = False
 
 
 @dataclasses.dataclass
@@ -150,6 +167,28 @@ class _InflightStep:
     samples: list | None = None  # per-row: does the engine accept a sample?
     drafts: list | None = None  # per-decode-row draft tokens (spec)
     v: int = 1  # verify width (spec)
+
+
+@dataclasses.dataclass
+class _OnboardSession:
+    """An admitted row's in-flight tier onboarding (config.async_onboard).
+
+    The fetch thread fills ``payloads``/``tiers`` and sets ``done``; the
+    engine thread lands the session under ``step_lock`` (device write +
+    prefix-cache commit + ``num_cached`` advance) from ``_poll_onboards``.
+    Cancellation (preempt/finish/abort) simply removes the session from the
+    engine's list — the orphaned fetch thread finishes into this object and
+    nobody reads it, so stale payloads can never land in reused pages."""
+
+    seq: Sequence
+    hashes: list  # full block-hash chain of the sequence
+    start: int  # first onboard block index (== resident match length)
+    pages: list  # freshly-allocated G1 pages awaiting payloads
+    t0: float  # session start (perf_counter) for the wait histogram
+    count_at_start: bool  # fold landed pages into num_cached_at_start
+    payloads: list = dataclasses.field(default_factory=list)
+    tiers: list = dataclasses.field(default_factory=list)
+    done: threading.Event = dataclasses.field(default_factory=threading.Event)
 
 
 class EngineCore:
@@ -201,8 +240,30 @@ class EngineCore:
                 self.admission = build_admission_controller()
             if self.chunk_controller is None and config.chunk_prefill_tokens > 0:
                 self.chunk_controller = build_chunk_controller(config.chunk_prefill_tokens)
+        if config.cache_aware and self.admission is not None:
+            # Residual-cost admission (DYN_CACHE_AWARE): the EDF plane
+            # prices every waiting request by its uncached prefill tokens.
+            self.admission.cached_tokens_fn = self._cached_prefix_tokens
         # Last _schedule_prefill's admission outcome (flight STEP record).
-        self.last_admission = {"admitted": 0, "deferred": 0, "deadline_slack_ms": 0.0}
+        self.last_admission = {
+            "admitted": 0, "deferred": 0, "deadline_slack_ms": 0.0, "cached_frac": 0.0,
+        }
+        # Async tier onboarding (config.async_onboard): live sessions, the
+        # lazy fetch pool, and the counters the metrics/bench planes read.
+        self._onboards: list[_OnboardSession] = []
+        self._onboard_pool = None  # ThreadPoolExecutor, built on first use
+        self.onboard_sessions = 0
+        self.onboard_page_counts: dict[str, int] = {}  # tier -> pages landed
+        self.onboard_shortfall_pages = 0  # probed but gone at fetch: recomputed
+        self._onboard_waits: list[float] = []  # seconds; metrics plane drains
+        self.onboard_wait_ms_sum = 0.0
+        self.onboard_wait_count = 0
+        # Overlap accounting: of the steps that had a session in flight, how
+        # many still dispatched fresh device work (the pipelining win) vs
+        # idled waiting on the fetch. overlap_frac = overlap / (overlap+stall).
+        self.onboard_overlap_steps = 0
+        self.onboard_stall_steps = 0
+        self._onboard_pending_step = False
         # Speculative decoding: cumulative drafting/verify counters (metrics
         # plane syncs them; acceptance rate = accepted / proposed).
         self.spec_tokens_proposed = 0
@@ -475,6 +536,14 @@ class EngineCore:
             wall_ms = (time.perf_counter() - t0) * 1e3
             info = self.last_step_info
             fresh = info is not prev_info  # _run_mixed built a new dict
+            if self._onboard_pending_step:
+                # A tier fetch was in flight across this step: did the step
+                # still dispatch device work (overlapped) or idle on it?
+                if fresh:
+                    self.onboard_overlap_steps += 1
+                else:
+                    self.onboard_stall_steps += 1
+                self._onboard_pending_step = False
             if not fresh and not out and not self.running:
                 self._prev_step_end = time.perf_counter()
                 return out  # idle drain: nothing dispatched, nothing to record
@@ -550,6 +619,7 @@ class EngineCore:
                 admitted=int(self.last_admission.get("admitted", 0)),
                 deferred=int(self.last_admission.get("deferred", 0)),
                 deadline_slack_ms=self.last_admission.get("deadline_slack_ms", 0.0),
+                cached_frac=self.last_admission.get("cached_frac", 0.0),
                 gap_ms=round(gap_ms, 3),
                 overlap_mode=overlap_mode,
                 barrier_reason=barrier_reason,
@@ -755,6 +825,11 @@ class EngineCore:
         token is the legitimate next token of the continuation (no
         re-emission of old tokens).
         """
+        # Land any finished onboarding sessions first: their rows' num_cached
+        # advances here (engine thread, under step_lock), which both unblocks
+        # their next chunk and frees this step from re-probing them.
+        if self._onboards:
+            self._poll_onboards(wait=False)
         ps = self.config.page_size
         chunk_budget = self.chunk_budget_tokens()
         chunked = chunk_budget > 0
@@ -784,6 +859,12 @@ class EngineCore:
         for seq in self.prefilling:
             if budget <= 0:
                 break
+            if seq.onboard_pending:
+                # Tier payloads still in flight: the row's cached prefix is
+                # not final, so chunking it now would recompute tokens the
+                # session is about to land. Skipped exactly like a
+                # page-starved row; lands via _poll_onboards.
+                continue
             # A chunk already in flight counts as computed (overlap): the
             # next chunk starts where the in-flight one will leave off.
             dc = self._adv(seq)[0]
@@ -821,6 +902,8 @@ class EngineCore:
             # by the controller, and don't belong in this count.
             quota_deferred = len(self.waiting) - admissible
         n_admitted = 0
+        admit_cached = 0  # admission-time cached tokens (resident + probed)
+        admit_total = 0  # total prompt tokens admitted this step
         while (
             self.waiting
             and budget > 0
@@ -857,7 +940,24 @@ class EngineCore:
                         self.allocator.release([matched.pop()])
             cached_len = (len(matched) + onboard_n) * ps
             num_new = total - cached_len
-            if chunked:
+            # Pipelined onboarding (config.async_onboard): admit the row
+            # with only its onboard-region pages allocated and ZERO chunk —
+            # the tier payloads are fetched on a background thread and land
+            # through the batched write_pages scatter while other rows (and
+            # later this row's own chunks) compute. Legacy unchunked mode
+            # keeps the synchronous path: its whole-prompt admission has no
+            # later chunk for the session to overlap with.
+            async_ob = self.config.async_onboard and chunked and onboard_n > 0
+            if async_ob:
+                n = 0
+                try:
+                    new_pages = self.allocator.allocate(onboard_n)
+                except OutOfPagesError:
+                    self.allocator.release(matched)
+                    if not chunks and not self.running:
+                        self._note_head_stall(seq, num_new)
+                    break
+            elif chunked:
                 # First chunk: capped by the budget and by what the free
                 # pool can hold. (Onboard pages hold fully *cached* tokens,
                 # so any n >= 1 allocates at least the onboard_n pages.)
@@ -873,27 +973,33 @@ class EngineCore:
                 if chunks and n > budget:
                     self.allocator.release(matched)
                     break
-            pages_goal = -(-(cached_len + n) // ps)
-            try:
-                new_pages = self.allocator.allocate(pages_goal - len(matched))
-            except OutOfPagesError:
-                self.allocator.release(matched)
-                if not chunks and not self.running:
-                    self._note_head_stall(seq, num_new)
-                break
+            if not async_ob:
+                pages_goal = -(-(cached_len + n) // ps)
+                try:
+                    new_pages = self.allocator.allocate(pages_goal - len(matched))
+                except OutOfPagesError:
+                    self.allocator.release(matched)
+                    if not chunks and not self.running:
+                        self._note_head_stall(seq, num_new)
+                    break
             self.waiting.popleft()
             seq.admitted_time = time.monotonic()
             n_admitted += 1
+            admit_cached += cached_len
+            admit_total += total
             if self.admission is not None:
                 self.admission.on_admit(seq, seq.admitted_time)
-            if onboard_n:
+            if onboard_n and not async_ob:
                 # Pages exist now: fetch tier payloads, copy them in, and
                 # commit — they re-enter the G1 prefix cache and re-announce
                 # on the KV event plane. A fetch shortfall (evicted since the
                 # probe) just means those tokens get recomputed.
-                onboard = self.block_manager.fetch_prefix(hashes, len(matched), onboard_n)
+                onboard, tiers = self.block_manager.fetch_prefix_tiered(
+                    hashes, len(matched), onboard_n
+                )
                 if len(onboard) < onboard_n:
                     shortfall = onboard_n - len(onboard)
+                    self.onboard_shortfall_pages += shortfall
                     onboard_n = len(onboard)
                     cached_len = (len(matched) + onboard_n) * ps
                     n += min(shortfall * ps, total - cached_len - n)
@@ -902,16 +1008,34 @@ class EngineCore:
                 for i, pid in enumerate(new_pages[:onboard_n]):
                     blk = blocks[len(matched) + i]
                     self.allocator.commit(pid, blk.block_hash, blk.parent_hash, blk.tokens)
+                for tier in tiers[:onboard_n]:
+                    self.onboard_page_counts[tier] = (
+                        self.onboard_page_counts.get(tier, 0) + 1
+                    )
             seq.pages = matched + new_pages
-            seq.committed_pages = len(matched) + onboard_n
-            seq.num_cached = cached_len
             seq.prefill_chunks = 0
-            if seq.status is not SeqStatus.PREEMPTED:
-                seq.num_cached_at_start = cached_len
+            if async_ob:
+                # The onboard region is pending: cached state reflects only
+                # the resident match until the session lands (shortfall
+                # pages then degrade to plain compute pages).
+                seq.committed_pages = len(matched)
+                seq.num_cached = len(matched) * ps
+                if seq.status is not SeqStatus.PREEMPTED:
+                    seq.num_cached_at_start = seq.num_cached  # re-set at land
+                self._start_onboard(
+                    seq, hashes, len(matched), new_pages,
+                    count_at_start=seq.status is not SeqStatus.PREEMPTED,
+                )
+            else:
+                seq.committed_pages = len(matched) + onboard_n
+                seq.num_cached = cached_len
+                if seq.status is not SeqStatus.PREEMPTED:
+                    seq.num_cached_at_start = cached_len
             seq.status = SeqStatus.RUNNING
             self.prefilling.append(seq)
             budget -= n
-            chunks.append((seq, n))
+            if n:
+                chunks.append((seq, n))
         if chunks:
             self._head_stall_steps = 0
         elif (
@@ -919,6 +1043,7 @@ class EngineCore:
             and not self.running
             and len(self.prefilling) > 1
             and self._inflight is None
+            and not self._onboards
         ):
             # Nothing can move: mid-prompt sequences pin every page among
             # themselves. Preempt the most recently arrived one (its pages
@@ -927,15 +1052,23 @@ class EngineCore:
             # whole prompt passed the pool check in add_request). With a
             # step in flight, emptiness is progress (the in-flight chunks
             # land next step), not deadlock — never preempt a row whose
-            # chunk is mid-air.
+            # chunk is mid-air. An onboarding session in flight is progress
+            # for the same reason: its row's cached prefix lands shortly.
             self._preempt(self.prefilling[-1])
             return self._schedule_prefill()
+        if self._onboards and not chunks and not self.running:
+            # Nothing else to run: block briefly on the fetch instead of
+            # busy-spinning the step loop. Bounded wait — a hung tier read
+            # never wedges the engine; landed sessions schedule next step.
+            self._poll_onboards(wait=True)
+        self._onboard_pending_step = bool(self._onboards)
         self.last_admission = {
             "admitted": n_admitted,
             "deferred": quota_deferred,
             "deadline_slack_ms": (
                 round(self.admission.last_slack_ms, 3) if self.admission is not None else 0.0
             ),
+            "cached_frac": round(admit_cached / admit_total, 4) if admit_total else 0.0,
         }
         return chunks
 
@@ -947,6 +1080,125 @@ class EngineCore:
                 "(free %d pages) with nothing running; stalled %d steps",
                 seq.seq_id, num_new, self.allocator.num_free(), self._head_stall_steps,
             )
+
+    # -- async tier onboarding ---------------------------------------------
+
+    def _start_onboard(
+        self, seq: Sequence, hashes: list, start: int, pages: list, *, count_at_start: bool
+    ) -> None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        if self._onboard_pool is None:
+            self._onboard_pool = ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="kv-onboard"
+            )
+        sess = _OnboardSession(
+            seq=seq, hashes=list(hashes), start=start, pages=list(pages),
+            t0=time.perf_counter(), count_at_start=count_at_start,
+        )
+        seq.onboard_pending = len(pages)
+        self._onboards.append(sess)
+        self.onboard_sessions += 1
+        self._onboard_pool.submit(self._onboard_fetch, sess)
+
+    def _onboard_fetch(self, sess: _OnboardSession) -> None:
+        """Background path: tier reads only — never touches scheduler state
+        (the engine thread lands the session under step_lock). Any failure,
+        including an armed store.op fault on the G4 path, degrades to an
+        empty fetch: the row recomputes, the engine never sees the raise."""
+        try:
+            sess.payloads, sess.tiers = self.block_manager.fetch_prefix_tiered(
+                sess.hashes, sess.start, len(sess.pages)
+            )
+        except Exception:
+            logger.exception(
+                "tier fetch failed for seq %d; onboarding degrades to recompute",
+                sess.seq.seq_id,
+            )
+            sess.payloads, sess.tiers = [], []
+        finally:
+            sess.done.set()
+
+    def _cancel_onboards(self, seq: Sequence) -> None:
+        """Forget any session for ``seq`` (preempt/finish): its pages are
+        being released, so a later landing would scatter stale payloads into
+        reused pages. The orphaned fetch thread finishes into the dropped
+        session object, which nothing reads."""
+        if self._onboards:
+            self._onboards = [s for s in self._onboards if s.seq is not seq]
+        seq.onboard_pending = 0
+
+    def _poll_onboards(self, *, wait: bool) -> None:
+        """Land finished onboarding sessions (engine thread, under step_lock).
+
+        ``wait`` blocks briefly on the oldest session when the caller has
+        nothing else to schedule — bounded, so a hung tier read degrades to
+        a slow poll loop rather than a wedged engine."""
+        if wait and self._onboards:
+            self._onboards[0].done.wait(timeout=0.05)
+        rest: list[_OnboardSession] = []
+        for sess in self._onboards:
+            if sess.done.is_set():
+                self._land_onboard(sess)
+            else:
+                rest.append(sess)
+        self._onboards = rest
+
+    def _land_onboard(self, sess: _OnboardSession) -> None:
+        """Apply a finished session: batched device write, prefix-cache
+        commit, and the row's ``num_cached`` advance. A shortfall (blocks
+        evicted or a tier fault since the probe) leaves the trailing pages
+        as plain compute pages — the next chunk recomputes those tokens,
+        exactly like the synchronous path."""
+        seq = sess.seq
+        wait_s = time.perf_counter() - sess.t0
+        self._onboard_waits.append(wait_s)
+        self.onboard_wait_ms_sum += wait_s * 1e3
+        self.onboard_wait_count += 1
+        if seq.status is not SeqStatus.RUNNING or seq not in self.prefilling:
+            seq.onboard_pending = 0  # finished/preempted while in flight
+            return
+        ps = self.config.page_size
+        expected = len(sess.pages)
+        landed = min(len(sess.payloads), expected)
+        if landed:
+            self.block_manager.onboard(sess.pages[:landed], sess.payloads[:landed])
+            blocks = seq.block_seq.blocks
+            for i, pid in enumerate(sess.pages[:landed]):
+                blk = blocks[sess.start + i]
+                self.allocator.commit(pid, blk.block_hash, blk.parent_hash, blk.tokens)
+            for tier in sess.tiers[:landed]:
+                self.onboard_page_counts[tier] = self.onboard_page_counts.get(tier, 0) + 1
+        if landed < expected:
+            self.onboard_shortfall_pages += expected - landed
+        seq.num_cached += landed * ps
+        seq.committed_pages += landed
+        if sess.count_at_start:
+            seq.num_cached_at_start = seq.num_cached
+        seq.onboard_pending = 0
+
+    def drain_onboard_waits(self) -> list[float]:
+        """Hand the accumulated per-session wait times (seconds) to the
+        metrics plane — observed into the histogram exactly once."""
+        out, self._onboard_waits = self._onboard_waits, []
+        return out
+
+    def _cached_prefix_tokens(self, seq: Sequence) -> int:
+        """Admission-time estimate of this prompt's reusable KV tokens:
+        the resident G1 prefix (non-mutating peek — pricing must not touch
+        refcounts or LRU order) extended by the capacity-tier probe (local
+        membership only — prepare() must never block on a store
+        round-trip). Capped at len-1: the final token always computes."""
+        if not self.config.enable_prefix_caching:
+            return 0
+        hashes = seq.block_seq.block_hashes
+        m = self.allocator.peek_prefix(hashes)
+        t = (
+            self.block_manager.probe_prefix(hashes, m, local_only=True)
+            if self.block_manager is not None
+            else 0
+        )
+        return max(0, min((m + t) * self.config.page_size, len(seq.tokens) - 1))
 
     # -- speculative decoding ----------------------------------------------
 
@@ -1946,6 +2198,7 @@ class EngineCore:
         self._inflight = None
         self._inflight_adv = {}
         self._chain_map = {}
+        self._onboards = []  # orphaned fetch threads write into dropped sessions
         if hasattr(self.runner, "reset_chain"):
             self.runner.reset_chain()
         for seq in list(self.running) + list(self.prefilling) + list(self.waiting):
@@ -2033,6 +2286,7 @@ class EngineCore:
     def _preempt(self, seq: Sequence) -> None:
         logger.info("preempting seq %d (%d pages)", seq.seq_id, len(seq.pages))
         self.num_preemptions += 1
+        self._cancel_onboards(seq)
         self.allocator.release([p for p in seq.pages if p != 0])
         seq.pages = []
         seq.committed_pages = 0
@@ -2053,6 +2307,7 @@ class EngineCore:
     def _finish(self, seq: Sequence, reason: FinishReason) -> None:
         seq.status = SeqStatus.FINISHED
         seq.finish_reason = reason
+        self._cancel_onboards(seq)
         if self.admission is not None:
             self.admission.on_finish(seq)
         if seq.pages:

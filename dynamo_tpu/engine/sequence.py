@@ -40,6 +40,11 @@ class Sequence:
     # Forward chunks this (re)prefill has executed (chunked prefill
     # progress; reset on preemption along with num_cached).
     prefill_chunks: int = 0
+    # Tier pages whose payload fetch is in flight (async onboarding): the
+    # chunk scheduler skips the row until the session lands — num_cached
+    # advances only then, exactly like an in-flight chunk. 0 once landed
+    # (shortfall pages degrade to plain compute pages) or on preemption.
+    onboard_pending: int = 0
     status: SeqStatus = SeqStatus.WAITING
     finish_reason: FinishReason | None = None
     # Image embeddings [total_image_tokens, D] substituted at placeholder

@@ -151,6 +151,13 @@ class WorkerSpec:
                 or os.environ.get("DYN_WORKER_SPEC_K", "0")
             ),
             slo_sched=env_flag(os.environ, "DYN_SLO_SCHED"),
+            cache_aware=env_flag(os.environ, "DYN_CACHE_AWARE"),
+            # DYN_CACHE_AWARE implies async onboarding: residual pricing
+            # assumes tier hits are cheap, which they only are pipelined.
+            async_onboard=(
+                env_flag(os.environ, "DYN_ASYNC_ONBOARD")
+                or env_flag(os.environ, "DYN_CACHE_AWARE")
+            ),
             overlap=(
                 env_flag(os.environ, "DYN_OVERLAP")
                 or env_flag(os.environ, "DYN_WORKER_OVERLAP")
@@ -294,6 +301,7 @@ async def build_engine_service(spec: WorkerSpec, *, on_kv_event=None, g4_storage
             spec.block_manager_config,
             read_page=runner.read_page,
             write_page=runner.write_page,
+            write_pages=getattr(runner, "write_pages", None),
             g4_storage=g4_storage,
         )
     core = EngineCore(runner, spec.engine_config, on_kv_event=on_kv_event, block_manager=block_manager)

@@ -168,6 +168,28 @@ class EngineMetrics:
             "failed), 'fill'/'idle' (nothing to chain)",
             ["worker", "reason"], registry=self.registry,
         )
+        # Async tier onboarding (DYN_ASYNC_ONBOARD / DYN_CACHE_AWARE):
+        # per-tier landed page counts are clear-then-set labelled gauges
+        # synced from the core's cumulative dict; the wait histogram is
+        # observed from drained per-session samples at scrape time (each
+        # session observed exactly once).
+        self._onboard_pages = Gauge(
+            "dynamo_engine_prefix_onboard_pages_total",
+            "KV pages onboarded from the capacity tiers into device pages, "
+            "by source tier (g2 host / g3 disk / g4 remote)",
+            ["worker", "tier"], registry=self.registry,
+        )
+        self.onboard_shortfall = gauge(
+            f"{ns}_prefix_onboard_shortfall_pages_total",
+            "Probed tier pages whose payload fetch came up short (evicted or "
+            "faulted between probe and fetch) and fell back to recompute",
+        )
+        self._onboard_wait = Histogram(
+            "dynamo_engine_onboard_wait_seconds",
+            "Wall time from onboarding-session start (admission) to its "
+            "payloads landing in device pages",
+            ["worker"], buckets=_PHASE_BUCKETS, registry=self.registry,
+        )
         self.prefill_queue_depth = gauge(
             f"{ns}_prefill_queue_depth", "Unclaimed tasks in the distributed prefill queue"
         )
@@ -321,6 +343,16 @@ class EngineMetrics:
             self._overlap_barriers.clear()
             for reason, n in barrier_counts.items():
                 self._overlap_barriers.labels(self.worker, reason).set(n)
+        onboard_counts = getattr(core, "onboard_page_counts", None)
+        if onboard_counts is not None:
+            self._onboard_pages.clear()
+            for tier, n in onboard_counts.items():
+                self._onboard_pages.labels(self.worker, tier).set(n)
+        self.onboard_shortfall.set(getattr(core, "onboard_shortfall_pages", 0))
+        drain = getattr(core, "drain_onboard_waits", None)
+        if callable(drain):
+            for wait_s in drain():
+                self._onboard_wait.labels(self.worker).observe(max(0.0, wait_s))
 
     def _sync_transfer(self) -> None:
         if self._transfer is None:

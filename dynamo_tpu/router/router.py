@@ -108,13 +108,15 @@ async def build_kv_router(
     subscriber = await KvEventSubscriber(events_ep, indexer).start()
     aggregator = await KvMetricsAggregator(runtime, namespace, component).start()
     if scheduler_config is None:
-        # Default config picks up the SLO attainment term from the
-        # environment (no-op unless DYN_SLO_SCHED is on); an explicit
+        # Default config picks up the SLO attainment term (no-op unless
+        # DYN_SLO_SCHED is on) and the cache-aware residual term (no-op
+        # unless DYN_CACHE_AWARE is on) from the environment; an explicit
         # config is the caller's to arm.
-        from dynamo_tpu.sched import configure_attainment
+        from dynamo_tpu.sched import configure_attainment, configure_cache_aware
 
         scheduler_config = SchedulerConfig()
         configure_attainment(scheduler_config)
+        configure_cache_aware(scheduler_config, block_tokens=block_size)
     scheduler = KvScheduler(scheduler_config)
     router = KvRouter(indexer, scheduler, aggregator, block_size=block_size, salt=salt)
     client = runtime.namespace(namespace).component(component).endpoint(endpoint).client(router_mode="direct")

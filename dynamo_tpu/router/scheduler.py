@@ -37,6 +37,18 @@ class SchedulerConfig:
     attainment_weight: float = 0.0
     ttft_slo_s: float = 0.5
     profile: object | None = None  # planner.core.WorkerProfile
+    # Cache-aware term (DYN_CACHE_AWARE): add each worker's predicted
+    # *residual prefill* — the seconds of prefill its cache misses imply,
+    # normalized by the TTFT budget — so a worker already holding the
+    # request's blocks wins even when base overlap scores near-tie. A
+    # worker whose KV-event feed is staler than ``cache_max_staleness_s``
+    # is priced as cold — a stale index claims overlap the worker may have
+    # evicted, and placement must not chase ghosts. 0 weight disables
+    # (bit-identical base cost).
+    cache_aware_weight: float = 0.0
+    cache_block_tokens: int = 16  # tokens per KV block (engine page_size)
+    cache_rate_tokens_per_s: float = 20000.0  # assumed prefill throughput
+    cache_max_staleness_s: float = 10.0
 
 
 # (worker_id -> cost) -> chosen worker id
@@ -84,6 +96,24 @@ class KvScheduler:
                     pred *= 1.0 + min(staleness.get(wid, 0.0), 10.0)
                 ratio = pred / max(cfg.ttft_slo_s, 1e-9)
                 cost += cfg.attainment_weight * (ratio + max(0.0, ratio - 1.0))
+            if cfg.cache_aware_weight > 0:
+                # A worker whose KV-event feed is stale gets priced as cold
+                # (full residual): its claimed overlap may be evicted ghosts,
+                # and trusting it would *reward* staleness. When every
+                # worker is stale the term is a constant and selection falls
+                # back to the existing cost ordering.
+                stale = (
+                    staleness is not None
+                    and staleness.get(wid, 0.0) > cfg.cache_max_staleness_s
+                )
+                eff_new = num_request_blocks if stale else new_blocks
+                resid_s = (
+                    eff_new * cfg.cache_block_tokens
+                    / max(cfg.cache_rate_tokens_per_s, 1e-9)
+                )
+                cost += cfg.cache_aware_weight * (
+                    resid_s / max(cfg.ttft_slo_s, 1e-9)
+                )
             out[wid] = cost
         return out
 

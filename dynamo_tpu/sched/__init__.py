@@ -87,6 +87,32 @@ def build_chunk_controller(base_tokens: int, *, settings=None, slo=None) -> Chun
     )
 
 
+def cache_aware_enabled(env=None) -> bool:
+    """``DYN_CACHE_AWARE`` truthy: residual-cost admission pricing,
+    cache-aware router cost, and (implicitly) async tier onboarding."""
+    from dynamo_tpu.config import env_flag
+
+    return env_flag(os.environ if env is None else env, "DYN_CACHE_AWARE", False)
+
+
+def configure_cache_aware(config, env=None, *, block_tokens=None) -> None:
+    """Arm a router ``SchedulerConfig``'s cache-aware cost term from the
+    environment; a no-op unless ``DYN_CACHE_AWARE`` is on (same discipline
+    as :func:`configure_attainment` — off means bit-identical costs).
+    ``block_tokens`` lets the caller pass the deployment's real KV block
+    size so predicted residual-prefill tokens are scaled correctly."""
+    if not cache_aware_enabled(env):
+        return
+    from dynamo_tpu.config import load_cache_aware_settings
+
+    s = load_cache_aware_settings(env=env) if env is not None else load_cache_aware_settings()
+    config.cache_aware_weight = s.weight
+    config.cache_rate_tokens_per_s = s.rate_tokens_per_s
+    config.cache_max_staleness_s = s.max_staleness_s
+    if block_tokens:
+        config.cache_block_tokens = int(block_tokens)
+
+
 def configure_attainment(config, env=None) -> None:
     """Arm a router ``SchedulerConfig``'s attainment cost term from the
     environment; a no-op unless ``DYN_SLO_SCHED`` is on. Mutates in place
@@ -112,6 +138,8 @@ __all__ = [
     "TtftPredictor",
     "build_admission_controller",
     "build_chunk_controller",
+    "cache_aware_enabled",
     "configure_attainment",
+    "configure_cache_aware",
     "slo_sched_enabled",
 ]

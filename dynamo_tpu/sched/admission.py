@@ -56,6 +56,26 @@ class AdmissionController:
         self.admitted_total = 0
         self.throttle_events = 0
         self.last_slack_ms = 0.0  # min slack across waiting at the last prepare
+        # Residual-cost pricing (DYN_CACHE_AWARE): the engine wires a
+        # callable ``seq -> cached KV tokens`` (resident G1 match + local
+        # tier probe). With it, prediction and quota charges price a request
+        # by its *uncached* prefill tokens — a 95%-cached 3000-token prompt
+        # stops costing the same as a cold one. None keeps the cache-blind
+        # behaviour bit-identical.
+        self.cached_tokens_fn = None
+
+    def _cached_tokens(self, seq) -> int:
+        """Admission-time cached-token estimate for ``seq`` (0 without a
+        pricing hook). Clamped so at least one token is always charged —
+        the final token computes no matter how warm the prefix is."""
+        fn = self.cached_tokens_fn
+        if fn is None:
+            return 0
+        try:
+            est = int(fn(seq))
+        except Exception:
+            return 0  # estimate failure degrades to cache-blind pricing
+        return max(0, min(est, len(seq.tokens) - 1))
 
     # -- identity ----------------------------------------------------------
 
@@ -82,20 +102,23 @@ class AdmissionController:
         now = self._clock() if now is None else now
         scored = []
         for seq in waiting:
+            cached = self._cached_tokens(seq)
             pred = self.predictor.predict(
-                queued_tokens=seq.prompt_remaining, running=running, slots=slots
+                queued_tokens=max(0, seq.prompt_remaining - cached),
+                running=running,
+                slots=slots,
             )
             seq.predicted_ttft_s = pred
             seq.predicted_at = now
             slack = self.deadline(seq) - (now + pred)
-            scored.append((slack, seq.arrival_time, seq.seq_id, seq))
+            scored.append((slack, seq.arrival_time, seq.seq_id, seq, cached))
         scored.sort(key=lambda t: (t[0], t[1], t[2]))
         self.last_slack_ms = scored[0][0] * 1e3
         admissible: list = []
         deferred: list = []
         planned_tokens: dict[str, float] = {}
         planned_inflight: dict[str, int] = {}
-        for _, _, _, seq in scored:
+        for _, _, _, seq, cached in scored:
             if seq.seq_id in self._charges:
                 # Preempted resume: charged at first admission, refunded only
                 # at on_finish — the quota already accounts for the resources
@@ -105,7 +128,10 @@ class AdmissionController:
                 admissible.append(seq)
                 continue
             tenant = self.tenant_of(seq)
-            tokens = len(seq.tokens)
+            # Quota charge is the residual: cached blocks are a copy, not a
+            # prefill, so the bucket pays only for compute the request will
+            # actually demand (min 1 — the final token always computes).
+            tokens = max(1, len(seq.tokens) - cached)
             if self.tenants.would_admit(
                 tenant,
                 tokens,
@@ -131,7 +157,7 @@ class AdmissionController:
             return  # preempted resume: quota already charged
         now = self._clock() if now is None else now
         tenant = self.tenant_of(seq)
-        tokens = len(seq.tokens)
+        tokens = max(1, len(seq.tokens) - self._cached_tokens(seq))
         self.tenants.on_admit(tenant, tokens)
         self._charges[seq.seq_id] = (tenant, tokens)
         self.admitted_total += 1

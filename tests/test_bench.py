@@ -299,6 +299,25 @@ def test_bench_doc_goodput_keys():
         assert empty[key] == 0.0
 
 
+def test_bench_doc_prefix_reuse_keys():
+    """Cache-aware serving headline keys (ISSUE 12): the prefix-reuse probe
+    surfaces stable top-level keys and a detail record; absent probe emits
+    0.0 defaults so the doc schema never shifts."""
+    import bench
+
+    configs = [{"preset": "test-tiny", "tok_per_sec": 5.0}]
+    doc = bench.build_doc(configs, pull={})
+    assert doc["prefix_reuse_ttft_gain"] == 0.0
+    assert doc["prefix_onboard_overlap_frac"] == 0.0
+    assert doc["detail"]["prefix_reuse_probe"] == {"pending": True}
+    pr = {"prefix_reuse_ttft_gain": 55.04, "prefix_onboard_overlap_frac": 1.0,
+          "cold": {"ttft_p50_ms": 212.46}, "reuse": {"ttft_p50_ms": 3.86}}
+    doc2 = bench.build_doc(configs, pull={}, prefix_reuse=pr)
+    assert doc2["prefix_reuse_ttft_gain"] == 55.04
+    assert doc2["prefix_onboard_overlap_frac"] == 1.0
+    assert doc2["detail"]["prefix_reuse_probe"] == pr
+
+
 def test_synthesizer_prefix_structure():
     cfg = SyntheticConfig(num_requests=32, shared_prefix_len=16, num_groups=3,
                           group_prefix_len=8, unique_len=4, osl_mean=20, seed=7)

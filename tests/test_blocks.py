@@ -157,15 +157,17 @@ def test_g4_capacity_eviction_deletes_remote(tmp_path):
 # -- engine integration ------------------------------------------------------
 
 
-def make_core_with_tiers(num_pages, tmp_path=None, **bm_kw):
+def make_core_with_tiers(num_pages, tmp_path=None, engine_kw=None, g4_storage=None, **bm_kw):
     runner = ModelRunner(CFG, PARAMS, num_pages=num_pages, page_size=PAGE,
                          max_batch_size=4, prefill_bucket=16, attn_impl="reference")
     bm_cfg = BlockManagerConfig(**bm_kw) if tmp_path is None else BlockManagerConfig(
         g3_path=tmp_path / "g3", **bm_kw
     )
-    bm = KvBlockManager(bm_cfg, read_page=runner.read_page, write_page=runner.write_page)
+    bm = KvBlockManager(bm_cfg, read_page=runner.read_page, write_page=runner.write_page,
+                        write_pages=getattr(runner, "write_pages", None),
+                        g4_storage=g4_storage)
     config = EngineConfig(num_pages=num_pages, page_size=PAGE, max_batch_size=4,
-                          max_prefill_tokens=256, max_seq_len=128)
+                          max_prefill_tokens=256, max_seq_len=128, **(engine_kw or {}))
     return EngineCore(runner, config, block_manager=bm), bm
 
 
@@ -204,3 +206,127 @@ def test_onboarded_tokens_exact_vs_reference(tmp_path):
     out = run_to_completion(core)
     assert out[seq.seq_id] == greedy_reference(pa, 3)
     assert (bm.g3.stats().hits + bm.g2.stats().hits) >= 1
+
+
+def test_onboard_batches_through_write_pages():
+    """N onboarded pages go through one write_pages scatter, not N
+    per-page round-trips (the per-page writer stays the fallback)."""
+    batched, single = [], []
+    mgr = KvBlockManager(
+        BlockManagerConfig(g2_capacity_blocks=8),
+        read_page=lambda pid: payload(pid),
+        write_page=lambda pid, k, v: single.append(pid),
+        write_pages=lambda pids, ks, vs: batched.append((list(pids), len(ks))),
+    )
+    mgr.onboard([3, 4, 5], [payload(1), payload(2), payload(3)])
+    assert batched == [([3, 4, 5], 3)] and single == []
+    # A single payload skips the batch machinery (no stacking overhead).
+    mgr.onboard([7], [payload(9)])
+    assert single == [7] and len(batched) == 1
+    assert mgr.onboarded == 4
+
+
+def test_async_onboard_tokens_exact():
+    """Pipelined onboarding (DYN_ASYNC_ONBOARD): the background fetch +
+    batched write_pages landing must produce token-exact results, and the
+    scheduler must report the onboarded prefix as cached."""
+    core, bm = make_core_with_tiers(
+        num_pages=7, g2_capacity_blocks=16,
+        engine_kw={"async_onboard": True, "chunk_prefill_tokens": 8},
+    )
+    pa = list(range(1, 13))  # 12 tokens = 3 pages
+    core.add_request(greedy_request(pa, max_tokens=2))
+    out_a = run_to_completion(core)
+    core.add_request(greedy_request([50 + i for i in range(12)], max_tokens=2))
+    run_to_completion(core)  # evicts A from tiny G1
+
+    seq = core.add_request(greedy_request(pa, max_tokens=2))
+    out_a2 = run_to_completion(core)
+    assert out_a2[seq.seq_id] == out_a[0] == greedy_reference(pa, 2)
+    assert core.onboard_sessions >= 1, "expected an async onboarding session"
+    assert not core._onboards  # every session landed
+    assert sum(core.onboard_page_counts.values()) >= 2
+    assert bm.onboarded >= 2
+    assert seq.num_cached_at_start >= 4
+    assert core.onboard_wait_count >= 1
+    assert len(core.drain_onboard_waits()) >= 1
+    assert core.drain_onboard_waits() == []  # drained exactly once
+
+
+def test_async_onboard_probe_fetch_race_recomputes():
+    """Blocks lost between probe and the async fetch (here: a metadata-only
+    G2 whose payload reads always come up empty) must degrade to recompute
+    with token-exact output — the shortfall path of the pipelined session."""
+    core, _bm = make_core_with_tiers(
+        num_pages=7, g2_capacity_blocks=16, null_storage=True,
+        engine_kw={"async_onboard": True, "chunk_prefill_tokens": 8},
+    )
+    pa = list(range(1, 13))
+    core.add_request(greedy_request(pa, max_tokens=2))
+    run_to_completion(core)
+    core.add_request(greedy_request([50 + i for i in range(12)], max_tokens=2))
+    run_to_completion(core)
+
+    seq = core.add_request(greedy_request(pa, max_tokens=2))
+    out = run_to_completion(core)
+    assert out[seq.seq_id] == greedy_reference(pa, 2)
+    assert core.onboard_sessions >= 1
+    assert core.onboard_shortfall_pages >= 1, "probe hit but fetch lost: shortfall"
+    assert seq.status.value == "finished" and seq.onboard_pending == 0
+
+
+def test_async_onboard_chaos_store_fault_recomputes(tmp_path):
+    """Chaos drill: a store.op fault fired during the background G4 fetch
+    must degrade the session to recompute (token-exact), never crash the
+    engine thread."""
+    import asyncio
+    import threading
+
+    from dynamo_tpu.blocks.storage import RemoteStorage
+    from dynamo_tpu.runtime.faults import FAULTS
+    from dynamo_tpu.runtime.objects import ObjectStore
+    from dynamo_tpu.runtime.store_server import StoreClient, StoreServer
+
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    try:
+        async def _bring_up():
+            server = await StoreServer(host="127.0.0.1", port=0).start()
+            return server, StoreClient("127.0.0.1", server.port)
+
+        server, client = asyncio.run_coroutine_threadsafe(_bring_up(), loop).result(10)
+        remote = RemoteStorage(ObjectStore(client), loop)
+        # G2 capacity 1 + no G3: committed blocks spill host -> remote, so
+        # the replay's onboard fetch must cross the faulted store plane.
+        core, bm = make_core_with_tiers(
+            num_pages=7, g2_capacity_blocks=1, g4_capacity_blocks=16,
+            g4_storage=remote,
+            engine_kw={"async_onboard": True, "chunk_prefill_tokens": 8},
+        )
+        pa = list(range(1, 13))
+        core.add_request(greedy_request(pa, max_tokens=2))
+        run_to_completion(core)
+        core.add_request(greedy_request([50 + i for i in range(12)], max_tokens=2))
+        run_to_completion(core)
+        assert bm.g4 is not None and bm.g4.stats().used >= 1
+
+        FAULTS.arm("store.op:drop@1")
+        try:
+            seq = core.add_request(greedy_request(pa, max_tokens=2))
+            out = run_to_completion(core)
+            assert FAULTS.fired("store.op") >= 1, "fault never crossed the fetch path"
+        finally:
+            FAULTS.disarm()
+        assert out[seq.seq_id] == greedy_reference(pa, 2)
+        assert not core._onboards
+
+        async def _tear_down():
+            await client.close()
+            await server.close()
+
+        asyncio.run_coroutine_threadsafe(_tear_down(), loop).result(10)
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(timeout=5)
+        loop.close()

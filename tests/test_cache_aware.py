@@ -1,0 +1,229 @@
+"""Cache-aware serving (ISSUE 12): residual-prefill-cost admission pricing,
+the router's cache-aware cost term, env gating, and the engine seam that
+wires the admission pricing hook only when ``DYN_CACHE_AWARE`` is on."""
+
+from collections import deque
+
+import pytest
+
+from dynamo_tpu.engine.sequence import Sequence
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.runtime.engine import Context
+from dynamo_tpu.sched import (
+    AdmissionConfig,
+    AdmissionController,
+    TenantQuota,
+    TenantRegistry,
+    TtftPredictor,
+    cache_aware_enabled,
+    configure_cache_aware,
+)
+
+
+def _req(tokens, *, tenant=None, priority=0, max_tokens=4):
+    return PreprocessedRequest(
+        token_ids=list(tokens),
+        sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=max_tokens, ignore_eos=True),
+        tenant_id=tenant,
+        priority=priority,
+    )
+
+
+def _seq(seq_id, n_tokens, *, arrival, tenant=None, priority=0):
+    seq = Sequence.from_request(
+        seq_id, _req(range(1, n_tokens + 1), tenant=tenant, priority=priority),
+        Context(), page_size=16, salt=0,
+    )
+    seq.arrival_time = arrival
+    return seq
+
+
+class _Clock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _controller(clk, *, cached_fn=None, quota=None):
+    tenants = TenantRegistry(clock=clk)
+    if quota:
+        for tenant, q in quota.items():
+            tenants.configure(tenant, q)
+    ctl = AdmissionController(
+        AdmissionConfig(ttft_budget_s=0.5, tier_stretch=2.0),
+        predictor=TtftPredictor(), tenants=tenants, clock=clk,
+    )
+    ctl.cached_tokens_fn = cached_fn
+    return ctl
+
+
+# -- residual-cost admission --------------------------------------------------
+
+
+def test_residual_pricing_admits_cached_long_before_cold_short():
+    """The acceptance scenario: a 95%-cached 3000-token prompt from a
+    quota-bounded tenant is admitted AHEAD of a cold 300-token prompt.
+    Cache-blind pricing charges the full prompt, fails the tenant's
+    in-flight cap, and defers the long request behind the cold one."""
+    cached = {0: 2850, 1: 0}  # seq 0: 95% of 3000 tokens already resident
+
+    def scenario(priced):
+        clk = _Clock(t=2.0)
+        ctl = _controller(
+            clk,
+            cached_fn=(lambda s: cached[s.seq_id]) if priced else None,
+            quota={"bulk": TenantQuota(max_inflight_tokens=600)},
+        )
+        ctl.tenants.on_admit("bulk", 400)  # tenant already has work in flight
+        long = _seq(0, 3000, arrival=0.0, tenant="bulk")
+        cold = _seq(1, 300, arrival=0.2)
+        waiting = deque([cold, long])  # cold ahead in raw arrival-queue order
+        admissible = ctl.prepare(waiting, running=0, slots=8)
+        return admissible, [s.seq_id for s in waiting]
+
+    # Residual pricing: the long prompt charges 3000-2850=150 tokens, fits
+    # the 600 cap (400+150), and its earlier arrival gives it less slack.
+    admissible, order = scenario(priced=True)
+    assert admissible == 2
+    assert order == [0, 1]
+    # Cache-blind: 400+3000 > 600 defers it behind the admissible cold one.
+    admissible, order = scenario(priced=False)
+    assert admissible == 1
+    assert order == [1, 0]
+
+
+def test_on_admit_charges_residual_and_refunds_same_amount():
+    clk = _Clock()
+    ctl = _controller(clk, cached_fn=lambda s: 2850)
+    seq = _seq(7, 3000, arrival=0.0, tenant="acme")
+    ctl.on_admit(seq)
+    assert ctl._charges[7] == ("acme", 150)
+    assert ctl.tenants.inflight("acme") == 150
+    ctl.on_finish(seq)
+    assert ctl.tenants.inflight("acme") == 0
+    # Over-estimate clamps: at least the final token is always charged.
+    ctl.cached_tokens_fn = lambda s: 10**9
+    tiny = _seq(8, 4, arrival=0.0, tenant="acme")
+    ctl.on_admit(tiny)
+    assert ctl._charges[8] == ("acme", 1)
+
+
+def test_estimate_failure_degrades_to_cache_blind():
+    def boom(seq):
+        raise RuntimeError("indexer down")
+
+    clk = _Clock()
+    ctl = _controller(clk, cached_fn=boom)
+    seq = _seq(3, 40, arrival=0.0, tenant="t")
+    waiting = deque([seq])
+    assert ctl.prepare(waiting, running=0, slots=8) == 1
+    ctl.on_admit(seq)
+    assert ctl._charges[3] == ("t", 40)  # full cache-blind charge
+
+
+# -- router cache-aware cost term ---------------------------------------------
+
+
+def test_router_cache_term_prefers_overlap_worker_stale_falls_back():
+    from dynamo_tpu.router.indexer import OverlapScores
+    from dynamo_tpu.router.scheduler import KvScheduler, SchedulerConfig
+
+    overlaps = OverlapScores(scores={2: 8})  # worker 2 holds 8 of 10 blocks
+    # overlap_weight=0 isolates the new term: base costs tie exactly.
+    base = KvScheduler(SchedulerConfig(overlap_weight=0.0))
+    costs = base.costs(10, overlaps, {}, [1, 2])
+    assert costs[1] == pytest.approx(costs[2])
+    assert base.select(costs) == 1  # existing tie-break: lowest id
+
+    armed = KvScheduler(SchedulerConfig(
+        overlap_weight=0.0, cache_aware_weight=1.0, cache_block_tokens=16,
+        cache_rate_tokens_per_s=20000.0, cache_max_staleness_s=5.0,
+        ttft_slo_s=0.5,
+    ))
+    costs = armed.costs(10, overlaps, {}, [1, 2])
+    assert costs[2] < costs[1]
+    assert armed.select(costs) == 2  # prefix-overlap worker wins
+    # Residual seconds normalized by the budget: (blocks*16/20000)/0.5.
+    assert costs[1] - costs[2] == pytest.approx((8 * 16 / 20000.0) / 0.5)
+
+    # The overlap worker's KV-event feed goes stale: it is priced as cold,
+    # the term ties, and selection falls back to the existing ordering.
+    costs = armed.costs(10, overlaps, {}, [1, 2], staleness={1: 0.0, 2: 99.0})
+    assert costs[1] == pytest.approx(costs[2])
+    assert armed.select(costs) == 1
+    # Every worker stale -> constant term -> same fallback.
+    costs = armed.costs(10, overlaps, {}, [1, 2], staleness={1: 99.0, 2: 99.0})
+    assert costs[1] == pytest.approx(costs[2])
+    assert armed.select(costs) == 1
+
+
+def test_configure_cache_aware_gated_on_master_toggle(monkeypatch):
+    from dynamo_tpu.router.scheduler import SchedulerConfig
+
+    cfg = SchedulerConfig()
+    monkeypatch.delenv("DYN_CACHE_AWARE", raising=False)
+    assert not cache_aware_enabled()
+    configure_cache_aware(cfg, block_tokens=32)
+    assert cfg.cache_aware_weight == 0.0  # off: untouched (bit-identical cost)
+    monkeypatch.setenv("DYN_CACHE_AWARE", "1")
+    monkeypatch.setenv("DYN_CACHE_AWARE_WEIGHT", "2.5")
+    monkeypatch.setenv("DYN_CACHE_AWARE_RATE_TOKENS_PER_S", "40000")
+    monkeypatch.setenv("DYN_CACHE_AWARE_MAX_STALENESS_S", "3")
+    assert cache_aware_enabled()
+    configure_cache_aware(cfg, block_tokens=32)
+    assert cfg.cache_aware_weight == 2.5
+    assert cfg.cache_rate_tokens_per_s == 40000.0
+    assert cfg.cache_max_staleness_s == 3.0
+    assert cfg.cache_block_tokens == 32
+
+
+# -- engine seam --------------------------------------------------------------
+
+
+def _mock_core(admission=None, **cfg_kw):
+    from dynamo_tpu.engine.core import EngineConfig, EngineCore
+    from dynamo_tpu.mocker import MockRunner
+
+    kw = dict(
+        num_pages=256, page_size=16, max_batch_size=8,
+        max_prefill_tokens=4096, max_seq_len=8192,
+        enable_prefix_caching=True, chunk_prefill_tokens=64,
+    )
+    kw.update(cfg_kw)
+    cfg = EngineConfig(**kw)
+    runner = MockRunner(num_pages=cfg.num_pages, page_size=cfg.page_size, realtime=False)
+    return EngineCore(runner, cfg, admission=admission)
+
+
+def test_engine_wires_pricing_hook_only_when_cache_aware():
+    ctl = AdmissionController(predictor=TtftPredictor(), tenants=TenantRegistry())
+    core = _mock_core(admission=ctl, cache_aware=False)
+    assert core.admission.cached_tokens_fn is None  # off: cache-blind pricing
+    ctl2 = AdmissionController(predictor=TtftPredictor(), tenants=TenantRegistry())
+    core2 = _mock_core(admission=ctl2, cache_aware=True)
+    assert core2.admission.cached_tokens_fn is not None
+
+
+def test_cached_prefix_tokens_counts_resident_g1_match():
+    """After a request finishes, an identical waiting prompt prices almost
+    fully cached (capped at len-1: the final token always computes)."""
+    core = _mock_core(cache_aware=True)
+    seq = core.add_request(_req(range(1, 65), max_tokens=2))
+    for _ in range(50):
+        if not core.has_work:
+            break
+        core.step()
+    probe = core.add_request(_req(range(1, 65)))  # identical prompt, waiting
+    est = core._cached_prefix_tokens(probe)
+    assert est >= 48  # at least the full pages of the shared prefix
+    assert est <= 63  # never the whole prompt
+    # Different prompt: nothing resident.
+    other = core.add_request(_req(range(1000, 1064)))
+    assert core._cached_prefix_tokens(other) == 0

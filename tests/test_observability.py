@@ -206,6 +206,9 @@ EXPECTED_ENGINE_FAMILIES = {
     "dynamo_engine_overlap_steps_total",
     "dynamo_engine_overlap_barrier_total",
     "dynamo_engine_admission_queue_depth",
+    "dynamo_engine_prefix_onboard_pages_total",
+    "dynamo_engine_prefix_onboard_shortfall_pages_total",
+    "dynamo_engine_onboard_wait_seconds",
     "dynamo_engine_deadline_misses_total",
     "dynamo_tenant_throttled_total",
     "dynamo_engine_chunk_budget_tokens",
