@@ -1173,9 +1173,50 @@ def probe_prefix_reuse() -> dict:
     }
 
 
+def probe_fleet_sim() -> dict:
+    """Fleet-simulation probe (ISSUE 13): a small fixed scenario end-to-end.
+
+    Runs a registered fleetsim scenario (default ``smoke``: a deterministic
+    Poisson trace replayed open-loop against the real frontend/router/store
+    with mock workers as OS processes) twice — a dry run that generates and
+    digests the trace without spawning anything, then the measured run.
+    Top-level bench JSON promotes:
+
+      fleet_goodput_frac_at_slo — fraction of the scenario's requests that
+        attained the SLO (TTFT and per-request p99 ITL within targets),
+        with TTFT clocked from intended injection time (open loop, no
+        coordinated omission);
+      fleet_tenant_fairness — min/max ratio of per-tenant attainment
+        fractions (1.0 = perfectly fair).
+    """
+    import asyncio
+
+    from dynamo_tpu.fleetsim.scenario import SCENARIOS, run_scenario
+
+    name = os.environ.get("BENCH_FLEET_SCENARIO", "smoke")
+    workers = int(os.environ.get("BENCH_FLEET_WORKERS", "0"))
+    scn = SCENARIOS[name]
+    dry = asyncio.run(run_scenario(scn, dry_run=True))
+    report = asyncio.run(run_scenario(scn, workers_override=workers))
+    return {
+        "scenario": name,
+        "trace_digest": dry["trace"]["digest"],
+        "trace_events": dry["trace"]["events"],
+        "digest_stable": dry["trace"]["digest"] == report["trace"]["digest"],
+        "duration_s": report.get("duration_s", 0.0),
+        "requests": report.get("requests", {}),
+        "ttft_ms": report.get("ttft_ms", {}),
+        "itl_ms": report.get("itl_ms", {}),
+        "fleet": report.get("fleet", {}),
+        "passed": report.get("passed"),
+        "fleet_goodput_frac_at_slo": report.get("goodput_frac_at_slo", 0.0),
+        "fleet_tenant_fairness": report.get("tenant_fairness", 0.0),
+    }
+
+
 def build_doc(configs, pull, wire=None, stall=None, spec=None,
               decode_kernel=None, slo_sched=None, overlap=None,
-              prefix_reuse=None) -> dict:
+              prefix_reuse=None, fleet=None) -> dict:
     """The bench JSON document (one stdout line per emit).
 
     Module-level (not a closure) so its top-level key contract — the stable
@@ -1241,6 +1282,13 @@ def build_doc(configs, pull, wire=None, stall=None, spec=None,
             "prefix_reuse_ttft_gain", 0.0),
         "prefix_onboard_overlap_frac": (prefix_reuse or {}).get(
             "prefix_onboard_overlap_frac", 0.0),
+        # Fleet-simulation headline keys (ISSUE 13): goodput-under-SLO and
+        # per-tenant fairness from the fixed fleet scenario replayed against
+        # the real control plane with process-per-worker mock engines (see
+        # probe_fleet_sim / dynamo_tpu/fleetsim).
+        "fleet_goodput_frac_at_slo": (fleet or {}).get(
+            "fleet_goodput_frac_at_slo", 0.0),
+        "fleet_tenant_fairness": (fleet or {}).get("fleet_tenant_fairness", 0.0),
         "detail": {
             "backend": jax.default_backend(),
             "suite": [c.get("preset") for c in configs],
@@ -1251,6 +1299,7 @@ def build_doc(configs, pull, wire=None, stall=None, spec=None,
             "slo_sched_probe": slo_sched or {"pending": True},
             "engine_overlap_probe": overlap or {"pending": True},
             "prefix_reuse_probe": prefix_reuse or {"pending": True},
+            "fleet_sim_probe": fleet or {"pending": True},
             "kv_pull": pull,
             "kv_wire_cross_process": wire or {"pending": True},
             "ttft_note": "ttft_idle_* is the drained-engine best case; "
@@ -1263,9 +1312,9 @@ def main() -> None:
     from dynamo_tpu.models.config import PRESETS
 
     def emit(configs, pull, wire=None, stall=None, spec=None, dk=None, ss=None,
-             ov=None, pr=None):
+             ov=None, pr=None, fl=None):
         print(json.dumps(build_doc(configs, pull, wire, stall, spec, dk, ss, ov,
-                                   pr)),
+                                   pr, fl)),
               flush=True)
 
     suite = parse_suite()
@@ -1333,16 +1382,24 @@ def main() -> None:
          pr=pr)
     gc.collect()
     try:
+        fl = probe_fleet_sim()
+    except Exception as e:
+        fl = {"error": f"{type(e).__name__}: {e}"[:200]}
+    emit(configs, {"pending": True}, stall=stall, spec=spec, dk=dk, ss=ss, ov=ov,
+         pr=pr, fl=fl)
+    gc.collect()
+    try:
         pull = probe_kv_pull_gbps()
     except Exception as e:
         pull = {"error": f"{type(e).__name__}: {e}"[:200]}
-    emit(configs, pull, stall=stall, spec=spec, dk=dk, ss=ss, ov=ov, pr=pr)
+    emit(configs, pull, stall=stall, spec=spec, dk=dk, ss=ss, ov=ov, pr=pr, fl=fl)
     gc.collect()
     try:
         wire = probe_cross_process_wire()
     except Exception as e:
         wire = {"error": f"{type(e).__name__}: {e}"[:200]}
-    emit(configs, pull, wire, stall=stall, spec=spec, dk=dk, ss=ss, ov=ov, pr=pr)
+    emit(configs, pull, wire, stall=stall, spec=spec, dk=dk, ss=ss, ov=ov, pr=pr,
+         fl=fl)
 
 
 if __name__ == "__main__":

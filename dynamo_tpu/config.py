@@ -209,6 +209,22 @@ class CacheAwareSettings:
     max_staleness_s: float = 10.0
 
 
+@dataclasses.dataclass
+class FleetSettings:
+    """Fleet-simulation harness knobs (``dynamo_tpu/fleetsim``).
+
+    Env: ``DYN_FLEET_*``, TOML: ``[fleet]``. These tune how the harness
+    runs a scenario; the scenario spec itself (trace, fleet shape, faults,
+    checks) stays in code so runs are reviewable and deterministic.
+    """
+
+    spawn_timeout_s: float = 120.0  # per-worker READY deadline
+    drain_timeout_s: float = 15.0  # SIGTERM -> SIGKILL escalation deadline
+    workers: int = 0  # override the scenario's fleet size (0 = scenario value)
+    report_dir: str = ""  # write scenario reports here ("" = stdout only)
+    metrics_poll_s: float = 1.0  # federated /metrics scrape cadence
+
+
 def load_runtime_settings(**kw) -> RuntimeSettings:
     return load_config(RuntimeSettings(), section="runtime", **kw)
 
@@ -231,3 +247,7 @@ def load_tenant_settings(**kw) -> TenantSettings:
 
 def load_cache_aware_settings(**kw) -> CacheAwareSettings:
     return load_config(CacheAwareSettings(), section="cache_aware", **kw)
+
+
+def load_fleet_settings(**kw) -> FleetSettings:
+    return load_config(FleetSettings(), section="fleet", **kw)

@@ -1,0 +1,69 @@
+"""Fleet-scale simulation harness: diurnal traffic against the real control plane.
+
+The north star is "heavy traffic from millions of users", and every
+ingredient exists in isolation — the mocker timing-model runner, the
+seasonal load predictor, the SLA planner, the chaos plane, EDF admission
+with per-tenant quotas, predicted-TTFT + cache-aware routing. This package
+composes them into a regression gate:
+
+- :mod:`trace` — the **workload plane**: a deterministic arrival-trace
+  generator (inhomogeneous Poisson with diurnal modulation, period shifts,
+  burst episodes, a heavy-tenant flood, and a prefix-sharing token mix)
+  serialized as JSONL so runs are replayable and diffable.
+- :mod:`fleet` — the **fleet plane**: tens of mock workers spread across
+  OS processes (on a 1-core box an in-process fleet serializes and flattens
+  every measurement — real processes sleep on their timing models instead),
+  with per-worker timing profiles, spawn / SIGTERM-drain / SIGKILL
+  lifecycle, planner actuation, and scripted churn.
+- :mod:`scoreboard` — the **measurement plane**: an open-loop client that
+  timestamps at *intended* injection (coordinated omission can't hide
+  stalls), P² p99/p99.9 tails, goodput-under-SLO, per-tenant attainment and
+  fairness, breaker/restart/requeue counts scraped from the federated
+  ``/metrics``, and ``dynamo_fleet_*`` Prometheus families.
+- :mod:`scenario` — **scenarios as code**: a Scenario spec (trace + fleet
+  shape + fault script + churn + pass/fail checks) with fast-tier
+  deterministic scenarios (seconds, tier-1) and hours-long soak scenarios,
+  plus the ``python -m dynamo_tpu.fleetsim run <scenario>`` CLI.
+
+Determinism boundary: the same seed always produces the same trace
+(bit-identical JSONL, asserted by digest) and therefore the same request
+sequence, tenants, prefixes, and fault arming; wall-clock interleaving
+across real OS processes is not replayed — checks assert on distributional
+invariants (SLO attainment, fairness floors, event counts), which are
+stable under that boundary.
+"""
+
+from dynamo_tpu.fleetsim.fleet import ChurnEvent, FleetManager, WorkerTimingProfile
+from dynamo_tpu.fleetsim.metrics import FleetMetrics
+from dynamo_tpu.fleetsim.scenario import SCENARIOS, Check, Scenario, run_scenario
+from dynamo_tpu.fleetsim.scoreboard import Scoreboard
+from dynamo_tpu.fleetsim.trace import (
+    BurstEpisode,
+    TenantFlood,
+    TraceConfig,
+    TraceEvent,
+    generate_trace,
+    load_trace,
+    save_trace,
+    trace_digest,
+)
+
+__all__ = [
+    "BurstEpisode",
+    "Check",
+    "ChurnEvent",
+    "FleetManager",
+    "FleetMetrics",
+    "SCENARIOS",
+    "Scenario",
+    "Scoreboard",
+    "TenantFlood",
+    "TraceConfig",
+    "TraceEvent",
+    "WorkerTimingProfile",
+    "generate_trace",
+    "load_trace",
+    "run_scenario",
+    "save_trace",
+    "trace_digest",
+]

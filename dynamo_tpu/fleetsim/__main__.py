@@ -1,0 +1,94 @@
+"""CLI: ``python -m dynamo_tpu.fleetsim <command>``.
+
+``run <scenario>`` executes a registered scenario end-to-end and prints
+the report (exit code 1 when any check fails); ``list`` shows the
+registry; ``trace`` generates or replays a serialized arrival trace
+without starting any process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from dynamo_tpu.fleetsim.scenario import SCENARIOS, run_scenario
+from dynamo_tpu.fleetsim.trace import generate_trace, load_trace, save_trace, trace_digest
+
+
+def _cmd_list(_args: argparse.Namespace) -> int:
+    for name, scn in sorted(SCENARIOS.items()):
+        print(f"{name:16s} [{scn.tier}]  {scn.description}")
+    return 0
+
+
+def _cmd_run(args: argparse.Namespace) -> int:
+    scn = SCENARIOS.get(args.scenario)
+    if scn is None:
+        print(f"unknown scenario {args.scenario!r}; try: {', '.join(sorted(SCENARIOS))}",
+              file=sys.stderr)
+        return 2
+    report = asyncio.run(run_scenario(
+        scn, dry_run=args.dry_run, report_path=args.report,
+        workers_override=args.workers,
+    ))
+    print(json.dumps(report, indent=2, sort_keys=True))
+    if args.dry_run:
+        return 0
+    return 0 if report.get("passed") else 1
+
+
+def _cmd_trace(args: argparse.Namespace) -> int:
+    if args.replay:
+        cfg, events = load_trace(args.replay)
+        print(json.dumps({
+            "replay": args.replay, "seed": cfg.seed, "events": len(events),
+            "digest": trace_digest(events), "duration_s": cfg.duration_s,
+        }, indent=2))
+        return 0
+    scn = SCENARIOS.get(args.scenario)
+    if scn is None:
+        print(f"unknown scenario {args.scenario!r}", file=sys.stderr)
+        return 2
+    events = generate_trace(scn.trace)
+    if args.out:
+        save_trace(args.out, scn.trace, events)
+        print(f"wrote {len(events)} events to {args.out}")
+    else:
+        print(json.dumps({
+            "scenario": scn.name, "seed": scn.trace.seed,
+            "events": len(events), "digest": trace_digest(events),
+        }, indent=2))
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m dynamo_tpu.fleetsim")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p_run = sub.add_parser("run", help="run a scenario end-to-end")
+    p_run.add_argument("scenario")
+    p_run.add_argument("--report", default=None, help="write the report JSON here")
+    p_run.add_argument("--dry-run", action="store_true",
+                       help="generate + digest the trace only; no processes")
+    p_run.add_argument("--workers", type=int, default=0,
+                       help="override the scenario's fixed fleet size")
+    p_run.set_defaults(fn=_cmd_run)
+
+    p_list = sub.add_parser("list", help="list registered scenarios")
+    p_list.set_defaults(fn=_cmd_list)
+
+    p_trace = sub.add_parser("trace", help="generate or inspect a trace file")
+    p_trace.add_argument("scenario", nargs="?", default="smoke")
+    p_trace.add_argument("--out", default=None, help="write the trace JSONL here")
+    p_trace.add_argument("--replay", default=None,
+                         help="load + digest-check an existing trace file")
+    p_trace.set_defaults(fn=_cmd_trace)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

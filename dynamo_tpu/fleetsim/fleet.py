@@ -1,0 +1,303 @@
+"""Fleet plane: tens of mock workers as real OS processes.
+
+Each worker is a ``python -m dynamo_tpu.launch --role worker --mock``
+subprocess joined to the scenario's store — the same spawn contract as the
+planner's :class:`~dynamo_tpu.planner.connector.LocalProcessConnector`, but
+with the fidelity the fleet scenarios need on top:
+
+- **per-worker timing profiles** (:class:`WorkerTimingProfile` → the
+  mocker's ``DYN_MOCK_*`` env overlay): heterogeneous speeds, jitter, and
+  cold-start warm-up ramps, so planner scale-ups see realistic TTFT;
+- **full lifecycle control**: spawn (wait for READY), ``drain`` (SIGTERM →
+  the launch CLI's graceful drain: draining=True republish, in-flight work
+  finishes, lease revoked), ``kill`` (SIGKILL → crash; lease expiry cleans
+  up, mid-stream requests see the structured failure SSE);
+- **planner actuation**: the manager implements the planner ``Connector``
+  protocol, so a ``PlannerLoop`` scales this fleet directly;
+- **scripted churn**: a timed kill/drain/spawn schedule running alongside
+  the trace (:class:`ChurnEvent`), chaos faults armed via ``DYN_FAULTS`` in
+  each worker's environment.
+
+Process-per-worker is the point, not an implementation detail: on a 1-core
+host an in-process fleet serializes on the GIL and flattens every latency
+measurement (the r06 striping sweep hit exactly this), while mock workers
+in separate processes spend their time in ``time.sleep`` and interleave
+like a real fleet.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+
+from dynamo_tpu.config import load_fleet_settings
+from dynamo_tpu.planner.core import PlanDecision
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkerTimingProfile:
+    """One worker's timing model, carried to the subprocess as env."""
+
+    prefill_us_per_token: float = 50.0
+    decode_us_base: float = 2000.0
+    decode_us_per_seq: float = 100.0
+    jitter: float = 0.0  # lognormal sigma on per-step compute (0 = exact)
+    warmup_s: float = 0.0  # cold-start ramp duration (0 = instant capacity)
+    warmup_factor: float = 1.0  # compute multiplier at t=0, decaying to 1.0
+    seed: int = 0
+
+    def to_env(self) -> dict[str, str]:
+        return {
+            "DYN_MOCK_PREFILL_US_PER_TOKEN": str(self.prefill_us_per_token),
+            "DYN_MOCK_DECODE_US_BASE": str(self.decode_us_base),
+            "DYN_MOCK_DECODE_US_PER_SEQ": str(self.decode_us_per_seq),
+            "DYN_MOCK_JITTER": str(self.jitter),
+            "DYN_MOCK_WARMUP_S": str(self.warmup_s),
+            "DYN_MOCK_WARMUP_FACTOR": str(self.warmup_factor),
+            "DYN_MOCK_SEED": str(self.seed),
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class ChurnEvent:
+    """A scripted fleet mutation at ``at_s`` seconds into the scenario."""
+
+    at_s: float
+    action: str  # "kill" | "drain" | "spawn"
+    count: int = 1
+    # Index into the live fleet for kill/drain. -1 = youngest; 0 = oldest —
+    # the one KV-affinity concentrates shared-prefix streams on, so kill @ 0
+    # is the "worker with work in flight" case.
+    which: int = -1
+
+
+@dataclasses.dataclass
+class WorkerHandle:
+    proc: subprocess.Popen
+    profile: WorkerTimingProfile
+    index: int  # stable spawn ordinal (profile assignment, logs)
+
+
+class FleetManager:
+    """Owns the worker subprocesses of one scenario run.
+
+    Implements the planner ``Connector`` protocol (``apply``/``close``) so a
+    ``PlannerLoop`` can drive the same fleet the churn script mutates.
+    """
+
+    def __init__(
+        self,
+        *,
+        store_url: str,
+        model: str = "test-tiny",
+        host: str = "127.0.0.1",
+        router_mode: str = "kv",
+        base_env: dict[str, str] | None = None,
+        profiles: tuple[WorkerTimingProfile, ...] = (),
+        spawn_timeout: float | None = None,
+        drain_timeout: float | None = None,
+    ) -> None:
+        settings = load_fleet_settings()
+        self.store_url = store_url
+        self.model = model
+        self.host = host
+        self.router_mode = router_mode
+        self.base_env = dict(base_env or {})
+        self.profiles = tuple(profiles)
+        self.spawn_timeout = spawn_timeout if spawn_timeout is not None else settings.spawn_timeout_s
+        self.drain_timeout = drain_timeout if drain_timeout is not None else settings.drain_timeout_s
+        self.workers: list[WorkerHandle] = []
+        self._spawned_total = 0
+        self.counters = {"spawns": 0, "kills": 0, "drains": 0,
+                         "scale_ups": 0, "scale_downs": 0}
+
+    # -- spawn -------------------------------------------------------------
+
+    def _profile_for(self, ordinal: int) -> WorkerTimingProfile:
+        if not self.profiles:
+            return WorkerTimingProfile(seed=ordinal)
+        p = self.profiles[ordinal % len(self.profiles)]
+        # Distinct jitter streams per worker even when profiles repeat.
+        return dataclasses.replace(p, seed=p.seed + ordinal)
+
+    def _spawn_one(self) -> WorkerHandle:
+        import dynamo_tpu
+
+        ordinal = self._spawned_total
+        self._spawned_total += 1
+        profile = self._profile_for(ordinal)
+        cmd = [
+            sys.executable, "-m", "dynamo_tpu.launch",
+            "--role", "worker", "--model", self.model,
+            "--store", self.store_url, "--host", self.host,
+            "--router-mode", self.router_mode, "--mock",
+        ]
+        env = dict(os.environ)
+        env.update(self.base_env)
+        env.update(profile.to_env())
+        pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(dynamo_tpu.__file__)))
+        env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                                text=True, env=env)
+        logger.info("fleet: spawned worker #%d pid=%d", ordinal, proc.pid)
+        return WorkerHandle(proc=proc, profile=profile, index=ordinal)
+
+    async def _wait_ready(self, handle: WorkerHandle) -> None:
+        proc = handle.proc
+
+        def read() -> None:
+            while True:
+                line = proc.stdout.readline() if proc.stdout else ""
+                if not line:
+                    raise RuntimeError(
+                        f"worker #{handle.index} pid={proc.pid} exited rc={proc.poll()} before READY"
+                    )
+                if line.startswith("READY"):
+                    return
+
+        try:
+            await asyncio.wait_for(
+                asyncio.get_running_loop().run_in_executor(None, read), self.spawn_timeout
+            )
+        except (asyncio.TimeoutError, TimeoutError):
+            proc.kill()  # EOFs the pipe, unblocking the reader thread
+            raise TimeoutError(
+                f"worker #{handle.index} pid={proc.pid} not READY in {self.spawn_timeout}s"
+            ) from None
+        # Keep the pipe drained for life: a full 64KB pipe would eventually
+        # block the worker's own log writes and wedge it mid-serve.
+        threading.Thread(target=self._drain_pipe, args=(proc,), daemon=True).start()
+
+    @staticmethod
+    def _drain_pipe(proc: subprocess.Popen) -> None:
+        try:
+            while proc.stdout and proc.stdout.readline():
+                pass
+        except Exception:  # pragma: no cover - interpreter teardown
+            pass
+
+    async def spawn_workers(self, n: int) -> list[WorkerHandle]:
+        """Spawn ``n`` workers and wait for all READY lines concurrently
+        (cold starts overlap instead of serializing)."""
+        handles = [self._spawn_one() for _ in range(n)]
+        results = await asyncio.gather(
+            *(self._wait_ready(h) for h in handles), return_exceptions=True
+        )
+        failures: list[BaseException] = []
+        for h, r in zip(handles, results):
+            if isinstance(r, BaseException):
+                logger.error("fleet: worker #%d failed to start: %s", h.index, r)
+                if h.proc.poll() is None:
+                    h.proc.kill()
+                failures.append(r)
+            else:
+                self.workers.append(h)
+                self.counters["spawns"] += 1
+        if failures:
+            raise failures[0]
+        return handles
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def reap(self) -> None:
+        self.workers = [h for h in self.workers if h.proc.poll() is None]
+
+    def live_count(self) -> int:
+        self.reap()
+        return len(self.workers)
+
+    def kill(self, which: int = -1) -> WorkerHandle | None:
+        """SIGKILL a live worker (default: the youngest). A crash, not a
+        shutdown: lease expiry removes its records, in-flight streams get
+        the structured mid_stream_failure SSE."""
+        self.reap()
+        if not self.workers:
+            return None
+        handle = self.workers.pop(which)
+        handle.proc.kill()
+        self.counters["kills"] += 1
+        logger.info("fleet: killed worker #%d pid=%d", handle.index, handle.proc.pid)
+        return handle
+
+    async def drain(self, which: int = -1) -> WorkerHandle | None:
+        """SIGTERM a live worker (default: the youngest) and wait for the
+        launch CLI's graceful drain to finish, escalating to SIGKILL at the
+        drain deadline."""
+        self.reap()
+        if not self.workers:
+            return None
+        handle = self.workers.pop(which)
+        handle.proc.send_signal(signal.SIGTERM)
+        self.counters["drains"] += 1
+
+        def wait() -> None:
+            try:
+                handle.proc.wait(timeout=self.drain_timeout)
+            except subprocess.TimeoutExpired:
+                logger.warning("fleet: drain deadline hit for worker #%d; killing", handle.index)
+                handle.proc.kill()
+                handle.proc.wait(timeout=5)
+
+        await asyncio.get_running_loop().run_in_executor(None, wait)
+        logger.info("fleet: drained worker #%d", handle.index)
+        return handle
+
+    async def run_churn(self, events: list[ChurnEvent], t0: float) -> None:
+        """Execute a churn script against the scenario clock (``t0`` is the
+        loop-time origin shared with the open-loop client)."""
+        loop = asyncio.get_running_loop()
+        for ev in sorted(events, key=lambda e: e.at_s):
+            delay = ev.at_s - (loop.time() - t0)
+            if delay > 0:
+                await asyncio.sleep(delay)
+            for _ in range(ev.count):
+                if ev.action == "kill":
+                    self.kill(ev.which)
+                elif ev.action == "drain":
+                    await self.drain(ev.which)
+                elif ev.action == "spawn":
+                    await self.spawn_workers(1)
+                else:
+                    raise ValueError(f"unknown churn action {ev.action!r}")
+
+    # -- planner Connector protocol ----------------------------------------
+
+    async def apply(self, decision: PlanDecision) -> None:
+        self.reap()
+        target = max(decision.decode_workers, 0)
+        if len(self.workers) < target:
+            await self.spawn_workers(target - len(self.workers))
+            self.counters["scale_ups"] += 1
+        elif len(self.workers) > target:
+            while len(self.workers) > target:
+                handle = self.workers.pop()  # youngest first (coldest cache)
+                handle.proc.terminate()
+            self.counters["scale_downs"] += 1
+
+    async def close(self) -> None:
+        procs = [h.proc for h in self.workers]
+        self.workers = []
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+
+        def wait_all() -> None:
+            for p in procs:
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    try:
+                        p.wait(timeout=5)
+                    except subprocess.TimeoutExpired:  # pragma: no cover
+                        pass
+
+        await asyncio.get_running_loop().run_in_executor(None, wait_all)

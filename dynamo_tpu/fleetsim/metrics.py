@@ -1,0 +1,87 @@
+"""Fleet scoreboard Prometheus families (``dynamo_fleet_*``).
+
+One registry per scenario run, synced from the final
+:class:`~dynamo_tpu.fleetsim.scoreboard.Scoreboard` report, so a soak run
+can be scraped live and a CI run can assert on the same names the
+dashboards use. Enumerated by ``tools/check_metric_names.py`` next to the
+frontend and engine registries — names must stay ``dynamo_``-prefixed,
+globally unique, HELP'd, and label-consistent.
+"""
+
+from __future__ import annotations
+
+from prometheus_client import CollectorRegistry, Gauge, generate_latest
+
+
+class FleetMetrics:
+    def __init__(self, registry: CollectorRegistry | None = None) -> None:
+        self.registry = registry or CollectorRegistry()
+        ns = "dynamo_fleet"
+        self.goodput_frac = Gauge(
+            f"{ns}_goodput_frac_at_slo",
+            "Fraction of finished requests that attained the scenario SLO "
+            "(TTFT and per-request p99 ITL within targets)",
+            registry=self.registry,
+        )
+        self.goodput_tokens_per_s = Gauge(
+            f"{ns}_goodput_tokens_per_s",
+            "Output tokens/s from SLO-attaining requests over the scenario wall time",
+            registry=self.registry,
+        )
+        self.tenant_fairness = Gauge(
+            f"{ns}_tenant_fairness",
+            "min/max ratio of per-tenant SLO-attainment fractions (1.0 = perfectly fair)",
+            registry=self.registry,
+        )
+        self.requests = Gauge(
+            f"{ns}_requests",
+            "Scenario requests by outcome (ok / error / mid_stream_failure)",
+            ["outcome"], registry=self.registry,
+        )
+        self.tenant_goodput_frac = Gauge(
+            f"{ns}_tenant_goodput_frac",
+            "Per-tenant fraction of requests that attained the scenario SLO",
+            ["tenant"], registry=self.registry,
+        )
+        self.ttft_quantile = Gauge(
+            f"{ns}_ttft_quantile_seconds",
+            "Open-loop TTFT quantile (P^2), measured from intended injection time",
+            ["quantile"], registry=self.registry,
+        )
+        self.itl_quantile = Gauge(
+            f"{ns}_itl_quantile_seconds",
+            "Open-loop inter-token-latency quantile (P^2) across all streams",
+            ["quantile"], registry=self.registry,
+        )
+        self.workers_live = Gauge(
+            f"{ns}_workers_live",
+            "Worker processes alive at the last fleet reap",
+            registry=self.registry,
+        )
+        self.lifecycle = Gauge(
+            f"{ns}_lifecycle_events",
+            "Fleet lifecycle event counts (spawns / kills / drains / scale_ups / scale_downs)",
+            ["event"], registry=self.registry,
+        )
+
+    def sync_report(self, report: dict) -> None:
+        """Load a finished scenario report's fields into the gauges."""
+        self.goodput_frac.set(report.get("goodput_frac_at_slo", 0.0))
+        self.goodput_tokens_per_s.set(report.get("goodput_tokens_per_s_at_slo", 0.0))
+        self.tenant_fairness.set(report.get("tenant_fairness", 0.0))
+        req = report.get("requests", {})
+        for outcome in ("ok", "error", "mid_stream_failure"):
+            self.requests.labels(outcome).set(req.get(outcome, 0))
+        for tenant, t in report.get("tenants", {}).items():
+            self.tenant_goodput_frac.labels(tenant).set(t.get("goodput_frac", 0.0))
+        for q, v in report.get("ttft_ms", {}).items():
+            self.ttft_quantile.labels(q).set(v / 1e3)
+        for q, v in report.get("itl_ms", {}).items():
+            self.itl_quantile.labels(q).set(v / 1e3)
+        self.workers_live.set(report.get("fleet", {}).get("live", 0))
+        for event, n in report.get("fleet", {}).items():
+            if event != "live":
+                self.lifecycle.labels(event).set(n)
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)

@@ -1,0 +1,461 @@
+"""Scenarios as code: trace + fleet shape + fault script + assertions.
+
+A :class:`Scenario` is the complete, reviewable description of one fleet
+run — the workload (a :class:`~dynamo_tpu.fleetsim.trace.TraceConfig`),
+the fleet (worker count, per-worker timing profiles, optional planner),
+the chaos (``DYN_FAULTS`` spec + scripted churn), the SLO the run is
+judged against, and machine-checkable pass/fail :class:`Check` assertions
+over the scoreboard report. Nothing about a run lives outside the spec,
+so the same scenario line in CI and on an operator's laptop is the same
+experiment.
+
+:func:`run_scenario` is the harness: it brings up the **real** control
+plane in-process (store server, distributed runtime, frontend with the
+ModelWatcher-built router, optionally the metrics aggregator + planner
+loop) and the fleet as worker OS processes, replays the trace open-loop,
+and folds everything into one report dict.
+
+Tiers: ``fast`` scenarios finish in seconds and run in tier-1 CI;
+``soak`` scenarios run for hours behind the ``slow`` marker.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+import os
+import socket
+
+import aiohttp
+
+from dynamo_tpu.config import load_fleet_settings
+from dynamo_tpu.fleetsim.fleet import ChurnEvent, FleetManager, WorkerTimingProfile
+from dynamo_tpu.fleetsim.scoreboard import (
+    Scoreboard,
+    SloTarget,
+    poll_control_plane,
+    run_open_loop,
+    wall_clock,
+)
+from dynamo_tpu.fleetsim.trace import (
+    BurstEpisode,
+    TenantFlood,
+    TraceConfig,
+    generate_trace,
+    trace_digest,
+)
+from dynamo_tpu.planner.core import Planner, PlannerConfig, WorkerProfile
+
+logger = logging.getLogger(__name__)
+
+_OPS = {
+    ">=": lambda a, b: a >= b,
+    "<=": lambda a, b: a <= b,
+    ">": lambda a, b: a > b,
+    "<": lambda a, b: a < b,
+    "==": lambda a, b: a == b,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Check:
+    """One pass/fail assertion over the report: ``key op value`` where
+    ``key`` is a dotted path into the report dict (``itl_ms.p99``,
+    ``tenants.light.goodput_frac``, ``planner.max_decode_workers``)."""
+
+    key: str
+    op: str
+    value: float
+
+    def evaluate(self, report: dict) -> dict:
+        node: object = report
+        found = True
+        for part in self.key.split("."):
+            if isinstance(node, dict) and part in node:
+                node = node[part]
+            else:
+                found = False
+                break
+        ok = found and isinstance(node, (int, float)) and _OPS[self.op](node, self.value)
+        return {
+            "key": self.key, "op": self.op, "value": self.value,
+            "actual": node if found and isinstance(node, (int, float)) else None,
+            "ok": bool(ok),
+        }
+
+
+@dataclasses.dataclass
+class Scenario:
+    name: str
+    description: str
+    trace: TraceConfig
+    workers: int = 2
+    profiles: tuple[WorkerTimingProfile, ...] = ()
+    # Optional autoscaling: planner config + the capacity profile it plans
+    # with. When set, the fleet starts at min_workers and the planner loop
+    # (not ``workers``) owns the fleet size.
+    planner: PlannerConfig | None = None
+    planner_profile: WorkerProfile | None = None
+    faults: str = ""  # DYN_FAULTS grammar, armed in every worker process
+    churn: tuple[ChurnEvent, ...] = ()
+    env: dict[str, str] = dataclasses.field(default_factory=dict)
+    slo: SloTarget = dataclasses.field(default_factory=SloTarget)
+    checks: tuple[Check, ...] = ()
+    tier: str = "fast"  # "fast" (tier-1 CI) | "soak" (behind the slow marker)
+    router_mode: str = "kv"
+    model: str = "test-tiny"
+    # Keep the planner ticking this long after the trace drains, so
+    # scale-DOWN decisions land inside the run (and the report).
+    cooldown_s: float = 0.0
+    request_timeout_s: float = 60.0
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+async def _wait_model(base: str, model: str, timeout_s: float = 60.0) -> None:
+    """Poll /v1/models until the watcher has discovered the fleet's model."""
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout_s
+    async with aiohttp.ClientSession() as session:
+        while loop.time() < deadline:
+            try:
+                async with session.get(f"{base}/v1/models") as resp:
+                    if resp.status == 200:
+                        doc = await resp.json()
+                        if any(m.get("id") == model for m in doc.get("data", [])):
+                            return
+            except Exception:
+                pass
+            await asyncio.sleep(0.2)
+    raise TimeoutError(f"model {model!r} not discoverable at {base} in {timeout_s}s")
+
+
+class _LoggingConnector:
+    """Planner Connector that records every decision (scenario-relative
+    time) before delegating to the fleet."""
+
+    def __init__(self, fleet: FleetManager, scoreboard: Scoreboard, t0: float) -> None:
+        self.fleet = fleet
+        self.scoreboard = scoreboard
+        self.t0 = t0
+
+    async def apply(self, decision) -> None:
+        self.scoreboard.planner_decisions.append({
+            "t_s": round(asyncio.get_running_loop().time() - self.t0, 3),
+            "decode_workers": decision.decode_workers,
+            "prefill_workers": decision.prefill_workers,
+        })
+        await self.fleet.apply(decision)
+
+    async def close(self) -> None:
+        pass  # the fleet is torn down by run_scenario's finally block
+
+
+async def run_scenario(
+    scn: Scenario,
+    *,
+    dry_run: bool = False,
+    report_path: str | None = None,
+    workers_override: int = 0,
+) -> dict:
+    """Run one scenario end-to-end and return the report dict.
+
+    ``dry_run`` generates and digests the trace and returns the report
+    skeleton without starting any process — the cheap determinism /
+    structure check. ``workers_override`` (or ``DYN_FLEET_WORKERS``)
+    resizes a fixed fleet; planner-owned fleets ignore it.
+    """
+    settings = load_fleet_settings()
+    events = generate_trace(scn.trace)
+    digest = trace_digest(events)
+    report: dict = {
+        "scenario": scn.name,
+        "tier": scn.tier,
+        "seed": scn.trace.seed,
+        "trace": {
+            "digest": digest,
+            "events": len(events),
+            "duration_s": scn.trace.duration_s,
+        },
+        "dry_run": dry_run,
+    }
+    if dry_run:
+        report.update({
+            "checks": [dataclasses.asdict(c) for c in scn.checks],
+            "passed": None,
+        })
+        return report
+
+    workers = workers_override or settings.workers or scn.workers
+    saved_env = {k: os.environ.get(k) for k in scn.env}
+    os.environ.update(scn.env)  # frontend/router-side toggles live here
+
+    from dynamo_tpu.launch import serve_frontend
+    from dynamo_tpu.router.metrics import KvMetricsAggregator
+    from dynamo_tpu.runtime.component import DistributedRuntime
+    from dynamo_tpu.runtime.store_server import StoreServer
+    from dynamo_tpu.runtime.tcp import TcpTransport
+
+    loop = asyncio.get_running_loop()
+    started = wall_clock()
+    server = runtime = aggregator = http = watcher = fleet = planner_loop = None
+    tasks: list[asyncio.Task] = []
+    scoreboard = Scoreboard(slo=scn.slo)
+    try:
+        port = _free_port()
+        server = await StoreServer(host="127.0.0.1", port=port).start()
+        runtime = DistributedRuntime(server.store, TcpTransport(host="127.0.0.1"))
+        http, watcher, http_port = await serve_frontend(runtime, host="127.0.0.1", port=0)
+        base = f"http://127.0.0.1:{http_port}"
+
+        base_env = dict(scn.env)
+        if scn.faults:
+            base_env["DYN_FAULTS"] = scn.faults
+            base_env.setdefault("DYN_FAULTS_SEED", str(scn.trace.seed))
+        fleet = FleetManager(
+            store_url=f"tcp://127.0.0.1:{port}", model=scn.model,
+            router_mode=scn.router_mode, base_env=base_env,
+            profiles=scn.profiles,
+        )
+        initial = scn.planner.min_workers if scn.planner is not None else workers
+        await fleet.spawn_workers(initial)
+        await _wait_model(base, scn.model, timeout_s=fleet.spawn_timeout)
+
+        t0 = loop.time()
+        if scn.planner is not None:
+            from dynamo_tpu.planner.connector import PlannerLoop
+
+            aggregator = await KvMetricsAggregator(runtime, "dynamo", "backend").start()
+            planner = Planner(scn.planner, scn.planner_profile or WorkerProfile())
+            planner_loop = PlannerLoop(planner, aggregator,
+                                       _LoggingConnector(fleet, scoreboard, t0))
+            await planner_loop.start()
+        tasks.append(asyncio.create_task(
+            poll_control_plane(base, scoreboard, interval_s=settings.metrics_poll_s)))
+        if scn.churn:
+            tasks.append(asyncio.create_task(fleet.run_churn(list(scn.churn), t0)))
+
+        await run_open_loop(base, scn.model, events, scoreboard, t0=t0,
+                            request_timeout_s=scn.request_timeout_s)
+        if scn.cooldown_s > 0:
+            await asyncio.sleep(scn.cooldown_s)
+        duration = loop.time() - t0
+
+        report.update(scoreboard.report(duration_s=duration))
+        report["fleet"] = {**fleet.counters, "live": fleet.live_count()}
+    finally:
+        for t in tasks:
+            t.cancel()
+        for t in tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        if planner_loop is not None:
+            await planner_loop.close()
+        if fleet is not None:
+            await fleet.close()
+        if aggregator is not None:
+            await aggregator.close()
+        if watcher is not None:
+            await watcher.close()
+        if http is not None:
+            await http.stop()
+        if runtime is not None:
+            await runtime.close()
+        if server is not None:
+            await server.close()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    report["started_unix"] = round(started, 3)
+    results = [c.evaluate(report) for c in scn.checks]
+    report["checks"] = results
+    report["passed"] = all(r["ok"] for r in results)
+
+    out_path = report_path or (
+        os.path.join(settings.report_dir, f"{scn.name}.json") if settings.report_dir else None
+    )
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+        logger.info("fleetsim: report written to %s", out_path)
+    return report
+
+
+# -- scenario registry -----------------------------------------------------
+
+# Heterogeneous fleet: a fast half and a slower, noisier half with real
+# cold-start ramps — what a planner scale-up actually lands on.
+_MIXED_PROFILES = (
+    WorkerTimingProfile(jitter=0.05, warmup_s=1.0, warmup_factor=3.0),
+    WorkerTimingProfile(prefill_us_per_token=80.0, decode_us_base=3000.0,
+                        jitter=0.15, warmup_s=2.0, warmup_factor=4.0),
+)
+
+SCENARIOS: dict[str, Scenario] = {}
+
+
+def _register(scn: Scenario) -> Scenario:
+    SCENARIOS[scn.name] = scn
+    return scn
+
+
+_register(Scenario(
+    name="smoke",
+    description="Tiny steady trace on a 2-worker fleet; the bench probe and "
+                "CLI default. Seconds, no chaos.",
+    trace=TraceConfig(duration_s=3.0, base_qps=4.0, osl_mean=16, seed=7),
+    workers=2,
+    checks=(
+        Check("requests.total", ">=", 6),
+        Check("goodput_frac_at_slo", ">=", 0.5),
+    ),
+))
+
+_register(Scenario(
+    name="burst_absorb",
+    description="4x Poisson burst mid-trace: the fleet must absorb it "
+                "without blowing the ITL tail (decode steps keep their "
+                "cadence while the prefill backlog drains).",
+    trace=TraceConfig(duration_s=6.0, base_qps=4.0, osl_mean=24,
+                      bursts=(BurstEpisode(start_s=2.0, duration_s=1.5, rate_scale=4.0),),
+                      seed=11),
+    workers=2,
+    profiles=(WorkerTimingProfile(jitter=0.05),),
+    checks=(
+        Check("requests.total", ">=", 20),
+        Check("itl_ms.p99", "<=", 50.0),
+        Check("goodput_frac_at_slo", ">=", 0.7),
+    ),
+))
+
+_register(Scenario(
+    name="tenant_flood",
+    description="A heavy tenant floods 8x the organic rate; per-tenant "
+                "quotas + the admission plane must keep the light tenant's "
+                "attainment above the fairness floor.",
+    trace=TraceConfig(duration_s=6.0, base_qps=3.0, osl_mean=20,
+                      tenants=(("light", 1.0),),
+                      flood=TenantFlood(tenant="heavy", start_s=1.5, duration_s=3.0, qps=25.0),
+                      seed=13),
+    workers=2,
+    env={
+        "DYN_SLO_SCHED": "1",
+        "DYN_TENANT_QUOTAS": json.dumps({
+            "heavy": {"rate_tokens_per_s": 400, "max_inflight_tokens": 1024},
+        }),
+    },
+    checks=(
+        Check("requests.total", ">=", 30),
+        Check("tenants.light.goodput_frac", ">=", 0.6),
+    ),
+))
+
+_register(Scenario(
+    name="kill_midstream",
+    description="SIGKILL a worker while long streams are in flight: clients "
+                "on the dead worker get the structured mid_stream_failure "
+                "SSE, the breaker sheds the corpse, the survivor keeps "
+                "serving.",
+    trace=TraceConfig(duration_s=5.0, base_qps=3.0, osl_mean=80, osl_cv=0.2, seed=17),
+    workers=2,
+    # Slow decode (~20ms/token) so streams span the kill point.
+    profiles=(WorkerTimingProfile(decode_us_base=20000.0, jitter=0.05),),
+    slo=SloTarget(ttft_ms=500.0, itl_p99_ms=80.0),
+    # Round-robin (not KV) routing: the shared trace prefix makes
+    # KV-affinity concentrate every stream on whichever worker caches it
+    # first — a race — so a fixed-index kill sometimes hits an idle worker.
+    # Round-robin guarantees both workers hold streams at the kill point.
+    router_mode="round_robin",
+    churn=(ChurnEvent(at_s=2.0, action="kill", which=0),),
+    checks=(
+        Check("requests.total", ">=", 10),
+        Check("requests.mid_stream_failure", ">=", 1),
+        Check("requests.ok", ">=", 3),
+        Check("fleet.kills", ">=", 1),
+    ),
+))
+
+_register(Scenario(
+    name="period_shift",
+    description="Diurnal period shift (5x rate step): the planner loop must "
+                "scale the decode fleet up into the shift and back down in "
+                "the cooldown drain.",
+    trace=TraceConfig(duration_s=10.0, base_qps=2.0, osl_mean=40,
+                      period_shift_at_s=4.0, period_shift_scale=5.0, seed=19),
+    planner=PlannerConfig(mode="load", predictor="linear", min_workers=1,
+                          max_workers=3, target_utilization=0.7,
+                          interval_seconds=1.5),
+    # Capacity far under the mocker's real throughput: measured token rate
+    # forces the scale-up deterministically (same trick as the planner
+    # connector's live-fleet test).
+    planner_profile=WorkerProfile(prefill_tokens_per_sec=1e5, decode_tokens_per_sec=150.0),
+    profiles=(WorkerTimingProfile(warmup_s=1.0, warmup_factor=3.0),),
+    cooldown_s=8.0,
+    checks=(
+        Check("requests.total", ">=", 15),
+        Check("planner.max_decode_workers", ">=", 2),
+        Check("planner.final_decode_workers", "<=", 1),
+        Check("fleet.scale_ups", ">=", 1),
+        Check("fleet.scale_downs", ">=", 1),
+    ),
+))
+
+_register(Scenario(
+    name="fleet_accept",
+    description="The acceptance gate: 8 heterogeneous workers, diurnal + "
+                "burst + two tenants, chaos delays armed in every worker, "
+                "kill-then-respawn churn — goodput, fairness, and lifecycle "
+                "accounting all asserted in one run.",
+    trace=TraceConfig(duration_s=8.0, base_qps=6.0, osl_mean=24,
+                      diurnal_amplitude=0.3, diurnal_period_s=8.0,
+                      bursts=(BurstEpisode(start_s=3.0, duration_s=1.0, rate_scale=3.0),),
+                      tenants=(("alpha", 0.6), ("beta", 0.4)),
+                      seed=23),
+    workers=8,
+    profiles=_MIXED_PROFILES,
+    faults="store.op:delay@0.05,tcp.read:delay@0.05",
+    churn=(ChurnEvent(at_s=2.5, action="kill"), ChurnEvent(at_s=4.0, action="spawn")),
+    checks=(
+        Check("requests.total", ">=", 30),
+        Check("goodput_frac_at_slo", ">=", 0.5),
+        Check("tenant_fairness", ">=", 0.5),
+        Check("fleet.spawns", ">=", 9),
+        Check("fleet.kills", ">=", 1),
+    ),
+))
+
+_register(Scenario(
+    name="diurnal_soak",
+    description="Hour-scale diurnal soak with a mid-cycle tenant flood and "
+                "planner-owned fleet: the long-haul stability run (leaks, "
+                "lease churn, predictor drift).",
+    trace=TraceConfig(duration_s=1800.0, base_qps=5.0, osl_mean=32,
+                      diurnal_amplitude=0.6, diurnal_period_s=300.0,
+                      bursts=(BurstEpisode(start_s=600.0, duration_s=30.0, rate_scale=3.0),),
+                      tenants=(("light", 0.7), ("steady", 0.3)),
+                      flood=TenantFlood(tenant="heavy", start_s=900.0, duration_s=120.0, qps=20.0),
+                      seed=29),
+    planner=PlannerConfig(mode="load", predictor="seasonal", min_workers=2,
+                          max_workers=8, interval_seconds=10.0),
+    planner_profile=WorkerProfile(prefill_tokens_per_sec=1e5, decode_tokens_per_sec=200.0),
+    profiles=_MIXED_PROFILES,
+    faults="store.op:delay@0.02,lease.keepalive:drop@0.02",
+    cooldown_s=60.0,
+    tier="soak",
+    checks=(
+        Check("requests.total", ">=", 5000),
+        Check("goodput_frac_at_slo", ">=", 0.6),
+        Check("planner.max_decode_workers", ">=", 3),
+    ),
+))

@@ -1,0 +1,347 @@
+"""Measurement plane: open-loop injection, SLO ledger, control-plane scrape.
+
+The client is **open-loop**: every trace event fires at its scheduled time
+regardless of how many earlier requests are still in flight, and TTFT is
+measured from the *intended* injection time, not from when the send
+actually left. A closed-loop (or send-clocked) measurement hides stalls —
+when the server wedges, a closed loop simply stops offering load and the
+recorded latencies stay rosy (coordinated omission). Here a wedged second
+shows up as exactly the tail inflation a real user population would see.
+
+Tails come from P² streaming estimators (``observability/slo.py``) at
+p50/p95/p99/p99.9 — fleet runs are long enough that keeping every sample
+is wasteful and fixed histogram buckets would distort the exact quantiles
+the SLO is stated on.
+
+Per-request SLO classification reuses the frontend's accountant semantics
+(TTFT within target AND the request's own p99 inter-token gap within
+target); goodput is tokens from attaining, successful requests. Per-tenant
+ledgers give attainment and the fairness ratio (min/max across tenants).
+
+Control-plane behavior (breaker trips, watch restarts, prefill requeues,
+live engine registries) is scraped from the frontend's federated
+``/metrics`` by a background poller — peak values survive even when the
+condition heals before the run ends.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+import time
+
+import aiohttp
+
+from dynamo_tpu.fleetsim.trace import TraceEvent
+from dynamo_tpu.observability.slo import StreamingQuantiles, percentile
+
+logger = logging.getLogger(__name__)
+
+QUANTILES = (0.5, 0.95, 0.99, 0.999)
+
+
+@dataclasses.dataclass
+class RequestOutcome:
+    request_id: str
+    tenant: str
+    injected_at_s: float  # intended injection offset (trace time)
+    ttft_s: float
+    gaps: list[float]
+    output_tokens: int
+    ok: bool
+    mid_stream_failure: bool = False
+    error: str = ""
+
+
+@dataclasses.dataclass
+class SloTarget:
+    ttft_ms: float = 500.0
+    itl_p99_ms: float = 50.0
+
+
+class _TenantLedger:
+    def __init__(self) -> None:
+        self.requests = 0
+        self.attained = 0
+        self.goodput_tokens = 0
+        self.output_tokens = 0
+
+    def frac(self) -> float:
+        return self.attained / self.requests if self.requests else 0.0
+
+
+class Scoreboard:
+    """Folds request outcomes + control-plane scrapes into one report."""
+
+    def __init__(self, slo: SloTarget | None = None) -> None:
+        self.slo = slo or SloTarget()
+        self.ttft = StreamingQuantiles(QUANTILES)
+        self.itl = StreamingQuantiles(QUANTILES)
+        self.outcomes: list[RequestOutcome] = []
+        self.tenants: dict[str, _TenantLedger] = {}
+        self.attained = 0
+        self.goodput_tokens = 0
+        self.output_tokens = 0
+        self.mid_stream_failures = 0
+        self.errors = 0
+        # Peak/final control-plane counters from the /metrics poller.
+        self.scrape: dict[str, float] = {
+            "breaker_open_max": 0.0, "watch_restarts": 0.0,
+            "prefill_requeues": 0.0, "engine_registries_max": 0.0,
+        }
+        self.planner_decisions: list[dict] = []
+
+    # -- per-request accounting --------------------------------------------
+
+    def observe(self, out: RequestOutcome) -> None:
+        self.outcomes.append(out)
+        ledger = self.tenants.setdefault(out.tenant, _TenantLedger())
+        ledger.requests += 1
+        if out.mid_stream_failure:
+            self.mid_stream_failures += 1
+        if not out.ok:
+            self.errors += 1
+            return
+        self.ttft.observe(out.ttft_s)
+        for g in out.gaps:
+            self.itl.observe(g)
+        self.output_tokens += out.output_tokens
+        ledger.output_tokens += out.output_tokens
+        ttft_ok = out.ttft_s * 1e3 <= self.slo.ttft_ms
+        itl_ok = (
+            percentile(sorted(out.gaps), 0.99) * 1e3 <= self.slo.itl_p99_ms
+            if out.gaps else True
+        )
+        if ttft_ok and itl_ok:
+            self.attained += 1
+            self.goodput_tokens += out.output_tokens
+            ledger.attained += 1
+            ledger.goodput_tokens += out.output_tokens
+
+    # -- report ------------------------------------------------------------
+
+    def tenant_fairness(self) -> float:
+        fracs = [t.frac() for t in self.tenants.values() if t.requests]
+        if not fracs:
+            return 1.0
+        hi = max(fracs)
+        return min(fracs) / hi if hi > 0 else 0.0
+
+    def report(self, *, duration_s: float) -> dict:
+        total = len(self.outcomes)
+        ok = total - self.errors
+
+        def q_ms(qs: StreamingQuantiles) -> dict:
+            return {
+                ("p" + format(q * 100, "g").replace(".", "_")): round(v * 1e3, 3)
+                for q, v in qs.snapshot().items()
+            }
+
+        return {
+            "duration_s": round(duration_s, 3),
+            "requests": {
+                "total": total, "ok": ok, "error": self.errors,
+                "mid_stream_failure": self.mid_stream_failures,
+            },
+            "goodput_frac_at_slo": round(self.attained / total, 4) if total else 0.0,
+            "goodput_tokens_per_s_at_slo": round(
+                self.goodput_tokens / duration_s, 2) if duration_s > 0 else 0.0,
+            "output_tokens_total": self.output_tokens,
+            "ttft_ms": q_ms(self.ttft),
+            "itl_ms": q_ms(self.itl),
+            "slo": {"ttft_ms": self.slo.ttft_ms, "itl_p99_ms": self.slo.itl_p99_ms},
+            "tenants": {
+                name: {
+                    "requests": t.requests,
+                    "goodput_frac": round(t.frac(), 4),
+                    "goodput_tokens": t.goodput_tokens,
+                    "output_tokens": t.output_tokens,
+                }
+                for name, t in sorted(self.tenants.items())
+            },
+            "tenant_fairness": round(self.tenant_fairness(), 4),
+            "control_plane": {k: v for k, v in self.scrape.items()},
+            "planner": {
+                "decisions": self.planner_decisions,
+                "max_decode_workers": max(
+                    (d["decode_workers"] for d in self.planner_decisions), default=0),
+                "final_decode_workers": (
+                    self.planner_decisions[-1]["decode_workers"]
+                    if self.planner_decisions else 0),
+            },
+        }
+
+
+# -- open-loop client ------------------------------------------------------
+
+
+async def _one_request(
+    session: aiohttp.ClientSession,
+    base: str,
+    model: str,
+    ev: TraceEvent,
+    intended_t: float,
+) -> RequestOutcome:
+    """Stream one completion; clock TTFT/done from ``intended_t`` (the
+    loop-time instant the trace scheduled this arrival)."""
+    loop = asyncio.get_running_loop()
+    body = {
+        "model": model,
+        "prompt": ev.token_ids,
+        "max_tokens": ev.max_tokens,
+        "temperature": 0,
+        "stream": True,
+        "stream_options": {"include_usage": True},
+    }
+    headers = {"x-dynamo-tenant": ev.tenant}
+    ttft = 0.0
+    gaps: list[float] = []
+    chunks = 0
+    usage_tokens = None
+    prev = None
+    mid_stream = False
+    error = ""
+    try:
+        async with session.post(f"{base}/v1/completions", json=body, headers=headers) as resp:
+            if resp.status != 200:
+                return RequestOutcome(
+                    ev.request_id, ev.tenant, ev.t_s, 0.0, [], 0, ok=False,
+                    error=f"http {resp.status}",
+                )
+            async for line in resp.content:
+                if not line.startswith(b"data:"):
+                    continue
+                payload = line[5:].strip()
+                if payload == b"[DONE]":
+                    continue
+                now = loop.time()
+                try:
+                    obj = json.loads(payload)
+                except Exception:
+                    continue
+                if "error" in obj:
+                    code = (obj["error"] or {}).get("code", "")
+                    mid_stream = mid_stream or code == "mid_stream_failure"
+                    error = code or "stream_error"
+                    continue
+                usage = obj.get("usage")
+                if usage and usage.get("completion_tokens"):
+                    usage_tokens = usage["completion_tokens"]
+                if prev is None:
+                    ttft = now - intended_t  # open-loop: from intended arrival
+                else:
+                    gaps.append(now - prev)
+                prev = now
+                chunks += 1
+    except Exception as exc:
+        return RequestOutcome(
+            ev.request_id, ev.tenant, ev.t_s, 0.0, [], 0, ok=False,
+            mid_stream_failure=mid_stream or prev is not None,
+            error=error or f"{type(exc).__name__}",
+        )
+    tokens = usage_tokens if usage_tokens is not None else chunks
+    if chunks > 1 and tokens > chunks:
+        # Burst streaming (decode_steps > 1): normalize gaps to per-token.
+        gaps = [g * chunks / tokens for g in gaps]
+    if error:
+        return RequestOutcome(
+            ev.request_id, ev.tenant, ev.t_s, ttft, gaps, tokens, ok=False,
+            mid_stream_failure=mid_stream, error=error,
+        )
+    return RequestOutcome(ev.request_id, ev.tenant, ev.t_s, ttft, gaps, tokens, ok=True)
+
+
+async def run_open_loop(
+    base: str,
+    model: str,
+    events: list[TraceEvent],
+    scoreboard: Scoreboard,
+    *,
+    t0: float | None = None,
+    request_timeout_s: float = 120.0,
+) -> None:
+    """Replay ``events`` open-loop against the frontend at ``base``.
+
+    ``t0`` is the loop-time origin of the scenario clock (shared with the
+    churn script); injection of event ``e`` is scheduled at ``t0 + e.t_s``
+    no matter what earlier requests are doing.
+    """
+    loop = asyncio.get_running_loop()
+    t0 = loop.time() if t0 is None else t0
+    connector = aiohttp.TCPConnector(limit=0)  # open loop: no client-side cap
+    timeout = aiohttp.ClientTimeout(total=request_timeout_s)
+    async with aiohttp.ClientSession(connector=connector, timeout=timeout) as session:
+
+        async def one(ev: TraceEvent) -> RequestOutcome:
+            intended = t0 + ev.t_s
+            delay = intended - loop.time()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            return await _one_request(session, base, model, ev, intended)
+
+        for out in await asyncio.gather(*(one(ev) for ev in events)):
+            scoreboard.observe(out)
+
+
+# -- federated /metrics scrape ---------------------------------------------
+
+
+def parse_control_plane(text: str) -> dict[str, float]:
+    """Pull the control-plane counters out of a federated /metrics body."""
+    breaker_open = 0
+    watch_restarts = 0.0
+    requeues = 0.0
+    engine_workers: set[str] = set()
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        name, _, rest = line.partition("{") if "{" in line else (line.split()[0], "", line)
+        try:
+            value = float(line.rsplit(None, 1)[-1])
+        except ValueError:
+            continue
+        if name == "dynamo_client_breaker_state" and value >= 2.0:
+            breaker_open += 1
+        elif name == "dynamo_client_watch_restarts_total":
+            watch_restarts += value
+        elif name.startswith("dynamo_engine_prefill_requeues"):
+            requeues += value
+        elif name.startswith("dynamo_engine_") and 'worker="' in rest:
+            engine_workers.add(rest.split('worker="', 1)[1].split('"', 1)[0])
+    return {
+        "breaker_open": float(breaker_open),
+        "watch_restarts": watch_restarts,
+        "prefill_requeues": requeues,
+        "engine_registries": float(len(engine_workers)),
+    }
+
+
+async def poll_control_plane(
+    base: str, scoreboard: Scoreboard, *, interval_s: float = 1.0
+) -> None:
+    """Scrape the federated /metrics until cancelled, folding peaks and
+    finals into the scoreboard (breaker trips recover; peaks must not)."""
+    async with aiohttp.ClientSession() as session:
+        while True:
+            try:
+                async with session.get(f"{base}/metrics") as resp:
+                    if resp.status == 200:
+                        snap = parse_control_plane(await resp.text())
+                        s = scoreboard.scrape
+                        s["breaker_open_max"] = max(s["breaker_open_max"], snap["breaker_open"])
+                        s["watch_restarts"] = max(s["watch_restarts"], snap["watch_restarts"])
+                        s["prefill_requeues"] = max(s["prefill_requeues"], snap["prefill_requeues"])
+                        s["engine_registries_max"] = max(
+                            s["engine_registries_max"], snap["engine_registries"])
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # scrape failures must not kill the run
+                logger.debug("metrics scrape failed: %s", exc)
+            await asyncio.sleep(interval_s)
+
+
+def wall_clock() -> float:
+    """Report-stamp helper (kept here so scenario code avoids bare time)."""
+    return time.time()

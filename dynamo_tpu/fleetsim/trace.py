@@ -1,0 +1,242 @@
+"""Workload plane: deterministic arrival traces with fleet-scale structure.
+
+Arrivals follow an inhomogeneous Poisson process (thinning over a rate
+envelope) so load has the statistics real frontends see — bursty
+interarrivals, not a metronome. The rate function composes the fleet
+phenomena the scenarios exercise:
+
+- a **diurnal** sinusoid (amplitude as a fraction of the base rate),
+- a **period shift** (the rate steps to a new scale at a given time — the
+  planner's scale-up/scale-down trigger),
+- **burst episodes** (multiplicative windows over the base rate),
+- a **heavy-tenant flood** (an independent homogeneous stream for one
+  tenant over a window, on top of the organic mix).
+
+Prompts carry the two-level prefix structure of ``bench/synthesizer.py``
+(one corpus-wide shared prefix, G group prefixes, unique tails) so the KV
+router and prefix cache see realistic sharing.
+
+Everything derives from one ``numpy`` Generator seeded by
+``TraceConfig.seed``: the same config always produces the bit-identical
+event list, serialized as JSONL (one header line, one line per event) so
+traces are replayable and diffable across runs and machines.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import math
+
+import numpy as np
+
+TRACE_FORMAT = "dynamo-fleet-trace"
+TRACE_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class BurstEpisode:
+    """A multiplicative rate window: ``rate *= rate_scale`` inside it."""
+
+    start_s: float
+    duration_s: float
+    rate_scale: float
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantFlood:
+    """An independent homogeneous arrival stream for one tenant."""
+
+    tenant: str = "heavy"
+    start_s: float = 0.0
+    duration_s: float = 0.0
+    qps: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceConfig:
+    duration_s: float = 10.0
+    base_qps: float = 4.0
+    # Diurnal modulation: rate(t) = base * (1 + amplitude * sin(2πt/period)).
+    diurnal_amplitude: float = 0.0
+    diurnal_period_s: float = 60.0
+    # Period shift: from shift_at_s on, the whole envelope scales by
+    # shift_scale (a step change in offered load, not a burst).
+    period_shift_at_s: float = -1.0  # < 0 disables
+    period_shift_scale: float = 1.0
+    bursts: tuple[BurstEpisode, ...] = ()
+    flood: TenantFlood | None = None
+    # Organic tenant mix: (name, weight) pairs; weights need not sum to 1.
+    tenants: tuple[tuple[str, float], ...] = (("default", 1.0),)
+    # Prompt structure (two-level prefix tree, see bench/synthesizer.py).
+    shared_prefix_len: int = 32
+    num_groups: int = 4
+    group_prefix_len: int = 32
+    unique_len: int = 16
+    vocab: int = 250
+    osl_mean: int = 24
+    osl_cv: float = 0.3
+    seed: int = 0
+
+    def rate_at(self, t: float) -> float:
+        """The arrival-rate envelope (req/s) at time ``t``, floods excluded."""
+        rate = self.base_qps
+        if self.diurnal_amplitude > 0.0 and self.diurnal_period_s > 0.0:
+            rate *= 1.0 + self.diurnal_amplitude * math.sin(
+                2.0 * math.pi * t / self.diurnal_period_s
+            )
+        if 0.0 <= self.period_shift_at_s <= t:
+            rate *= self.period_shift_scale
+        for b in self.bursts:
+            if b.start_s <= t < b.start_s + b.duration_s:
+                rate *= b.rate_scale
+        return max(rate, 0.0)
+
+    def rate_max(self) -> float:
+        """An upper bound on :meth:`rate_at` (the thinning envelope)."""
+        rate = self.base_qps * (1.0 + max(self.diurnal_amplitude, 0.0))
+        if self.period_shift_at_s >= 0.0:
+            rate *= max(self.period_shift_scale, 1.0)
+        for b in self.bursts:
+            rate *= max(b.rate_scale, 1.0)
+        return rate
+
+
+@dataclasses.dataclass
+class TraceEvent:
+    t_s: float  # arrival offset from trace start
+    request_id: str
+    tenant: str
+    token_ids: list[int]
+    max_tokens: int
+    group: int
+
+    def to_dict(self) -> dict:
+        return {
+            "t": round(self.t_s, 6),
+            "id": self.request_id,
+            "tenant": self.tenant,
+            "tokens": self.token_ids,
+            "max_tokens": self.max_tokens,
+            "group": self.group,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TraceEvent":
+        return cls(
+            t_s=float(d["t"]), request_id=d["id"], tenant=d["tenant"],
+            token_ids=[int(t) for t in d["tokens"]],
+            max_tokens=int(d["max_tokens"]), group=int(d["group"]),
+        )
+
+
+def _arrival_times(cfg: TraceConfig, rng: np.random.Generator) -> list[float]:
+    """Inhomogeneous Poisson arrivals on [0, duration) by thinning."""
+    lam = cfg.rate_max()
+    out: list[float] = []
+    t = 0.0
+    if lam <= 0.0:
+        return out
+    while True:
+        t += float(rng.exponential(1.0 / lam))
+        if t >= cfg.duration_s:
+            return out
+        if float(rng.random()) * lam <= cfg.rate_at(t):
+            out.append(t)
+
+
+def generate_trace(cfg: TraceConfig) -> list[TraceEvent]:
+    rng = np.random.default_rng(cfg.seed)
+    shared = rng.integers(5, cfg.vocab, cfg.shared_prefix_len).tolist()
+    groups = [
+        rng.integers(5, cfg.vocab, cfg.group_prefix_len).tolist()
+        for _ in range(max(cfg.num_groups, 1))
+    ]
+    names = [name for name, _ in cfg.tenants]
+    weights = np.array([max(w, 0.0) for _, w in cfg.tenants], np.float64)
+    weights = weights / weights.sum() if weights.sum() > 0 else None
+
+    arrivals = [(t, None) for t in _arrival_times(cfg, rng)]
+    if cfg.flood is not None and cfg.flood.qps > 0.0 and cfg.flood.duration_s > 0.0:
+        t = cfg.flood.start_s
+        end = min(cfg.flood.start_s + cfg.flood.duration_s, cfg.duration_s)
+        while True:
+            t += float(rng.exponential(1.0 / cfg.flood.qps))
+            if t >= end:
+                break
+            arrivals.append((t, cfg.flood.tenant))
+    arrivals.sort(key=lambda a: a[0])
+
+    events: list[TraceEvent] = []
+    for i, (t, tenant) in enumerate(arrivals):
+        if tenant is None:
+            tenant = names[int(rng.choice(len(names), p=weights))]
+        g = int(rng.integers(0, len(groups)))
+        unique = rng.integers(5, cfg.vocab, cfg.unique_len).tolist()
+        sigma = max(cfg.osl_mean * cfg.osl_cv, 1e-6)
+        osl = int(np.clip(rng.normal(cfg.osl_mean, sigma), 1, cfg.osl_mean * 4))
+        events.append(TraceEvent(
+            t_s=round(t, 6),
+            request_id=f"r{i:05d}",
+            tenant=tenant,
+            token_ids=shared + groups[g] + unique,
+            max_tokens=osl,
+            group=g,
+        ))
+    return events
+
+
+def trace_digest(events: list[TraceEvent]) -> str:
+    """Canonical content hash: the determinism assertion (same seed -> same
+    trace) and the replay-identity assertion (load(save(t)) == t) both
+    reduce to digest equality."""
+    h = hashlib.sha256()
+    for ev in events:
+        h.update(json.dumps(ev.to_dict(), sort_keys=True).encode())
+        h.update(b"\n")
+    return h.hexdigest()
+
+
+def _config_to_dict(cfg: TraceConfig) -> dict:
+    d = dataclasses.asdict(cfg)
+    d["bursts"] = [dataclasses.asdict(b) for b in cfg.bursts]
+    d["flood"] = dataclasses.asdict(cfg.flood) if cfg.flood is not None else None
+    d["tenants"] = [[name, w] for name, w in cfg.tenants]
+    return d
+
+
+def config_from_dict(d: dict) -> TraceConfig:
+    d = dict(d)
+    d["bursts"] = tuple(BurstEpisode(**b) for b in d.get("bursts", ()))
+    flood = d.get("flood")
+    d["flood"] = TenantFlood(**flood) if flood else None
+    d["tenants"] = tuple((name, float(w)) for name, w in d.get("tenants", []))
+    return TraceConfig(**d)
+
+
+def save_trace(path: str, cfg: TraceConfig, events: list[TraceEvent]) -> None:
+    with open(path, "w") as f:
+        f.write(json.dumps({
+            "format": TRACE_FORMAT,
+            "version": TRACE_VERSION,
+            "seed": cfg.seed,
+            "events": len(events),
+            "digest": trace_digest(events),
+            "config": _config_to_dict(cfg),
+        }, sort_keys=True) + "\n")
+        for ev in events:
+            f.write(json.dumps(ev.to_dict(), sort_keys=True) + "\n")
+
+
+def load_trace(path: str) -> tuple[TraceConfig, list[TraceEvent]]:
+    with open(path) as f:
+        header = json.loads(f.readline())
+        if header.get("format") != TRACE_FORMAT:
+            raise ValueError(f"{path}: not a {TRACE_FORMAT} file")
+        events = [TraceEvent.from_dict(json.loads(line)) for line in f if line.strip()]
+    cfg = config_from_dict(header["config"])
+    digest = header.get("digest")
+    if digest and digest != trace_digest(events):
+        raise ValueError(f"{path}: event digest mismatch (truncated or edited trace)")
+    return cfg, events

@@ -11,10 +11,21 @@ real fleet emits, at simulated speed.
 Timing model: prefill costs ``prefill_us_per_token * new_tokens``; a decode
 step costs ``decode_us_base + decode_us_per_seq * batch``. Generated tokens
 are deterministic per (seed, position) so tests can assert streams.
+
+Fleet fidelity (the fleetsim harness exposed these): ``jitter`` multiplies
+every step's compute by deterministic lognormal noise (heteroscedastic —
+absolute variance grows with the step cost, like real steps), and
+``warmup_s``/``warmup_factor`` ramp a fresh worker from ``warmup_factor``×
+compute down to 1× over its first ``warmup_s`` of stepping, so planner
+scale-ups see realistic cold-start TTFT instead of instant capacity. Both
+default off and leave the timing model bit-identical. Per-worker values
+arrive via the ``DYN_MOCK_*`` env overlay (see :func:`build_mock_core`),
+which is how the fleet plane gives each worker subprocess its own profile.
 """
 
 from __future__ import annotations
 
+import os
 import time
 
 import numpy as np
@@ -39,6 +50,9 @@ class MockRunner:
         seed: int = 0,
         realtime: bool = True,
         d2h_us: float = 0.0,
+        jitter: float = 0.0,
+        warmup_s: float = 0.0,
+        warmup_factor: float = 1.0,
     ) -> None:
         self.num_pages = num_pages
         self.page_size = page_size
@@ -48,6 +62,17 @@ class MockRunner:
         self.decode_us_per_seq = decode_us_per_seq
         self.seed = seed
         self.realtime = realtime
+        # Heteroscedastic step noise: lognormal(0, jitter) multiplier on
+        # compute. A separate rng keeps token generation untouched.
+        self.jitter = jitter
+        self._jitter_rng = np.random.default_rng(seed ^ 0x5EED)
+        # Cold-start ramp: warmup_factor x compute at the first step,
+        # decaying linearly to 1.0 over warmup_s of wall time. The clock
+        # starts lazily at the first step, so a worker that sat idle after
+        # spawn still shows its ramp to the first requests routed at it.
+        self.warmup_s = warmup_s
+        self.warmup_factor = warmup_factor
+        self._warm_t0: float | None = None
         # Device->host result-transfer latency per step: the synchronous loop
         # pays it inline (step() blocks on compute + copy); the overlapped
         # loop (step_async) pays it only at harvest, where it hides under the
@@ -65,6 +90,22 @@ class MockRunner:
         self.simulated_us += us
         if self.realtime and us > 0:
             time.sleep(us / 1e6)
+
+    def _timing_scale(self) -> float:
+        """Per-step compute multiplier: warm-up ramp x jitter noise.
+
+        Exactly 1.0 (and the jitter rng untouched) at the defaults, keeping
+        legacy timing bit-identical.
+        """
+        scale = 1.0
+        if self.warmup_s > 0.0 and self.warmup_factor > 1.0:
+            if self._warm_t0 is None:
+                self._warm_t0 = time.monotonic()
+            frac = min(1.0, (time.monotonic() - self._warm_t0) / self.warmup_s)
+            scale *= self.warmup_factor - (self.warmup_factor - 1.0) * frac
+        if self.jitter > 0.0:
+            scale *= float(self._jitter_rng.lognormal(0.0, self.jitter))
+        return scale
 
     def _tokens_for(self, positions: np.ndarray, row_tokens: np.ndarray) -> np.ndarray:
         # Deterministic pseudo-generation: next token = f(seed, pos, last token).
@@ -89,10 +130,11 @@ class MockRunner:
         b, t = batch.tokens.shape
         if t > 1:  # prefill
             new_tokens = int((batch.last_token_index + 1).sum())
-            self.busy_us += self.prefill_us_per_token * new_tokens
-            self._sleep_us(self.prefill_us_per_token * new_tokens)
+            compute = self.prefill_us_per_token * new_tokens * self._timing_scale()
+            self.busy_us += compute
+            self._sleep_us(compute)
         else:
-            compute = self.decode_us_base + self.decode_us_per_seq * b
+            compute = (self.decode_us_base + self.decode_us_per_seq * b) * self._timing_scale()
             self.busy_us += compute
             # The synchronous loop blocks on compute AND the result copy.
             self._sleep_us(compute + self.d2h_us)
@@ -116,7 +158,7 @@ class MockRunner:
             self.decode_us_base
             + self.decode_us_per_seq * b
             + self.prefill_us_per_token * max(0, total_new - b)
-        )
+        ) * self._timing_scale()
 
     def _chain_col0(self, batch: StepBatch, chain: bool, chain_src) -> np.ndarray:
         """Column-0 input token per row, with per-row chain sourcing from the
@@ -227,7 +269,7 @@ class MockRunner:
         tok = batch.tokens[:, 0]
         pos = batch.positions[:, 0]
         for i in range(num_steps):
-            self._sleep_us(self.decode_us_base + self.decode_us_per_seq * b)
+            self._sleep_us((self.decode_us_base + self.decode_us_per_seq * b) * self._timing_scale())
             tok = self._tokens_for(pos, tok)
             out[:, i] = tok
             pos = pos + 1
@@ -286,6 +328,29 @@ class MockSpecTokens:
         return self._targets, self._aux
 
 
+#: Env -> MockRunner kwarg overlay: how a fleet gives each worker
+#: subprocess its own timing profile (fleetsim WorkerTimingProfile.to_env).
+_ENV_RUNNER_KW = (
+    ("DYN_MOCK_PREFILL_US_PER_TOKEN", "prefill_us_per_token", float),
+    ("DYN_MOCK_DECODE_US_BASE", "decode_us_base", float),
+    ("DYN_MOCK_DECODE_US_PER_SEQ", "decode_us_per_seq", float),
+    ("DYN_MOCK_JITTER", "jitter", float),
+    ("DYN_MOCK_WARMUP_S", "warmup_s", float),
+    ("DYN_MOCK_WARMUP_FACTOR", "warmup_factor", float),
+    ("DYN_MOCK_SEED", "seed", int),
+)
+
+
+def mock_runner_env_kw(env=None) -> dict:
+    """MockRunner kwargs taken from ``DYN_MOCK_*`` environment variables."""
+    env = os.environ if env is None else env
+    out = {}
+    for key, name, cast in _ENV_RUNNER_KW:
+        if key in env:
+            out[name] = cast(env[key])
+    return out
+
+
 def build_mock_core(
     config: EngineConfig | None = None,
     *,
@@ -293,6 +358,7 @@ def build_mock_core(
     **runner_kw,
 ) -> EngineCore:
     config = config or EngineConfig(num_pages=1024, page_size=16, max_batch_size=256, max_seq_len=32768)
+    runner_kw = {**mock_runner_env_kw(), **runner_kw}  # explicit kwargs win
     runner = MockRunner(num_pages=config.num_pages, page_size=config.page_size, **runner_kw)
     return EngineCore(runner, config, on_kv_event=on_kv_event)
 

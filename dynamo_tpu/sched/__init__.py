@@ -95,20 +95,41 @@ def cache_aware_enabled(env=None) -> bool:
     return env_flag(os.environ if env is None else env, "DYN_CACHE_AWARE", False)
 
 
-def configure_cache_aware(config, env=None, *, block_tokens=None) -> None:
+def configure_cache_aware(config, env=None, *, block_tokens=None, profile=None) -> None:
     """Arm a router ``SchedulerConfig``'s cache-aware cost term from the
     environment; a no-op unless ``DYN_CACHE_AWARE`` is on (same discipline
     as :func:`configure_attainment` — off means bit-identical costs).
     ``block_tokens`` lets the caller pass the deployment's real KV block
-    size so predicted residual-prefill tokens are scaled correctly."""
+    size so predicted residual-prefill tokens are scaled correctly.
+
+    The rate that converts residual prefill tokens into predicted seconds
+    comes from the worker's *profiled* prefill throughput when one is
+    available (``profile`` argument, else the ``DYN_SLO_SCHED_PROFILE``
+    surface) — the 20k-tokens/s settings default is a guess that can skew
+    placement by an order of magnitude on hardware it wasn't measured on.
+    An explicit ``DYN_CACHE_AWARE_RATE_TOKENS_PER_S`` still wins: an
+    operator override outranks a profile."""
     if not cache_aware_enabled(env):
         return
-    from dynamo_tpu.config import load_cache_aware_settings
+    from dynamo_tpu.config import load_cache_aware_settings, load_slo_sched_settings
 
+    e = os.environ if env is None else env
     s = load_cache_aware_settings(env=env) if env is not None else load_cache_aware_settings()
     config.cache_aware_weight = s.weight
-    config.cache_rate_tokens_per_s = s.rate_tokens_per_s
     config.cache_max_staleness_s = s.max_staleness_s
+    rate = s.rate_tokens_per_s
+    if "DYN_CACHE_AWARE_RATE_TOKENS_PER_S" not in e:
+        if profile is None:
+            # configure_attainment may already have armed the config with
+            # the DYN_SLO_SCHED_PROFILE surface; reuse it before re-reading.
+            profile = getattr(config, "profile", None)
+        if profile is None:
+            ss = load_slo_sched_settings(env=env) if env is not None else load_slo_sched_settings()
+            if ss.profile:
+                profile = _load_profile(ss.profile)
+        if profile is not None and getattr(profile, "prefill_tokens_per_sec", 0.0) > 0.0:
+            rate = float(profile.prefill_tokens_per_sec)
+    config.cache_rate_tokens_per_s = rate
     if block_tokens:
         config.cache_block_tokens = int(block_tokens)
 

@@ -318,6 +318,30 @@ def test_bench_doc_prefix_reuse_keys():
     assert doc2["detail"]["prefix_reuse_probe"] == pr
 
 
+def test_bench_doc_fleet_sim_keys():
+    """Fleet-sim headline keys (ISSUE 13): probe_fleet_sim surfaces stable
+    top-level goodput/fairness keys and a detail record; absent probe emits
+    0.0 defaults so the doc schema never shifts."""
+    import bench
+
+    configs = [{"preset": "test-tiny", "tok_per_sec": 5.0}]
+    doc = bench.build_doc(configs, pull={})
+    assert doc["fleet_goodput_frac_at_slo"] == 0.0
+    assert doc["fleet_tenant_fairness"] == 0.0
+    assert doc["detail"]["fleet_sim_probe"] == {"pending": True}
+    fl = {"scenario": "smoke", "trace_digest": "abc", "digest_stable": True,
+          "fleet_goodput_frac_at_slo": 0.92, "fleet_tenant_fairness": 0.88,
+          "passed": True}
+    doc2 = bench.build_doc(configs, pull={}, fleet=fl)
+    assert doc2["fleet_goodput_frac_at_slo"] == 0.92
+    assert doc2["fleet_tenant_fairness"] == 0.88
+    assert doc2["detail"]["fleet_sim_probe"] == fl
+    # A probe that errored keeps the stable defaults.
+    doc3 = bench.build_doc(configs, pull={}, fleet={"error": "boom"})
+    assert doc3["fleet_goodput_frac_at_slo"] == 0.0
+    assert doc3["fleet_tenant_fairness"] == 0.0
+
+
 def test_synthesizer_prefix_structure():
     cfg = SyntheticConfig(num_requests=32, shared_prefix_len=16, num_groups=3,
                           group_prefix_len=8, unique_len=4, osl_mean=20, seed=7)

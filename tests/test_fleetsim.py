@@ -1,0 +1,395 @@
+"""Fleet-simulation harness (dynamo_tpu/fleetsim, ISSUE 13).
+
+Unit layers (trace determinism, scoreboard math, mocker fidelity knobs,
+fleet metrics, check evaluation) run in-process; the scenario tests run
+the registered fast-tier scenarios END TO END — real store server, real
+frontend + KV router, real planner loop, mock-engine workers as OS
+processes — and assert on the scoreboard report the same way CI operators
+would.
+
+The live scenario tests are deliberately *sync* ``def`` tests driving
+``asyncio.run`` themselves: the conftest's async wrapper imposes a 60s
+per-test cap that multi-worker scenarios (spawns serialize on one core)
+can legitimately exceed.
+"""
+
+import asyncio
+import dataclasses
+import json
+import time
+
+import pytest
+
+from dynamo_tpu.fleetsim import (
+    BurstEpisode,
+    Check,
+    ChurnEvent,
+    FleetMetrics,
+    Scoreboard,
+    TenantFlood,
+    TraceConfig,
+    WorkerTimingProfile,
+    generate_trace,
+    load_trace,
+    save_trace,
+    trace_digest,
+)
+from dynamo_tpu.fleetsim.scenario import SCENARIOS, run_scenario
+from dynamo_tpu.fleetsim.scoreboard import RequestOutcome, SloTarget, parse_control_plane
+
+pytestmark = pytest.mark.fleet
+
+
+# -- workload plane --------------------------------------------------------
+
+
+def test_trace_determinism_and_seed_sensitivity():
+    cfg = TraceConfig(duration_s=20.0, base_qps=8.0, diurnal_amplitude=0.4,
+                      bursts=(BurstEpisode(start_s=5.0, duration_s=2.0, rate_scale=3.0),),
+                      flood=TenantFlood(tenant="heavy", start_s=8.0, duration_s=4.0, qps=20.0),
+                      tenants=(("a", 0.7), ("b", 0.3)), seed=42)
+    a, b = generate_trace(cfg), generate_trace(cfg)
+    assert trace_digest(a) == trace_digest(b)
+    assert [e.to_dict() for e in a] == [e.to_dict() for e in b]
+    c = generate_trace(dataclasses.replace(cfg, seed=43))
+    assert trace_digest(a) != trace_digest(c)
+    # The flood stream is merged in order and carries its tenant.
+    tenants = {e.tenant for e in a}
+    assert "heavy" in tenants and {"a", "b"} & tenants
+    assert all(a[i].t_s <= a[i + 1].t_s for i in range(len(a) - 1))
+    # Shared prefix: every request starts with the same tokens.
+    heads = {tuple(e.token_ids[: cfg.shared_prefix_len]) for e in a}
+    assert len(heads) == 1
+
+
+def test_trace_rate_shapes():
+    cfg = TraceConfig(duration_s=100.0, base_qps=10.0,
+                      period_shift_at_s=50.0, period_shift_scale=3.0,
+                      bursts=(BurstEpisode(start_s=10.0, duration_s=5.0, rate_scale=2.0),))
+    assert cfg.rate_at(5.0) == pytest.approx(10.0)
+    assert cfg.rate_at(12.0) == pytest.approx(20.0)  # inside the burst
+    assert cfg.rate_at(60.0) == pytest.approx(30.0)  # after the period shift
+    assert cfg.rate_max() >= 30.0
+    # More offered rate -> more arrivals, deterministically.
+    lo = generate_trace(TraceConfig(duration_s=30.0, base_qps=2.0, seed=1))
+    hi = generate_trace(TraceConfig(duration_s=30.0, base_qps=8.0, seed=1))
+    assert len(hi) > len(lo) > 10
+
+
+def test_trace_save_load_roundtrip(tmp_path):
+    cfg = TraceConfig(duration_s=10.0, base_qps=5.0, seed=9,
+                      bursts=(BurstEpisode(start_s=2.0, duration_s=1.0, rate_scale=2.0),),
+                      flood=TenantFlood(tenant="x", start_s=3.0, duration_s=2.0, qps=5.0))
+    events = generate_trace(cfg)
+    path = tmp_path / "trace.jsonl"
+    save_trace(path, cfg, events)
+    cfg2, events2 = load_trace(path)
+    assert cfg2 == cfg
+    assert trace_digest(events2) == trace_digest(events)
+    # Regenerating from the loaded config reproduces the file bit-for-bit.
+    assert trace_digest(generate_trace(cfg2)) == trace_digest(events)
+    # Tampering trips the digest check.
+    lines = path.read_text().splitlines()
+    lines[1] = lines[1].replace('"max_tokens": ', '"max_tokens": 9')
+    path.write_text("\n".join(lines) + "\n")
+    with pytest.raises(ValueError, match="digest"):
+        load_trace(path)
+
+
+# -- mocker fidelity (satellite: jitter + warm-up ramp) --------------------
+
+
+def test_mock_runner_timing_scale_defaults_exact():
+    from dynamo_tpu.mocker import MockRunner
+
+    r = MockRunner(num_pages=8, page_size=16)
+    state0 = r._jitter_rng.bit_generator.state
+    assert all(r._timing_scale() == 1.0 for _ in range(5))
+    # Defaults never touch the rng: legacy timing stays bit-identical.
+    assert r._jitter_rng.bit_generator.state == state0
+
+
+def test_mock_runner_jitter_seeded():
+    from dynamo_tpu.mocker import MockRunner
+
+    a = MockRunner(num_pages=8, page_size=16, seed=3, jitter=0.3)
+    b = MockRunner(num_pages=8, page_size=16, seed=3, jitter=0.3)
+    sa = [a._timing_scale() for _ in range(32)]
+    sb = [b._timing_scale() for _ in range(32)]
+    assert sa == sb  # same seed, same stream
+    assert len(set(sa)) > 16  # actually stochastic
+    assert all(s > 0 for s in sa)
+    c = MockRunner(num_pages=8, page_size=16, seed=4, jitter=0.3)
+    assert [c._timing_scale() for _ in range(32)] != sa
+
+
+def test_mock_runner_warmup_ramp():
+    from dynamo_tpu.mocker import MockRunner
+
+    r = MockRunner(num_pages=8, page_size=16, warmup_s=100.0, warmup_factor=4.0)
+    first = r._timing_scale()  # cold: ~4x slower
+    assert first == pytest.approx(4.0, rel=0.01)
+    r._warm_t0 = time.monotonic() - 50.0  # halfway through the ramp
+    assert r._timing_scale() == pytest.approx(2.5, rel=0.05)
+    r._warm_t0 = time.monotonic() - 200.0  # fully warm
+    assert r._timing_scale() == pytest.approx(1.0, rel=0.01)
+
+
+def test_mock_runner_env_overlay(monkeypatch):
+    from dynamo_tpu.mocker import build_mock_core, mock_runner_env_kw
+
+    monkeypatch.setenv("DYN_MOCK_DECODE_US_BASE", "12345")
+    monkeypatch.setenv("DYN_MOCK_JITTER", "0.25")
+    kw = mock_runner_env_kw()
+    assert kw == {"decode_us_base": 12345.0, "jitter": 0.25}
+    core = build_mock_core()
+    assert core.runner.decode_us_base == 12345.0
+    assert core.runner.jitter == 0.25
+    # Explicit kwargs outrank the env overlay.
+    core2 = build_mock_core(decode_us_base=777.0)
+    assert core2.runner.decode_us_base == 777.0
+
+
+def test_worker_timing_profile_env_roundtrip():
+    from dynamo_tpu.mocker import mock_runner_env_kw
+
+    p = WorkerTimingProfile(prefill_us_per_token=70.0, decode_us_base=2500.0,
+                            jitter=0.1, warmup_s=2.0, warmup_factor=3.0, seed=5)
+    kw = mock_runner_env_kw(env=p.to_env())
+    assert kw["prefill_us_per_token"] == 70.0
+    assert kw["decode_us_base"] == 2500.0
+    assert kw["jitter"] == 0.1
+    assert kw["warmup_s"] == 2.0
+    assert kw["warmup_factor"] == 3.0
+    assert kw["seed"] == 5
+
+
+# -- scoreboard + checks ---------------------------------------------------
+
+
+def _outcome(tenant, ttft_s, gap_s, tokens=10, ok=True, mid=False):
+    return RequestOutcome(request_id="r", tenant=tenant, injected_at_s=0.0,
+                          ttft_s=ttft_s, gaps=[gap_s] * 4, output_tokens=tokens,
+                          ok=ok, mid_stream_failure=mid)
+
+
+def test_scoreboard_slo_classification_and_fairness():
+    sb = Scoreboard(SloTarget(ttft_ms=100.0, itl_p99_ms=20.0))
+    for _ in range(8):
+        sb.observe(_outcome("light", ttft_s=0.05, gap_s=0.01))  # attains
+    sb.observe(_outcome("light", ttft_s=0.5, gap_s=0.01))  # TTFT blown
+    for _ in range(4):
+        sb.observe(_outcome("heavy", ttft_s=0.05, gap_s=0.05))  # ITL blown
+    sb.observe(_outcome("heavy", ttft_s=0.05, gap_s=0.01))  # attains
+    sb.observe(_outcome("heavy", ttft_s=0.0, gap_s=0.0, ok=False, mid=True))
+    rep = sb.report(duration_s=10.0)
+    assert rep["requests"] == {"total": 15, "ok": 14, "error": 1,
+                               "mid_stream_failure": 1}
+    assert rep["tenants"]["light"]["goodput_frac"] == pytest.approx(8 / 9, abs=1e-4)
+    assert rep["tenants"]["heavy"]["goodput_frac"] == pytest.approx(1 / 6, abs=1e-4)
+    assert rep["tenant_fairness"] == pytest.approx((1 / 6) / (8 / 9), abs=1e-4)
+    assert rep["goodput_frac_at_slo"] == pytest.approx(9 / 15)
+    assert rep["goodput_tokens_per_s_at_slo"] == pytest.approx(9.0)  # 90 tok / 10 s
+    assert set(rep["ttft_ms"]) == {"p50", "p95", "p99", "p99_9"}
+    # Failed requests must not pollute the latency estimators.
+    assert rep["ttft_ms"]["p99"] < 600.0
+
+
+def test_check_dotted_paths():
+    rep = {"a": {"b": {"c": 3.0}}, "x": 1}
+    assert Check("a.b.c", ">=", 3.0).evaluate(rep)["ok"]
+    assert not Check("a.b.c", ">", 3.0).evaluate(rep)["ok"]
+    missing = Check("a.b.zzz", ">=", 0.0).evaluate(rep)
+    assert not missing["ok"] and missing["actual"] is None
+    assert Check("x", "==", 1).evaluate(rep)["ok"]
+
+
+def test_parse_control_plane_metrics_text():
+    text = "\n".join([
+        "# HELP dynamo_client_breaker_state state",
+        'dynamo_client_breaker_state{endpoint="generate",instance="i1"} 2.0',
+        'dynamo_client_breaker_state{endpoint="generate",instance="i2"} 0.0',
+        'dynamo_client_watch_restarts_total{endpoint="generate"} 3.0',
+        'dynamo_engine_prefill_requeues_total{worker="w1"} 5.0',
+        'dynamo_engine_steps_total{worker="w1"} 100.0',
+        'dynamo_engine_steps_total{worker="w2"} 90.0',
+        "not_a_metric",
+    ])
+    snap = parse_control_plane(text)
+    assert snap["breaker_open"] == 1.0
+    assert snap["watch_restarts"] == 3.0
+    assert snap["prefill_requeues"] == 5.0
+    assert snap["engine_registries"] == 2.0
+
+
+def test_fleet_metrics_sync_and_render():
+    fm = FleetMetrics()
+    fm.sync_report({
+        "goodput_frac_at_slo": 0.9, "goodput_tokens_per_s_at_slo": 120.0,
+        "tenant_fairness": 0.8,
+        "requests": {"ok": 9, "error": 1, "mid_stream_failure": 1},
+        "tenants": {"light": {"goodput_frac": 1.0}},
+        "ttft_ms": {"p50": 10.0, "p99": 40.0},
+        "itl_ms": {"p50": 2.0},
+        "fleet": {"spawns": 3, "kills": 1, "live": 2},
+    })
+    text = fm.render().decode()
+    assert "dynamo_fleet_goodput_frac_at_slo 0.9" in text
+    assert 'dynamo_fleet_requests{outcome="ok"} 9.0' in text
+    assert 'dynamo_fleet_tenant_goodput_frac{tenant="light"} 1.0' in text
+    assert 'dynamo_fleet_ttft_quantile_seconds{quantile="p99"} 0.04' in text
+    assert "dynamo_fleet_workers_live 2.0" in text
+    assert 'dynamo_fleet_lifecycle_events{event="kills"} 1.0' in text
+
+
+def test_cache_rate_from_profile(monkeypatch):
+    """Satellite: the router's cache-aware rate comes from the profiled
+    prefill throughput, env override outranks it, default is the fallback."""
+    import types
+
+    from dynamo_tpu.planner.core import WorkerProfile
+    from dynamo_tpu.sched import configure_cache_aware
+
+    prof = WorkerProfile(prefill_tokens_per_sec=55555.0)
+
+    cfg = types.SimpleNamespace(profile=None)
+    configure_cache_aware(cfg, {"DYN_CACHE_AWARE": "1"}, profile=prof)
+    assert cfg.cache_rate_tokens_per_s == 55555.0
+
+    # configure_attainment already armed config.profile: reuse it.
+    cfg2 = types.SimpleNamespace(profile=prof)
+    configure_cache_aware(cfg2, {"DYN_CACHE_AWARE": "1"})
+    assert cfg2.cache_rate_tokens_per_s == 55555.0
+
+    # An explicit operator rate outranks the profile.
+    cfg3 = types.SimpleNamespace(profile=prof)
+    configure_cache_aware(
+        cfg3, {"DYN_CACHE_AWARE": "1", "DYN_CACHE_AWARE_RATE_TOKENS_PER_S": "9000"})
+    assert cfg3.cache_rate_tokens_per_s == 9000.0
+
+    # No profile anywhere: the settings default.
+    cfg4 = types.SimpleNamespace(profile=None)
+    configure_cache_aware(cfg4, {"DYN_CACHE_AWARE": "1"})
+    assert cfg4.cache_rate_tokens_per_s == 20000.0
+
+    # Master toggle off: untouched.
+    cfg5 = types.SimpleNamespace(profile=prof)
+    configure_cache_aware(cfg5, {})
+    assert not hasattr(cfg5, "cache_rate_tokens_per_s")
+
+
+def test_scenario_registry_and_dry_run():
+    assert {"smoke", "burst_absorb", "tenant_flood", "kill_midstream",
+            "period_shift", "fleet_accept", "diurnal_soak"} <= set(SCENARIOS)
+    assert SCENARIOS["diurnal_soak"].tier == "soak"
+    rep = asyncio.run(run_scenario(SCENARIOS["fleet_accept"], dry_run=True))
+    rep2 = asyncio.run(run_scenario(SCENARIOS["fleet_accept"], dry_run=True))
+    # Same seed -> same trace -> same digest: the determinism contract.
+    assert rep["trace"]["digest"] == rep2["trace"]["digest"]
+    assert rep["trace"]["events"] > 0
+    assert rep["passed"] is None  # dry runs don't adjudicate
+
+
+def test_fleetsim_cli_list_and_trace(tmp_path, capsys):
+    from dynamo_tpu.fleetsim.__main__ import main
+
+    assert main(["list"]) == 0
+    out = capsys.readouterr().out
+    assert "fleet_accept" in out and "soak" in out
+
+    path = tmp_path / "smoke.jsonl"
+    assert main(["trace", "smoke", "--out", str(path)]) == 0
+    assert main(["trace", "--replay", str(path)]) == 0
+    capsys.readouterr()  # drain replay summary
+    cfg, events = load_trace(path)
+    assert cfg.seed == SCENARIOS["smoke"].trace.seed
+    assert trace_digest(events) == trace_digest(generate_trace(cfg))
+    assert main(["run", "nope", "--dry-run"]) == 2
+
+
+# -- live scenarios (real control plane + worker processes) ----------------
+#
+# Sync tests on purpose — see module docstring. Each runs one registered
+# fast-tier scenario exactly as `python -m dynamo_tpu.fleetsim run <name>`
+# would and asserts the scenario's own checks passed.
+
+
+def _run(name: str) -> dict:
+    report = asyncio.run(run_scenario(SCENARIOS[name]))
+    assert report["passed"], json.dumps(report.get("checks"), indent=2)
+    return report
+
+
+@pytest.mark.e2e
+def test_scenario_burst_absorb_live():
+    """A 4x burst must not blow the ITL tail: decode cadence holds while
+    the prefill backlog drains through chunked steps."""
+    report = _run("burst_absorb")
+    assert report["itl_ms"]["p99"] <= 50.0
+    assert report["requests"]["error"] == 0
+
+
+@pytest.mark.e2e
+def test_scenario_tenant_flood_live():
+    """A heavy-tenant flood cannot starve the light tenant below the
+    attainment floor (admission plane + quotas armed via scenario env)."""
+    report = _run("tenant_flood")
+    assert report["tenants"]["light"]["goodput_frac"] >= 0.6
+    assert report["tenants"]["heavy"]["requests"] > report["tenants"]["light"]["requests"]
+
+
+@pytest.mark.e2e
+def test_scenario_kill_midstream_live():
+    """SIGKILL of the stream-holding worker: structured mid_stream_failure
+    SSEs for the severed streams, the survivor keeps completing requests."""
+    report = _run("kill_midstream")
+    assert report["requests"]["mid_stream_failure"] >= 1
+    assert report["requests"]["ok"] >= 3
+    assert report["fleet"]["kills"] == 1
+    assert report["fleet"]["live"] == 1
+
+
+@pytest.mark.e2e
+def test_scenario_period_shift_live():
+    """Planner scales the decode fleet up into the 5x period shift and back
+    down in the cooldown drain, with every decision in the report."""
+    report = _run("period_shift")
+    assert report["planner"]["max_decode_workers"] >= 2
+    assert report["planner"]["final_decode_workers"] <= 1
+    assert report["fleet"]["scale_ups"] >= 1
+    assert report["fleet"]["scale_downs"] >= 1
+    assert all("t_s" in d for d in report["planner"]["decisions"])
+
+
+@pytest.mark.e2e
+def test_scenario_fleet_accept_live(tmp_path):
+    """ISSUE 13 acceptance gate: >= 8 worker processes against the real
+    frontend/router/store with chaos armed, goodput + fairness + lifecycle
+    accounting asserted, trace digest deterministic."""
+    scn = SCENARIOS["fleet_accept"]
+    assert scn.workers >= 8 and scn.faults
+    out = tmp_path / "accept.json"
+    report = asyncio.run(run_scenario(scn, report_path=str(out)))
+    assert report["passed"], json.dumps(report.get("checks"), indent=2)
+    assert report["fleet"]["spawns"] >= 9
+    assert report["fleet"]["kills"] >= 1
+    assert report["goodput_frac_at_slo"] >= 0.5
+    assert report["tenant_fairness"] >= 0.5
+    assert len(report["tenants"]) == 2
+    # The written report round-trips and carries the deterministic digest.
+    disk = json.loads(out.read_text())
+    dry = asyncio.run(run_scenario(scn, dry_run=True))
+    assert disk["trace"]["digest"] == dry["trace"]["digest"]
+    # The scoreboard report feeds the dynamo_fleet_* families directly.
+    fm = FleetMetrics()
+    fm.sync_report(disk)
+    assert b"dynamo_fleet_goodput_frac_at_slo" in fm.render()
+
+
+@pytest.mark.slow
+@pytest.mark.e2e
+def test_scenario_diurnal_soak():
+    """The hour-scale diurnal soak (slow tier): planner-owned fleet under
+    diurnal load with a mid-cycle flood and chaos armed."""
+    report = asyncio.run(run_scenario(SCENARIOS["diurnal_soak"]))
+    assert report["passed"], json.dumps(report.get("checks"), indent=2)

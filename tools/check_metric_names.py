@@ -24,6 +24,7 @@ def collect_families() -> dict[str, list[dict]]:
     Importing here keeps the tool usable before optional deps of unrelated
     modules are present.
     """
+    from dynamo_tpu.fleetsim.metrics import FleetMetrics
     from dynamo_tpu.frontend.metrics import FrontendMetrics
     from dynamo_tpu.observability.metrics import EngineMetrics
 
@@ -31,6 +32,7 @@ def collect_families() -> dict[str, list[dict]]:
     for label, registry in (
         ("frontend", FrontendMetrics().registry),
         ("engine", EngineMetrics(worker="check").registry),
+        ("fleet", FleetMetrics().registry),
     ):
         families: list[dict] = []
         for collector in registry._collector_to_names:  # noqa: SLF001 - no public enumeration API
