@@ -982,6 +982,75 @@ def probe_engine_overlap() -> dict:
             ) if armed else 0.0,
         }, tokens
 
+    # Constrained-traffic variant (ISSUE 14): JSON-mode rows under overlap.
+    # Without mask lookahead every chained constrained row forces a barrier
+    # (reason "constraint": the next step's token mask depends on the
+    # not-yet-harvested sample), degenerating the pipeline to sync timing.
+    # With lookahead the scheduler pre-builds masks for every admissible
+    # successor state and resolves the right one in-graph against the
+    # chained token; only cold-cache steps barrier ("constraint_miss")
+    # while the mask cache warms. Baseline here is overlap ON with
+    # constraint_lookahead_tokens=0, isolating the lookahead itself.
+    j_decoders = int(os.environ.get("BENCH_OVERLAP_JSON_DECODERS", "4"))
+    j_isl = int(os.environ.get("BENCH_OVERLAP_JSON_ISL", "32"))
+    j_osl = int(os.environ.get("BENCH_OVERLAP_JSON_OSL", "48"))
+    j_lookahead = int(os.environ.get("BENCH_OVERLAP_JSON_LOOKAHEAD", "32"))
+    # Small vocab: the digit tokenizer has 9 distinct pieces, and the pure-
+    # Python mask builder walks every id — at 32k ids two cold mask builds
+    # cost more than the whole decode and swamp the timing comparison.
+    j_vocab = int(os.environ.get("BENCH_OVERLAP_JSON_VOCAB", "512"))
+    j_pages = j_decoders * (j_isl + j_osl) // page_size + 32
+    j_prompts = [rng.integers(1, j_vocab - 2, size=j_isl).tolist()
+                 for _ in range(j_decoders)]
+
+    class _DigitTokenizer:
+        """Nine-piece vocabulary: every token id decodes to a nonzero digit,
+        so each sampled token extends a JSON number forever — the adversarial
+        case where a fresh mask must be ready before every decode step."""
+
+        def decode(self, ids, skip_special_tokens=False):
+            return "".join("123456789"[int(t) % 9] for t in ids)
+
+    def run_json(lookahead: int) -> tuple[dict, dict[int, list[int]]]:
+        cfg = EngineConfig(
+            num_pages=j_pages, page_size=page_size, max_batch_size=j_decoders,
+            max_prefill_tokens=j_isl, max_seq_len=j_isl + j_osl + 8,
+            enable_prefix_caching=False, chunk_prefill_tokens=0,
+            overlap=True, constraint_lookahead_tokens=lookahead,
+        )
+        runner = MockRunner(
+            num_pages=j_pages, page_size=page_size, realtime=True,
+            vocab_size=j_vocab, decode_us_base=decode_us, d2h_us=d2h_us,
+        )
+        core = EngineCore(runner, cfg)
+        core.set_constraint_tokenizer(_DigitTokenizer())
+        for prompt in j_prompts:
+            core.add_request(PreprocessedRequest(
+                token_ids=prompt,
+                sampling=SamplingOptions(temperature=0.0, json_mode=True),
+                stop=StopConditions(max_tokens=j_osl, ignore_eos=True),
+            ))
+        tokens: dict[int, list[int]] = {}
+        t0 = time.perf_counter()
+        while core.has_work:
+            for seq, out in core.step():
+                tokens.setdefault(seq.seq_id, []).extend(out.token_ids)
+        elapsed = time.perf_counter() - t0
+        counts = dict(core.overlap_step_counts)
+        armed = sum(counts.values())
+        return {
+            "mode": f"lookahead_{lookahead}" if lookahead else "no_lookahead",
+            "elapsed_s": round(elapsed, 4),
+            "itl_mean_ms": round(elapsed * 1e3 / j_osl, 3),
+            "overlap_steps": counts,
+            "barrier_reasons": dict(core.overlap_barrier_counts),
+            "overlap_barrier_frac": round(
+                counts.get("barrier", 0) / armed, 4
+            ) if armed else 0.0,
+            "mask_cache_hits": core.constraint_mask_cache_hits,
+            "mask_cache_misses": core.constraint_mask_cache_misses,
+        }, tokens
+
     sync, sync_tokens = run(False)
     gc.collect()
     overlap, overlap_tokens = run(True)
@@ -989,6 +1058,10 @@ def probe_engine_overlap() -> dict:
     m_sync, m_sync_tokens = run_mixed(False)
     gc.collect()
     m_overlap, m_overlap_tokens = run_mixed(True)
+    gc.collect()
+    j_base, j_base_tokens = run_json(0)
+    gc.collect()
+    j_la, j_la_tokens = run_json(j_lookahead)
     gc.collect()
     return {
         "decoders": decoders, "isl": isl, "osl": osl,
@@ -1011,6 +1084,17 @@ def probe_engine_overlap() -> dict:
         "engine_overlap_mixed_itl_gain": round(
             m_sync["itl_mean_ms"] / m_overlap["itl_mean_ms"], 4
         ) if m_overlap["itl_mean_ms"] > 0 else 0.0,
+        "constrained": {
+            "decoders": j_decoders, "isl": j_isl, "osl": j_osl,
+            "lookahead": j_lookahead,
+            "no_lookahead": j_base,
+            "lookahead_on": j_la,
+            "bit_identical": j_base_tokens == j_la_tokens,
+        },
+        "overlap_constrained_itl_gain": round(
+            j_base["itl_mean_ms"] / j_la["itl_mean_ms"], 4
+        ) if j_la["itl_mean_ms"] > 0 else 0.0,
+        "overlap_barrier_frac": j_la["overlap_barrier_frac"],
     }
 
 
@@ -1274,6 +1358,14 @@ def build_doc(configs, pull, wire=None, stall=None, spec=None,
         "overlap_chained_frac": (overlap or {}).get("overlap_chained_frac", 0.0),
         "engine_overlap_mixed_itl_gain": (overlap or {}).get(
             "engine_overlap_mixed_itl_gain", 0.0),
+        # Chained constrained decode headline keys (ISSUE 14): ITL ratio of
+        # lookahead-off over lookahead-on JSON-mode traffic under overlap
+        # (both bit-identical streams), and the lookahead-on run's residual
+        # barrier fraction (cold mask-cache steps only).
+        "overlap_constrained_itl_gain": (overlap or {}).get(
+            "overlap_constrained_itl_gain", 0.0),
+        "overlap_barrier_frac": (overlap or {}).get(
+            "overlap_barrier_frac", 0.0),
         # Cache-aware serving headline keys (ISSUE 12): cold-over-reuse TTFT
         # p50 at fixed QPS on the prefix-heavy workload, and the fraction of
         # onboarding-pending steps that still dispatched fresh work (tier

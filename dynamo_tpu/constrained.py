@@ -239,7 +239,22 @@ class TokenMaskCache:
         self._pieces: list[str] | None = None
         self._tok = tokenizer
         self._masks: dict[tuple, np.ndarray] = {}
+        # Per-summary transition table built alongside each mask: for every
+        # admitted piece, a small descriptor of the machine's state change
+        # (summary -> (desc_id i32[vocab], [descriptor, ...])). Lets the
+        # overlapped engine reconstruct the EXACT successor state of any
+        # allowed token without re-walking its piece — the one-step-lookahead
+        # mask precompute groups candidate tokens by descriptor.
+        self._descs: dict[tuple, tuple[np.ndarray, list]] = {}
         self._close_ids: dict[str, int | None] = {}
+        # Cache-lookup counters (mirrored to the metrics plane as
+        # dynamo_engine_constraint_mask_cache_{hits,misses}_total): a miss is
+        # a lookup the cache could not answer warm — a cold mask build, or a
+        # peek/lookahead that had to decline (the overlapped engine then
+        # barriers with reason constraint_miss and the sync fallback warms
+        # the summary).
+        self.hits = 0
+        self.misses = 0
         # Serializes the seconds-long cold builds (piece table, per-summary
         # vocab walks): the warm-up thread and a racing request must not
         # duplicate them, and the second comer blocks instead of recomputing.
@@ -287,17 +302,23 @@ class TokenMaskCache:
         key = state.summary()
         cached = self._masks.get(key)
         if cached is not None:
+            self.hits += 1
             return cached
         pieces = self._ensure_pieces()
         with self._build_lock:
             cached = self._masks.get(key)  # built while we waited?
             if cached is not None:
+                self.hits += 1
                 return cached
+            self.misses += 1
             return self._build_mask(state, key, pieces)
 
     def _build_mask(self, state: MachineState, key: tuple, pieces) -> tuple[np.ndarray, np.ndarray]:
         allowed = np.zeros(self.vocab_size, bool)
         close_after = np.zeros(self.vocab_size, np.int16)
+        desc_ids = np.full(self.vocab_size, -1, np.int32)
+        descs: list[tuple] = []
+        desc_index: dict[tuple, int] = {}
         # Soundness floor: with depth <= 3 the summary records the WHOLE
         # stack, so the machine's own verdict is exact. Deeper states may
         # only admit pieces whose every stack consult (pop / ',' / closer
@@ -316,7 +337,20 @@ class TokenMaskCache:
                 # Depth-RELATIVE: states deeper than the summary cap share
                 # this entry; the caller adds its own depth back.
                 close_after[t] = min(self.budget_to_close(ns) - state.depth, 2**14)
+                # Transition descriptor, depth-relative like close_after.
+                # The floor guarantees the simulation only consulted
+                # recorded stack symbols, so any state sharing this summary
+                # reaches the same (rel, pushed) — its successor stack is
+                # stack[: depth + rel] + pushed exactly.
+                d = (ns.mode, ns.literal, min_depth - state.depth,
+                     ns.stack[min_depth:], ns.num_ok, ns.no_close)
+                g = desc_index.get(d)
+                if g is None:
+                    g = desc_index[d] = len(descs)
+                    descs.append(d)
+                desc_ids[t] = g
         self._masks[key] = (allowed, close_after)
+        self._descs[key] = (desc_ids, descs)
         return allowed, close_after
 
     def _finalize(self, base: np.ndarray, state: MachineState) -> np.ndarray:
@@ -393,6 +427,92 @@ class TokenMaskCache:
         if state.mode == EXPECT_KEY and state.no_close:
             extra = 5  # '"' + '"' + ':' + value before the '}' can come
         return state.depth + extra + 1  # +1 for EOS
+
+    # ---- one-step lookahead (overlapped engine) ------------------------
+    #
+    # The overlapped pipeline composes step N+1 while step N's token is
+    # still on device. These peek-only entry points let the engine (a)
+    # recompute the mask the in-flight step samples under and (b) group
+    # every candidate token it can emit by exact successor state — WITHOUT
+    # ever paying a cold O(vocab) build on the dispatch path. A cold
+    # summary returns None; the engine barriers (reason constraint_miss),
+    # the sync fallback builds the mask, and the next step chains warm.
+
+    def peek_mask(self, state: MachineState, remaining: int) -> np.ndarray | None:
+        """:meth:`JsonConstraint.mask` replicated warm-only: None when the
+        state's summary has no cached base mask."""
+        if state.summary() not in self._masks:
+            self.misses += 1
+            return None
+        force = remaining <= self.budget_to_close(state) + 2
+        return self.mask_for(state, force_close=force, remaining=remaining)
+
+    def lookahead_groups(
+        self, state: MachineState, allowed: np.ndarray, cap: int
+    ) -> tuple[list[MachineState], np.ndarray] | None:
+        """Group the candidate next tokens by exact successor state.
+
+        ``allowed`` is the mask the in-flight step samples under. Returns
+        ``(states, group_of)`` with ``group_of`` int32[vocab]: candidate
+        tokens map to an index into ``states``, everything else (including
+        EOS, whose sample the engine discards at harvest) maps to -1.
+        Returns None — the caller barriers — when the answer would need a
+        cold build or more than ``cap`` distinct successor states.
+        """
+        if not allowed.any():
+            # Pathological (closer-less vocab fallback masks): the sampled
+            # token is unconstrained, so no finite group table covers it.
+            self.misses += 1
+            return None
+        cands = np.flatnonzero(allowed)
+        if self.eos_ids:
+            cands = cands[~np.isin(cands, np.asarray(self.eos_ids))]
+        group_of = np.full(self.vocab_size, -1, np.int32)
+        states: list[MachineState] = []
+        if cands.size == 0:
+            return states, group_of  # EOS-only: the row finishes at harvest
+        if cands.size <= cap:
+            # Few candidates: advance each piece directly (exact, cheap).
+            pieces = self._ensure_pieces()
+            index: dict[MachineState, int] = {}
+            for t in cands.tolist():
+                ns = advance_text(state, pieces[t])
+                g = index.get(ns)
+                if g is None:
+                    if len(states) >= cap:
+                        self.misses += 1
+                        return None
+                    g = index[ns] = len(states)
+                    states.append(ns)
+                group_of[t] = g
+            self.hits += 1
+            return states, group_of
+        # Wide masks (e.g. IN_STRING admits most of the vocab): use the
+        # transition table recorded when the summary's mask was built.
+        table = self._descs.get(state.summary())
+        if table is None:
+            self.misses += 1
+            return None
+        desc_ids, descs = table
+        ids = desc_ids[cands]
+        if (ids < 0).any():
+            # An allowed token outside the recorded table (force-close /
+            # clamp edge): decline rather than guess.
+            self.misses += 1
+            return None
+        uniq, inv = np.unique(ids, return_inverse=True)
+        if uniq.size > cap:
+            self.misses += 1
+            return None
+        for d in uniq.tolist():
+            mode, literal, rel, pushed, num_ok, no_close = descs[d]
+            states.append(MachineState(
+                mode, literal, state.stack[: state.depth + rel] + pushed,
+                num_ok, no_close,
+            ))
+        group_of[cands] = inv.astype(np.int32)
+        self.hits += 1
+        return states, group_of
 
 
 @dataclasses.dataclass

@@ -63,6 +63,24 @@ logger = logging.getLogger(__name__)
 # setting). 20 = the OpenAI top_logprobs cap.
 LOGPROBS_TOP_K = 20
 
+# Every reason an overlapped step can record for barriering (first reason
+# wins within a step; "idle" is the default when none was noted). This
+# vocabulary is load-bearing: docs/SCHEDULER.md documents each row and
+# tools/check_barrier_reasons.py pins both the _note_barrier call sites and
+# the docs table against it — the two have drifted before.
+BARRIER_REASONS = (
+    "cancel",  # cancellation reaped mid-pipeline: in-flight writes are stale
+    "runner",  # runner has no step_async (mock timing modes, embedders)
+    "prefill",  # legacy XOR mode: whole-prompt prefill steps carry no decodes
+    "constraint",  # constrained rows with lookahead disabled (knob = 0)
+    "constraint_miss",  # lookahead mask-cache miss or candidate-cap overflow
+    "spec",  # verify in flight (harvest-first) or spec cannot chain
+    "drain",  # every live row finishes inside the in-flight step
+    "pages",  # sole candidate cannot extend: commit in-flight, then re-check
+    "fill",  # pipeline refill: dispatched with nothing in flight
+    "idle",  # barrier step with no recorded reason (nothing dispatched)
+)
+
 
 @dataclasses.dataclass
 class EngineConfig:
@@ -113,14 +131,17 @@ class EngineConfig:
     # prefill chunk rows feed from host (their tokens are known), decode
     # rows chain; penalty history and the pos_limit write clamp are applied
     # in-graph, so penalized rows and budget-final tokens are not barriers.
-    # Stops are evaluated one step late; a late-detected stop cancels the
-    # in-flight row (its token is discarded, its pages released — output
-    # streams stay bit-identical to overlap=False). Only composition the
-    # graph cannot absorb barriers to the synchronous path: cancellation,
-    # constrained decode, multimodal prefill, decode_steps>1, and a verify
-    # step whose acceptance the next dispatch depends on (harvested first,
-    # then chained out of). Reasons are counted in overlap_barrier_counts
-    # and flight STEP records. docs/SCHEDULER.md.
+    # Constrained (json_mode) rows chain via one-step-lookahead mask groups
+    # (constraint_lookahead_tokens); multimodal/mrope rows chain with their
+    # extras threaded through the explicit-args chained program; and
+    # decode_steps>1 folds into the same pipeline as K chained sub-steps
+    # per dispatch. Stops are evaluated one step late; a late-detected stop
+    # cancels the in-flight row (its token is discarded, its pages released
+    # — output streams stay bit-identical to overlap=False). The residual
+    # barriers are cancellation, a lookahead-mask cache miss/cap overflow
+    # (constraint_miss), and spec without an async verify. Reasons are
+    # counted in overlap_barrier_counts and flight STEP records
+    # (BARRIER_REASONS is the full vocabulary). docs/SCHEDULER.md.
     overlap: bool = False
     # Allow speculative verify dispatches to participate in the overlapped
     # pipeline (DYN_OVERLAP_SPEC): verify steps chain their base token from
@@ -145,28 +166,42 @@ class EngineConfig:
     # bit-identical to full-cost pricing. (The router's residual-prefill
     # cost term is armed by the same knob via sched.configure_cache_aware.)
     cache_aware: bool = False
+    # Constrained-decode lookahead (DYN_CONSTRAINT_LOOKAHEAD_TOKENS): max
+    # distinct successor machine states a chained json_mode row may fan out
+    # to per step. At compose time the row's input token is still in flight,
+    # so the engine precomputes the constraint mask for every admissible
+    # candidate (grouped by successor state — JSON masks collapse thousands
+    # of candidate tokens into a handful of states) and the chained program
+    # selects the right one in-graph from the gathered token. Overflow or a
+    # cold mask cache barriers that step (reason "constraint_miss") and
+    # self-warms. 0 disables lookahead: every constrained step barriers
+    # (reason "constraint") — the pre-lookahead behavior, kept as the bench
+    # baseline.
+    constraint_lookahead_tokens: int = 32
 
 
 @dataclasses.dataclass
 class _InflightStep:
     """A dispatched-but-unharvested device step.
 
-    kind "burst" is the pipelined multi-step decode (decode_steps > 1);
-    "step" is a plain (possibly mixed prefill+decode) single step; "spec"
-    is a speculative verify. ns/samples/drafts snapshot the composition the
-    harvest needs to apply the results — sequence state may have moved on
-    (preemption, cancellation) by the time the tokens land, so apply skips
-    any row whose sequence is no longer RUNNING."""
+    kind "step" is a plain (possibly mixed prefill+decode) single step;
+    "spec" is a speculative verify. ns/samples/drafts snapshot the
+    composition the harvest needs to apply the results — sequence state may
+    have moved on (preemption, cancellation) by the time the tokens land,
+    so apply skips any row whose sequence is no longer RUNNING. ``extra``
+    holds the chained pure-decode sub-step handles a decode_steps>1 burst
+    dispatched behind the primary step — harvested in dispatch order, one
+    more token per row each."""
 
     batch: list
     handle: object
-    kind: str = "burst"
-    k: int = 1  # burst length (kind == "burst")
+    kind: str = "step"
     ns: list | None = None  # real token columns per row (step/spec)
     n_dec: int = 0  # leading decode rows (the rest are prefill chunks)
     samples: list | None = None  # per-row: does the engine accept a sample?
     drafts: list | None = None  # per-decode-row draft tokens (spec)
     v: int = 1  # verify width (spec)
+    extra: list = dataclasses.field(default_factory=list)  # burst sub-step handles
 
 
 @dataclasses.dataclass
@@ -317,6 +352,12 @@ class EngineCore:
         # verify: row*verify_width + accepted_col, filled at harvest). A
         # chained dispatch sources these rows' input tokens in-graph.
         self._chain_map: dict[int, int] = {}
+        # Constrained-row lookahead plans for the step being composed:
+        # seq_id -> (successor masks, token -> group map). Built by
+        # _plan_constraint_lookahead during routing, consumed by
+        # _run_mixed_overlapped when it assembles the la_masks/la_groups
+        # device arrays. Rebuilt whenever constrained rows route overlapped.
+        self._la_plan: dict[int, tuple[list, np.ndarray]] = {}
         # Overlapped execution accounting (config.overlap): per-step mode —
         # "overlapped" when the step dispatched a chained lookahead while
         # harvesting the previous one, "barrier" otherwise — plus the host
@@ -436,6 +477,16 @@ class EngineCore:
                 c.cache.mask_for(advance_text(MachineState(), prefix))
         except Exception:
             logger.debug("constraint warm-up skipped", exc_info=True)
+
+    @property
+    def constraint_mask_cache_hits(self) -> int:
+        """Cumulative TokenMaskCache hits (mask builds + lookahead plans) —
+        mirrored as dynamo_engine_constraint_mask_cache_hits_total."""
+        return self._mask_cache.hits if self._mask_cache is not None else 0
+
+    @property
+    def constraint_mask_cache_misses(self) -> int:
+        return self._mask_cache.misses if self._mask_cache is not None else 0
 
     def _decode_mm_inputs(self, request: PreprocessedRequest):
         """mm_inputs wire format -> [total_image_tokens, D] embeddings.
@@ -634,18 +685,14 @@ class EngineCore:
         self.flush_offloads()
         cancelled = self._reap_cancelled()
         if self._inflight is not None and (
-            cancelled
-            or (
-                (not self.config.overlap or self._inflight.kind == "burst")
-                and (self.waiting or self.prefilling)
-            )
+            cancelled or (not self.config.overlap and (self.waiting or self.prefilling))
         ):
-            # Composition is about to change. Pipelined bursts (overlap off,
-            # or decode_steps>1 with overlap armed) drain on any
-            # admission/chunk pressure; the chained pipeline drains only on
-            # cancellation — reaping released the cancelled rows' pages, so
-            # the in-flight step's writes for them are stale and nothing new
-            # may be composed on top of it.
+            # Composition is about to change. With overlap off an in-flight
+            # step only exists defensively (config flipped mid-run) and
+            # drains on any admission/chunk pressure; the chained pipeline
+            # drains only on cancellation — reaping released the cancelled
+            # rows' pages, so the in-flight step's writes for them are stale
+            # and nothing new may be composed on top of it.
             if cancelled:
                 self._note_barrier("cancel")
             out = cancelled + self._drain_inflight()
@@ -662,17 +709,10 @@ class EngineCore:
             return out
         if reason is not None:
             self._note_barrier(reason)
-        if (
-            self.config.overlap
-            and self._inflight is not None
-            and self._inflight.kind != "burst"
-        ):
+        if self.config.overlap and self._inflight is not None:
             # Barrier with work in flight: commit it before any synchronous
             # dispatch. Chunks scheduled above keep their pages and are
-            # re-scheduled (idempotently) next step. (A burst-kind handle
-            # belongs to the multi-step burst pipeline, which harvests and
-            # re-dispatches it itself in _run_decode — admission pressure
-            # for it already drained above.)
+            # re-scheduled (idempotently) next step.
             out = cancelled + self._drain_inflight()
             if not self.defer_offloads:
                 self.flush_offloads()
@@ -752,17 +792,14 @@ class EngineCore:
         Returns (use_overlap, barrier_reason). reason is None when overlap
         is simply off/idle; otherwise it names the composition the graph
         cannot absorb. Penalties, logprobs, page-budget-final tokens,
-        admission, and mixed prefill+decode are deliberately NOT here —
-        they are all chained in-graph now."""
+        admission, mixed prefill+decode, multimodal/mrope rows, json_mode
+        constraints, and decode_steps>1 are deliberately NOT here — they
+        are all chained in-graph now."""
         cfg = self.config
         if not cfg.overlap:
             return False, None
         if not hasattr(self.runner, "step_async"):
             return False, "runner"
-        if cfg.decode_steps > 1:
-            # Multi-step bursts keep their own pipelined path (the burst
-            # already amortizes the round trip the lookahead would hide).
-            return False, "multistep"
         if chunks and cfg.chunk_prefill_tokens <= 0:
             # Legacy XOR mode: whole-prompt prefill steps carry no decode
             # rows, so there is nothing to chain.
@@ -777,7 +814,13 @@ class EngineCore:
             # finishing — let the driver harvest it; otherwise idle.
             return (self._inflight is not None), None
         if any(s.constraint is not None for s in rows):
-            return False, "constraint"
+            if cfg.constraint_lookahead_tokens <= 0:
+                return False, "constraint"
+            if not self._plan_constraint_lookahead(rows):
+                # Cold successor mask or candidate fan-out past the cap:
+                # barrier to the sync mask path (which warms exactly the
+                # states that missed) and retry the pipeline next step.
+                return False, "constraint_miss"
         if self._spec_active() and not (
             self.config.overlap_spec
             and hasattr(self.runner, "spec_step_async")
@@ -787,14 +830,88 @@ class EngineCore:
             # verify path rather than silently dropping drafts (the
             # pre-ISSUE-11 behavior).
             return False, "spec"
-        if any(s.mm_embeds is not None for s in rows) or any(
-            s.mrope is not None for s, _ in chunks
-        ):
-            # mm embeds ride an explicit (unpacked) argument; mrope *prefill*
-            # needs explicit 3-axis positions. mrope decode rows are fine —
-            # their position delta rides the packed buffer.
-            return False, "mm"
         return True, None
+
+    def _plan_constraint_lookahead(self, rows) -> bool:
+        """Pre-build successor masks for constrained rows whose input token
+        is still in flight. Returns False (barrier "constraint_miss") when
+        any plan would need a mask the cache cannot produce warm.
+
+        Soundness: at compose time exactly one step is unharvested, so the
+        host constraint state is current through the *previous* harvested
+        token — which makes ``constraint.mask(remaining_tokens)`` exactly
+        the mask the in-flight step is sampling under (state unchanged
+        since that compose, and remaining_tokens has not advanced for the
+        in-flight emit). Every token that mask admits (minus EOS, whose
+        sample the late stop check discards at harvest) is a candidate;
+        candidates collapse into successor machine states and each state's
+        mask at the row's post-emit remaining becomes one lookahead group."""
+        cap = self.config.constraint_lookahead_tokens
+        cache = self._mask_cache
+        plan: dict[int, tuple[list, np.ndarray]] = {}
+        self._la_plan = plan
+        ok = True
+        for s in rows:
+            if s.constraint is None or s.seq_id not in self._chain_map:
+                continue  # unchained constrained rows ship a host-built mask
+            allowed = s.constraint.mask(s.remaining_tokens(self.config.max_seq_len))
+            la = cache.lookahead_groups(s.constraint.state, allowed, cap)
+            if la is None:
+                ok = False
+                continue
+            states, group_of = la
+            rem_next = self._eff_remaining(s)
+            masks = []
+            for ns in states:
+                m = cache.peek_mask(ns, rem_next)
+                if m is None:
+                    # Cold successor summary: this step barriers to the sync
+                    # mask path anyway, so spend the barrier warming the
+                    # summary — otherwise a successor the stream never takes
+                    # would stay cold and re-miss every step it remains a
+                    # candidate.
+                    cache.mask_for(ns, remaining=rem_next)
+                    ok = False
+                masks.append(m)
+            if ok:
+                plan[s.seq_id] = (masks, group_of)
+        return ok
+
+    def _attach_lookahead_masks(self, sb, batch, chain_src) -> None:
+        """Ship per-row constraint masks as lookahead groups on a chained
+        dispatch: ``la_masks[i, la_groups[i, tok]]`` is row i's sampling mask
+        once its chained input token ``tok`` materialises in-graph. Group 0
+        is the all-True identity (unconstrained rows; EOS candidates, whose
+        rows finish at harvest before the sampled token is ever used)."""
+        vocab = self.runner.cfg.vocab_size
+        rows: dict[int, list] = {}
+        groups = np.zeros((len(batch), vocab), np.int32)
+        g_max = 1
+        for i, s in enumerate(batch):
+            if s.constraint is None:
+                continue
+            if chain_src[i] >= 0:
+                # Routed here only after _plan_constraint_lookahead succeeded
+                # for every chained constrained row: a missing plan is a bug,
+                # not a fallback case.
+                masks, group_of = self._la_plan[s.seq_id]
+                groups[i] = np.where(group_of >= 0, group_of + 1, 0)
+                rows[i] = masks
+            else:
+                # The host knows this row's input token (fresh chunk row or a
+                # chain-lost decode row): one group holding its exact mask.
+                # Non-final chunk rows' samples are discarded, so masking
+                # them is harmless.
+                rows[i] = [s.constraint.mask(s.remaining_tokens(self.config.max_seq_len))]
+                groups[i] = 1
+            g_max = max(g_max, 1 + len(rows[i]))
+        la = np.zeros((len(batch), g_max, vocab), bool)
+        la[:, 0] = True
+        for i, masks in rows.items():
+            for g, m in enumerate(masks):
+                la[i, g + 1] = m
+        sb.la_masks = la
+        sb.la_groups = groups
 
     # -- prefill phase -----------------------------------------------------
 
@@ -1365,45 +1482,7 @@ class EngineCore:
             for i, (s, n) in enumerate(zip(batch, ns))
         ]
         sb = self._sampling_batch(batch, tokens, positions, block_tables, slots, last)
-        if any(s.mm_embeds is not None for s in batch[n_dec:]):
-            d = next(s.mm_embeds.shape[1] for s in batch if s.mm_embeds is not None)
-            m = max(s.mm_embeds.shape[0] for s in batch if s.mm_embeds is not None)
-            img_id = self.runner.cfg.image_token_id
-            vid_id = self.runner.cfg.video_token_id
-            mm = np.zeros((b, m, d), np.float32)
-            off = np.full(b, -1, np.int32)  # -1: text row, no substitution
-            counts = np.zeros(b, np.int32)
-            for i, (s, n) in enumerate(zip(batch, ns)):
-                # Decode rows keep -1 (a sampled image-token id is an
-                # ordinary token there, exactly as in pure decode steps).
-                if s.mm_embeds is not None and i >= n_dec:
-                    mm[i, : s.mm_embeds.shape[0]] = s.mm_embeds
-                    counts[i] = s.mm_embeds.shape[0]
-                    # Placeholders already covered by cached/previous chunks.
-                    cached = np.asarray(s.tokens[: s.num_cached], np.int32)
-                    off[i] = int(np.count_nonzero(
-                        (cached == img_id) | (cached == (vid_id if vid_id is not None else -1))
-                    ))
-            sb.mm_embeds, sb.mm_slot_offset, sb.mm_counts = mm, off, counts
-        if any(s.mrope is not None for s in batch):
-            # Per-token 3D rope coords for this step's columns. Rows without
-            # mrope (text prompts sharing the batch) use sequential positions
-            # on all axes — exactly 1D rope. Indices past the stored prompt
-            # coords (recomputed generated tokens and decode rows) sit at
-            # index + delta.
-            mrope3 = np.broadcast_to(positions[:, None, :], (b, 3, t)).copy()
-            for i, (s, n) in enumerate(zip(batch, ns)):
-                if s.mrope is None:
-                    continue
-                pos3, delta = s.mrope
-                idx = np.arange(s.num_cached, s.num_cached + n)
-                in_prompt = idx < pos3.shape[1]
-                cols = np.where(
-                    in_prompt[None, :], pos3[:, np.minimum(idx, pos3.shape[1] - 1)],
-                    (idx + delta)[None, :],
-                )
-                mrope3[i, :, :n] = cols
-            sb.mrope_positions = mrope3.astype(np.int32)
+        self._mm_rows(sb, batch, ns, n_dec, positions, lambda s: s.num_cached)
         sb.num_new = np.asarray(ns, np.int32)
         lp_k = LOGPROBS_TOP_K if any(
             s.request.sampling.logprobs and smp for s, smp in zip(batch, samples)
@@ -1440,6 +1519,57 @@ class EngineCore:
             v=(self.config.spec_k + 1 if use_spec else 1),
         )
         return out + self._apply_mixed_results(rec, next_tokens, targets, lp_aux)
+
+    def _mm_rows(self, sb: StepBatch, batch, ns, n_dec, positions, cached_of) -> None:
+        """Attach multimodal extras to a (possibly mixed) step batch: packed
+        image embeddings for the prefill chunk rows and explicit 3-axis
+        M-RoPE coords for every row when any row needs them. ``cached_of``
+        maps a sequence to its first computed index this step — num_cached
+        on the sync path, the effective (in-flight-advanced) state on the
+        overlapped path. Both paths produce identical arrays for the same
+        row span, which is what keeps chained multimodal dispatches
+        bit-identical to the synchronous step."""
+        b, t = positions.shape
+        if any(s.mm_embeds is not None for s in batch[n_dec:]):
+            d = next(s.mm_embeds.shape[1] for s in batch if s.mm_embeds is not None)
+            m = max(s.mm_embeds.shape[0] for s in batch if s.mm_embeds is not None)
+            img_id = self.runner.cfg.image_token_id
+            vid_id = self.runner.cfg.video_token_id
+            mm = np.zeros((b, m, d), np.float32)
+            off = np.full(b, -1, np.int32)  # -1: text row, no substitution
+            counts = np.zeros(b, np.int32)
+            for i, (s, n) in enumerate(zip(batch, ns)):
+                # Decode rows keep -1 (a sampled image-token id is an
+                # ordinary token there, exactly as in pure decode steps).
+                if s.mm_embeds is not None and i >= n_dec:
+                    mm[i, : s.mm_embeds.shape[0]] = s.mm_embeds
+                    counts[i] = s.mm_embeds.shape[0]
+                    # Placeholders already covered by cached/previous chunks.
+                    cached = np.asarray(s.tokens[: cached_of(s)], np.int32)
+                    off[i] = int(np.count_nonzero(
+                        (cached == img_id) | (cached == (vid_id if vid_id is not None else -1))
+                    ))
+            sb.mm_embeds, sb.mm_slot_offset, sb.mm_counts = mm, off, counts
+        if any(s.mrope is not None for s in batch):
+            # Per-token 3D rope coords for this step's columns. Rows without
+            # mrope (text prompts sharing the batch) use sequential positions
+            # on all axes — exactly 1D rope. Indices past the stored prompt
+            # coords (recomputed generated tokens and decode rows) sit at
+            # index + delta.
+            mrope3 = np.broadcast_to(positions[:, None, :], (b, 3, t)).copy()
+            for i, (s, n) in enumerate(zip(batch, ns)):
+                if s.mrope is None:
+                    continue
+                pos3, delta = s.mrope
+                ec = cached_of(s)
+                idx = np.arange(ec, ec + n)
+                in_prompt = idx < pos3.shape[1]
+                cols = np.where(
+                    in_prompt[None, :], pos3[:, np.minimum(idx, pos3.shape[1] - 1)],
+                    (idx + delta)[None, :],
+                )
+                mrope3[i, :, :n] = cols
+            sb.mrope_positions = mrope3.astype(np.int32)
 
     def _apply_mixed_results(
         self,
@@ -1559,13 +1689,17 @@ class EngineCore:
 
     # -- overlapped mixed pipeline -----------------------------------------
 
-    def _ensure_lookahead_pages(self, rows: list[Sequence]) -> Sequence | None:
-        """Give every lookahead decode row pages covering its chained write
-        (position ``eff_cached``); preempt on exhaustion. Rows preempted by
-        an earlier row's allocation are dropped from ``rows`` in place (the
-        driver re-filters afterwards for victims already behind the cursor).
-        A sole row that cannot fit is returned *unfinished* — the step in
-        flight may hold its legitimate finish."""
+    def _ensure_lookahead_pages(
+        self, rows: list[Sequence], horizon: int = 1
+    ) -> Sequence | None:
+        """Give every lookahead decode row pages covering its chained writes
+        (positions ``eff_cached .. eff_cached + horizon - 1``, clamped to
+        the row's finish line); preempt on exhaustion. horizon > 1 is the
+        decode_steps burst composing multiple chained sub-steps up front.
+        Rows preempted by an earlier row's allocation are dropped from
+        ``rows`` in place (the driver re-filters afterwards for victims
+        already behind the cursor). A sole row that cannot fit is returned
+        *unfinished* — the step in flight may hold its legitimate finish."""
         ps = self.config.page_size
         i = 0
         while i < len(rows):
@@ -1573,7 +1707,9 @@ class EngineCore:
             if s.status is not SeqStatus.RUNNING:
                 rows.pop(i)
                 continue
-            need = s.pages_needed(ps, self._adv(s)[0] + 1)
+            need = s.pages_needed(
+                ps, self._adv(s)[0] + max(1, min(horizon, self._eff_remaining(s)))
+            )
             if need:
                 try:
                     s.pages.extend(self.allocator.allocate(need))
@@ -1626,6 +1762,15 @@ class EngineCore:
         its acceptance decides every position after it — and the next
         dispatch chains out of its device-resident targets buffer, so even
         then tokens never round-trip through the host.
+
+        Compositions the pre-lookahead pipeline barriered on now ride it
+        too: constrained rows select their mask in-graph from the
+        precomputed lookahead groups (_plan_constraint_lookahead),
+        multimodal/mrope rows thread their extras through the explicit-args
+        chained program, and decode_steps>1 issues K-1 extra pure-decode
+        sub-steps chained back-to-back behind the primary dispatch (the
+        whole burst is harvested one step late, exactly like a single
+        chained step).
         """
         fused = self.config.chunk_prefill_tokens > 0
         out: list[tuple[Sequence, EngineOutput]] = []
@@ -1637,11 +1782,6 @@ class EngineCore:
             "chained_rows": 0,
         }
         inf = self._inflight
-        if inf is not None and inf.kind == "burst":
-            # decode_steps config flipped mid-run: commit the legacy burst.
-            self._note_barrier("multistep")
-            out += self._drain_inflight()
-            inf = None
         if inf is not None and inf.kind == "spec":
             # A verify's acceptance decides every position that follows —
             # nothing can be composed until it lands. Harvest first; the
@@ -1671,7 +1811,39 @@ class EngineCore:
                 self._note_barrier("drain")
                 out += self._drain_inflight()
             return out
-        failed = self._ensure_lookahead_pages(decode_rows)
+        spec = (
+            self._spec_active()
+            and self.config.overlap_spec
+            and hasattr(self.runner, "spec_step_async")
+        )
+        # decode_steps>1 folds into the pipeline as K chained pure-decode
+        # sub-steps behind the primary dispatch. Only clean decode batches
+        # burst: chunks change composition mid-burst; speculation already
+        # amortizes the round trip; constraints need a fresh mask per token
+        # (the lookahead plan is depth-1); per-step logprobs and penalty
+        # history need the host between tokens.
+        k_cfg = max(1, self.config.decode_steps)
+        want_burst = (
+            k_cfg > 1
+            and not chunks
+            and not spec
+            and bool(decode_rows)
+            and not any(
+                s.constraint is not None
+                or s.request.sampling.logprobs
+                or s.request.sampling.frequency_penalty
+                or s.request.sampling.presence_penalty
+                for s in decode_rows
+            )
+        )
+        failed = self._ensure_lookahead_pages(
+            decode_rows, k_cfg if want_burst else 1
+        )
+        if failed is not None and want_burst:
+            # The burst horizon didn't fit; a single lookahead token still
+            # might — retry at depth 1 before declaring the row stuck.
+            want_burst = False
+            failed = self._ensure_lookahead_pages(decode_rows, 1)
         if failed is not None:
             # The sole candidate can't extend: the in-flight step may hold
             # its legitimate finish — commit that first, then re-check.
@@ -1685,11 +1857,14 @@ class EngineCore:
         # _ensure_lookahead_pages may have preempted rows already behind
         # its cursor; drop them (their recompute is scheduled from waiting).
         decode_rows = [s for s in decode_rows if s.status is SeqStatus.RUNNING]
-        spec = (
-            self._spec_active()
-            and self.config.overlap_spec
-            and hasattr(self.runner, "spec_step_async")
-        )
+        k_burst = 1
+        if want_burst and decode_rows:
+            # Never burst a row past its finish line: unlike the sync fused
+            # burst there is no cheap overshoot to discard — every sub-step
+            # is a real dispatch — so the shortest row clamps the depth.
+            k_burst = max(
+                1, min(k_cfg, min(self._eff_remaining(s) for s in decode_rows))
+            )
         drafts = (
             self._propose_drafts(decode_rows, chunks) if spec and decode_rows
             else [[] for _ in decode_rows]
@@ -1698,6 +1873,15 @@ class EngineCore:
             # mrope decode rows chain fine (their position delta rides the
             # packed buffer) but the verify program wants explicit 3-axis
             # positions; drop the drafts — losslessly — rather than barrier.
+            drafts = [[] for _ in decode_rows]
+        if any(s.constraint is not None for s in decode_rows) or any(
+            s.constraint is not None or s.mm_embeds is not None or s.mrope is not None
+            for s, _ in chunks
+        ):
+            # Verify dispatches carry neither lookahead mask groups nor mm
+            # extras: a batch with constrained or multimodal rows anywhere
+            # downgrades to a plain chained step (drafts dropped,
+            # losslessly) instead of barriering.
             drafts = [[] for _ in decode_rows]
         # All-empty drafts degrade to a plain chained step (bit-identical
         # per the PR 6 contract) — which, unlike a verify, the *next* step
@@ -1749,8 +1933,19 @@ class EngineCore:
             slots[i, :n] = page_arr[pos // ps] * ps + pos % ps
             last[i] = n - 1
         info["chained_rows"] = chained = int((chain_src >= 0).sum())
+        info["chained_rows"] += b * (k_burst - 1)  # every sub-step row chains
         sb = self._sampling_batch(batch, tokens, positions, block_tables, slots, last)
+        self._mm_rows(sb, batch, ns, n_dec, positions, self._eff_cached)
         sb.num_new = np.asarray(ns, np.int32)
+        if any(s.constraint is not None for s in batch):
+            if chained:
+                # Chained dispatch: masks resolve in-graph against the
+                # gathered token (la groups); a host logit_mask cannot ride.
+                self._attach_lookahead_masks(sb, batch, chain_src)
+            else:
+                # Pipeline fill — every token host-known, exact masks ride
+                # the plain logit_mask argument as on the sync path.
+                sb.logit_mask = self._constraint_masks(batch)
         lp_k = LOGPROBS_TOP_K if any(
             s.request.sampling.logprobs and smp for s, smp in zip(batch, samples)
         ) else 0
@@ -1776,6 +1971,28 @@ class EngineCore:
                     batch, dev, kind="step", ns=ns, n_dec=n_dec,
                     samples=samples, drafts=drafts,
                 )
+                for j in range(1, k_burst):
+                    # decode_steps burst: one extra pure-decode sub-step per
+                    # depth, each chaining row i's input from the previous
+                    # dispatch's row-i sample (chain_src=None, the identity
+                    # map). Host tokens are placeholders; positions/slots
+                    # advance by j; sample_steps += j keeps the rng fold
+                    # counter on the exact sync-loop lattice.
+                    tok_j = np.zeros((b, 1), np.int32)
+                    pos_j = positions[:, :1] + j
+                    slots_j = np.zeros((b, 1), np.int32)
+                    for i, s in enumerate(batch):
+                        p = int(positions[i, 0]) + j
+                        slots_j[i, 0] = s.pages[p // ps] * ps + p % ps
+                    sbj = self._sampling_batch(
+                        batch, tok_j, pos_j, block_tables, slots_j,
+                        np.zeros(b, np.int32),
+                    )
+                    sbj.sample_steps += j
+                    sbj.num_new = np.ones(b, np.int32)
+                    new_inf.extra.append(
+                        self.runner.step_async(sbj, chain=True, chain_src=None)
+                    )
         except Exception:
             self._abort_pipeline(batch)
             raise
@@ -1806,8 +2023,11 @@ class EngineCore:
                 for s, n, smp in zip(batch[n_dec:], ns[n_dec:], samples[n_dec:])
             }
         else:
+            # A burst's sub-steps advance every row one more cached slot and
+            # one more emitted token each (rows that finish mid-burst discard
+            # the overshoot at harvest, same as the sync fused burst).
             self._inflight_adv = {
-                s.seq_id: (n, 1 if smp else 0)
+                s.seq_id: (n + k_burst - 1, (1 if smp else 0) + k_burst - 1)
                 for s, n, smp in zip(batch, ns, samples)
             }
         return out
@@ -1824,40 +2044,22 @@ class EngineCore:
 
             rem = max(s.remaining_tokens(self.config.max_seq_len) for s in self.running)
             k = max(1, min(k, next_pow2(rem)))
-        # Penalized sampling needs fresh host-side token history per burst;
-        # a chained (pipelined) burst would dispatch with history missing the
-        # burst still in flight, undercounting repetitions. Those batches
-        # take the sync path (the in-burst scan still self-counts).
-        penalized = any(
-            s.request.sampling.frequency_penalty or s.request.sampling.presence_penalty
-            for s in self.running
-        )
-        constrained = any(s.constraint is not None for s in self.running)
         # Overlapped execution (DYN_OVERLAP) never reaches this method:
-        # _step_locked routes overlappable compositions to
-        # _run_mixed_overlapped and drains the pipeline before any barrier
-        # falls through to the synchronous paths below.
-        # Logprobs ride the single-step sync path: the fused burst's scan
-        # doesn't surface per-step logits, and mixing would stall the
-        # pipeline anyway (same trade as penalties).
-        if constrained or any(s.request.sampling.logprobs for s in self.running):
-            # (constraints additionally need a fresh mask per token)
-            if self._inflight is not None:
-                return self._drain_inflight()
-            return self._run_decode_sync(1)
-        use_pipelined = (
-            k > 1
-            and not penalized
-            and hasattr(self.runner, "multi_step_async")
-            and getattr(self.runner, "mesh", None) is None
-        )
-        if not use_pipelined and self._inflight is not None:
-            # Entering the sync path (penalties joined, or k collapsed near
-            # the finish line) with a burst still in flight: commit it first
-            # or its positions would be recomputed over live device writes.
+        # _step_locked routes every composition — including decode_steps>1,
+        # which is now served as chained sub-dispatches inside
+        # _run_mixed_overlapped — through the pipeline, and drains it before
+        # any barrier falls through to the synchronous paths below.
+        if self._inflight is not None:
             return self._drain_inflight()
-        if use_pipelined:
-            return self._run_decode_pipelined(k)
+        # Constraints need a fresh host-built mask per token, and logprobs
+        # ride the single-step path because the fused burst's scan doesn't
+        # surface per-step logits. (Penalized rows burst fine: the in-graph
+        # scan self-counts repetitions within the burst.)
+        if any(
+            s.constraint is not None or s.request.sampling.logprobs
+            for s in self.running
+        ):
+            return self._run_decode_sync(1)
         return self._run_decode_sync(k)
 
     def _ensure_burst_pages(self, horizon: int, *, fail_sole: bool = True) -> Sequence | None:
@@ -1892,10 +2094,9 @@ class EngineCore:
             i += 1
         return None
 
-    def _decode_step_batch(self, batch: list[Sequence], offset: int = 0) -> StepBatch:
-        """Host arrays for a decode burst starting ``offset`` tokens ahead of
-        each sequence's committed state (offset > 0 = chained burst whose
-        input tokens live on device; the host token column is a placeholder)."""
+    def _decode_step_batch(self, batch: list[Sequence]) -> StepBatch:
+        """Host arrays for a synchronous decode step/burst, each row starting
+        at its committed state."""
         ps = self.config.page_size
         b = len(batch)
         n = max(len(s.pages) for s in batch)
@@ -1905,16 +2106,12 @@ class EngineCore:
         slots = np.zeros((b, 1), np.int32)
         last = np.zeros(b, np.int32)
         for i, s in enumerate(batch):
-            pos = s.num_cached + offset
-            if offset == 0:
-                tokens[i, 0] = s.tokens[s.num_cached]
+            pos = s.num_cached
+            tokens[i, 0] = s.tokens[pos]
             positions[i, 0] = pos
             block_tables[i, : len(s.pages)] = s.pages
             slots[i, 0] = s.pages[pos // ps] * ps + pos % ps
-        sb = self._sampling_batch(batch, tokens, positions, block_tables, slots, last)
-        if offset:
-            sb.sample_steps += offset  # rng fold-counter continuity across bursts
-        return sb
+        return self._sampling_batch(batch, tokens, positions, block_tables, slots, last)
 
     def _process_burst_tokens(self, batch: list[Sequence], next_tokens, lp_aux=None) -> list[tuple[Sequence, EngineOutput]]:
         """Apply a burst's sampled tokens to the batch's sequences.
@@ -1969,87 +2166,6 @@ class EngineCore:
             raise
         return self._process_burst_tokens(batch, next_tokens, lp_aux)
 
-    def _run_decode_pipelined(self, k: int) -> list[tuple[Sequence, EngineOutput]]:
-        """One-burst-deep pipelined decode.
-
-        Burst N+1 is dispatched (with its input tokens chained device-side
-        from burst N's output) *before* burst N's tokens are fetched, so the
-        blocking host round-trip overlaps the next burst's compute. Stop
-        conditions are evaluated one burst late; the page slack and discarded
-        overshoot this costs is the same trade ``decode_steps`` already makes.
-        Any composition change (admission, cancellation, preemption, finish)
-        drains the pipeline first — stale in-flight writes land only in
-        uncommitted or reallocated-after-completion pages, so the prefix
-        cache is never corrupted (device programs execute in dispatch order).
-        """
-        if self._inflight is None:
-            failed = self._ensure_burst_pages(k)
-            if failed is not None:
-                return [(failed, self._final_output(failed))]
-            if not self.running:
-                return []
-            batch = list(self.running)
-            self.runner.reset_chain()
-            try:
-                dev = self.runner.multi_step_async(self._decode_step_batch(batch), k)
-            except Exception:
-                for s in batch:
-                    self._finish(s, FinishReason.ERROR)
-                raise
-            self._inflight = _InflightStep(batch, dev, kind="burst", k=k)
-            return []  # pipeline fill: outputs arrive next step
-
-        inflight = self._inflight
-        batch, dev, kprev = inflight.batch, inflight.handle, inflight.k
-        same = len(batch) == len(self.running) and all(
-            a is b for a, b in zip(batch, self.running)
-        )
-        if same:
-            # Someone finishes inside the burst already in flight: the
-            # composition is about to change, so a chained dispatch would be
-            # pure waste — and its pages (capped at each sequence's remaining
-            # tokens) cannot cover positions past the finish line.
-            same = all(s.remaining_tokens(self.config.max_seq_len) > kprev for s in batch)
-        dispatched = False
-        if same:
-            # Don't fail the sole sequence yet: the burst in flight may hold
-            # its legitimate finish (EOS/length) — commit that first below.
-            failed = self._ensure_burst_pages(kprev + k, fail_sole=False)
-            # _ensure_burst_pages may have preempted or failed someone: re-check.
-            same = failed is None and len(batch) == len(self.running) and all(
-                a is b for a, b in zip(batch, self.running)
-            )
-            if same and self.runner.can_chain(len(batch)):
-                try:
-                    dev2 = self.runner.multi_step_async(
-                        self._decode_step_batch(batch, offset=kprev), k, chain=True
-                    )
-                except Exception:
-                    for s in batch:
-                        self._finish(s, FinishReason.ERROR)
-                    raise
-                self._inflight = _InflightStep(batch, dev2, kind="burst", k=k)
-                dispatched = True
-        if not dispatched:
-            self._inflight = None
-            self.runner.reset_chain()
-        out = self._process_burst_tokens(batch, dev.fetch())
-        # A sole sequence that couldn't extend and wasn't finished by the
-        # burst has truly outgrown the cache — fail it now (sync behavior).
-        if not dispatched and self.running:
-            failed2 = self._ensure_burst_pages(1)
-            if failed2 is not None:
-                out.append((failed2, self._final_output(failed2)))
-        return out
-
-    @staticmethod
-    def _fetch_inflight(dev) -> tuple:
-        """Harvest any in-flight handle: ``DeviceStepTokens`` (overlapped
-        single step — carries logprob aux) or ``DeviceTokens`` (fused burst)."""
-        if hasattr(dev, "result"):
-            return dev.result()
-        return dev.fetch(), None
-
     def _harvest_inflight(self) -> list[tuple[Sequence, EngineOutput]]:
         """Consume the in-flight step, keeping the runner's device-resident
         sample buffer alive — a dispatch composed on top of this harvest may
@@ -2060,13 +2176,26 @@ class EngineCore:
             return []
         self._inflight = None
         self._inflight_adv = {}
-        if inf.kind == "burst":
-            next_tokens, lp_aux = self._fetch_inflight(inf.handle)
-            return self._process_burst_tokens(inf.batch, next_tokens, lp_aux)
         res, lp_aux = inf.handle.result()
         if inf.kind == "spec":
             return self._apply_mixed_results(inf, res[:, 0], res, lp_aux, chain_out=True)
-        return self._apply_mixed_results(inf, res[:, 0], None, lp_aux)
+        out = self._apply_mixed_results(inf, res[:, 0], None, lp_aux)
+        for h in inf.extra:
+            # decode_steps burst sub-steps: one more pure-decode token per
+            # row each, applied in dispatch order. Rows that finished in an
+            # earlier sub-step are skipped by the RUNNING guard inside
+            # _apply_mixed_results; their overshoot KV writes land in pages
+            # that are only reallocated to dispatches composed *after* these
+            # sub-steps, so device program order makes the stale writes
+            # harmless (same argument as preemption under overlap).
+            res_j, lp_j = h.result()
+            b = len(inf.batch)
+            rec = _InflightStep(
+                inf.batch, h, kind="step", ns=[1] * b, n_dec=b,
+                samples=[True] * b, drafts=[[] for _ in inf.batch],
+            )
+            out += self._apply_mixed_results(rec, res_j[:, 0], None, lp_j)
+        return out
 
     def _drain_inflight(self) -> list[tuple[Sequence, EngineOutput]]:
         """Consume the in-flight step without composing on top of it: apply

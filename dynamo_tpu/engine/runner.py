@@ -166,8 +166,18 @@ class StepBatch:
     # coords a scalar shift can't express).
     mrope_delta: np.ndarray | None = None  # i32[B]; None -> zeros at pad time
     mrope_positions: np.ndarray | None = None  # i32[B, 3, T] (mm prefill only)
-    # Constrained decoding (sync path only): bool[B, vocab] allowed tokens.
+    # Constrained decoding, host-known tokens: bool[B, vocab] allowed
+    # tokens (sync steps and unchained overlapped dispatches).
     logit_mask: np.ndarray | None = None
+    # Constrained decoding, chained dispatches: one-step-lookahead mask
+    # groups. Each row carries G candidate masks; the chained program picks
+    # row i's mask in-graph as la_masks[i, la_groups[i, tokens[i, 0]]] AFTER
+    # the chain gather resolves the device-resident input token. Group 0 is
+    # all-True by convention (unconstrained rows, EOS candidates whose
+    # sample the engine discards at harvest). Mutually exclusive with
+    # logit_mask; requires chain=True.
+    la_masks: np.ndarray | None = None  # bool[B, G, vocab]
+    la_groups: np.ndarray | None = None  # i32[B, vocab]
     # Mixed-step metadata: real token columns per row (decode rows 1,
     # prefill-chunk rows their chunk length; padding rows 0). Host-side
     # only — never shipped to device (the kernels derive the same
@@ -319,15 +329,30 @@ class ModelRunner:
                                    tokens, positions, block_tables, slot_mapping,
                                    last_idx, temperature, top_k, top_p, seeds,
                                    sample_steps, freq_pen, pres_pen, pos_limit,
-                                   history, mrope_delta=None, *, impl, lp_k=0):
-            """Explicit-args chained step for mesh runners (the packed buffer
-            cannot be row-sharded; mesh steps ship per-array like the sync
-            mesh path, plus the two chain arrays)."""
+                                   history, mrope_delta=None,
+                                   mm_embeds=None, mm_slot_offset=None, mm_counts=None,
+                                   mrope_positions=None, la_masks=None, la_groups=None,
+                                   *, impl, lp_k=0):
+            """Explicit-args chained step: mesh runners (the packed buffer
+            cannot be row-sharded) and any chained dispatch carrying extras
+            the packed buffer has no slots for — multimodal embeds, explicit
+            3-axis mrope coords, or lookahead constraint-mask groups.
+
+            The lookahead mask selection happens strictly AFTER the chain
+            gather: each row's group id is looked up at its (possibly
+            device-sourced) column-0 token, which is exactly the token the
+            host could not know at compose time."""
             tokens, history = _apply_chain(tokens, history, sample_steps, chain_buf, chain_src)
+            logit_mask = None
+            if la_masks is not None:
+                rows = jnp.arange(tokens.shape[0])
+                g = la_groups[rows, tokens[:, 0]]
+                logit_mask = la_masks[rows, g]
             return _step(
                 params, k_cache, v_cache, tokens, positions, block_tables,
                 slot_mapping, last_idx, temperature, top_k, top_p, seeds,
                 sample_steps, freq_pen, pres_pen, pos_limit, history, mrope_delta,
+                mm_embeds, mm_slot_offset, mm_counts, mrope_positions, logit_mask,
                 impl=impl, lp_k=lp_k,
             )
 
@@ -452,6 +477,7 @@ class ModelRunner:
                 logits, kc, vc = self._forward(
                     params, self.cfg, tok[:, None], pos[:, None], kc, vc,
                     block_tables, slot[:, None], zeros, attn_impl=self.attn_impl,
+                    mesh=self.mesh,
                     **mm_kw,
                 )
                 keys = jax.vmap(lambda s, c: jax.random.fold_in(jax.random.PRNGKey(s), c))(seeds, cnt)
@@ -484,22 +510,7 @@ class ModelRunner:
 
         self._multi_step_packed_fn = _multi_step_packed
 
-        @functools.partial(jax.jit, static_argnames=("b", "t", "n", "h", "num_steps"), donate_argnums=(1, 2))
-        def _multi_step_chained(params, k_cache, v_cache, packed, chain_tokens, *, b, t, n, h, num_steps):
-            """Chained decode burst: input tokens come from the previous
-            burst's device-resident output instead of the host (the host
-            never blocks on them — see multi_step_async)."""
-            (_tok, positions, block_tables, _slot, _last,
-             temperature, top_k, top_p, seeds, sample_steps,
-             freq_pen, pres_pen, pos_limit, history, mrope_delta) = _unpack(packed, b, t, n, h)
-            return _multi_step(
-                params, k_cache, v_cache, chain_tokens, positions[:, 0], block_tables,
-                temperature, top_k, top_p, seeds, sample_steps,
-                freq_pen, pres_pen, pos_limit, history, mrope_delta, num_steps=num_steps,
-            )
-
-        self._multi_step_chained_fn = _multi_step_chained
-        self._chain_tokens = None  # device i32[B]: last sampled tokens of the latest burst
+        self._chain_tokens = None  # device i32[Bp] (or [Bp*V]): latest dispatch's samples
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def _write_page(k_cache, v_cache, k, v, pid):
@@ -653,6 +664,16 @@ class ModelRunner:
         if batch.logit_mask is not None:
             lmask = np.ones((bp, batch.logit_mask.shape[1]), bool)
             lmask[: batch.logit_mask.shape[0]] = batch.logit_mask
+        la_m = la_g = None
+        if batch.la_masks is not None:
+            gb, g, vocab = batch.la_masks.shape
+            gp = next_pow2(g)
+            # Pad rows and pad groups are all-True with group id 0: padding
+            # samples stay unconstrained, exactly as on the sync path.
+            la_m = np.ones((bp, gp, vocab), bool)
+            la_m[:gb, :g] = batch.la_masks
+            la_g = np.zeros((bp, vocab), np.int32)
+            la_g[: batch.la_groups.shape[0]] = batch.la_groups
 
         def pad2(a, rows, cols, fill=0):
             out = np.full((rows, cols), fill, a.dtype)
@@ -686,6 +707,8 @@ class ModelRunner:
                          else pad1(batch.mrope_delta, bp)),
             mrope_positions=mrope3,
             logit_mask=lmask,
+            la_masks=la_m,
+            la_groups=la_g,
             num_new=None if batch.num_new is None else pad1(batch.num_new, bp),
             spec_start=None if batch.spec_start is None else pad1(batch.spec_start, bp),
         )
@@ -950,49 +973,6 @@ class ModelRunner:
                 )
             return np.asarray(toks).T[:b_real]  # [B, num_steps]
 
-    @_locked
-    def multi_step_async(self, batch: StepBatch, num_steps: int, *, chain: bool = False) -> "DeviceTokens":
-        """Dispatch a decode burst WITHOUT blocking on its result.
-
-        Returns a :class:`DeviceTokens` handle; ``fetch()`` materializes the
-        sampled tokens on host. With ``chain=True`` the burst's input tokens
-        are the device-resident last tokens of the previous burst (same batch
-        composition required) — the host never ships them, so consecutive
-        bursts pipeline: burst N+1 computes while burst N's tokens stream
-        back. On a remote/tunneled chip this hides the ~100 ms blocking
-        round-trip that would otherwise serialize every burst.
-        """
-        assert batch.tokens.shape[1] == 1, "multi_step is decode-only"
-        b_real = batch.batch_size
-        padded = self._pad(batch)
-        self.last_attn_dispatch = self._attn_dispatch(padded, self.attn_impl)
-        b, t = padded.tokens.shape
-        n = padded.block_tables.shape[1]
-        h = padded.history.shape[1]
-        packed = jnp.asarray(_pack(padded))
-        with timed_dispatch(
-            self.compile_tracker, "multi_step_async", (b, t, n, h, num_steps, chain)
-        ):
-            if chain:
-                assert self._chain_tokens is not None and self._chain_tokens.shape[0] == b, (
-                    "chained burst requires a previous burst with identical padded batch"
-                )
-                toks, self.k_cache, self.v_cache = self._multi_step_chained_fn(
-                    self.params, self.k_cache, self.v_cache, packed, self._chain_tokens,
-                    b=b, t=t, n=n, h=h, num_steps=num_steps,
-                )
-            else:
-                toks, self.k_cache, self.v_cache = self._multi_step_packed_fn(
-                    self.params, self.k_cache, self.v_cache, packed,
-                    b=b, t=t, n=n, h=h, num_steps=num_steps,
-                )
-        self._chain_tokens = toks[num_steps - 1]
-        try:  # start the device->host DMA early; overlaps the next burst
-            toks.copy_to_host_async()
-        except Exception:
-            pass
-        return DeviceTokens(toks, b_real)
-
     def _chain_src_padded(self, chain_src, b_real: int, bp: int) -> np.ndarray:
         """Pad a per-row chain source vector to the batch bucket (-1 = host).
 
@@ -1030,12 +1010,20 @@ class ModelRunner:
         their single real token. Returns a :class:`DeviceStepTokens` handle
         whose ``result()`` blocks on the already-started device->host copy.
 
-        No multimodal embeds / logit masks (those route through the sync
-        :meth:`step`); ``lp_k`` rides along — the aux logprob arrays are
-        fetched with the tokens.
+        Extras the packed i32 buffer has no slots for — multimodal embeds,
+        explicit 3-axis mrope coords, a host-known constraint mask
+        (``logit_mask``, unchained rows only) or the lookahead mask groups
+        (``la_masks``/``la_groups``, chained dispatches) — route through the
+        explicit-args programs; plain text steps keep the single packed
+        transfer. ``lp_k`` rides along — the aux logprob arrays are fetched
+        with the tokens.
         """
-        assert batch.mm_embeds is None and batch.logit_mask is None, (
-            "step_async does not take multimodal/constrained batches"
+        assert batch.la_masks is None or chain, (
+            "lookahead mask groups resolve against the chain gather; "
+            "host-known tokens take logit_mask"
+        )
+        assert batch.logit_mask is None or not chain, (
+            "chained dispatches carry constraint masks as la_masks/la_groups"
         )
         b_real = batch.batch_size
         padded = self._pad(batch)
@@ -1045,15 +1033,27 @@ class ModelRunner:
         n = padded.block_tables.shape[1]
         h = padded.history.shape[1]
         src = self._chain_src_padded(chain_src, b_real, b) if chain else None
+        extras = (
+            padded.mm_embeds is not None or padded.mrope_positions is not None
+            or padded.logit_mask is not None or padded.la_masks is not None
+        )
         with timed_dispatch(
             self.compile_tracker, "step_async",
-            (b, t, n, h, lp_k, chain, impl, self.mesh is not None),
+            (b, t, n, h, lp_k, chain, impl, self.mesh is not None,
+             padded.mm_embeds is not None, padded.logit_mask is not None,
+             padded.la_masks is not None),
         ):
-            if self.mesh is not None:
-                from dynamo_tpu.parallel.sharding import batch_sharding
+            if self.mesh is not None or extras:
+                if self.mesh is not None:
+                    from dynamo_tpu.parallel.sharding import batch_sharding
 
-                def put(a):
-                    return jax.device_put(a, batch_sharding(self.mesh, a.ndim))
+                    def put(a):
+                        return jax.device_put(a, batch_sharding(self.mesh, a.ndim))
+                else:
+                    put = jnp.asarray
+
+                def opt(a):
+                    return None if a is None else put(a)
 
                 explicit = (
                     put(padded.tokens), put(padded.positions),
@@ -1069,11 +1069,17 @@ class ModelRunner:
                     out = self._step_chained_explicit_fn(
                         self.params, self.k_cache, self.v_cache,
                         self._chain_tokens, put(src), *explicit,
+                        opt(padded.mm_embeds), opt(padded.mm_slot_offset),
+                        opt(padded.mm_counts), opt(padded.mrope_positions),
+                        opt(padded.la_masks), opt(padded.la_groups),
                         impl=impl, lp_k=lp_k,
                     )
                 else:
                     out = self._step_fn(
                         self.params, self.k_cache, self.v_cache, *explicit,
+                        opt(padded.mm_embeds), opt(padded.mm_slot_offset),
+                        opt(padded.mm_counts), opt(padded.mrope_positions),
+                        opt(padded.logit_mask),
                         impl=impl, lp_k=lp_k,
                     )
             else:
@@ -1243,24 +1249,9 @@ class InFlightPages:
         return [(k_host[:, i], v_host[:, i]) for i in range(self._n)]
 
 
-class DeviceTokens:
-    """Handle to a dispatched burst's sampled tokens (device-resident)."""
-
-    def __init__(self, toks: jax.Array, b_real: int) -> None:
-        self._toks = toks
-        self._b_real = b_real
-
-    def fetch(self) -> np.ndarray:
-        """Block until the tokens are on host; returns i32[B_real, num_steps]."""
-        return np.asarray(self._toks).T[: self._b_real]
-
-
 class DeviceStepTokens:
     """Handle to a single dispatched decode step's sampled tokens (and
-    optional logprob aux arrays), device-resident (``ModelRunner.step_async``).
-
-    Distinguished from :class:`DeviceTokens` by exposing ``result()`` instead
-    of ``fetch()`` — the engine's harvest helper dispatches on that."""
+    optional logprob aux arrays), device-resident (``ModelRunner.step_async``)."""
 
     def __init__(self, toks: jax.Array, aux, b_real: int) -> None:
         self._toks = toks

@@ -166,6 +166,9 @@ class WorkerSpec:
                 env_flag(os.environ, "DYN_OVERLAP_SPEC", default=True)
                 and env_flag(os.environ, "DYN_WORKER_OVERLAP_SPEC", default=True)
             ),
+            constraint_lookahead_tokens=int(
+                os.environ.get("DYN_CONSTRAINT_LOOKAHEAD_TOKENS", "32")
+            ),
         )
         defaults.update(engine_kw)
         return EngineConfig(**defaults)

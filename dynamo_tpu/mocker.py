@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import os
 import time
+from types import SimpleNamespace
 
 import numpy as np
 
@@ -57,6 +58,10 @@ class MockRunner:
         self.num_pages = num_pages
         self.page_size = page_size
         self.vocab_size = vocab_size
+        # Constrained (JSON-mode) decode reads ``runner.cfg.vocab_size``
+        # when sizing token-mask caches and lookahead banks; this minimal
+        # model-config shim keeps the mock API-compatible there.
+        self.cfg = SimpleNamespace(vocab_size=vocab_size)
         self.prefill_us_per_token = prefill_us_per_token
         self.decode_us_base = decode_us_base
         self.decode_us_per_seq = decode_us_per_seq

@@ -163,10 +163,25 @@ class EngineMetrics:
             "Overlap barrier steps by the condition that forced them: "
             "'cancel'/'drain' (in-flight state invalidated), 'spec' (verify "
             "harvest or DYN_OVERLAP_SPEC off), 'prefill' (whole-prompt XOR "
-            "mode), 'constraint'/'mm'/'multistep'/'runner' (composition the "
-            "graph cannot absorb), 'pages' (lookahead page reservation "
-            "failed), 'fill'/'idle' (nothing to chain)",
+            "mode), 'constraint' (lookahead disabled), 'constraint_miss' "
+            "(mask-cache miss or successor fan-out over the lookahead cap), "
+            "'runner' (runner cannot chain), 'pages' (lookahead page "
+            "reservation failed), 'fill'/'idle' (nothing to chain)",
             ["worker", "reason"], registry=self.registry,
+        )
+        # Constrained-decode lookahead mask cache (DYN_CONSTRAINT_LOOKAHEAD_
+        # TOKENS): hit/miss totals synced from the engine's TokenMaskCache on
+        # scrape. The miss rate is the live predictor of 'constraint_miss'
+        # barriers — a hot grammar converges to ~100% hits after warm-up.
+        self.constraint_mask_cache_hits = gauge(
+            f"{ns}_constraint_mask_cache_hits_total",
+            "Constrained-decode token-mask cache hits (mask reused for a "
+            "machine-state summary already built)",
+        )
+        self.constraint_mask_cache_misses = gauge(
+            f"{ns}_constraint_mask_cache_misses_total",
+            "Constrained-decode token-mask cache misses (mask built by "
+            "scanning the vocabulary for a new machine-state summary)",
         )
         # Async tier onboarding (DYN_ASYNC_ONBOARD / DYN_CACHE_AWARE):
         # per-tier landed page counts are clear-then-set labelled gauges
@@ -343,6 +358,8 @@ class EngineMetrics:
             self._overlap_barriers.clear()
             for reason, n in barrier_counts.items():
                 self._overlap_barriers.labels(self.worker, reason).set(n)
+        self.constraint_mask_cache_hits.set(getattr(core, "constraint_mask_cache_hits", 0))
+        self.constraint_mask_cache_misses.set(getattr(core, "constraint_mask_cache_misses", 0))
         onboard_counts = getattr(core, "onboard_page_counts", None)
         if onboard_counts is not None:
             self._onboard_pages.clear()

@@ -200,6 +200,9 @@ def test_overlap_probe_structure(monkeypatch):
     monkeypatch.setenv("BENCH_OVERLAP_MIXED_ISL", "96")
     monkeypatch.setenv("BENCH_OVERLAP_MIXED_OSL", "16")
     monkeypatch.setenv("BENCH_OVERLAP_MIXED_CHUNK", "32")
+    monkeypatch.setenv("BENCH_OVERLAP_JSON_DECODERS", "2")
+    monkeypatch.setenv("BENCH_OVERLAP_JSON_ISL", "16")
+    monkeypatch.setenv("BENCH_OVERLAP_JSON_OSL", "24")
     out = bench.probe_engine_overlap()
     assert out["decoders"] == 2 and out["osl"] == 24
     for mode in ("sync", "overlap"):
@@ -228,6 +231,24 @@ def test_overlap_probe_structure(monkeypatch):
     assert out["overlap_chained_frac"] == mo["overlap_chained_frac"]
     assert out["overlap_chained_frac"] >= 0.9  # the ISSUE 11 acceptance bar
     assert out["engine_overlap_mixed_itl_gain"] > 0.0
+    # Constrained variant (ISSUE 14): JSON-mode rows chain through the mask
+    # lookahead instead of barriering every step, streams stay identical,
+    # and the residual barriers are not constraint-shaped.
+    con = out["constrained"]
+    assert con["bit_identical"] is True
+    base, la = con["no_lookahead"], con["lookahead_on"]
+    for key in ("mode", "elapsed_s", "itl_mean_ms", "overlap_steps",
+                "barrier_reasons", "overlap_barrier_frac",
+                "mask_cache_hits", "mask_cache_misses"):
+        assert key in base and key in la, f"constrained run missing {key}"
+    assert base["overlap_steps"]["overlapped"] == 0
+    assert base["barrier_reasons"].get("constraint", 0) > 0
+    assert la["overlap_steps"]["overlapped"] > 0
+    assert la["barrier_reasons"].get("constraint", 0) == 0
+    assert la["overlap_barrier_frac"] < base["overlap_barrier_frac"] == 1.0
+    assert out["overlap_barrier_frac"] == la["overlap_barrier_frac"]
+    assert out["overlap_constrained_itl_gain"] > 0.0
+    assert la["mask_cache_hits"] > 0
 
 
 def test_bench_doc_goodput_keys():
@@ -277,7 +298,8 @@ def test_bench_doc_goodput_keys():
     # Overlapped-execution headline keys (ISSUE 10) surface from the probe.
     ov = {"engine_overlap_itl_gain": 1.7523, "device_idle_frac": 0.0508,
           "bit_identical": True, "overlap_chained_frac": 0.9412,
-          "engine_overlap_mixed_itl_gain": 1.31}
+          "engine_overlap_mixed_itl_gain": 1.31,
+          "overlap_constrained_itl_gain": 1.654, "overlap_barrier_frac": 0.115}
     doc6 = bench.build_doc(configs, pull={}, overlap=ov)
     assert doc6["engine_overlap_itl_gain"] == 1.7523
     assert doc6["device_idle_frac"] == 0.0508
@@ -285,6 +307,11 @@ def test_bench_doc_goodput_keys():
     assert doc6["overlap_chained_frac"] == 0.9412
     assert doc6["engine_overlap_mixed_itl_gain"] == 1.31
     assert doc5["overlap_chained_frac"] == 0.0  # probe absent: stable default
+    # Chained constrained decode headline keys (ISSUE 14).
+    assert doc6["overlap_constrained_itl_gain"] == 1.654
+    assert doc6["overlap_barrier_frac"] == 0.115
+    assert doc5["overlap_constrained_itl_gain"] == 0.0  # probe absent
+    assert doc5["overlap_barrier_frac"] == 0.0
     assert doc6["detail"]["engine_overlap_probe"] == ov
     # An all-errors suite still emits the full key set.
     empty = bench.build_doc([{"preset": "x", "error": "boom"}], pull={})

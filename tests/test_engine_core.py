@@ -263,9 +263,12 @@ def test_multi_step_decode_odd_max_tokens():
 
 
 def test_pipelined_decode_midstream_admission():
-    # A request admitted while a chained burst is in flight must drain the
-    # pipeline cleanly; both sequences still match the greedy reference.
-    core = make_core_multi(decode_steps=4)
+    # A request admitted while a chained burst is in flight must be absorbed
+    # cleanly (the overlap pipeline re-plans composition per step); both
+    # sequences still match the greedy reference. decode_steps>1 pipelining
+    # is served by the overlap path since the standalone burst pipeline
+    # was folded into it.
+    core = make_core_multi(decode_steps=4, overlap=True)
     p1, p2 = [1, 2, 3, 4, 5], [9, 8, 7]
     core.add_request(greedy_request(p1, max_tokens=12))
     # Fill the pipeline (prefill step + first dispatched burst + one chained).
@@ -281,7 +284,7 @@ def test_pipelined_decode_midstream_admission():
 
 
 def test_pipelined_decode_cancellation_inflight():
-    core = make_core_multi(decode_steps=4)
+    core = make_core_multi(decode_steps=4, overlap=True)
     ctx1, ctx2 = Context(), Context()
     core.add_request(greedy_request([1, 2, 3], max_tokens=40), ctx1)
     core.add_request(greedy_request([4, 5, 6], max_tokens=40), ctx2)

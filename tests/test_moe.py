@@ -81,10 +81,15 @@ def test_moe_forward_sharded_ep_matches_single_device():
         kc, vc = llama.init_kv_cache(CFG, num_pages=8, page_size=ps)
         logits, _, _ = llama.forward(
             p, CFG, tokens, positions, kc, vc, tables, slots, last,
-            attn_impl="reference",
+            attn_impl="reference", mesh=mesh,
         )
         return logits
 
+    # The mesh must be threaded exactly as the serving runner does: it is
+    # what routes _mlp_moe onto the capacity dispatch under an ep axis. The
+    # dropless ragged_dot path is NOT ep-shardable — GSPMD mis-partitions the
+    # group axis when the expert weights are sharded, producing wrong logits
+    # rather than an error (max abs diff ~1.3 on this tiny config).
     want = np.asarray(fwd(PARAMS))
     placed = shard_params(PARAMS, mesh)
     got = np.asarray(jax.jit(fwd)(placed))

@@ -137,6 +137,88 @@ def test_text_row_with_placeholder_id_unaffected_by_mm_batchmate():
     assert seq_text.tokens[len(text_prompt):] == solo.tokens[len(text_prompt):]
 
 
+def test_mm_overlap_chained_decode_bit_identical():
+    """Multimodal rows ride the chained pipeline: overlap on/off must be
+    token-identical with the pipeline actually engaged. mm_embeds only feed
+    prefill chunks; chained decode of an mm row is plain decode, so there is
+    no 'mm' barrier reason anymore — assert it stayed dead."""
+    params = llama.init_params(CFG, 0)
+    mm = np.random.default_rng(7).standard_normal((2, CFG.hidden_size)).astype(np.float32)
+
+    def run(overlap):
+        core = _core(params, overlap=overlap, chunk_prefill_tokens=4, max_seq_len=64)
+        seqs = [
+            core.add_request(PreprocessedRequest(
+                token_ids=[5, 6, IMG, IMG, 9, 10, 11, 12],
+                sampling=SamplingOptions(temperature=0.0),
+                stop=StopConditions(max_tokens=10, ignore_eos=True),
+                mm_inputs=_mm_payload(mm),
+            )),
+            core.add_request(PreprocessedRequest(
+                token_ids=[3, 4, 5, 6],
+                sampling=SamplingOptions(temperature=0.8, seed=11),
+                stop=StopConditions(max_tokens=8, ignore_eos=True),
+            )),
+        ]
+        while core.has_work:
+            core.step()
+        return [s.tokens for s in seqs], core
+
+    base, _ = run(False)
+    over, core = run(True)
+    assert over == base
+    assert core.overlap_step_counts["overlapped"] > 0
+    assert "mm" not in core.overlap_barrier_counts
+    assert core.allocator.stats().active_pages == 0
+
+
+def test_mrope_overlap_chained_decode_bit_identical():
+    """M-RoPE chained decode: the 3-axis positions of a chained token are
+    derived in-graph (pos + per-row mrope delta on all three axes), so an
+    image request on an M-RoPE model must decode through the overlapped
+    pipeline bit-identically to the sync loop."""
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        PRESETS["test-tiny"], mrope_section=(2, 3, 3), image_token_id=250,
+    )
+    params = llama.init_params(cfg, 3)
+    runner = ModelRunner(cfg, params, num_pages=64, page_size=4, max_batch_size=4)
+    mm = np.random.default_rng(9).standard_normal((4, cfg.hidden_size)).astype(np.float32)
+    payload = {**_mm_payload(mm), "grids": [[1, 4, 4]]}  # 4 merged placeholders
+
+    def run(overlap):
+        core = EngineCore(runner, EngineConfig(
+            num_pages=64, page_size=4, max_batch_size=4, max_seq_len=64,
+            chunk_prefill_tokens=4, enable_prefix_caching=False, overlap=overlap,
+        ))
+        seqs = [
+            core.add_request(PreprocessedRequest(
+                token_ids=[5, 6, 250, 250, 250, 250, 9, 10],
+                sampling=SamplingOptions(temperature=0.0),
+                stop=StopConditions(max_tokens=10, ignore_eos=True),
+                mm_inputs=payload,
+            )),
+            core.add_request(PreprocessedRequest(
+                token_ids=[3, 4, 5, 6, 7, 8],
+                sampling=SamplingOptions(temperature=0.7, seed=13, logprobs=2),
+                stop=StopConditions(max_tokens=8, ignore_eos=True),
+            )),
+        ]
+        lps = {s.seq_id: [] for s in seqs}
+        while core.has_work:
+            for seq, out in core.step():
+                if out.logprobs:
+                    lps[seq.seq_id].extend(out.logprobs)
+        return [(s.tokens, lps[s.seq_id]) for s in seqs], core
+
+    base, _ = run(False)
+    over, core = run(True)
+    assert over == base
+    assert core.overlap_step_counts["overlapped"] > 0
+    assert core.allocator.stats().active_pages == 0
+
+
 def test_malformed_mm_inputs_fail_only_that_request():
     params = llama.init_params(CFG, 0)
     core = _core(params)

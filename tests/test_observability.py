@@ -140,6 +140,8 @@ class _FakeCore:
     step_gap_ms_count = 8
     overlap_step_counts = {"overlapped": 6, "barrier": 2}
     overlap_barrier_counts = {"spec": 1, "drain": 1}
+    constraint_mask_cache_hits = 11
+    constraint_mask_cache_misses = 3
     waiting = ["a"]
     running = ["b", "c"]
     prefilling = ["d"]
@@ -205,6 +207,8 @@ EXPECTED_ENGINE_FAMILIES = {
     "dynamo_engine_step_gap_ms_mean",
     "dynamo_engine_overlap_steps_total",
     "dynamo_engine_overlap_barrier_total",
+    "dynamo_engine_constraint_mask_cache_hits_total",
+    "dynamo_engine_constraint_mask_cache_misses_total",
     "dynamo_engine_admission_queue_depth",
     "dynamo_engine_prefix_onboard_pages_total",
     "dynamo_engine_prefix_onboard_shortfall_pages_total",
@@ -257,6 +261,8 @@ async def test_engine_metrics_names_labels_and_values():
     assert 'dynamo_engine_overlap_steps_total{mode="barrier",worker="w1"} 2.0' in text
     assert 'dynamo_engine_overlap_barrier_total{reason="spec",worker="w1"} 1.0' in text
     assert 'dynamo_engine_overlap_barrier_total{reason="drain",worker="w1"} 1.0' in text
+    assert 'dynamo_engine_constraint_mask_cache_hits_total{worker="w1"} 11.0' in text
+    assert 'dynamo_engine_constraint_mask_cache_misses_total{worker="w1"} 3.0' in text
     assert 'dynamo_engine_pages_active{worker="w1"} 40.0' in text
     assert 'dynamo_engine_page_utilization_ratio{worker="w1"} 0.625' in text
     # fragmentation = cached / (free + cached) = 8 / 24
@@ -326,8 +332,27 @@ def test_env_knobs_documented():
     generated = check_env_knobs.generated_knobs()
     documented = check_env_knobs.documented_knobs()
     assert "DYN_OVERLAP" in source and "DYN_WORKER_OVERLAP" in generated
+    assert "DYN_CONSTRAINT_LOOKAHEAD_TOKENS" in source
     assert len(source | generated) > 40
     assert check_env_knobs.check(source, generated, prefixes, documented) == []
+
+
+def test_barrier_reasons_synced():
+    """Invokes the tools/ barrier-vocabulary gate (ISSUE 14 satellite): the
+    BARRIER_REASONS tuple, the _note_barrier call sites, and the
+    SCHEDULER.md barrier table must agree exactly."""
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "tools"))
+    try:
+        import check_barrier_reasons
+    finally:
+        sys.path.pop(0)
+    declared = check_barrier_reasons.declared_reasons()
+    recorded = check_barrier_reasons.recorded_reasons()
+    documented = check_barrier_reasons.documented_reasons()
+    assert "constraint_miss" in declared and "multistep" not in declared
+    assert "mm" not in declared
+    assert len(documented) == len(declared) > 5
+    assert check_barrier_reasons.check(declared, recorded, documented) == []
 
 
 # -- timeline assembly --------------------------------------------------------

@@ -403,11 +403,11 @@ def test_budget_clamped_final_token_chains(preset):
     assert core.allocator.stats().active_pages == 0
 
 
-def test_multistep_burst_keeps_its_own_pipeline_under_overlap():
-    """overlap + decode_steps>1: the fused burst already amortizes the
-    round trip, so the chained loop stands down (reason 'multistep') and
-    the burst pipeline keeps its own in-flight handle — bit-identically,
-    admission drains included."""
+def test_multistep_rides_the_chained_pipeline_under_overlap():
+    """overlap + decode_steps>1: the burst is served as K chained
+    sub-dispatches inside the unified pipeline (no 'multistep' barrier
+    exists anymore) — bit-identically vs the sync fused burst, admission
+    drains included, and with the sub-steps counted as chained rows."""
     reqs = lambda: [  # noqa: E731
         PreprocessedRequest(
             token_ids=[5, 7, 5, 7, 9, 11],
@@ -422,11 +422,131 @@ def test_multistep_burst_keeps_its_own_pipeline_under_overlap():
     ]
     base_tok, _ = run_all(make_core(decode_steps=4), reqs())
     core = make_core(overlap=True, decode_steps=4)
-    over_tok, _ = run_all(core, reqs())
+    over_tok = {}
+    for req in reqs():
+        over_tok[core.add_request(req).seq_id] = []
+    max_chained = 0
+    for _ in range(400):
+        if not core.has_work:
+            break
+        for seq, out in core.step():
+            over_tok[seq.seq_id].extend(out.token_ids)
+        max_chained = max(max_chained, core.last_step_info.get("chained_rows", 0))
+    assert not core.has_work
     assert over_tok == base_tok
-    assert core.overlap_step_counts["overlapped"] == 0
-    assert core.overlap_barrier_counts.get("multistep", 0) > 0
+    assert core.overlap_step_counts["overlapped"] > 0
+    assert "multistep" not in core.overlap_barrier_counts
+    # A burst step reports its sub-dispatches as chained rows: with 2 rows
+    # and decode_steps=4 some step must chain more rows than the batch has.
+    assert max_chained > 2
     assert core.allocator.stats().active_pages == 0
+
+
+def test_multistep_chained_burst_deep_parity():
+    """decode_steps sweep: the chained burst path must replay the sync
+    fused burst token-for-token at several depths, including depths that
+    overshoot the rows' budgets (the clamp keeps every sub-step real)."""
+    vocab = PRESETS["test-tiny"].vocab_size
+    reqs = lambda: [  # noqa: E731
+        PreprocessedRequest(
+            token_ids=[i % (vocab - 2) + 1 for i in range(7)],
+            sampling=SamplingOptions(temperature=0.8, seed=3),
+            stop=StopConditions(max_tokens=17, ignore_eos=True),
+        ),
+        PreprocessedRequest(
+            token_ids=[2, 4, 6],
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=5, ignore_eos=True),  # clamps k
+        ),
+        PreprocessedRequest(
+            token_ids=[9, 9, 1, 1],
+            sampling=SamplingOptions(temperature=0.5, seed=11),
+            stop=StopConditions(max_tokens=13, ignore_eos=True),
+        ),
+    ]
+    base_tok, _ = run_all(make_core(), reqs())
+    for k in (2, 8):
+        over_tok, _ = run_all(make_core(overlap=True, decode_steps=k), reqs())
+        assert over_tok == base_tok, f"decode_steps={k} diverged"
+
+
+# -- chained constrained (JSON-mode) decode ----------------------------------
+
+
+def _json_core(*, overlap, chunk=16, **cfg_kw):
+    from dynamo_tpu.tokenizer import ByteTokenizer
+
+    core = make_core(overlap=overlap, chunk=chunk, **cfg_kw)
+    core.set_constraint_tokenizer(ByteTokenizer())
+    return core
+
+
+def _json_reqs(max_tokens=24):
+    from dynamo_tpu.tokenizer import ByteTokenizer
+
+    prompt = ByteTokenizer().encode("data: ", add_bos=False)
+    return [
+        PreprocessedRequest(
+            token_ids=prompt,
+            sampling=SamplingOptions(temperature=0.8, seed=1, json_mode=True),
+            stop=StopConditions(max_tokens=max_tokens),
+        ),
+        # Plain greedy row sharing every batch with the constrained rows.
+        PreprocessedRequest(
+            token_ids=[5, 7, 9, 11],
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=10, ignore_eos=True),
+        ),
+        PreprocessedRequest(
+            token_ids=prompt + prompt,
+            sampling=SamplingOptions(
+                temperature=0.7, seed=9, json_mode=True, logprobs=2
+            ),
+            stop=StopConditions(max_tokens=max_tokens),
+        ),
+    ]
+
+
+@pytest.mark.parametrize("chunk", [16, 0])
+def test_constrained_chained_decode_bit_identical(chunk):
+    """JSON-mode rows ride the chained pipeline (lookahead mask groups
+    resolve in-graph against the chained token) bit-identically — tokens
+    AND logprobs — vs the sync masked loop, chunked and legacy prefill."""
+    base_tok, base_lp = run_all(_json_core(overlap=False, chunk=chunk), _json_reqs())
+    core = _json_core(overlap=True, chunk=chunk)
+    over_tok, over_lp = run_all(core, _json_reqs())
+    assert over_tok == base_tok
+    assert over_lp == base_lp
+    assert core.overlap_step_counts["overlapped"] > 0
+    # With the lookahead enabled "constraint" never fires; residual cold
+    # summaries surface as (self-curing) constraint_miss barriers instead.
+    assert "constraint" not in core.overlap_barrier_counts
+    assert core.constraint_mask_cache_hits > 0
+    assert core.allocator.stats().active_pages == 0
+
+
+def test_constrained_chained_forced_close_near_budget():
+    """Tight max_tokens: budget_to_close force-closing must kick in at the
+    same steps under overlap (the plan's successor masks are built at the
+    row's post-emit remaining), keeping streams identical to the end."""
+    for mt in (6, 9, 12):
+        base_tok, base_lp = run_all(_json_core(overlap=False), _json_reqs(mt))
+        core = _json_core(overlap=True)
+        over_tok, over_lp = run_all(core, _json_reqs(mt))
+        assert over_tok == base_tok, f"max_tokens={mt} diverged"
+        assert over_lp == base_lp, f"max_tokens={mt} logprobs diverged"
+
+
+def test_constraint_lookahead_disabled_barriers_every_step():
+    """DYN_CONSTRAINT_LOOKAHEAD_TOKENS=0: constrained rows barrier with
+    reason 'constraint' (the bench baseline) — still bit-identical."""
+    base_tok, base_lp = run_all(_json_core(overlap=False), _json_reqs())
+    core = _json_core(overlap=True, constraint_lookahead_tokens=0)
+    over_tok, over_lp = run_all(core, _json_reqs())
+    assert over_tok == base_tok
+    assert over_lp == base_lp
+    assert core.overlap_barrier_counts.get("constraint", 0) > 0
+    assert "constraint_miss" not in core.overlap_barrier_counts
 
 
 def test_overlap_off_never_touches_async_path(monkeypatch):
@@ -568,6 +688,21 @@ def test_launch_resolves_dyn_overlap(monkeypatch):
     assert WorkerSpec._engine_cfg(card, {}).overlap is True
     monkeypatch.setenv("DYN_OVERLAP_SPEC", "0")
     assert WorkerSpec._engine_cfg(card, {}).overlap_spec is False
+
+
+def test_launch_resolves_constraint_lookahead(monkeypatch):
+    from dynamo_tpu.launch import WorkerSpec
+    from dynamo_tpu.model_card import ModelDeploymentCard
+
+    card = ModelDeploymentCard(
+        name="test-tiny", context_length=256, kv_page_size=PAGE, eos_token_ids=[2],
+    )
+    monkeypatch.delenv("DYN_CONSTRAINT_LOOKAHEAD_TOKENS", raising=False)
+    assert WorkerSpec._engine_cfg(card, {}).constraint_lookahead_tokens == 32
+    monkeypatch.setenv("DYN_CONSTRAINT_LOOKAHEAD_TOKENS", "0")
+    assert WorkerSpec._engine_cfg(card, {}).constraint_lookahead_tokens == 0
+    monkeypatch.setenv("DYN_CONSTRAINT_LOOKAHEAD_TOKENS", "64")
+    assert WorkerSpec._engine_cfg(card, {}).constraint_lookahead_tokens == 64
 
 
 def test_worker_settings_overlap_field(monkeypatch):

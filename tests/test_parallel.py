@@ -108,6 +108,50 @@ def test_sharded_engine_overlap_bit_identical():
     assert base[0] == greedy_reference([1, 2, 3, 4, 5], 6)
 
 
+@pytest.mark.tpu_8
+def test_sharded_engine_spec_overlap_bit_identical():
+    """spec_k>0 + DYN_OVERLAP=1 on the mesh runner: the async verify
+    (spec_step_async) dispatches through the same batch-sharded put path as
+    the plain chained step, so overlapped speculation on a dp×tp mesh must
+    stay token-identical to the non-speculative synchronous sharded engine
+    — with both speculation and the pipeline actually engaged."""
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest, SamplingOptions, StopConditions,
+    )
+
+    mesh = make_mesh(MeshPlan(dp=4, tp=2), jax.devices())
+    runner = ModelRunner(
+        CFG, PARAMS, num_pages=64, page_size=PAGE, max_batch_size=8,
+        prefill_bucket=16, attn_impl="reference", mesh=mesh,
+    )
+
+    def reqs():
+        return [  # periodic prompts so the prompt-lookup drafter engages
+            greedy_request([5, 7, 5, 7, 5, 7, 9, 11], max_tokens=12, ignore_eos=True),
+            PreprocessedRequest(
+                token_ids=[2, 4, 2, 4, 2, 4, 6, 8],
+                sampling=SamplingOptions(temperature=0.7, seed=21, logprobs=2),
+                stop=StopConditions(max_tokens=10, ignore_eos=True),
+            ),
+        ]
+
+    def run(overlap, spec_k):
+        core = EngineCore(runner, EngineConfig(
+            num_pages=64, page_size=PAGE, max_batch_size=8, max_seq_len=128,
+            chunk_prefill_tokens=8, overlap=overlap, spec_k=spec_k,
+        ))
+        for r in reqs():
+            core.add_request(r)
+        return run_to_completion(core), core
+
+    base, _ = run(False, 0)
+    over, core = run(True, 3)
+    assert over == base
+    assert core.spec_tokens_proposed > 0  # speculation engaged...
+    assert core.overlap_step_counts["overlapped"] > 0  # ...and still pipelined
+    assert core.allocator.stats().active_pages == 0
+
+
 def test_mrope_forward_sharded_matches_single_device():
     """Qwen2-VL M-RoPE shards like everything else: the same 3D-rope
     forward under a dp*tp mesh reproduces the single-device logits (the

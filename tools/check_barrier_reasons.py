@@ -1,0 +1,109 @@
+"""Barrier-reason vocabulary check: the overlap pipeline's barrier reasons
+live in three places that have drifted before — the ``BARRIER_REASONS``
+tuple in ``engine/core.py`` (the source of truth the metrics plane labels
+with), the literal reason strings the engine actually records
+(``_note_barrier(...)`` call sites and ``_overlap_route``'s returns), and
+the reason table in ``docs/SCHEDULER.md`` that operators read.
+
+This gate pins all three to each other:
+
+- every literal reason the source records must be in ``BARRIER_REASONS``
+  (a typo'd reason would mint an undocumented metric label), and every
+  tuple entry must be recordable from some call site (a dead entry means a
+  barrier was erased but its vocabulary row lingers);
+- the SCHEDULER.md barrier table must list exactly ``BARRIER_REASONS``.
+
+Run directly (``python tools/check_barrier_reasons.py``) or via the test
+suite (``tests/test_observability.py``).
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+
+#: Literal reason strings the engine can record: explicit _note_barrier
+#: calls, and the (False, "reason") routing returns that _step_locked
+#: forwards into _note_barrier.
+_NOTE_CALL = re.compile(r"_note_barrier\(\s*\"([a-z_]+)\"\s*\)")
+_ROUTE_RETURN = re.compile(r"return\s+False,\s*\"([a-z_]+)\"")
+#: SCHEDULER.md barrier-table rows: | `reason` | description |
+_DOC_ROW = re.compile(r"^\|\s*`([a-z_]+)`\s*\|", re.MULTILINE)
+#: The default reason when a barrier step recorded nothing (core.step()'s
+#: ``or "idle"`` fallback — not a literal _note_barrier site).
+_IMPLICIT = {"idle"}
+
+
+def _repo_root() -> pathlib.Path:
+    return pathlib.Path(__file__).resolve().parent.parent
+
+
+def declared_reasons() -> tuple[str, ...]:
+    from dynamo_tpu.engine.core import BARRIER_REASONS
+
+    return tuple(BARRIER_REASONS)
+
+
+def recorded_reasons(root: pathlib.Path | None = None) -> set[str]:
+    src = ((root or _repo_root()) / "dynamo_tpu" / "engine" / "core.py").read_text()
+    return set(_NOTE_CALL.findall(src)) | set(_ROUTE_RETURN.findall(src)) | _IMPLICIT
+
+
+def documented_reasons(root: pathlib.Path | None = None) -> list[str]:
+    doc = ((root or _repo_root()) / "docs" / "SCHEDULER.md").read_text()
+    return _DOC_ROW.findall(doc)
+
+
+def check(declared: tuple[str, ...], recorded: set[str],
+          documented: list[str]) -> list[str]:
+    problems: list[str] = []
+    decl = set(declared)
+    if len(decl) != len(declared):
+        problems.append(f"BARRIER_REASONS has duplicate entries: {declared}")
+    for r in sorted(recorded - decl):
+        problems.append(
+            f"core.py records barrier reason {r!r} missing from BARRIER_REASONS"
+        )
+    for r in sorted(decl - recorded):
+        problems.append(
+            f"BARRIER_REASONS entry {r!r} is never recorded by any "
+            "_note_barrier call site (erased barrier with a lingering row?)"
+        )
+    docset = set(documented)
+    if len(docset) != len(documented):
+        dupes = sorted({r for r in documented if documented.count(r) > 1})
+        problems.append(f"SCHEDULER.md barrier table has duplicate rows: {dupes}")
+    for r in sorted(docset - decl):
+        problems.append(
+            f"SCHEDULER.md documents barrier reason {r!r} that BARRIER_REASONS "
+            "does not declare (renamed or removed?)"
+        )
+    for r in sorted(decl - docset):
+        problems.append(
+            f"BARRIER_REASONS entry {r!r} is missing from the SCHEDULER.md "
+            "barrier table"
+        )
+    return problems
+
+
+def main() -> int:
+    declared = declared_reasons()
+    recorded = recorded_reasons()
+    documented = documented_reasons()
+    problems = check(declared, recorded, documented)
+    if problems:
+        for p in problems:
+            print(f"FAIL: {p}", file=sys.stderr)
+        return 1
+    print(
+        f"ok: {len(declared)} barrier reasons — BARRIER_REASONS, the "
+        "_note_barrier call sites, and the SCHEDULER.md table all agree"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    # Direct CLI use from a checkout: make the repo importable.
+    sys.path.insert(0, str(_repo_root()))
+    sys.exit(main())
