@@ -971,6 +971,16 @@ def probe_engine_overlap() -> dict:
         elapsed = time.perf_counter() - t0
         counts = dict(core.overlap_step_counts)
         armed = sum(counts.values())
+        # Time-loss ledger coverage (ISSUE 15): the per-cause accounting
+        # must explain nearly all non-compute wall (step wall + inter-step
+        # gap - device dispatch). Queue/admission waits are pre-step and
+        # excluded from the step-side comparison.
+        lost = dict(core.lost_time_ms)
+        noncompute = max(
+            0.0,
+            core.step_wall_ms_total + core.step_gap_ms_sum - core.step_dispatch_ms_total,
+        )
+        step_lost = sum(v for k, v in lost.items() if k not in ("queue", "admission"))
         return {
             "mode": "overlap" if overlap_on else "sync",
             "elapsed_s": round(elapsed, 4),
@@ -980,6 +990,10 @@ def probe_engine_overlap() -> dict:
             "overlap_chained_frac": round(
                 counts.get("overlapped", 0) / armed, 4
             ) if armed else 0.0,
+            "lost_time_ms": {k: round(v, 3) for k, v in sorted(lost.items())},
+            "noncompute_wall_ms": round(noncompute, 3),
+            "loss_coverage_frac": round(
+                min(1.0, step_lost / noncompute), 4) if noncompute > 0 else 1.0,
         }, tokens
 
     # Constrained-traffic variant (ISSUE 14): JSON-mode rows under overlap.
@@ -1081,6 +1095,7 @@ def probe_engine_overlap() -> dict:
             "bit_identical": m_sync_tokens == m_overlap_tokens,
         },
         "overlap_chained_frac": m_overlap["overlap_chained_frac"],
+        "loss_coverage_frac": m_overlap["loss_coverage_frac"],
         "engine_overlap_mixed_itl_gain": round(
             m_sync["itl_mean_ms"] / m_overlap["itl_mean_ms"], 4
         ) if m_overlap["itl_mean_ms"] > 0 else 0.0,
@@ -1358,6 +1373,9 @@ def build_doc(configs, pull, wire=None, stall=None, spec=None,
         "overlap_chained_frac": (overlap or {}).get("overlap_chained_frac", 0.0),
         "engine_overlap_mixed_itl_gain": (overlap or {}).get(
             "engine_overlap_mixed_itl_gain", 0.0),
+        # Attribution headline key (ISSUE 15): fraction of non-compute wall
+        # in the mixed overlap probe explained by the time-loss ledger.
+        "loss_coverage_frac": (overlap or {}).get("loss_coverage_frac", 0.0),
         # Chained constrained decode headline keys (ISSUE 14): ITL ratio of
         # lookahead-off over lookahead-on JSON-mode traffic under overlap
         # (both bit-identical streams), and the lookahead-on run's residual

@@ -225,6 +225,40 @@ class FleetSettings:
     metrics_poll_s: float = 1.0  # federated /metrics scrape cadence
 
 
+@dataclasses.dataclass
+class AnomalySettings:
+    """Anomaly-sentinel knobs (``dynamo_tpu/observability/anomaly``).
+
+    Rolling-window detectors over the engine step stream; conservative
+    defaults (warm-up floors + absolute thresholds on top of the relative
+    ratios) so a quiet fleet never false-positives. Env: ``DYN_ANOMALY_*``,
+    TOML: ``[anomaly]``.
+    """
+
+    enable: bool = True
+    window: int = 64  # rolling detector window (steps)
+    min_samples: int = 256  # baseline steps required before relative detectors arm
+    ratio: float = 3.0  # window-vs-baseline ratio that counts as a spike/drop
+    barrier_frac: float = 0.5  # absolute window barrier fraction floor
+    gap_floor_ms: float = 50.0  # absolute window mean step-gap floor
+    recompile_storm: int = 8  # new-shape compiles within one window
+    shortfall_pages: int = 32  # onboard shortfall pages within one window
+    clear_after: int = 64  # quiet steps before an active anomaly clears
+
+
+@dataclasses.dataclass
+class AttribSettings:
+    """Latency-attribution knobs (``dynamo_tpu/observability/attribution``).
+
+    Env: ``DYN_ATTRIB_*``, TOML: ``[attrib]``.
+    """
+
+    # |unattributed| / e2e above this marks the explain budget incomplete.
+    tolerance_frac: float = 0.1
+    # Cap on flight STEP records each worker returns per explain query.
+    max_steps: int = 2048
+
+
 def load_runtime_settings(**kw) -> RuntimeSettings:
     return load_config(RuntimeSettings(), section="runtime", **kw)
 
@@ -251,3 +285,11 @@ def load_cache_aware_settings(**kw) -> CacheAwareSettings:
 
 def load_fleet_settings(**kw) -> FleetSettings:
     return load_config(FleetSettings(), section="fleet", **kw)
+
+
+def load_anomaly_settings(**kw) -> AnomalySettings:
+    return load_config(AnomalySettings(), section="anomaly", **kw)
+
+
+def load_attrib_settings(**kw) -> AttribSettings:
+    return load_config(AttribSettings(), section="attrib", **kw)

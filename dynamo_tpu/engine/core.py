@@ -322,6 +322,22 @@ class EngineCore:
         _tracker = getattr(runner, "compile_tracker", None)
         if _tracker is not None:
             _tracker.bind_sink(self.flight.record)
+        # Time-loss accounting (attribution plane): cumulative ms charged per
+        # cause (the pinned attribution.LOSS_CAUSES vocabulary — barrier
+        # reasons + queue/admission/onboard_stall/preempt/recompile/gap),
+        # exported as dynamo_engine_lost_time_seconds_total{cause}. The
+        # step-time totals let consumers compute non-compute wall time
+        # (wall + gap - dispatch) and hence the unattributed residual.
+        self.lost_time_ms: dict[str, float] = {}
+        self.step_wall_ms_total = 0.0
+        self.step_dispatch_ms_total = 0.0
+        self._recompile_events_seen = 0  # tracker events already charged
+        self.recompile_count = 0  # cumulative new_shape events (sentinel feed)
+        # Anomaly sentinel: rolling-window self-diagnosis over the step
+        # stream, raising ANOMALY flight records + dynamo_anomaly_active.
+        from dynamo_tpu.observability.anomaly import AnomalySentinel
+
+        self.sentinel = AnomalySentinel(flight=self.flight)
         # Cumulative counters for the metrics plane.
         self._prompt_tokens_total = 0
         self._generated_tokens_total = 0
@@ -566,6 +582,7 @@ class EngineCore:
             self._overlap_mode = None
             self._overlap_barrier_reason = None
             self._aborted_inflight = 0
+            preempt0 = self.num_preemptions
             try:
                 out = self._step_locked()
             except Exception as exc:
@@ -587,6 +604,7 @@ class EngineCore:
             wall_ms = (time.perf_counter() - t0) * 1e3
             info = self.last_step_info
             fresh = info is not prev_info  # _run_mixed built a new dict
+            onboard_stalled = False
             if self._onboard_pending_step:
                 # A tier fetch was in flight across this step: did the step
                 # still dispatch device work (overlapped) or idle on it?
@@ -594,6 +612,7 @@ class EngineCore:
                     self.onboard_overlap_steps += 1
                 else:
                     self.onboard_stall_steps += 1
+                    onboard_stalled = True
                 self._onboard_pending_step = False
             if not fresh and not out and not self.running:
                 self._prev_step_end = time.perf_counter()
@@ -676,8 +695,44 @@ class EngineCore:
                 barrier_reason=barrier_reason,
                 chained_rows=int(info.get("chained_rows", 0)) if fresh else 0,
             )
+            # Time-loss accounting: every millisecond of this step's wall
+            # clock that was not runner dispatch, plus the host gap before
+            # it, lands under exactly one cause. Without a compile tracker
+            # (mock/timing runners) the step wall IS the model-compute
+            # analog, so only the gap is lost time.
+            self.step_wall_ms_total += wall_ms
+            self.step_dispatch_ms_total += dispatch_ms if tracker is not None else wall_ms
+            host_ms = max(0.0, wall_ms - dispatch_ms) if tracker is not None else 0.0
+            self._charge_loss("gap", gap_ms)
+            if self.num_preemptions > preempt0:
+                self._charge_loss("preempt", host_ms)
+            elif onboard_stalled:
+                self._charge_loss("onboard_stall", host_ms)
+            elif overlap_mode == "barrier" and barrier_reason:
+                self._charge_loss(barrier_reason, host_ms)
+            else:
+                self._charge_loss("gap", host_ms)
+            if tracker is not None:
+                events = tracker.events()
+                for ev in events[self._recompile_events_seen:]:
+                    if ev.get("reason") == "new_shape":
+                        self.recompile_count += 1
+                        self._charge_loss("recompile", float(ev.get("wall_ms", 0.0)))
+                self._recompile_events_seen = len(events)
+            self.sentinel.observe_step(
+                wall_ms=wall_ms, gap_ms=gap_ms,
+                barrier=overlap_mode == "barrier",
+                outputs=len(out), decode_rows=decode_rows,
+                recompiles=self.recompile_count,
+                shortfall_pages=self.onboard_shortfall_pages,
+            )
             self._prev_step_end = time.perf_counter()
             return out
+
+    def _charge_loss(self, cause: str, ms: float) -> None:
+        """Accumulate lost wall time under one attribution cause (ms)."""
+        if ms > 0.0:
+            self.lost_time_ms[cause] = self.lost_time_ms.get(cause, 0.0) + ms
 
     def _step_locked(self) -> list[tuple[Sequence, EngineOutput]]:
         # Pending offloads must be read before allocate() can evict their
@@ -1272,6 +1327,16 @@ class EngineCore:
         self._onboard_waits.append(wait_s)
         self.onboard_wait_ms_sum += wait_s * 1e3
         self.onboard_wait_count += 1
+        # Per-request onboard segment for /debug/explain: the fetch ran in
+        # the background, so only the measured session wait is attributable
+        # to this request's critical path.
+        from dynamo_tpu.tracing import record_span, trace_of
+
+        record_span(
+            "engine_onboard_wait", round(wait_s * 1e3, 3),
+            trace=trace_of(seq.context), request_id=seq.request.request_id,
+            pages=len(sess.pages),
+        )
         if seq.status is not SeqStatus.RUNNING or seq not in self.prefilling:
             seq.onboard_pending = 0  # finished/preempted while in flight
             return
@@ -2349,6 +2414,9 @@ class EngineCore:
         if seq.admitted_time is not None and not seq.admission_reported:
             seq.admission_reported = True
             wait_ms = max(0.0, (seq.admitted_time - seq.arrival_time) * 1e3)
+            # Pre-admission wait is lost time: a quota-gated deferral is the
+            # admission plane's doing, anything else is plain resource wait.
+            self._charge_loss("admission" if seq.quota_deferred else "queue", wait_ms)
             if self.admission is not None and tokens:
                 self.admission.on_first_token(seq, time.monotonic())
         out = EngineOutput(

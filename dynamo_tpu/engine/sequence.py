@@ -67,6 +67,10 @@ class Sequence:
     admission_reported: bool = False
     predicted_ttft_s: float | None = None
     predicted_at: float | None = None
+    # True once the quota gate held this request back at any prepare():
+    # its pre-admission wait is then charged to the "admission" loss cause
+    # rather than plain "queue" (observability/attribution.py).
+    quota_deferred: bool = False
 
     @classmethod
     def from_request(cls, seq_id: int, request: PreprocessedRequest, context: Context, *, page_size: int, salt: int) -> "Sequence":

@@ -277,6 +277,16 @@ class JaxEngineService(AsyncEngine[Any, dict]):
                 if item is _SENTINEL:
                     finished = True
                     return
+                if item.admission_wait_ms is not None:
+                    # Arrival -> scheduler admission, measured by the core
+                    # and attached to the first delta. As a span it joins
+                    # the /debug/explain budget's pre-decode segments.
+                    record_span(
+                        "engine_admission_wait",
+                        item.admission_wait_ms,
+                        trace=span.context,
+                        request_id=request.request_id,
+                    )
                 if tokens_out == 0 and item.token_ids:
                     # TTFT as seen at the engine boundary: submit -> first
                     # token out of the step loop. Child of engine_request.

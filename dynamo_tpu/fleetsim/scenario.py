@@ -319,6 +319,9 @@ _register(Scenario(
     checks=(
         Check("requests.total", ">=", 6),
         Check("goodput_frac_at_slo", ">=", 0.5),
+        # Quiet fleet: the anomaly sentinel must stay silent (false
+        # positives here mean the detectors are armed too aggressively).
+        Check("anomalies.fired_total", "==", 0),
     ),
 ))
 
@@ -432,6 +435,9 @@ _register(Scenario(
         Check("tenant_fairness", ">=", 0.5),
         Check("fleet.spawns", ">=", 9),
         Check("fleet.kills", ">=", 1),
+        # Time-loss ledger coverage: the per-cause accounting must explain
+        # all but a sliver of the fleet's non-compute wall time.
+        Check("loss.unattributed_frac", "<=", 0.25),
     ),
 ))
 

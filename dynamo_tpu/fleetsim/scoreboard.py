@@ -91,6 +91,14 @@ class Scoreboard:
             "breaker_open_max": 0.0, "watch_restarts": 0.0,
             "prefill_requeues": 0.0, "engine_registries_max": 0.0,
         }
+        # Fleet-wide time-loss ledger, folded from the same poller: seconds
+        # lost per cause, step-time composition (wall/dispatch/gap), and the
+        # anomaly sentinel's fired counters — all max-folded so the peak
+        # survives worker churn shrinking the federated sum.
+        self.lost_time_s: dict[str, float] = {}
+        self.step_time_s: dict[str, float] = {}
+        self.anomaly_fired: dict[str, float] = {}
+        self.anomaly_active_max: dict[str, float] = {}
         self.planner_decisions: list[dict] = []
 
     # -- per-request accounting --------------------------------------------
@@ -129,6 +137,37 @@ class Scoreboard:
         hi = max(fracs)
         return min(fracs) / hi if hi > 0 else 0.0
 
+    def top_loss_causes(self, n: int = 5) -> list[dict]:
+        ranked = sorted(self.lost_time_s.items(), key=lambda kv: -kv[1])
+        return [
+            {"cause": cause, "seconds": round(sec, 3)}
+            for cause, sec in ranked[:n] if sec > 0.0
+        ]
+
+    def loss_accounting(self) -> dict:
+        """Lost-time coverage: how much non-compute wall the ledger explains.
+
+        Non-compute wall = step wall + inter-step gap - device dispatch.
+        The step-side ledger excludes queue/admission (those waits happen
+        before the step loop and are not part of step wall)."""
+        wall = self.step_time_s.get("wall", 0.0)
+        gap = self.step_time_s.get("gap", 0.0)
+        dispatch = self.step_time_s.get("dispatch", 0.0)
+        noncompute = max(0.0, wall + gap - dispatch)
+        step_lost = sum(
+            sec for cause, sec in self.lost_time_s.items()
+            if cause not in ("queue", "admission")
+        )
+        unattributed = max(0.0, noncompute - step_lost)
+        return {
+            "noncompute_wall_s": round(noncompute, 3),
+            "step_lost_s": round(step_lost, 3),
+            "lost_s_total": round(sum(self.lost_time_s.values()), 3),
+            "unattributed_frac": round(
+                unattributed / noncompute, 4) if noncompute > 0 else 0.0,
+            "top_loss_causes": self.top_loss_causes(),
+        }
+
     def report(self, *, duration_s: float) -> dict:
         total = len(self.outcomes)
         ok = total - self.errors
@@ -163,6 +202,16 @@ class Scoreboard:
             },
             "tenant_fairness": round(self.tenant_fairness(), 4),
             "control_plane": {k: v for k, v in self.scrape.items()},
+            "loss": self.loss_accounting(),
+            "anomalies": {
+                "fired_total": round(sum(self.anomaly_fired.values())),
+                "by_kind": {
+                    k: round(v) for k, v in sorted(self.anomaly_fired.items()) if v > 0
+                },
+                "active_peak": {
+                    k: round(v) for k, v in sorted(self.anomaly_active_max.items()) if v > 0
+                },
+            },
             "planner": {
                 "decisions": self.planner_decisions,
                 "max_decode_workers": max(
@@ -288,12 +337,27 @@ async def run_open_loop(
 # -- federated /metrics scrape ---------------------------------------------
 
 
-def parse_control_plane(text: str) -> dict[str, float]:
-    """Pull the control-plane counters out of a federated /metrics body."""
+def _label(rest: str, key: str) -> str | None:
+    marker = key + '="'
+    if marker not in rest:
+        return None
+    return rest.split(marker, 1)[1].split('"', 1)[0]
+
+
+def parse_control_plane(text: str) -> dict:
+    """Pull the control-plane counters out of a federated /metrics body.
+
+    Besides the scalar counters, folds the attribution families across all
+    workers: lost seconds per ``cause``, step-time seconds per ``kind``,
+    and the anomaly sentinel's active/fired gauges per ``kind``."""
     breaker_open = 0
     watch_restarts = 0.0
     requeues = 0.0
     engine_workers: set[str] = set()
+    lost_time: dict[str, float] = {}
+    step_time: dict[str, float] = {}
+    anomaly_active: dict[str, float] = {}
+    anomaly_fired: dict[str, float] = {}
     for line in text.splitlines():
         if line.startswith("#") or not line.strip():
             continue
@@ -306,15 +370,35 @@ def parse_control_plane(text: str) -> dict[str, float]:
             breaker_open += 1
         elif name == "dynamo_client_watch_restarts_total":
             watch_restarts += value
+        elif name == "dynamo_engine_lost_time_seconds_total":
+            cause = _label(rest, "cause")
+            if cause is not None:
+                lost_time[cause] = lost_time.get(cause, 0.0) + value
+        elif name == "dynamo_engine_step_time_seconds_total":
+            kind = _label(rest, "kind")
+            if kind is not None:
+                step_time[kind] = step_time.get(kind, 0.0) + value
+        elif name == "dynamo_anomaly_active":
+            kind = _label(rest, "kind")
+            if kind is not None:
+                anomaly_active[kind] = anomaly_active.get(kind, 0.0) + value
+        elif name == "dynamo_anomaly_fired_total":
+            kind = _label(rest, "kind")
+            if kind is not None:
+                anomaly_fired[kind] = anomaly_fired.get(kind, 0.0) + value
         elif name.startswith("dynamo_engine_prefill_requeues"):
             requeues += value
-        elif name.startswith("dynamo_engine_") and 'worker="' in rest:
+        if name.startswith("dynamo_engine_") and 'worker="' in rest:
             engine_workers.add(rest.split('worker="', 1)[1].split('"', 1)[0])
     return {
         "breaker_open": float(breaker_open),
         "watch_restarts": watch_restarts,
         "prefill_requeues": requeues,
         "engine_registries": float(len(engine_workers)),
+        "lost_time_s": lost_time,
+        "step_time_s": step_time,
+        "anomaly_active": anomaly_active,
+        "anomaly_fired": anomaly_fired,
     }
 
 
@@ -335,6 +419,17 @@ async def poll_control_plane(
                         s["prefill_requeues"] = max(s["prefill_requeues"], snap["prefill_requeues"])
                         s["engine_registries_max"] = max(
                             s["engine_registries_max"], snap["engine_registries"])
+                        # Cumulative families max-fold per key: monotone
+                        # within a worker, and the peak survives a dead
+                        # worker dropping out of the federated sum.
+                        for dst, key in (
+                            (scoreboard.lost_time_s, "lost_time_s"),
+                            (scoreboard.step_time_s, "step_time_s"),
+                            (scoreboard.anomaly_fired, "anomaly_fired"),
+                            (scoreboard.anomaly_active_max, "anomaly_active"),
+                        ):
+                            for k, v in snap[key].items():
+                                dst[k] = max(dst.get(k, 0.0), v)
             except asyncio.CancelledError:
                 raise
             except Exception as exc:  # scrape failures must not kill the run

@@ -92,6 +92,7 @@ class HttpService:
                 web.get("/live", self.live),
                 web.get("/metrics", self.prometheus),
                 web.get("/debug/traces/{request_id}", self.debug_traces),
+                web.get("/debug/explain/{request_id}", self.debug_explain),
                 web.get("/debug/flight/{worker}", self.debug_flight),
                 web.post("/clear_kv_blocks", self.clear_kv_blocks),
                 web.post("/engine/profile", self.engine_profile),
@@ -257,7 +258,9 @@ class HttpService:
                         return _error(
                             502, "the engine failed while generating this response", "engine_error"
                         )
-                    return web.json_response(payload)
+                    return web.json_response(
+                        payload, headers={"x-dynamo-trace-id": root.trace_id}
+                    )
                 except asyncio.CancelledError:
                     ctx.kill()
                     raise
@@ -288,13 +291,18 @@ class HttpService:
         backend_stream: AsyncIterator[BackendOutput], send_usage: bool,
         *, parse_tools: bool = False, tracker=None,
     ) -> web.StreamResponse:
-        resp = web.StreamResponse(
-            headers={
-                "Content-Type": "text/event-stream",
-                "Cache-Control": "no-cache",
-                "Connection": "keep-alive",
-            }
-        )
+        headers = {
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "Connection": "keep-alive",
+        }
+        # Surface the trace id to the client on the stream too: with it (or
+        # the request id) /debug/traces and /debug/explain are reachable
+        # without grepping worker logs.
+        trace_id = (ctx.trace or {}).get("trace_id") if isinstance(ctx.trace, dict) else None
+        if trace_id:
+            headers["x-dynamo-trace-id"] = str(trace_id)
+        resp = web.StreamResponse(headers=headers)
         await resp.prepare(request)
         fmt = ChatStream(model, send_usage=send_usage) if kind == "chat" else CompletionStream(model, send_usage=send_usage)
         jail = None
@@ -411,9 +419,20 @@ class HttpService:
         catches spans a hop recorded under a different request id.
         """
         from dynamo_tpu.observability.service import assemble_timeline
-        from dynamo_tpu.tracing import SPANS
 
         rid = request.match_info["request_id"]
+        unique = await self._request_spans(rid)
+        if not unique:
+            return web.json_response(
+                {"request_id": rid, "trace_ids": [], "span_count": 0, "spans": []}, status=404
+            )
+        return web.json_response(assemble_timeline(rid, unique))
+
+    async def _request_spans(self, rid: str) -> list[dict]:
+        """Deduped span-doc union for one request (local + worker fan-out +
+        a trace-id follow-up for spans recorded under other request ids)."""
+        from dynamo_tpu.tracing import SPANS
+
         spans = SPANS.query(request_id=rid)
         if self.telemetry is not None:
             try:
@@ -432,11 +451,48 @@ class HttpService:
             if sid:
                 seen.add(sid)
             unique.append(s)
-        if not unique:
+        return unique
+
+    async def debug_explain(self, request: web.Request) -> web.Response:
+        """One request's critical-path latency budget.
+
+        Joins the request's span timeline (same union as ``/debug/traces``)
+        with the serving worker's flight STEP/COMPILE records (``debug_explain``
+        fan-out, windowed to the request's span bounds) into an ordered
+        segment breakdown whose sum is checked against the measured E2E
+        latency — the residual reported as ``unattributed``
+        (``observability/attribution.py``).
+        """
+        from dynamo_tpu.config import load_attrib_settings
+        from dynamo_tpu.observability.attribution import build_explain
+
+        rid = request.match_info["request_id"]
+        spans = await self._request_spans(rid)
+        if not spans:
             return web.json_response(
-                {"request_id": rid, "trace_ids": [], "span_count": 0, "spans": []}, status=404
+                {"request_id": rid, "error": "no spans for this request id"}, status=404
             )
-        return web.json_response(assemble_timeline(rid, unique))
+        step_docs: list[dict] = []
+        if self.telemetry is not None:
+            t0 = min((s.get("start_ts") or 0.0) for s in spans)
+            t1 = max(
+                (s.get("start_ts") or 0.0) + (s.get("duration_ms") or 0.0) / 1e3
+                for s in spans
+            )
+            try:
+                step_docs = await self.telemetry.collect_explain(t0=t0 - 1.0, t1=t1 + 1.0)
+            except Exception:
+                logger.exception("explain fan-out failed; serving span-only budget")
+        doc = build_explain(
+            rid, spans, step_docs,
+            tolerance_frac=load_attrib_settings().tolerance_frac,
+        )
+        if doc is None:
+            return web.json_response(
+                {"request_id": rid, "error": "no anchor span (http_request/engine_request)"},
+                status=404,
+            )
+        return web.json_response(doc)
 
     async def debug_flight(self, request: web.Request) -> web.Response:
         """One worker's engine flight ring (ordered per-step records).

@@ -428,10 +428,12 @@ async def _serve_worker_telemetry(
     direct debug HTTP surface (0 = pick a free port).
     """
     from dynamo_tpu.observability import (
+        DEBUG_EXPLAIN_ENDPOINT,
         DEBUG_TRACES_ENDPOINT,
         FLIGHT_ENDPOINT,
         METRICS_SCRAPE_ENDPOINT,
         EngineMetrics,
+        ExplainQueryService,
         FlightQueryService,
         MetricsScrapeService,
         SpanQueryService,
@@ -458,6 +460,10 @@ async def _serve_worker_telemetry(
     if flight is not None:
         await component.endpoint(FLIGHT_ENDPOINT).serve(
             FlightQueryService(flight, worker=worker_id), metadata=metadata, lease=lease
+        )
+        await component.endpoint(DEBUG_EXPLAIN_ENDPOINT).serve(
+            ExplainQueryService(service.core, worker=worker_id),
+            metadata=metadata, lease=lease,
         )
     port_spec = os.environ.get("DYN_WORKER_HTTP_PORT")
     if port_spec is not None:

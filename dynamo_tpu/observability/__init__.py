@@ -14,13 +14,16 @@ Two surfaces over the same worker internals:
   ``/debug/traces/{request_id}``) for scraping workers directly.
 """
 
+from dynamo_tpu.observability.anomaly import ANOMALY_KINDS, AnomalySentinel
 from dynamo_tpu.observability.compile import CompileTracker, timed_dispatch
 from dynamo_tpu.observability.flight import FlightRecorder
 from dynamo_tpu.observability.metrics import EngineMetrics, federate_text, observe_kv_phase
 from dynamo_tpu.observability.service import (
+    DEBUG_EXPLAIN_ENDPOINT,
     DEBUG_TRACES_ENDPOINT,
     FLIGHT_ENDPOINT,
     METRICS_SCRAPE_ENDPOINT,
+    ExplainQueryService,
     FlightQueryService,
     MetricsScrapeService,
     SpanQueryService,
@@ -30,15 +33,19 @@ from dynamo_tpu.observability.service import (
 from dynamo_tpu.observability.slo import SloAccountant, StreamingQuantiles
 
 __all__ = [
+    "ANOMALY_KINDS",
+    "AnomalySentinel",
     "CompileTracker",
     "timed_dispatch",
     "FlightRecorder",
     "EngineMetrics",
     "federate_text",
     "observe_kv_phase",
+    "DEBUG_EXPLAIN_ENDPOINT",
     "DEBUG_TRACES_ENDPOINT",
     "FLIGHT_ENDPOINT",
     "METRICS_SCRAPE_ENDPOINT",
+    "ExplainQueryService",
     "FlightQueryService",
     "MetricsScrapeService",
     "SpanQueryService",
@@ -46,4 +53,18 @@ __all__ = [
     "assemble_timeline",
     "SloAccountant",
     "StreamingQuantiles",
+    "LOSS_CAUSES",
+    "build_explain",
 ]
+
+
+def __getattr__(name):
+    # attribution imports engine.core (for the pinned BARRIER_REASONS), and
+    # engine.core imports this package's flight module at import time — so
+    # the attribution symbols resolve lazily to keep the package importable
+    # from either side.
+    if name in ("LOSS_CAUSES", "EXTRA_LOSS_CAUSES", "build_explain"):
+        from dynamo_tpu.observability import attribution
+
+        return getattr(attribution, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -17,6 +17,9 @@ recorder keeps the last N steps verbatim, the way an aircraft FDR does:
   bucket (the XLA recompile a generic tool cannot see).
 - ``crash`` records — appended by ``EngineCore.step()`` when a step raises,
   capturing the failing step's context before the exception propagates.
+- ``anomaly`` records — rising edges from the
+  :class:`~dynamo_tpu.observability.anomaly.AnomalySentinel` rolling-window
+  detectors, landed next to the steps that tripped them.
 
 The ring is dumpable two ways: remotely via the ``debug_flight`` worker
 endpoint behind ``GET /debug/flight/{worker}`` (``service.py``), and to a
@@ -41,6 +44,7 @@ logger = logging.getLogger(__name__)
 STEP = "step"
 COMPILE = "compile"
 CRASH = "crash"
+ANOMALY = "anomaly"
 
 _DEFAULT_CAPACITY = 2048
 _DUMP_DIR_ENV = "DYN_FLIGHT_DUMP_DIR"

@@ -205,6 +205,46 @@ class EngineMetrics:
             "payloads landing in device pages",
             ["worker"], buckets=_PHASE_BUCKETS, registry=self.registry,
         )
+        # Time-loss accounting (attribution plane): cumulative seconds the
+        # engine charged per loss cause (attribution.LOSS_CAUSES = the pinned
+        # barrier vocabulary + queue/admission/onboard_stall/preempt/
+        # recompile/gap), plus the step-time totals consumers need to derive
+        # non-compute wall time (wall + gap - dispatch) and the unattributed
+        # residual. Clear-then-set labelled gauges, same sync-on-scrape
+        # no-double-booking idiom as recompiles.
+        self._lost_time = Gauge(
+            "dynamo_engine_lost_time_seconds_total",
+            "Wall-clock seconds the engine attributes to a latency loss "
+            "cause: overlap barrier reasons plus queue (pre-admission "
+            "resource wait), admission (quota-gated deferral), onboard_stall "
+            "(steps idled on a tier fetch), preempt, recompile (new-shape "
+            "compiles on the serving path), and gap (residual host time "
+            "between dispatches)",
+            ["worker", "cause"], registry=self.registry,
+        )
+        self._step_time = Gauge(
+            "dynamo_engine_step_time_seconds_total",
+            "Cumulative engine step time by kind: wall (in-step wall clock), "
+            "dispatch (runner dispatch inside steps; equals wall on runners "
+            "without a compile tracker), gap (host gap between steps) — "
+            "non-compute wall time = wall + gap - dispatch",
+            ["worker", "kind"], registry=self.registry,
+        )
+        # Anomaly sentinel: 1 while a rolling-window detector is active on
+        # this worker (hysteresis in the sentinel, not here), keyed by the
+        # detector kind; fired totals count rising edges ever.
+        self._anomaly_active = Gauge(
+            "dynamo_anomaly_active",
+            "1 while the worker's anomaly sentinel holds this detector "
+            "active (barrier_frac_spike, step_gap_regression, goodput_drop, "
+            "recompile_storm, onboard_shortfall_burst)",
+            ["worker", "kind"], registry=self.registry,
+        )
+        self._anomaly_fired = Gauge(
+            "dynamo_anomaly_fired_total",
+            "Anomaly-sentinel rising edges ever fired, by detector kind",
+            ["worker", "kind"], registry=self.registry,
+        )
         self.prefill_queue_depth = gauge(
             f"{ns}_prefill_queue_depth", "Unclaimed tasks in the distributed prefill queue"
         )
@@ -370,6 +410,29 @@ class EngineMetrics:
         if callable(drain):
             for wait_s in drain():
                 self._onboard_wait.labels(self.worker).observe(max(0.0, wait_s))
+        lost = getattr(core, "lost_time_ms", None)
+        if lost is not None:
+            self._lost_time.clear()
+            for cause, ms in lost.items():
+                self._lost_time.labels(self.worker, cause).set(ms / 1e3)
+            self._step_time.clear()
+            self._step_time.labels(self.worker, "wall").set(
+                getattr(core, "step_wall_ms_total", 0.0) / 1e3
+            )
+            self._step_time.labels(self.worker, "dispatch").set(
+                getattr(core, "step_dispatch_ms_total", 0.0) / 1e3
+            )
+            self._step_time.labels(self.worker, "gap").set(
+                getattr(core, "step_gap_ms_sum", 0.0) / 1e3
+            )
+        sentinel = getattr(core, "sentinel", None)
+        if sentinel is not None:
+            self._anomaly_active.clear()
+            for kind in getattr(sentinel, "active", {}):
+                self._anomaly_active.labels(self.worker, kind).set(1)
+            self._anomaly_fired.clear()
+            for kind, n in getattr(sentinel, "fired", {}).items():
+                self._anomaly_fired.labels(self.worker, kind).set(n)
 
     def _sync_transfer(self) -> None:
         if self._transfer is None:

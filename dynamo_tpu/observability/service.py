@@ -1,11 +1,15 @@
 """Worker telemetry endpoints + the frontend-side fan-out client.
 
-Every worker serves two extra runtime endpoints next to ``generate``:
+Every worker serves extra runtime endpoints next to ``generate``:
 
 - ``debug_traces`` (:class:`SpanQueryService`) — query the process-local
   span ring (``tracing.SPANS``) by request or trace id;
 - ``metrics_scrape`` (:class:`MetricsScrapeService`) — render the process's
-  :class:`~dynamo_tpu.observability.metrics.EngineMetrics` registry.
+  :class:`~dynamo_tpu.observability.metrics.EngineMetrics` registry;
+- ``debug_flight`` (:class:`FlightQueryService`) — the engine flight ring;
+- ``debug_explain`` (:class:`ExplainQueryService`) — windowed STEP/COMPILE
+  records + lost-time totals, the worker half of
+  ``GET /debug/explain/{request_id}`` (``attribution.build_explain``).
 
 They ride the same discovery + stream transport as serving traffic, so the
 frontend needs no extra connectivity to reach them:
@@ -31,6 +35,7 @@ logger = logging.getLogger(__name__)
 DEBUG_TRACES_ENDPOINT = "debug_traces"
 METRICS_SCRAPE_ENDPOINT = "metrics_scrape"
 FLIGHT_ENDPOINT = "debug_flight"
+DEBUG_EXPLAIN_ENDPOINT = "debug_explain"
 
 _FANOUT_TIMEOUT = 5.0
 
@@ -81,6 +86,48 @@ class FlightQueryService(AsyncEngine[Any, dict]):
             kind=request.get("kind"),
         )
         yield {"worker": self.worker, "records": records}
+
+
+class ExplainQueryService(AsyncEngine[Any, dict]):
+    """Answers ``{"t0"?, "t1"?}`` with this worker's attribution inputs.
+
+    Returns the flight ring's STEP/COMPILE records (optionally windowed to
+    ``[t0, t1]`` wall-clock seconds — the frontend passes the request's span
+    bounds so the payload stays proportional to the request, not the ring)
+    plus the engine's cumulative per-cause lost-time totals. The per-request
+    join happens on the frontend (``attribution.build_explain``): flight
+    records carry no request ids, so windowing is the only per-request cut a
+    worker can make.
+    """
+
+    def __init__(self, core, *, worker: str = "") -> None:
+        self.core = core
+        self.worker = worker or f"pid-{os.getpid()}"
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
+        from dynamo_tpu.config import load_attrib_settings
+        from dynamo_tpu.observability.flight import COMPILE, STEP
+
+        request = request or {}
+        t0 = request.get("t0")
+        t1 = request.get("t1")
+
+        def in_window(rec: dict) -> bool:
+            ts = rec.get("ts") or 0.0
+            return (t0 is None or ts >= float(t0)) and (t1 is None or ts <= float(t1))
+
+        max_steps = load_attrib_settings().max_steps
+        steps = [r for r in self.core.flight.snapshot(kind=STEP) if in_window(r)]
+        compiles = [r for r in self.core.flight.snapshot(kind=COMPILE) if in_window(r)]
+        yield {
+            "worker": self.worker,
+            "steps": steps[-max_steps:],
+            "compiles": compiles,
+            "lost_time_ms": {
+                k: round(v, 3)
+                for k, v in (getattr(self.core, "lost_time_ms", None) or {}).items()
+            },
+        }
 
 
 class WorkerTelemetryClient:
@@ -163,6 +210,25 @@ class WorkerTelemetryClient:
             out[wid] = res.get("records", [])
         return out
 
+    async def collect_explain(
+        self, *, t0: float | None = None, t1: float | None = None
+    ) -> list[dict]:
+        """Every worker's windowed attribution inputs (steps + compiles)."""
+        targets = await self._targets(DEBUG_EXPLAIN_ENDPOINT)
+        request: dict = {}
+        if t0 is not None:
+            request["t0"] = t0
+        if t1 is not None:
+            request["t1"] = t1
+        results = await asyncio.gather(*(self._ask(t, request) for t in targets))
+        docs = []
+        for inst, res in zip(targets, results):
+            if res is None:
+                continue
+            res.setdefault("worker", f"{inst.instance_id:x}")
+            docs.append(res)
+        return docs
+
     async def collect_metrics_texts(self) -> list[bytes]:
         """Every worker's rendered registry (for /metrics federation)."""
         targets = await self._targets(METRICS_SCRAPE_ENDPOINT)
@@ -177,7 +243,10 @@ def assemble_timeline(request_id: str, spans: list[dict]) -> dict:
     clock, so ordering uses the wall-clock ``start_ts``; ``offset_ms`` is
     relative to the earliest span (queue wait → router decision → prefill →
     KV phases → first decode step read top to bottom). ``children`` indexes
-    restore the parent/child structure where ids link up.
+    restore the parent/child structure where ids link up. A span whose
+    parent was evicted from the ring (span buffers are bounded) still
+    surfaces at top level, flagged ``parent_evicted: true`` — orphans must
+    never silently vanish from a postmortem.
     """
     spans = sorted(spans, key=lambda s: (s.get("start_ts") or 0.0, s.get("duration_ms") or 0.0))
     t0 = spans[0].get("start_ts", 0.0) if spans else 0.0
@@ -190,6 +259,8 @@ def assemble_timeline(request_id: str, spans: list[dict]) -> dict:
             j for j, c in enumerate(spans) if c.get("parent_id") and c["parent_id"] == s.get("span_id")
         ]
         doc["root"] = s.get("parent_id") not in by_id or s.get("parent_id") is None
+        if s.get("parent_id") is not None and s.get("parent_id") not in by_id:
+            doc["parent_evicted"] = True
         out_spans.append(doc)
     trace_ids = sorted({s["trace_id"] for s in spans if s.get("trace_id")})
     return {

@@ -144,6 +144,10 @@ class AdmissionController:
             else:
                 self.tenants.note_throttled(tenant)
                 self.throttle_events += 1
+                # Sticky marker for loss attribution: this request's eventual
+                # pre-admission wait was (at least partly) the quota gate's
+                # doing, not plain resource contention.
+                seq.quota_deferred = True
                 deferred.append(seq)
         waiting.clear()
         waiting.extend(admissible)

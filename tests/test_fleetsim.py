@@ -213,6 +213,14 @@ def test_parse_control_plane_metrics_text():
         'dynamo_engine_prefill_requeues_total{worker="w1"} 5.0',
         'dynamo_engine_steps_total{worker="w1"} 100.0',
         'dynamo_engine_steps_total{worker="w2"} 90.0',
+        # Attribution families fold across workers, keyed by cause/kind.
+        'dynamo_engine_lost_time_seconds_total{cause="gap",worker="w1"} 1.5',
+        'dynamo_engine_lost_time_seconds_total{cause="gap",worker="w2"} 0.5',
+        'dynamo_engine_lost_time_seconds_total{cause="queue",worker="w1"} 0.25',
+        'dynamo_engine_step_time_seconds_total{kind="wall",worker="w1"} 4.0',
+        'dynamo_engine_step_time_seconds_total{kind="dispatch",worker="w1"} 3.0',
+        'dynamo_anomaly_active{kind="recompile_storm",worker="w2"} 1.0',
+        'dynamo_anomaly_fired_total{kind="recompile_storm",worker="w2"} 2.0',
         "not_a_metric",
     ])
     snap = parse_control_plane(text)
@@ -220,6 +228,45 @@ def test_parse_control_plane_metrics_text():
     assert snap["watch_restarts"] == 3.0
     assert snap["prefill_requeues"] == 5.0
     assert snap["engine_registries"] == 2.0
+    assert snap["lost_time_s"] == {"gap": 2.0, "queue": 0.25}
+    assert snap["step_time_s"] == {"wall": 4.0, "dispatch": 3.0}
+    assert snap["anomaly_active"] == {"recompile_storm": 1.0}
+    assert snap["anomaly_fired"] == {"recompile_storm": 2.0}
+
+
+def test_scoreboard_loss_accounting_and_anomaly_report():
+    """Fleet-wide time-loss accounting (ISSUE 15): the report explains
+    non-compute wall (wall + gap - dispatch) with the step-side causes,
+    ranks the top losses, and surfaces the sentinel's counters."""
+    sb = Scoreboard(SloTarget())
+    sb.lost_time_s = {"gap": 2.0, "pages": 1.0, "queue": 5.0, "drain": 0.0}
+    sb.step_time_s = {"wall": 100.0, "dispatch": 97.0, "gap": 3.0}
+    sb.anomaly_fired = {"recompile_storm": 2.0}
+    sb.anomaly_active_max = {"recompile_storm": 1.0, "goodput_drop": 0.0}
+
+    loss = sb.loss_accounting()
+    assert loss["noncompute_wall_s"] == pytest.approx(6.0)  # 100 + 3 - 97
+    # queue waits happen before the step loop: excluded from step coverage.
+    assert loss["step_lost_s"] == pytest.approx(3.0)  # gap 2 + pages 1
+    assert loss["lost_s_total"] == pytest.approx(8.0)
+    assert loss["unattributed_frac"] == pytest.approx(0.5)  # (6 - 3) / 6
+    assert loss["top_loss_causes"] == [
+        {"cause": "queue", "seconds": 5.0},
+        {"cause": "gap", "seconds": 2.0},
+        {"cause": "pages", "seconds": 1.0},
+    ]  # zero-second causes never pad the ranking
+
+    rep = sb.report(duration_s=10.0)
+    assert rep["loss"]["unattributed_frac"] == pytest.approx(0.5)
+    assert rep["anomalies"]["fired_total"] == 2
+    assert rep["anomalies"]["by_kind"] == {"recompile_storm": 2}
+    assert rep["anomalies"]["active_peak"] == {"recompile_storm": 1}
+
+    # An empty ledger (no scrape landed) reports cleanly, never divides by 0.
+    empty = Scoreboard(SloTarget()).loss_accounting()
+    assert empty["noncompute_wall_s"] == 0.0
+    assert empty["unattributed_frac"] == 0.0
+    assert empty["top_loss_causes"] == []
 
 
 def test_fleet_metrics_sync_and_render():

@@ -8,6 +8,7 @@ and the engine registries federate into the frontend's /metrics render.
 """
 
 import asyncio
+import json
 import pathlib
 import sys
 import time
@@ -142,6 +143,13 @@ class _FakeCore:
     overlap_barrier_counts = {"spec": 1, "drain": 1}
     constraint_mask_cache_hits = 11
     constraint_mask_cache_misses = 3
+    lost_time_ms = {"gap": 1500.0, "queue": 250.0, "recompile": 40.0}
+    step_wall_ms_total = 4000.0
+    step_dispatch_ms_total = 3000.0
+    sentinel = SimpleNamespace(
+        active={"recompile_storm": {"value": 9.0, "threshold": 8.0, "since_step": 300}},
+        fired={"recompile_storm": 2},
+    )
     waiting = ["a"]
     running = ["b", "c"]
     prefilling = ["d"]
@@ -216,6 +224,12 @@ EXPECTED_ENGINE_FAMILIES = {
     "dynamo_engine_deadline_misses_total",
     "dynamo_tenant_throttled_total",
     "dynamo_engine_chunk_budget_tokens",
+    # Attribution plane (ISSUE 15): time-loss ledger, step-time composition,
+    # and the anomaly sentinel's active/fired gauges.
+    "dynamo_engine_lost_time_seconds_total",
+    "dynamo_engine_step_time_seconds_total",
+    "dynamo_anomaly_active",
+    "dynamo_anomaly_fired_total",
     "dynamo_kv_transfer_phase_seconds",
     # prometheus_client emits the histogram's _created timestamps as their
     # own gauge family once a labelled child exists.
@@ -263,6 +277,16 @@ async def test_engine_metrics_names_labels_and_values():
     assert 'dynamo_engine_overlap_barrier_total{reason="drain",worker="w1"} 1.0' in text
     assert 'dynamo_engine_constraint_mask_cache_hits_total{worker="w1"} 11.0' in text
     assert 'dynamo_engine_constraint_mask_cache_misses_total{worker="w1"} 3.0' in text
+    # Attribution plane: per-cause lost seconds, step-time composition, and
+    # the sentinel's active/fired state, all synced from the core.
+    assert 'dynamo_engine_lost_time_seconds_total{cause="gap",worker="w1"} 1.5' in text
+    assert 'dynamo_engine_lost_time_seconds_total{cause="queue",worker="w1"} 0.25' in text
+    assert 'dynamo_engine_lost_time_seconds_total{cause="recompile",worker="w1"} 0.04' in text
+    assert 'dynamo_engine_step_time_seconds_total{kind="wall",worker="w1"} 4.0' in text
+    assert 'dynamo_engine_step_time_seconds_total{kind="dispatch",worker="w1"} 3.0' in text
+    assert 'dynamo_engine_step_time_seconds_total{kind="gap",worker="w1"} 0.01' in text
+    assert 'dynamo_anomaly_active{kind="recompile_storm",worker="w1"} 1.0' in text
+    assert 'dynamo_anomaly_fired_total{kind="recompile_storm",worker="w1"} 2.0' in text
     assert 'dynamo_engine_pages_active{worker="w1"} 40.0' in text
     assert 'dynamo_engine_page_utilization_ratio{worker="w1"} 0.625' in text
     # fragmentation = cached / (free + cached) = 8 / 24
@@ -353,6 +377,295 @@ def test_barrier_reasons_synced():
     assert "mm" not in declared
     assert len(documented) == len(declared) > 5
     assert check_barrier_reasons.check(declared, recorded, documented) == []
+    # The loss-cause layer (ISSUE 15 satellite): LOSS_CAUSES must be exactly
+    # the barrier vocabulary + the literal extras tuple, and the
+    # OBSERVABILITY.md loss-cause table must list all of them.
+    extras = check_barrier_reasons.source_extra_causes()
+    loss = check_barrier_reasons.declared_loss_causes()
+    doc_loss = check_barrier_reasons.documented_loss_causes()
+    assert extras == ("queue", "admission", "onboard_stall", "preempt", "recompile", "gap")
+    assert loss == tuple(declared) + extras
+    assert check_barrier_reasons.check_loss_causes(declared, loss, extras, doc_loss) == []
+
+
+def test_bench_regress_gate(tmp_path, monkeypatch):
+    """Invokes the tools/ bench-trajectory gate (ISSUE 15 satellite): the
+    newest committed BENCH_r*.json round must hold the trajectory, with
+    direction-aware tolerances and the documented waiver knob."""
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "tools"))
+    try:
+        import bench_regress
+    finally:
+        sys.path.pop(0)
+    # Direction table: throughput-like keys gate downward movement,
+    # latency-like keys gate upward movement, unknown keys never gate.
+    assert bench_regress.direction("decode_tokens_per_sec_per_chip") == 1
+    assert bench_regress.direction("loss_coverage_frac") == 1
+    assert bench_regress.direction("ttft_ms") == -1
+    assert bench_regress.direction("decode_idle_frac") == -1
+    assert bench_regress.direction("mystery_key") == 0
+    # Tail recovery: a parsed=null wrapper falls back to the last JSON line
+    # of the tail; an unusable tail yields no document (round skipped).
+    doc = bench_regress._recover_doc(
+        {"parsed": None, "tail": 'noise\n{"value": 2.0}\ntrailing'}
+    )
+    assert doc == {"value": 2.0}
+    assert bench_regress._recover_doc({"parsed": None, "tail": "junk"}) is None
+
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"n": 1, "parsed": {"value": 100.0, "ttft_ms": 10.0, "odd": 1.0}}))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        {"n": 2, "parsed": {"value": 60.0, "ttft_ms": 14.0, "odd": 9.0}}))
+    regressions, notes = bench_regress.compare(
+        bench_regress.load_rounds(tmp_path), tolerance=0.25)
+    assert any(r.startswith("value:") for r in regressions)  # 60 < 100 * 0.75
+    assert any(r.startswith("ttft_ms:") for r in regressions)  # 14 > 10 * 1.25
+    assert any("odd" in n for n in notes)  # unknown direction stays advisory
+    monkeypatch.setenv("DYN_BENCH_REGRESS_WAIVE", "value")
+    assert [r.split(":")[0] for r in bench_regress.check(tmp_path)] == ["ttft_ms"]
+    monkeypatch.setenv("DYN_BENCH_REGRESS_WAIVE", "all")
+    assert bench_regress.check(tmp_path) == []
+    # The committed history itself must hold (this is the CI wiring).
+    monkeypatch.delenv("DYN_BENCH_REGRESS_WAIVE", raising=False)
+    assert bench_regress.check() == []
+
+
+# -- latency attribution (ISSUE 15 tentpole) ----------------------------------
+
+
+def _span_doc(name, start_s, dur_ms, *, tid="a" * 32, sid=None, parent=None):
+    return {
+        "name": name, "trace_id": tid,
+        "span_id": sid or (name[:12] + "0000")[:16].ljust(16, "0"),
+        "parent_id": parent, "start_ts": start_s, "duration_ms": dur_ms,
+        "status": "ok",
+    }
+
+
+def test_build_explain_disagg_budget_sums_to_e2e():
+    """The acceptance shape: a disagg request's segments (queue, admission,
+    onboard, prefill, KV phases, transfer slack, decode split, recompiles,
+    frontend) de-overlap along the span hierarchy and sum to the measured
+    E2E latency, residual reported as unattributed."""
+    from dynamo_tpu.observability.attribution import build_explain
+
+    t0 = 1000.0
+    spans = [
+        _span_doc("http_request", t0, 100.0),
+        # Remote-prefill window: queue pickup + exec (containing the
+        # sender-side KV phases) + scatter, with 4ms of uncovered slack.
+        _span_doc("remote_prefill", t0 + 0.005, 30.0),
+        _span_doc("prefill_queue_wait", t0 + 0.005, 5.0),
+        _span_doc("prefill_exec", t0 + 0.010, 18.0),
+        _span_doc("kv_gather", t0 + 0.011, 2.0),
+        _span_doc("kv_pack", t0 + 0.013, 1.0),
+        _span_doc("kv_wire", t0 + 0.014, 5.0),
+        _span_doc("kv_scatter", t0 + 0.028, 3.0),
+        # Engine side: queue + admission + onboard waits inside a 12ms TTFT.
+        _span_doc("engine_request", t0 + 0.036, 60.0),
+        _span_doc("engine_queue_wait", t0 + 0.036, 4.0),
+        _span_doc("engine_admission_wait", t0 + 0.040, 2.0),
+        _span_doc("engine_onboard_wait", t0 + 0.042, 1.0),
+        _span_doc("engine_first_token", t0 + 0.036, 12.0),
+    ]
+    steps = [
+        {"ts": t0 + 0.050 + i * 0.006, "wall_ms": 5.0, "dispatch_ms": 4.0,
+         "gap_ms": 1.0, "overlap_mode": "overlapped", "barrier_reason": ""}
+        for i in range(8)
+    ]
+    steps[3]["overlap_mode"] = "barrier"
+    steps[3]["barrier_reason"] = "pages"
+    step_docs = [
+        {"worker": "w-dec", "steps": steps, "compiles": [
+            {"ts": t0 + 0.060, "wall_ms": 2.0, "reason": "new_shape", "program": "step"},
+            {"ts": t0 + 0.061, "wall_ms": 9.0, "reason": "warm_cache", "program": "step"},
+        ]},
+        # A second worker with fewer in-window steps must lose the vote:
+        # cross-worker records would double-charge the same wall clock.
+        {"worker": "w-other", "steps": steps[:2], "compiles": []},
+    ]
+    doc = build_explain("req-attr-1", spans, step_docs)
+    assert doc is not None
+    assert doc["decode_worker"] == "w-dec"
+    assert doc["steps_in_window"] == 8
+    segs = {s["name"]: s["ms"] for s in doc["segments"]}
+    assert segs["queue"] == pytest.approx(9.0)  # engine 4 + prefill 5
+    assert segs["admission"] == pytest.approx(2.0)
+    assert segs["onboard"] == pytest.approx(1.0)
+    assert segs["prefill"] == pytest.approx(15.0)  # 10 remote compute + 5 local
+    assert segs["kv_gather"] == pytest.approx(2.0)
+    assert segs["kv_wire"] == pytest.approx(5.0)
+    assert segs["kv_scatter"] == pytest.approx(3.0)
+    assert segs["transfer_wait"] == pytest.approx(4.0)  # remote window slack
+    assert segs["decode_compute"] == pytest.approx(30.0)  # 32 minus recompile
+    assert segs["gap"] == pytest.approx(15.0)
+    assert segs["barrier:pages"] == pytest.approx(1.0)
+    assert segs["recompile"] == pytest.approx(2.0)  # warm_cache excluded
+    assert segs["frontend"] == pytest.approx(10.0)  # e2e - engine - remote
+    assert doc["segments"][-1]["name"] == "unattributed"
+    assert doc["unattributed_ms"] == pytest.approx(0.0, abs=0.01)
+    assert doc["coverage_frac"] == pytest.approx(1.0, abs=0.001)
+    assert doc["within_tolerance"] is True
+    assert doc["decode_ms"] == pytest.approx(48.0)
+
+
+def test_build_explain_clamps_decode_overhang_and_handles_edges():
+    from dynamo_tpu.observability.attribution import build_explain
+
+    # No http_request/engine_request anchor -> no budget.
+    assert build_explain("nope", [_span_doc("kv_wire", 1.0, 3.0)]) is None
+
+    t0 = 2000.0
+    spans = [
+        _span_doc("engine_request", t0, 20.0),
+        _span_doc("engine_first_token", t0, 5.0),
+    ]
+    # One step whose gap field spans pre-request idle: the raw decode split
+    # (45ms) dwarfs the 15ms decode window and must be scaled down to it,
+    # not surface as negative unattributed time.
+    step_docs = [{"worker": "w1", "steps": [
+        {"ts": t0 + 0.010, "wall_ms": 10.0, "dispatch_ms": 9.0, "gap_ms": 35.0},
+    ], "compiles": []}]
+    doc = build_explain("req-clamp", spans, step_docs)
+    segs = {s["name"]: s["ms"] for s in doc["segments"]}
+    assert segs.get("decode_compute", 0.0) + segs.get("gap", 0.0) == pytest.approx(15.0, abs=0.01)
+    assert "frontend" not in segs  # anchor IS the engine span
+    assert doc["within_tolerance"] is True
+
+    # TTFT == engine duration: a zero decode window zeroes the decode split.
+    spans2 = [
+        _span_doc("engine_request", t0, 10.0),
+        _span_doc("engine_first_token", t0, 10.0),
+    ]
+    doc2 = build_explain("req-zero-decode", spans2, step_docs)
+    segs2 = {s["name"]: s["ms"] for s in doc2["segments"]}
+    assert "decode_compute" not in segs2 and "gap" not in segs2
+    assert segs2["prefill"] == pytest.approx(10.0)
+    assert doc2["within_tolerance"] is True
+
+
+def test_loss_cause_vocabulary_pinned_to_barriers():
+    from dynamo_tpu.engine.core import BARRIER_REASONS
+    from dynamo_tpu.observability import EXTRA_LOSS_CAUSES, LOSS_CAUSES  # lazy export
+
+    assert LOSS_CAUSES[: len(BARRIER_REASONS)] == tuple(BARRIER_REASONS)
+    assert LOSS_CAUSES[len(BARRIER_REASONS):] == EXTRA_LOSS_CAUSES
+    assert len(set(LOSS_CAUSES)) == len(LOSS_CAUSES)
+    assert {"queue", "admission", "onboard_stall", "preempt", "recompile", "gap"} <= set(LOSS_CAUSES)
+
+
+def test_engine_lost_time_covers_noncompute_wall():
+    """The fleet-wide ledger (acceptance criterion): after serving traffic,
+    the per-cause lost-time totals explain >= 90% of the engine's
+    non-compute wall time (wall + gap - dispatch), every cause in the
+    pinned vocabulary."""
+    from dynamo_tpu.engine.core import EngineConfig, EngineCore
+    from dynamo_tpu.mocker import MockRunner
+    from dynamo_tpu.observability.attribution import LOSS_CAUSES
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest,
+        SamplingOptions,
+        StopConditions,
+    )
+
+    runner = MockRunner(num_pages=64, page_size=16, realtime=False)
+    core = EngineCore(runner, EngineConfig(
+        num_pages=64, page_size=16, max_batch_size=4, max_seq_len=256,
+        chunk_prefill_tokens=32, enable_prefix_caching=False,
+    ))
+    for _ in range(3):
+        core.add_request(PreprocessedRequest(
+            token_ids=list(range(1, 25)),
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=12, ignore_eos=True),
+        ))
+    steps = 0
+    while core.has_work and steps < 200:
+        core.step()
+        steps += 1
+    assert not core.has_work
+
+    assert set(core.lost_time_ms) <= set(LOSS_CAUSES)
+    noncompute = core.step_wall_ms_total + core.step_gap_ms_sum - core.step_dispatch_ms_total
+    step_lost = sum(
+        ms for cause, ms in core.lost_time_ms.items()
+        if cause not in ("queue", "admission")
+    )
+    if noncompute > 0.0:
+        assert step_lost >= 0.9 * noncompute
+    # The sentinel rode the same step stream without firing on quiet load.
+    assert core.sentinel is not None
+    assert core.sentinel.fired == {}
+
+
+# -- anomaly sentinel ---------------------------------------------------------
+
+
+def _feed(sent, *, n=1, recompiles=0, shortfall=0, barrier=False, gap=1.0):
+    for _ in range(n):
+        sent.observe_step(
+            wall_ms=5.0, gap_ms=gap, barrier=barrier, outputs=3, decode_rows=3,
+            recompiles=recompiles, shortfall_pages=shortfall,
+        )
+
+
+def test_anomaly_sentinel_quiet_stream_never_fires():
+    from dynamo_tpu.config import AnomalySettings
+    from dynamo_tpu.observability.anomaly import AnomalySentinel
+
+    sent = AnomalySentinel(AnomalySettings(window=16, min_samples=32))
+    _feed(sent, n=400)
+    assert sent.active == {} and sent.fired == {}
+
+
+def test_anomaly_sentinel_recompile_storm_fires_once_then_clears():
+    from dynamo_tpu.config import AnomalySettings
+    from dynamo_tpu.observability.anomaly import AnomalySentinel
+    from dynamo_tpu.observability.flight import ANOMALY
+
+    records = []
+    flight = SimpleNamespace(record=lambda kind, **f: records.append((kind, f)))
+    sent = AnomalySentinel(
+        AnomalySettings(window=16, min_samples=32, clear_after=8), flight=flight
+    )
+    _feed(sent, n=64)
+    # A storm: the cumulative compile counter jumps inside one window.
+    for i in range(16):
+        _feed(sent, recompiles=i)
+    assert "recompile_storm" in sent.active
+    assert sent.fired.get("recompile_storm") == 1  # one rising edge, no flap
+    storm_records = [f for kind, f in records if kind == ANOMALY]
+    assert [f["anomaly"] for f in storm_records] == ["recompile_storm"]
+    assert storm_records[0]["value"] >= storm_records[0]["threshold"]
+    # Hysteresis: clear_after consecutive quiet steps retire the alert but
+    # the fired counter keeps the history.
+    _feed(sent, n=24, recompiles=15)
+    assert "recompile_storm" not in sent.active
+    assert sent.fired.get("recompile_storm") == 1
+
+
+def test_anomaly_sentinel_barrier_frac_spike_fires():
+    from dynamo_tpu.config import AnomalySettings
+    from dynamo_tpu.observability.anomaly import AnomalySentinel
+
+    sent = AnomalySentinel(AnomalySettings(window=16, min_samples=32))
+    _feed(sent, n=64)  # quiet baseline arms the relative detectors
+    _feed(sent, n=16, barrier=True)
+    assert "barrier_frac_spike" in sent.active
+    assert sent.active["barrier_frac_spike"]["value"] >= 0.5
+    assert sent.fired["barrier_frac_spike"] == 1
+    # The spike also shows up as gap-free barrier steps, never as a goodput
+    # drop (outputs stayed constant).
+    assert "goodput_drop" not in sent.fired
+
+
+def test_anomaly_kinds_exported():
+    from dynamo_tpu.observability import ANOMALY_KINDS
+
+    assert set(ANOMALY_KINDS) == {
+        "barrier_frac_spike", "step_gap_regression", "goodput_drop",
+        "recompile_storm", "onboard_shortfall_burst",
+    }
 
 
 # -- timeline assembly --------------------------------------------------------
@@ -377,6 +690,56 @@ def test_assemble_timeline_orders_and_links():
     assert root["root"] is True and root["children"] == [1]
     assert doc["spans"][1]["children"] == [2]
     assert doc["duration_ms"] == 50.0
+    assert all("parent_evicted" not in s for s in doc["spans"])
+
+
+def test_assemble_timeline_surfaces_orphans_of_evicted_parents():
+    """Regression (ISSUE 15 satellite): a span whose parent fell out of the
+    bounded ring used to hang the tree — it must surface at top level,
+    flagged parent_evicted, with its own children intact."""
+    t0 = 3000.0
+    tid = "d" * 32
+    spans = [
+        {"name": "engine_request", "trace_id": tid, "span_id": "a" * 16,
+         "parent_id": "gone000000000000", "start_ts": t0, "duration_ms": 9.0,
+         "status": "ok"},
+        {"name": "kv_scatter", "trace_id": tid, "span_id": "b" * 16,
+         "parent_id": "a" * 16, "start_ts": t0 + 0.001, "duration_ms": 2.0,
+         "status": "ok"},
+    ]
+    doc = assemble_timeline("req-orphan", spans)
+    orphan = doc["spans"][0]
+    assert orphan["name"] == "engine_request"
+    assert orphan["root"] is True and orphan["parent_evicted"] is True
+    assert orphan["children"] == [1]
+    assert "parent_evicted" not in doc["spans"][1]
+
+
+def test_span_buffer_eviction_keeps_children_visible(monkeypatch):
+    """An undersized ring (DYN_SPAN_BUFFER) evicting the root must not make
+    its surviving children vanish from the assembled timeline."""
+    import dynamo_tpu.tracing as tracing
+
+    monkeypatch.setenv("DYN_SPAN_BUFFER", "2")
+    buf = tracing.SpanBuffer(tracing._buffer_capacity())
+    assert buf._spans.maxlen == 2
+    monkeypatch.setattr(tracing, "SPANS", buf)
+    rid = "evict-regress-1"
+    root = Span("http_request", request_id=rid)
+    with root:
+        pass
+    with Span("engine_request", trace=root.context, request_id=rid) as eng:
+        pass
+    with Span("engine_first_token", trace=eng.context, request_id=rid):
+        pass
+    spans = buf.query(request_id=rid)
+    assert {s["name"] for s in spans} == {"engine_request", "engine_first_token"}
+    doc = assemble_timeline(rid, spans)
+    by_name = {s["name"]: s for s in doc["spans"]}
+    assert by_name["engine_request"]["root"] is True
+    assert by_name["engine_request"]["parent_evicted"] is True
+    assert by_name["engine_first_token"].get("parent_evicted") is None
+    assert doc["span_count"] == 2
 
 
 async def test_debug_traces_endpoint_assembles_mocked_disagg_hop():
@@ -437,6 +800,62 @@ async def test_debug_traces_endpoint_assembles_mocked_disagg_hop():
     assert names.index("prefill_exec") < names.index("kv_wire")
 
 
+async def test_debug_explain_endpoint_serves_budget():
+    """GET /debug/explain/{id}: the frontend joins the span union with the
+    debug_explain fan-out's windowed STEP records into a segment budget."""
+    from dynamo_tpu.frontend.http import HttpService
+    from dynamo_tpu.frontend.metrics import FrontendMetrics
+    from dynamo_tpu.frontend.model_manager import ModelManager
+
+    rid = "mock-explain-1"
+    root = Span("http_request", request_id=rid, model="m", endpoint="completions")
+    with root:
+        time.sleep(0.05)
+    start = SPANS.query(request_id=rid)[-1]["start_ts"]
+
+    class FakeTelemetry:
+        def __init__(self):
+            self.windows = []
+
+        async def collect_spans(self, *, request_id=None, trace_id=None):
+            return []
+
+        async def collect_metrics_texts(self):
+            return []
+
+        async def collect_explain(self, *, t0=None, t1=None):
+            self.windows.append((t0, t1))
+            # One step overhanging the ~50ms window: the clamp scales the
+            # decode split down to it, so the budget closes exactly.
+            return [{"worker": "w-x", "steps": [
+                {"ts": start + 0.010, "wall_ms": 60.0, "dispatch_ms": 55.0,
+                 "gap_ms": 0.0, "overlap_mode": "overlapped", "barrier_reason": ""},
+            ], "compiles": [], "lost_time_ms": {}}]
+
+    telemetry = FakeTelemetry()
+    service = HttpService(ModelManager(), metrics=FrontendMetrics(), telemetry=telemetry)
+    port = await service.start("127.0.0.1", 0)
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{port}/debug/explain/{rid}") as r:
+                assert r.status == 200
+                doc = await r.json()
+            async with s.get(f"http://127.0.0.1:{port}/debug/explain/no-such") as r:
+                assert r.status == 404
+    finally:
+        await service.stop()
+
+    assert doc["request_id"] == rid
+    assert doc["decode_worker"] == "w-x"
+    assert doc["steps_in_window"] == 1
+    assert doc["segments"][-1]["name"] == "unattributed"
+    assert doc["within_tolerance"] is True
+    assert doc["coverage_frac"] == pytest.approx(1.0, abs=0.01)
+    # The fan-out was windowed to the request's span bounds (padded 1s).
+    (t0, t1), = telemetry.windows
+    assert t0 <= start and t1 >= start + 0.05
+
+
 # -- full-stack disagg timeline + federation (acceptance criterion) -----------
 
 
@@ -449,6 +868,7 @@ async def test_disagg_request_yields_single_trace_timeline(monkeypatch):
     from dynamo_tpu.disagg import device_transfer, prefill_worker
     from dynamo_tpu.disagg.router import DisaggConfig
     from dynamo_tpu.launch import run_local
+    from dynamo_tpu.observability.attribution import LOSS_CAUSES
 
     # Force the chunked TCP wire path (the phase-span source): disable the
     # same-process device shortcut and the cross-process device pull.
@@ -477,6 +897,10 @@ async def test_disagg_request_yields_single_trace_timeline(monkeypatch):
                 base + "/v1/completions", json=body, headers={"traceparent": traceparent}
             ) as r:
                 assert r.status == 200, await r.text()
+                # Satellite: the unary response surfaces the trace id, so
+                # /debug/traces and /debug/explain are reachable without
+                # grepping logs — and it is the ingested traceparent's id.
+                assert r.headers["x-dynamo-trace-id"] == traceparent.split("-")[1]
 
             # The prefill worker's final phase spans land just after the
             # decode response unblocks — poll the timeline briefly.
@@ -498,6 +922,33 @@ async def test_disagg_request_yields_single_trace_timeline(monkeypatch):
             statuses = {sp["status"] for sp in doc["spans"]}
             assert statuses == {"ok"}
 
+            # Attribution (ISSUE 15 acceptance): the explain budget's
+            # segments must sum to within tolerance of the measured E2E,
+            # joined from this worker's live flight STEP records.
+            explain = None
+            for _ in range(100):
+                async with s.get(f"{base}/debug/explain/{rid}") as r:
+                    if r.status == 200:
+                        explain = await r.json()
+                        if explain.get("within_tolerance") and explain.get("steps_in_window", 0) > 0:
+                            break
+                await asyncio.sleep(0.05)
+            assert explain is not None, "no explain budget assembled"
+            assert explain["within_tolerance"] is True, explain
+            assert explain["steps_in_window"] > 0
+            seg_names = [sg["name"] for sg in explain["segments"]]
+            assert seg_names[-1] == "unattributed"  # residual always reported
+            assert abs(explain["unattributed_ms"]) <= 0.1 * explain["e2e_ms"]
+            assert explain["trace_id"] == traceparent.split("-")[1]
+            known = set(LOSS_CAUSES) | {
+                "queue", "admission", "onboard", "prefill", "transfer_wait",
+                "decode_compute", "recompile", "frontend", "unattributed",
+                "kv_gather", "kv_pack", "kv_wire", "kv_scatter",
+            }
+            for name in seg_names:
+                base_name = name.split(":", 1)[1] if name.startswith("barrier:") else name
+                assert base_name in known, name
+
             # Flight recorder (ISSUE 4): force a mixed step — hold one
             # stream in decode while a second short prompt (below the local
             # prefill threshold) is admitted, so its chunk rows fuse with
@@ -508,6 +959,8 @@ async def test_disagg_request_yields_single_trace_timeline(monkeypatch):
                       "temperature": 0, "stream": True},
             ) as r1:
                 assert r1.status == 200
+                # The SSE response carries the trace id too (satellite).
+                assert len(r1.headers["x-dynamo-trace-id"]) == 32
                 await r1.content.readany()  # first chunk: decode is live
                 async with s.post(
                     base + "/v1/completions",
@@ -573,6 +1026,16 @@ async def test_disagg_request_yields_single_trace_timeline(monkeypatch):
             assert "dynamo_output_tokens_total" in text
             assert "dynamo_engine_recompiles_total" in text
             assert "dynamo_frontend_ttft_quantile_seconds" in text
+            # The time-loss ledger federates with per-cause labels drawn
+            # from the pinned vocabulary (ISSUE 15).
+            assert "dynamo_engine_lost_time_seconds_total" in text
+            assert 'dynamo_engine_step_time_seconds_total' in text
+            causes = {
+                line.split('cause="', 1)[1].split('"', 1)[0]
+                for line in text.splitlines()
+                if line.startswith("dynamo_engine_lost_time_seconds_total{")
+            }
+            assert causes and causes <= set(LOSS_CAUSES), causes
             assert 'dynamo_kv_transfer_phase_seconds_count{phase="wire"' in text
             assert text.count("# TYPE dynamo_engine_pages_total gauge") == 1
             workers = {

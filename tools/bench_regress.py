@@ -1,0 +1,192 @@
+"""Bench-trajectory regression gate: compare the newest ``BENCH_r*.json``
+round against the best prior round, per stable headline key, direction-aware.
+
+Each round file is the driver's wrapper ``{n, cmd, rc, tail, parsed}``.
+``parsed`` holds the bench's final JSON document when the run's last stdout
+line parsed cleanly; otherwise the tail may still end with a recoverable
+JSON line (the bench prints its document last). Rounds where neither yields
+a dict are *unusable* and skipped — a truncated tail is not a measurement.
+
+For every numeric headline key present in both the newest usable round and
+at least one prior usable round, the newest value must not regress past the
+best prior value by more than the tolerance: for higher-is-better keys
+(throughput, gains, coverage fractions) ``new >= best * (1 - tol)``; for
+lower-is-better keys (latencies, idle/barrier fractions)
+``new <= best * (1 + tol)``. Keys with no known direction are reported as
+informational only — an unknown key must not silently gate.
+
+Knobs:
+
+- ``DYN_BENCH_REGRESS_TOLERANCE`` — allowed fractional slack (default 0.25;
+  bench rounds run on shared hardware and are noisy).
+- ``DYN_BENCH_REGRESS_WAIVE`` — comma-separated key names to exempt, or
+  ``all`` to disable the gate (prints findings, always exits 0). Use when a
+  known trade-off intentionally moves a headline key.
+
+Run directly (``python tools/bench_regress.py``) or via the test suite
+(``tests/test_observability.py``). Exits 1 on any unwaived regression.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import re
+import sys
+
+_ROUND = re.compile(r"BENCH_r(\d+)\.json$")
+
+#: Direction of goodness by key suffix. First match wins; unknown keys are
+#: informational. Order matters: "idle_frac"/"barrier_frac" must outrank
+#: the generic "frac" rule.
+_LOWER_BETTER = (
+    "idle_frac", "barrier_frac", "unattributed", "_ms", "_s", "seconds",
+    "stall",
+)
+_HIGHER_BETTER = (
+    "value", "vs_baseline", "per_sec", "per_chip", "gain", "frac",
+    "goodput", "gbytes",
+)
+
+
+def direction(key: str) -> int:
+    """+1 higher-is-better, -1 lower-is-better, 0 unknown."""
+    for suffix in _LOWER_BETTER:
+        if key.endswith(suffix):
+            return -1
+    for suffix in _HIGHER_BETTER:
+        if key.endswith(suffix):
+            return 1
+    return 0
+
+
+def _recover_doc(wrapper: dict) -> dict | None:
+    """The round's bench document: ``parsed``, else the last line of the
+    tail that parses to a dict (the bench prints its document last)."""
+    parsed = wrapper.get("parsed")
+    if isinstance(parsed, dict):
+        return parsed
+    for line in reversed((wrapper.get("tail") or "").splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(doc, dict):
+            return doc
+    return None
+
+
+def load_rounds(root: pathlib.Path | None = None) -> list[tuple[int, dict]]:
+    """Usable (round_number, doc) pairs, ascending. Unusable rounds skip."""
+    root = root or _repo_root()
+    out: list[tuple[int, dict]] = []
+    for path in sorted(root.glob("BENCH_r*.json")):
+        m = _ROUND.search(path.name)
+        if m is None:
+            continue
+        try:
+            wrapper = json.loads(path.read_text())
+        except ValueError:
+            continue
+        doc = _recover_doc(wrapper) if isinstance(wrapper, dict) else None
+        if doc is not None:
+            out.append((int(m.group(1)), doc))
+    out.sort()
+    return out
+
+
+def numeric_keys(doc: dict) -> dict[str, float]:
+    return {
+        k: float(v) for k, v in doc.items()
+        if isinstance(v, (int, float)) and not isinstance(v, bool)
+    }
+
+
+def compare(rounds: list[tuple[int, dict]], *, tolerance: float) -> tuple[list[str], list[str]]:
+    """(regressions, notes) comparing the newest round to the best prior."""
+    if len(rounds) < 2:
+        return [], [f"only {len(rounds)} usable round(s); nothing to compare"]
+    newest_n, newest = rounds[-1]
+    new_vals = numeric_keys(newest)
+    regressions: list[str] = []
+    notes: list[str] = []
+    for key, new in sorted(new_vals.items()):
+        prior = [
+            (n, numeric_keys(doc)[key]) for n, doc in rounds[:-1]
+            if key in numeric_keys(doc)
+        ]
+        if not prior:
+            notes.append(f"{key}: new in r{newest_n:02d} (no trajectory yet)")
+            continue
+        sign = direction(key)
+        if sign == 0:
+            notes.append(f"{key}: no known direction; informational only")
+            continue
+        if sign > 0:
+            best_n, best = max(prior, key=lambda p: p[1])
+            floor = best * (1.0 - tolerance)
+            if new < floor:
+                regressions.append(
+                    f"{key}: r{newest_n:02d}={new:g} fell below r{best_n:02d}="
+                    f"{best:g} by more than {tolerance:.0%} (floor {floor:g})"
+                )
+        else:
+            best_n, best = min(prior, key=lambda p: p[1])
+            ceil = best * (1.0 + tolerance)
+            if new > ceil:
+                regressions.append(
+                    f"{key}: r{newest_n:02d}={new:g} rose above r{best_n:02d}="
+                    f"{best:g} by more than {tolerance:.0%} (ceiling {ceil:g})"
+                )
+    return regressions, notes
+
+
+def check(root: pathlib.Path | None = None) -> list[str]:
+    """Unwaived regressions against the committed bench history."""
+    tolerance = float(os.environ.get("DYN_BENCH_REGRESS_TOLERANCE", "0.25"))
+    waive = {
+        w.strip() for w in os.environ.get("DYN_BENCH_REGRESS_WAIVE", "").split(",")
+        if w.strip()
+    }
+    regressions, _ = compare(load_rounds(root), tolerance=tolerance)
+    if "all" in waive:
+        return []
+    return [r for r in regressions if r.split(":", 1)[0] not in waive]
+
+
+def _repo_root() -> pathlib.Path:
+    return pathlib.Path(__file__).resolve().parent.parent
+
+
+def main() -> int:
+    tolerance = float(os.environ.get("DYN_BENCH_REGRESS_TOLERANCE", "0.25"))
+    rounds = load_rounds()
+    regressions, notes = compare(rounds, tolerance=tolerance)
+    waive = {
+        w.strip() for w in os.environ.get("DYN_BENCH_REGRESS_WAIVE", "").split(",")
+        if w.strip()
+    }
+    gating = [] if "all" in waive else [
+        r for r in regressions if r.split(":", 1)[0] not in waive
+    ]
+    for n in notes:
+        print(f"note: {n}")
+    for r in regressions:
+        tag = "WAIVED" if r not in gating else "FAIL"
+        print(f"{tag}: {r}", file=sys.stderr if tag == "FAIL" else sys.stdout)
+    if gating:
+        return 1
+    usable = ", ".join(f"r{n:02d}" for n, _ in rounds)
+    print(
+        f"ok: newest bench round holds the trajectory "
+        f"(usable rounds: {usable or 'none'}; tolerance {tolerance:.0%})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

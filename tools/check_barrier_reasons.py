@@ -13,6 +13,13 @@ This gate pins all three to each other:
   barrier was erased but its vocabulary row lingers);
 - the SCHEDULER.md barrier table must list exactly ``BARRIER_REASONS``.
 
+It also pins the **loss-cause** vocabulary layered on top (ISSUE 15): the
+``LOSS_CAUSES`` label set of ``dynamo_engine_lost_time_seconds_total`` must
+be exactly ``BARRIER_REASONS`` plus the literal ``EXTRA_LOSS_CAUSES`` tuple
+in ``observability/attribution.py``, and the loss-cause table in
+``docs/OBSERVABILITY.md`` must list exactly that set — a new barrier reason
+is a new loss cause by construction, and it must land in the operator docs.
+
 Run directly (``python tools/check_barrier_reasons.py``) or via the test
 suite (``tests/test_observability.py``).
 """
@@ -87,18 +94,96 @@ def check(declared: tuple[str, ...], recorded: set[str],
     return problems
 
 
+#: The literal extras tuple in attribution.py (parsed from source so a
+#: runtime mutation can't satisfy the gate).
+_EXTRA_TUPLE = re.compile(r"EXTRA_LOSS_CAUSES\s*=\s*\(([^)]*)\)")
+_TUPLE_ITEM = re.compile(r"\"([a-z_]+)\"")
+#: The OBSERVABILITY.md loss-cause section: rows under the "Loss causes"
+#: heading, up to the next heading.
+_LOSS_HEADING = re.compile(r"^#{2,4}\s+Loss causes\b.*$", re.MULTILINE)
+_NEXT_HEADING = re.compile(r"^#{2,4}\s", re.MULTILINE)
+
+
+def declared_loss_causes() -> tuple[str, ...]:
+    from dynamo_tpu.observability.attribution import LOSS_CAUSES
+
+    return tuple(LOSS_CAUSES)
+
+
+def source_extra_causes(root: pathlib.Path | None = None) -> tuple[str, ...]:
+    src = (
+        (root or _repo_root()) / "dynamo_tpu" / "observability" / "attribution.py"
+    ).read_text()
+    m = _EXTRA_TUPLE.search(src)
+    return tuple(_TUPLE_ITEM.findall(m.group(1))) if m else ()
+
+
+def documented_loss_causes(root: pathlib.Path | None = None) -> list[str]:
+    doc = ((root or _repo_root()) / "docs" / "OBSERVABILITY.md").read_text()
+    head = _LOSS_HEADING.search(doc)
+    if head is None:
+        return []
+    seg = doc[head.end():]
+    nxt = _NEXT_HEADING.search(seg)
+    if nxt is not None:
+        seg = seg[: nxt.start()]
+    return _DOC_ROW.findall(seg)
+
+
+def check_loss_causes(
+    declared_barriers: tuple[str, ...],
+    loss_causes: tuple[str, ...],
+    extras: tuple[str, ...],
+    documented: list[str],
+) -> list[str]:
+    problems: list[str] = []
+    if not extras:
+        problems.append(
+            "could not parse the EXTRA_LOSS_CAUSES literal tuple out of "
+            "observability/attribution.py"
+        )
+    expected = tuple(declared_barriers) + tuple(extras)
+    if tuple(loss_causes) != expected:
+        problems.append(
+            f"LOSS_CAUSES is {loss_causes} but must be BARRIER_REASONS + "
+            f"EXTRA_LOSS_CAUSES = {expected}"
+        )
+    docset = set(documented)
+    if len(docset) != len(documented):
+        dupes = sorted({r for r in documented if documented.count(r) > 1})
+        problems.append(f"OBSERVABILITY.md loss-cause table has duplicate rows: {dupes}")
+    losset = set(loss_causes)
+    for r in sorted(docset - losset):
+        problems.append(
+            f"OBSERVABILITY.md documents loss cause {r!r} that LOSS_CAUSES "
+            "does not declare (renamed or removed?)"
+        )
+    for r in sorted(losset - docset):
+        problems.append(
+            f"loss cause {r!r} is missing from the OBSERVABILITY.md "
+            "loss-cause table"
+        )
+    return problems
+
+
 def main() -> int:
     declared = declared_reasons()
     recorded = recorded_reasons()
     documented = documented_reasons()
     problems = check(declared, recorded, documented)
+    problems += check_loss_causes(
+        declared, declared_loss_causes(), source_extra_causes(),
+        documented_loss_causes(),
+    )
     if problems:
         for p in problems:
             print(f"FAIL: {p}", file=sys.stderr)
         return 1
     print(
         f"ok: {len(declared)} barrier reasons — BARRIER_REASONS, the "
-        "_note_barrier call sites, and the SCHEDULER.md table all agree"
+        "_note_barrier call sites, and the SCHEDULER.md table all agree; "
+        f"{len(declared_loss_causes())} loss causes pinned to the barrier "
+        "vocabulary and the OBSERVABILITY.md table"
     )
     return 0
 
