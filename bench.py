@@ -137,14 +137,23 @@ def decode_step_bytes(params, cfg, batch: int, isl: int, osl: int,
       sequence's window is its context rounded up to the page size,
       averaged over the osl decode steps.
     """
-    weight_read = tree_nbytes(params)
-    if not cfg.tie_embeddings:
-        weight_read -= tree_nbytes(params["embed"])
+    weight_read = decode_weight_bytes(params, cfg)
     per_tok = kv_bytes_per_token(cfg, cache_itemsize)
     page_tokens = sum(
         -(-(isl + s + 1) // page_size) * page_size for s in range(osl)
     ) / max(osl, 1)
     return int(weight_read + batch * page_tokens * per_tok)
+
+
+def decode_weight_bytes(params, cfg) -> int:
+    """The weights component of :func:`decode_step_bytes`: measured tree
+    bytes (packed quantized leaves count at their true size, so int8 is
+    ~1 byte/elem and int4 ~0.5) minus the embedding table when untied —
+    decode gathers ``batch`` rows of it, never the full table."""
+    weight_read = tree_nbytes(params)
+    if not cfg.tie_embeddings:
+        weight_read -= tree_nbytes(params["embed"])
+    return weight_read
 
 
 def roofline_tok_per_sec(step_bytes: int, batch: int) -> float:
@@ -1313,9 +1322,146 @@ def probe_fleet_sim() -> dict:
     }
 
 
+def probe_quant_sweep() -> dict:
+    """Quant-mode sweep (ISSUE 16): one shape, bf16 vs int8 vs int4.
+
+    Runs the 8b proxy at an identical (batch, isl, osl) across the three
+    weight formats so the bench trajectory captures the decode roofline
+    burn-down directly. Top-level bench JSON promotes:
+
+      quant_int8_decode_gain — int8 decode tok/s over the bf16 baseline
+      quant_int4_decode_gain — int4 decode tok/s over the bf16 baseline
+      quant_int4_vs_int8_decode_gain — int4 over int8, both measured
+
+    The bf16 leg of an 8B-class proxy does not fit a 16 GB chip; when it
+    OOMs, the baseline falls back to a bandwidth-modeled figure (the int4
+    run's MEASURED achieved GB/s against the bf16 step's modeled bytes)
+    and ``bf16_basis`` says so — on larger-HBM parts all three legs
+    measure for real.
+    """
+    from dynamo_tpu.models.config import PRESETS
+
+    spec = os.environ.get("BENCH_QUANT_SWEEP", "mla-8b-proxy:48:512:64:32")
+    f = spec.split(":")
+    preset, batch = f[0], int(f[1]) if len(f) > 1 else 48
+    isl = int(f[2]) if len(f) > 2 else 512
+    osl = int(f[3]) if len(f) > 3 else 64
+    steps = int(f[4]) if len(f) > 4 else 32
+    cfg = PRESETS[preset]
+    modes: dict = {}
+    for quant in ("", "int8", "int4"):
+        label = quant or "bf16"
+        try:
+            modes[label] = run_config(preset, quant, batch, isl, osl, steps)
+        except Exception as e:  # OOM (bf16 8B on a 16 GB chip) or compile
+            modes[label] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        gc.collect()
+
+    def tps(label: str) -> float:
+        return modes.get(label, {}).get("tok_per_sec", 0.0)
+
+    bf16_basis = "measured"
+    bf16_tps = tps("bf16")
+    if not bf16_tps and tps("int4"):
+        # Model the baseline from the int4 leg's measured bandwidth: same
+        # achieved GB/s, bf16-sized step bytes (weights at 2 bytes/elem).
+        int4 = modes["int4"]
+        bf16_params_bytes = tree_nbytes_modeled_bf16(cfg)
+        int4_step = int4["modeled_step_bytes"]
+        int4_weight = int4["weights_gb"] * 2**30
+        bf16_step = int4_step - int4_weight + bf16_params_bytes
+        bf16_tps = int4["hbm_gbps_achieved"] * 1e9 / bf16_step * batch
+        bf16_basis = "modeled_from_int4_achieved_bw"
+    return {
+        "preset": preset, "batch": batch, "isl": isl, "osl": osl,
+        "decode_steps": steps, "modes": modes,
+        "bf16_basis": bf16_basis,
+        "bf16_baseline_tok_per_sec": round(bf16_tps, 2),
+        "quant_int8_decode_gain": round(tps("int8") / bf16_tps, 4) if bf16_tps else 0.0,
+        "quant_int4_decode_gain": round(tps("int4") / bf16_tps, 4) if bf16_tps else 0.0,
+        "quant_int4_vs_int8_decode_gain": round(
+            tps("int4") / tps("int8"), 4) if tps("int8") else 0.0,
+    }
+
+
+def tree_nbytes_modeled_bf16(cfg) -> int:
+    """Weight bytes of the preset AT bf16 without materializing the tree
+    (the whole point is that the bf16 tree may not fit)."""
+    import jax
+
+    from dynamo_tpu.models import llama
+
+    shapes = jax.eval_shape(lambda: llama.init_params(cfg, 0))
+    return sum(l.size * l.dtype.itemsize for l in jax.tree.leaves(shapes))
+
+
+def probe_mask_build() -> dict:
+    """Constrained-decoding cold-mask-build probe (ISSUE 16).
+
+    Builds masks for a corpus of JSON-machine summaries over a synthetic
+    128k-piece vocab with the vectorized builder and the pure-Python one,
+    asserting bitwise identity (masks, close budgets, transition
+    descriptors). Top-level bench JSON promotes:
+
+      constraint_mask_build_ms — mean vectorized cold-build wall ms
+      constraint_mask_build_gain — pure-Python ms over vectorized ms
+    """
+    import random
+
+    from dynamo_tpu import constrained as C
+
+    vocab = int(os.environ.get("BENCH_MASK_VOCAB", "128000"))
+    rnd = random.Random(7)
+    chars = list('{}[]",: \t\n0123456789.-+eE') + list(
+        "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ_\\/"
+    ) + ["٣", "é", "世", "�"]
+    pieces = [""]
+    while len(pieces) < vocab:
+        n = rnd.choice((1, 1, 2, 3, 4, 5, 6, 8, 12))
+        pieces.append("".join(rnd.choice(chars) for _ in range(n)))
+
+    class _Tok:
+        def decode(self, ids, skip_special_tokens=False):
+            return pieces[ids[0]]
+
+    states = [
+        C.advance_text(C.MachineState(), t)
+        for t in ("", "{", '{"', '{"k": ', '{"k": "v', '{"k": [1, ', "[1")
+    ]
+    cache = C.TokenMaskCache(_Tok(), len(pieces), (0,))
+    plist = cache._ensure_pieces()
+    t0 = time.perf_counter()
+    cache._vocab_table()
+    table_s = time.perf_counter() - t0
+    vec_s = py_s = 0.0
+    mismatches = 0
+    for st in states:
+        key = st.summary()
+        t0 = time.perf_counter()
+        av, cv = cache._build_mask_vectorized(st, key, plist)
+        vec_s += time.perf_counter() - t0
+        dv = cache._descs[key]
+        t0 = time.perf_counter()
+        ap, cp = cache._build_mask_python(st, key, plist)
+        py_s += time.perf_counter() - t0
+        dp = cache._descs[key]
+        if not (np.array_equal(av, ap) and np.array_equal(cv, cp)
+                and np.array_equal(dv[0], dp[0]) and dv[1] == dp[1]):
+            mismatches += 1
+    n = len(states)
+    return {
+        "vocab": vocab, "summaries": n, "mismatches": mismatches,
+        "table_build_ms": round(table_s * 1e3, 1),
+        "python_build_ms": round(py_s / n * 1e3, 1),
+        "constraint_mask_build_ms": round(vec_s / n * 1e3, 2),
+        "constraint_mask_build_gain": round(py_s / vec_s, 1) if vec_s else 0.0,
+    }
+
+
 def build_doc(configs, pull, wire=None, stall=None, spec=None,
               decode_kernel=None, slo_sched=None, overlap=None,
-              prefix_reuse=None, fleet=None) -> dict:
+              prefix_reuse=None, fleet=None, quant_sweep=None,
+              mask_build=None) -> dict:
     """The bench JSON document (one stdout line per emit).
 
     Module-level (not a closure) so its top-level key contract — the stable
@@ -1399,6 +1545,23 @@ def build_doc(configs, pull, wire=None, stall=None, spec=None,
         "fleet_goodput_frac_at_slo": (fleet or {}).get(
             "fleet_goodput_frac_at_slo", 0.0),
         "fleet_tenant_fairness": (fleet or {}).get("fleet_tenant_fairness", 0.0),
+        # Quantization headline keys (ISSUE 16): decode tok/s of each weight
+        # format over the bf16 baseline on one 8b-proxy shape, plus the
+        # always-measured int4-over-int8 ratio (see probe_quant_sweep for
+        # the bf16 OOM fallback semantics).
+        "quant_int8_decode_gain": (quant_sweep or {}).get(
+            "quant_int8_decode_gain", 0.0),
+        "quant_int4_decode_gain": (quant_sweep or {}).get(
+            "quant_int4_decode_gain", 0.0),
+        "quant_int4_vs_int8_decode_gain": (quant_sweep or {}).get(
+            "quant_int4_vs_int8_decode_gain", 0.0),
+        # Constrained-decoding cold-build headline keys (ISSUE 16): mean
+        # vectorized cold mask build at 128k vocab and its speedup over the
+        # pure-Python builder, bitwise-identity asserted (probe_mask_build).
+        "constraint_mask_build_ms": (mask_build or {}).get(
+            "constraint_mask_build_ms", 0.0),
+        "constraint_mask_build_gain": (mask_build or {}).get(
+            "constraint_mask_build_gain", 0.0),
         "detail": {
             "backend": jax.default_backend(),
             "suite": [c.get("preset") for c in configs],
@@ -1410,6 +1573,8 @@ def build_doc(configs, pull, wire=None, stall=None, spec=None,
             "engine_overlap_probe": overlap or {"pending": True},
             "prefix_reuse_probe": prefix_reuse or {"pending": True},
             "fleet_sim_probe": fleet or {"pending": True},
+            "quant_sweep_probe": quant_sweep or {"pending": True},
+            "mask_build_probe": mask_build or {"pending": True},
             "kv_pull": pull,
             "kv_wire_cross_process": wire or {"pending": True},
             "ttft_note": "ttft_idle_* is the drained-engine best case; "
@@ -1422,9 +1587,9 @@ def main() -> None:
     from dynamo_tpu.models.config import PRESETS
 
     def emit(configs, pull, wire=None, stall=None, spec=None, dk=None, ss=None,
-             ov=None, pr=None, fl=None):
+             ov=None, pr=None, fl=None, qs=None, mb=None):
         print(json.dumps(build_doc(configs, pull, wire, stall, spec, dk, ss, ov,
-                                   pr, fl)),
+                                   pr, fl, qs, mb)),
               flush=True)
 
     suite = parse_suite()
@@ -1499,17 +1664,32 @@ def main() -> None:
          pr=pr, fl=fl)
     gc.collect()
     try:
+        qs = probe_quant_sweep()
+    except Exception as e:
+        qs = {"error": f"{type(e).__name__}: {e}"[:200]}
+    emit(configs, {"pending": True}, stall=stall, spec=spec, dk=dk, ss=ss, ov=ov,
+         pr=pr, fl=fl, qs=qs)
+    gc.collect()
+    try:
+        mb = probe_mask_build()
+    except Exception as e:
+        mb = {"error": f"{type(e).__name__}: {e}"[:200]}
+    emit(configs, {"pending": True}, stall=stall, spec=spec, dk=dk, ss=ss, ov=ov,
+         pr=pr, fl=fl, qs=qs, mb=mb)
+    gc.collect()
+    try:
         pull = probe_kv_pull_gbps()
     except Exception as e:
         pull = {"error": f"{type(e).__name__}: {e}"[:200]}
-    emit(configs, pull, stall=stall, spec=spec, dk=dk, ss=ss, ov=ov, pr=pr, fl=fl)
+    emit(configs, pull, stall=stall, spec=spec, dk=dk, ss=ss, ov=ov, pr=pr, fl=fl,
+         qs=qs, mb=mb)
     gc.collect()
     try:
         wire = probe_cross_process_wire()
     except Exception as e:
         wire = {"error": f"{type(e).__name__}: {e}"[:200]}
     emit(configs, pull, wire, stall=stall, spec=spec, dk=dk, ss=ss, ov=ov, pr=pr,
-         fl=fl)
+         fl=fl, qs=qs, mb=mb)
 
 
 if __name__ == "__main__":

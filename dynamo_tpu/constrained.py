@@ -33,6 +33,8 @@ strings only when they decode to the replacement char.
 from __future__ import annotations
 
 import dataclasses
+import os
+import time
 
 import numpy as np
 
@@ -228,6 +230,450 @@ def advance_text_tracked(state: MachineState, text: str) -> tuple[MachineState, 
 _CLOSERS = {"{": "}", "[": "]"}
 
 
+# ---------------------------------------------------------------------------
+# Vectorized mask builder
+#
+# The pure-Python builder walks every vocab piece through ``advance`` char by
+# char — ~0.4 s per cold summary at a 128k vocab, which caps chained
+# JSON-mode traffic at exactly the vocab sizes production models use. The
+# machine's per-piece state is finite and small (mode enum, one of ~27
+# literal/phase strings, a relative stack depth, a handful of flags), so the
+# whole vocab can be simulated COLUMN-WISE as numpy array ops: one pass over
+# ``max_piece_len`` columns updates every piece's machine in lockstep. The
+# result (mask, close budgets, transition descriptors) is bitwise identical
+# to the Python builder's; DYN_CONSTRAINT_VECTOR_MASKS=0 falls back.
+# ---------------------------------------------------------------------------
+
+# Mode ids (np.int8 enum mirroring the single-char mode constants).
+_M_V, _M_S, _M_E, _M_U, _M_N, _M_A, _M_K, _M_C, _M_L, _M_X = range(10)
+_MODE_ID = {VALUE: _M_V, IN_STRING: _M_S, STR_ESCAPE: _M_E, STR_HEX: _M_U,
+            IN_NUMBER: _M_N, AFTER_VALUE: _M_A, EXPECT_KEY: _M_K,
+            AFTER_KEY: _M_C, LITERAL: _M_L, REJECT: _M_X}
+_MODE_STR = {v: k for k, v in _MODE_ID.items()}
+
+# Every value MachineState.literal can hold: the empty/key markers, literal
+# tails, pending-hex chains (value and key strings), and number phases.
+_LIT_STRINGS = (
+    "", "k",
+    "rue", "ue", "e", "alse", "lse", "se", "ull", "ll", "l",
+    "hhhh", "hhh", "hh", "h", "khhhh", "khhh", "khh", "kh",
+    "sign", "zero", "int", "frac0", "frac", "exp0", "exp1", "exp",
+)
+_LIT_ID = {s: i for i, s in enumerate(_LIT_STRINGS)}
+_NLIT = len(_LIT_STRINGS)
+
+# LITERAL mode: expected next char code and successor lit id (-1 = literal
+# complete -> AFTER_VALUE).
+_LIT_EXPECT = np.zeros(_NLIT, np.int32)
+_LIT_NEXT = np.full(_NLIT, -1, np.int8)
+for _s in ("rue", "ue", "e", "alse", "lse", "se", "ull", "ll", "l"):
+    _LIT_EXPECT[_LIT_ID[_s]] = ord(_s[0])
+    _LIT_NEXT[_LIT_ID[_s]] = _LIT_ID.get(_s[1:], -1) if len(_s) > 1 else -1
+# STR_HEX chains: one hex digit consumed -> lit[:-1]; stay in STR_HEX while
+# the rest still ends in 'h', else back to IN_STRING with "" / "k".
+_HEX_NEXT = np.zeros(_NLIT, np.int8)
+_HEX_STAY = np.zeros(_NLIT, bool)
+for _s in ("hhhh", "hhh", "hh", "h", "khhhh", "khhh", "khh", "kh"):
+    _rest = _s[:-1]
+    _HEX_NEXT[_LIT_ID[_s]] = _LIT_ID[_rest]
+    _HEX_STAY[_LIT_ID[_s]] = _rest.endswith("h")
+# STR_ESCAPE '\u': lit ("" or "k") gains four pending hex digits.
+_ESC_U_NEXT = np.zeros(_NLIT, np.int8)
+_ESC_U_NEXT[_LIT_ID[""]] = _LIT_ID["hhhh"]
+_ESC_U_NEXT[_LIT_ID["k"]] = _LIT_ID["khhhh"]
+# budget_to_close lookups: literal length, pending hex digits, key marker.
+_LIT_LEN = np.array([len(s) for s in _LIT_STRINGS], np.int16)
+_LIT_HCOUNT = np.array([s.count("h") for s in _LIT_STRINGS], np.int16)
+_LIT_ISKEY = np.array([s.startswith("k") for s in _LIT_STRINGS], bool)
+
+# Char property bits. ASCII from the table below; non-ASCII chars carry
+# only _P_UDIG (``str.isdigit()`` — the Python machine's number phases use
+# it, so e.g. Arabic-Indic digits advance IN_NUMBER exactly as they do
+# there; everything else is plain string content matching no structural
+# char).
+_P_WS, _P_DIG19, _P_ZERO, _P_HEX, _P_ESC, _P_CTRL, _P_UDIG = 1, 2, 4, 8, 16, 32, 64
+_PROPS = np.zeros(128, np.uint8)
+for _c in _WS:
+    _PROPS[ord(_c)] |= _P_WS
+for _c in "123456789":
+    _PROPS[ord(_c)] |= _P_DIG19
+_PROPS[ord("0")] |= _P_ZERO
+for _c in "0123456789abcdefABCDEF":
+    _PROPS[ord(_c)] |= _P_HEX
+for _c in _ESCAPABLE:
+    _PROPS[ord(_c)] |= _P_ESC
+_PROPS[:0x20] |= _P_CTRL
+for _c in "0123456789":
+    _PROPS[ord(_c)] |= _P_UDIG
+
+_SYM_ID = {"{": 1, "[": 2}
+_SYM_STR = {1: "{", 2: "["}
+
+# Number phase lit-id groups (IN_NUMBER rides its phase in ``literal``).
+_PH_SIGN, _PH_ZERO, _PH_INT = _LIT_ID["sign"], _LIT_ID["zero"], _LIT_ID["int"]
+_PH_FRAC0, _PH_FRAC = _LIT_ID["frac0"], _LIT_ID["frac"]
+_PH_EXP0, _PH_EXP1, _PH_EXP = _LIT_ID["exp0"], _LIT_ID["exp1"], _LIT_ID["exp"]
+_PH_CORE = np.zeros(_NLIT, bool)  # zero|int|frac|exp: digit-extensible
+for _p in (_PH_ZERO, _PH_INT, _PH_FRAC, _PH_EXP):
+    _PH_CORE[_p] = True
+_PH_DOT_OK = np.zeros(_NLIT, bool)  # '.' legal: zero|int
+_PH_DOT_OK[_PH_ZERO] = _PH_DOT_OK[_PH_INT] = True
+_PH_EXP_OK = np.zeros(_NLIT, bool)  # e|E legal: zero|int|frac
+_PH_EXP_OK[_PH_ZERO] = _PH_EXP_OK[_PH_INT] = _PH_EXP_OK[_PH_FRAC] = True
+
+# Mode-keyed budget_to_close extras (IN_NUMBER/STR_HEX/LITERAL handled
+# separately — they depend on lit/num_ok).
+_MODE_EXTRA = np.zeros(10, np.int16)
+for _m, _x in ((_M_S, 1), (_M_E, 2), (_M_C, 2), (_M_V, 1), (_M_K, 1)):
+    _MODE_EXTRA[_m] = _x
+
+
+class _VocabTable:
+    """Per-tokenizer piece descriptors for the vectorized builder: a padded
+    char-code matrix plus per-char ASCII property bits, built once."""
+
+    def __init__(self, pieces: list[str]) -> None:
+        V = len(pieces)
+        lens = np.fromiter((len(p) for p in pieces), np.int32, count=V)
+        maxlen = int(lens.max()) if V else 0
+        codes = np.full((V, max(maxlen, 1)), -1, np.int32)
+        for t, p in enumerate(pieces):
+            if p:
+                codes[t, : len(p)] = np.frombuffer(p.encode("utf-32-le"), "<u4")
+        props = np.zeros_like(codes, np.uint8)
+        ascii_mask = (codes >= 0) & (codes < 128)
+        props[ascii_mask] = _PROPS[codes[ascii_mask]]
+        hi = codes >= 128
+        if hi.any():
+            uniq = np.unique(codes[hi])
+            udig = np.fromiter(
+                (chr(int(u)).isdigit() for u in uniq), bool, count=uniq.size
+            )
+            props[hi] |= np.where(
+                udig[np.searchsorted(uniq, codes[hi])], _P_UDIG, 0
+            ).astype(np.uint8)
+        self.lens = lens
+        self.codes = codes
+        self.props = props
+        self.maxlen = maxlen
+        self.empty = lens == 0
+        self.has_replacement = (codes == 0xFFFD).any(axis=1)
+
+
+def _simulate_vocab(state: MachineState, tab: _VocabTable):
+    """Run every vocab piece through the machine in lockstep.
+
+    Returns ``(mode, lit, rel, minrel, num_ok, no_close, buf)`` final
+    arrays; ``buf[t, s]`` is piece ``t``'s current stack symbol at relative
+    depth ``s - 3`` (slots 0-2 pre-seeded with the summary's recorded
+    ``stack[-3:]``, 0 = no symbol), so ``buf[t, minrel+3 : rel+3]`` is
+    exactly ``ns.stack[min_depth:]``. Pieces are REJECTed (mode X) exactly
+    when the Python machine rejects them, plus — for depth > 3 states —
+    when they dip below the recorded stack suffix (the soundness floor
+    would disallow them anyway, and early kill keeps slot indices valid).
+    """
+    V, width = tab.codes.shape
+    depth0 = state.depth
+    deep = depth0 > 3
+    mode = np.full(V, _MODE_ID[state.mode], np.int8)
+    lit = np.full(V, _LIT_ID[state.literal], np.int8)
+    rel = np.zeros(V, np.int16)
+    minrel = np.zeros(V, np.int16)
+    num_ok = np.full(V, state.num_ok, bool)
+    no_close = np.full(V, state.no_close, bool)
+    buf = np.zeros((V, width + 3), np.uint8)
+    base = state.stack[-3:]
+    for i, sym in enumerate(base):
+        buf[:, 3 - len(base) + i] = _SYM_ID[sym]
+
+    Q, BSL, LB, RB, LK, RK = ord('"'), ord("\\"), ord("{"), ord("}"), ord("["), ord("]")
+    COMMA, COLON, MINUS, PLUS, DOT = ord(","), ord(":"), ord("-"), ord("+"), ord(".")
+    ZERO, LE, UE, LU = ord("0"), ord("e"), ord("E"), ord("u")
+    LT, LF, LN = ord("t"), ord("f"), ord("n")
+
+    # The column loop runs COMPACTED: ``idx`` holds the still-live row ids
+    # (piece long enough, not REJECTed) and every block operates on arrays
+    # of ``idx.size``. Rejections shrink the working set fast (a cold build
+    # in a structural mode kills most of a random vocab in the first one
+    # or two columns), so later columns cost almost nothing. ``m``/``l``/
+    # ``nk``/``nc`` are the compact views, scattered back each column;
+    # ``rel``/``minrel``/``buf`` are touched by few rows (pushes/pops) and
+    # stay full-width, indexed through ``idx``.
+    live = np.flatnonzero(tab.lens > 0)
+    for j in range(tab.maxlen):
+        if j:
+            live = live[(tab.lens[live] > j) & (mode[live] != _M_X)]
+        if live.size == 0:
+            break
+        idx = live
+        m = mode[idx]
+        l = lit[idx]
+        nk = num_ok[idx]
+        nc = no_close[idx]
+        c = tab.codes[idx, j]
+        p = tab.props[idx, j]
+        ws = (p & _P_WS) != 0
+        todo = np.ones(idx.size, bool)
+
+        def pop_rows(rows):
+            """Pop one level for compact positions ``rows`` (top already
+            verified) -> AFTER_VALUE with default flags."""
+            g = idx[rows]
+            rel[g] -= 1
+            minrel[g] = np.minimum(minrel[g], rel[g])
+            m[rows] = _M_A
+            l[rows] = 0
+            nk[rows] = False
+            nc[rows] = False
+            if deep:
+                m[rows[rel[g] < -2]] = _M_X  # below the recorded suffix
+
+        def top_of(rows):
+            """Current stack-top symbol per compact position (0 = empty)."""
+            g = idx[rows]
+            r = rel[g]
+            t = buf[g, np.maximum(r + 2, 0)]
+            return np.where(depth0 + r > 0, t, 0)
+
+        # IN_STRING: '"' ends (key -> AFTER_KEY), '\' escapes, control
+        # dies; every step is a fresh st(...) so both flags reset.
+        sel = todo & (m == _M_S)
+        if sel.any():
+            q = sel & (c == Q)
+            m[q] = np.where(l[q] == _LIT_ID["k"], _M_C, _M_A).astype(np.int8)
+            l[q] = 0
+            m[sel & (c == BSL)] = _M_E
+            m[sel & ((p & _P_CTRL) != 0)] = _M_X
+            nk[sel] = False
+            nc[sel] = False
+            todo[sel] = False
+
+        # STR_ESCAPE: 'u' starts a hex run, escapables return to the string.
+        sel = todo & (m == _M_E)
+        if sel.any():
+            u = sel & (c == LU)
+            l[u] = _ESC_U_NEXT[l[u]]
+            m[u] = _M_U
+            m[sel & ~u & ((p & _P_ESC) != 0)] = _M_S
+            m[sel & ~u & ((p & _P_ESC) == 0)] = _M_X
+            nk[sel] = False
+            nc[sel] = False
+            todo[sel] = False
+
+        # STR_HEX: consume one pending digit; non-hex dies.
+        sel = todo & (m == _M_U)
+        if sel.any():
+            hx = sel & ((p & _P_HEX) != 0)
+            stay = _HEX_STAY[l[hx]]
+            nxt = _HEX_NEXT[l[hx]]
+            m[hx] = np.where(stay, _M_U, _M_S).astype(np.int8)
+            l[hx] = nxt
+            m[sel & ~hx] = _M_X
+            nk[sel] = False
+            nc[sel] = False
+            todo[sel] = False
+
+        # LITERAL: exact-char chain; completion -> AFTER_VALUE.
+        sel = todo & (m == _M_L)
+        if sel.any():
+            exp = _LIT_EXPECT[l]
+            hit = sel & (c == exp) & (exp != 0)  # exp 0: empty lit, no match
+            nxt = _LIT_NEXT[l[hit]]
+            m[hit] = np.where(nxt < 0, _M_A, _M_L).astype(np.int8)
+            l[hit] = np.maximum(nxt, 0)
+            m[sel & ~hit] = _M_X
+            nk[sel] = False
+            nc[sel] = False
+            todo[sel] = False
+
+        # IN_NUMBER: phase grammar; a delimiter on a terminable number
+        # re-dispatches through AFTER_VALUE *in this same column* (the rows
+        # stay on the todo list and the AFTER_VALUE block below picks them
+        # up), matching advance()'s recursive re-interpretation.
+        sel = todo & (m == _M_N)
+        if sel.any():
+            # Every legacy num() construction leaves no_close at its default.
+            nc[sel] = False
+            isdig = (p & (_P_DIG19 | _P_ZERO | _P_UDIG)) != 0
+            s_sign = sel & (l == _PH_SIGN)
+            if s_sign.any():
+                z = s_sign & (c == ZERO)
+                l[z] = _PH_ZERO
+                nk[z] = True
+                d = s_sign & isdig & (c != ZERO)
+                l[d] = _PH_INT
+                nk[d] = True
+                m[s_sign & ~isdig] = _M_X
+                todo[s_sign] = False
+            core = sel & _PH_CORE[l] & todo
+            if core.any():
+                m[core & isdig & (l == _PH_ZERO)] = _M_X  # "01" is not JSON
+                nk[core & isdig & (l != _PH_ZERO)] = True
+                dot = core & (c == DOT) & _PH_DOT_OK[l]
+                l[dot] = _PH_FRAC0
+                nk[dot] = False
+                ee = core & ((c == LE) | (c == UE)) & _PH_EXP_OK[l]
+                l[ee] = _PH_EXP0
+                nk[ee] = False
+                delim = core & ~isdig & ~dot & ~ee
+                m[delim & ~nk] = _M_X
+                redo = delim & nk
+                m[redo] = _M_A
+                l[redo] = 0
+                nk[redo] = False
+                nc[redo] = False
+                todo[core] = False
+                todo[redo] = True  # AFTER_VALUE reprocesses this char below
+            f0 = sel & (l == _PH_FRAC0) & todo
+            if f0.any():
+                d = f0 & isdig
+                l[d] = _PH_FRAC
+                nk[d] = True
+                m[f0 & ~isdig] = _M_X
+                todo[f0] = False
+            e0 = sel & (l == _PH_EXP0) & todo
+            if e0.any():
+                pm = e0 & ((c == PLUS) | (c == MINUS))
+                l[pm] = _PH_EXP1
+                nk[pm] = False
+                d = e0 & isdig
+                l[d] = _PH_EXP
+                nk[d] = True
+                m[e0 & ~isdig & ~pm] = _M_X
+                todo[e0] = False
+            e1 = sel & (l == _PH_EXP1) & todo
+            if e1.any():
+                d = e1 & isdig
+                l[d] = _PH_EXP
+                nk[d] = True
+                m[e1 & ~isdig] = _M_X
+                todo[e1] = False
+
+        # AFTER_VALUE: WS stays (state untouched), ',' reopens (no_close
+        # set), closers pop.
+        sel = todo & (m == _M_A)
+        if sel.any():
+            todo[sel & ws] = False
+            sel &= ~ws
+            if sel.any():
+                rows = np.flatnonzero(sel)
+                top = top_of(rows)
+                ch = c[rows]
+                comma = (ch == COMMA) & (top != 0)
+                cr = rows[comma]
+                m[cr] = np.where(top[comma] == 1, _M_K, _M_V).astype(np.int8)
+                l[cr] = 0
+                nk[cr] = False
+                nc[cr] = True
+                popm = ((ch == RB) & (top == 1)) | ((ch == RK) & (top == 2))
+                pop_rows(rows[popm])
+                m[rows[~comma & ~popm]] = _M_X
+                todo[sel] = False
+
+        # VALUE: value starts, '[' / '{' pushes, ']' closes an empty array.
+        sel = todo & (m == _M_V)
+        if sel.any():
+            todo[sel & ws] = False
+            sel &= ~ws
+            if sel.any():
+                q = sel & (c == Q)
+                m[q] = _M_S
+                l[q] = 0
+                nk[q] = False
+                nc[q] = False
+                mi = sel & (c == MINUS)
+                m[mi] = _M_N
+                l[mi] = _PH_SIGN
+                nk[mi] = False
+                nc[mi] = False
+                z = sel & (c == ZERO)
+                m[z] = _M_N
+                l[z] = _PH_ZERO
+                nk[z] = True
+                nc[z] = False
+                d = sel & ((p & _P_DIG19) != 0)
+                m[d] = _M_N
+                l[d] = _PH_INT
+                nk[d] = True
+                nc[d] = False
+                handled = q | mi | z | d
+                for code, tail in ((LT, "rue"), (LF, "alse"), (LN, "ull")):
+                    li = sel & (c == code)
+                    m[li] = _M_L
+                    l[li] = _LIT_ID[tail]
+                    nk[li] = False
+                    nc[li] = False
+                    handled |= li
+                for code, tgt, sym in ((LB, _M_K, 1), (LK, _M_V, 2)):
+                    op = sel & (c == code)
+                    if op.any():
+                        rows = np.flatnonzero(op)
+                        g = idx[rows]
+                        buf[g, rel[g] + 3] = sym
+                        rel[g] += 1
+                        m[rows] = tgt
+                        l[rows] = 0
+                        nk[rows] = False
+                        nc[rows] = False
+                    handled |= op
+                cl = sel & (c == RK) & ~nc
+                if cl.any():
+                    rows = np.flatnonzero(cl)
+                    okt = top_of(rows) == 2
+                    pop_rows(rows[okt])
+                    m[rows[~okt]] = _M_X
+                handled |= cl
+                m[sel & ~handled] = _M_X  # incl. ']' right after a comma
+                todo[sel] = False
+
+        # EXPECT_KEY: key string or '}' (unless just after a comma).
+        sel = todo & (m == _M_K)
+        if sel.any():
+            todo[sel & ws] = False
+            sel &= ~ws
+            if sel.any():
+                q = sel & (c == Q)
+                m[q] = _M_S
+                l[q] = _LIT_ID["k"]
+                nk[q] = False
+                nc[q] = False
+                cl = sel & (c == RB) & ~nc
+                if cl.any():
+                    rows = np.flatnonzero(cl)
+                    okt = top_of(rows) == 1
+                    pop_rows(rows[okt])
+                    m[rows[~okt]] = _M_X
+                m[sel & ~q & ~cl] = _M_X  # incl. '}' right after a comma
+                todo[sel] = False
+
+        # AFTER_KEY: only ':'.
+        sel = todo & (m == _M_C)
+        if sel.any():
+            todo[sel & ws] = False
+            sel &= ~ws
+            if sel.any():
+                col = sel & (c == COLON)
+                m[col] = _M_V
+                l[col] = 0
+                nk[col] = False
+                nc[col] = False
+                m[sel & ~col] = _M_X
+                todo[sel] = False
+
+        mode[idx] = m
+        lit[idx] = l
+        num_ok[idx] = nk
+        no_close[idx] = nc
+
+    return mode, lit, rel, minrel, num_ok, no_close, buf
+
+
+def _vector_masks_enabled() -> bool:
+    """DYN_CONSTRAINT_VECTOR_MASKS=0 falls back to the pure-Python builder
+    (escape hatch; outputs are bitwise identical, only build time differs)."""
+    return os.environ.get("DYN_CONSTRAINT_VECTOR_MASKS", "1") != "0"
+
+
 class TokenMaskCache:
     """Per-tokenizer vocab masks keyed by machine summary."""
 
@@ -255,6 +701,13 @@ class TokenMaskCache:
         # the summary).
         self.hits = 0
         self.misses = 0
+        # Per-tokenizer piece descriptor arrays for the vectorized builder,
+        # computed lazily on the first cold build.
+        self._table: _VocabTable | None = None
+        # Wall-clock seconds of each cold mask build since the last drain;
+        # the metrics plane observes these into the
+        # dynamo_engine_constraint_mask_build_seconds histogram.
+        self._build_seconds: list[float] = []
         # Serializes the seconds-long cold builds (piece table, per-summary
         # vocab walks): the warm-up thread and a racing request must not
         # duplicate them, and the second comer blocks instead of recomputing.
@@ -314,6 +767,101 @@ class TokenMaskCache:
             return self._build_mask(state, key, pieces)
 
     def _build_mask(self, state: MachineState, key: tuple, pieces) -> tuple[np.ndarray, np.ndarray]:
+        """Cold build (caller holds ``_build_lock``): dispatch to the
+        vectorized builder, timing the build for the metrics histogram."""
+        t0 = time.perf_counter()
+        if _vector_masks_enabled():
+            out = self._build_mask_vectorized(state, key, pieces)
+        else:
+            out = self._build_mask_python(state, key, pieces)
+        self._build_seconds.append(time.perf_counter() - t0)
+        return out
+
+    def drain_build_seconds(self) -> list[float]:
+        """Cold-build durations since the last drain (metrics scrape path)."""
+        out, self._build_seconds = self._build_seconds, []
+        return out
+
+    def _vocab_table(self) -> _VocabTable:
+        # Only reached from _build_mask (under _build_lock) AFTER _base_mask
+        # materialized the pieces, so _ensure_pieces returns without trying
+        # to re-take the (non-reentrant) lock.
+        if self._table is None:
+            self._table = _VocabTable(self._ensure_pieces())
+        return self._table
+
+    def _build_mask_vectorized(
+        self, state: MachineState, key: tuple, pieces
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Column-wise numpy simulation of the whole vocab; outputs are
+        bitwise identical to :meth:`_build_mask_python` (the parity suite in
+        tests/test_constrained.py checks masks, close budgets, descriptor
+        ids AND decoded descriptors across a summary corpus)."""
+        tab = self._vocab_table()
+        mode, lit, rel, minrel, num_ok, no_close, buf = _simulate_vocab(state, tab)
+        # Admission: the simulation already REJECTs every piece the Python
+        # machine rejects, and (deep states) every piece dipping below the
+        # recorded stack suffix — exactly the soundness floor. Empty pieces
+        # and lossy-decode pieces mirror the Python builder's skips.
+        allowed = (mode != _M_X) & ~tab.empty
+        if state.mode in (IN_STRING, STR_ESCAPE, STR_HEX, VALUE, EXPECT_KEY):
+            allowed &= ~tab.has_replacement
+        # budget_to_close(ns) - state.depth, computed in the same override
+        # order as the scalar method (mode extra -> hex -> key-string ->
+        # unterminable number -> post-comma EXPECT_KEY).
+        extra = _MODE_EXTRA[mode].astype(np.int32)
+        isL = mode == _M_L
+        extra[isL] = _LIT_LEN[lit[isL]]
+        isU = mode == _M_U
+        extra[isU] = _LIT_HCOUNT[lit[isU]] + 1
+        keystr = ((mode == _M_S) | (mode == _M_E) | (mode == _M_U)) & _LIT_ISKEY[lit]
+        extra[keystr] += 2
+        badnum = (mode == _M_N) & ~num_ok
+        extra[badnum] = 1
+        kc = (mode == _M_K) & no_close
+        extra[kc] = 5
+        close = np.minimum(rel.astype(np.int32) + extra + 1, 2**14)
+        close_after = np.where(allowed, close, 0).astype(np.int16)
+        # Transition descriptors: dedup admitted pieces on fixed-width byte
+        # records of (mode, literal, min rel depth, flags, stack slice), with
+        # ids assigned in FIRST-OCCURRENCE (= token) order so they match the
+        # Python builder's incremental numbering exactly.
+        desc_ids = np.full(self.vocab_size, -1, np.int32)
+        descs: list[tuple] = []
+        tok = np.flatnonzero(allowed)
+        if tok.size:
+            width = buf.shape[1]
+            slot = np.arange(width)[None, :]
+            mb = buf[tok]
+            keep = (slot >= minrel[tok, None] + 3) & (slot < rel[tok, None] + 3)
+            mb = np.where(keep, mb, 0)
+            rec = np.empty((tok.size, 5 + width), np.uint8)
+            rec[:, 0] = mode[tok]
+            rec[:, 1] = lit[tok]
+            rec[:, 2] = (minrel[tok] + 3).astype(np.uint8)
+            rec[:, 3] = num_ok[tok]
+            rec[:, 4] = no_close[tok]
+            rec[:, 5:] = mb
+            rec = np.ascontiguousarray(rec)
+            flat = rec.view(np.dtype((np.void, rec.shape[1]))).ravel()
+            _, first_idx, inv = np.unique(flat, return_index=True, return_inverse=True)
+            order = np.argsort(first_idx, kind="stable")
+            rank = np.empty(order.size, np.int32)
+            rank[order] = np.arange(order.size, dtype=np.int32)
+            desc_ids[tok] = rank[inv]
+            for g in range(order.size):
+                r = rec[first_idx[order[g]]]
+                body = r[5:]
+                pushed = tuple(_SYM_STR[int(s)] for s in body[body != 0])
+                descs.append((
+                    _MODE_STR[int(r[0])], _LIT_STRINGS[int(r[1])],
+                    int(r[2]) - 3, pushed, bool(r[3]), bool(r[4]),
+                ))
+        self._masks[key] = (allowed, close_after)
+        self._descs[key] = (desc_ids, descs)
+        return allowed, close_after
+
+    def _build_mask_python(self, state: MachineState, key: tuple, pieces) -> tuple[np.ndarray, np.ndarray]:
         allowed = np.zeros(self.vocab_size, bool)
         close_after = np.zeros(self.vocab_size, np.int16)
         desc_ids = np.full(self.vocab_size, -1, np.int32)

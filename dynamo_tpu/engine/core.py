@@ -504,6 +504,13 @@ class EngineCore:
     def constraint_mask_cache_misses(self) -> int:
         return self._mask_cache.misses if self._mask_cache is not None else 0
 
+    def drain_constraint_build_seconds(self) -> list[float]:
+        """Cold mask-build durations since the last scrape — observed into
+        the dynamo_engine_constraint_mask_build_seconds histogram."""
+        if self._mask_cache is None:
+            return []
+        return self._mask_cache.drain_build_seconds()
+
     def _decode_mm_inputs(self, request: PreprocessedRequest):
         """mm_inputs wire format -> [total_image_tokens, D] embeddings.
 

@@ -256,7 +256,13 @@ async def build_engine_service(spec: WorkerSpec, *, on_kv_event=None, g4_storage
         elif spec.model_dir is not None and spec.model_dir.endswith(".gguf"):
             from dynamo_tpu.models.gguf import load_gguf_params, shared_reader
 
-            params = load_gguf_params(shared_reader(spec.model_dir), spec.model_config, mesh=mesh)
+            # int4 serving imports the file's own Q4_0/Q4_K codes directly
+            # into packed leaves (lossless repack, no bf16 round trip); the
+            # quantize_params pass below converts whatever fell back.
+            params = load_gguf_params(
+                shared_reader(spec.model_dir), spec.model_config, mesh=mesh,
+                quantize=spec.quantize,
+            )
         elif spec.model_dir is not None and spec.vision_config is not None:
             from dynamo_tpu.models.loader import load_vlm
 
@@ -994,7 +1000,11 @@ def main(argv: list[str] | None = None) -> None:
     )
     parser.add_argument("--store", default=rs.store or None, help="tcp://host:port of the deployment's store server")
     parser.add_argument("--mock", action="store_true", help="timing-model engine instead of JAX (fleet tests, planner)")
-    parser.add_argument("--quantize", default="", choices=["", "int8"], help="weight-only quantization for serving")
+    parser.add_argument(
+        "--quantize", default="", choices=["", "int8", "int4"],
+        help="weight-only quantization for serving (int4: packed nibbles, "
+        "group scales of DYN_QUANT_GROUP_SIZE, default 128)",
+    )
     parser.add_argument(
         "--input", default="http",
         help="ingress: 'http' (serve), 'text' (interactive stdin chat), or 'batch:FILE.jsonl'",

@@ -181,6 +181,46 @@ class GGUFReader:
         raw = memoryview(self._mm)[start : start + info.nbytes]
         return _dequant(raw, info.ggml_type, info.shape)
 
+    def read_q4(self, name: str) -> tuple[np.ndarray, np.ndarray, np.ndarray | None]:
+        """Q4_0/Q4_K tensor ``name`` without dequantizing to full width:
+        ``(q, scale, bias)`` with ``q`` int8 in [-8, 7] at the tensor's
+        shape, and f32 ``scale``/``bias`` per 32-wide group along the
+        innermost (contiguous) axis — ``shape[:-1] + (shape[-1]//32,)``.
+
+        The decomposition is exact against ``read``'s dequant: Q4_0 is
+        ``d*(q_raw-8)`` natively; Q4_K's ``d*sc*q_raw - dmin*mn`` rewrites
+        to ``(d*sc)*(q_raw-8) + (8*d*sc - dmin*mn)`` (q shifted to the
+        symmetric code range, the shift folded into the bias).
+        """
+        info = self.tensors[name]
+        start = self._data_start + info.offset
+        raw = memoryview(self._mm)[start : start + info.nbytes]
+        shape = info.shape
+        gshape = shape[:-1] + (shape[-1] // _BLOCK,)
+        if info.ggml_type == GGML_Q4_0:
+            rec = np.frombuffer(raw, dtype=np.dtype([("d", "<f2"), ("qs", "u1", (_BLOCK // 2,))]))
+            lo = (rec["qs"] & 0x0F).astype(np.int8) - 8
+            hi = (rec["qs"] >> 4).astype(np.int8) - 8
+            q = np.concatenate([lo, hi], axis=1)  # [nb, 32]: elems 0..15 in low nibbles
+            return q.reshape(shape), rec["d"].astype(np.float32).reshape(gshape), None
+        if info.ggml_type == GGML_Q4_K:
+            rec = np.frombuffer(raw, dtype=np.dtype(
+                [("d", "<f2"), ("dmin", "<f2"), ("scales", "u1", (12,)), ("qs", "u1", (_QK_K // 2,))]
+            ))
+            nb = rec.shape[0]
+            sc, mn = _k_scale_min(rec["scales"])
+            qs = rec["qs"].reshape(nb, 4, 32)
+            q = (np.stack([qs & 0xF, qs >> 4], axis=2).reshape(nb, 8, 32).astype(np.int8) - 8)
+            d = rec["d"].astype(np.float32)[:, None]
+            dmin = rec["dmin"].astype(np.float32)[:, None]
+            scale = d * sc.astype(np.float32)  # [nb, 8]
+            bias = 8.0 * scale - dmin * mn.astype(np.float32)
+            return q.reshape(shape), scale.reshape(gshape), bias.reshape(gshape)
+        raise ValueError(
+            f"{name}: ggml type {_TYPE_NAMES.get(info.ggml_type, info.ggml_type)} "
+            "has no packed int4 read path (Q4_0/Q4_K only)"
+        )
+
     def close(self) -> None:
         self._mm.close()
         self._file.close()
@@ -706,12 +746,21 @@ _GGUF_SHARED_MAP: dict[str, tuple[str, bool]] = {
 }
 
 
+def _pack_nibble_rows(q: np.ndarray) -> np.ndarray:
+    """[..., d_in, O] int4-valued int8 -> [..., d_in//2, O] packed bytes,
+    element ``2i`` in the low nibble of byte ``i`` — the layout
+    ``models/quant.unpack_int4`` expects (numpy twin of ``pack_int4``)."""
+    lo, hi = q[..., 0::2, :], q[..., 1::2, :]
+    return ((hi.astype(np.uint8) << 4) | (lo.astype(np.uint8) & 0x0F)).astype(np.int8)
+
+
 def load_gguf_params(
     source: str | pathlib.Path | GGUFReader,
     cfg: ModelConfig,
     *,
     mesh: Any | None = None,
     dtype: Any | None = None,
+    quantize: str = "",
 ) -> dict:
     """GGUF file -> stacked params pytree (optionally sharded onto ``mesh``).
 
@@ -719,6 +768,15 @@ def load_gguf_params(
     checkpoints are single-file and quant-compressed, so unlike the
     safetensors path (`loader.load_params`) there is no per-shard lazy read —
     peak host memory is one dequantized leaf.
+
+    ``quantize="int4"`` imports Q4_0/Q4_K matmul tensors DIRECTLY into
+    packed int4 leaves (``{"qw4", "scale"[, "qbias"]}`` — see
+    ``models/quant``) instead of round-tripping through full-width bf16:
+    the checkpoint's own 4-bit codes and group scales are repacked
+    losslessly, so the serve path streams 0.5 byte/elem where the dequant
+    path would forfeit the checkpoint's bandwidth win. Tensors stored at
+    other ggml types (and any leaf whose layers mix types) fall back to the
+    dequant path; the caller's ``quantize_params`` pass picks those up.
     """
     import jax
     import jax.numpy as jnp
@@ -750,17 +808,55 @@ def load_gguf_params(
             arr = arr[perm]
         return arr.T if transpose else arr
 
+    packed_q4 = quantize == "int4"
+
+    def rd_packed(name: str, perm: np.ndarray | None = None, moe: bool = False) -> dict | None:
+        """Q4_0/Q4_K -> packed int4 leaf in model orientation, else None.
+
+        The rope permutation applies to GGML rows = output channels, which
+        become the leaf's last axis after transpose — compatible with the
+        group scales, whose groups run along the contraction axis.
+        """
+        info = reader.tensors.get(name)
+        if info is None or info.ggml_type not in (GGML_Q4_0, GGML_Q4_K):
+            return None
+        q, scale, bias = reader.read_q4(name)
+        if perm is not None:
+            q, scale = q[perm], scale[perm]
+            bias = bias[perm] if bias is not None else None
+        tr = (lambda a: a.transpose(0, 2, 1)) if moe else (lambda a: a.T)
+        leaf = {
+            "qw4": _pack_nibble_rows(np.ascontiguousarray(tr(q))),
+            "scale": np.ascontiguousarray(tr(scale)),
+        }
+        if bias is not None:
+            leaf["qbias"] = np.ascontiguousarray(tr(bias))
+        return leaf
+
     L = cfg.num_layers
-    layers: dict[str, np.ndarray] = {}
+    layers: dict[str, Any] = {}
 
     def stack(leaf: str, suffix: str, transpose: bool) -> np.ndarray:
         perm = qk_perms.get(leaf)
         return np.stack([rd(f"blk.{li}.{suffix}", transpose, perm) for li in range(L)]).astype(np_dtype, copy=False)
 
+    def stack_packed(leaf: str, suffix: str, moe: bool = False) -> dict | None:
+        """Layer-stacked packed leaf, or None if any layer can't pack (or
+        the layers mix Q4_0 with Q4_K — stacking needs uniform keys)."""
+        perm = qk_perms.get(leaf)
+        per_layer = []
+        for li in range(L):
+            d = rd_packed(f"blk.{li}.{suffix}", perm, moe=moe)
+            if d is None or (per_layer and set(d) != set(per_layer[0])):
+                return None
+            per_layer.append(d)
+        return {k: np.stack([d[k] for d in per_layer]) for k in per_layer[0]}
+
     for leaf, (suffix, t) in _GGUF_LAYER_MAP.items():
         if leaf in ("w_gate", "w_up", "w_down") and cfg.is_moe:
             continue
-        layers[leaf] = stack(leaf, suffix, t)
+        packed = stack_packed(leaf, suffix) if packed_q4 and t else None
+        layers[leaf] = packed if packed is not None else stack(leaf, suffix, t)
     if cfg.attention_bias:
         for leaf, suffix in _GGUF_BIAS_MAP.items():
             layers[leaf] = stack(leaf, suffix, False)
@@ -770,12 +866,17 @@ def load_gguf_params(
     if cfg.is_moe:
         layers["router"] = stack("router", "ffn_gate_inp.weight", True)
         for leaf, suffix in _GGUF_MOE_MAP.items():
+            packed = stack_packed(leaf, suffix, moe=True) if packed_q4 else None
+            if packed is not None:
+                layers[leaf] = packed
+                continue
             # [E, out, in] per layer -> transpose within-expert to [E, in, out]
             arrs = [reader.read(f"blk.{li}.{suffix}").transpose(0, 2, 1) for li in range(L)]
             layers[leaf] = np.stack(arrs).astype(np_dtype, copy=False)
         if cfg.shared_expert_size and "blk.0.ffn_gate_shexp.weight" in reader:
             for leaf, (suffix, t) in _GGUF_SHARED_MAP.items():
-                layers[leaf] = stack(leaf, suffix, t)
+                packed = stack_packed(leaf, suffix) if packed_q4 and t else None
+                layers[leaf] = packed if packed is not None else stack(leaf, suffix, t)
             if cfg.shared_expert_gated and "blk.0.ffn_gate_inp_shexp.weight" in reader:
                 layers["shared_gate"] = stack("shared_gate", "ffn_gate_inp_shexp.weight", True)
 
@@ -786,7 +887,8 @@ def load_gguf_params(
     }
     if not cfg.tie_embeddings:
         lm = "output.weight" if "output.weight" in reader else "token_embd.weight"
-        params["lm_head"] = rd(lm, True).astype(np_dtype, copy=False)
+        packed = rd_packed(lm) if packed_q4 else None
+        params["lm_head"] = packed if packed is not None else rd(lm, True).astype(np_dtype, copy=False)
 
     if mesh is None:
         return jax.tree.map(jnp.asarray, params)

@@ -205,6 +205,13 @@ class EngineMetrics:
             "payloads landing in device pages",
             ["worker"], buckets=_PHASE_BUCKETS, registry=self.registry,
         )
+        self._constraint_build = Histogram(
+            "dynamo_engine_constraint_mask_build_seconds",
+            "Wall time of each cold constrained-decoding mask build (a "
+            "machine summary seen for the first time; warm steps are dict "
+            "lookups and are not observed)",
+            ["worker"], buckets=_PHASE_BUCKETS, registry=self.registry,
+        )
         # Time-loss accounting (attribution plane): cumulative seconds the
         # engine charged per loss cause (attribution.LOSS_CAUSES = the pinned
         # barrier vocabulary + queue/admission/onboard_stall/preempt/
@@ -410,6 +417,10 @@ class EngineMetrics:
         if callable(drain):
             for wait_s in drain():
                 self._onboard_wait.labels(self.worker).observe(max(0.0, wait_s))
+        drain_builds = getattr(core, "drain_constraint_build_seconds", None)
+        if callable(drain_builds):
+            for build_s in drain_builds():
+                self._constraint_build.labels(self.worker).observe(max(0.0, build_s))
         lost = getattr(core, "lost_time_ms", None)
         if lost is not None:
             self._lost_time.clear()

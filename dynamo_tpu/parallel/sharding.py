@@ -81,6 +81,23 @@ def param_shardings(mesh: Mesh, params: dict[str, Any]) -> dict[str, Any]:
                     "qw": NamedSharding(mesh, base),
                     "scale": NamedSharding(mesh, scale_spec),
                 }
+            # Packed int4 leaf {"qw4": int8[..., d_in//2, O], "scale":
+            # [..., G, O], "qbias"?}: qw4 keeps the float weight's rank, so
+            # the base spec applies unchanged. The scale's group axis
+            # subdivides d_in exactly like the packed byte axis does, so it
+            # inherits the same spec (a row-parallel tp split of d_in maps
+            # to a tp split of whole groups, provided tp divides G — the
+            # same divisibility the weight split already requires).
+            if "qw4" in tree and "scale" in tree:
+                base = spec_for(path, tree["qw4"])
+                scale_spec = base
+                out = {
+                    "qw4": NamedSharding(mesh, base),
+                    "scale": NamedSharding(mesh, scale_spec),
+                }
+                if "qbias" in tree:
+                    out["qbias"] = NamedSharding(mesh, scale_spec)
+                return out
             return {k: walk(v, path + (k,)) for k, v in tree.items()}
         return NamedSharding(mesh, spec_for(path, tree))
 

@@ -369,6 +369,37 @@ def test_bench_doc_fleet_sim_keys():
     assert doc3["fleet_tenant_fairness"] == 0.0
 
 
+def test_bench_doc_quant_and_mask_keys():
+    """Roofline burn-down keys (ISSUE 16): the quant-mode sweep and the
+    vectorized-mask probe surface stable `_gain`/`_ms` suffixed keys (so
+    tools/bench_regress.py derives direction without a schema change) and
+    detail records; absent probes keep 0.0 defaults."""
+    import bench
+
+    configs = [{"preset": "test-tiny", "tok_per_sec": 5.0}]
+    doc = bench.build_doc(configs, pull={})
+    for key in ("quant_int8_decode_gain", "quant_int4_decode_gain",
+                "quant_int4_vs_int8_decode_gain", "constraint_mask_build_ms",
+                "constraint_mask_build_gain"):
+        assert doc[key] == 0.0
+    assert doc["detail"]["quant_sweep_probe"] == {"pending": True}
+    assert doc["detail"]["mask_build_probe"] == {"pending": True}
+
+    qs = {"preset": "mla-8b-proxy", "bf16_basis": "modeled_from_int4_achieved_bw",
+          "quant_int8_decode_gain": 1.9, "quant_int4_decode_gain": 3.1,
+          "quant_int4_vs_int8_decode_gain": 1.63}
+    mb = {"vocab": 128000, "mismatches": 0,
+          "constraint_mask_build_ms": 30.7, "constraint_mask_build_gain": 16.9}
+    doc2 = bench.build_doc(configs, pull={}, quant_sweep=qs, mask_build=mb)
+    assert doc2["quant_int8_decode_gain"] == 1.9
+    assert doc2["quant_int4_decode_gain"] == 3.1
+    assert doc2["quant_int4_vs_int8_decode_gain"] == 1.63
+    assert doc2["constraint_mask_build_ms"] == 30.7
+    assert doc2["constraint_mask_build_gain"] == 16.9
+    assert doc2["detail"]["quant_sweep_probe"] == qs
+    assert doc2["detail"]["mask_build_probe"] == mb
+
+
 def test_synthesizer_prefix_structure():
     cfg = SyntheticConfig(num_requests=32, shared_prefix_len=16, num_groups=3,
                           group_prefix_len=8, unique_len=4, osl_mean=20, seed=7)

@@ -171,6 +171,102 @@ def test_token_masks_with_multichar_bpe_pieces():
     assert tight[p.index('{"a": 1}')] or tight[p.index("true")]
 
 
+# ---------------------------------------------------------------------------
+# Vectorized mask builder: bitwise parity with the per-char Python walk
+# ---------------------------------------------------------------------------
+
+_PARITY_PIECES = [
+    "", "{", "}", "[", "]", ",", ":", '"', " ", "  ", "\t", "\n",
+    "0", "1", "9", "-", "-1", "12", "1.5", "0.25", "1e9", "1E+3", "1e-",
+    "01", "0.", ".", "e", "E", "+", "-5e2", "123,", "1, ", "3]", "4}",
+    "true", "false", "null", "t", "tr", "rue", "alse", "ull", "n", "f",
+    '"a"', '"ab', "abc", "a b", "\\", "\\n", "\\u", "\\u0041", "u00", "0041",
+    '"key":', '": ', '","', '"}', '"]', '"},"', '":', "k", "\x00", "\x01",
+    '{"', "[1", "[[", "{{", "[]", "{}", "[1,2]", '{"a":1}', "}]", "]]",
+    "}}", "],", "},", ',"', ', "', " ]", " }", "��", "�", "٣", "²",
+    '"٣"', "hello", 'wor"ld', '\\"', "\\\\", "/", "b", "r",
+    '"a":', "1}", "2]", "e5", ".5", "5.", "+7", "-0", "-0.5e+10", "�]",
+]
+
+_PARITY_STATES = [
+    "", "{", '{"', '{"k', '{"k"', '{"k":', '{"k": ', '{"k": 1',
+    '{"k": 1,', '{"k": 1, ', '{"k": "v"', '{"k": "v",', '{"k": [',
+    '{"k": [1', '{"k": [1,', '{"k": [1,2', '{"k": [1,2]', '{"k": tr',
+    '{"k": -', '{"k": 0', '{"k": 1.', '{"k": 1.5', '{"k": 1e',
+    '{"k": 1e+', '{"k": 1e+3', '{"k": "a\\', '{"k": "a\\u', '{"k": "a\\u0',
+    '{"k": "a\\u00', '{"k": "a\\u004', "[", "[[", "[[[", "[[[[", "[[[[[",
+    "[[[[[1", "[[[[[1,", "[{", '[{"a": [', '[{"a": [[', "[1, ", "[tru",
+    "[fals", "[nul", "1", "-", "0", "[0", '{"a": {"b": {"c": {"d": ',
+    '{"a": {"b": {"c": {"d": 1', '{"a": {"b": {"c": {"d": 1}',
+    '{"a": {"b": {"c": {"d": 1}}', '[[[[{"x": ', '[[{"x": "y"',
+    "[ ", "{ ", "[1 ", '"s', '"s\\', '"', "tru", "12345", "-1.5e",
+]
+
+
+class _PieceTok:
+    def decode(self, ids, skip_special_tokens=True):
+        return "".join(_PARITY_PIECES[i] for i in ids if i < len(_PARITY_PIECES))
+
+
+def test_vectorized_masks_bitwise_match_python():
+    """The vectorized builder must be BITWISE identical to the per-char
+    Python walk — allow mask, close_after budgets, descriptor ids and
+    decoded descriptor tuples — across pathological pieces (NUL, lone
+    replacement chars, non-ASCII Unicode digits like '٣' which count as
+    digits in number phases but not as number STARTS, multi-open/close
+    pieces) and a state corpus touching every machine mode and depth>3."""
+    from dynamo_tpu import constrained as C
+
+    states, seen = [MachineState()], set()
+    for text in _PARITY_STATES:
+        s = advance_text(MachineState(), text)
+        if s.mode != C.REJECT:
+            states.append(s)
+    checked = 0
+    for st in states:
+        key = st.summary()
+        if key in seen:
+            continue
+        seen.add(key)
+        cache_v = TokenMaskCache(_PieceTok(), len(_PARITY_PIECES), (0,))
+        cache_p = TokenMaskCache(_PieceTok(), len(_PARITY_PIECES), (0,))
+        pieces = cache_v._ensure_pieces()
+        cache_p._ensure_pieces()
+        av, cv = cache_v._build_mask_vectorized(st, key, pieces)
+        ap, cp = cache_p._build_mask_python(st, key, pieces)
+        np.testing.assert_array_equal(av, ap, err_msg=f"allow mask @ {key}")
+        np.testing.assert_array_equal(cv, cp, err_msg=f"close_after @ {key}")
+        dv, descv = cache_v._descs[key]
+        dp, descp = cache_p._descs[key]
+        np.testing.assert_array_equal(dv, dp, err_msg=f"desc ids @ {key}")
+        assert descv == descp, key
+        checked += 1
+    assert checked > 40  # corpus actually covered distinct summaries
+
+
+def test_vector_masks_env_fallback(monkeypatch):
+    """DYN_CONSTRAINT_VECTOR_MASKS=0 routes mask_for through the Python
+    builder and yields the same masks."""
+    tok = _CharTok()
+    s = advance_text(MachineState(), '{"a": [1, ')
+    a = TokenMaskCache(tok, len(tok.CHARS), (0,)).mask_for(s)
+    monkeypatch.setenv("DYN_CONSTRAINT_VECTOR_MASKS", "0")
+    b = TokenMaskCache(tok, len(tok.CHARS), (0,)).mask_for(s)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_mask_build_timing_drained():
+    """Cold builds record wall-time samples; drain returns-and-clears (the
+    metrics exporter feeds dynamo_engine_constraint_mask_build_seconds)."""
+    tok = _CharTok()
+    cache = TokenMaskCache(tok, vocab_size=len(tok.CHARS), eos_ids=(0,))
+    cache.mask_for(advance_text(MachineState(), '{"a": '))
+    cache.mask_for(advance_text(MachineState(), '{"a": '))  # warm: no build
+    samples = cache.drain_build_seconds()
+    assert len(samples) == 1 and samples[0] >= 0.0
+    assert cache.drain_build_seconds() == []
+
+
 def test_engine_json_mode_yields_parseable_json():
     """Greedy generation on a RANDOM tiny model, json_mode on: the output
     must parse (force-close kicks in before max_tokens)."""

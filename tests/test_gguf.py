@@ -349,6 +349,65 @@ def test_quantized_load_close(tmp_path):
         assert np.abs(a32 - b32).max() <= scale / 100.0  # int8 blocks: <1% of range
 
 
+def test_q4_packed_read_matches_dequant(tmp_path):
+    """read_q4 + nibble repack feeds models/quant.maybe_dequant the same
+    values read()'s full dequant produces — Q4_0 bitwise (d*(q-8) is the
+    native form), Q4_K within f32 rounding of the rewritten bias form."""
+    import jax.numpy as jnp
+
+    from dynamo_tpu.models.gguf import GGML_Q4_K, _pack_nibble_rows
+    from dynamo_tpu.models.quant import maybe_dequant
+
+    rng = np.random.default_rng(21)
+    t40 = (rng.standard_normal((16, 64)) * 0.1).astype(np.float32)
+    t4k = (rng.standard_normal((8, 256)) * 0.1).astype(np.float32)
+    path = tmp_path / "q4pair.gguf"
+    write_gguf(path, {"general.architecture": "llama"},
+               {"a": t40, "b": t4k}, quant={"a": GGML_Q4_0, "b": GGML_Q4_K})
+    r = GGUFReader(path)
+    for name, exact in (("a", True), ("b", False)):
+        dense = r.read(name)  # [out, in] f32 via the dequant path
+        q, scale, bias = r.read_q4(name)
+        leaf = {"qw4": _pack_nibble_rows(q.T), "scale": scale.T}
+        if bias is not None:
+            leaf["qbias"] = bias.T
+        back = np.asarray(maybe_dequant(leaf, jnp.float32)).T
+        if exact:
+            np.testing.assert_array_equal(back, dense)
+        else:
+            np.testing.assert_allclose(back, dense, rtol=1e-6, atol=1e-7)
+    r.close()
+
+
+def test_q4_0_packed_model_load_matches_dequant_path(tmp_path):
+    """``load_gguf_params(quantize="int4")`` imports Q4_0 matmul tensors as
+    packed leaves whose dequant equals the full-width load BITWISE (the
+    checkpoint's own codes and scales are repacked, not requantized); every
+    other leaf comes back identical to the plain path."""
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.models.quant import is_quantized, maybe_dequant
+
+    cfg = PRESETS["test-tiny"]
+    params = llama.init_params(cfg, 17)
+    path = tmp_path / "model-q4.gguf"
+    save_params_gguf(path, cfg, params, quant=GGML_Q4_0)
+    r = GGUFReader(path)
+    mcfg = config_from_gguf(r, name=cfg.name)
+    plain = load_gguf_params(r, mcfg, dtype="float32")
+    packed = load_gguf_params(r, mcfg, dtype="float32", quantize="int4")
+    r.close()
+    for leaf in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"):
+        d = packed["layers"][leaf]
+        assert is_quantized(d) and "qw4" in d, leaf
+        np.testing.assert_array_equal(
+            np.asarray(maybe_dequant(d, jnp.float32)),
+            np.asarray(plain["layers"][leaf], np.float32), err_msg=leaf)
+    for name in ("embed", "norm_f"):
+        np.testing.assert_array_equal(np.asarray(packed[name]), np.asarray(plain[name]))
+
+
 def test_worker_spec_from_gguf(tmp_path):
     from dynamo_tpu.launch import WorkerSpec
 

@@ -143,6 +143,10 @@ class _FakeCore:
     overlap_barrier_counts = {"spec": 1, "drain": 1}
     constraint_mask_cache_hits = 11
     constraint_mask_cache_misses = 3
+
+    def drain_constraint_build_seconds(self):
+        return [0.5, 0.05]
+
     lost_time_ms = {"gap": 1500.0, "queue": 250.0, "recompile": 40.0}
     step_wall_ms_total = 4000.0
     step_dispatch_ms_total = 3000.0
@@ -215,6 +219,11 @@ EXPECTED_ENGINE_FAMILIES = {
     "dynamo_engine_step_gap_ms_mean",
     "dynamo_engine_overlap_steps_total",
     "dynamo_engine_overlap_barrier_total",
+    "dynamo_engine_constraint_mask_build_seconds",
+    # _created appears once the worker-labeled child exists (the fake core's
+    # drain returns samples) — same prometheus_client behavior as the kv
+    # phase histogram below.
+    "dynamo_engine_constraint_mask_build_seconds_created",
     "dynamo_engine_constraint_mask_cache_hits_total",
     "dynamo_engine_constraint_mask_cache_misses_total",
     "dynamo_engine_admission_queue_depth",
@@ -277,6 +286,8 @@ async def test_engine_metrics_names_labels_and_values():
     assert 'dynamo_engine_overlap_barrier_total{reason="drain",worker="w1"} 1.0' in text
     assert 'dynamo_engine_constraint_mask_cache_hits_total{worker="w1"} 11.0' in text
     assert 'dynamo_engine_constraint_mask_cache_misses_total{worker="w1"} 3.0' in text
+    assert 'dynamo_engine_constraint_mask_build_seconds_count{worker="w1"} 2.0' in text
+    assert 'dynamo_engine_constraint_mask_build_seconds_sum{worker="w1"} 0.55' in text
     # Attribution plane: per-cause lost seconds, step-time composition, and
     # the sentinel's active/fired state, all synced from the core.
     assert 'dynamo_engine_lost_time_seconds_total{cause="gap",worker="w1"} 1.5' in text

@@ -124,6 +124,7 @@ def main() -> None:
     tok_per_sec = generated / elapsed
     step_bytes = bench_mod.decode_step_bytes(params, cfg, batch, isl, osl, page)
     roofline = bench_mod.roofline_tok_per_sec(step_bytes, batch)
+    weight_bytes = bench_mod.decode_weight_bytes(params, cfg)
     ops, device_us, num_cores = op_breakdown(trace_dir)
     # device_us sums op time over every device core pid; per-core busy time
     # is that total divided by the core count (the old code skipped the
@@ -138,6 +139,12 @@ def main() -> None:
         "device_cores": num_cores,
         "wall_us": round(elapsed * 1e6, 0),
         "device_busy_fraction": round(busy, 4),
+        # Weight traffic per generated token, from the measured tree (packed
+        # quantized leaves at true size) — HBM-utilization claims in bench
+        # notes derive from these instead of hand-computed weight sizes.
+        "weight_bytes_per_step": weight_bytes,
+        "weight_bytes_per_token": round(weight_bytes / batch, 1),
+        "weight_frac_of_step_bytes": round(weight_bytes / step_bytes, 4),
         "top_ops_us": [[n, round(us, 0)] for n, us in ops[:15]],
         "trace_dir": trace_dir,
     }
