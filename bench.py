@@ -1693,4 +1693,12 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    import sys
+
+    if "--tune" in sys.argv:
+        # Closed-loop knob auto-tune instead of the measurement suite:
+        # remaining flags pass through to python -m dynamo_tpu.tuning.
+        from dynamo_tpu.tuning.__main__ import main as tune_main
+
+        sys.exit(tune_main([a for a in sys.argv[1:] if a != "--tune"]))
     main()

@@ -259,6 +259,31 @@ class AttribSettings:
     max_steps: int = 2048
 
 
+@dataclasses.dataclass
+class TuneSettings:
+    """Auto-tuner knobs (``dynamo_tpu/tuning``).
+
+    Tune the closed-loop knob search itself — the space it sweeps and the
+    probe discipline behind each trial — not the knobs it searches over
+    (those live in their own sections/envs). Env: ``DYN_TUNE_*``, TOML:
+    ``[tune]``.
+    """
+
+    preset: str = "test-tiny"  # model preset the probe engine is built from
+    mode: str = "mock"  # probe backend: 'mock' (CPU proxy) | 'jax' (real model)
+    seed: int = 0  # workload seed; the whole search is deterministic under it
+    rounds: int = 3  # max coordinate-descent sweeps over the knob list
+    requests: int = 16  # requests per full-length measured probe
+    isl: int = 96  # probe prompt length (tokens)
+    osl: int = 48  # probe decode length (tokens)
+    rung_frac: float = 0.5  # successive-halving rung-0 probe scale (of requests)
+    plateau_eps: float = 0.005  # relative gain below this counts as a plateau
+    plateau_rounds: int = 1  # consecutive plateau rounds before early stop
+    max_trials: int = 0  # hard cap on measured trials (0 = unlimited)
+    out_dir: str = "bench/results/tune"  # journal + profile + report root
+    knobs: str = ""  # comma list restricting swept knob names ("" = all)
+
+
 def load_runtime_settings(**kw) -> RuntimeSettings:
     return load_config(RuntimeSettings(), section="runtime", **kw)
 
@@ -293,3 +318,7 @@ def load_anomaly_settings(**kw) -> AnomalySettings:
 
 def load_attrib_settings(**kw) -> AttribSettings:
     return load_config(AttribSettings(), section="attrib", **kw)
+
+
+def load_tune_settings(**kw) -> TuneSettings:
+    return load_config(TuneSettings(), section="tune", **kw)

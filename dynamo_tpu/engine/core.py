@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import threading
 import time
 from collections import deque
@@ -391,6 +392,9 @@ class EngineCore:
         self.step_gap_ms_sum = 0.0
         self.step_gap_ms_count = 0
         self.step_gap_ms_last = 0.0
+        # Steps recorded per kind ("mixed"/"prefill"/"decode"/"drain") — the
+        # step-kind histogram behind loss_snapshot() and the metrics plane.
+        self.step_kind_counts: dict[str, int] = {}
         # Constrained decoding (response_format json_object): the mask cache
         # needs token TEXT, so a tokenizer (or factory) must be installed
         # before json_mode requests are admitted.
@@ -654,6 +658,7 @@ class EngineCore:
                 chunk_rows = chunk_tokens = 0
                 spec_drafted = spec_accepted = 0
                 kind = "decode" if self.running else "drain"
+            self.step_kind_counts[kind] = self.step_kind_counts.get(kind, 0) + 1
             dispatch_ms = (
                 (tracker.dispatch_seconds_total - disp0) * 1e3 if tracker is not None else 0.0
             )
@@ -740,6 +745,50 @@ class EngineCore:
         """Accumulate lost wall time under one attribution cause (ms)."""
         if ms > 0.0:
             self.lost_time_ms[cause] = self.lost_time_ms.get(cause, 0.0) + ms
+
+    def loss_snapshot(self) -> dict:
+        """Programmatic lost-time/step-kind snapshot (stable keys).
+
+        The structured twin of the ``dynamo_engine_lost_time_seconds_total``
+        and ``dynamo_engine_step_time_seconds_total`` exports, so the tuner
+        and tests never scrape Prometheus text. All times are cumulative
+        milliseconds since engine construction. Keys (pinned — extend, never
+        rename):
+
+        - ``lost_time_ms``: cumulative ms per attribution cause (the pinned
+          :data:`~dynamo_tpu.observability.attribution.LOSS_CAUSES`
+          vocabulary; absent cause = 0 charged so far).
+        - ``step_time_ms``: ``{"wall", "dispatch", "gap"}`` cumulative totals.
+        - ``step_kind_counts``: steps recorded per kind
+          (``mixed``/``prefill``/``decode``/``drain``).
+        - ``steps_total``: sum of ``step_kind_counts``.
+        - ``overlap_step_counts`` / ``overlap_barrier_counts``: the overlap
+          pipeline's mode and per-reason barrier tallies.
+        - ``noncompute_wall_ms``: ``max(0, wall + gap - dispatch)`` — the
+          denominator the burn-down targets divide by.
+        - ``loss_coverage_frac``: fraction of non-compute wall the per-cause
+          ledger accounts for (1.0 when nothing is unattributed).
+        """
+        wall = self.step_wall_ms_total
+        dispatch = self.step_dispatch_ms_total
+        gap = self.step_gap_ms_sum
+        noncompute = max(0.0, wall + gap - dispatch)
+        attributed = sum(
+            ms for cause, ms in self.lost_time_ms.items()
+            if cause not in ("queue", "admission")  # pre-step waits, not step wall
+        )
+        return {
+            "lost_time_ms": dict(self.lost_time_ms),
+            "step_time_ms": {"wall": wall, "dispatch": dispatch, "gap": gap},
+            "step_kind_counts": dict(self.step_kind_counts),
+            "steps_total": sum(self.step_kind_counts.values()),
+            "overlap_step_counts": dict(self.overlap_step_counts),
+            "overlap_barrier_counts": dict(self.overlap_barrier_counts),
+            "noncompute_wall_ms": noncompute,
+            "loss_coverage_frac": (
+                min(1.0, attributed / noncompute) if noncompute > 0.0 else 1.0
+            ),
+        }
 
     def _step_locked(self) -> list[tuple[Sequence, EngineOutput]]:
         # Pending offloads must be read before allocate() can evict their
@@ -1268,8 +1317,12 @@ class EngineCore:
         from concurrent.futures import ThreadPoolExecutor
 
         if self._onboard_pool is None:
+            # Pool width bounds how many tier fetches overlap the forward
+            # pass; on hardware wider pools contend with compute for HBM
+            # bandwidth, so the width is a tunable (swept by the auto-tuner).
+            width = max(1, int(os.environ.get("DYN_ONBOARD_POOL_WIDTH", "2")))
             self._onboard_pool = ThreadPoolExecutor(
-                max_workers=2, thread_name_prefix="kv-onboard"
+                max_workers=width, thread_name_prefix="kv-onboard"
             )
         sess = _OnboardSession(
             seq=seq, hashes=list(hashes), start=start, pages=list(pages),

@@ -1058,7 +1058,38 @@ def main(argv: list[str] | None = None) -> None:
         help="force a jax platform (e.g. 'cpu'); needed because hardware "
              "plugins may override the JAX_PLATFORMS env var",
     )
+    parser.add_argument(
+        "--tune-profile", default=None,
+        help="auto-tuner profile JSON (bench.py --tune output); applies its "
+        "knob assignments as env defaults — explicit env/CLI still wins",
+    )
     args = parser.parse_args(argv)
+    if args.tune_profile:
+        import os
+
+        from dynamo_tpu.tuning.profile import apply_profile, load_profile
+
+        # Precedence env > CLI > profile: a knob already in the environment
+        # is untouched, and one the operator set via flag is claimed by the
+        # CLI (its re-export below must not be shadowed by the profile).
+        cli_set = set()
+        if args.decode_steps != ws.decode_steps:
+            cli_set.add("DYN_WORKER_DECODE_STEPS")
+        if args.chunk_prefill_tokens != ws.chunk_prefill_tokens:
+            cli_set.add("DYN_WORKER_CHUNK_PREFILL_TOKENS")
+        if args.spec_k != ws.spec_k:
+            cli_set.add("DYN_WORKER_SPEC_K")
+        applied = apply_profile(
+            load_profile(args.tune_profile), env=os.environ, cli_set=cli_set
+        )
+        if applied:
+            print(
+                "tune profile %s: %s" % (
+                    args.tune_profile,
+                    " ".join(f"{k}={v}" for k, v in sorted(applied.items())),
+                ),
+                flush=True,
+            )
     if args.platform:
         import jax
 

@@ -28,7 +28,7 @@ from __future__ import annotations
 import logging
 from typing import Any, Awaitable, Callable
 
-from prometheus_client import CollectorRegistry, Gauge, Histogram, generate_latest
+from prometheus_client import Counter, CollectorRegistry, Gauge, Histogram, generate_latest
 
 logger = logging.getLogger(__name__)
 
@@ -217,10 +217,13 @@ class EngineMetrics:
         # barrier vocabulary + queue/admission/onboard_stall/preempt/
         # recompile/gap), plus the step-time totals consumers need to derive
         # non-compute wall time (wall + gap - dispatch) and the unattributed
-        # residual. Clear-then-set labelled gauges, same sync-on-scrape
-        # no-double-booking idiom as recompiles.
-        self._lost_time = Gauge(
-            "dynamo_engine_lost_time_seconds_total",
+        # residual. True monotone Counters (so Prometheus rate()/increase()
+        # are valid and the ``_total`` sample suffix is honest): each scrape
+        # incs by the delta of the core's cumulative ledger since the last
+        # sync (tracked in ``_lost_time_synced``/``_step_time_synced``, reset
+        # by bind_core so a rebound core's full totals land once).
+        self._lost_time = Counter(
+            "dynamo_engine_lost_time_seconds",
             "Wall-clock seconds the engine attributes to a latency loss "
             "cause: overlap barrier reasons plus queue (pre-admission "
             "resource wait), admission (quota-gated deferral), onboard_stall "
@@ -229,14 +232,23 @@ class EngineMetrics:
             "between dispatches)",
             ["worker", "cause"], registry=self.registry,
         )
-        self._step_time = Gauge(
-            "dynamo_engine_step_time_seconds_total",
+        self._step_time = Counter(
+            "dynamo_engine_step_time_seconds",
             "Cumulative engine step time by kind: wall (in-step wall clock), "
             "dispatch (runner dispatch inside steps; equals wall on runners "
             "without a compile tracker), gap (host gap between steps) — "
             "non-compute wall time = wall + gap - dispatch",
             ["worker", "kind"], registry=self.registry,
         )
+        self._step_kinds = Counter(
+            "dynamo_engine_step_kind_steps",
+            "Engine steps recorded, by step kind (mixed / prefill / decode / "
+            "drain) — the step-kind histogram behind EngineCore.loss_snapshot",
+            ["worker", "kind"], registry=self.registry,
+        )
+        self._lost_time_synced: dict[str, float] = {}
+        self._step_time_synced: dict[str, float] = {}
+        self._step_kinds_synced: dict[str, int] = {}
         # Anomaly sentinel: 1 while a rolling-window detector is active on
         # this worker (hysteresis in the sentinel, not here), keyed by the
         # detector kind; fired totals count rising edges ever.
@@ -317,6 +329,12 @@ class EngineMetrics:
 
     def bind_core(self, core: Any) -> "EngineMetrics":
         self._core = core
+        # A fresh core's cumulative ledgers restart at zero; resetting the
+        # sync watermarks makes its totals land as new Counter increments
+        # (process-lifetime accumulation across cores, proper monotone).
+        self._lost_time_synced.clear()
+        self._step_time_synced.clear()
+        self._step_kinds_synced.clear()
         return self
 
     def bind_transfer(self, transfer: Any) -> "EngineMetrics":
@@ -423,19 +441,28 @@ class EngineMetrics:
                 self._constraint_build.labels(self.worker).observe(max(0.0, build_s))
         lost = getattr(core, "lost_time_ms", None)
         if lost is not None:
-            self._lost_time.clear()
             for cause, ms in lost.items():
-                self._lost_time.labels(self.worker, cause).set(ms / 1e3)
-            self._step_time.clear()
-            self._step_time.labels(self.worker, "wall").set(
-                getattr(core, "step_wall_ms_total", 0.0) / 1e3
+                prev = self._lost_time_synced.get(cause, 0.0)
+                if ms > prev:
+                    self._lost_time.labels(self.worker, cause).inc((ms - prev) / 1e3)
+                    self._lost_time_synced[cause] = ms
+            step_totals = (
+                ("wall", getattr(core, "step_wall_ms_total", 0.0)),
+                ("dispatch", getattr(core, "step_dispatch_ms_total", 0.0)),
+                ("gap", getattr(core, "step_gap_ms_sum", 0.0)),
             )
-            self._step_time.labels(self.worker, "dispatch").set(
-                getattr(core, "step_dispatch_ms_total", 0.0) / 1e3
-            )
-            self._step_time.labels(self.worker, "gap").set(
-                getattr(core, "step_gap_ms_sum", 0.0) / 1e3
-            )
+            for kind, ms in step_totals:
+                prev = self._step_time_synced.get(kind, 0.0)
+                if ms > prev:
+                    self._step_time.labels(self.worker, kind).inc((ms - prev) / 1e3)
+                    self._step_time_synced[kind] = ms
+        kind_counts = getattr(core, "step_kind_counts", None)
+        if kind_counts is not None:
+            for kind, n in kind_counts.items():
+                prev = self._step_kinds_synced.get(kind, 0)
+                if n > prev:
+                    self._step_kinds.labels(self.worker, kind).inc(n - prev)
+                    self._step_kinds_synced[kind] = n
         sentinel = getattr(core, "sentinel", None)
         if sentinel is not None:
             self._anomaly_active.clear()

@@ -1,0 +1,54 @@
+"""Closed-loop auto-tuner: search the performance knob space against the
+bench keys *joined with* the lost-time vocabulary, and converge on a
+per-(model, batch-shape, platform) knob profile.
+
+ROADMAP item 3's "the loop itself": PR 15 built the instrument (the pinned
+16-cause lost-time ledger + critical-path budgets) and PR 16 the kernels
+(packed int4, vectorized masks); this package closes the loop the way the
+reference's planner closes observed-load -> resource decisions — but aimed
+at per-host kernel/scheduler knobs instead of fleet sizing.
+
+Layout:
+
+- :mod:`~dynamo_tpu.tuning.space` — the knob registry: typed, bounded,
+  sweepable knobs, each mapped to the config-cascade env name
+  ``tools/check_env_knobs.py`` already enforces.
+- :mod:`~dynamo_tpu.tuning.objective` — trial scoring: goodput from the
+  probe's bench keys (tok/s, ITL p99, TTFT p50) discounted by the
+  burnable lost-time fraction (``gap`` + barrier causes vs. the
+  <5%-of-wall burn-down target).
+- :mod:`~dynamo_tpu.tuning.probe` — the trial evaluator: one seeded
+  mixed workload on a real ``EngineCore`` (CPU mock proxy or a real JAX
+  preset), dry-run-then-measure like every bench probe, returning bench
+  keys + the ``loss_snapshot()`` delta of the measured pass.
+- :mod:`~dynamo_tpu.tuning.search` — coordinate descent with
+  successive halving, resumable JSONL trial journals under
+  ``bench/results/tune/``, and a plateau early-stop rule.
+- :mod:`~dynamo_tpu.tuning.profile` — the winning-profile JSON artifact
+  ``launch.py --tune-profile`` loads (explicit env/CLI still wins).
+- :mod:`~dynamo_tpu.tuning.metrics` — ``dynamo_tuner_trials_total`` /
+  ``dynamo_tuner_best_score``.
+
+Entry points: ``python -m dynamo_tpu.tuning`` and ``bench.py --tune``.
+"""
+
+from dynamo_tpu.tuning.objective import BURN_DOWN_TARGET, burn_down, score_trial
+from dynamo_tpu.tuning.profile import apply_profile, load_profile, make_profile, save_profile
+from dynamo_tpu.tuning.search import Tuner
+from dynamo_tpu.tuning.space import KNOBS, Knob, default_assignment, get_knob, select_knobs
+
+__all__ = [
+    "BURN_DOWN_TARGET",
+    "KNOBS",
+    "Knob",
+    "Tuner",
+    "apply_profile",
+    "burn_down",
+    "default_assignment",
+    "get_knob",
+    "load_profile",
+    "make_profile",
+    "save_profile",
+    "score_trial",
+    "select_knobs",
+]

@@ -1,0 +1,40 @@
+"""Tuner telemetry registry.
+
+Tiny on purpose: the search's own record of truth is the trial journal
+(resumable JSONL under ``bench/results/tune/``); these families exist so a
+long-running tuning session is observable like every other plane —
+``dynamo_tuner_trials_total`` rates trial progress, and
+``dynamo_tuner_best_score`` tracks convergence. Registered with
+``tools/check_metric_names.py`` alongside the frontend/engine/fleet
+registries.
+"""
+
+from __future__ import annotations
+
+from prometheus_client import CollectorRegistry, Counter, Gauge, generate_latest
+
+
+class TunerMetrics:
+    """Registry for one auto-tuner session."""
+
+    def __init__(self, registry: CollectorRegistry | None = None) -> None:
+        self.registry = registry or CollectorRegistry()
+        self._trials = Counter(
+            "dynamo_tuner_trials",
+            "Measured auto-tuner trials (journal cache hits do not count)",
+            ["preset", "mode"], registry=self.registry,
+        )
+        self._best = Gauge(
+            "dynamo_tuner_best_score",
+            "Best objective score the search has accepted so far",
+            ["preset", "mode"], registry=self.registry,
+        )
+
+    def observe_trial(self, preset: str, mode: str) -> None:
+        self._trials.labels(preset, mode).inc()
+
+    def set_best(self, preset: str, mode: str, score: float) -> None:
+        self._best.labels(preset, mode).set(score)
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)

@@ -1,0 +1,103 @@
+"""Trial scoring: bench keys joined with the lost-time vocabulary.
+
+A trial is scored from what the probe measured — throughput (tok/s), tail
+latency (ITL p99, TTFT p50) — *and* where its wall clock went, per the
+pinned attribution vocabulary (:data:`LOSS_CAUSES`). Raw throughput alone
+would happily trade a 2x ITL tail for 5% more tok/s; latency targets alone
+would pin every knob at its most conservative rung. The join optimizes
+goodput while explicitly driving the *burnable* loss causes — the host
+``gap`` plus every overlap-barrier reason — toward the burn-down target
+ROADMAP item 3 sets: under 5% of wall.
+
+Scores are comparable only within one probe configuration (same preset,
+workload shape, platform); the search never mixes rungs of different probe
+lengths into one argmax without re-measuring.
+"""
+
+from __future__ import annotations
+
+from dynamo_tpu.engine.core import BARRIER_REASONS
+
+#: The burn-down target: gap + barrier:* may consume at most this fraction
+#: of step wall time before the objective starts discounting the trial.
+BURN_DOWN_TARGET = 0.05
+
+#: Loss causes the tuner can actually burn down with the knobs it sweeps —
+#: the host gap between dispatches and every overlap-barrier reason.
+#: Pre-admission waits (queue/admission) price load, not knob settings.
+BURNABLE_CAUSES = tuple(BARRIER_REASONS) + ("gap", "onboard_stall", "recompile")
+
+
+def burn_down(loss: dict) -> dict:
+    """Per-cause fractions of step wall time, from a loss snapshot (delta).
+
+    ``loss`` is an ``EngineCore.loss_snapshot()``-shaped dict (typically the
+    measured pass's delta). Returns stable keys:
+
+    - ``frac_by_cause``: each charged cause as a fraction of ``wall + gap``
+      (the full serving timeline the step loop owned).
+    - ``burnable_frac``: the sum over :data:`BURNABLE_CAUSES` — the number
+      the burn-down target bounds.
+    - ``target`` / ``met``: :data:`BURN_DOWN_TARGET` and whether this trial
+      is under it.
+    """
+    step = loss.get("step_time_ms", {})
+    wall = float(step.get("wall", 0.0)) + float(step.get("gap", 0.0))
+    lost = loss.get("lost_time_ms", {})
+    frac = {
+        cause: (float(ms) / wall if wall > 0.0 else 0.0)
+        for cause, ms in sorted(lost.items())
+    }
+    burnable = sum(f for cause, f in frac.items() if cause in BURNABLE_CAUSES)
+    return {
+        "frac_by_cause": frac,
+        "burnable_frac": burnable,
+        "target": BURN_DOWN_TARGET,
+        "met": burnable <= BURN_DOWN_TARGET,
+    }
+
+
+def score_trial(
+    metrics: dict,
+    *,
+    itl_p99_target_ms: float = 50.0,
+    ttft_p50_target_ms: float = 500.0,
+) -> tuple[float, dict]:
+    """Score one trial; higher is better.
+
+    ``metrics`` carries the probe's bench keys (``tok_per_sec``,
+    ``itl_p99_ms``, ``ttft_p50_ms``) and ``loss`` (the measured pass's
+    loss-snapshot delta). The score is throughput discounted by three
+    multiplicative factors, each 1.0 when its budget is respected:
+
+    - ``itl_factor`` / ``ttft_factor``: ``target / actual`` once the tail
+      overshoots its SLO target — goodput, not raw throughput.
+    - ``burn_factor``: ``1 - (burnable_frac - target)`` once the burnable
+      lost-time fraction exceeds the burn-down target, so two trials with
+      equal goodput rank by how little serving time they waste.
+
+    Returns ``(score, breakdown)``; the breakdown lands in the trial
+    journal so a report can explain every ranking.
+    """
+    tok = float(metrics.get("tok_per_sec", 0.0))
+    itl = float(metrics.get("itl_p99_ms", 0.0))
+    ttft = float(metrics.get("ttft_p50_ms", 0.0))
+    itl_factor = min(1.0, itl_p99_target_ms / itl) if itl > itl_p99_target_ms else 1.0
+    ttft_factor = (
+        min(1.0, ttft_p50_target_ms / ttft) if ttft > ttft_p50_target_ms else 1.0
+    )
+    burn = burn_down(metrics.get("loss", {}))
+    burn_factor = max(0.0, 1.0 - max(0.0, burn["burnable_frac"] - burn["target"]))
+    score = tok * itl_factor * ttft_factor * burn_factor
+    return score, {
+        "tok_per_sec": tok,
+        "itl_p99_ms": itl,
+        "itl_factor": round(itl_factor, 4),
+        "ttft_p50_ms": ttft,
+        "ttft_factor": round(ttft_factor, 4),
+        "burnable_frac": round(burn["burnable_frac"], 4),
+        "burn_target": burn["target"],
+        "burn_factor": round(burn_factor, 4),
+        "frac_by_cause": {c: round(f, 4) for c, f in burn["frac_by_cause"].items()},
+        "score": round(score, 4),
+    }

@@ -1,0 +1,212 @@
+"""The trial evaluator: one seeded mixed workload on a real ``EngineCore``.
+
+Every trial runs the *identical* scenario — N seeded prompts, half admitted
+up front and the rest dripped in to force mixed prefill+decode steps — and
+reports the bench keys the objective consumes (tok/s, ITL p50/p99, TTFT
+p50) joined with the measured pass's ``loss_snapshot()`` delta. Two probe
+backends share the scenario:
+
+- ``mock`` — the CPU proxy: ``MockRunner`` realtime timing (the fleetsim
+  engine), CI-scale seconds per trial. Engine/scheduler knobs move real
+  scheduling decisions; kernel-layer knobs are inert here (the space marks
+  them ``hardware_only``).
+- ``jax`` — a real model preset through ``ModelRunner``; the same code
+  path scales unchanged to a chip (swap the preset, keep the discipline).
+
+Trials are comparable because each one follows the bench suite's warm-up
+rule: the scenario runs TWICE on one engine and only the second pass is
+measured — the step-bucket lattice is data-dependent, so the only warm-up
+that provably compiles (or warms) every shape the measurement hits is an
+identical dry run. Knobs without an ``EngineConfig`` field are applied as
+a scoped env overlay restored after the trial.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Iterator
+
+import numpy as np
+
+from dynamo_tpu.config import TuneSettings
+from dynamo_tpu.tuning.space import get_knob, validate_assignment
+
+
+def _pct(xs: list[float], p: float) -> float:
+    return xs[min(len(xs) - 1, int(p * len(xs)))] if xs else 0.0
+
+
+def _delta(after: dict, before: dict) -> dict:
+    """Elementwise numeric delta of two loss snapshots (nested dicts)."""
+    out: dict = {}
+    for key, a in after.items():
+        b = before.get(key)
+        if isinstance(a, dict):
+            out[key] = _delta(a, b if isinstance(b, dict) else {})
+        elif isinstance(a, (int, float)):
+            out[key] = a - (b if isinstance(b, (int, float)) else 0)
+        else:
+            out[key] = a
+    return out
+
+
+@contextlib.contextmanager
+def env_overlay(assignment: dict[str, int]) -> Iterator[None]:
+    """Apply the env-mapped knobs of ``assignment`` for the trial's scope.
+
+    Every knob is exported (engine-field knobs too — their env readers are
+    the source of truth for subsystems the probe does not construct
+    directly), and the prior environment is restored exactly on exit so
+    trials cannot leak settings into each other or the caller.
+    """
+    saved: dict[str, str | None] = {}
+    try:
+        for name, value in assignment.items():
+            env_name = get_knob(name).env
+            saved[env_name] = os.environ.get(env_name)
+            os.environ[env_name] = str(value)
+        yield
+    finally:
+        for env_name, prior in saved.items():
+            if prior is None:
+                os.environ.pop(env_name, None)
+            else:
+                os.environ[env_name] = prior
+
+
+def _build_core(assignment: dict[str, int], settings: TuneSettings, requests: int):
+    from dynamo_tpu.engine.core import EngineConfig
+
+    isl, osl = settings.isl, settings.osl
+    page_size = 16 if settings.mode == "mock" else 64
+    num_pages = requests * ((isl + osl) // page_size + 2) + 16
+    cfg = EngineConfig(
+        num_pages=num_pages,
+        page_size=page_size,
+        max_batch_size=requests + 2,
+        max_prefill_tokens=max(isl * requests, isl),
+        max_seq_len=isl + osl + 8,
+        enable_prefix_caching=False,
+        chunk_prefill_tokens=int(assignment.get("chunk_prefill_tokens", 512)),
+        decode_steps=int(assignment.get("decode_steps", 1)),
+        spec_k=int(assignment.get("spec_k", 0)),
+    )
+    if settings.mode == "mock":
+        from dynamo_tpu.mocker import build_mock_core
+
+        return build_mock_core(cfg, seed=settings.seed, d2h_us=200.0), 32000
+    from dynamo_tpu.engine.runner import ModelRunner
+    from dynamo_tpu.models import llama
+    from dynamo_tpu.models.config import PRESETS
+
+    model_cfg = PRESETS[settings.preset]
+    params = llama.init_params(model_cfg, 0)
+    runner = ModelRunner(
+        model_cfg, params, num_pages=num_pages, page_size=page_size,
+        max_batch_size=requests + 2, prefill_bucket=max(isl, 64),
+    )
+    from dynamo_tpu.engine.core import EngineCore
+
+    return EngineCore(runner, cfg), model_cfg.vocab_size
+
+
+def _prompts(rng: np.random.Generator, requests: int, isl: int, vocab: int) -> list[list[int]]:
+    """Seeded prompts, half patterned so the n-gram drafter has structure
+    (the regime spec_k targets; uniform-random text pins acceptance at 0)."""
+    pattern = rng.integers(1, vocab - 1, size=16).tolist()
+    out = []
+    for i in range(requests):
+        if i % 2 == 0:
+            reps = isl // len(pattern) + 1
+            out.append((pattern * reps)[:isl])
+        else:
+            out.append(rng.integers(1, vocab - 1, size=isl).tolist())
+    return out
+
+
+def run_probe(
+    assignment: dict[str, int],
+    settings: TuneSettings,
+    *,
+    requests: int | None = None,
+) -> dict:
+    """Evaluate one knob assignment; returns the objective's metric dict.
+
+    Keys: ``tok_per_sec``, ``itl_p50_ms``, ``itl_p99_ms``, ``ttft_p50_ms``,
+    ``generated_tokens``, ``steps``, ``elapsed_s``, and ``loss`` — the
+    measured pass's ``EngineCore.loss_snapshot()`` delta.
+    """
+    from dynamo_tpu.protocols.common import PreprocessedRequest, SamplingOptions, StopConditions
+
+    validate_assignment(assignment)
+    requests = requests or settings.requests
+    with env_overlay(assignment):
+        core, vocab = _build_core(assignment, settings, requests)
+        rng = np.random.default_rng(settings.seed)
+        prompts = _prompts(rng, requests, settings.isl, vocab)
+
+        def scenario() -> dict:
+            def submit(tokens: list[int]):
+                return core.add_request(PreprocessedRequest(
+                    token_ids=list(tokens),
+                    sampling=SamplingOptions(temperature=0.0),
+                    stop=StopConditions(max_tokens=settings.osl, ignore_eos=True),
+                ))
+
+            t0 = time.perf_counter()
+            submitted: dict[int, float] = {}
+            emits: dict[int, list[float]] = {}
+            first: dict[int, float] = {}
+            # Half the load up front, the rest dripped one per step: forces
+            # the mixed prefill+decode regime every knob here is about.
+            pending = list(prompts)
+            for _ in range(max(1, requests // 2)):
+                seq = submit(pending.pop(0))
+                submitted[seq.seq_id] = time.perf_counter()
+                emits[seq.seq_id] = []
+            steps = 0
+            generated = 0
+            last_emit = t0
+            while core.has_work or pending:
+                if pending and steps % 2 == 0:
+                    seq = submit(pending.pop(0))
+                    submitted[seq.seq_id] = time.perf_counter()
+                    emits[seq.seq_id] = []
+                outputs = core.step()
+                now = time.perf_counter()
+                steps += 1
+                for seq, out in outputs:
+                    n = len(out.token_ids)
+                    if not n:
+                        continue
+                    generated += n
+                    last_emit = now
+                    first.setdefault(seq.seq_id, now)
+                    emits[seq.seq_id].append(now)
+            elapsed = max(last_emit - t0, 1e-9)
+            itls = sorted(
+                (b - a) * 1e3
+                for ts in emits.values()
+                for a, b in zip(ts, ts[1:])
+            )
+            ttfts = sorted(
+                (first[sid] - submitted[sid]) * 1e3
+                for sid in first
+            )
+            return {
+                "tok_per_sec": round(generated / elapsed, 2),
+                "itl_p50_ms": round(_pct(itls, 0.50), 3),
+                "itl_p99_ms": round(_pct(itls, 0.99), 3),
+                "ttft_p50_ms": round(_pct(ttfts, 0.50), 3),
+                "generated_tokens": generated,
+                "steps": steps,
+                "elapsed_s": round(elapsed, 4),
+            }
+
+        scenario()  # dry run: warms every step-bucket shape the pass hits
+        before = core.loss_snapshot()
+        metrics = scenario()
+        metrics["loss"] = _delta(core.loss_snapshot(), before)
+        return metrics

@@ -1,0 +1,92 @@
+"""The winning-profile artifact: a tuned knob assignment as a JSON file.
+
+A profile is deliberately *just documented env assignments* — the same
+config-cascade names ``tools/check_env_knobs.py`` enforces — so applying
+one needs no new plumbing anywhere: ``launch.py --tune-profile p.json``
+exports each assignment into the environment the existing readers already
+resolve. Precedence is explicit-wins: a knob the operator set via env or
+CLI is never overridden by a profile (env > CLI > profile).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable, Mapping, MutableMapping
+
+from dynamo_tpu.tuning.space import assignment_env, validate_assignment
+
+PROFILE_VERSION = 1
+
+
+def make_profile(
+    assignment: dict[str, int],
+    *,
+    preset: str,
+    mode: str,
+    platform: str,
+    score: float,
+    baseline_score: float,
+    meta: dict | None = None,
+) -> dict:
+    """A profile document from a winning assignment.
+
+    ``env`` is the applicable payload; everything else is provenance so a
+    reviewer can tell where (and how well) the profile was won.
+    """
+    validate_assignment(assignment)
+    return {
+        "version": PROFILE_VERSION,
+        "preset": preset,
+        "mode": mode,
+        "platform": platform,
+        "assignment": dict(sorted(assignment.items())),
+        "env": dict(sorted(assignment_env(assignment).items())),
+        "score": round(float(score), 4),
+        "baseline_score": round(float(baseline_score), 4),
+        "gain": round(float(score) / baseline_score, 4) if baseline_score else 0.0,
+        "meta": meta or {},
+    }
+
+
+def save_profile(path: str | os.PathLike, profile: dict) -> None:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(profile, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def load_profile(path: str | os.PathLike) -> dict:
+    with open(path) as f:
+        profile = json.load(f)
+    version = profile.get("version")
+    if version != PROFILE_VERSION:
+        raise ValueError(f"{path}: unsupported profile version {version!r}")
+    if not isinstance(profile.get("env"), dict):
+        raise ValueError(f"{path}: profile has no 'env' assignment map")
+    return profile
+
+
+def apply_profile(
+    profile: Mapping,
+    *,
+    env: MutableMapping[str, str] | None = None,
+    cli_set: Iterable[str] = (),
+) -> dict[str, str]:
+    """Export a profile's knobs into ``env``; explicit settings win.
+
+    ``cli_set`` names the env keys whose values the CLI set explicitly
+    (the launcher derives it from non-default flags). A profile entry is
+    applied only when the operator expressed *no* opinion: the key is
+    absent from ``env`` (env wins) and not in ``cli_set`` (CLI wins).
+    Returns the entries actually applied.
+    """
+    env = os.environ if env is None else env
+    cli_set = set(cli_set)
+    applied: dict[str, str] = {}
+    for key, value in profile["env"].items():
+        if key in env or key in cli_set:
+            continue
+        env[key] = str(value)
+        applied[key] = str(value)
+    return applied

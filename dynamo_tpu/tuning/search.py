@@ -1,0 +1,274 @@
+"""The search driver: coordinate descent + successive halving + journal.
+
+The space is small and discrete (each knob an explicit ladder), trials are
+seconds-scale, and knob interactions are mostly separable — so the search
+is deliberately simple and *auditable* rather than clever:
+
+- **Coordinate descent**: sweep one knob at a time in registry order,
+  holding the incumbent assignment for the rest; accept a move only when
+  its full-length probe beats the incumbent by more than ``plateau_eps``.
+- **Successive halving** per coordinate: every candidate first runs a
+  short probe (``rung_frac`` of the full request count); only the top
+  half graduates to full-length probes. Short probes never rank against
+  full probes — the argmax is always taken within one rung.
+- **Plateau early-stop**: a full round with no accepted move counts as a
+  plateau; ``plateau_rounds`` consecutive plateaus (or the round budget,
+  or ``max_trials``) ends the search.
+
+Every probe lands in a resumable JSONL journal keyed by (assignment,
+probe length): re-running the same search replays completed trials from
+the journal instead of re-measuring, so an interrupted session continues
+where it stopped and a finished one is fully deterministic to re-audit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import math
+import os
+import time
+from typing import Callable
+
+from dynamo_tpu.config import TuneSettings
+from dynamo_tpu.tuning.objective import burn_down, score_trial
+from dynamo_tpu.tuning.space import Knob, default_assignment, select_knobs
+
+logger = logging.getLogger(__name__)
+
+
+class BudgetExhausted(Exception):
+    """Raised internally when ``max_trials`` measured probes are spent."""
+
+
+class TrialJournal:
+    """Append-only JSONL trial log; the resume cache is its replay."""
+
+    def __init__(self, path: str | os.PathLike) -> None:
+        self.path = str(path)
+        self._cache: dict[str, dict] = {}
+        if os.path.exists(self.path):
+            with open(self.path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    entry = json.loads(line)
+                    self._cache[entry["key"]] = entry
+        self.loaded = len(self._cache)
+
+    @staticmethod
+    def key(assignment: dict[str, int], requests: int) -> str:
+        return json.dumps(
+            {"assignment": dict(sorted(assignment.items())), "requests": requests},
+            sort_keys=True, separators=(",", ":"),
+        )
+
+    def lookup(self, assignment: dict[str, int], requests: int) -> dict | None:
+        return self._cache.get(self.key(assignment, requests))
+
+    def record(self, entry: dict) -> None:
+        self._cache[entry["key"]] = entry
+        os.makedirs(os.path.dirname(os.path.abspath(self.path)), exist_ok=True)
+        with open(self.path, "a") as f:
+            f.write(json.dumps(entry, sort_keys=True) + "\n")
+
+
+class Tuner:
+    """Closed-loop knob search for one (preset, workload-shape, platform).
+
+    ``probe_fn(assignment, requests) -> metrics`` defaults to the real
+    engine probe; tests inject synthetic objectives through it.
+    """
+
+    def __init__(
+        self,
+        settings: TuneSettings | None = None,
+        *,
+        probe_fn: Callable[[dict, int], dict] | None = None,
+        knobs: tuple[Knob, ...] | None = None,
+        metrics=None,
+    ) -> None:
+        self.settings = settings or TuneSettings()
+        s = self.settings
+        if probe_fn is None:
+            from dynamo_tpu.tuning.probe import run_probe
+
+            probe_fn = lambda assignment, requests: run_probe(  # noqa: E731
+                assignment, s, requests=requests
+            )
+        self.probe_fn = probe_fn
+        self.knobs = knobs if knobs is not None else select_knobs(
+            s.knobs, hardware=(s.mode != "mock")
+        )
+        if not self.knobs:
+            raise ValueError("tuner has no knobs to sweep")
+        self.journal = TrialJournal(os.path.join(s.out_dir, "journal.jsonl"))
+        self.metrics = metrics
+        self.trials_measured = 0
+        self.trials_cached = 0
+
+    # -- trial evaluation --------------------------------------------------
+
+    def evaluate(self, assignment: dict[str, int], requests: int) -> dict:
+        key = TrialJournal.key(assignment, requests)
+        cached = self.journal.lookup(assignment, requests)
+        if cached is not None:
+            self.trials_cached += 1
+            return cached
+        s = self.settings
+        if s.max_trials and self.trials_measured >= s.max_trials:
+            raise BudgetExhausted(f"max_trials={s.max_trials} measured probes spent")
+        t0 = time.perf_counter()
+        metrics = self.probe_fn(assignment, requests)
+        score, breakdown = score_trial(metrics)
+        self.trials_measured += 1
+        entry = {
+            "key": key,
+            "trial": self.trials_measured,
+            "assignment": dict(sorted(assignment.items())),
+            "requests": requests,
+            "metrics": metrics,
+            "score": round(score, 4),
+            "breakdown": breakdown,
+            "probe_wall_s": round(time.perf_counter() - t0, 3),
+        }
+        self.journal.record(entry)
+        if self.metrics is not None:
+            self.metrics.observe_trial(s.preset, s.mode)
+        return entry
+
+    # -- the loop ----------------------------------------------------------
+
+    def _sweep_knob(self, knob: Knob, current: dict[str, int], best: dict) -> tuple[dict[str, int], dict, bool]:
+        """One coordinate: halve candidates on short probes, settle on full."""
+        s = self.settings
+        short = max(2, int(math.ceil(s.requests * s.rung_frac)))
+        rung0 = [
+            (value, self.evaluate({**current, knob.name: value}, short))
+            for value in knob.candidates
+        ]
+        keep = max(1, math.ceil(len(rung0) / 2))
+        survivors = sorted(rung0, key=lambda r: -r[1]["score"])[:keep]
+        # Settle survivors at full length, in ladder order (deterministic).
+        finalists = [
+            (value, self.evaluate({**current, knob.name: value}, s.requests))
+            for value, _ in sorted(survivors, key=lambda r: knob.candidates.index(r[0]))
+        ]
+        value, entry = max(finalists, key=lambda r: r[1]["score"])
+        if value != current[knob.name] and entry["score"] > best["score"] * (1.0 + s.plateau_eps):
+            logger.info(
+                "tuner: %s %s -> %s (score %.2f -> %.2f)",
+                knob.name, current[knob.name], value, best["score"], entry["score"],
+            )
+            return {**current, knob.name: value}, entry, True
+        return current, best, False
+
+    def run(self) -> dict:
+        """Run the search to convergence; write profile + report; return the
+        report document."""
+        s = self.settings
+        current = default_assignment(self.knobs)
+        stopped = "rounds"
+        history: list[dict] = []
+        try:
+            baseline = self.evaluate(current, s.requests)
+            best = baseline
+            plateaus = 0
+            for round_no in range(1, s.rounds + 1):
+                moved = False
+                for knob in self.knobs:
+                    current, best, accepted = self._sweep_knob(knob, current, best)
+                    if accepted:
+                        moved = True
+                        history.append({
+                            "round": round_no, "knob": knob.name,
+                            "value": current[knob.name],
+                            "score": best["score"],
+                        })
+                        if self.metrics is not None:
+                            self.metrics.set_best(s.preset, s.mode, best["score"])
+                if not moved:
+                    plateaus += 1
+                    if plateaus >= s.plateau_rounds:
+                        stopped = "plateau"
+                        break
+                else:
+                    plateaus = 0
+        except BudgetExhausted as exc:
+            logger.info("tuner: %s", exc)
+            stopped = "budget"
+        return self._finalize(current, baseline, best, history, stopped)
+
+    # -- artifacts ---------------------------------------------------------
+
+    def _finalize(
+        self, assignment: dict[str, int], baseline: dict, best: dict,
+        history: list[dict], stopped: str,
+    ) -> dict:
+        from dynamo_tpu.tuning.profile import make_profile, save_profile
+
+        s = self.settings
+        try:
+            import jax
+
+            platform = jax.default_backend()
+        except Exception:
+            platform = "unknown"
+        profile = make_profile(
+            assignment,
+            preset=s.preset, mode=s.mode, platform=platform,
+            score=best["score"], baseline_score=baseline["score"],
+            meta={
+                "requests": s.requests, "isl": s.isl, "osl": s.osl,
+                "seed": s.seed, "stopped": stopped,
+                "trials_measured": self.trials_measured,
+            },
+        )
+        base_burn = burn_down(baseline["metrics"].get("loss", {}))
+        best_burn = burn_down(best["metrics"].get("loss", {}))
+        causes = sorted(
+            set(base_burn["frac_by_cause"]) | set(best_burn["frac_by_cause"])
+        )
+        report = {
+            "settings": dataclasses.asdict(s),
+            "platform": platform,
+            "knobs_swept": [k.name for k in self.knobs],
+            "baseline": baseline,
+            "best": best,
+            "gain": round(best["score"] / baseline["score"], 4)
+            if baseline["score"] else 0.0,
+            "stopped": stopped,
+            "trials_measured": self.trials_measured,
+            "trials_cached": self.trials_cached,
+            "history": history,
+            # The per-cause burn-down story: where the winning profile's
+            # wall-time went vs. the untuned default's, as fractions of
+            # each run's own serving timeline.
+            "burn_down": {
+                "target": base_burn["target"],
+                "baseline_burnable_frac": round(base_burn["burnable_frac"], 4),
+                "best_burnable_frac": round(best_burn["burnable_frac"], 4),
+                "baseline_met": base_burn["met"],
+                "best_met": best_burn["met"],
+                "frac_by_cause": {
+                    cause: {
+                        "baseline": round(base_burn["frac_by_cause"].get(cause, 0.0), 4),
+                        "best": round(best_burn["frac_by_cause"].get(cause, 0.0), 4),
+                    }
+                    for cause in causes
+                },
+            },
+        }
+        os.makedirs(s.out_dir, exist_ok=True)
+        profile_path = os.path.join(s.out_dir, "profile.json")
+        report_path = os.path.join(s.out_dir, "report.json")
+        save_profile(profile_path, profile)
+        with open(report_path, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+            f.write("\n")
+        report["profile_path"] = profile_path
+        report["report_path"] = report_path
+        report["journal_path"] = self.journal.path
+        return report

@@ -1,0 +1,166 @@
+"""The knob-space registry: every performance knob the auto-tuner may sweep.
+
+Each :class:`Knob` is typed, bounded (an explicit ordered candidate ladder —
+no unbounded numeric search), and mapped to the config-cascade env name that
+``tools/check_env_knobs.py`` already enforces, so a tuned profile is just a
+set of documented env assignments any deployment already understands.
+
+Knobs that the probe can apply directly on :class:`EngineConfig` carry an
+``engine_field``; the rest are applied as a scoped env overlay around the
+trial (their readers resolve the env at trace/connect time). Knobs whose
+effect only exists on real hardware (``hardware_only``) are skipped by the
+CPU mock proxy unless explicitly requested — sweeping them there would just
+fit timing noise — but sweep normally under the ``jax`` probe on a chip.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+_MIB = 1024 * 1024
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One sweepable performance knob.
+
+    ``candidates`` is the full ordered ladder INCLUDING ``default`` — the
+    search compares every rung against the incumbent, so the default must
+    be reachable (and re-winnable) like any other value.
+    """
+
+    name: str  # tuner-facing short name (journal / profile keys)
+    env: str  # config-cascade env name (check_env_knobs-enforced)
+    candidates: tuple[int, ...]  # ordered sweep ladder
+    default: int  # untuned default (mirrors the reader's own default)
+    layer: str  # scheduler | engine | kernel | quant | wire | tiers
+    doc: str
+    engine_field: str | None = None  # EngineConfig field, when one exists
+    hardware_only: bool = False  # no observable effect on the CPU proxy
+
+    def __post_init__(self) -> None:
+        if self.default not in self.candidates:
+            raise ValueError(
+                f"knob {self.name}: default {self.default} not in candidates"
+            )
+
+
+#: The registry. Order is the coordinate-descent sweep order: scheduler-level
+#: knobs first (largest, most portable effects), hardware-bound knobs last.
+KNOBS: tuple[Knob, ...] = (
+    Knob(
+        name="chunk_prefill_tokens",
+        env="DYN_WORKER_CHUNK_PREFILL_TOKENS",
+        candidates=(128, 256, 512, 1024),
+        default=512,
+        layer="scheduler",
+        doc="Per-step prefill chunk budget fused with decodes; smaller "
+        "bounds decode stalls (ITL), larger finishes prefills (TTFT).",
+        engine_field="chunk_prefill_tokens",
+    ),
+    Knob(
+        name="decode_steps",
+        env="DYN_WORKER_DECODE_STEPS",
+        candidates=(1, 2, 4, 8),
+        default=1,
+        layer="engine",
+        doc="Fused decode steps per device dispatch; amortizes dispatch "
+        "and device->host copies at the cost of coarser token delivery.",
+        engine_field="decode_steps",
+    ),
+    Knob(
+        name="spec_k",
+        env="DYN_WORKER_SPEC_K",
+        candidates=(0, 2, 4),
+        default=0,
+        layer="engine",
+        doc="Speculative-decoding draft length (lossless n-gram "
+        "self-drafting); pays verify overhead for multi-token steps.",
+        engine_field="spec_k",
+    ),
+    Knob(
+        name="decode_splits",
+        env="DYN_DECODE_SPLITS",
+        candidates=(0, 2, 4, 8),
+        default=0,
+        layer="kernel",
+        doc="Split-K factor of the paged-attention decode kernel "
+        "(0 = shape heuristic); resolved at trace time.",
+        hardware_only=True,
+    ),
+    Knob(
+        name="quant_group_size",
+        env="DYN_QUANT_GROUP_SIZE",
+        candidates=(32, 64, 128, 256),
+        default=128,
+        layer="quant",
+        doc="int4 weight-quantization group width along the contraction "
+        "axis; trades scale-stream bytes against dequant granularity.",
+        hardware_only=True,
+    ),
+    Knob(
+        name="kv_wire_inflight",
+        env="DYN_KV_WIRE_INFLIGHT",
+        candidates=(64 * _MIB, 128 * _MIB, 256 * _MIB, 512 * _MIB),
+        default=256 * _MIB,
+        layer="wire",
+        doc="KV-wire in-flight byte budget across sessions (the DMA-depth "
+        "analog): deeper hides RTT, shallower bounds receiver staging.",
+        hardware_only=True,
+    ),
+    Knob(
+        name="onboard_pool_width",
+        env="DYN_ONBOARD_POOL_WIDTH",
+        candidates=(1, 2, 4, 8),
+        default=2,
+        layer="tiers",
+        doc="KV-tier onboard fetch pool width; wider overlaps more tier "
+        "reads with the forward pass but contends for HBM bandwidth.",
+        hardware_only=True,
+    ),
+)
+
+_BY_NAME = {k.name: k for k in KNOBS}
+
+
+def get_knob(name: str) -> Knob:
+    try:
+        return _BY_NAME[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown knob {name!r}; known: {sorted(_BY_NAME)}"
+        ) from None
+
+
+def select_knobs(names: str | list[str] | None = None, *, hardware: bool = True) -> tuple[Knob, ...]:
+    """The knobs a search sweeps.
+
+    ``names`` (comma string or list) restricts to an explicit subset — and
+    overrides the hardware filter, so a CPU run can still force-sweep a
+    hardware knob for loop testing. Otherwise ``hardware=False`` (the mock
+    proxy) drops ``hardware_only`` knobs.
+    """
+    if names:
+        if isinstance(names, str):
+            names = [n.strip() for n in names.split(",") if n.strip()]
+        return tuple(get_knob(n) for n in names)
+    return tuple(k for k in KNOBS if hardware or not k.hardware_only)
+
+
+def default_assignment(knobs: tuple[Knob, ...] = KNOBS) -> dict[str, int]:
+    """The untuned baseline point of the space."""
+    return {k.name: k.default for k in knobs}
+
+
+def assignment_env(assignment: dict[str, int]) -> dict[str, str]:
+    """An assignment as the env overlay its readers resolve."""
+    return {get_knob(name).env: str(value) for name, value in assignment.items()}
+
+
+def validate_assignment(assignment: dict[str, int]) -> None:
+    for name, value in assignment.items():
+        knob = get_knob(name)
+        if value not in knob.candidates:
+            raise ValueError(
+                f"knob {name}: value {value} not on its ladder {knob.candidates}"
+            )

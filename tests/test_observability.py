@@ -150,6 +150,7 @@ class _FakeCore:
     lost_time_ms = {"gap": 1500.0, "queue": 250.0, "recompile": 40.0}
     step_wall_ms_total = 4000.0
     step_dispatch_ms_total = 3000.0
+    step_kind_counts = {"mixed": 5, "decode": 30}
     sentinel = SimpleNamespace(
         active={"recompile_storm": {"value": 9.0, "threshold": 8.0, "since_step": 300}},
         fired={"recompile_storm": 2},
@@ -234,9 +235,15 @@ EXPECTED_ENGINE_FAMILIES = {
     "dynamo_tenant_throttled_total",
     "dynamo_engine_chunk_budget_tokens",
     # Attribution plane (ISSUE 15): time-loss ledger, step-time composition,
-    # and the anomaly sentinel's active/fired gauges.
+    # and the anomaly sentinel's active/fired gauges. True Counters since
+    # ISSUE 17 (delta-inc on scrape), so the `_total` sample suffix is
+    # honest and each gains a `_created` timestamp family.
     "dynamo_engine_lost_time_seconds_total",
+    "dynamo_engine_lost_time_seconds_created",
     "dynamo_engine_step_time_seconds_total",
+    "dynamo_engine_step_time_seconds_created",
+    "dynamo_engine_step_kind_steps_total",
+    "dynamo_engine_step_kind_steps_created",
     "dynamo_anomaly_active",
     "dynamo_anomaly_fired_total",
     "dynamo_kv_transfer_phase_seconds",
@@ -296,6 +303,8 @@ async def test_engine_metrics_names_labels_and_values():
     assert 'dynamo_engine_step_time_seconds_total{kind="wall",worker="w1"} 4.0' in text
     assert 'dynamo_engine_step_time_seconds_total{kind="dispatch",worker="w1"} 3.0' in text
     assert 'dynamo_engine_step_time_seconds_total{kind="gap",worker="w1"} 0.01' in text
+    assert 'dynamo_engine_step_kind_steps_total{kind="mixed",worker="w1"} 5.0' in text
+    assert 'dynamo_engine_step_kind_steps_total{kind="decode",worker="w1"} 30.0' in text
     assert 'dynamo_anomaly_active{kind="recompile_storm",worker="w1"} 1.0' in text
     assert 'dynamo_anomaly_fired_total{kind="recompile_storm",worker="w1"} 2.0' in text
     assert 'dynamo_engine_pages_active{worker="w1"} 40.0' in text
@@ -324,6 +333,45 @@ async def test_engine_metrics_names_labels_and_values():
 async def test_unbound_engine_metrics_render_safely():
     text = (await EngineMetrics(worker="idle").render()).decode()
     assert 'dynamo_engine_pages_total{worker="idle"} 0.0' in text
+
+
+async def test_lost_time_counters_are_monotone_across_scrapes():
+    """The lost-time/step-time exports are true Counters (ISSUE 17): a
+    scrape incs by the core ledger's delta since the last sync — repeated
+    scrapes never double-book, a growing ledger lands exactly once, and a
+    rebound core's totals accumulate instead of resetting."""
+    core = _FakeCore()
+    core.lost_time_ms = {"gap": 1000.0}
+    core.step_wall_ms_total = 2000.0
+    core.step_kind_counts = {"decode": 10}
+    m = EngineMetrics(worker="w1").bind_core(core)
+
+    def sample(text: str, line_start: str) -> float:
+        for line in text.splitlines():
+            if line.startswith(line_start):
+                return float(line.rsplit(" ", 1)[1])
+        raise AssertionError(f"{line_start} not found")
+
+    text = (await m.render()).decode()
+    assert sample(text, 'dynamo_engine_lost_time_seconds_total{cause="gap",worker="w1"}') == 1.0
+    # Idempotent scrape: no growth without ledger growth.
+    text = (await m.render()).decode()
+    assert sample(text, 'dynamo_engine_lost_time_seconds_total{cause="gap",worker="w1"}') == 1.0
+    # Ledger growth lands exactly once.
+    core.lost_time_ms = {"gap": 1500.0}
+    core.step_kind_counts = {"decode": 12, "mixed": 1}
+    text = (await m.render()).decode()
+    assert sample(text, 'dynamo_engine_lost_time_seconds_total{cause="gap",worker="w1"}') == 1.5
+    assert sample(text, 'dynamo_engine_step_kind_steps_total{kind="decode",worker="w1"}') == 12.0
+    assert sample(text, 'dynamo_engine_step_kind_steps_total{kind="mixed",worker="w1"}') == 1.0
+    # Rebinding a fresh core (restart) accumulates — monotone across cores.
+    fresh = _FakeCore()
+    fresh.lost_time_ms = {"gap": 100.0}
+    fresh.step_kind_counts = {"decode": 2}
+    m.bind_core(fresh)
+    text = (await m.render()).decode()
+    assert sample(text, 'dynamo_engine_lost_time_seconds_total{cause="gap",worker="w1"}') == 1.6
+    assert sample(text, 'dynamo_engine_step_kind_steps_total{kind="decode",worker="w1"}') == 14.0
 
 
 async def test_federate_text_merges_two_workers():

@@ -1,0 +1,383 @@
+"""Auto-tuner tests: search convergence, journal resume, profile precedence,
+objective scoring, the loss-snapshot API, and the end-to-end mock-probe smoke
+(the ``tune`` marker tier)."""
+
+import json
+import os
+
+import pytest
+
+from dynamo_tpu.config import TuneSettings
+from dynamo_tpu.tuning import (
+    BURN_DOWN_TARGET,
+    KNOBS,
+    Tuner,
+    apply_profile,
+    burn_down,
+    default_assignment,
+    get_knob,
+    load_profile,
+    make_profile,
+    save_profile,
+    score_trial,
+    select_knobs,
+)
+from dynamo_tpu.tuning.probe import env_overlay
+from dynamo_tpu.tuning.search import TrialJournal
+from dynamo_tpu.tuning.space import assignment_env, validate_assignment
+
+# ---------------------------------------------------------------- knob space
+
+
+def test_knob_registry_shape():
+    names = [k.name for k in KNOBS]
+    assert len(set(names)) == len(names)
+    envs = [k.env for k in KNOBS]
+    assert len(set(envs)) == len(envs)
+    for knob in KNOBS:
+        assert knob.default in knob.candidates
+        assert knob.env.startswith("DYN_")
+        assert knob.doc
+
+
+def test_select_knobs_hardware_filter():
+    mock_knobs = select_knobs(hardware=False)
+    assert all(not k.hardware_only for k in mock_knobs)
+    assert {k.name for k in mock_knobs} == {
+        "chunk_prefill_tokens", "decode_steps", "spec_k"
+    }
+    # An explicit name list overrides the hardware filter (loop tests can
+    # force-sweep a hardware knob on the CPU proxy).
+    forced = select_knobs("decode_splits,spec_k", hardware=False)
+    assert [k.name for k in forced] == ["decode_splits", "spec_k"]
+
+
+def test_validate_assignment_rejects_off_ladder():
+    with pytest.raises(ValueError, match="not on its ladder"):
+        validate_assignment({"decode_steps": 3})
+    with pytest.raises(KeyError, match="unknown knob"):
+        validate_assignment({"warp_speed": 11})
+
+
+def test_env_overlay_restores_exactly(monkeypatch):
+    monkeypatch.setenv("DYN_WORKER_DECODE_STEPS", "7")
+    monkeypatch.delenv("DYN_WORKER_SPEC_K", raising=False)
+    with env_overlay({"decode_steps": 4, "spec_k": 2}):
+        assert os.environ["DYN_WORKER_DECODE_STEPS"] == "4"
+        assert os.environ["DYN_WORKER_SPEC_K"] == "2"
+    assert os.environ["DYN_WORKER_DECODE_STEPS"] == "7"
+    assert "DYN_WORKER_SPEC_K" not in os.environ
+
+
+# ----------------------------------------------------------------- objective
+
+
+def test_score_trial_is_throughput_when_within_budgets():
+    score, breakdown = score_trial(
+        {"tok_per_sec": 1234.0, "itl_p99_ms": 10.0, "ttft_p50_ms": 100.0, "loss": {}}
+    )
+    assert score == 1234.0
+    assert breakdown["itl_factor"] == 1.0
+    assert breakdown["ttft_factor"] == 1.0
+    assert breakdown["burn_factor"] == 1.0
+
+
+def test_score_trial_discounts_tail_overshoot():
+    score, breakdown = score_trial(
+        {"tok_per_sec": 1000.0, "itl_p99_ms": 100.0, "ttft_p50_ms": 0.0, "loss": {}}
+    )
+    assert breakdown["itl_factor"] == 0.5
+    assert score == 500.0
+
+
+def test_score_trial_discounts_burnable_loss():
+    loss = {
+        "step_time_ms": {"wall": 900.0, "dispatch": 800.0, "gap": 100.0},
+        "lost_time_ms": {"gap": 100.0, "queue": 500.0},
+    }
+    score, breakdown = score_trial(
+        {"tok_per_sec": 1000.0, "itl_p99_ms": 0.0, "ttft_p50_ms": 0.0, "loss": loss}
+    )
+    # gap is burnable (100/1000 of the timeline); queue prices load, not
+    # knobs, and must not discount the trial.
+    assert breakdown["burnable_frac"] == 0.1
+    assert breakdown["burn_factor"] == pytest.approx(1.0 - (0.1 - BURN_DOWN_TARGET))
+    assert score == pytest.approx(950.0)
+
+
+def test_burn_down_target_and_met():
+    ok = burn_down({
+        "step_time_ms": {"wall": 1000.0, "gap": 0.0},
+        "lost_time_ms": {"gap": 10.0},
+    })
+    assert ok["met"] and ok["burnable_frac"] == pytest.approx(0.01)
+    bad = burn_down({
+        "step_time_ms": {"wall": 1000.0, "gap": 0.0},
+        "lost_time_ms": {"gap": 200.0, "spec": 100.0},
+    })
+    assert not bad["met"] and bad["burnable_frac"] == pytest.approx(0.3)
+    assert bad["target"] == BURN_DOWN_TARGET
+    # Degenerate empty snapshot: no wall, nothing burnable, target met.
+    assert burn_down({})["met"]
+
+
+# ---------------------------------------------------- search on a synthetic
+# objective with a planted optimum: separable quadratic over ladder indices.
+
+OPTIMUM = {"chunk_prefill_tokens": 256, "decode_steps": 4, "spec_k": 2}
+
+
+def quadratic_probe(assignment, requests):
+    dist = sum(
+        (get_knob(n).candidates.index(assignment[n])
+         - get_knob(n).candidates.index(opt)) ** 2
+        for n, opt in OPTIMUM.items()
+    )
+    return {
+        "tok_per_sec": 1000.0 - 100.0 * dist,
+        "itl_p99_ms": 0.0,
+        "ttft_p50_ms": 0.0,
+        "loss": {},
+    }
+
+
+def _settings(out_dir, **kw):
+    base = dict(mode="mock", seed=0, rounds=3, requests=16, out_dir=str(out_dir))
+    base.update(kw)
+    return TuneSettings(**base)
+
+
+def test_search_converges_to_planted_optimum(tmp_path):
+    tuner = Tuner(_settings(tmp_path), probe_fn=quadratic_probe)
+    report = tuner.run()
+    assert report["best"]["assignment"] == dict(sorted(OPTIMUM.items()))
+    # Defaults (512, 1, 0) sit at squared ladder distance 6 -> score 400;
+    # the optimum scores 1000.
+    assert report["baseline"]["score"] == 400.0
+    assert report["best"]["score"] == 1000.0
+    assert report["gain"] == 2.5
+    assert report["stopped"] == "plateau"
+    assert [h["knob"] for h in report["history"]] == [
+        "chunk_prefill_tokens", "decode_steps", "spec_k"
+    ]
+
+
+def test_search_is_deterministic_and_bounded(tmp_path):
+    def run(out_dir):
+        calls = []
+
+        def counting_probe(assignment, requests):
+            calls.append((dict(sorted(assignment.items())), requests))
+            return quadratic_probe(assignment, requests)
+
+        tuner = Tuner(_settings(out_dir), probe_fn=counting_probe)
+        report = tuner.run()
+        return report, calls
+
+    report_a, calls_a = run(tmp_path / "a")
+    report_b, calls_b = run(tmp_path / "b")
+    assert calls_a == calls_b  # identical trial sequence, not just winner
+    assert report_a["best"]["assignment"] == report_b["best"]["assignment"]
+    assert report_a["trials_measured"] == report_b["trials_measured"]
+    # Bounded: far under exhaustive (4*4*3=48 full-length trials) even
+    # before dedup -- halving measures at most ~half the rungs full-length.
+    assert report_a["trials_measured"] <= 40
+
+
+def test_search_budget_stop_still_writes_artifacts(tmp_path):
+    tuner = Tuner(_settings(tmp_path, max_trials=3), probe_fn=quadratic_probe)
+    report = tuner.run()
+    assert report["stopped"] == "budget"
+    assert report["trials_measured"] == 3
+    assert os.path.exists(report["profile_path"])
+    assert os.path.exists(report["report_path"])
+    with open(report["journal_path"]) as f:
+        assert sum(1 for line in f if line.strip()) == 3
+
+
+# ------------------------------------------------------------------ journal
+
+
+def test_trial_journal_roundtrip(tmp_path):
+    path = tmp_path / "journal.jsonl"
+    journal = TrialJournal(path)
+    assert journal.loaded == 0
+    entry = {
+        "key": TrialJournal.key({"decode_steps": 4}, 16),
+        "assignment": {"decode_steps": 4},
+        "requests": 16,
+        "score": 1.5,
+    }
+    journal.record(entry)
+    assert journal.lookup({"decode_steps": 4}, 16) == entry
+    assert journal.lookup({"decode_steps": 4}, 8) is None
+    reloaded = TrialJournal(path)
+    assert reloaded.loaded == 1
+    assert reloaded.lookup({"decode_steps": 4}, 16) == entry
+
+
+def test_journal_key_is_order_insensitive():
+    a = TrialJournal.key({"spec_k": 2, "decode_steps": 4}, 16)
+    b = TrialJournal.key({"decode_steps": 4, "spec_k": 2}, 16)
+    assert a == b
+    assert TrialJournal.key({"spec_k": 2}, 16) != TrialJournal.key({"spec_k": 2}, 8)
+
+
+def test_resume_replays_journal_without_remeasuring(tmp_path):
+    first = Tuner(_settings(tmp_path), probe_fn=quadratic_probe)
+    report_first = first.run()
+    assert report_first["trials_measured"] > 0
+
+    def forbidden_probe(assignment, requests):
+        raise AssertionError("resume must replay the journal, not re-measure")
+
+    resumed = Tuner(_settings(tmp_path), probe_fn=forbidden_probe)
+    report_resumed = resumed.run()
+    assert report_resumed["trials_measured"] == 0
+    assert report_resumed["trials_cached"] > 0
+    assert report_resumed["best"]["assignment"] == report_first["best"]["assignment"]
+    assert report_resumed["best"]["score"] == report_first["best"]["score"]
+
+
+# ------------------------------------------------------------------ profile
+
+
+def test_profile_roundtrip(tmp_path):
+    profile = make_profile(
+        OPTIMUM, preset="test-tiny", mode="mock", platform="cpu",
+        score=1000.0, baseline_score=400.0, meta={"seed": 0},
+    )
+    assert profile["gain"] == 2.5
+    assert profile["env"] == assignment_env(OPTIMUM)
+    path = tmp_path / "profile.json"
+    save_profile(path, profile)
+    assert load_profile(path) == profile
+
+
+def test_load_profile_rejects_bad_documents(tmp_path):
+    bad_version = tmp_path / "v99.json"
+    bad_version.write_text(json.dumps({"version": 99, "env": {}}))
+    with pytest.raises(ValueError, match="unsupported profile version"):
+        load_profile(bad_version)
+    no_env = tmp_path / "noenv.json"
+    no_env.write_text(json.dumps({"version": 1}))
+    with pytest.raises(ValueError, match="no 'env' assignment map"):
+        load_profile(no_env)
+
+
+def test_apply_profile_precedence_env_cli_profile():
+    profile = make_profile(
+        OPTIMUM, preset="test-tiny", mode="mock", platform="cpu",
+        score=1.0, baseline_score=1.0,
+    )
+    env = {"DYN_WORKER_DECODE_STEPS": "8"}  # operator env wins
+    applied = apply_profile(
+        profile, env=env, cli_set={"DYN_WORKER_SPEC_K"},  # CLI wins too
+    )
+    assert applied == {"DYN_WORKER_CHUNK_PREFILL_TOKENS": "256"}
+    assert env["DYN_WORKER_DECODE_STEPS"] == "8"  # untouched
+    assert env["DYN_WORKER_CHUNK_PREFILL_TOKENS"] == "256"
+    assert "DYN_WORKER_SPEC_K" not in env
+
+
+# ----------------------------------------------------- loss-snapshot API
+
+
+def test_loss_snapshot_stable_keys_on_mock_core():
+    from dynamo_tpu.engine.core import EngineConfig
+    from dynamo_tpu.mocker import build_mock_core
+    from dynamo_tpu.protocols.common import (
+        PreprocessedRequest, SamplingOptions, StopConditions,
+    )
+
+    cfg = EngineConfig(
+        num_pages=64, page_size=16, max_batch_size=4, max_seq_len=256,
+        enable_prefix_caching=False,
+    )
+    core = build_mock_core(
+        cfg, decode_us_base=50.0, decode_us_per_seq=5.0,
+        prefill_us_per_token=1.0,
+    )
+    empty = core.loss_snapshot()
+    assert empty["steps_total"] == 0
+    assert empty["loss_coverage_frac"] == 1.0
+
+    for _ in range(2):
+        core.add_request(PreprocessedRequest(
+            token_ids=list(range(1, 9)),
+            sampling=SamplingOptions(temperature=0.0),
+            stop=StopConditions(max_tokens=8, ignore_eos=True),
+        ))
+    while core.has_work:
+        core.step()
+
+    snap = core.loss_snapshot()
+    assert set(snap) == {
+        "lost_time_ms", "step_time_ms", "step_kind_counts", "steps_total",
+        "overlap_step_counts", "overlap_barrier_counts",
+        "noncompute_wall_ms", "loss_coverage_frac",
+    }
+    assert set(snap["step_time_ms"]) == {"wall", "dispatch", "gap"}
+    assert snap["steps_total"] == sum(snap["step_kind_counts"].values())
+    assert snap["steps_total"] > 0
+    assert set(snap["step_kind_counts"]) <= {"mixed", "prefill", "decode", "drain"}
+    assert snap["step_time_ms"]["wall"] > 0.0
+    assert snap["noncompute_wall_ms"] >= 0.0
+    assert 0.0 <= snap["loss_coverage_frac"] <= 1.0
+    # The snapshot is a copy: mutating it must not touch the engine ledger.
+    snap["lost_time_ms"]["gap"] = -1.0
+    assert core.loss_snapshot()["lost_time_ms"].get("gap") != -1.0
+
+
+# ---------------------------------------------------------- end-to-end smoke
+
+
+@pytest.mark.tune
+def test_tune_smoke_real_mock_probe(tmp_path, monkeypatch):
+    """The whole loop against the real CPU-proxy probe, budget-capped."""
+    from dynamo_tpu.tuning.metrics import TunerMetrics
+
+    monkeypatch.setenv("DYN_MOCK_PREFILL_US_PER_TOKEN", "2")
+    monkeypatch.setenv("DYN_MOCK_DECODE_US_BASE", "200")
+    monkeypatch.setenv("DYN_MOCK_DECODE_US_PER_SEQ", "20")
+    settings = _settings(
+        tmp_path, requests=4, isl=24, osl=8, rounds=1, max_trials=3,
+    )
+    metrics = TunerMetrics()
+    report = Tuner(settings, metrics=metrics).run()
+    assert report["stopped"] == "budget"
+    assert report["trials_measured"] == 3
+    assert report["baseline"]["score"] > 0.0
+    assert report["baseline"]["metrics"]["generated_tokens"] == 4 * 8
+    assert "loss" in report["baseline"]["metrics"]
+    assert os.path.exists(report["journal_path"])
+    assert os.path.exists(report["profile_path"])
+    assert load_profile(report["profile_path"])["mode"] == "mock"
+    text = metrics.render().decode()
+    assert 'dynamo_tuner_trials_total{mode="mock",preset="test-tiny"} 3.0' in text
+
+
+@pytest.mark.tune
+def test_tune_cli_main(tmp_path, monkeypatch, capsys):
+    from dynamo_tpu.tuning.__main__ import main
+
+    monkeypatch.setenv("DYN_MOCK_PREFILL_US_PER_TOKEN", "2")
+    monkeypatch.setenv("DYN_MOCK_DECODE_US_BASE", "200")
+    monkeypatch.setenv("DYN_MOCK_DECODE_US_PER_SEQ", "20")
+    rc = main([
+        "--requests", "4", "--isl", "16", "--osl", "6", "--rounds", "1",
+        "--max-trials", "2", "--out-dir", str(tmp_path),
+    ])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["stopped"] == "budget"
+    assert summary["trials_measured"] == 2
+    assert summary["baseline_score"] > 0.0
+    assert os.path.exists(summary["journal"])
+
+
+def test_default_assignment_matches_untuned_defaults():
+    mock_knobs = select_knobs(hardware=False)
+    assert default_assignment(mock_knobs) == {
+        "chunk_prefill_tokens": 512, "decode_steps": 1, "spec_k": 0,
+    }

@@ -1,0 +1,142 @@
+"""HLO fusion audit for the packed-int4 weight path (ROADMAP item 3).
+
+The int4 bandwidth win exists only if XLA fuses the dequant expression
+(unpack nibbles -> scale -> optional bias) into the consuming dot's operand
+read. If the compiler instead *materializes* the full-width bf16 weight, the
+weight round-trips HBM at 2 byte/elem and the packed format saved nothing —
+the residual-dequant failure mode ROADMAP item 3 says to chase.
+
+This tool compiles ``quant_matmul`` on a packed-int4 leaf at a decode-like
+shape and checks the optimized artifact two ways:
+
+1. **Memory analysis** (authoritative where the backend reports it): the
+   compiled executable's temp allocation must be smaller than the
+   full-width bf16 weight — a materialized dequant *must* live in a temp
+   buffer at least that large.
+2. **Optimized-HLO scan**: no instruction in the *entry* computation may
+   produce the full-width weight shape in a wide dtype. Full-width shapes
+   inside fusion bodies are fine — fusion-internal values live in
+   registers/tiles, never in HBM.
+
+Run directly (``python tools/check_int4_fusion.py``; exits non-zero on a
+materialized dequant) or via the test suite (``tests/test_quant.py``). The
+gate is **strict on TPU** — the fusion contract is an HBM-bandwidth claim
+about the TPU pipeline. The CPU backend's dot kernels require materialized
+operands (no operand fusion into dots exists there at all), so on CPU the
+audit runs the identical checks but reports advisorily (exit 0), keeping
+the tool tier-1-viable while still exercising every line of the gate;
+``DYN_INT4_FUSION_STRICT=1`` forces the strict verdict anywhere.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def audit_int4_fusion(
+    batch: int = 8, d_in: int = 1024, d_out: int = 1024, group_size: int = 128
+) -> dict:
+    """Compile the int4 matmul and report fusion evidence.
+
+    Returns a dict with ``ok`` (no materialized full-width weight),
+    per-check verdicts, and the numbers behind them.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dynamo_tpu.models.quant import quant_matmul, quantize_leaf_int4
+
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((d_in, d_out)), jnp.bfloat16)
+    leaf = quantize_leaf_int4(w, group_size=group_size)
+    leaf = {k: jax.device_put(v) for k, v in leaf.items()}
+    x = jnp.asarray(rng.standard_normal((batch, d_in)), jnp.bfloat16)
+
+    compiled = jax.jit(quant_matmul).lower(x, leaf).compile()
+    full_weight_bytes = d_in * d_out * 2  # the bf16 tensor fusion must avoid
+
+    report: dict = {
+        "backend": jax.default_backend(),
+        "shape": {"batch": batch, "d_in": d_in, "d_out": d_out, "group_size": group_size},
+        "full_weight_bytes": full_weight_bytes,
+    }
+
+    # Check 1: temp allocation bound. A materialized dequant needs a temp at
+    # least the size of the full-width weight.
+    temp_bytes = None
+    try:
+        mem = compiled.memory_analysis()
+        temp_bytes = int(getattr(mem, "temp_size_in_bytes"))
+    except Exception:
+        pass  # backend doesn't report memory analysis; HLO scan decides
+    report["temp_bytes"] = temp_bytes
+    report["temp_ok"] = temp_bytes is None or temp_bytes < full_weight_bytes
+
+    # Check 2: entry-computation scan of the optimized HLO. Instructions
+    # inside fusion computations are exempt (fusion-internal values never
+    # round-trip HBM); any entry-scope instruction producing the full-width
+    # weight shape in a >=2-byte dtype is a materialized dequant.
+    hlo = compiled.as_text()
+    wide = re.compile(
+        rf"%?\w[\w.\-]*\s*=\s*(bf16|f16|f32)\[{d_in},{d_out}\]"
+    )
+    offenders: list[str] = []
+    in_entry = False
+    depth = 0
+    for line in hlo.splitlines():
+        stripped = line.strip()
+        if stripped.startswith("ENTRY "):
+            in_entry = True
+            depth = 0
+        if in_entry:
+            m = wide.search(stripped)
+            # Parameters echo their declared shapes; only computed values
+            # (non-parameter instructions) can be materializations.
+            if m and " parameter(" not in stripped:
+                offenders.append(stripped[:160])
+            depth += stripped.count("{") - stripped.count("}")
+            if depth <= 0 and "}" in stripped:
+                in_entry = False
+    report["entry_offenders"] = offenders
+    report["hlo_ok"] = not offenders
+    report["ok"] = bool(report["temp_ok"] and report["hlo_ok"])
+    # The fusion contract is a TPU-pipeline claim; CPU dot kernels always
+    # take materialized operands, so only TPU (or a forced override) gates.
+    report["strict"] = (
+        report["backend"] == "tpu"
+        or os.environ.get("DYN_INT4_FUSION_STRICT", "") == "1"
+    )
+    return report
+
+
+def main() -> int:
+    report = audit_int4_fusion()
+    print(json.dumps(report, indent=2))
+    if not report["ok"]:
+        msg = (
+            "optimized HLO materializes the full-width int4 weight "
+            "(dequant not fused into the dot's operand read)"
+        )
+        if report["strict"]:
+            print(f"FAIL: {msg}", file=sys.stderr)
+            return 1
+        print(
+            f"advisory ({report['backend']} backend, expected there): {msg}",
+            file=sys.stderr,
+        )
+        return 0
+    print(
+        "ok: int4 dequant fuses into the matmul operand read "
+        f"(backend={report['backend']}, temp_bytes={report['temp_bytes']})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

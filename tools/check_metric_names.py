@@ -27,12 +27,14 @@ def collect_families() -> dict[str, list[dict]]:
     from dynamo_tpu.fleetsim.metrics import FleetMetrics
     from dynamo_tpu.frontend.metrics import FrontendMetrics
     from dynamo_tpu.observability.metrics import EngineMetrics
+    from dynamo_tpu.tuning.metrics import TunerMetrics
 
     out: dict[str, list[dict]] = {}
     for label, registry in (
         ("frontend", FrontendMetrics().registry),
         ("engine", EngineMetrics(worker="check").registry),
         ("fleet", FleetMetrics().registry),
+        ("tuner", TunerMetrics().registry),
     ):
         families: list[dict] = []
         for collector in registry._collector_to_names:  # noqa: SLF001 - no public enumeration API
