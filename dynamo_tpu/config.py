@@ -247,6 +247,47 @@ class AnomalySettings:
 
 
 @dataclasses.dataclass
+class IncidentSettings:
+    """Incident-plane capture knobs (``dynamo_tpu/observability/incidents``).
+
+    When an anomaly detector rises, a step crashes, or an SLO burn-rate
+    alert fires, the worker snapshots a bounded black-box bundle (flight
+    excerpt, intersecting spans, loss ledger, config) into a size-capped
+    on-disk store so a dead worker still leaves a postmortem artifact.
+    Env: ``DYN_INCIDENT_*``, TOML: ``[incident]``.
+    """
+
+    enable: bool = True
+    dir: str = ""  # bundle root; '' -> <tmp>/dynamo-incidents
+    max_bundles: int = 32  # store-wide bundle count cap (oldest evicted)
+    max_bytes: int = 16_000_000  # store-wide on-disk byte cap
+    flight_last: int = 256  # flight-ring records captured per bundle
+    span_window_s: float = 30.0  # spans whose lifetime intersects [now - window, now]
+    cooldown_s: float = 30.0  # min seconds between bundles for the same trigger kind
+
+
+@dataclasses.dataclass
+class AlertSettings:
+    """SLO burn-rate alerting knobs (``dynamo_tpu/observability/slo``).
+
+    Multi-window burn rates over goodput attainment: burn = miss fraction
+    in the window divided by the SLO error budget (``1 - objective``).
+    A window's alert fires when its burn rate clears the threshold and
+    clears only after ``clear_after`` consecutive quiet requests
+    (hysteresis, same discipline as the anomaly sentinel).
+    Env: ``DYN_ALERT_*``, TOML: ``[alert]``.
+    """
+
+    objective: float = 0.9  # SLO objective: fraction of requests that must attain
+    fast_window: int = 64  # fast rolling window (requests; the "5 m" analogue)
+    slow_window: int = 512  # slow rolling window (requests; the "1 h" analogue)
+    fast_burn: float = 4.0  # fast-window burn-rate threshold
+    slow_burn: float = 2.0  # slow-window burn-rate threshold
+    min_requests: int = 32  # requests seen in a window before its alert arms
+    clear_after: int = 32  # quiet requests before an active alert clears
+
+
+@dataclasses.dataclass
 class AttribSettings:
     """Latency-attribution knobs (``dynamo_tpu/observability/attribution``).
 
@@ -314,6 +355,14 @@ def load_fleet_settings(**kw) -> FleetSettings:
 
 def load_anomaly_settings(**kw) -> AnomalySettings:
     return load_config(AnomalySettings(), section="anomaly", **kw)
+
+
+def load_incident_settings(**kw) -> IncidentSettings:
+    return load_config(IncidentSettings(), section="incident", **kw)
+
+
+def load_alert_settings(**kw) -> AlertSettings:
+    return load_config(AlertSettings(), section="alert", **kw)
 
 
 def load_attrib_settings(**kw) -> AttribSettings:

@@ -253,7 +253,8 @@ class PrefillWorker:
         if not blocks:
             logger.warning("prefill %s produced no transferable blocks", request_id)
         result = await send_blocks(
-            self.runtime.transport, task["transfer_address"], request_id, blocks, trace=trace
+            self.runtime.transport, task["transfer_address"], request_id, blocks,
+            trace=trace, core=self.service.core,
         )
         logger.info(
             "prefill %s: %d tokens -> %d blocks shipped (%s injected)",

@@ -577,7 +577,7 @@ class KvTransferService(AsyncEngine[Any, dict]):
                     )
                     dt_sc = time.perf_counter() - t_sc
                     self.scatter_seconds += dt_sc
-                    observe_kv_phase("scatter", dt_sc)
+                    observe_kv_phase("scatter", dt_sc, core=self.core)
                     # Receiver-side phase span, linked into the sender's
                     # trace when the chunk carries one.
                     record_span(
@@ -715,7 +715,7 @@ class KvTransferService(AsyncEngine[Any, dict]):
                     )
                     dt_sc = time.perf_counter() - t_sc
                     self.scatter_seconds += dt_sc
-                    observe_kv_phase("scatter", dt_sc)
+                    observe_kv_phase("scatter", dt_sc, core=self.core)
                     record_span(
                         "kv_scatter", dt_sc * 1e3,
                         trace=TraceContext.from_dict(sess.trace),
@@ -1058,7 +1058,7 @@ class KvTransferService(AsyncEngine[Any, dict]):
                 )
                 dt_sc = time.perf_counter() - t_sc
                 self.scatter_seconds += dt_sc
-                observe_kv_phase("scatter", dt_sc)
+                observe_kv_phase("scatter", dt_sc, core=self.core)
                 record_span(
                     "kv_scatter", dt_sc * 1e3,
                     trace=TraceContext.from_dict(request.get("trace")),
@@ -1092,8 +1092,13 @@ async def send_blocks(
     *,
     context: Context | None = None,
     trace: TraceContext | None = None,
+    core: EngineCore | None = None,
 ) -> dict:
-    """Sender-side: ship packed blocks to a decode worker's transfer endpoint."""
+    """Sender-side: ship packed blocks to a decode worker's transfer endpoint.
+
+    ``core`` (when the caller has one) routes the wire-phase observation to
+    that engine's metrics registry instead of the process-global fallback.
+    """
     context = context or Context()
     msg: dict = {"request_id": request_id, "blocks": blocks}
     if trace is not None:
@@ -1103,7 +1108,7 @@ async def send_blocks(
     async for item in transport.generate(address, msg, context):
         result = item
     dt = time.perf_counter() - t0
-    observe_kv_phase("wire", dt)
+    observe_kv_phase("wire", dt, core=core)
     record_span("kv_wire", dt * 1e3, trace=trace, request_id=request_id, blocks=len(blocks), protocol="v1")
     return result
 
@@ -1247,7 +1252,7 @@ async def send_blocks_chunked(
         # Sender-side phase telemetry: one span per phase (cumulative over
         # the stream) + histogram observations for the metrics plane.
         for phase, secs in (("gather", phases["gather_s"]), ("pack", phases["pack_s"]), ("wire", phases["wire_s"])):
-            observe_kv_phase(phase, secs)
+            observe_kv_phase(phase, secs, core=core)
             record_span(
                 f"kv_{phase}", secs * 1e3, trace=trace,
                 request_id=request_id, chunks=len(chunks), bytes=total_bytes,
@@ -1425,7 +1430,7 @@ async def _send_blocks_striped(
         result["streams"] = n_stripes
         for phase, secs in (("gather", phases["gather_s"]), ("pack", phases["pack_s"]),
                             ("wire", phases["wire_s"])):
-            observe_kv_phase(phase, secs)
+            observe_kv_phase(phase, secs, core=core)
             record_span(
                 f"kv_{phase}", secs * 1e3, trace=trace,
                 request_id=request_id, chunks=n, bytes=total_bytes, streams=n_stripes,

@@ -337,8 +337,16 @@ class EngineCore:
         # Anomaly sentinel: rolling-window self-diagnosis over the step
         # stream, raising ANOMALY flight records + dynamo_anomaly_active.
         from dynamo_tpu.observability.anomaly import AnomalySentinel
+        from dynamo_tpu.observability.incidents import IncidentCapture
 
         self.sentinel = AnomalySentinel(flight=self.flight)
+        # Incident plane: a sentinel rising edge (or a step crash, below)
+        # snapshots a black-box bundle — flight excerpt, intersecting spans,
+        # loss ledger, config — into the size-capped on-disk store, so a
+        # worker that dies still leaves a postmortem artifact. The worker
+        # label is refined to the lease id at telemetry bring-up (launch.py).
+        self.incidents = IncidentCapture(worker=f"pid-{os.getpid()}", core=self)
+        self.sentinel.on_fire = lambda kind, info: self.incidents.capture("anomaly", info)
         # Cumulative counters for the metrics plane.
         self._prompt_tokens_total = 0
         self._generated_tokens_total = 0
@@ -610,6 +618,19 @@ class EngineCore:
                     free_pages=self.allocator.num_free(),
                     inflight_rows=inflight_rows,
                     last_step_info=dict(self.last_step_info),
+                )
+                # After the CRASH flight record, so the bundle's flight
+                # excerpt ends on the crash itself.
+                self.incidents.capture(
+                    "crash",
+                    {
+                        "error": type(exc).__name__,
+                        "detail": str(exc)[:500],
+                        "where": "engine_step",
+                        "waiting": len(self.waiting),
+                        "running": len(self.running),
+                        "inflight_rows": inflight_rows,
+                    },
                 )
                 raise
             wall_ms = (time.perf_counter() - t0) * 1e3

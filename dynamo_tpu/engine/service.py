@@ -180,6 +180,16 @@ class JaxEngineService(AsyncEngine[Any, dict]):
                         logger.error("flight recorder dumped to %s", path)
                     except Exception:
                         logger.exception("flight recorder dump failed")
+                # core.step's own except already captured a bundle for a
+                # genuine step crash; the capture cooldown folds this
+                # loop-level one into it, so pre-step injected faults
+                # (FAULTS "engine.step") still produce exactly one bundle.
+                incidents = getattr(self.core, "incidents", None)
+                if incidents is not None:
+                    incidents.capture("crash", {
+                        "error": type(exc).__name__, "detail": str(exc)[:500],
+                        "where": "engine_loop", "streams": len(self._streams),
+                    })
                 self._fail_all_streams()
                 continue
             self._route(outputs)

@@ -27,6 +27,7 @@ import json
 import logging
 import os
 import socket
+import tempfile
 
 import aiohttp
 
@@ -136,6 +137,39 @@ async def _wait_model(base: str, model: str, timeout_s: float = 60.0) -> None:
     raise TimeoutError(f"model {model!r} not discoverable at {base} in {timeout_s}s")
 
 
+async def _collect_incidents(base: str) -> dict:
+    """Fold the incident plane into the report: the fleet-wide bundle
+    listing from ``GET /debug/incidents``, plus a round-trip fetch of the
+    newest bundle through ``GET /debug/incidents/{id}`` (``fetch_ok``) so a
+    Check can assert the black-box path works end-to-end, not just that
+    files landed on disk."""
+    out: dict = {"bundles": 0, "kinds": {}, "fetch_ok": 0}
+    try:
+        async with aiohttp.ClientSession() as session:
+            async with session.get(f"{base}/debug/incidents") as resp:
+                if resp.status != 200:
+                    return out
+                doc = await resp.json()
+            items = doc.get("incidents") or []
+            out["bundles"] = len(items)
+            kinds: dict[str, int] = {}
+            for item in items:
+                kind = item.get("kind", "?")
+                kinds[kind] = kinds.get(kind, 0) + 1
+            out["kinds"] = kinds
+            if items:
+                newest = max(items, key=lambda i: i.get("ts", 0))
+                async with session.get(
+                    f"{base}/debug/incidents/{newest['id']}"
+                ) as resp:
+                    if resp.status == 200:
+                        bundle = await resp.json()
+                        out["fetch_ok"] = int(bool(bundle.get("flight") is not None))
+    except Exception:
+        logger.exception("fleetsim: incident collection failed (report stays 0)")
+    return out
+
+
 class _LoggingConnector:
     """Planner Connector that records every decision (scenario-relative
     time) before delegating to the fleet."""
@@ -193,8 +227,16 @@ async def run_scenario(
         return report
 
     workers = workers_override or settings.workers or scn.workers
-    saved_env = {k: os.environ.get(k) for k in scn.env}
-    os.environ.update(scn.env)  # frontend/router-side toggles live here
+    run_env = dict(scn.env)
+    # Fresh incident dir per run: the default store dir is shared per host,
+    # so without this the report's incident count would include bundles left
+    # over from earlier runs (and other fleets on the same box).
+    run_env.setdefault(
+        "DYN_INCIDENT_DIR",
+        tempfile.mkdtemp(prefix=f"dynamo-incidents-{scn.name}-"),
+    )
+    saved_env = {k: os.environ.get(k) for k in run_env}
+    os.environ.update(run_env)  # frontend/router-side toggles live here
 
     from dynamo_tpu.launch import serve_frontend
     from dynamo_tpu.router.metrics import KvMetricsAggregator
@@ -214,7 +256,7 @@ async def run_scenario(
         http, watcher, http_port = await serve_frontend(runtime, host="127.0.0.1", port=0)
         base = f"http://127.0.0.1:{http_port}"
 
-        base_env = dict(scn.env)
+        base_env = dict(run_env)
         if scn.faults:
             base_env["DYN_FAULTS"] = scn.faults
             base_env.setdefault("DYN_FAULTS_SEED", str(scn.trace.seed))
@@ -249,6 +291,7 @@ async def run_scenario(
 
         report.update(scoreboard.report(duration_s=duration))
         report["fleet"] = {**fleet.counters, "live": fleet.live_count()}
+        report["incidents"] = await _collect_incidents(base)
     finally:
         for t in tasks:
             t.cancel()
@@ -386,6 +429,27 @@ _register(Scenario(
         Check("requests.mid_stream_failure", ">=", 1),
         Check("requests.ok", ">=", 3),
         Check("fleet.kills", ">=", 1),
+    ),
+))
+
+_register(Scenario(
+    name="incident_capture",
+    description="Deterministic engine-step crash (fault plane, 40th step in "
+                "every worker): the black-box recorder must land crash "
+                "bundles in the incident store and the frontend must serve "
+                "them back through GET /debug/incidents/{id}.",
+    trace=TraceConfig(duration_s=4.0, base_qps=4.0, osl_mean=24, seed=31),
+    workers=2,
+    profiles=(WorkerTimingProfile(jitter=0.05),),
+    faults="engine.step:crash@40",
+    checks=(
+        Check("requests.total", ">=", 10),
+        Check("requests.ok", ">=", 3),
+        Check("incidents.bundles", ">=", 1),
+        Check("incidents.kinds.crash", ">=", 1),
+        # The newest bundle round-trips through the frontend fetch path
+        # with its flight excerpt intact.
+        Check("incidents.fetch_ok", ">=", 1),
     ),
 ))
 

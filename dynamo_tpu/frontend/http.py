@@ -94,6 +94,9 @@ class HttpService:
                 web.get("/debug/traces/{request_id}", self.debug_traces),
                 web.get("/debug/explain/{request_id}", self.debug_explain),
                 web.get("/debug/flight/{worker}", self.debug_flight),
+                web.get("/debug/incidents", self.debug_incidents),
+                web.get("/debug/incidents/{incident_id}", self.debug_incident),
+                web.get("/debug/federation", self.debug_federation),
                 web.post("/clear_kv_blocks", self.clear_kv_blocks),
                 web.post("/engine/profile", self.engine_profile),
             ]
@@ -386,16 +389,21 @@ class HttpService:
 
     async def prometheus(self, request: web.Request) -> web.Response:
         self._sync_router_staleness()
-        parts = [self.metrics.render()]
         if self.telemetry is not None:
             from dynamo_tpu.observability.metrics import federate_text
 
+            worker_parts: list[bytes] = []
             try:
-                parts.extend(await self.telemetry.collect_metrics_texts())
+                worker_parts = await self.telemetry.collect_metrics_texts()
             except Exception:
                 logger.exception("worker metrics federation failed; serving frontend registry only")
+            # Sync the scrape-failure counters *before* rendering the
+            # frontend registry so a worker lost this scrape shows up in
+            # this scrape's dynamo_federation_scrape_failures_total.
+            self.metrics.sync_federation(self.telemetry.scrape_failures)
+            parts = [self.metrics.render(), *worker_parts]
             return web.Response(body=federate_text(parts), content_type="text/plain")
-        return web.Response(body=parts[0], content_type="text/plain")
+        return web.Response(body=self.metrics.render(), content_type="text/plain")
 
     def _sync_router_staleness(self) -> None:
         """Fold every model's KvMetricsAggregator view into the staleness
@@ -526,6 +534,54 @@ class HttpService:
                 "workers": {
                     wid: {"count": len(recs), "records": recs} for wid, recs in rings.items()
                 },
+            }
+        )
+
+    async def debug_incidents(self, request: web.Request) -> web.Response:
+        """Fleet-wide incident bundle listing (frontend-local + every worker).
+
+        Workers on one host may share the incident directory (run_local,
+        fleetsim), so summaries are deduplicated by id; each summary's
+        ``worker`` field names the process that captured it.
+        """
+        workers: dict[str, list[dict]] = {}
+        if self.telemetry is not None:
+            try:
+                workers = await self.telemetry.collect_incidents()
+            except Exception:
+                logger.exception("incident fan-out failed")
+                return web.json_response({"error": "incident fan-out failed"}, status=502)
+        seen: dict[str, dict] = {}
+        for items in workers.values():
+            for item in items:
+                seen.setdefault(item["id"], item)
+        for item in self.metrics.incidents.store.list():
+            seen.setdefault(item["id"], item)
+        incidents = sorted(seen.values(), key=lambda i: i.get("ts") or 0.0)
+        return web.json_response({"count": len(incidents), "incidents": incidents})
+
+    async def debug_incident(self, request: web.Request) -> web.Response:
+        """One full incident bundle by id, from whichever process holds it."""
+        incident_id = request.match_info["incident_id"]
+        bundle = self.metrics.incidents.store.get(incident_id)
+        if bundle is None and self.telemetry is not None:
+            try:
+                bundle = await self.telemetry.fetch_incident(incident_id)
+            except Exception:
+                logger.exception("incident fetch fan-out failed")
+                return web.json_response({"error": "incident fetch failed"}, status=502)
+        if bundle is None:
+            return web.json_response({"error": f"no incident {incident_id!r}"}, status=404)
+        return web.json_response(bundle)
+
+    async def debug_federation(self, request: web.Request) -> web.Response:
+        """Telemetry fan-out health: per-worker failure counts + last failure."""
+        if self.telemetry is None:
+            return web.json_response({"failures": {}, "last_failure": None})
+        return web.json_response(
+            {
+                "failures": dict(self.telemetry.scrape_failures),
+                "last_failure": self.telemetry.last_failure,
             }
         )
 

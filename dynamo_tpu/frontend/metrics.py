@@ -12,6 +12,7 @@ import time
 
 from prometheus_client import CollectorRegistry, Counter, Gauge, Histogram, generate_latest
 
+from dynamo_tpu.observability.incidents import IncidentCapture
 from dynamo_tpu.observability.slo import SloAccountant
 
 _DURATION_BUCKETS = (0.005, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
@@ -83,8 +84,13 @@ class FrontendMetrics:
         # SLO-conditioned accounting: the north star is goodput (tokens from
         # requests that attained the latency targets), not raw throughput.
         # Source of truth is the SloAccountant; counters/gauges are synced on
-        # scrape so nothing is double-booked.
-        self.slo = SloAccountant()
+        # scrape so nothing is double-booked. A burn-rate alert rising edge
+        # is itself an incident-capture trigger: the frontend snapshots its
+        # own bundle (SLO state + spans + config) into the incident store.
+        self.incidents = IncidentCapture(worker="frontend")
+        self.slo = SloAccountant(
+            on_fire=lambda kind, info: self.incidents.capture("slo_burn", info)
+        )
         self.output_tokens = Gauge(
             "dynamo_output_tokens_total",
             "Output tokens generated across finished requests (SLO-blind)",
@@ -105,6 +111,35 @@ class FrontendMetrics:
             "dynamo_slo_attainment_ratio",
             "Fraction of finished requests that attained the SLO (cumulative)",
             registry=self.registry,
+        )
+        # Multi-window burn-rate alerting over goodput attainment
+        # (observability/slo.py): burn = window miss fraction / error budget.
+        self.slo_burn_rate = Gauge(
+            "dynamo_slo_burn_rate",
+            "SLO burn rate per rolling window (window miss fraction over the "
+            "error budget 1 - alert.objective; 1.0 burns the budget exactly "
+            "at the sustainable rate)",
+            ["window"], registry=self.registry,
+        )
+        self.alert_active = Gauge(
+            "dynamo_alert_active",
+            "Burn-rate alerts currently firing (1 while active; hysteresis "
+            "clears after alert.clear_after quiet requests)",
+            ["kind"], registry=self.registry,
+        )
+        self.alert_fired = Gauge(
+            "dynamo_alert_fired_total",
+            "Burn-rate alert rising edges since frontend start",
+            ["kind"], registry=self.registry,
+        )
+        # Federation visibility: worker telemetry scrapes that failed (the
+        # federated /metrics otherwise degrades silently to the frontend
+        # registry alone). Synced per scrape from the telemetry client.
+        self.federation_failures = Gauge(
+            "dynamo_federation_scrape_failures_total",
+            "Failed worker telemetry fan-out calls per worker (metrics "
+            "scrapes and debug queries that timed out or errored)",
+            ["worker"], registry=self.registry,
         )
         # Client-plane health: watch-loop restarts/staleness and per-instance
         # circuit-breaker state, synced per scrape from every live runtime
@@ -158,11 +193,26 @@ class FrontendMetrics:
         self.output_tokens.set(self.slo.output_tokens_total)
         self.goodput_tokens.set(self.slo.goodput_tokens_total)
         self.slo_attainment.set(self.slo.attainment())
+        for window, burn in self.slo.burn_rates().items():
+            self.slo_burn_rate.labels(window).set(burn)
+        self.alert_active.clear()
+        for kind in self.slo.alerts_active:
+            self.alert_active.labels(kind).set(1)
+        self.alert_fired.clear()
+        for kind, n in self.slo.alerts_fired.items():
+            self.alert_fired.labels(kind).set(n)
         for q, v in self.slo.ttft.snapshot().items():
             self.ttft_quantile.labels(f"p{int(q * 100)}").set(v)
         for q, v in self.slo.itl.snapshot().items():
             self.itl_quantile.labels(f"p{int(q * 100)}").set(v)
         return generate_latest(self.registry)
+
+    def sync_federation(self, failures: dict[str, int]) -> None:
+        """Refresh the per-worker scrape-failure gauge from the telemetry
+        client's counters (clears first so departed workers drop out)."""
+        self.federation_failures.clear()
+        for worker, n in failures.items():
+            self.federation_failures.labels(worker).set(n)
 
     def sync_staleness(self, staleness: dict[int, float]) -> None:
         """Refresh the per-worker staleness gauge from an aggregator view

@@ -445,15 +445,16 @@ async def _serve_worker_telemetry(
         SpanQueryService,
     )
     from dynamo_tpu.observability.metrics import install
+    from dynamo_tpu.observability.service import DEBUG_INCIDENTS_ENDPOINT, IncidentQueryService
 
     metrics = EngineMetrics(worker=worker_id).bind_core(service.core)
     if transfer is not None:
         metrics.bind_transfer(transfer)
     if queue is not None:
         metrics.bind_queue(queue)
-    # Process-global phase sink: with several in-process workers (run_local)
-    # the last one installed attributes the KV phases; multi-process
-    # deployments — the topology disagg targets — are exact.
+    # Process-global phase sink (plus the per-core route, so several
+    # in-process workers each attribute their own KV phases — run_local is
+    # now exact, not just multi-process deployments).
     install(metrics)
     service.engine_metrics = metrics  # reachable for tests / direct scraping
     await component.endpoint(DEBUG_TRACES_ENDPOINT).serve(
@@ -471,11 +472,23 @@ async def _serve_worker_telemetry(
             ExplainQueryService(service.core, worker=worker_id),
             metadata=metadata, lease=lease,
         )
+    incidents = getattr(service.core, "incidents", None)
+    if incidents is not None:
+        # Bundles captured before bring-up keep the pid label; everything
+        # after carries the lease id the frontend addresses workers by.
+        incidents.worker = worker_id
+        await component.endpoint(DEBUG_INCIDENTS_ENDPOINT).serve(
+            IncidentQueryService(incidents.store, worker=worker_id),
+            metadata=metadata, lease=lease,
+        )
     port_spec = os.environ.get("DYN_WORKER_HTTP_PORT")
     if port_spec is not None:
         from dynamo_tpu.observability.http import WorkerDebugServer
 
-        debug = WorkerDebugServer(metrics, flight=flight)
+        debug = WorkerDebugServer(
+            metrics, flight=flight,
+            incidents=incidents.store if incidents is not None else None,
+        )
         await debug.start(port=int(port_spec))
         service.aux.append(debug)
     return metrics
@@ -761,24 +774,40 @@ async def run_role(args: argparse.Namespace) -> None:
     if service is not None:
         import signal
 
+        def _dump_flight(reason: str) -> None:
+            # Planner scale-downs and rolling upgrades end with a signal,
+            # not a crash — the flight ring's last seconds must land on
+            # disk for those exits too, not only engine-loop failures.
+            flight = getattr(service.core, "flight", None)
+            if flight is None:
+                return
+            try:
+                path = flight.dump_jsonl(reason=reason)
+                logger.info("flight ring dumped on %s -> %s", reason, path)
+            except Exception:
+                logger.exception("flight dump on %s failed", reason)
+
         async def _drain_then_stop() -> None:
             try:
                 await drain_worker(runtime, service)
             except Exception:
-                logger.exception("drain on SIGTERM failed")
+                logger.exception("drain on signal failed")
             finally:
                 stop.set()
 
-        def _on_sigterm() -> None:
-            logger.info("SIGTERM received: draining before exit")
+        def _on_signal(reason: str) -> None:
+            logger.info("%s received: dumping flight ring, draining before exit", reason.upper())
+            _dump_flight(reason)
             asyncio.ensure_future(_drain_then_stop())
 
         try:
-            asyncio.get_running_loop().add_signal_handler(signal.SIGTERM, _on_sigterm)
+            loop = asyncio.get_running_loop()
+            loop.add_signal_handler(signal.SIGTERM, lambda: _on_signal("sigterm"))
+            loop.add_signal_handler(signal.SIGINT, lambda: _on_signal("sigint"))
         except (NotImplementedError, RuntimeError):
             # Non-Unix loops (or nested-loop shims) don't support signal
             # handlers; the role then relies on lease expiry for cleanup.
-            logger.debug("SIGTERM handler unavailable; drain-on-terminate disabled")
+            logger.debug("signal handlers unavailable; drain-on-terminate disabled")
     print(f"READY role={args.role}", flush=True)
     await stop.wait()
 

@@ -11,42 +11,59 @@ Two surfaces over the same worker internals:
   remotely queryable, the fan-out client, and the timeline assembler behind
   ``GET /debug/traces/{request_id}``.
 - :mod:`http` — the optional per-worker debug HTTP surface (``/metrics``,
-  ``/debug/traces/{request_id}``) for scraping workers directly.
+  ``/debug/traces/{request_id}``, ``/debug/incidents``) for scraping workers
+  directly.
+- :mod:`incidents` — capture-on-anomaly black-box bundles: a size-capped
+  on-disk store of flight/span/loss snapshots written at anomaly rising
+  edges, engine-step crashes, and SLO burn-rate alerts.
 """
 
 from dynamo_tpu.observability.anomaly import ANOMALY_KINDS, AnomalySentinel
 from dynamo_tpu.observability.compile import CompileTracker, timed_dispatch
 from dynamo_tpu.observability.flight import FlightRecorder
+from dynamo_tpu.observability.incidents import (
+    INCIDENT_KINDS,
+    IncidentCapture,
+    IncidentStore,
+)
 from dynamo_tpu.observability.metrics import EngineMetrics, federate_text, observe_kv_phase
 from dynamo_tpu.observability.service import (
     DEBUG_EXPLAIN_ENDPOINT,
+    DEBUG_INCIDENTS_ENDPOINT,
     DEBUG_TRACES_ENDPOINT,
     FLIGHT_ENDPOINT,
     METRICS_SCRAPE_ENDPOINT,
     ExplainQueryService,
     FlightQueryService,
+    IncidentQueryService,
     MetricsScrapeService,
     SpanQueryService,
     WorkerTelemetryClient,
     assemble_timeline,
 )
-from dynamo_tpu.observability.slo import SloAccountant, StreamingQuantiles
+from dynamo_tpu.observability.slo import ALERT_KINDS, SloAccountant, StreamingQuantiles
 
 __all__ = [
     "ANOMALY_KINDS",
+    "ALERT_KINDS",
     "AnomalySentinel",
     "CompileTracker",
     "timed_dispatch",
     "FlightRecorder",
+    "INCIDENT_KINDS",
+    "IncidentCapture",
+    "IncidentStore",
     "EngineMetrics",
     "federate_text",
     "observe_kv_phase",
     "DEBUG_EXPLAIN_ENDPOINT",
+    "DEBUG_INCIDENTS_ENDPOINT",
     "DEBUG_TRACES_ENDPOINT",
     "FLIGHT_ENDPOINT",
     "METRICS_SCRAPE_ENDPOINT",
     "ExplainQueryService",
     "FlightQueryService",
+    "IncidentQueryService",
     "MetricsScrapeService",
     "SpanQueryService",
     "WorkerTelemetryClient",

@@ -51,13 +51,17 @@ class AnomalySentinel:
     raises into the engine — it is observability, not control flow.
     """
 
-    def __init__(self, settings=None, *, flight=None) -> None:
+    def __init__(self, settings=None, *, flight=None, on_fire=None) -> None:
         if settings is None:
             from dynamo_tpu.config import load_anomaly_settings
 
             settings = load_anomaly_settings()
         self.settings = settings
         self.flight = flight
+        #: Rising-edge sink, ``on_fire(kind, info)`` — called exactly once per
+        #: edge (never while a kind stays active); the incident plane hangs
+        #: capture off it. Exceptions are swallowed by _observe's guard.
+        self.on_fire = on_fire
         self._window: deque[dict] = deque(maxlen=max(2, settings.window))
         # Incremental window aggregates (subtract the evictee, add the new).
         self._w = {"barrier": 0, "gap_ms": 0.0, "decode_steps": 0, "outputs": 0}
@@ -198,6 +202,9 @@ class AnomalySentinel:
                         threshold=round(float(threshold), 4),
                         window=len(self._window),
                     )
+                if self.on_fire is not None:
+                    self.on_fire(kind, dict(self.active[kind], anomaly=kind,
+                                            window=len(self._window)))
             else:
                 self.active[kind]["value"] = round(float(value), 4)
         elif kind in self.active:

@@ -21,9 +21,10 @@ WORKER_HTTP_ENV = "DYN_WORKER_HTTP_PORT"
 
 
 class WorkerDebugServer:
-    def __init__(self, metrics: EngineMetrics, *, flight=None) -> None:
+    def __init__(self, metrics: EngineMetrics, *, flight=None, incidents=None) -> None:
         self.metrics = metrics
         self.flight = flight  # this worker's FlightRecorder, if it has one
+        self.incidents = incidents  # this worker's IncidentStore, if it has one
         self._runner: web.AppRunner | None = None
         self.port: int | None = None
         self.app = web.Application()
@@ -32,6 +33,8 @@ class WorkerDebugServer:
                 web.get("/metrics", self.prometheus),
                 web.get("/debug/traces/{request_id}", self.traces),
                 web.get("/debug/flight", self.flight_dump),
+                web.get("/debug/incidents", self.incidents_list),
+                web.get("/debug/incidents/{incident_id}", self.incident_get),
             ]
         )
 
@@ -56,6 +59,21 @@ class WorkerDebugServer:
             last=int(last) if last else None, kind=request.query.get("kind")
         )
         return web.json_response({"records": records, "count": len(records)})
+
+    async def incidents_list(self, request: web.Request) -> web.Response:
+        if self.incidents is None:
+            return web.json_response({"error": "no incident store on this worker"}, status=404)
+        items = self.incidents.list()
+        return web.json_response({"count": len(items), "incidents": items})
+
+    async def incident_get(self, request: web.Request) -> web.Response:
+        if self.incidents is None:
+            return web.json_response({"error": "no incident store on this worker"}, status=404)
+        incident_id = request.match_info["incident_id"]
+        bundle = self.incidents.get(incident_id)
+        if bundle is None:
+            return web.json_response({"error": f"no incident {incident_id!r}"}, status=404)
+        return web.json_response(bundle)
 
     async def start(self, host: str = "0.0.0.0", port: int = 0) -> int:
         self._runner = web.AppRunner(self.app, access_log=None)

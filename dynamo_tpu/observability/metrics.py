@@ -26,6 +26,7 @@ can be polled during the scrape.
 from __future__ import annotations
 
 import logging
+import weakref
 from typing import Any, Awaitable, Callable
 
 from prometheus_client import Counter, CollectorRegistry, Gauge, Histogram, generate_latest
@@ -264,6 +265,12 @@ class EngineMetrics:
             "Anomaly-sentinel rising edges ever fired, by detector kind",
             ["worker", "kind"], registry=self.registry,
         )
+        self._incidents_captured = Gauge(
+            "dynamo_incidents_captured_total",
+            "Incident bundles this engine wrote to the on-disk store, by "
+            "trigger kind (anomaly / crash / slo_burn)",
+            ["worker", "kind"], registry=self.registry,
+        )
         self.prefill_queue_depth = gauge(
             f"{ns}_prefill_queue_depth", "Unclaimed tasks in the distributed prefill queue"
         )
@@ -471,6 +478,11 @@ class EngineMetrics:
             self._anomaly_fired.clear()
             for kind, n in getattr(sentinel, "fired", {}).items():
                 self._anomaly_fired.labels(self.worker, kind).set(n)
+        incidents = getattr(core, "incidents", None)
+        if incidents is not None:
+            self._incidents_captured.clear()
+            for kind, n in getattr(incidents, "captured", {}).items():
+                self._incidents_captured.labels(self.worker, kind).set(n)
 
     def _sync_transfer(self) -> None:
         if self._transfer is None:
@@ -512,12 +524,24 @@ class EngineMetrics:
 # transfer protocol to the telemetry plane. Instead the worker installs its
 # EngineMetrics once at bring-up and the transfer code calls
 # observe_kv_phase() — a no-op until something is installed.
+#
+# Routing is keyed per engine core: install() registers the metrics under
+# its bound core (weakly — a retired core drops its route with its last
+# reference), and call sites that know their core pass it so several
+# in-process workers (run_local) each attribute their own phases. The
+# last-installed registry remains the fallback for core-less call sites.
 
 _installed: EngineMetrics | None = None
+_by_core: "weakref.WeakKeyDictionary[Any, EngineMetrics]" = weakref.WeakKeyDictionary()
 
 
 def install(metrics: EngineMetrics | None) -> None:
     global _installed
+    if metrics is not None and getattr(metrics, "_core", None) is not None:
+        try:
+            _by_core[metrics._core] = metrics
+        except TypeError:  # core type without weakref support (test doubles)
+            pass
     _installed = metrics
 
 
@@ -525,8 +549,15 @@ def installed() -> EngineMetrics | None:
     return _installed
 
 
-def observe_kv_phase(phase: str, seconds: float) -> None:
-    m = _installed
+def observe_kv_phase(phase: str, seconds: float, *, core: Any = None) -> None:
+    m = None
+    if core is not None:
+        try:
+            m = _by_core.get(core)
+        except TypeError:  # core type without weakref support (test doubles)
+            m = None
+    if m is None:
+        m = _installed
     if m is not None:
         try:
             m.observe_phase(phase, seconds)

@@ -9,7 +9,10 @@ Every worker serves extra runtime endpoints next to ``generate``:
 - ``debug_flight`` (:class:`FlightQueryService`) — the engine flight ring;
 - ``debug_explain`` (:class:`ExplainQueryService`) — windowed STEP/COMPILE
   records + lost-time totals, the worker half of
-  ``GET /debug/explain/{request_id}`` (``attribution.build_explain``).
+  ``GET /debug/explain/{request_id}`` (``attribution.build_explain``);
+- ``debug_incidents`` (:class:`IncidentQueryService`) — the worker's
+  on-disk incident bundles (``observability/incidents.py``), the worker
+  half of ``GET /debug/incidents[/{id}]``.
 
 They ride the same discovery + stream transport as serving traffic, so the
 frontend needs no extra connectivity to reach them:
@@ -25,6 +28,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import time
 from typing import Any, AsyncIterator
 
 from dynamo_tpu.runtime.component import INSTANCE_PREFIX, DistributedRuntime, Instance
@@ -36,6 +40,7 @@ DEBUG_TRACES_ENDPOINT = "debug_traces"
 METRICS_SCRAPE_ENDPOINT = "metrics_scrape"
 FLIGHT_ENDPOINT = "debug_flight"
 DEBUG_EXPLAIN_ENDPOINT = "debug_explain"
+DEBUG_INCIDENTS_ENDPOINT = "debug_incidents"
 
 _FANOUT_TIMEOUT = 5.0
 
@@ -130,6 +135,28 @@ class ExplainQueryService(AsyncEngine[Any, dict]):
         }
 
 
+class IncidentQueryService(AsyncEngine[Any, dict]):
+    """Answers ``{"id"?: str}`` with this worker's incident bundles.
+
+    Without an id: bundle summaries (the store's ``list()`` view). With an
+    id: the full bundle, or ``{"found": False}`` when it isn't here — the
+    frontend fans the id out to every worker and keeps the one that has it.
+    """
+
+    def __init__(self, store, *, worker: str = "") -> None:
+        self.store = store
+        self.worker = worker or f"pid-{os.getpid()}"
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
+        request = request or {}
+        incident_id = request.get("id")
+        if incident_id:
+            bundle = self.store.get(str(incident_id))
+            yield {"worker": self.worker, "found": bundle is not None, "bundle": bundle}
+        else:
+            yield {"worker": self.worker, "incidents": self.store.list()}
+
+
 class WorkerTelemetryClient:
     """Frontend-side fan-out over every worker's telemetry endpoints.
 
@@ -142,6 +169,12 @@ class WorkerTelemetryClient:
     def __init__(self, runtime: DistributedRuntime, *, timeout: float = _FANOUT_TIMEOUT) -> None:
         self.runtime = runtime
         self.timeout = timeout
+        #: Per-worker failed fan-out calls (dynamo_federation_scrape_failures_total).
+        #: A failure here means the federated /metrics silently lost that
+        #: worker's registry — which is exactly why it is counted.
+        self.scrape_failures: dict[str, int] = {}
+        #: The most recent failure, for the control tower: worker/error/ts.
+        self.last_failure: dict[str, Any] | None = None
 
     async def _targets(self, endpoint: str) -> list[Instance]:
         records = await self.runtime.store.get_prefix(f"{INSTANCE_PREFIX}/")
@@ -167,8 +200,17 @@ class WorkerTelemetryClient:
 
         try:
             return await asyncio.wait_for(first(), self.timeout)
-        except Exception:
-            logger.warning("telemetry query to %x failed", inst.instance_id, exc_info=True)
+        except Exception as exc:
+            worker = f"{inst.instance_id:x}"
+            self.scrape_failures[worker] = self.scrape_failures.get(worker, 0) + 1
+            self.last_failure = {
+                "worker": worker,
+                "endpoint": inst.endpoint,
+                "error": type(exc).__name__,
+                "detail": str(exc)[:200],
+                "ts": time.time(),
+            }
+            logger.warning("telemetry query to %s failed", worker, exc_info=True)
         return None
 
     async def collect_spans(self, *, request_id: str | None = None, trace_id: str | None = None) -> list[dict]:
@@ -234,6 +276,29 @@ class WorkerTelemetryClient:
         targets = await self._targets(METRICS_SCRAPE_ENDPOINT)
         results = await asyncio.gather(*(self._ask(t, {}) for t in targets))
         return [r["text"].encode() for r in results if r and "text" in r]
+
+    async def collect_incidents(self) -> dict[str, list[dict]]:
+        """Bundle summaries by worker id (the /debug/incidents listing)."""
+        targets = await self._targets(DEBUG_INCIDENTS_ENDPOINT)
+        results = await asyncio.gather(*(self._ask(t, {}) for t in targets))
+        out: dict[str, list[dict]] = {}
+        for inst, res in zip(targets, results):
+            if res is None:
+                continue
+            wid = str(res.get("worker", f"{inst.instance_id:x}"))
+            out[wid] = res.get("incidents", [])
+        return out
+
+    async def fetch_incident(self, incident_id: str) -> dict | None:
+        """The full bundle for one id, from whichever worker holds it."""
+        targets = await self._targets(DEBUG_INCIDENTS_ENDPOINT)
+        results = await asyncio.gather(
+            *(self._ask(t, {"id": incident_id}) for t in targets)
+        )
+        for res in results:
+            if res and res.get("found"):
+                return res.get("bundle")
+        return None
 
 
 def assemble_timeline(request_id: str, spans: list[dict]) -> dict:

@@ -27,18 +27,33 @@ promotes the resulting goodput keys to top-level JSON.
 from __future__ import annotations
 
 import dataclasses
-from typing import Iterable
+import logging
+from collections import deque
+from typing import Callable, Iterable
 
-from dynamo_tpu.config import SloSettings, load_slo_settings
+from dynamo_tpu.config import AlertSettings, SloSettings, load_alert_settings, load_slo_settings
+
+logger = logging.getLogger(__name__)
 
 __all__ = [
     "SloSettings",
     "load_slo_settings",
+    "AlertSettings",
+    "load_alert_settings",
+    "ALERT_KINDS",
     "StreamingQuantile",
     "StreamingQuantiles",
     "SloAccountant",
     "percentile",
 ]
+
+#: Burn-rate alert kinds (the dynamo_alert_active{kind} label values).
+#: One per rolling window: the fast window catches sharp regressions, the
+#: slow window catches sustained slow burns the fast window averages away.
+ALERT_KINDS = (
+    "slo_fast_burn",
+    "slo_slow_burn",
+)
 
 
 def percentile(sorted_xs: list[float], q: float) -> float:
@@ -155,16 +170,43 @@ class SloVerdict:
 
 class SloAccountant:
     """Classifies finished requests against the SLO and keeps the goodput
-    ledger. Single-threaded use (the frontend event loop)."""
+    ledger, plus multi-window burn-rate alerting over attainment.
+    Single-threaded use (the frontend event loop).
 
-    def __init__(self, settings: SloSettings | None = None) -> None:
+    Burn rate (Google-SRE multiwindow discipline, request-count windows so
+    tests stay deterministic): ``miss_frac(window) / (1 - objective)`` —
+    a burn of 1.0 consumes the error budget exactly at the sustainable
+    rate; the fast window alerts at a high threshold (sharp regression),
+    the slow window at a low one (sustained burn). Alerts follow the
+    anomaly sentinel's hysteresis: a rising edge fires once (and invokes
+    ``on_fire`` — the incident plane's capture trigger), then the alert
+    stays active until ``alert.clear_after`` consecutive quiet requests.
+    """
+
+    def __init__(
+        self,
+        settings: SloSettings | None = None,
+        alerts: AlertSettings | None = None,
+        *,
+        on_fire: Callable[[str, dict], None] | None = None,
+    ) -> None:
         self.settings = settings or load_slo_settings()
+        self.alerts = alerts or load_alert_settings()
+        self.on_fire = on_fire
         self.ttft = StreamingQuantiles()
         self.itl = StreamingQuantiles()
         self.requests_total = 0
         self.requests_met = 0
         self.output_tokens_total = 0
         self.goodput_tokens_total = 0
+        # Rolling attainment windows (True = the request earned goodput).
+        self._fast: deque[bool] = deque(maxlen=max(1, self.alerts.fast_window))
+        self._slow: deque[bool] = deque(maxlen=max(1, self.alerts.slow_window))
+        self._quiet: dict[str, int] = {}
+        #: kind -> {"value", "threshold", "since_request"} while active.
+        self.alerts_active: dict[str, dict] = {}
+        #: kind -> rising edges ever fired.
+        self.alerts_fired: dict[str, int] = {}
 
     # -- live observations (fed per token, deployment-wide) ----------------
 
@@ -195,10 +237,77 @@ class SloAccountant:
         if verdict.met and ok:
             self.requests_met += 1
             self.goodput_tokens_total += max(0, output_tokens)
+        self._observe_burn(verdict.met and ok)
         return verdict
 
     def attainment(self) -> float:
         return self.requests_met / self.requests_total if self.requests_total else 1.0
+
+    # -- burn-rate alerting ------------------------------------------------
+
+    @staticmethod
+    def _burn(window: deque[bool], budget: float) -> float:
+        if not window:
+            return 0.0
+        miss_frac = sum(1 for met in window if not met) / len(window)
+        return miss_frac / budget
+
+    def burn_rates(self) -> dict[str, float]:
+        """Current burn per window (dynamo_slo_burn_rate{window})."""
+        budget = max(1e-9, 1.0 - self.alerts.objective)
+        return {
+            "fast": round(self._burn(self._fast, budget), 4),
+            "slow": round(self._burn(self._slow, budget), 4),
+        }
+
+    def _observe_burn(self, met: bool) -> None:
+        self._fast.append(met)
+        self._slow.append(met)
+        budget = max(1e-9, 1.0 - self.alerts.objective)
+        armed_fast = len(self._fast) >= min(self.alerts.min_requests, self._fast.maxlen or 1)
+        armed_slow = len(self._slow) >= min(self.alerts.min_requests, self._slow.maxlen or 1)
+        burn_fast = self._burn(self._fast, budget)
+        burn_slow = self._burn(self._slow, budget)
+        self._update_alert(
+            "slo_fast_burn",
+            armed_fast and burn_fast >= self.alerts.fast_burn,
+            value=burn_fast, threshold=self.alerts.fast_burn, window="fast",
+        )
+        self._update_alert(
+            "slo_slow_burn",
+            armed_slow and burn_slow >= self.alerts.slow_burn,
+            value=burn_slow, threshold=self.alerts.slow_burn, window="slow",
+        )
+
+    def _update_alert(self, kind: str, firing: bool, *, value: float,
+                      threshold: float, window: str) -> None:
+        if firing:
+            self._quiet[kind] = 0
+            if kind not in self.alerts_active:
+                self.alerts_active[kind] = {
+                    "value": round(float(value), 4),
+                    "threshold": round(float(threshold), 4),
+                    "window": window,
+                    "since_request": self.requests_total,
+                }
+                self.alerts_fired[kind] = self.alerts_fired.get(kind, 0) + 1
+                logger.warning(
+                    "SLO alert %s: burn %.4g over threshold %.4g (%s window)",
+                    kind, value, threshold, window,
+                )
+                if self.on_fire is not None:
+                    try:
+                        self.on_fire(kind, dict(self.alerts_active[kind], alert=kind))
+                    except Exception:
+                        logger.exception("SLO alert sink failed (ignored)")
+            else:
+                self.alerts_active[kind]["value"] = round(float(value), 4)
+        elif kind in self.alerts_active:
+            self._quiet[kind] = self._quiet.get(kind, 0) + 1
+            if self._quiet[kind] >= self.alerts.clear_after:
+                del self.alerts_active[kind]
+                del self._quiet[kind]
+                logger.info("SLO alert %s cleared", kind)
 
     def snapshot(self) -> dict:
         return {
@@ -210,4 +319,7 @@ class SloAccountant:
             "output_tokens_total": self.output_tokens_total,
             "goodput_tokens_total": self.goodput_tokens_total,
             "targets": {"ttft_ms": self.settings.ttft_ms, "itl_p99_ms": self.settings.itl_p99_ms},
+            "burn_rates": self.burn_rates(),
+            "alerts_active": {k: dict(v) for k, v in self.alerts_active.items()},
+            "alerts_fired": dict(self.alerts_fired),
         }

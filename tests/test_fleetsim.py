@@ -327,7 +327,8 @@ def test_cache_rate_from_profile(monkeypatch):
 
 def test_scenario_registry_and_dry_run():
     assert {"smoke", "burst_absorb", "tenant_flood", "kill_midstream",
-            "period_shift", "fleet_accept", "diurnal_soak"} <= set(SCENARIOS)
+            "incident_capture", "period_shift", "fleet_accept",
+            "diurnal_soak"} <= set(SCENARIOS)
     assert SCENARIOS["diurnal_soak"].tier == "soak"
     rep = asyncio.run(run_scenario(SCENARIOS["fleet_accept"], dry_run=True))
     rep2 = asyncio.run(run_scenario(SCENARIOS["fleet_accept"], dry_run=True))
@@ -394,6 +395,18 @@ def test_scenario_kill_midstream_live():
     assert report["requests"]["ok"] >= 3
     assert report["fleet"]["kills"] == 1
     assert report["fleet"]["live"] == 1
+
+
+@pytest.mark.e2e
+def test_scenario_incident_capture_live():
+    """Deterministic engine-step crash (fault plane): every worker's 40th
+    step raises, the black-box recorder lands crash bundles in the incident
+    store, and the frontend serves them back via /debug/incidents/{id}."""
+    report = _run("incident_capture")
+    assert report["incidents"]["bundles"] >= 1
+    assert report["incidents"]["kinds"].get("crash", 0) >= 1
+    assert report["incidents"]["fetch_ok"] == 1
+    assert report["requests"]["ok"] >= 3
 
 
 @pytest.mark.e2e

@@ -1,0 +1,521 @@
+"""Incident plane: capture-on-anomaly black-box bundles, SLO burn-rate
+alerting, the fleet-wide /debug/incidents surface, and the control tower.
+
+Covers the ISSUE 18 acceptance criteria that are unit-testable without a
+fleet: store size-cap eviction, exactly-one-bundle-per-rising-edge (no
+hysteresis duplicates), deterministic burn-window trip + clear on synthetic
+attainment streams, frontend fetch of worker bundles, and a `top --once`
+render against a live mock frontend. The live fleetsim chaos scenario is
+``tests/test_fleetsim.py::test_scenario_incident_capture_live``.
+"""
+
+import pathlib
+import sys
+import time
+from types import SimpleNamespace
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.config import AlertSettings, AnomalySettings, IncidentSettings, SloSettings
+from dynamo_tpu.mocker import build_mock_core
+from dynamo_tpu.observability.anomaly import AnomalySentinel
+from dynamo_tpu.observability.flight import CRASH
+from dynamo_tpu.observability.incidents import (
+    INCIDENT_KINDS,
+    IncidentCapture,
+    IncidentStore,
+)
+from dynamo_tpu.observability.slo import ALERT_KINDS, SloAccountant
+from dynamo_tpu.protocols.common import (
+    PreprocessedRequest,
+    SamplingOptions,
+    StopConditions,
+)
+from dynamo_tpu.tracing import Span
+
+# -- store -------------------------------------------------------------------
+
+
+def _bundle(kind="anomaly", **extra):
+    return {"ts": time.time(), "kind": kind, "worker": "w-test",
+            "trigger": {"anomaly": "goodput_drop"}, "flight": [], "spans": [],
+            "loss": None, **extra}
+
+
+def test_incident_store_save_list_get(tmp_path):
+    store = IncidentStore(str(tmp_path / "inc"))
+    ids = [store.save(_bundle()) for _ in range(3)]
+    assert len(set(ids)) == 3
+    assert ids == sorted(ids)  # ids are chronological by construction
+    assert len(store) == 3
+
+    summaries = store.list()
+    assert [s["id"] for s in summaries] == ids  # oldest first
+    for s in summaries:
+        assert s["kind"] == "anomaly"
+        assert s["worker"] == "w-test"
+        assert s["trigger"] == {"anomaly": "goodput_drop"}
+        assert s["bytes"] > 0
+
+    full = store.get(ids[0])
+    assert full["id"] == ids[0]
+    assert full["kind"] == "anomaly"
+    # Unknown ids and traversal attempts come back None, never raise.
+    assert store.get("inc-nope") is None
+    assert store.get("../etc/passwd") is None
+    assert store.get(".hidden") is None
+
+
+def test_incident_store_count_cap_evicts_oldest(tmp_path):
+    store = IncidentStore(str(tmp_path / "inc"), max_bundles=3)
+    ids = [store.save(_bundle()) for _ in range(5)]
+    assert len(store) == 3
+    kept = [s["id"] for s in store.list()]
+    assert kept == ids[2:]  # the two oldest were evicted
+    assert store.get(ids[0]) is None
+    assert store.get(ids[-1]) is not None
+
+
+def test_incident_store_byte_cap_evicts_oldest(tmp_path):
+    store = IncidentStore(str(tmp_path / "inc"), max_bundles=100, max_bytes=2000)
+    big = _bundle(flight=[{"pad": "x" * 64} for _ in range(10)])  # ~800 B each
+    ids = [store.save(dict(big)) for _ in range(6)]
+    remaining = store.list()
+    assert 0 < len(remaining) < 6
+    total = sum(s["bytes"] for s in remaining)
+    assert total <= 2000
+    assert [s["id"] for s in remaining] == ids[-len(remaining):]
+
+
+# -- capture -----------------------------------------------------------------
+
+
+def _capture(tmp_path, **kw):
+    settings = IncidentSettings(
+        dir=str(tmp_path / "inc"), cooldown_s=kw.pop("cooldown_s", 0.0),
+        span_window_s=kw.pop("span_window_s", 30.0), **kw
+    )
+    return IncidentCapture(settings, worker="w-test")
+
+
+def test_capture_bundle_contents(tmp_path):
+    flight = SimpleNamespace(snapshot=lambda last=None, kind=None: [
+        {"kind": "step", "seq": 1}, {"kind": "anomaly", "anomaly": "goodput_drop"},
+    ])
+    core = SimpleNamespace(loss_snapshot=lambda: {"lost_time_ms": {"barrier": 3.0}})
+    cap = IncidentCapture(
+        IncidentSettings(dir=str(tmp_path / "inc"), span_window_s=30.0),
+        worker="w-test", core=core, flight=flight,
+    )
+    with Span("engine_step", request_id="req-inc-1"):
+        pass
+    incident_id = cap.capture(
+        "anomaly", {"anomaly": "goodput_drop", "value": 0.1, "threshold": 0.5}
+    )
+    assert incident_id is not None
+    assert cap.captured == {"anomaly": 1}
+
+    bundle = cap.store.get(incident_id)
+    assert bundle["kind"] == "anomaly"
+    assert bundle["worker"] == "w-test"
+    assert bundle["trigger"]["anomaly"] == "goodput_drop"
+    # The black box: flight excerpt, intersecting spans, loss snapshot.
+    assert {r["kind"] for r in bundle["flight"]} == {"step", "anomaly"}
+    assert any(s.get("name") == "engine_step" for s in bundle["spans"])
+    assert bundle["loss"] == {"lost_time_ms": {"barrier": 3.0}}
+    # Config + device-trace context ride along for the postmortem join.
+    assert "incident" in bundle["config"] and "env" in bundle["config"]
+    assert set(bundle["device_trace"]) == {"armed", "dir"}
+
+
+def test_capture_cooldown_and_disable(tmp_path):
+    cap = _capture(tmp_path, cooldown_s=60.0)
+    trigger = {"anomaly": "recompile_storm"}
+    assert cap.capture("anomaly", trigger) is not None
+    # Same kind within the cooldown: suppressed (a flapping detector must
+    # not flood the store).
+    assert cap.capture("anomaly", trigger) is None
+    # A different anomaly kind has its own cooldown key.
+    assert cap.capture("anomaly", {"anomaly": "goodput_drop"}) is not None
+    assert cap.captured == {"anomaly": 2}
+
+    off = IncidentCapture(
+        IncidentSettings(enable=False, dir=str(tmp_path / "off")), worker="w")
+    assert off.capture("crash", {"error": "X"}) is None
+    assert len(off.store) == 0
+
+
+def test_capture_never_raises(tmp_path):
+    cap = _capture(tmp_path)
+    cap.store.save = lambda bundle: (_ for _ in ()).throw(OSError("disk gone"))
+    assert cap.capture("crash", {"error": "X"}) is None  # swallowed, logged
+
+
+# -- anomaly -> incident e2e -------------------------------------------------
+
+
+def test_anomaly_rising_edge_captures_exactly_one_bundle(tmp_path):
+    """One bundle per rising edge: the sentinel's hysteresis keeps the
+    detector active for many steps but only the edge captures; after a
+    clear, the next edge captures again."""
+    cap = _capture(tmp_path, cooldown_s=0.0)
+    sent = AnomalySentinel(
+        AnomalySettings(window=16, min_samples=32, clear_after=8),
+        on_fire=lambda kind, info: cap.capture("anomaly", info),
+    )
+
+    def feed(n, recompiles=0):
+        for _ in range(n):
+            sent.observe_step(wall_ms=5.0, gap_ms=1.0, barrier=False, outputs=3,
+                              decode_rows=3, recompiles=recompiles,
+                              shortfall_pages=0)
+
+    feed(64)  # quiet baseline
+    for i in range(16):
+        feed(1, recompiles=i)  # a storm inside one window
+    assert sent.fired.get("recompile_storm") == 1
+    assert len(cap.store) == 1  # the edge captured; active steps did not
+
+    feed(24, recompiles=15)  # hysteresis clears the alert
+    assert "recompile_storm" not in sent.active
+    assert len(cap.store) == 1  # clearing is not a capture
+
+    for i in range(16):
+        feed(1, recompiles=16 + i)  # a second storm: a new rising edge
+    assert sent.fired.get("recompile_storm") == 2
+    assert len(cap.store) == 2
+
+    bundle = cap.store.get(cap.store.list()[-1]["id"])
+    assert bundle["kind"] == "anomaly"
+    assert bundle["trigger"]["anomaly"] == "recompile_storm"
+    assert bundle["trigger"]["value"] >= bundle["trigger"]["threshold"]
+
+
+def test_engine_core_crash_captures_bundle(tmp_path, monkeypatch):
+    """A step crash leaves a self-contained postmortem: the bundle's flight
+    excerpt ends with the CRASH record and the trigger names the exception."""
+    monkeypatch.setenv("DYN_INCIDENT_DIR", str(tmp_path / "inc"))
+    core = build_mock_core(realtime=False)
+    core.add_request(PreprocessedRequest(
+        token_ids=[1, 2, 3], sampling=SamplingOptions(temperature=0.0),
+        stop=StopConditions(max_tokens=4),
+    ))
+    core.step()  # healthy context before the crash
+
+    def boom():
+        raise RuntimeError("device array poisoned")
+
+    monkeypatch.setattr(core, "_step_locked", boom)
+    with pytest.raises(RuntimeError, match="device array poisoned"):
+        core.step()
+
+    assert core.incidents.captured == {"crash": 1}
+    summaries = core.incidents.store.list()
+    assert len(summaries) == 1
+    bundle = core.incidents.store.get(summaries[0]["id"])
+    assert bundle["kind"] == "crash"
+    assert bundle["trigger"]["error"] == "RuntimeError"
+    assert "device array poisoned" in bundle["trigger"]["detail"]
+    kinds = [r["kind"] for r in bundle["flight"]]
+    assert kinds[-1] == CRASH  # the excerpt references the crash itself
+    assert bundle["loss"] is not None  # loss_snapshot rode along
+
+
+# -- burn-rate alerting ------------------------------------------------------
+
+
+def _alert_acct(**kw):
+    fired = []
+    defaults = dict(objective=0.9, fast_window=8, slow_window=64,
+                    fast_burn=4.0, slow_burn=2.0, min_requests=8,
+                    clear_after=8)
+    acct = SloAccountant(
+        SloSettings(ttft_ms=100.0, itl_p99_ms=20.0),
+        AlertSettings(**{**defaults, **kw}),
+        on_fire=lambda kind, info: fired.append((kind, info)),
+    )
+    return acct, fired
+
+
+def _good(acct, n):
+    for _ in range(n):
+        acct.account(ttft_s=0.01, itl_gaps=[0.001], output_tokens=4, ok=True)
+
+
+def _bad(acct, n):
+    for _ in range(n):
+        acct.account(ttft_s=1.0, itl_gaps=[0.001], output_tokens=4, ok=True)
+
+
+def test_burn_rate_math_on_synthetic_stream():
+    acct, _ = _alert_acct()
+    _good(acct, 8)
+    assert acct.burn_rates() == {"fast": 0.0, "slow": 0.0}
+    _bad(acct, 4)  # fast window now 4 misses / 8 requests
+    # budget = 1 - 0.9 = 0.1; miss_frac(fast) = 0.5 -> burn 5x.
+    assert acct.burn_rates()["fast"] == pytest.approx(5.0)
+    assert acct.burn_rates()["slow"] == pytest.approx(4.0 / 12 / 0.1, abs=0.01)
+
+
+def test_burn_alert_trips_fast_window_and_clears_with_hysteresis():
+    # slow_burn un-trippable: this test isolates the fast window's edges.
+    acct, fired = _alert_acct(slow_burn=1000.0)
+    _good(acct, 8)
+    assert acct.alerts_active == {} and fired == []
+
+    _bad(acct, 4)  # burn hits 5x >= the 4x fast threshold
+    assert "slo_fast_burn" in acct.alerts_active
+    assert acct.alerts_active["slo_fast_burn"]["window"] == "fast"
+    assert acct.alerts_fired == {"slo_fast_burn": 1}
+    # The rising edge fired the sink exactly once, with the window state.
+    assert [k for k, _ in fired] == ["slo_fast_burn"]
+    assert fired[0][1]["alert"] == "slo_fast_burn"
+    assert fired[0][1]["value"] >= fired[0][1]["threshold"]
+
+    _bad(acct, 8)  # still burning: active, no duplicate edge
+    assert acct.alerts_fired == {"slo_fast_burn": 1}
+    assert len(fired) == 1
+
+    # Recovery: burn falls under threshold once the window holds < 4 misses
+    # (4 met requests in), then clear_after=8 further quiet requests retire
+    # the alert — 12 met requests total, deterministically.
+    _good(acct, 11)
+    assert "slo_fast_burn" in acct.alerts_active  # hysteresis still holding
+    _good(acct, 1)
+    assert "slo_fast_burn" not in acct.alerts_active
+    assert acct.alerts_fired == {"slo_fast_burn": 1}  # history survives
+
+    # A fresh violation is a new edge.
+    _bad(acct, 4)
+    assert acct.alerts_fired == {"slo_fast_burn": 2}
+
+
+def test_slow_burn_alert_needs_sustained_violation():
+    acct, fired = _alert_acct()
+    _good(acct, 48)
+    _bad(acct, 4)
+    # Fast trips on the sharp spike; slow (4/52 misses -> 0.77x) does not.
+    assert "slo_fast_burn" in acct.alerts_active
+    assert "slo_slow_burn" not in acct.alerts_active
+    _bad(acct, 12)  # sustained: 16/64 misses -> 2.5x >= 2x slow threshold
+    assert "slo_slow_burn" in acct.alerts_active
+    assert {k for k, _ in fired} == set(ALERT_KINDS)
+
+
+def test_alert_not_armed_before_min_requests():
+    acct, fired = _alert_acct()
+    _bad(acct, 7)  # 100% miss but under min_requests: must stay silent
+    assert acct.alerts_active == {} and fired == []
+    _bad(acct, 1)
+    assert "slo_fast_burn" in acct.alerts_active
+
+
+def test_frontend_metrics_burn_alert_captures_slo_bundle(tmp_path, monkeypatch):
+    """The frontend wiring end-to-end: a synthetic SLO-violation stream trips
+    the fast burn window and the alert capture lands an slo_burn bundle."""
+    from dynamo_tpu.frontend.metrics import FrontendMetrics
+
+    monkeypatch.setenv("DYN_INCIDENT_DIR", str(tmp_path / "inc"))
+    monkeypatch.setenv("DYN_ALERT_FAST_WINDOW", "8")
+    monkeypatch.setenv("DYN_ALERT_MIN_REQUESTS", "8")
+    monkeypatch.setenv("DYN_ALERT_SLOW_BURN", "1000")  # isolate the fast edge
+    fm = FrontendMetrics()
+    for _ in range(8):
+        fm.slo.account(ttft_s=10.0, itl_gaps=[], output_tokens=1, ok=True)
+    assert fm.slo.alerts_fired == {"slo_fast_burn": 1}
+    assert fm.incidents.captured == {"slo_burn": 1}
+    summaries = fm.incidents.store.list()
+    assert summaries[0]["kind"] == "slo_burn"
+    assert summaries[0]["trigger"]["alert"] == "slo_fast_burn"
+
+    # The exported families carry the alert + burn state.
+    text = fm.render().decode()
+    assert 'dynamo_alert_active{kind="slo_fast_burn"} 1.0' in text
+    assert 'dynamo_alert_fired_total{kind="slo_fast_burn"} 1.0' in text
+    assert 'dynamo_slo_burn_rate{window="fast"}' in text
+
+
+# -- frontend HTTP surface ---------------------------------------------------
+
+
+class _FakeIncidentTelemetry:
+    """WorkerTelemetryClient stand-in: one remote worker holding one bundle."""
+
+    def __init__(self, bundle):
+        self.bundle = bundle
+        self.scrape_failures = {"dead-worker": 3}
+        self.last_failure = {"worker": "dead-worker", "endpoint": "metrics_scrape",
+                             "error": "TimeoutError", "detail": "", "ts": time.time()}
+
+    async def collect_incidents(self):
+        b = self.bundle
+        return {"w-remote": [{"id": b["id"], "ts": b["ts"], "kind": b["kind"],
+                              "worker": b["worker"], "trigger": b["trigger"],
+                              "bytes": 100}]}
+
+    async def fetch_incident(self, incident_id):
+        return dict(self.bundle) if incident_id == self.bundle["id"] else None
+
+    async def collect_metrics_texts(self):
+        return []
+
+
+async def _mock_frontend(tmp_path, monkeypatch):
+    from dynamo_tpu.frontend.http import HttpService
+    from dynamo_tpu.frontend.metrics import FrontendMetrics
+    from dynamo_tpu.frontend.model_manager import ModelManager
+
+    monkeypatch.setenv("DYN_INCIDENT_DIR", str(tmp_path / "frontend-inc"))
+    metrics = FrontendMetrics()
+    local_id = metrics.incidents.capture("slo_burn", {"alert": "slo_fast_burn"})
+    remote = dict(_bundle(kind="crash", worker="w-remote",
+                          trigger={"error": "RuntimeError"}),
+                  id="inc-0000000000001-9999-0001", flight=[{"kind": "crash"}])
+    telemetry = _FakeIncidentTelemetry(remote)
+    service = HttpService(ModelManager(), metrics=metrics, telemetry=telemetry)
+    port = await service.start("127.0.0.1", 0)
+    return service, f"http://127.0.0.1:{port}", local_id, remote
+
+
+async def test_debug_incidents_endpoints(tmp_path, monkeypatch):
+    service, base, local_id, remote = await _mock_frontend(tmp_path, monkeypatch)
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/debug/incidents") as r:
+                assert r.status == 200
+                doc = await r.json()
+            # Frontend-local + fanned-out worker bundles, merged and sorted.
+            assert doc["count"] == 2
+            ids = [i["id"] for i in doc["incidents"]]
+            assert set(ids) == {local_id, remote["id"]}
+
+            # A worker-held bundle is fetchable through the frontend.
+            async with s.get(f"{base}/debug/incidents/{remote['id']}") as r:
+                assert r.status == 200
+                bundle = await r.json()
+            assert bundle["kind"] == "crash"
+            assert bundle["flight"] == [{"kind": "crash"}]
+
+            # A frontend-local bundle resolves without the fan-out.
+            async with s.get(f"{base}/debug/incidents/{local_id}") as r:
+                assert r.status == 200
+            async with s.get(f"{base}/debug/incidents/inc-missing") as r:
+                assert r.status == 404
+
+            # Federation health: failure counters + last failure detail.
+            async with s.get(f"{base}/debug/federation") as r:
+                assert r.status == 200
+                fed = await r.json()
+            assert fed["failures"] == {"dead-worker": 3}
+            assert fed["last_failure"]["error"] == "TimeoutError"
+    finally:
+        await service.stop()
+
+
+async def test_worker_debug_server_serves_incidents(tmp_path):
+    from dynamo_tpu.observability.http import WorkerDebugServer
+    from dynamo_tpu.observability.metrics import EngineMetrics
+
+    store = IncidentStore(str(tmp_path / "inc"))
+    incident_id = store.save(_bundle(kind="crash"))
+    server = WorkerDebugServer(EngineMetrics(worker="w-0"), incidents=store)
+    port = await server.start("127.0.0.1", 0)
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{port}/debug/incidents") as r:
+                assert r.status == 200
+                doc = await r.json()
+            assert doc["count"] == 1 and doc["incidents"][0]["id"] == incident_id
+            async with s.get(f"http://127.0.0.1:{port}/debug/incidents/{incident_id}") as r:
+                assert r.status == 200
+                assert (await r.json())["kind"] == "crash"
+            async with s.get(f"http://127.0.0.1:{port}/debug/incidents/nope") as r:
+                assert r.status == 404
+    finally:
+        await server.close()
+
+
+# -- control tower -----------------------------------------------------------
+
+
+def test_top_parse_prometheus():
+    from dynamo_tpu.top import parse_prometheus
+
+    text = (
+        "# HELP x y\n# TYPE x gauge\n"
+        'dynamo_alert_active{kind="slo_fast_burn"} 1.0\n'
+        "dynamo_output_tokens_total 42\n"
+        "garbage line without value\n"
+        "dynamo_bad_value notafloat\n"
+    )
+    samples = parse_prometheus(text)
+    assert ("dynamo_alert_active", {"kind": "slo_fast_burn"}, 1.0) in samples
+    assert ("dynamo_output_tokens_total", {}, 42.0) in samples
+    assert all(name != "dynamo_bad_value" for name, _, _ in samples)
+
+
+async def test_top_once_renders_live_mock_fleet(tmp_path, monkeypatch, capsys):
+    """`python -m dynamo_tpu.top --once` against a live mock frontend: one
+    frame showing alerts, burn rates, federation health, and incidents."""
+    from dynamo_tpu.top import run
+
+    service, base, local_id, _remote = await _mock_frontend(tmp_path, monkeypatch)
+    # Light up the alert plane so the frame has something to show.
+    for _ in range(64):
+        service.metrics.slo.account(ttft_s=10.0, itl_gaps=[], output_tokens=1, ok=True)
+    try:
+        rc = await run(base, once=True, interval=0.0)
+    finally:
+        await service.stop()
+    assert rc == 0
+    frame = capsys.readouterr().out
+    assert "fleet control tower" in frame
+    assert "FIRING slo_fast_burn" in frame
+    assert "burn" in frame
+    assert "dead-worker" in frame and "TimeoutError" in frame
+    assert local_id in frame or "inc-" in frame
+
+
+def test_top_cli_once_exits_nonzero_when_frontend_unreachable(capsys):
+    from dynamo_tpu.top import main
+
+    # A port from the reserved block: connection refused immediately.
+    assert main(["--url", "http://127.0.0.1:9", "--once"]) == 1
+    frame = capsys.readouterr().out
+    assert "!!" in frame  # degraded panels are visible, not silent
+
+
+# -- vocabulary gate ---------------------------------------------------------
+
+
+def test_alert_kind_vocabulary_synced():
+    """Invokes the tools/ alert-kind gate (ISSUE 18 satellite): the declared
+    tuples, the recording call sites, and the OBSERVABILITY.md kind tables
+    must agree exactly."""
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent / "tools"))
+    try:
+        import check_alert_kinds
+    finally:
+        sys.path.pop(0)
+    declared = check_alert_kinds.declared_kinds()
+    assert set(declared["alert"]) == set(ALERT_KINDS)
+    assert set(declared["incident"]) == set(INCIDENT_KINDS)
+    assert len(declared["anomaly"]) == 5
+    problems = check_alert_kinds.check(
+        declared, check_alert_kinds.recorded_kinds(), check_alert_kinds.documented_kinds()
+    )
+    assert problems == [], "\n".join(problems)
+
+
+def test_settings_env_overrides(monkeypatch):
+    from dynamo_tpu.config import load_alert_settings, load_incident_settings
+
+    monkeypatch.setenv("DYN_INCIDENT_MAX_BUNDLES", "5")
+    monkeypatch.setenv("DYN_INCIDENT_COOLDOWN_S", "1.5")
+    monkeypatch.setenv("DYN_ALERT_OBJECTIVE", "0.99")
+    monkeypatch.setenv("DYN_ALERT_FAST_BURN", "14.4")
+    inc = load_incident_settings()
+    assert inc.max_bundles == 5 and inc.cooldown_s == 1.5
+    alert = load_alert_settings()
+    assert alert.objective == 0.99 and alert.fast_burn == 14.4

@@ -220,6 +220,7 @@ EXPECTED_ENGINE_FAMILIES = {
     "dynamo_engine_step_gap_ms_mean",
     "dynamo_engine_overlap_steps_total",
     "dynamo_engine_overlap_barrier_total",
+    "dynamo_incidents_captured_total",
     "dynamo_engine_constraint_mask_build_seconds",
     # _created appears once the worker-labeled child exists (the fake core's
     # drain returns samples) — same prometheus_client behavior as the kv

@@ -1,0 +1,156 @@
+"""Alert/anomaly-kind vocabulary check: the incident plane's trigger
+vocabularies live in three places each that can drift — the declared
+tuples (``ALERT_KINDS`` in ``observability/slo.py``, ``ANOMALY_KINDS`` in
+``observability/anomaly.py``, ``INCIDENT_KINDS`` in
+``observability/incidents.py``), the literal kind strings the source
+actually records (``_update_alert("...")`` / ``self._update("...")`` /
+``capture("...")`` call sites), and the kind tables in
+``docs/OBSERVABILITY.md`` that operators read.
+
+This gate pins all three to each other, the same contract as
+``check_barrier_reasons.py``: a typo'd kind would mint an undocumented
+metric label (``dynamo_alert_active{kind=...}``,
+``dynamo_anomaly_active{kind=...}``, ``dynamo_incidents_captured_total
+{kind=...}``), and a dead tuple entry means a detector was erased but its
+vocabulary row lingers.
+
+Run directly (``python tools/check_alert_kinds.py``) or via the test
+suite (``tests/test_incidents.py``).
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+
+#: Literal alert kinds SloAccountant records: _update_alert("...") call sites.
+_ALERT_CALL = re.compile(r"_update_alert\(\s*\"([a-z_]+)\"")
+#: Literal anomaly kinds the sentinel records: self._update("...") call sites.
+_ANOMALY_CALL = re.compile(r"self\._update\(\s*\"([a-z_]+)\"")
+#: Literal incident trigger kinds: .capture("...") call sites anywhere in
+#: the package (engine core/service, frontend metrics, sentinel wiring).
+_CAPTURE_CALL = re.compile(r"\.capture\(\s*\"([a-z_]+)\"")
+#: Docs table rows: | `kind` | ... |
+_DOC_ROW = re.compile(r"^\|\s*`([a-z_]+)`\s*\|", re.MULTILINE)
+_NEXT_HEADING = re.compile(r"^#{2,4}\s", re.MULTILINE)
+
+#: Each vocabulary's docs section heading in docs/OBSERVABILITY.md.
+_HEADINGS = {
+    "alert": re.compile(r"^#{2,4}\s+Alert kinds\b.*$", re.MULTILINE),
+    "anomaly": re.compile(r"^#{2,4}\s+Anomaly kinds\b.*$", re.MULTILINE),
+    "incident": re.compile(r"^#{2,4}\s+Incident trigger kinds\b.*$", re.MULTILINE),
+}
+
+
+def _repo_root() -> pathlib.Path:
+    return pathlib.Path(__file__).resolve().parent.parent
+
+
+def declared_kinds() -> dict[str, tuple[str, ...]]:
+    from dynamo_tpu.observability.anomaly import ANOMALY_KINDS
+    from dynamo_tpu.observability.incidents import INCIDENT_KINDS
+    from dynamo_tpu.observability.slo import ALERT_KINDS
+
+    return {
+        "alert": tuple(ALERT_KINDS),
+        "anomaly": tuple(ANOMALY_KINDS),
+        "incident": tuple(INCIDENT_KINDS),
+    }
+
+
+def recorded_kinds(root: pathlib.Path | None = None) -> dict[str, set[str]]:
+    root = root or _repo_root()
+    pkg = root / "dynamo_tpu"
+    slo_src = (pkg / "observability" / "slo.py").read_text()
+    anomaly_src = (pkg / "observability" / "anomaly.py").read_text()
+    capture_kinds: set[str] = set()
+    for path in sorted(pkg.rglob("*.py")):
+        capture_kinds |= set(_CAPTURE_CALL.findall(path.read_text()))
+    return {
+        "alert": set(_ALERT_CALL.findall(slo_src)),
+        "anomaly": set(_ANOMALY_CALL.findall(anomaly_src)),
+        "incident": capture_kinds,
+    }
+
+
+def documented_kinds(root: pathlib.Path | None = None) -> dict[str, list[str]]:
+    doc = ((root or _repo_root()) / "docs" / "OBSERVABILITY.md").read_text()
+    out: dict[str, list[str]] = {}
+    for vocab, heading in _HEADINGS.items():
+        head = heading.search(doc)
+        if head is None:
+            out[vocab] = []
+            continue
+        seg = doc[head.end():]
+        nxt = _NEXT_HEADING.search(seg)
+        if nxt is not None:
+            seg = seg[: nxt.start()]
+        out[vocab] = _DOC_ROW.findall(seg)
+    return out
+
+
+def check(
+    declared: dict[str, tuple[str, ...]],
+    recorded: dict[str, set[str]],
+    documented: dict[str, list[str]],
+) -> list[str]:
+    problems: list[str] = []
+    for vocab, decl_tuple in declared.items():
+        decl = set(decl_tuple)
+        if len(decl) != len(decl_tuple):
+            problems.append(f"{vocab} kinds tuple has duplicate entries: {decl_tuple}")
+        rec = recorded.get(vocab, set())
+        for k in sorted(rec - decl):
+            problems.append(
+                f"source records {vocab} kind {k!r} missing from the declared tuple"
+            )
+        for k in sorted(decl - rec):
+            problems.append(
+                f"declared {vocab} kind {k!r} is never recorded by any call "
+                "site (erased detector with a lingering row?)"
+            )
+        doc_rows = documented.get(vocab, [])
+        docset = set(doc_rows)
+        if len(docset) != len(doc_rows):
+            dupes = sorted({k for k in doc_rows if doc_rows.count(k) > 1})
+            problems.append(
+                f"OBSERVABILITY.md {vocab}-kind table has duplicate rows: {dupes}"
+            )
+        if not doc_rows:
+            problems.append(
+                f"OBSERVABILITY.md has no {vocab}-kind table (missing the "
+                f"section heading {_HEADINGS[vocab].pattern!r}?)"
+            )
+        for k in sorted(docset - decl):
+            problems.append(
+                f"OBSERVABILITY.md documents {vocab} kind {k!r} that the "
+                "declared tuple does not contain (renamed or removed?)"
+            )
+        for k in sorted(decl - docset):
+            problems.append(
+                f"declared {vocab} kind {k!r} is missing from the "
+                f"OBSERVABILITY.md {vocab}-kind table"
+            )
+    return problems
+
+
+def main() -> int:
+    declared = declared_kinds()
+    problems = check(declared, recorded_kinds(), documented_kinds())
+    if problems:
+        for p in problems:
+            print(f"FAIL: {p}", file=sys.stderr)
+        return 1
+    counts = ", ".join(f"{len(v)} {k}" for k, v in declared.items())
+    print(
+        f"ok: {counts} kinds — the declared tuples, the recording call "
+        "sites, and the OBSERVABILITY.md tables all agree"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    # Direct CLI use from a checkout: make the repo importable.
+    sys.path.insert(0, str(_repo_root()))
+    sys.exit(main())

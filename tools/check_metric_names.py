@@ -77,12 +77,42 @@ def check(names: dict[str, list[str]]) -> list[str]:
     return problems
 
 
+#: Incident-plane families dashboards and the control tower depend on, and
+#: the registry that must export each. A rename or accidental removal fails
+#: the gate here rather than as a silently empty tower panel. (These
+#: ``_total`` families are Gauges synced from internal counters, so unlike
+#: Counter families the suffix stays part of the family name.)
+REQUIRED_FAMILIES: dict[str, str] = {
+    "dynamo_slo_burn_rate": "frontend",
+    "dynamo_alert_active": "frontend",
+    "dynamo_alert_fired_total": "frontend",
+    "dynamo_federation_scrape_failures_total": "frontend",
+    "dynamo_incidents_captured_total": "engine",
+    "dynamo_anomaly_active": "engine",
+    "dynamo_anomaly_fired_total": "engine",
+}
+
+
+def check_required(families: dict[str, list[dict]]) -> list[str]:
+    problems: list[str] = []
+    for name, registry in REQUIRED_FAMILIES.items():
+        present = {f["name"] for f in families.get(registry, [])}
+        if name not in present:
+            problems.append(
+                f"required family {name!r} missing from the {registry} "
+                "registry (renamed? the control tower and dashboards key on it)"
+            )
+    return problems
+
+
 def check_families(families: dict[str, list[dict]]) -> list[str]:
-    """All violations: the name checks plus non-empty HELP and consistent
-    label sets for any name seen more than once across registries."""
+    """All violations: the name checks plus non-empty HELP, consistent
+    label sets for any name seen more than once across registries, and
+    required-presence of the incident-plane families."""
     problems = check(
         {label: [f["name"] for f in fams] for label, fams in families.items()}
     )
+    problems += check_required(families)
     label_sets: dict[str, tuple[str, tuple]] = {}
     for label, fams in families.items():
         for f in fams:
