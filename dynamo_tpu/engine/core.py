@@ -695,6 +695,23 @@ class EngineCore:
             # rows: their wall time is the ITL a running request observed.
             if self.chunk_controller is not None and decode_rows:
                 self.chunk_controller.observe(wall_ms)
+            # Device-cost join: the registry accumulated bytes/flops for every
+            # dispatch this step made; against the dispatch wall that yields
+            # the step's roofline fraction. Without a tracker (mock runners)
+            # the step wall stands in for the dispatch wall.
+            cost_reg = getattr(self.runner, "cost_registry", None)
+            cost_fields: dict = {}
+            if cost_reg is not None:
+                step_hbm_bytes, step_flops = cost_reg.take_step()
+                disp_s = (dispatch_ms if tracker is not None else wall_ms) / 1e3
+                roofline_frac, _bound = cost_reg.roofline_of(
+                    step_hbm_bytes, step_flops, disp_s
+                )
+                cost_fields = {
+                    "hbm_bytes": int(step_hbm_bytes),
+                    "flops": int(step_flops),
+                    "roofline_frac": round(roofline_frac, 4),
+                }
             self.flight.record(
                 STEP,
                 step_kind=kind,
@@ -727,6 +744,7 @@ class EngineCore:
                 overlap_mode=overlap_mode,
                 barrier_reason=barrier_reason,
                 chained_rows=int(info.get("chained_rows", 0)) if fresh else 0,
+                **cost_fields,
             )
             # Time-loss accounting: every millisecond of this step's wall
             # clock that was not runner dispatch, plus the host gap before

@@ -25,6 +25,12 @@ import numpy as np
 from dynamo_tpu.models.config import ModelConfig
 from dynamo_tpu.models import llama
 from dynamo_tpu.observability.compile import CompileTracker, timed_dispatch
+from dynamo_tpu.observability.cost import (
+    CostRegistry,
+    cost_plane_enabled,
+    decode_step_estimate,
+    make_lower_thunk,
+)
 from dynamo_tpu.ops.sampling import sample_tokens
 
 logger = logging.getLogger(__name__)
@@ -231,6 +237,12 @@ class ModelRunner:
         # this is how a production recompile becomes visible (metrics plane
         # syncs counts(); the engine's flight recorder is its event sink).
         self.compile_tracker = CompileTracker()
+        # Device-cost plane (DYN_COST_PLANE, default on): per-bucket
+        # flops/bytes records joined with measured dispatch wall into the
+        # live roofline ledger. None when the plane is off — the dispatch
+        # sites then skip every cost call (bit-identical serving, zero
+        # extraction).
+        self.cost_registry = CostRegistry() if cost_plane_enabled() else None
         # Padded page-counts whose gather/scatter kernels are compiled for
         # this runner (device-transfer warm-up bookkeeping — keyed on the
         # runner object itself, so id() reuse after GC can't skip a warm-up).
@@ -770,6 +782,51 @@ class ModelRunner:
             )
         return phase, "pallas" if ok else "fallback"
 
+    # -- device-cost plane -------------------------------------------------
+
+    def _dispatch_kind(self, batch: StepBatch, *, spec: bool = False) -> str:
+        """Ledger step-kind of a dispatch (cost-plane vocabulary)."""
+        if spec:
+            return "spec_verify"
+        if batch.tokens.shape[1] == 1:
+            return "decode"
+        if batch.num_new is not None and bool((np.asarray(batch.num_new) == 1).any()):
+            return "mixed"  # decode rows fused into a multi-column step
+        return "prefill"
+
+    def _cost_estimate(self, padded: StepBatch, kind: str) -> dict[str, float] | None:
+        """Model-derived {bytes, flops} fallback for one dispatch of this
+        padded bucket: weight stream + page-granular KV window."""
+        try:
+            b, t = padded.tokens.shape
+            window_tokens = padded.block_tables.shape[1] * self.page_size
+            itemsize = int(np.dtype(self.k_cache.dtype).itemsize)
+            return decode_step_estimate(
+                self.params, self.cfg, b, window_tokens,
+                cache_itemsize=itemsize, new_tokens=b * t,
+            )
+        except Exception:  # estimate is best-effort; pending beats wrong
+            return None
+
+    def _cost_call(self, program: str, key: tuple, kind: str, padded: StepBatch,
+                   fn, *args, **kwargs):
+        """Run one jitted dispatch, registering its bucket with the cost
+        registry on first sight. The lowering thunk avatars the arguments
+        *before* the call (donation invalidates the cache buffers after),
+        and the actual extraction runs on the registry's background thread
+        — this wrapper adds one set lookup to warm dispatches."""
+        reg = self.cost_registry
+        if reg is not None and not reg.seen(program, key):
+            try:
+                reg.submit(
+                    program, key, kind,
+                    lower=make_lower_thunk(fn, args, kwargs),
+                    estimate=self._cost_estimate(padded, kind),
+                )
+            except Exception:
+                logger.debug("cost submit failed for %s", program, exc_info=True)
+        return fn(*args, **kwargs)
+
     @_locked
     def step(self, batch: StepBatch, lp_k: int = 0):
         """Run one forward+sample step; returns sampled token ids i32[B_real].
@@ -799,7 +856,9 @@ class ModelRunner:
             lp_k, impl, self.mesh is not None,
             padded.mm_embeds is not None, padded.logit_mask is not None,
         )
-        with timed_dispatch(self.compile_tracker, "step", dispatch_key):
+        cost_kind = self._dispatch_kind(batch)
+        with timed_dispatch(self.compile_tracker, "step", dispatch_key,
+                            cost=self.cost_registry, kind=cost_kind):
             if padded.mm_embeds is not None or padded.logit_mask is not None:
                 if self.mesh is not None:
                     from dynamo_tpu.parallel.sharding import batch_sharding
@@ -812,7 +871,8 @@ class ModelRunner:
                 def opt(a):
                     return None if a is None else put(a)
 
-                out = self._step_fn(
+                out = self._cost_call(
+                    "step", dispatch_key, cost_kind, padded, self._step_fn,
                     self.params, self.k_cache, self.v_cache,
                     put(padded.tokens), put(padded.positions),
                     put(padded.block_tables), put(padded.slot_mapping),
@@ -833,7 +893,8 @@ class ModelRunner:
                 def put(a):
                     return jax.device_put(a, batch_sharding(self.mesh, a.ndim))
 
-                out = self._step_fn(
+                out = self._cost_call(
+                    "step", dispatch_key, cost_kind, padded, self._step_fn,
                     self.params, self.k_cache, self.v_cache,
                     put(padded.tokens), put(padded.positions),
                     put(padded.block_tables), put(padded.slot_mapping),
@@ -847,7 +908,8 @@ class ModelRunner:
                 )
             else:
                 b, t = padded.tokens.shape
-                out = self._step_packed_fn(
+                out = self._cost_call(
+                    "step", dispatch_key, cost_kind, padded, self._step_packed_fn,
                     self.params, self.k_cache, self.v_cache, jnp.asarray(_pack(padded)),
                     b=b, t=t, n=padded.block_tables.shape[1], h=padded.history.shape[1],
                     lp_k=lp_k,
@@ -896,7 +958,8 @@ class ModelRunner:
             padded.history.shape[1], verify_width, lp_k, impl, self.mesh is not None,
             padded.mm_embeds is not None, padded.logit_mask is not None,
         )
-        with timed_dispatch(self.compile_tracker, "spec_step", dispatch_key):
+        with timed_dispatch(self.compile_tracker, "spec_step", dispatch_key,
+                            cost=self.cost_registry, kind="spec_verify"):
             if self.mesh is not None:
                 from dynamo_tpu.parallel.sharding import batch_sharding
 
@@ -908,7 +971,8 @@ class ModelRunner:
             def opt(a):
                 return None if a is None else put(a)
 
-            out = self._spec_step_fn(
+            out = self._cost_call(
+                "spec_step", dispatch_key, "spec_verify", padded, self._spec_step_fn,
                 self.params, self.k_cache, self.v_cache,
                 put(padded.tokens), put(padded.positions),
                 put(padded.block_tables), put(padded.slot_mapping),
@@ -946,14 +1010,18 @@ class ModelRunner:
             padded.block_tables.shape[1], padded.history.shape[1],
             num_steps, self.mesh is not None,
         )
-        with timed_dispatch(self.compile_tracker, "multi_step", dispatch_key):
+        # steps=num_steps: XLA cost analysis counts the fused loop body once,
+        # so the per-record bytes/flops cover ONE decode iteration.
+        with timed_dispatch(self.compile_tracker, "multi_step", dispatch_key,
+                            cost=self.cost_registry, kind="decode", steps=num_steps):
             if self.mesh is not None:
                 from dynamo_tpu.parallel.sharding import batch_sharding
 
                 def put(a):
                     return jax.device_put(a, batch_sharding(self.mesh, a.ndim))
 
-                toks, self.k_cache, self.v_cache = self._multi_step_fn(
+                toks, self.k_cache, self.v_cache = self._cost_call(
+                    "multi_step", dispatch_key, "decode", padded, self._multi_step_fn,
                     self.params, self.k_cache, self.v_cache,
                     put(padded.tokens[:, 0]), put(padded.positions[:, 0]),
                     put(padded.block_tables), put(padded.temperature),
@@ -966,7 +1034,8 @@ class ModelRunner:
                 )
             else:
                 b, t = padded.tokens.shape
-                toks, self.k_cache, self.v_cache = self._multi_step_packed_fn(
+                toks, self.k_cache, self.v_cache = self._cost_call(
+                    "multi_step", dispatch_key, "decode", padded, self._multi_step_packed_fn,
                     self.params, self.k_cache, self.v_cache, jnp.asarray(_pack(padded)),
                     b=b, t=t, n=padded.block_tables.shape[1], h=padded.history.shape[1],
                     num_steps=num_steps,
@@ -1037,12 +1106,14 @@ class ModelRunner:
             padded.mm_embeds is not None or padded.mrope_positions is not None
             or padded.logit_mask is not None or padded.la_masks is not None
         )
-        with timed_dispatch(
-            self.compile_tracker, "step_async",
-            (b, t, n, h, lp_k, chain, impl, self.mesh is not None,
-             padded.mm_embeds is not None, padded.logit_mask is not None,
-             padded.la_masks is not None),
-        ):
+        dispatch_key = (
+            b, t, n, h, lp_k, chain, impl, self.mesh is not None,
+            padded.mm_embeds is not None, padded.logit_mask is not None,
+            padded.la_masks is not None,
+        )
+        cost_kind = self._dispatch_kind(batch)
+        with timed_dispatch(self.compile_tracker, "step_async", dispatch_key,
+                            cost=self.cost_registry, kind=cost_kind):
             if self.mesh is not None or extras:
                 if self.mesh is not None:
                     from dynamo_tpu.parallel.sharding import batch_sharding
@@ -1066,7 +1137,9 @@ class ModelRunner:
                     put(padded.mrope_delta),
                 )
                 if chain:
-                    out = self._step_chained_explicit_fn(
+                    out = self._cost_call(
+                        "step_async", dispatch_key, cost_kind, padded,
+                        self._step_chained_explicit_fn,
                         self.params, self.k_cache, self.v_cache,
                         self._chain_tokens, put(src), *explicit,
                         opt(padded.mm_embeds), opt(padded.mm_slot_offset),
@@ -1075,7 +1148,9 @@ class ModelRunner:
                         impl=impl, lp_k=lp_k,
                     )
                 else:
-                    out = self._step_fn(
+                    out = self._cost_call(
+                        "step_async", dispatch_key, cost_kind, padded,
+                        self._step_fn,
                         self.params, self.k_cache, self.v_cache, *explicit,
                         opt(padded.mm_embeds), opt(padded.mm_slot_offset),
                         opt(padded.mm_counts), opt(padded.mrope_positions),
@@ -1085,13 +1160,17 @@ class ModelRunner:
             else:
                 packed = jnp.asarray(_pack(padded))
                 if chain:
-                    out = self._step_chained_fn(
+                    out = self._cost_call(
+                        "step_async", dispatch_key, cost_kind, padded,
+                        self._step_chained_fn,
                         self.params, self.k_cache, self.v_cache, packed,
                         self._chain_tokens, jnp.asarray(src),
                         b=b, t=t, n=n, h=h, lp_k=lp_k,
                     )
                 else:
-                    out = self._step_packed_fn(
+                    out = self._cost_call(
+                        "step_async", dispatch_key, cost_kind, padded,
+                        self._step_packed_fn,
                         self.params, self.k_cache, self.v_cache, packed,
                         b=b, t=t, n=n, h=h, lp_k=lp_k,
                     )
@@ -1139,12 +1218,13 @@ class ModelRunner:
         self.last_attn_dispatch = self._attn_dispatch(padded, impl, verify=True)
         chain = chain_src is not None
         src = self._chain_src_padded(chain_src, b_real, bp) if chain else None
-        with timed_dispatch(
-            self.compile_tracker, "spec_step_async",
-            (bp, padded.tokens.shape[1], padded.block_tables.shape[1],
-             padded.history.shape[1], verify_width, lp_k, chain, impl,
-             self.mesh is not None),
-        ):
+        dispatch_key = (
+            bp, padded.tokens.shape[1], padded.block_tables.shape[1],
+            padded.history.shape[1], verify_width, lp_k, chain, impl,
+            self.mesh is not None,
+        )
+        with timed_dispatch(self.compile_tracker, "spec_step_async", dispatch_key,
+                            cost=self.cost_registry, kind="spec_verify"):
             if self.mesh is not None:
                 from dynamo_tpu.parallel.sharding import batch_sharding
 
@@ -1161,13 +1241,17 @@ class ModelRunner:
                 put(padded.mrope_delta),
             )
             if chain:
-                out = self._spec_step_chained_fn(
+                out = self._cost_call(
+                    "spec_step_async", dispatch_key, "spec_verify", padded,
+                    self._spec_step_chained_fn,
                     self.params, self.k_cache, self.v_cache,
                     self._chain_tokens, put(src), *explicit,
                     impl=impl, lp_k=lp_k,
                 )
             else:
-                out = self._spec_step_fn(
+                out = self._cost_call(
+                    "spec_step_async", dispatch_key, "spec_verify", padded,
+                    self._spec_step_fn,
                     self.params, self.k_cache, self.v_cache, *explicit,
                     impl=impl, lp_k=lp_k,
                 )

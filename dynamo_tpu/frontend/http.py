@@ -94,6 +94,9 @@ class HttpService:
                 web.get("/debug/traces/{request_id}", self.debug_traces),
                 web.get("/debug/explain/{request_id}", self.debug_explain),
                 web.get("/debug/flight/{worker}", self.debug_flight),
+                web.get("/debug/cost", self.debug_cost),
+                web.get("/debug/profile/{worker}", self.debug_profile_status),
+                web.post("/debug/profile/{worker}", self.debug_profile_capture),
                 web.get("/debug/incidents", self.debug_incidents),
                 web.get("/debug/incidents/{incident_id}", self.debug_incident),
                 web.get("/debug/federation", self.debug_federation),
@@ -536,6 +539,77 @@ class HttpService:
                 },
             }
         )
+
+    async def debug_cost(self, request: web.Request) -> web.Response:
+        """Fleet-wide device-cost snapshot: per-worker chip peaks, the
+        per-compiled-program cost table (XLA flops / bytes-accessed / peak
+        memory joined with measured dispatch wall) and the per-step-kind
+        roofline ledger. A worker with ``DYN_COST_PLANE=0`` reports
+        ``enabled: false`` rather than vanishing from the listing."""
+        if self.telemetry is None:
+            return web.json_response(
+                {"error": "no worker telemetry wired on this frontend"}, status=404
+            )
+        try:
+            workers = await self.telemetry.collect_cost()
+        except Exception:
+            logger.exception("cost fan-out failed")
+            return web.json_response({"error": "cost fan-out failed"}, status=502)
+        return web.json_response({"count": len(workers), "workers": workers})
+
+    async def debug_profile_status(self, request: web.Request) -> web.Response:
+        """Profile-capture availability: is ``jax.profiler`` usable on the
+        worker, is a trace currently running, and where artifacts land.
+        ``{worker}`` = engine worker id, or ``all``."""
+        if self.telemetry is None:
+            return web.json_response(
+                {"error": "no worker telemetry wired on this frontend"}, status=404
+            )
+        worker = request.match_info["worker"]
+        try:
+            workers = await self.telemetry.profile_status(worker=worker)
+        except Exception:
+            logger.exception("profile status fan-out failed")
+            return web.json_response({"error": "profile status fan-out failed"}, status=502)
+        if not workers:
+            return web.json_response(
+                {"error": f"no profile endpoint for worker {worker!r}"}, status=404
+            )
+        return web.json_response({"worker": worker, "workers": workers})
+
+    async def debug_profile_capture(self, request: web.Request) -> web.Response:
+        """Arm a bounded device trace on one worker:
+        ``POST /debug/profile/{worker}?duration_ms=2000``.
+
+        Blocks for the trace window and returns the artifact directory +
+        file summary; ``409`` when another capture is already running on
+        that worker (single-flight) and ``501`` when ``jax.profiler`` is
+        unavailable there — a refusal, not an error, so automation can tell
+        "try later" from "never works here"."""
+        if self.telemetry is None:
+            return web.json_response(
+                {"error": "no worker telemetry wired on this frontend"}, status=404
+            )
+        worker = request.match_info["worker"]
+        try:
+            duration_ms = float(request.query.get("duration_ms", 2000.0))
+        except ValueError:
+            return web.json_response({"error": "duration_ms must be a number"}, status=400)
+        try:
+            doc = await self.telemetry.capture_profile(worker, duration_ms)
+        except Exception:
+            logger.exception("profile capture fan-out failed")
+            return web.json_response({"error": "profile capture failed"}, status=502)
+        if doc is None:
+            return web.json_response(
+                {"error": f"no profile endpoint for worker {worker!r}"}, status=404
+            )
+        if not doc.get("ok"):
+            status = {"busy": 409, "profiler_unavailable": 501}.get(
+                doc.get("reason", ""), 502
+            )
+            return web.json_response(doc, status=status)
+        return web.json_response(doc)
 
     async def debug_incidents(self, request: web.Request) -> web.Response:
         """Fleet-wide incident bundle listing (frontend-local + every worker).

@@ -190,14 +190,23 @@ class timed_dispatch:
     ...     out = self._step_fn(...)
 
     A ``None`` tracker makes it a no-op, so call sites need no branching.
+    ``cost``/``kind`` optionally forward the same (program, key, seconds)
+    observation to a :class:`~dynamo_tpu.observability.cost.CostRegistry`
+    on clean exit — the cost plane rides the exact bucket keys this
+    tracker already sees, without a second timing wrapper.
     """
 
-    __slots__ = ("tracker", "program", "key", "_t0")
+    __slots__ = ("tracker", "program", "key", "cost", "kind", "steps", "_t0")
 
-    def __init__(self, tracker: CompileTracker | None, program: str, key: tuple) -> None:
+    def __init__(self, tracker: CompileTracker | None, program: str, key: tuple,
+                 *, cost: Any | None = None, kind: str | None = None,
+                 steps: int = 1) -> None:
         self.tracker = tracker
         self.program = program
         self.key = key
+        self.cost = cost
+        self.kind = kind
+        self.steps = steps
         self._t0 = 0.0
 
     def __enter__(self) -> "timed_dispatch":
@@ -205,5 +214,13 @@ class timed_dispatch:
         return self
 
     def __exit__(self, exc_type, exc, tb) -> None:
-        if self.tracker is not None and exc_type is None:
-            self.tracker.observe(self.program, self.key, time.perf_counter() - self._t0)
+        if exc_type is not None:
+            return
+        seconds = time.perf_counter() - self._t0
+        if self.tracker is not None:
+            self.tracker.observe(self.program, self.key, seconds)
+        if self.cost is not None:
+            try:
+                self.cost.observe(self.program, self.key, seconds, self.kind, steps=self.steps)
+            except Exception:
+                logger.exception("cost observe failed")

@@ -178,11 +178,19 @@ def _config_snapshot(settings: IncidentSettings) -> dict:
 
 
 def _device_trace_state() -> dict:
+    """Live profile-capture state, not just a static env snapshot: whether a
+    trace is running NOW, whether ``jax.profiler`` could start one (the
+    ``POST /debug/profile/{worker}`` follow-up an operator reaches for on a
+    ``recompile_storm`` / ``step_gap_regression`` bundle), and where
+    artifacts land."""
     from dynamo_tpu import tracing
+    from dynamo_tpu.observability.cost import profile_artifact_dir, profiler_available
 
     return {
         "armed": tracing.trace_running(),
         "dir": os.environ.get("DYN_TRACE_DIR"),
+        "capture_available": profiler_available(),
+        "artifact_dir": profile_artifact_dir(),
     }
 
 
@@ -256,6 +264,13 @@ class IncidentCapture:
         loss = None
         if self.core is not None and hasattr(self.core, "loss_snapshot"):
             loss = self.core.loss_snapshot()
+        cost = None
+        cost_reg = getattr(getattr(self.core, "runner", None), "cost_registry", None)
+        if cost_reg is not None:
+            try:
+                cost = cost_reg.snapshot()
+            except Exception:
+                logger.exception("cost snapshot for incident bundle failed (ignored)")
         return {
             "ts": now,
             "kind": kind,
@@ -265,6 +280,7 @@ class IncidentCapture:
             "flight": records,
             "spans": spans,
             "loss": loss,
+            "cost": cost,
             "config": _config_snapshot(self.settings),
             "device_trace": _device_trace_state(),
         }

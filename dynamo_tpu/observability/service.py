@@ -12,7 +12,13 @@ Every worker serves extra runtime endpoints next to ``generate``:
   ``GET /debug/explain/{request_id}`` (``attribution.build_explain``);
 - ``debug_incidents`` (:class:`IncidentQueryService`) — the worker's
   on-disk incident bundles (``observability/incidents.py``), the worker
-  half of ``GET /debug/incidents[/{id}]``.
+  half of ``GET /debug/incidents[/{id}]``;
+- ``debug_cost`` (:class:`CostQueryService`) — the runner's device-cost
+  registry snapshot (``observability/cost.py``), the worker half of
+  ``GET /debug/cost``;
+- ``debug_profile`` (:class:`ProfileCaptureService`) — arms a bounded
+  ``jax.profiler`` device trace on the worker, the worker half of
+  ``POST /debug/profile/{worker}``.
 
 They ride the same discovery + stream transport as serving traffic, so the
 frontend needs no extra connectivity to reach them:
@@ -41,6 +47,8 @@ METRICS_SCRAPE_ENDPOINT = "metrics_scrape"
 FLIGHT_ENDPOINT = "debug_flight"
 DEBUG_EXPLAIN_ENDPOINT = "debug_explain"
 DEBUG_INCIDENTS_ENDPOINT = "debug_incidents"
+COST_ENDPOINT = "debug_cost"
+PROFILE_ENDPOINT = "debug_profile"
 
 _FANOUT_TIMEOUT = 5.0
 
@@ -155,6 +163,119 @@ class IncidentQueryService(AsyncEngine[Any, dict]):
             yield {"worker": self.worker, "found": bundle is not None, "bundle": bundle}
         else:
             yield {"worker": self.worker, "incidents": self.store.list()}
+
+
+class CostQueryService(AsyncEngine[Any, dict]):
+    """Answers any request with the runner's device-cost registry snapshot.
+
+    The snapshot is the ``GET /debug/cost`` body for one worker: chip peaks,
+    the per-compiled-program cost table and the per-step-kind roofline
+    ledger. A worker whose cost plane is disabled (``DYN_COST_PLANE=0``)
+    answers ``{"enabled": False}`` rather than dropping off the fan-out —
+    an operator must be able to tell "off" from "dead".
+    """
+
+    def __init__(self, runner, *, worker: str = "") -> None:
+        self.runner = runner
+        self.worker = worker or f"pid-{os.getpid()}"
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
+        registry = getattr(self.runner, "cost_registry", None)
+        if registry is None:
+            yield {"worker": self.worker, "enabled": False}
+            return
+        doc = registry.snapshot()
+        doc["worker"] = self.worker
+        yield doc
+
+
+class ProfileCaptureService(AsyncEngine[Any, dict]):
+    """Arms a bounded ``jax.profiler`` device trace on this worker.
+
+    ``{"action": "status"}`` (or an empty request) reports availability and
+    whether a trace is currently running. ``{"action": "capture",
+    "duration_ms": N}`` traces the next N ms of device work (clamped to
+    ``DYN_PROFILE_MAX_MS``) and returns the artifact directory plus a file
+    summary. Single-flight is inherited from ``tracing.start_device_trace``
+    — a second capture while one runs gets ``{"ok": False, "reason":
+    "busy"}`` instead of queueing (profiles are operator actions; queueing
+    them would silently serialize minutes of tracing). Refuses politely
+    with ``reason: "profiler_unavailable"`` where ``jax.profiler`` cannot
+    start a trace (e.g. stripped builds).
+    """
+
+    DEFAULT_DURATION_MS = 2000.0
+
+    def __init__(self, *, worker: str = "") -> None:
+        self.worker = worker or f"pid-{os.getpid()}"
+
+    def _status(self) -> dict:
+        from dynamo_tpu.observability.cost import (
+            profile_artifact_dir,
+            profile_max_ms,
+            profiler_available,
+        )
+        from dynamo_tpu.tracing import trace_running
+
+        return {
+            "worker": self.worker,
+            "available": profiler_available(),
+            "running": trace_running(),
+            "artifact_dir": profile_artifact_dir(),
+            "max_duration_ms": profile_max_ms(),
+        }
+
+    async def generate(self, request: Any, context: Context) -> AsyncIterator[dict]:
+        from dynamo_tpu.observability.cost import (
+            profile_artifact_dir,
+            profile_max_ms,
+            profiler_available,
+        )
+        from dynamo_tpu.tracing import profile_for
+
+        request = request or {}
+        if request.get("action", "status") != "capture":
+            yield self._status()
+            return
+        status = self._status()
+        if not profiler_available():
+            yield {**status, "ok": False, "reason": "profiler_unavailable"}
+            return
+        try:
+            duration_ms = float(request.get("duration_ms") or self.DEFAULT_DURATION_MS)
+        except (TypeError, ValueError):
+            duration_ms = self.DEFAULT_DURATION_MS
+        duration_ms = max(1.0, min(duration_ms, profile_max_ms()))
+        log_dir = os.path.join(
+            profile_artifact_dir(), f"{self.worker}-{int(time.time() * 1e3)}"
+        )
+        try:
+            artifact = await profile_for(duration_ms / 1e3, log_dir)
+        except Exception as exc:
+            yield {
+                **status, "ok": False, "reason": "capture_failed",
+                "error": type(exc).__name__, "detail": str(exc)[:200],
+            }
+            return
+        if artifact is None:
+            yield {**status, "ok": False, "reason": "busy"}
+            return
+        files = []
+        total_bytes = 0
+        for root, _dirs, names in os.walk(artifact):
+            for name in names:
+                path = os.path.join(root, name)
+                try:
+                    total_bytes += os.path.getsize(path)
+                except OSError:
+                    continue
+                files.append(os.path.relpath(path, artifact))
+        yield {
+            **status, "ok": True, "artifact": artifact,
+            "duration_ms": duration_ms,
+            "files": sorted(files)[:50], "file_count": len(files),
+            "total_bytes": total_bytes,
+        }
 
 
 class WorkerTelemetryClient:
@@ -288,6 +409,61 @@ class WorkerTelemetryClient:
             wid = str(res.get("worker", f"{inst.instance_id:x}"))
             out[wid] = res.get("incidents", [])
         return out
+
+    async def collect_cost(self) -> dict[str, dict]:
+        """Device-cost snapshots by worker id (the /debug/cost body)."""
+        targets = await self._targets(COST_ENDPOINT)
+        results = await asyncio.gather(*(self._ask(t, {}) for t in targets))
+        out: dict[str, dict] = {}
+        for inst, res in zip(targets, results):
+            if res is None:
+                continue
+            wid = str(res.pop("worker", f"{inst.instance_id:x}"))
+            out[wid] = res
+        return out
+
+    async def profile_status(self, worker: str | None = None) -> dict[str, dict]:
+        """Profile-capture availability by worker id (GET /debug/profile)."""
+        targets = await self._targets(PROFILE_ENDPOINT)
+        results = await asyncio.gather(
+            *(self._ask(t, {"action": "status"}) for t in targets)
+        )
+        out: dict[str, dict] = {}
+        for inst, res in zip(targets, results):
+            if res is None:
+                continue
+            wid = str(res.pop("worker", f"{inst.instance_id:x}"))
+            if worker not in (None, "all") and wid != worker:
+                continue
+            out[wid] = res
+        return out
+
+    async def capture_profile(self, worker: str, duration_ms: float) -> dict | None:
+        """Arm a device trace on one worker; returns its capture doc.
+
+        The capture blocks for the trace window, so the fan-out timeout is
+        stretched to cover the requested duration plus generous slack: on a
+        busy worker the service coroutine may not even be scheduled for
+        seconds (synchronous jit dispatches block the loop), and a timeout
+        here cancels the trace mid-window.
+        """
+        targets = await self._targets(PROFILE_ENDPOINT)
+        saved_timeout = self.timeout
+        self.timeout = max(saved_timeout, duration_ms / 1e3 + 60.0)
+        try:
+            for inst in targets:
+                status = await self._ask(inst, {"action": "status"})
+                if status is None:
+                    continue
+                wid = str(status.get("worker", f"{inst.instance_id:x}"))
+                if wid != worker:
+                    continue
+                return await self._ask(
+                    inst, {"action": "capture", "duration_ms": duration_ms}
+                )
+            return None
+        finally:
+            self.timeout = saved_timeout
 
     async def fetch_incident(self, incident_id: str) -> dict | None:
         """The full bundle for one id, from whichever worker holds it."""

@@ -126,7 +126,13 @@ def test_capture_bundle_contents(tmp_path):
     assert bundle["loss"] == {"lost_time_ms": {"barrier": 3.0}}
     # Config + device-trace context ride along for the postmortem join.
     assert "incident" in bundle["config"] and "env" in bundle["config"]
-    assert set(bundle["device_trace"]) == {"armed", "dir"}
+    # capture_available/artifact_dir landed with the device-cost plane
+    # (ISSUE 19): the bundle tells the responder whether a follow-up
+    # /debug/profile capture is possible and where artifacts will land.
+    assert set(bundle["device_trace"]) == {
+        "armed", "dir", "capture_available", "artifact_dir",
+    }
+    assert isinstance(bundle["device_trace"]["capture_available"], bool)
 
 
 def test_capture_cooldown_and_disable(tmp_path):

@@ -95,6 +95,7 @@ def op_breakdown(trace_dir: str) -> tuple[list[tuple[str, float]], float, int]:
 def main() -> None:
     import bench as bench_mod
     from dynamo_tpu import tracing
+    from dynamo_tpu.observability import cost as cost_mod
 
     trace_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/trace_1b"
     batch = int(os.environ.get("PROFILE_BATCH", "256"))
@@ -124,7 +125,22 @@ def main() -> None:
     tok_per_sec = generated / elapsed
     step_bytes = bench_mod.decode_step_bytes(params, cfg, batch, isl, osl, page)
     roofline = bench_mod.roofline_tok_per_sec(step_bytes, batch)
-    weight_bytes = bench_mod.decode_weight_bytes(params, cfg)
+    # Same estimate helpers the serving-path CostRegistry uses — one tree
+    # walk shared between this tool and the live ledger (ISSUE 19 dedupe).
+    weight_bytes = cost_mod.weight_stream_bytes(params, cfg)
+    # XLA's own per-dispatch byte count for the decode bucket, from the
+    # runner's cost registry: the cross-check column against the modeled
+    # accounting above (agreement within ~15% is the acceptance bar; a
+    # larger gap means the model or the extraction is lying).
+    cost_analysis_bytes = 0
+    cost_source = "disabled"
+    cost_reg = getattr(core.runner, "cost_registry", None)
+    if cost_reg is not None:
+        cost_reg.drain(timeout=60.0)
+        decode_row = cost_reg.ledger().get("decode", {})
+        cost_analysis_bytes = int(decode_row.get("bytes_per_step", 0))
+        rec = cost_reg.record_for("multi_step") or cost_reg.record_for("step")
+        cost_source = rec.source if rec is not None else "none"
     ops, device_us, num_cores = op_breakdown(trace_dir)
     # device_us sums op time over every device core pid; per-core busy time
     # is that total divided by the core count (the old code skipped the
@@ -145,6 +161,14 @@ def main() -> None:
         "weight_bytes_per_step": weight_bytes,
         "weight_bytes_per_token": round(weight_bytes / batch, 1),
         "weight_frac_of_step_bytes": round(weight_bytes / step_bytes, 4),
+        # XLA cost-analysis bytes per decode dispatch (0 = cost plane off),
+        # next to the modeled column so the two instruments cross-check.
+        "cost_analysis_bytes": cost_analysis_bytes,
+        "cost_analysis_source": cost_source,
+        "modeled_step_bytes": step_bytes,
+        "cost_vs_modeled": (
+            round(cost_analysis_bytes / step_bytes, 4) if step_bytes else 0.0
+        ),
         "top_ops_us": [[n, round(us, 0)] for n, us in ops[:15]],
         "trace_dir": trace_dir,
     }
