@@ -226,6 +226,44 @@ class FleetSettings:
 
 
 @dataclasses.dataclass
+class StoreSettings:
+    """HA control-plane knobs (``dynamo_tpu/runtime/replication``).
+
+    Replication is armed by a non-empty ``replicas`` list (every store
+    process gets the same list plus its own ``replica_index``); with the
+    defaults the store is the single-process deployment and the whole plane
+    is dormant. Env: ``DYN_STORE_*``, TOML: ``[store]``.
+    """
+
+    # Comma list of every replica's advertised url (tcp://host:port), in
+    # priority order; index 0 is the bootstrap leader. "" = no replication.
+    replicas: str = ""
+    replica_index: int = 0  # this process's position in ``replicas``
+    promote_after_s: float = 1.0  # leaderless window before a follower elects
+    poll_s: float = 0.25  # peer who_leads poll cadence (election + watchdog)
+    # Extra seconds of lease grace granted at promotion, on top of one full
+    # TTL — covers clients still walking the replica list for the new leader.
+    epoch_grace_s: float = 0.0
+    # How long a multi-endpoint StoreClient keeps walking the replica list
+    # for a leader before an op fails with ConnectionError.
+    client_failover_s: float = 5.0
+
+
+@dataclasses.dataclass
+class RouterResyncSettings:
+    """Router KV-event resync knobs (``dynamo_tpu/router/events``).
+
+    A frontend (re)start — or a dropped worker stream — rebuilds the prefix
+    index from the workers' sequence-numbered snapshot feeds; these tune the
+    reconnect discipline. Env: ``DYN_ROUTER_RESYNC_*``, TOML:
+    ``[router_resync]``.
+    """
+
+    backoff_s: float = 0.2  # first reconnect delay after a dropped event stream
+    max_backoff_s: float = 5.0  # reconnect delay ceiling
+
+
+@dataclasses.dataclass
 class AnomalySettings:
     """Anomaly-sentinel knobs (``dynamo_tpu/observability/anomaly``).
 
@@ -351,6 +389,14 @@ def load_cache_aware_settings(**kw) -> CacheAwareSettings:
 
 def load_fleet_settings(**kw) -> FleetSettings:
     return load_config(FleetSettings(), section="fleet", **kw)
+
+
+def load_store_settings(**kw) -> StoreSettings:
+    return load_config(StoreSettings(), section="store", **kw)
+
+
+def load_router_resync_settings(**kw) -> RouterResyncSettings:
+    return load_config(RouterResyncSettings(), section="router_resync", **kw)
 
 
 def load_anomaly_settings(**kw) -> AnomalySettings:

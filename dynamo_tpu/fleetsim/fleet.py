@@ -32,6 +32,7 @@ import dataclasses
 import logging
 import os
 import signal
+import socket
 import subprocess
 import sys
 import threading
@@ -40,6 +41,30 @@ from dynamo_tpu.config import load_fleet_settings
 from dynamo_tpu.planner.core import PlanDecision
 
 logger = logging.getLogger(__name__)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+async def _wait_ready_line(proc: subprocess.Popen, what: str, timeout: float) -> None:
+    """Block until the subprocess prints its READY line (or fail loudly)."""
+
+    def read() -> None:
+        while True:
+            line = proc.stdout.readline() if proc.stdout else ""
+            if not line:
+                raise RuntimeError(f"{what} pid={proc.pid} exited rc={proc.poll()} before READY")
+            if line.startswith("READY"):
+                return
+
+    try:
+        await asyncio.wait_for(asyncio.get_running_loop().run_in_executor(None, read), timeout)
+    except (asyncio.TimeoutError, TimeoutError):
+        proc.kill()
+        raise TimeoutError(f"{what} pid={proc.pid} not READY in {timeout}s") from None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -288,6 +313,98 @@ class FleetManager:
         for p in procs:
             if p.poll() is None:
                 p.terminate()
+
+        def wait_all() -> None:
+            for p in procs:
+                try:
+                    p.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    try:
+                        p.wait(timeout=5)
+                    except subprocess.TimeoutExpired:  # pragma: no cover
+                        pass
+
+        await asyncio.get_running_loop().run_in_executor(None, wait_all)
+
+
+class StoreFleet:
+    """A replicated control-plane store as real OS processes.
+
+    Spawns ``n`` ``python -m dynamo_tpu.launch --role store`` replicas, each
+    serving its own port and joined into one replication group via
+    ``--store-replicas``/``--store-replica-index``. Replica 0 bootstraps as
+    leader; the others follow. ``kill(0)`` is the kill-the-leader scenario
+    primitive: SIGKILL, no goodbye, the survivors must fence and promote on
+    their own. Ports are allocated up front so every replica knows the full
+    peer list before any of them starts.
+    """
+
+    def __init__(self, n: int, *, base_env: dict[str, str] | None = None,
+                 spawn_timeout: float | None = None) -> None:
+        if n < 2:
+            raise ValueError("StoreFleet needs >= 2 replicas; use an in-process StoreServer for 1")
+        settings = load_fleet_settings()
+        self.base_env = dict(base_env or {})
+        self.spawn_timeout = spawn_timeout if spawn_timeout is not None else settings.spawn_timeout_s
+        self.ports = [_free_port() for _ in range(n)]
+        self.urls = [f"tcp://127.0.0.1:{p}" for p in self.ports]
+        self.procs: list[subprocess.Popen | None] = [None] * n
+        self.counters = {"kills": 0}
+
+    def _spawn_one(self, index: int) -> subprocess.Popen:
+        import dynamo_tpu
+
+        cmd = [
+            sys.executable, "-m", "dynamo_tpu.launch",
+            "--role", "store", "--host", "127.0.0.1",
+            "--serve-store-port", str(self.ports[index]),
+            "--store-replicas", ",".join(self.urls),
+            "--store-replica-index", str(index),
+        ]
+        env = dict(os.environ)
+        env.update(self.base_env)
+        pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(dynamo_tpu.__file__)))
+        env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                                text=True, env=env)
+        logger.info("store-fleet: spawned replica #%d pid=%d port=%d",
+                    index, proc.pid, self.ports[index])
+        return proc
+
+    async def start(self) -> None:
+        """Spawn every replica and wait for all READY lines concurrently."""
+        procs = [self._spawn_one(i) for i in range(len(self.ports))]
+        results = await asyncio.gather(
+            *(_wait_ready_line(p, f"store replica #{i}", self.spawn_timeout)
+              for i, p in enumerate(procs)),
+            return_exceptions=True,
+        )
+        failures = [r for r in results if isinstance(r, BaseException)]
+        if failures:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            raise failures[0]
+        for i, p in enumerate(procs):
+            self.procs[i] = p
+            threading.Thread(target=FleetManager._drain_pipe, args=(p,), daemon=True).start()
+
+    def kill(self, index: int) -> None:
+        """SIGKILL replica ``index`` — a crash, not a shutdown. No lease is
+        revoked, no demotion record is shipped; the survivors must notice."""
+        proc = self.procs[index]
+        if proc is None or proc.poll() is not None:
+            return
+        proc.kill()
+        self.counters["kills"] += 1
+        logger.info("store-fleet: killed replica #%d pid=%d", index, proc.pid)
+
+    async def close(self) -> None:
+        procs = [p for p in self.procs if p is not None and p.poll() is None]
+        self.procs = [None] * len(self.procs)
+        for p in procs:
+            p.terminate()
 
         def wait_all() -> None:
             for p in procs:

@@ -32,7 +32,12 @@ import tempfile
 import aiohttp
 
 from dynamo_tpu.config import load_fleet_settings
-from dynamo_tpu.fleetsim.fleet import ChurnEvent, FleetManager, WorkerTimingProfile
+from dynamo_tpu.fleetsim.fleet import (
+    ChurnEvent,
+    FleetManager,
+    StoreFleet,
+    WorkerTimingProfile,
+)
 from dynamo_tpu.fleetsim.scoreboard import (
     Scoreboard,
     SloTarget,
@@ -111,6 +116,19 @@ class Scenario:
     # scale-DOWN decisions land inside the run (and the report).
     cooldown_s: float = 0.0
     request_timeout_s: float = 60.0
+    # HA control plane: >1 runs the store as that many replica OS processes
+    # (leader + followers, ``launch --role store``) instead of the in-process
+    # StoreServer, with everything — harness, frontend, workers — connected
+    # through the multi-endpoint StoreClient.
+    store_replicas: int = 1
+    # SIGKILL the store *leader* this far into the trace (0 = never; needs
+    # store_replicas > 1). The report gains ``store_ha``: declarative keys
+    # lost, worker deregistrations, and the measured failover time.
+    store_kill_at_s: float = 0.0
+    # Stop + rebuild the frontend (HTTP service, watcher, router, metrics
+    # registry) this far into the trace (0 = never). The report gains
+    # ``frontend``: bounce count and resyncs observed during reconstruction.
+    frontend_bounce_at_s: float = 0.0
 
 
 def _free_port() -> int:
@@ -168,6 +186,34 @@ async def _collect_incidents(base: str) -> dict:
     except Exception:
         logger.exception("fleetsim: incident collection failed (report stays 0)")
     return out
+
+
+async def _store_failover_drill(
+    store_fleet: StoreFleet, store, at_s: float, t0: float, out: dict
+) -> None:
+    """Kill the store leader at ``at_s`` and clock the failover: how long
+    until the harness's own client sees a promoted leader (epoch >= 2)."""
+    loop = asyncio.get_running_loop()
+    delay = at_s - (loop.time() - t0)
+    if delay > 0:
+        await asyncio.sleep(delay)
+    try:
+        out["instances_before_kill"] = float(len(await store.get_prefix("instances/")))
+    except Exception:
+        logger.exception("fleetsim: pre-kill instance census failed")
+    killed_at = loop.time()
+    store_fleet.kill(0)  # replica 0 bootstrapped as leader
+    while True:
+        try:
+            info = await store.who_leads()
+            if info.get("role") == "leader" and float(info.get("epoch", 0)) >= 2:
+                break
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            pass
+        await asyncio.sleep(0.05)
+    out["failover_s"] = round(loop.time() - killed_at, 3)
 
 
 class _LoggingConnector:
@@ -239,29 +285,52 @@ async def run_scenario(
     os.environ.update(run_env)  # frontend/router-side toggles live here
 
     from dynamo_tpu.launch import serve_frontend
+    from dynamo_tpu.router.events import router_resync_snapshot
     from dynamo_tpu.router.metrics import KvMetricsAggregator
     from dynamo_tpu.runtime.component import DistributedRuntime
-    from dynamo_tpu.runtime.store_server import StoreServer
+    from dynamo_tpu.runtime.store_server import StoreClient, StoreServer
     from dynamo_tpu.runtime.tcp import TcpTransport
 
     loop = asyncio.get_running_loop()
     started = wall_clock()
     server = runtime = aggregator = http = watcher = fleet = planner_loop = None
+    store_fleet: StoreFleet | None = None
+    store_client: StoreClient | None = None
     tasks: list[asyncio.Task] = []
     scoreboard = Scoreboard(slo=scn.slo)
+    ha: dict = {}
+    frontend_info: dict = {"bounces": 0.0, "resyncs": 0.0}
+    probe_keys: dict[str, bytes] = {}
     try:
-        port = _free_port()
-        server = await StoreServer(host="127.0.0.1", port=port).start()
-        runtime = DistributedRuntime(server.store, TcpTransport(host="127.0.0.1"))
+        if scn.store_replicas > 1:
+            store_fleet = StoreFleet(scn.store_replicas, base_env=run_env)
+            await store_fleet.start()
+            store_url = ",".join(store_fleet.urls)
+            store_client = StoreClient.from_url(store_url)
+            store = store_client
+        else:
+            port = _free_port()
+            server = await StoreServer(host="127.0.0.1", port=port).start()
+            store = server.store
+            store_url = f"tcp://127.0.0.1:{port}"
+        runtime = DistributedRuntime(store, TcpTransport(host="127.0.0.1"))
         http, watcher, http_port = await serve_frontend(runtime, host="127.0.0.1", port=0)
         base = f"http://127.0.0.1:{http_port}"
+
+        if store_fleet is not None:
+            # Declarative canaries: a failover must carry every one of these
+            # to the promoted follower, byte-exact.
+            for i in range(16):
+                key, value = f"ha_probe/{i:02d}", f"probe-{i}".encode()
+                await store.put(key, value)
+                probe_keys[key] = value
 
         base_env = dict(run_env)
         if scn.faults:
             base_env["DYN_FAULTS"] = scn.faults
             base_env.setdefault("DYN_FAULTS_SEED", str(scn.trace.seed))
         fleet = FleetManager(
-            store_url=f"tcp://127.0.0.1:{port}", model=scn.model,
+            store_url=store_url, model=scn.model,
             router_mode=scn.router_mode, base_env=base_env,
             profiles=scn.profiles,
         )
@@ -282,6 +351,40 @@ async def run_scenario(
             poll_control_plane(base, scoreboard, interval_s=settings.metrics_poll_s)))
         if scn.churn:
             tasks.append(asyncio.create_task(fleet.run_churn(list(scn.churn), t0)))
+        if store_fleet is not None and scn.store_kill_at_s > 0:
+            tasks.append(asyncio.create_task(
+                _store_failover_drill(store_fleet, store, scn.store_kill_at_s, t0, ha)))
+
+        if scn.frontend_bounce_at_s > 0:
+            async def _bounce() -> None:
+                nonlocal http, watcher
+                delay = scn.frontend_bounce_at_s - (loop.time() - t0)
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                pre_resyncs = router_resync_snapshot()["resyncs"]
+                await http.stop()
+                await watcher.close()
+                # Rebind the same port; the old listener can linger a beat.
+                for _ in range(25):
+                    try:
+                        http, watcher, _ = await serve_frontend(
+                            runtime, host="127.0.0.1", port=http_port)
+                        break
+                    except OSError:
+                        await asyncio.sleep(0.2)
+                frontend_info["bounces"] += 1.0
+                # Reconstruction evidence: the replacement's subscribers must
+                # resync from the workers' sequence-numbered snapshots.
+                deadline = loop.time() + 10.0
+                while loop.time() < deadline:
+                    delta = router_resync_snapshot()["resyncs"] - pre_resyncs
+                    if delta > 0:
+                        break
+                    await asyncio.sleep(0.1)
+                frontend_info["resyncs"] = float(
+                    router_resync_snapshot()["resyncs"] - pre_resyncs)
+
+            tasks.append(asyncio.create_task(_bounce()))
 
         await run_open_loop(base, scn.model, events, scoreboard, t0=t0,
                             request_timeout_s=scn.request_timeout_s)
@@ -292,6 +395,20 @@ async def run_scenario(
         report.update(scoreboard.report(duration_s=duration))
         report["fleet"] = {**fleet.counters, "live": fleet.live_count()}
         report["incidents"] = await _collect_incidents(base)
+        report["frontend"] = dict(frontend_info)
+        if store_fleet is not None:
+            survivors = await store.get_prefix("ha_probe/")
+            ha["declarative_lost"] = float(sum(
+                1 for k, v in probe_keys.items() if survivors.get(k) != v))
+            ha["instances_final"] = float(len(await store.get_prefix("instances/")))
+            before = ha.get("instances_before_kill", ha["instances_final"])
+            ha["worker_deregistrations"] = max(0.0, before - ha["instances_final"])
+            try:
+                info = await store.who_leads()
+                ha["epoch"] = float(info.get("epoch", 0))
+            except Exception:
+                logger.exception("fleetsim: post-run who_leads failed")
+            report["store_ha"] = ha
     finally:
         for t in tasks:
             t.cancel()
@@ -312,8 +429,15 @@ async def run_scenario(
             await http.stop()
         if runtime is not None:
             await runtime.close()
+        if store_client is not None:
+            try:
+                await store_client.close()
+            except Exception:  # replicas may already be gone
+                pass
         if server is not None:
             await server.close()
+        if store_fleet is not None:
+            await store_fleet.close()
         for k, v in saved_env.items():
             if v is None:
                 os.environ.pop(k, None)
@@ -450,6 +574,63 @@ _register(Scenario(
         # The newest bundle round-trips through the frontend fetch path
         # with its flight excerpt intact.
         Check("incidents.fetch_ok", ">=", 1),
+    ),
+))
+
+_register(Scenario(
+    name="store_failover",
+    description="SIGKILL the store leader mid-trace on a 3-replica control "
+                "plane: a follower promotes under the epoch fence inside the "
+                "failover budget, every declarative key survives byte-exact, "
+                "no worker loses its registration (leases ride the handoff), "
+                "and the serving plane barely notices — requests flow on "
+                "cached discovery while clients chase the new leader.",
+    trace=TraceConfig(duration_s=6.0, base_qps=4.0, osl_mean=24, seed=37),
+    workers=2,
+    store_replicas=3,
+    store_kill_at_s=2.0,
+    # Tight fence timings so promotion lands well inside the run (defaults
+    # are sized for real fleets, not 6-second traces).
+    env={"DYN_STORE_PROMOTE_AFTER_S": "0.4", "DYN_STORE_POLL_S": "0.1"},
+    checks=(
+        Check("requests.total", ">=", 15),
+        Check("requests.ok", ">=", 10),
+        # Bounded goodput dip: a control-plane failover must not collapse
+        # the serving plane.
+        Check("goodput_frac_at_slo", ">=", 0.5),
+        Check("store_ha.declarative_lost", "==", 0),
+        Check("store_ha.worker_deregistrations", "==", 0),
+        # Recovery well under the 10s worker-lease TTL — the margin that
+        # makes zero deregistrations structural, not lucky.
+        Check("store_ha.failover_s", "<=", 5.0),
+        Check("store_ha.epoch", ">=", 2),
+        Check("control_plane.store_failovers", ">=", 1),
+    ),
+))
+
+_register(Scenario(
+    name="frontend_restart",
+    description="Bounce the frontend mid-trace: stop the HTTP service and "
+                "watcher, rebuild both on the same port. The replacement "
+                "must reconstruct its prefix index from the workers' "
+                "sequence-numbered KV-event snapshots (resyncs observed "
+                "during the bounce), recover warm routing (cache hits on "
+                "the *fresh* metrics registry), and wedge nothing — the "
+                "open-loop client keeps scoring through the gap.",
+    trace=TraceConfig(duration_s=6.0, base_qps=4.0, osl_mean=24, seed=41),
+    workers=2,
+    frontend_bounce_at_s=2.5,
+    checks=(
+        Check("requests.total", ">=", 15),
+        Check("requests.ok", ">=", 8),
+        Check("frontend.bounces", ">=", 1),
+        # State reconstruction: the replacement's subscribers resynced from
+        # worker snapshots (delta across the bounce, so accumulation from
+        # earlier runs in the same process can't fake a pass).
+        Check("frontend.resyncs", ">=", 1),
+        # Warm routing after the bounce: the post-bounce registry starts at
+        # zero, so any cached prompt tokens were served by the replacement.
+        Check("control_plane.cached_tokens_final", ">", 0),
     ),
 ))
 

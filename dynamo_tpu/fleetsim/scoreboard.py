@@ -90,6 +90,12 @@ class Scoreboard:
         self.scrape: dict[str, float] = {
             "breaker_open_max": 0.0, "watch_restarts": 0.0,
             "prefill_requeues": 0.0, "engine_registries_max": 0.0,
+            # HA control plane: failover/retry peaks plus the *final* values
+            # of the reconstruction signals — a frontend bounce resets the
+            # registry, so "what the last scrape saw" is exactly "what the
+            # replacement frontend rebuilt".
+            "store_failovers": 0.0, "store_client_retries": 0.0,
+            "router_resyncs_final": 0.0, "cached_tokens_final": 0.0,
         }
         # Fleet-wide time-loss ledger, folded from the same poller: seconds
         # lost per cause, step-time composition (wall/dispatch/gap), and the
@@ -353,6 +359,10 @@ def parse_control_plane(text: str) -> dict:
     breaker_open = 0
     watch_restarts = 0.0
     requeues = 0.0
+    router_resyncs = 0.0
+    store_failovers = 0.0
+    store_client_retries = 0.0
+    cached_tokens = 0.0
     engine_workers: set[str] = set()
     lost_time: dict[str, float] = {}
     step_time: dict[str, float] = {}
@@ -388,12 +398,24 @@ def parse_control_plane(text: str) -> dict:
                 anomaly_fired[kind] = anomaly_fired.get(kind, 0.0) + value
         elif name.startswith("dynamo_engine_prefill_requeues"):
             requeues += value
+        elif name == "dynamo_router_index_resyncs_total":
+            router_resyncs += value
+        elif name == "dynamo_store_failovers_total":
+            store_failovers += value
+        elif name == "dynamo_store_client_op_retries_total":
+            store_client_retries += value
+        elif name == "dynamo_frontend_cached_prompt_tokens_total":
+            cached_tokens += value  # summed across model labels
         if name.startswith("dynamo_engine_") and 'worker="' in rest:
             engine_workers.add(rest.split('worker="', 1)[1].split('"', 1)[0])
     return {
         "breaker_open": float(breaker_open),
         "watch_restarts": watch_restarts,
         "prefill_requeues": requeues,
+        "router_resyncs": router_resyncs,
+        "store_failovers": store_failovers,
+        "store_client_retries": store_client_retries,
+        "cached_tokens": cached_tokens,
         "engine_registries": float(len(engine_workers)),
         "lost_time_s": lost_time,
         "step_time_s": step_time,
@@ -419,6 +441,17 @@ async def poll_control_plane(
                         s["prefill_requeues"] = max(s["prefill_requeues"], snap["prefill_requeues"])
                         s["engine_registries_max"] = max(
                             s["engine_registries_max"], snap["engine_registries"])
+                        s["store_failovers"] = max(
+                            s["store_failovers"], snap["store_failovers"])
+                        s["store_client_retries"] = max(
+                            s["store_client_retries"], snap["store_client_retries"])
+                        # Last-seen, not max: cached tokens live in the
+                        # frontend registry and reset when a bounce rebuilds
+                        # it, so the final value is what the *replacement*
+                        # frontend served warm; resyncs are process-global
+                        # and only grow, so last-seen == total either way.
+                        s["router_resyncs_final"] = snap["router_resyncs"]
+                        s["cached_tokens_final"] = snap["cached_tokens"]
                         # Cumulative families max-fold per key: monotone
                         # within a worker, and the peak survives a dead
                         # worker dropping out of the federated sum.

@@ -100,6 +100,7 @@ class HttpService:
                 web.get("/debug/incidents", self.debug_incidents),
                 web.get("/debug/incidents/{incident_id}", self.debug_incident),
                 web.get("/debug/federation", self.debug_federation),
+                web.get("/debug/store", self.debug_store),
                 web.post("/clear_kv_blocks", self.clear_kv_blocks),
                 web.post("/engine/profile", self.engine_profile),
             ]
@@ -656,6 +657,23 @@ class HttpService:
             {
                 "failures": dict(self.telemetry.scrape_failures),
                 "last_failure": self.telemetry.last_failure,
+            }
+        )
+
+    async def debug_store(self, request: web.Request) -> web.Response:
+        """HA control-plane view from this process: the hosted store replica
+        (role/epoch/seq/lag, if one lives in-process), the client-side
+        failover ledger, and the router's index-resync counter. Process-local
+        snapshots only — no store RPC, so it answers even mid-failover."""
+        from dynamo_tpu.router.events import router_resync_snapshot
+        from dynamo_tpu.runtime.replication import replica_snapshot
+        from dynamo_tpu.runtime.store_server import store_client_snapshot
+
+        return web.json_response(
+            {
+                "replica": replica_snapshot(),
+                "client": store_client_snapshot(),
+                "router": router_resync_snapshot(),
             }
         )
 

@@ -159,6 +159,39 @@ class FrontendMetrics:
             "Per-instance circuit breaker state (0 closed / 1 half-open / 2 open)",
             ["endpoint", "instance"], registry=self.registry,
         )
+        # HA control plane: role/epoch/lag of the store replica hosted in
+        # this process (if any) plus the client-side failover view — synced
+        # per scrape from runtime/replication.py and runtime/store_server.py.
+        self.store_role = Gauge(
+            "dynamo_store_role",
+            "Store replica role hosted or observed by this process (1 for the active role label)",
+            ["role"], registry=self.registry,
+        )
+        self.store_epoch = Gauge(
+            "dynamo_store_epoch",
+            "Leadership epoch of the store cluster as seen by this process",
+            registry=self.registry,
+        )
+        self.store_replication_lag = Gauge(
+            "dynamo_store_replication_lag_seconds",
+            "Wall-clock age of the last replicated record applied by the local follower (0 on a leader)",
+            registry=self.registry,
+        )
+        self.store_failovers = Gauge(
+            "dynamo_store_failovers_total",
+            "Store leadership changes this process has observed",
+            registry=self.registry,
+        )
+        self.store_client_retries = Gauge(
+            "dynamo_store_client_op_retries_total",
+            "Idempotent store ops transparently replayed after a connection loss",
+            registry=self.registry,
+        )
+        self.router_index_resyncs = Gauge(
+            "dynamo_router_index_resyncs_total",
+            "KV-index reconstructions (snapshot rebases + gap-forced resyncs) since frontend start",
+            registry=self.registry,
+        )
         # Streaming P^2 quantiles — no fixed-bucket distortion at the 500 ms
         # target the way a histogram boundary would introduce.
         self.ttft_quantile = Gauge(
@@ -174,7 +207,10 @@ class FrontendMetrics:
 
     def render(self) -> bytes:
         from dynamo_tpu.ops.pallas_paged import fallback_snapshot
+        from dynamo_tpu.router.events import router_resync_snapshot
         from dynamo_tpu.runtime.client import breaker_snapshot, watch_snapshot
+        from dynamo_tpu.runtime.replication import replica_snapshot
+        from dynamo_tpu.runtime.store_server import store_client_snapshot
 
         # Drop label sets from a previous scrape first: a signature that
         # left the snapshot (fallback cache reset) must not keep exporting
@@ -205,6 +241,24 @@ class FrontendMetrics:
             self.ttft_quantile.labels(f"p{int(q * 100)}").set(v)
         for q, v in self.slo.itl.snapshot().items():
             self.itl_quantile.labels(f"p{int(q * 100)}").set(v)
+        # HA view: an in-process replica coordinator is authoritative; a pure
+        # client process (the usual frontend) reports what its StoreClient
+        # learned from who_leads.
+        replica = replica_snapshot()
+        client = store_client_snapshot()
+        self.store_role.clear()
+        if replica is not None:
+            self.store_role.labels(replica["role"]).set(1)
+            self.store_epoch.set(replica["epoch"])
+            self.store_replication_lag.set(replica["lag_s"])
+            self.store_failovers.set(replica["failovers"])
+        else:
+            self.store_role.labels(client["role"]).set(1)
+            self.store_epoch.set(client["epoch"])
+            self.store_replication_lag.set(0.0)
+            self.store_failovers.set(client["failovers"])
+        self.store_client_retries.set(client["retries"])
+        self.router_index_resyncs.set(router_resync_snapshot()["resyncs"])
         return generate_latest(self.registry)
 
     def sync_federation(self, failures: dict[str, int]) -> None:

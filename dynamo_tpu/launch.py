@@ -721,6 +721,22 @@ async def run_role(args: argparse.Namespace) -> None:
             backing = await PersistentStore.open(args.store_persist)
         store_server = await StoreServer(backing, host=args.host, port=args.serve_store_port).start()
         store = store_server.store
+        replicas = [u.strip() for u in (getattr(args, "store_replicas", "") or "").split(",") if u.strip()]
+        if len(replicas) > 1:
+            from dynamo_tpu.config import load_store_settings
+            from dynamo_tpu.runtime.replication import attach_replication
+
+            ss = load_store_settings()
+            coord = attach_replication(
+                store_server, replicas, args.store_replica_index,
+                promote_after_s=ss.promote_after_s, poll_s=ss.poll_s,
+                epoch_grace_s=ss.epoch_grace_s,
+            )
+            await coord.start()
+            logger.info(
+                "store replica %d/%d (%s) as %s", args.store_replica_index,
+                len(replicas), replicas[args.store_replica_index], coord.role,
+            )
     else:
         if not args.store:
             raise SystemExit("--role requires --store tcp://host:port (or --serve-store-port)")
@@ -1025,10 +1041,11 @@ def main(argv: list[str] | None = None) -> None:
     # Layered defaults (reference figment cascade, `config.rs:26-143`):
     # dataclass defaults <- TOML (DYN_CONFIG) <- DYN_RUNTIME_*/DYN_WORKER_*
     # env <- CLI flags (highest).
-    from dynamo_tpu.config import load_runtime_settings, load_worker_settings
+    from dynamo_tpu.config import load_runtime_settings, load_store_settings, load_worker_settings
 
     rs = load_runtime_settings()
     ws = load_worker_settings()
+    ss_store = load_store_settings()
     if ws.router_mode not in ("round_robin", "random", "kv"):
         # Env/TOML-seeded defaults bypass argparse choices validation.
         raise SystemExit(f"invalid router_mode from config: {ws.router_mode!r}")
@@ -1048,7 +1065,11 @@ def main(argv: list[str] | None = None) -> None:
         "--role", default="local", choices=["local", "frontend", "worker", "prefill", "encode", "router", "store"],
         help="multi-process deployments: run one role per process",
     )
-    parser.add_argument("--store", default=rs.store or None, help="tcp://host:port of the deployment's store server")
+    parser.add_argument(
+        "--store", default=rs.store or None,
+        help="store server url(s): tcp://host:port, or a comma list of "
+        "replica urls (tcp://a,tcp://b,...) for HA failover",
+    )
     parser.add_argument("--mock", action="store_true", help="timing-model engine instead of JAX (fleet tests, planner)")
     parser.add_argument(
         "--quantize", default="", choices=["", "int8", "int4"],
@@ -1063,6 +1084,15 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument(
         "--store-persist", default=None,
         help="WAL path for durable (lease-less) store state; replayed on restart",
+    )
+    parser.add_argument(
+        "--store-replicas", default=ss_store.replicas or None,
+        help="HA store: comma list of ALL replica urls (this process's own "
+        "included); index 0 bootstraps as leader",
+    )
+    parser.add_argument(
+        "--store-replica-index", type=int, default=ss_store.replica_index,
+        help="this store process's position in --store-replicas",
     )
     parser.add_argument(
         "--disagg-threshold", type=int, default=None,

@@ -27,6 +27,23 @@ logger = logging.getLogger(__name__)
 
 KV_EVENTS_ENDPOINT = "kv_events"
 
+#: Index reconstructions this process has performed: every snapshot rebase
+#: (fresh subscription — including each one a restarted frontend issues) and
+#: every gap-forced resync. Sync-on-render source for the frontend's
+#: ``dynamo_router_index_resyncs_total`` gauge; the counter being per-process
+#: is the point — a bounced frontend proves reconstruction by counting again
+#: from zero.
+_RESYNCS = 0
+
+
+def router_resync_snapshot() -> dict:
+    return {"resyncs": _RESYNCS}
+
+
+def _count_resync() -> None:
+    global _RESYNCS
+    _RESYNCS += 1
+
 
 class KvEventBroadcaster(AsyncEngine[Any, dict]):
     """Fans the engine's KV events out to any number of stream subscribers.
@@ -113,8 +130,11 @@ class KvEventSubscriber:
     """Router side: one stream per live worker instance, feeding the indexer."""
 
     def __init__(self, endpoint: Endpoint, indexer: KvIndexer) -> None:
+        from dynamo_tpu.config import load_router_resync_settings
+
         self.endpoint = endpoint
         self.indexer = indexer
+        self._resync = load_router_resync_settings()
         self._tasks: dict[int, asyncio.Task] = {}
         self._watch_task: asyncio.Task | None = None
 
@@ -153,7 +173,7 @@ class KvEventSubscriber:
     async def _consume(self, inst: Instance) -> None:
         wid = inst.instance_id
         transport = self.endpoint.runtime.transport
-        backoff = 0.2
+        backoff = self._resync.backoff_s
         while True:
             expected_seq = 0
             try:
@@ -162,18 +182,22 @@ class KvEventSubscriber:
                     seq = msg.get("seq", expected_seq)
                     if msg.get("snapshot"):
                         # Fresh subscription: rebase our view on the snapshot.
+                        # This is the reconstruction path — a restarted
+                        # frontend rebuilds its whole prefix index from these.
                         self.indexer.remove_worker(wid)
+                        _count_resync()
                         expected_seq = seq
                     elif seq != expected_seq:
                         # Missed events: our view of this worker is stale; the
                         # next reconnect snapshot will rebuild it.
                         logger.warning("kv event gap for worker %x (%d != %d); resync", wid, seq, expected_seq)
                         self.indexer.remove_worker(wid)
+                        _count_resync()
                         expected_seq = seq
                     if not msg.get("snapshot"):
                         expected_seq += 1
                     self.indexer.apply_event(RouterEvent(wid, KvCacheEvent.from_dict(msg["event"])))
-                    backoff = 0.2
+                    backoff = self._resync.backoff_s
             except asyncio.CancelledError:
                 raise
             except Exception as exc:
@@ -182,7 +206,7 @@ class KvEventSubscriber:
                 logger.info("kv event stream to %x dropped (%s); retrying", wid, exc)
                 self.indexer.remove_worker(wid)
                 await asyncio.sleep(backoff)
-                backoff = min(backoff * 2, 5.0)
+                backoff = min(backoff * 2, self._resync.max_backoff_s)
 
     async def close(self) -> None:
         if self._watch_task is not None:

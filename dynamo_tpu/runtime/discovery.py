@@ -19,7 +19,6 @@ from __future__ import annotations
 
 import abc
 import asyncio
-import itertools
 import time
 from dataclasses import dataclass
 from enum import Enum
@@ -118,7 +117,7 @@ class MemoryStore(KeyValueStore):
         self._lease_ttl: dict[int, float] = {}
         self._lease_keys: dict[int, set[str]] = {}
         self._watchers: list[tuple[str, asyncio.Queue[WatchEvent]]] = []
-        self._lease_counter = itertools.count(1)
+        self._lease_next = 1
         self._clock = clock
         self._reap_interval = reap_interval
         self._reaper: asyncio.Task | None = None
@@ -214,11 +213,30 @@ class MemoryStore(KeyValueStore):
     async def create_lease(self, ttl: float = DEFAULT_LEASE_TTL) -> Lease:
         async with self._lock:
             self._ensure_reaper()
-            lid = next(self._lease_counter)
+            lid = self._lease_next
+            self._lease_next += 1
             self._leases[lid] = self._clock() + ttl
             self._lease_ttl[lid] = ttl
             self._lease_keys[lid] = set()
             return Lease(id=lid, ttl=ttl, store=self)
+
+    async def adopt_lease(self, lease_id: int, ttl: float) -> None:
+        """Create — or re-arm — a lease under a *caller-chosen* id.
+
+        The replication apply path: a follower mirrors the leader's lease ids
+        so that lease-bound keys land under the same identity, and re-arms the
+        deadline against its own monotonic clock on every replicated
+        keepalive (absolute deadlines cannot be shipped across processes).
+        The id counter is kept ahead of adopted ids so leases created after a
+        promotion never collide.
+        """
+        async with self._lock:
+            self._ensure_reaper()
+            self._leases[lease_id] = self._clock() + ttl
+            self._lease_ttl[lease_id] = ttl
+            self._lease_keys.setdefault(lease_id, set())
+            if lease_id >= self._lease_next:
+                self._lease_next = lease_id + 1
 
     async def keep_alive(self, lease_id: int) -> None:
         if FAULTS.armed:

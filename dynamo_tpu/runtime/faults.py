@@ -51,6 +51,8 @@ FAULT_POINTS: dict[str, str] = {
     "tcp.write": "runtime/tcp.py — caller-side request-frame write on the data plane",
     "store.op": "runtime/store_server.py — StoreClient request/response call to the store",
     "store.watch": "runtime/discovery.py + store_server.py — per-event delivery on a prefix watch",
+    "store.replicate": "runtime/replication.py — follower-side apply of one replicated mutation record",
+    "store.promote": "runtime/replication.py — a follower's promotion to store leader",
     "lease.keepalive": "runtime/discovery.py — lease keep-alive refresh",
     "engine.step": "engine/service.py — one engine step in the service loop",
     "kv.chunk.send": "disagg/transfer.py — sender side of one v2 KV chunk",

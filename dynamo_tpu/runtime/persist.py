@@ -45,6 +45,12 @@ class PersistentStore(MemoryStore):
         # Serializes appends with close(): an executor fsync must never race
         # a close of (and fd-number reuse after) the WAL file.
         self._wal_lock = asyncio.Lock()
+        # Group commit: writers flush under _wal_lock and then wait for an
+        # fsync that covers their entry; one writer at a time leads a batch
+        # under _sync_lock, so N concurrent appends cost one fsync, not N.
+        self._sync_lock = asyncio.Lock()
+        self._wal_written = 0  # entries flushed to the fh
+        self._wal_synced = 0  # entries covered by a completed fsync
 
     @classmethod
     async def open(cls, path: str | pathlib.Path) -> "PersistentStore":
@@ -105,11 +111,30 @@ class PersistentStore(MemoryStore):
                 self._durable.add(key)
             else:
                 self._durable.discard(key)
-            # Durable against power loss, not just process crash — but fsync
-            # is a blocking syscall, so keep it off the store server's event
-            # loop (a stalled loop delays every op and lease keepalive). The
-            # lock keeps the fd alive until the fsync lands.
-            await asyncio.get_running_loop().run_in_executor(None, os.fsync, self._fh.fileno())
+            self._wal_written += 1
+            mine = self._wal_written
+        # Group commit: don't return before an fsync covers this entry, but
+        # let one fsync cover every entry flushed before it started. With a
+        # single uncontended writer this is exactly one fsync per mutation —
+        # the pre-batching behavior; under concurrency (replication makes the
+        # leader's WAL the hot path) waiters coalesce behind the leader of
+        # the current batch. fsync itself is a blocking syscall, so it runs
+        # in the executor, off the store server's event loop (a stalled loop
+        # delays every op and lease keepalive).
+        while self._wal_synced < mine:
+            async with self._sync_lock:
+                if self._wal_synced >= mine:
+                    break
+                async with self._wal_lock:
+                    if self._fh is None:
+                        return
+                    covers = self._wal_written
+                    fileno = self._fh.fileno()
+                # _sync_lock keeps the fd alive: close() takes it before
+                # closing the log, so the executor fsync never races an
+                # fd-number reuse.
+                await asyncio.get_running_loop().run_in_executor(None, os.fsync, fileno)
+                self._wal_synced = covers
 
     async def put(self, key: str, value: bytes, lease_id: int | None = None) -> None:
         await super().put(key, value, lease_id=lease_id)
@@ -134,8 +159,9 @@ class PersistentStore(MemoryStore):
         return existed
 
     async def close(self) -> None:
-        async with self._wal_lock:
-            self.close_log()
+        async with self._sync_lock:
+            async with self._wal_lock:
+                self.close_log()
         await super().close()
 
     def close_log(self) -> None:

@@ -1,0 +1,459 @@
+"""Store replication: leader/follower log shipping with epoch fencing.
+
+The deployment store (``runtime/store_server.py``) stays a single-writer
+system — one *leader* serializes every mutation — but gains standby
+*followers* that make leader death a survivable event:
+
+- The leader streams every mutation — lease-less puts/deletes **and** lease
+  create/keepalive/revoke — to each follower over the existing frame
+  protocol (a follower opens an ``op="replicate"`` subscription; the
+  connection becomes a one-way stream of records, exactly like a watch).
+- Each record is stamped with ``(epoch, seq)``: the epoch is bumped on every
+  leadership change, the sequence number is globally monotone. A follower
+  applies records into its own ``MemoryStore``/``PersistentStore`` through
+  the normal store API, so local reads, watches — and the WAL, when the
+  backing store persists — all work unchanged.
+- Lease deadlines are clock-relative and cannot be shipped: a follower
+  re-arms each lease against *its own* monotonic clock on every replicated
+  keepalive (``MemoryStore.adopt_lease``). A follower's deadline therefore
+  trails the leader's by at most the replication lag — leases never expire
+  *early* on a replica, which is what keeps worker instances registered
+  across a failover.
+- On leader death the freshest follower promotes: candidates rank by
+  ``(epoch, seq)`` with the replica-list index as the deterministic
+  tie-break, and a follower promotes only when it is the best *reachable*
+  candidate. Promotion bumps the epoch; a stale ex-leader is fenced by it —
+  its replicate handshakes and records are rejected by any peer that has
+  seen a higher epoch, and on demotion it resyncs from the new leader's
+  snapshot, discarding any divergent writes. There is never a window where
+  two replicas both *win*: the rank order is total.
+
+Single-replica deployments never construct a coordinator: ``StoreServer``
+with ``repl is None`` takes exactly the pre-replication code paths.
+
+Chaos seams: ``store.replicate`` fires per record on the follower's apply
+path (drop/corrupt force a resync), ``store.promote`` fires at the top of
+:meth:`ReplicationCoordinator.promote` (crash aborts the promotion and the
+next-ranked candidate takes over on a later poll).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from dynamo_tpu.runtime.codec import FrameType, read_frame, write_frame
+from dynamo_tpu.runtime.faults import FAULTS
+
+logger = logging.getLogger(__name__)
+
+#: Ops whose successful execution the leader ships to followers.
+REPLICATED_OPS = ("put", "delete", "lease", "keepalive", "revoke")
+
+
+class ReplicaDesync(Exception):
+    """The follower's view diverged (gap / corrupt record): full resync."""
+
+
+class StaleLeaderError(Exception):
+    """The peer we follow announced an epoch older than ours: fence it."""
+
+
+def parse_peer(url: str) -> tuple[str, int]:
+    """``tcp://host:port`` -> ``(host, port)``."""
+    rest = url.split("://", 1)[-1]
+    host, port = rest.rsplit(":", 1)
+    return host, int(port)
+
+
+@dataclass
+class ReplicaConfig:
+    """Identity + knobs of one replica (see ``StoreSettings`` / DYN_STORE_*)."""
+
+    url: str  # this replica's advertised tcp://host:port
+    peers: tuple[str, ...]  # the full replica list, in priority order
+    index: int  # this replica's position in ``peers``
+    promote_after_s: float = 1.0  # leaderless window before an election
+    poll_s: float = 0.25  # peer who_leads poll cadence
+    epoch_grace_s: float = 0.0  # extra lease grace granted at promotion
+
+
+async def _rpc(url: str, op: str, *, timeout: float = 1.0, **fields: Any) -> dict | None:
+    """One-shot request to a peer replica; None when unreachable/errored."""
+    host, port = parse_peer(url)
+    try:
+        reader, writer = await asyncio.wait_for(asyncio.open_connection(host, port), timeout)
+    except (OSError, asyncio.TimeoutError):
+        return None
+    try:
+        write_frame(writer, FrameType.REQUEST, op=op, rid=0, **fields)
+        await writer.drain()
+        frame = await asyncio.wait_for(read_frame(reader), timeout)
+        if frame is None or frame.type is not FrameType.DATA:
+            return None
+        return frame.payload
+    except (OSError, asyncio.TimeoutError, ConnectionError):
+        return None
+    finally:
+        writer.close()
+
+
+class ReplicationCoordinator:
+    """Replication + failover state machine attached to one ``StoreServer``.
+
+    The server calls :meth:`record` after each applied mutation (leader) and
+    :meth:`status` for ``who_leads``; the coordinator owns the follower link,
+    elections, and the leader's usurper watchdog.
+    """
+
+    def __init__(self, server, config: ReplicaConfig) -> None:
+        self.server = server
+        self.cfg = config
+        bootstrap_leader = config.index == 0
+        self.role = "leader" if bootstrap_leader else "follower"
+        self.epoch = 1 if bootstrap_leader else 0
+        self.seq = 0  # last assigned (leader) / last applied (follower)
+        self.leader_url: str | None = config.peers[0] if config.peers else None
+        self.failovers = 0  # leadership changes this replica observed
+        self.lag_s = 0.0  # follower: wall-clock age of the last applied record
+        self._subs: list[asyncio.Queue] = []
+        self._task: asyncio.Task | None = None
+        self._closed = False
+
+    # -- leader side -------------------------------------------------------
+
+    def record(self, op: str, **fields: Any) -> None:
+        """Stamp one applied mutation and fan it out to follower streams."""
+        if self.role != "leader":
+            return
+        self.seq += 1
+        rec = {"e": self.epoch, "s": self.seq, "ts": time.time(), "op": op, **fields}
+        for q in list(self._subs):
+            q.put_nowait(rec)
+
+    def subscribe(self) -> asyncio.Queue:
+        q: asyncio.Queue = asyncio.Queue()
+        self._subs.append(q)
+        return q
+
+    def unsubscribe(self, q: asyncio.Queue) -> None:
+        if q in self._subs:
+            self._subs.remove(q)
+
+    async def export_snapshot(self) -> dict:
+        """Full store state for a (re)joining follower.
+
+        Lease deadlines are shipped as TTLs: the follower re-arms each lease
+        from its own clock on adoption, which can only *extend* liveness.
+        """
+        store = self.server.store
+        async with store._lock:  # noqa: SLF001 - replication is a store-internal plane
+            return {
+                "data": dict(store._data),
+                "key_lease": dict(store._key_lease),
+                "leases": {str(lid): store._lease_ttl[lid] for lid in store._leases},
+            }
+
+    def note_stale(self, seen_epoch: int) -> None:
+        """A peer proved a higher epoch exists: fence ourselves (demote)."""
+        if self.role == "leader" and seen_epoch > self.epoch:
+            logger.warning(
+                "store replica %s fenced: saw epoch %d > own %d; demoting",
+                self.cfg.url, seen_epoch, self.epoch,
+            )
+            self.role = "follower"
+            self.failovers += 1
+            self._kick_subscribers()
+            self._respawn()
+
+    # -- follower side -----------------------------------------------------
+
+    async def start(self) -> "ReplicationCoordinator":
+        if self._task is None:
+            loop = self._leader_watchdog() if self.role == "leader" else self._follower_loop()
+            self._task = asyncio.create_task(loop)
+        return self
+
+    def _respawn(self) -> None:
+        """Restart the role loop after a role change from outside the task."""
+        if self._closed:
+            return
+        old = self._task
+        self._task = None
+        if old is not None and old is not asyncio.current_task():
+            old.cancel()
+        loop = self._leader_watchdog() if self.role == "leader" else self._follower_loop()
+        self._task = asyncio.create_task(loop)
+
+    async def _follower_loop(self) -> None:
+        down_since: float | None = None
+        clock = time.monotonic
+        while not self._closed:
+            leader = await self._find_leader()
+            if leader is not None and leader != self.cfg.url:
+                down_since = None
+                try:
+                    await self._follow(leader)
+                except StaleLeaderError:
+                    pass  # fence held; poll again for the real leader
+                except ReplicaDesync as exc:
+                    logger.warning("store replica %s desync (%s); resyncing", self.cfg.url, exc)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as exc:
+                    logger.info("replication link from %s dropped (%s)", leader, exc)
+                down_since = down_since or clock()
+            else:
+                down_since = down_since or clock()
+                if clock() - down_since >= self.cfg.promote_after_s and await self._should_promote():
+                    try:
+                        await self.promote()
+                        return  # promote() respawned us as the leader watchdog
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as exc:
+                        logger.warning("promotion of %s aborted (%s)", self.cfg.url, exc)
+            await asyncio.sleep(self.cfg.poll_s)
+
+    async def _find_leader(self) -> str | None:
+        """The url of the current leader, by asking every peer (None if none).
+
+        A leader claim is only believed at an epoch >= our own — the fence
+        that stops a rebooted stale ex-leader from recapturing its followers.
+        """
+        if self.leader_url is not None and self.leader_url != self.cfg.url:
+            info = await _rpc(self.leader_url, "who_leads", timeout=self.cfg.poll_s + 0.25)
+            if info is not None and info.get("role") == "leader" and info.get("epoch", 0) >= self.epoch:
+                return self.leader_url
+        for peer in self.cfg.peers:
+            if peer == self.cfg.url:
+                continue
+            info = await _rpc(peer, "who_leads", timeout=self.cfg.poll_s + 0.25)
+            if info is None:
+                continue
+            if info.get("role") == "leader" and info.get("epoch", 0) >= self.epoch:
+                return peer
+            hint = info.get("leader")
+            if hint and hint not in (self.cfg.url, peer):
+                hinted = await _rpc(hint, "who_leads", timeout=self.cfg.poll_s + 0.25)
+                if hinted is not None and hinted.get("role") == "leader" and hinted.get("epoch", 0) >= self.epoch:
+                    return hint
+        return None
+
+    async def _should_promote(self) -> bool:
+        """Am I the best-ranked reachable candidate? Rank: (epoch, seq, -index).
+
+        The order is total (indices are unique), so at most one reachable
+        follower can answer yes for any consistent view of the peer set.
+        """
+        mine = (self.epoch, self.seq, -self.cfg.index)
+        for i, peer in enumerate(self.cfg.peers):
+            if peer == self.cfg.url:
+                continue
+            info = await _rpc(peer, "who_leads", timeout=self.cfg.poll_s + 0.25)
+            if info is None:
+                continue
+            if info.get("role") == "leader" and info.get("epoch", 0) >= self.epoch:
+                return False  # a live leader exists after all
+            theirs = (info.get("epoch", 0), info.get("seq", 0), -i)
+            if theirs > mine:
+                return False
+        return True
+
+    async def promote(self) -> None:
+        """Become the leader: bump the epoch and grant every lease one fresh
+        TTL of grace (replicated keepalives may trail by the replication lag,
+        and their owners need a failover window to rediscover the leader)."""
+        if FAULTS.armed:
+            FAULTS.fire("store.promote")
+        store = self.server.store
+        async with store._lock:  # noqa: SLF001
+            now = store._clock()
+            for lid, ttl in store._lease_ttl.items():
+                if lid in store._leases:
+                    store._leases[lid] = max(
+                        store._leases[lid], now + ttl + self.cfg.epoch_grace_s
+                    )
+        self.epoch += 1
+        self.role = "leader"
+        self.leader_url = self.cfg.url
+        self.failovers += 1
+        self.lag_s = 0.0
+        logger.warning(
+            "store replica %s promoted to leader (epoch %d, seq %d)",
+            self.cfg.url, self.epoch, self.seq,
+        )
+        self._respawn()
+
+    async def _follow(self, leader_url: str) -> None:
+        """Hold one replicate stream from ``leader_url``: snapshot, then apply
+        records until the stream drops or the fence trips."""
+        host, port = parse_peer(leader_url)
+        reader, writer = await asyncio.open_connection(host, port)
+        try:
+            write_frame(
+                writer, FrameType.REQUEST, op="replicate", rid=0,
+                epoch=self.epoch, url=self.cfg.url,
+            )
+            await writer.drain()
+            first = await read_frame(reader)
+            if first is None:
+                raise ConnectionError("replicate handshake closed")
+            if first.type is FrameType.ERROR:
+                if first.fields.get("kind") == "stale_epoch":
+                    raise StaleLeaderError(first.fields.get("error", "stale leader"))
+                raise ConnectionError(first.fields.get("error", "replicate rejected"))
+            head = first.payload
+            if head.get("e", 0) < self.epoch:
+                raise StaleLeaderError(f"leader epoch {head.get('e')} < own {self.epoch}")
+            await self._apply_snapshot(head["snapshot"])
+            self.epoch = head["e"]
+            self.seq = head["s"]
+            self.leader_url = leader_url
+            self.lag_s = 0.0
+            logger.info(
+                "store replica %s following %s from (epoch %d, seq %d)",
+                self.cfg.url, leader_url, self.epoch, self.seq,
+            )
+            while True:
+                frame = await read_frame(reader)
+                if frame is None:
+                    raise ConnectionError("replicate stream closed")
+                rec = frame.payload
+                if FAULTS.armed and FAULTS.fire("store.replicate") == "corrupt":
+                    raise ReplicaDesync("injected corrupt replication record")
+                if rec["e"] < self.epoch:
+                    raise StaleLeaderError(f"record epoch {rec['e']} < own {self.epoch}")
+                if rec["s"] <= self.seq:
+                    continue  # already covered by the snapshot
+                if rec["s"] != self.seq + 1:
+                    raise ReplicaDesync(f"seq gap: {rec['s']} after {self.seq}")
+                await self._apply_record(rec)
+                self.epoch = rec["e"]
+                self.seq = rec["s"]
+                self.lag_s = max(0.0, time.time() - rec.get("ts", time.time()))
+        finally:
+            writer.close()
+
+    async def _apply_snapshot(self, snap: dict) -> None:
+        """Reconcile the local store to the leader's snapshot (not replace):
+        unchanged keys are left alone so local watchers see real deltas only,
+        plus idempotent re-puts for anything the stream may replay."""
+        store = self.server.store
+        for lid_s, ttl in snap.get("leases", {}).items():
+            await store.adopt_lease(int(lid_s), float(ttl))
+        want = snap.get("data", {})
+        key_lease = snap.get("key_lease", {})
+        have = await store.get_prefix("")
+        for key in sorted(set(have) - set(want)):
+            await store.delete(key)
+        for key, value in want.items():
+            lease_id = key_lease.get(key)
+            if have.get(key) != value or store._key_lease.get(key) != lease_id:  # noqa: SLF001
+                await store.put(key, value, lease_id=lease_id)
+        live = {int(lid_s) for lid_s in snap.get("leases", {})}
+        for lid in sorted(set(store._leases) - live):  # noqa: SLF001
+            await store.revoke_lease(lid)
+
+    async def _apply_record(self, rec: dict) -> None:
+        store = self.server.store
+        op = rec["op"]
+        if op == "put":
+            await store.put(rec["key"], rec["value"], lease_id=rec.get("lease_id"))
+        elif op == "delete":
+            await store.delete(rec["key"])
+        elif op in ("lease", "keepalive"):
+            await store.adopt_lease(rec["lease_id"], rec["ttl"])
+        elif op == "revoke":
+            await store.revoke_lease(rec["lease_id"])
+        else:
+            raise ReplicaDesync(f"unknown replicated op {op!r}")
+
+    async def _leader_watchdog(self) -> None:
+        """Leader-side fence: poll peers and demote on sight of a higher epoch
+        (covers the partition-heal case where no follower dials us first)."""
+        while not self._closed and self.role == "leader":
+            await asyncio.sleep(max(self.cfg.poll_s * 4, 0.5))
+            for peer in self.cfg.peers:
+                if peer == self.cfg.url or self.role != "leader":
+                    continue
+                info = await _rpc(peer, "who_leads", timeout=self.cfg.poll_s + 0.25)
+                if info is not None and info.get("epoch", 0) > self.epoch:
+                    self.note_stale(info["epoch"])
+                    return  # note_stale respawned us as a follower
+
+    # -- shared ------------------------------------------------------------
+
+    def status(self) -> dict:
+        return {
+            "role": self.role,
+            "epoch": self.epoch,
+            "seq": self.seq,
+            "leader": self.cfg.url if self.role == "leader" else self.leader_url,
+            "url": self.cfg.url,
+            "lag_s": self.lag_s,
+            "failovers": self.failovers,
+        }
+
+    def _kick_subscribers(self) -> None:
+        for q in list(self._subs):
+            q.put_nowait(None)  # sentinel: server closes the stream
+
+    async def close(self) -> None:
+        global _LOCAL
+        self._closed = True
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        self._kick_subscribers()
+        # A closed coordinator must stop advertising a role: leaving it in
+        # the in-process registry would make replica_snapshot() shadow the
+        # client-side failover view in FrontendMetrics.render().
+        if _LOCAL is self:
+            _LOCAL = None
+
+
+#: In-process replica registry (metrics): the last coordinator constructed in
+#: this process, surfaced by ``frontend/metrics.py`` as dynamo_store_role /
+#: dynamo_store_epoch / dynamo_store_replication_lag_seconds.
+_LOCAL: ReplicationCoordinator | None = None
+
+
+def attach_replication(server, peers: list[str] | tuple[str, ...], index: int, **knobs: Any) -> ReplicationCoordinator:
+    """Wire a coordinator onto a started ``StoreServer`` and register it for
+    in-process observability. ``peers`` must include this replica's own url at
+    position ``index``."""
+    global _LOCAL
+    cfg = ReplicaConfig(url=peers[index], peers=tuple(peers), index=index, **knobs)
+    coord = ReplicationCoordinator(server, cfg)
+    server.repl = coord
+    _LOCAL = coord
+    return coord
+
+
+def replica_snapshot() -> dict | None:
+    """Role/epoch/lag of the replica hosted in this process (None if none)."""
+    if _LOCAL is None:
+        return None
+    return {
+        "role": _LOCAL.role,
+        "epoch": _LOCAL.epoch,
+        "seq": _LOCAL.seq,
+        "lag_s": _LOCAL.lag_s,
+        "failovers": _LOCAL.failovers,
+    }
+
+
+__all__ = [
+    "REPLICATED_OPS",
+    "ReplicaConfig",
+    "ReplicaDesync",
+    "ReplicationCoordinator",
+    "StaleLeaderError",
+    "attach_replication",
+    "parse_peer",
+    "replica_snapshot",
+]

@@ -167,6 +167,23 @@ def render(snap: FleetSnapshot, *, url: str, now: float | None = None) -> str:
         total_fired = sum(fired.values())
         lines.append(f"  none active  ({total_fired:.0f} fired total)")
 
+    # --- store HA ---------------------------------------------------------
+    roles = {k: v for k, v in snap.by_label("dynamo_store_role", "role").items() if v}
+    epoch = snap.value("dynamo_store_epoch")
+    lag = snap.value("dynamo_store_replication_lag_seconds")
+    failovers = snap.value("dynamo_store_failovers_total")
+    retries = snap.value("dynamo_store_client_op_retries_total")
+    resyncs = snap.value("dynamo_router_index_resyncs_total")
+    lines.append("store")
+    role = next(iter(sorted(roles)), "-")
+    lines.append(
+        f"  role {role:<9} epoch {f'{epoch:.0f}' if epoch is not None else '-':>4}"
+        f"   repl lag {f'{lag:.3f}s' if lag is not None else '-':>8}"
+        f"   failovers {f'{failovers:.0f}' if failovers is not None else '-':>3}"
+        f"   op retries {f'{retries:.0f}' if retries is not None else '-':>3}"
+        f"   index resyncs {f'{resyncs:.0f}' if resyncs is not None else '-':>3}"
+    )
+
     # --- per-worker -------------------------------------------------------
     running = snap.by_label("dynamo_engine_requests_running", "worker")
     waiting = snap.by_label("dynamo_engine_requests_waiting", "worker")

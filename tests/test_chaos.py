@@ -310,6 +310,67 @@ async def test_store_op_fault_drill():
         await server.close()
 
 
+async def test_store_replicate_fault_drill():
+    """Chaos on the replication stream: a corrupt record forces a follower
+    desync + full resync, a dropped stream forces a reconnect — either way
+    the stores reconverge byte-identically, never silently diverge."""
+    from test_store_ha import _cluster, _converged, _shutdown, _wait
+
+    from dynamo_tpu.runtime.store_server import StoreClient
+
+    peers, servers, coords = await _cluster(2, promote_after_s=30, poll_s=0.05)
+    client = StoreClient.from_url(",".join(peers))
+    try:
+        await client.put("cfg/base", b"v0")
+        await _wait(lambda: coords[1].seq == coords[0].seq, msg="initial catch-up")
+
+        FAULTS.arm("store.replicate:corrupt@1")  # next applied record is garbage
+        await client.put("cfg/a", b"v1")
+        await _wait(lambda: coords[1].seq == coords[0].seq, msg="resync after corrupt")
+        assert FAULTS.fired("store.replicate") == 1
+        assert await _converged(servers[0], servers[1])
+
+        FAULTS.arm("store.replicate:drop@1")  # stream dies mid-flight
+        await client.put("cfg/b", b"v2")
+        await _wait(lambda: coords[1].seq == coords[0].seq, msg="reconnect after drop")
+        assert FAULTS.fired("store.replicate") == 1
+        assert await _converged(servers[0], servers[1])
+        assert coords[1].role == "follower"  # recovery never usurped the leader
+    finally:
+        await _shutdown(servers, client)
+
+
+async def test_store_promote_fault_drill():
+    """A crash mid-promotion aborts it cleanly (no epoch bump, no role
+    change); a later poll retries and exactly one leader emerges — the drill
+    that proves there are never two."""
+    from test_store_ha import _cluster, _shutdown, _wait
+
+    from dynamo_tpu.runtime.store_server import StoreClient
+
+    peers, servers, coords = await _cluster(3, promote_after_s=0.2, poll_s=0.05)
+    client = StoreClient.from_url(",".join(peers))
+    try:
+        await client.put("cfg/a", b"1")
+        await _wait(
+            lambda: coords[1].seq == coords[0].seq and coords[2].seq == coords[0].seq,
+            msg="followers caught up",
+        )
+        FAULTS.arm("store.promote:crash@1")  # first promotion attempt dies
+        await servers[0].close()
+        await _wait(
+            lambda: any(c.role == "leader" for c in coords[1:]),
+            msg="promotion despite the crashed first attempt",
+        )
+        assert FAULTS.fired("store.promote") == 1
+        assert [c.role for c in coords[1:]].count("leader") == 1
+        # The aborted attempt left no trace: one epoch bump total.
+        assert max(c.epoch for c in coords[1:]) == 2
+        assert await client.get("cfg/a") == b"1"
+    finally:
+        await _shutdown(servers, client)
+
+
 async def test_lease_keepalive_fault_drill():
     from dynamo_tpu.runtime.discovery import MemoryStore
 

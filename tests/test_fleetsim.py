@@ -221,6 +221,12 @@ def test_parse_control_plane_metrics_text():
         'dynamo_engine_step_time_seconds_total{kind="dispatch",worker="w1"} 3.0',
         'dynamo_anomaly_active{kind="recompile_storm",worker="w2"} 1.0',
         'dynamo_anomaly_fired_total{kind="recompile_storm",worker="w2"} 2.0',
+        # HA control plane: failover/retry view + reconstruction signals.
+        "dynamo_router_index_resyncs_total 4.0",
+        "dynamo_store_failovers_total 1.0",
+        "dynamo_store_client_op_retries_total 2.0",
+        'dynamo_frontend_cached_prompt_tokens_total{model="a"} 64.0',
+        'dynamo_frontend_cached_prompt_tokens_total{model="b"} 16.0',
         "not_a_metric",
     ])
     snap = parse_control_plane(text)
@@ -232,6 +238,10 @@ def test_parse_control_plane_metrics_text():
     assert snap["step_time_s"] == {"wall": 4.0, "dispatch": 3.0}
     assert snap["anomaly_active"] == {"recompile_storm": 1.0}
     assert snap["anomaly_fired"] == {"recompile_storm": 2.0}
+    assert snap["router_resyncs"] == 4.0
+    assert snap["store_failovers"] == 1.0
+    assert snap["store_client_retries"] == 2.0
+    assert snap["cached_tokens"] == 80.0  # summed across models
 
 
 def test_scoreboard_loss_accounting_and_anomaly_report():
@@ -327,8 +337,8 @@ def test_cache_rate_from_profile(monkeypatch):
 
 def test_scenario_registry_and_dry_run():
     assert {"smoke", "burst_absorb", "tenant_flood", "kill_midstream",
-            "incident_capture", "period_shift", "fleet_accept",
-            "diurnal_soak"} <= set(SCENARIOS)
+            "incident_capture", "store_failover", "frontend_restart",
+            "period_shift", "fleet_accept", "diurnal_soak"} <= set(SCENARIOS)
     assert SCENARIOS["diurnal_soak"].tier == "soak"
     rep = asyncio.run(run_scenario(SCENARIOS["fleet_accept"], dry_run=True))
     rep2 = asyncio.run(run_scenario(SCENARIOS["fleet_accept"], dry_run=True))
@@ -407,6 +417,34 @@ def test_scenario_incident_capture_live():
     assert report["incidents"]["kinds"].get("crash", 0) >= 1
     assert report["incidents"]["fetch_ok"] == 1
     assert report["requests"]["ok"] >= 3
+
+
+@pytest.mark.e2e
+def test_scenario_store_failover_live():
+    """Kill-the-leader gate (HA control plane): SIGKILL the store leader of
+    a 3-replica cluster mid-trace. A follower must promote under the epoch
+    fence inside the budget, no declarative key may be lost, no worker may
+    lose its registration, and the serving plane keeps scoring."""
+    report = _run("store_failover")
+    ha = report["store_ha"]
+    assert ha["declarative_lost"] == 0
+    assert ha["worker_deregistrations"] == 0
+    assert 0 < ha["failover_s"] <= 5.0
+    assert ha["epoch"] >= 2
+    assert report["requests"]["ok"] >= 10
+
+
+@pytest.mark.e2e
+def test_scenario_frontend_restart_live():
+    """Frontend reconstruction gate: bounce the frontend mid-trace. The
+    replacement rebuilds the prefix index from worker KV-event snapshots
+    (resyncs observed across the bounce), recovers warm routing (cache hits
+    on the fresh registry), and no stream wedges."""
+    report = _run("frontend_restart")
+    assert report["frontend"]["bounces"] >= 1
+    assert report["frontend"]["resyncs"] >= 1
+    assert report["control_plane"]["cached_tokens_final"] > 0
+    assert report["requests"]["ok"] >= 8
 
 
 @pytest.mark.e2e

@@ -1,0 +1,389 @@
+"""HA control plane: store replication, epoch-fenced failover, client HA.
+
+Every test stands up a real replicated cluster — N ``StoreServer`` instances
+on loopback ports with ``attach_replication`` coordinators — and exercises
+the wire protocol end to end: log-shipping byte-exactness, the epoch fence
+against a stale ex-leader, lease continuity across a leader kill,
+multi-endpoint client failover with watch re-arm, promotion determinism,
+and WAL durability on a promoted follower.
+"""
+
+import asyncio
+import socket
+
+import pytest
+
+from dynamo_tpu.runtime.discovery import MemoryStore, WatchEventType
+from dynamo_tpu.runtime.persist import PersistentStore
+from dynamo_tpu.runtime.replication import attach_replication, replica_snapshot
+from dynamo_tpu.runtime.store_server import (
+    StoreClient,
+    StoreServer,
+    store_client_snapshot,
+)
+
+pytestmark = pytest.mark.store_ha
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+async def _cluster(n: int, stores=None, *, promote_after_s=0.3, poll_s=0.05, **knobs):
+    """N replicas on loopback; returns (peers, servers, coords)."""
+    ports = [_free_port() for _ in range(n)]
+    peers = [f"tcp://127.0.0.1:{p}" for p in ports]
+    servers, coords = [], []
+    for i, port in enumerate(ports):
+        store = stores[i] if stores is not None else MemoryStore()
+        srv = await StoreServer(store, host="127.0.0.1", port=port).start()
+        coord = attach_replication(
+            srv, peers, i, promote_after_s=promote_after_s, poll_s=poll_s, **knobs
+        )
+        await coord.start()
+        servers.append(srv)
+        coords.append(coord)
+    return peers, servers, coords
+
+
+async def _shutdown(servers, client=None):
+    if client is not None:
+        await client.close()
+    for srv in servers:
+        if srv._server is not None:
+            await srv.close()
+
+
+async def _wait(predicate, timeout=8.0, every=0.05, msg="condition"):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while True:
+        result = predicate()
+        if asyncio.iscoroutine(result):
+            result = await result
+        if result:
+            return
+        assert loop.time() < deadline, f"timed out waiting for {msg}"
+        await asyncio.sleep(every)
+
+
+async def _converged(leader_srv, follower_srv) -> bool:
+    return await leader_srv.store.get_prefix("") == await follower_srv.store.get_prefix("")
+
+
+# -- replication semantics ---------------------------------------------------
+
+
+async def test_mutation_storm_replicates_byte_exact():
+    """Log shipping: after a storm of puts/overwrites/deletes/leases, every
+    follower's full keyspace is byte-identical to the leader's."""
+    peers, servers, coords = await _cluster(3)
+    client = StoreClient.from_url(",".join(peers))
+    try:
+        lease = await client.create_lease(30.0)
+        for i in range(40):
+            await client.put(f"cfg/{i % 13}", f"v{i}".encode())
+        for i in range(0, 13, 3):
+            await client.delete(f"cfg/{i}")
+        assert await client.put_if_absent("once", b"first")
+        assert not await client.put_if_absent("once", b"second")  # not recorded twice
+        await client.put(f"instances/w:{lease.id:x}", b"\x00\xffbin", lease_id=lease.id)
+        await client.keep_alive(lease.id)
+
+        want = await servers[0].store.get_prefix("")
+        assert want["once"] == b"first"
+        await _wait(
+            lambda: coords[1].seq == coords[0].seq and coords[2].seq == coords[0].seq,
+            msg="log fully shipped",
+        )
+        for i in (1, 2):
+            assert await servers[i].store.get_prefix("") == want
+            # The lease-bound key is lease-bound on the follower too.
+            assert servers[i].store._key_lease[f"instances/w:{lease.id:x}"] == lease.id
+        assert coords[0].epoch == coords[1].epoch == coords[2].epoch == 1
+    finally:
+        await _shutdown(servers, client)
+
+
+async def test_epoch_fence_demotes_stale_leader_and_discards_divergence():
+    """Split-brain heal: a usurper promotion bumps the epoch; the stale
+    ex-leader is fenced on its next peer poll, demotes, resyncs from the new
+    leader, and its divergent write vanishes — never two leaders at rest."""
+    peers, servers, coords = await _cluster(2, poll_s=0.05, promote_after_s=30)
+    client = StoreClient.from_url(",".join(peers))
+    try:
+        await client.put("cfg/shared", b"v1")
+        await _wait(lambda: _converged(servers[0], servers[1]), msg="initial convergence")
+
+        # Force a usurper: the follower promotes while the old leader lives.
+        await coords[1].promote()
+        assert coords[1].role == "leader" and coords[1].epoch == 2
+
+        # The stale leader accepts a divergent write (epoch-1 world)...
+        await servers[0]._execute("put", {"key": "cfg/divergent", "value": b"stale"})
+        assert await servers[0].store.get("cfg/divergent") == b"stale"
+
+        # ...until the watchdog sees epoch 2 and fences it.
+        await _wait(lambda: coords[0].role == "follower", msg="stale leader demotion")
+        await _wait(lambda: coords[0].epoch == 2, msg="ex-leader resync to epoch 2")
+        # Resync reconciled away the divergent write; real state survived.
+        await _wait(
+            lambda: servers[0].store._data.get("cfg/divergent") is None,
+            msg="divergent write discarded",
+        )
+        assert await servers[0].store.get("cfg/shared") == b"v1"
+        assert await servers[1].store.get("cfg/divergent") is None
+        assert [c.role for c in coords].count("leader") == 1
+    finally:
+        await _shutdown(servers, client)
+
+
+async def test_stale_follower_handshake_is_fence_too():
+    """The replicate handshake fences in both directions: a follower that has
+    seen a higher epoch demotes the leader it dials."""
+    peers, servers, coords = await _cluster(2, promote_after_s=30)
+    try:
+        await _wait(lambda: coords[1].leader_url == peers[0] and coords[1].epoch == 1,
+                    msg="follower subscribed")
+        # Simulate the follower having witnessed a newer epoch elsewhere.
+        coords[1].epoch = 5
+        with pytest.raises(Exception):
+            await coords[1]._follow(peers[0])
+        await _wait(lambda: coords[0].role == "follower", msg="leader fenced by handshake")
+    finally:
+        await _shutdown(servers)
+
+
+async def test_lease_continuity_across_handoff():
+    """Workers do NOT deregister on failover: replicated keepalives re-arm the
+    lease on followers, promotion grants a grace TTL, and the owner's next
+    keepalive lands on the new leader."""
+    peers, servers, coords = await _cluster(3, promote_after_s=0.3, poll_s=0.05)
+    client = StoreClient.from_url(",".join(peers))
+    try:
+        lease = await client.create_lease(1.5)
+        key = f"instances/worker:{lease.id:x}"
+        await client.put(key, b"registered", lease_id=lease.id)
+        await client.keep_alive(lease.id)
+        await _wait(lambda: _converged(servers[0], servers[1]), msg="lease replication")
+
+        kill_at = asyncio.get_running_loop().time()
+        await servers[0].close()
+        await _wait(lambda: any(c.role == "leader" for c in coords[1:]), msg="promotion")
+
+        # The instance key must survive past the original TTL measured from
+        # the kill — promotion grace + clock-relative adoption guarantee it.
+        await client.keep_alive(lease.id)  # client failover path
+        await asyncio.sleep(max(0.0, kill_at + 1.7 - asyncio.get_running_loop().time()))
+        assert (await client.get(key)) == b"registered"
+
+        # And the lease still expires honestly once keepalives really stop.
+        new_leader = next(s for s, c in zip(servers[1:], coords[1:]) if c.role == "leader")
+        await asyncio.sleep(2.0)
+        assert await new_leader.store.get(key) is None
+    finally:
+        await _shutdown(servers, client)
+
+
+async def test_promotion_determinism_rank_order():
+    """Election rank is the total order (epoch, seq, -index): only the
+    freshest reachable follower answers yes; ties break to the lowest index."""
+    ports = [_free_port() for _ in range(3)]
+    peers = [f"tcp://127.0.0.1:{p}" for p in ports]
+    servers, coords = [], []
+    for i in (1, 2):  # peers[0] (the bootstrap leader) is never started
+        srv = await StoreServer(MemoryStore(), host="127.0.0.1", port=ports[i]).start()
+        coord = attach_replication(srv, peers, i, promote_after_s=60, poll_s=0.05)
+        await coord.start()
+        servers.append(srv)
+        coords.append(coord)
+    try:
+        c1, c2 = coords
+        c1.seq, c2.seq = 5, 9
+        assert await c2._should_promote()  # freshest log wins
+        assert not await c1._should_promote()
+        c1.seq = 9
+        assert await c1._should_promote()  # tie: lower index wins
+        assert not await c2._should_promote()
+        c1.epoch = 1
+        assert await c1._should_promote()  # higher epoch dominates seq
+        c2.seq = 10_000
+        assert not await c2._should_promote()
+    finally:
+        await _shutdown(servers)
+
+
+# -- client HA ---------------------------------------------------------------
+
+
+async def test_client_failover_retries_idempotent_ops_once():
+    """A multi-endpoint client rides a leader SIGKILL: the in-flight/next op
+    reconnects via who_leads discovery and replays exactly once, counted in
+    dynamo_store_client_op_retries_total's source."""
+    peers, servers, coords = await _cluster(2, promote_after_s=0.2, poll_s=0.05)
+    client = StoreClient.from_url(",".join(peers))
+    try:
+        await client.put("cfg/a", b"1")
+        await _wait(lambda: coords[1].seq == coords[0].seq, msg="follower caught up")
+        retries_before = store_client_snapshot()["retries"]
+        await servers[0].close()
+        assert await client.get("cfg/a") == b"1"  # survived via retry+failover
+        assert store_client_snapshot()["retries"] == retries_before + 1
+        info = await client.who_leads()
+        assert info["role"] == "leader" and info["epoch"] == 2
+        assert store_client_snapshot()["epoch"] >= 2
+        await client.put("cfg/b", b"2")  # mutations land on the new leader
+        assert await client.get("cfg/b") == b"2"
+    finally:
+        await _shutdown(servers, client)
+
+
+async def test_client_raises_when_no_leader_within_window():
+    """With every replica dead, the client gives up after the failover window
+    instead of hanging — and non-idempotent ops are never silently replayed."""
+    peers, servers, coords = await _cluster(2)
+    client = StoreClient.from_url(",".join(peers))
+    client._failover_timeout_s = 0.5
+    try:
+        await client.put("cfg/a", b"1")
+        for srv in servers:
+            await srv.close()
+        with pytest.raises(ConnectionError):
+            await client.get("cfg/a")
+    finally:
+        await _shutdown(servers, client)
+
+
+async def test_watch_rearms_across_failover_with_synthetic_deletes():
+    """An HA watch survives the death of the replica serving it: it re-arms
+    against a live replica, replays current state, and synthesizes DELETE
+    events for keys that vanished while it was dark."""
+    peers, servers, coords = await _cluster(2, promote_after_s=0.2, poll_s=0.05)
+    client = StoreClient.from_url(",".join(peers))
+    events: list = []
+
+    async def _watch():
+        async for ev in client.watch_prefix("w/"):
+            events.append(ev)
+
+    task = asyncio.create_task(_watch())
+    try:
+        await client.put("w/keep", b"k")
+        await client.put("w/drop", b"d")
+        await _wait(lambda: len(events) >= 2, msg="initial watch events")
+        await _wait(lambda: coords[1].seq == coords[0].seq, msg="follower caught up")
+        # The watch walks endpoints from index 0, so it is held by replica 0.
+        await servers[0].close()
+        await _wait(lambda: coords[1].role == "leader", msg="promotion")
+        await client.delete("w/drop")  # happens while the watch is dark
+        await client.put("w/new", b"n")
+        await _wait(
+            lambda: any(e.type is WatchEventType.DELETE and e.key == "w/drop" for e in events),
+            msg="synthetic DELETE for w/drop",
+        )
+        await _wait(
+            lambda: any(e.type is WatchEventType.PUT and e.key == "w/new" for e in events),
+            msg="post-failover PUT event",
+        )
+        # Re-announced state after re-arm never invents keys.
+        assert {e.key for e in events} <= {"w/keep", "w/drop", "w/new"}
+    finally:
+        task.cancel()
+        await _shutdown(servers, client)
+
+
+# -- durability --------------------------------------------------------------
+
+
+async def test_wal_replay_on_promoted_follower(tmp_path):
+    """A PersistentStore-backed follower WALs every replicated record; after
+    promotion and a crash, replay recovers all declarative keys — including
+    ones written both before and after the handoff."""
+    wal = tmp_path / "follower.wal"
+    stores = [MemoryStore(), await PersistentStore.open(wal)]
+    peers, servers, coords = await _cluster(
+        2, stores=stores, promote_after_s=0.2, poll_s=0.05
+    )
+    client = StoreClient.from_url(",".join(peers))
+    try:
+        await client.put("deployments/a", b"spec-a")
+        lease = await client.create_lease(30.0)
+        await client.put(f"instances/w:{lease.id:x}", b"eph", lease_id=lease.id)
+        await _wait(lambda: _converged(servers[0], servers[1]), msg="follower caught up")
+
+        await servers[0].close()
+        await _wait(lambda: coords[1].role == "leader", msg="promotion")
+        await client.put("deployments/b", b"spec-b")  # written by the new leader
+    finally:
+        await _shutdown(servers, client)
+
+    replayed = await PersistentStore.open(wal)
+    try:
+        assert await replayed.get("deployments/a") == b"spec-a"
+        assert await replayed.get("deployments/b") == b"spec-b"
+        # Lease-bound keys stay ephemeral: their owner died with the cluster.
+        assert await replayed.get_prefix("instances/") == {}
+    finally:
+        replayed.close_log()
+        await replayed.close()
+
+
+# -- dormancy ----------------------------------------------------------------
+
+
+async def test_single_replica_mode_stays_dormant():
+    """No replica list -> no coordinator: who_leads answers 'single', the
+    client takes the pre-HA path, and no replication machinery exists."""
+    server = await StoreServer(MemoryStore(), host="127.0.0.1", port=0).start()
+    client = StoreClient.from_url(f"tcp://127.0.0.1:{server.port}")
+    try:
+        assert server.repl is None
+        assert not client._multi
+        await client.put("k", b"v")
+        assert await client.get("k") == b"v"
+        info = await client.who_leads()
+        assert info == {"role": "single", "leader": None, "epoch": 0, "seq": 0}
+    finally:
+        await _shutdown([server], client)
+
+
+async def test_replica_snapshot_reflects_local_coordinator():
+    peers, servers, coords = await _cluster(2, promote_after_s=30)
+    try:
+        snap = replica_snapshot()
+        assert snap is not None
+        assert snap["role"] in ("leader", "follower")
+        assert {"epoch", "seq", "lag_s", "failovers"} <= set(snap)
+    finally:
+        await _shutdown(servers)
+
+
+async def test_debug_store_endpoint_serves_ha_view():
+    """GET /debug/store: the operator's one-stop HA view — hosted replica
+    state, client failover ledger, router resync counter — answered from
+    process-local snapshots (no store RPC, so it works mid-failover too)."""
+    import aiohttp
+
+    from dynamo_tpu.frontend.http import HttpService
+    from dynamo_tpu.frontend.model_manager import ModelManager
+
+    peers, servers, coords = await _cluster(2, promote_after_s=30)
+    service = HttpService(ModelManager())
+    port = await service.start("127.0.0.1", 0)
+    try:
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"http://127.0.0.1:{port}/debug/store") as r:
+                assert r.status == 200
+                doc = await r.json()
+        assert doc["replica"] is not None
+        assert doc["replica"]["role"] in ("leader", "follower")
+        assert {"epoch", "seq", "lag_s", "failovers"} <= set(doc["replica"])
+        assert {"role", "epoch", "failovers", "retries"} <= set(doc["client"])
+        assert doc["router"]["resyncs"] >= 0
+    finally:
+        await service.stop()
+        await _shutdown(servers)

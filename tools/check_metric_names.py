@@ -95,6 +95,14 @@ REQUIRED_FAMILIES: dict[str, str] = {
     "dynamo_engine_roofline_frac": "engine",
     "dynamo_engine_hbm_bytes": "engine",
     "dynamo_engine_flops": "engine",
+    # HA control plane (replicated store + frontend reconstruction) — the
+    # store_failover / frontend_restart fleetsim gates key on these.
+    "dynamo_store_role": "frontend",
+    "dynamo_store_epoch": "frontend",
+    "dynamo_store_replication_lag_seconds": "frontend",
+    "dynamo_store_failovers_total": "frontend",
+    "dynamo_store_client_op_retries_total": "frontend",
+    "dynamo_router_index_resyncs_total": "frontend",
 }
 
 
